@@ -1,0 +1,20 @@
+"""cxxnet_tpu — a TPU-native neural-net training framework.
+
+A ground-up reimplementation of the capabilities of cxxnet (the 2014 DMLC
+C++/CUDA convnet trainer built on mshadow/mshadow-ps), redesigned for TPU:
+
+* compute path: jax / XLA / Pallas — layers are pure functions assembled into
+  one jitted train step (replaces mshadow expression templates + CUDA kernels,
+  reference: /root/reference/src/layer, src/nnet/neural_net-inl.hpp)
+* parallelism: jax.sharding.Mesh + sharding annotations; gradient sync is an
+  XLA all-reduce over ICI (replaces mshadow-ps push/pull parameter server,
+  reference: src/nnet/nnet_impl-inl.hpp, src/updater/async_updater-inl.hpp)
+* user surface: config-file DSL, iterator chains, trainer tasks
+  (train/finetune/pred/extract), checkpoint/finetune semantics and the
+  Python `DataIter`/`Net`/`train` API are kept compatible with the reference
+  (reference: src/cxxnet_main.cpp, wrapper/cxxnet.py).
+"""
+
+__version__ = "0.1.0"
+
+from . import utils  # noqa: F401

@@ -1,0 +1,9 @@
+"""Layer module: op-graph nodes with shape inference, init, and pure apply.
+
+TPU-native counterpart of the reference's src/layer/ (ILayer ABI + 25 layer
+implementations + factory)."""
+
+from .base import ApplyContext, LabelInfo, Layer, LayerParam, Shape4  # noqa: F401
+from .factory import create_layer, get_layer_type, PairTestLayer  # noqa: F401
+from . import layers  # noqa: F401
+from . import factory  # noqa: F401

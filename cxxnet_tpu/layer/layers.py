@@ -1,0 +1,811 @@
+"""All layer implementations.
+
+Each class reimplements one reference layer's behavior (config surface, shape
+inference, numerics, checkpoint fields) as a pure jax function; the reference
+file is cited per class. Backward passes come from autodiff — the reference's
+hand-written Backprop gradients are exactly the analytic gradients of these
+forward functions, which our golden tests verify (tests/test_layers.py).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import ops
+from ..utils import serializer
+from .base import ApplyContext, Layer, LayerParam, Shape4, check
+
+
+def _flat2d(x: jnp.ndarray) -> jnp.ndarray:
+    return x.reshape(x.shape[0], -1)
+
+
+# ---------------------------------------------------------------------------
+# dense layers
+# ---------------------------------------------------------------------------
+class FullConnectLayer(Layer):
+    """Dense layer: out = in . W^T + b  (src/layer/fullc_layer-inl.hpp:14).
+
+    W is stored (num_hidden, num_input) exactly like the reference so model
+    files are interchangeable. On TPU the matmul runs on the MXU; XLA fuses
+    the bias add.
+    """
+
+    type_name = "fullc"
+
+    def __init__(self):
+        super().__init__()
+        self.fullc_gather = 0
+
+    def set_param(self, name, val):
+        super().set_param(name, val)
+        if name == "fullc_gather":
+            self.fullc_gather = int(val)
+
+    def infer_shape(self, in_shapes):
+        check(len(in_shapes) == 1, "FullcLayer: only support 1-1 connection")
+        b, c, h, w = in_shapes[0]
+        check(c == 1 and h == 1, "FullcLayer: input need to be a matrix")
+        check(self.param.num_hidden > 0, "FullcLayer: must set nhidden correctly")
+        if self.param.num_input_node == 0:
+            self.param.num_input_node = w
+        else:
+            check(self.param.num_input_node == w,
+                  "FullcLayer: input hidden nodes is not consistent")
+        return [(b, 1, 1, self.param.num_hidden)]
+
+    def init_params(self, rng):
+        p = self.param
+        wmat = p.rand_init_weight(rng, (p.num_hidden, p.num_input_node),
+                                  in_num=p.num_input_node, out_num=p.num_hidden)
+        out = {"wmat": wmat}
+        if p.no_bias == 0:
+            out["bias"] = np.full((p.num_hidden,), p.init_bias, np.float32)
+        return out
+
+    def apply(self, params, inputs, ctx):
+        x = _flat2d(inputs[0])
+        y = x @ params["wmat"].T
+        if self.param.no_bias == 0:
+            y = y + params["bias"]
+        return [y.reshape(y.shape[0], 1, 1, y.shape[1])]
+
+    def visit_order(self):
+        if self.param.no_bias == 0:
+            return [("wmat", "wmat"), ("bias", "bias")]
+        return [("wmat", "wmat")]
+
+    def save_model(self, w, params):
+        self.param.save(w)
+        w.write_tensor(params["wmat"])
+        w.write_tensor(params.get("bias", np.zeros((self.param.num_hidden,), np.float32)))
+
+    def load_model(self, r):
+        self.param.load(r)
+        wmat = r.read_tensor()
+        bias = r.read_tensor()
+        out = {"wmat": wmat}
+        if self.param.no_bias == 0:
+            out["bias"] = bias
+        return out
+
+
+class FixConnectLayer(Layer):
+    """Frozen dense layer whose weight comes from a sparse-matrix text file
+    (src/layer/fixconn_layer-inl.hpp:14). File format: header "nrow ncol nnz"
+    then nnz lines of "row col value". No weight gradient."""
+
+    type_name = "fixconn"
+
+    def __init__(self):
+        super().__init__()
+        self.fname_weight = "NULL"
+
+    def set_param(self, name, val):
+        super().set_param(name, val)
+        if name == "fixconn_weight":
+            self.fname_weight = val
+
+    def infer_shape(self, in_shapes):
+        check(len(in_shapes) == 1, "FixConnLayer: only support 1-1 connection")
+        b, c, h, w = in_shapes[0]
+        check(c == 1 and h == 1, "FixConnLayer: input need to be a matrix")
+        check(self.param.num_hidden > 0, "FixConnLayer: must set nhidden correctly")
+        check(self.fname_weight != "NULL", "FixConnLayer: must specify fixconn_weight")
+        wm = np.zeros((self.param.num_hidden, w), np.float32)
+        with open(self.fname_weight) as f:
+            toks = f.read().split()
+        nrow, ncol, nnz = int(toks[0]), int(toks[1]), int(toks[2])
+        check(nrow == wm.shape[0] and ncol == wm.shape[1],
+              "FixConnLayer: fixconn_weight shape do not match architecture")
+        for i in range(nnz):
+            x, y, v = int(toks[3 + 3 * i]), int(toks[4 + 3 * i]), float(toks[5 + 3 * i])
+            wm[x, y] = v
+        self._wmat = wm
+        return [(b, 1, 1, self.param.num_hidden)]
+
+    def init_params(self, rng):
+        return {"wmat": self._wmat}
+
+    def apply(self, params, inputs, ctx):
+        w = jax.lax.stop_gradient(params["wmat"])
+        x = _flat2d(inputs[0])
+        y = x @ w.T
+        return [y.reshape(y.shape[0], 1, 1, y.shape[1])]
+
+
+class BiasLayer(Layer):
+    """Self-loop additive bias on flat nodes (src/layer/bias_layer-inl.hpp:14)."""
+
+    type_name = "bias"
+    self_loop = True
+
+    def infer_shape(self, in_shapes):
+        b, c, h, w = in_shapes[0]
+        check(c == 1 and h == 1, "BiasLayer only works for flatten node so far")
+        if self.param.num_input_node == 0:
+            self.param.num_input_node = w
+        else:
+            check(self.param.num_input_node == w,
+                  "BiasLayer: input hidden nodes is not consistent")
+        return [in_shapes[0]]
+
+    def init_params(self, rng):
+        return {"bias": np.full((self.param.num_input_node,),
+                                self.param.init_bias, np.float32)}
+
+    def apply(self, params, inputs, ctx):
+        return [inputs[0] + params["bias"]]
+
+    def visit_order(self):
+        return [("bias", "bias")]
+
+    def save_model(self, w, params):
+        self.param.save(w)
+        w.write_tensor(params["bias"])
+
+    def load_model(self, r):
+        self.param.load(r)
+        return {"bias": r.read_tensor()}
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+class ActivationLayer(Layer):
+    """Elementwise activation (src/layer/activation_layer-inl.hpp:12 over the
+    op structs in src/layer/op.h)."""
+
+    fn = staticmethod(lambda x: x)
+
+    def infer_shape(self, in_shapes):
+        check(len(in_shapes) == 1, "ActivationLayer only support 1-1 connection")
+        return [in_shapes[0]]
+
+    def apply(self, params, inputs, ctx):
+        return [self.fn(inputs[0])]
+
+
+class ReluLayer(ActivationLayer):
+    type_name = "relu"
+    fn = staticmethod(lambda x: jnp.maximum(x, 0.0))
+
+
+class SigmoidLayer(ActivationLayer):
+    type_name = "sigmoid"
+    fn = staticmethod(jax.nn.sigmoid)
+
+
+class TanhLayer(ActivationLayer):
+    type_name = "tanh"
+    fn = staticmethod(jnp.tanh)
+
+
+class SoftplusLayer(ActivationLayer):
+    """softplus is parseable in the reference (layer.h:331) but missing from
+    its factory — we implement it properly instead of erroring."""
+    type_name = "softplus"
+    fn = staticmethod(jax.nn.softplus)
+
+
+class XeluLayer(Layer):
+    """Leaky relu with divisor b: y = x > 0 ? x : x/b
+    (src/layer/xelu_layer-inl.hpp:15)."""
+
+    type_name = "xelu"
+
+    def __init__(self):
+        super().__init__()
+        self.b = 5.0
+
+    def set_param(self, name, val):
+        if name == "b":
+            self.b = float(val)
+
+    def infer_shape(self, in_shapes):
+        return [in_shapes[0]]
+
+    def apply(self, params, inputs, ctx):
+        return [ops.xelu(inputs[0], self.b)]
+
+
+class InsanityLayer(Layer):
+    """RReLU (src/layer/insanity_layer-inl.hpp:14): during training the
+    negative part is divided by a per-element random slope in [lb, ub]; at
+    eval by the mean slope. calm_start/calm_end linearly anneal [lb, ub]
+    toward the midpoint (the reference accumulates the shrink statefully
+    across forward calls; we use the intended linear schedule on the update
+    counter)."""
+
+    type_name = "insanity"
+
+    def __init__(self):
+        super().__init__()
+        self.lb = 5.0
+        self.ub = 10.0
+        self.calm_start = 0
+        self.calm_end = 0
+
+    def set_param(self, name, val):
+        if name == "lb":
+            self.lb = float(val)
+        if name == "ub":
+            self.ub = float(val)
+        if name == "calm_start":
+            self.calm_start = int(val)
+        if name == "calm_end":
+            self.calm_end = int(val)
+
+    def infer_shape(self, in_shapes):
+        return [in_shapes[0]]
+
+    def _bounds(self, epoch):
+        mid = (self.lb + self.ub) / 2.0
+        if self.calm_end > self.calm_start:
+            frac = jnp.clip((epoch - self.calm_start)
+                            / float(self.calm_end - self.calm_start), 0.0, 1.0)
+        else:
+            frac = 0.0
+        ub = self.ub - (self.ub - mid) * frac
+        lb = self.lb + (mid - self.lb) * frac
+        return lb, ub
+
+    def apply(self, params, inputs, ctx):
+        x = inputs[0]
+        lb, ub = self._bounds(ctx.epoch)
+        if ctx.train:
+            u = jax.random.uniform(ctx.rng, x.shape, x.dtype)
+            mask = u * (ub - lb) + lb
+            return [ops.xelu(x, mask)]
+        return [ops.xelu(x, (self.lb + self.ub) / 2.0)]
+
+
+class PReluLayer(Layer):
+    """Learnable per-channel negative slope, optional training noise
+    (src/layer/prelu_layer-inl.hpp:48). Slope mask is clipped to [0, 1];
+    y = x > 0 ? x : x * mask."""
+
+    type_name = "prelu"
+
+    def __init__(self):
+        super().__init__()
+        self.init_slope = 0.25
+        self.init_random = 0
+        self.random = 0.0
+
+    def set_param(self, name, val):
+        if name == "init_slope":
+            self.init_slope = float(val)
+        if name == "random_slope":
+            self.init_random = int(val)
+        if name == "random":
+            self.random = float(val)
+
+    def infer_shape(self, in_shapes):
+        b, c, h, w = in_shapes[0]
+        self.channel = w if c == 1 else c
+        self.is_fc = (c == 1)
+        return [in_shapes[0]]
+
+    def init_params(self, rng):
+        if self.init_random == 0:
+            slope = np.full((self.channel,), self.init_slope, np.float32)
+        else:
+            slope = (rng.uniform(0, 1, (self.channel,)) * self.init_slope).astype(np.float32)
+        return {"slope": slope}
+
+    def apply(self, params, inputs, ctx):
+        x = inputs[0]
+        slope = params["slope"]
+        bshape = (1, 1, 1, self.channel) if self.is_fc else (1, self.channel, 1, 1)
+        mask = jnp.broadcast_to(slope.reshape(bshape), x.shape)
+        if ctx.train and self.random != 0.0:
+            u = jax.random.uniform(ctx.rng, x.shape, x.dtype)
+            mask = mask * (1 + u * self.random * 2.0 - self.random)
+        mask = jnp.clip(mask, 0.0, 1.0)
+        return [ops.mxelu(x, mask)]
+
+    def visit_order(self):
+        # the reference visits the slope under the "bias" tag
+        # (prelu_layer-inl.hpp ApplyVisitor)
+        return [("bias", "slope")]
+
+    def save_model(self, w, params):
+        w.write_tensor(params["slope"])
+
+    def load_model(self, r):
+        return {"slope": r.read_tensor()}
+
+
+class MaxoutLayer(Layer):
+    """Channel-group maxout. The reference parses ``maxout`` (layer.h:342)
+    but never implemented it; we provide the standard formulation: every
+    ``ngroup`` *adjacent* channels (features for flat input) form one piece
+    reduced with max, so out[j] = max(in[j*g : (j+1)*g])."""
+
+    type_name = "maxout"
+
+    def infer_shape(self, in_shapes):
+        b, c, h, w = in_shapes[0]
+        g = self.param.num_group
+        if c == 1:
+            check(w % g == 0, "maxout: input width must divide ngroup")
+            return [(b, 1, 1, w // g)]
+        check(c % g == 0, "maxout: input channels must divide ngroup")
+        return [(b, c // g, h, w)]
+
+    def apply(self, params, inputs, ctx):
+        x = inputs[0]
+        g = self.param.num_group
+        b, c, h, w = x.shape
+        if c == 1:
+            return [jnp.max(x.reshape(b, 1, 1, w // g, g), axis=4)]
+        return [jnp.max(x.reshape(b, c // g, g, h, w), axis=2)]
+
+
+# ---------------------------------------------------------------------------
+# shape / routing layers
+# ---------------------------------------------------------------------------
+class FlattenLayer(Layer):
+    """(b,c,h,w) -> (b,1,1,c*h*w) (src/layer/flatten_layer-inl.hpp:11)."""
+
+    type_name = "flatten"
+
+    def infer_shape(self, in_shapes):
+        b, c, h, w = in_shapes[0]
+        return [(b, 1, 1, c * h * w)]
+
+    def apply(self, params, inputs, ctx):
+        x = inputs[0]
+        return [x.reshape(x.shape[0], 1, 1, -1)]
+
+
+class ConcatLayer(Layer):
+    """N->1 concat along dim 3 (src/layer/concat_layer-inl.hpp:12)."""
+
+    type_name = "concat"
+    dim = 3
+
+    def infer_shape(self, in_shapes):
+        check(1 < len(in_shapes) <= 4, "Concat layer supports 2-4 inputs")
+        oshape = list(in_shapes[0])
+        total = 0
+        for s in in_shapes:
+            total += s[self.dim]
+            for j in range(4):
+                if j != self.dim:
+                    check(s[j] == oshape[j], "Concat shape doesn't match")
+        oshape[self.dim] = total
+        return [tuple(oshape)]
+
+    def apply(self, params, inputs, ctx):
+        return [jnp.concatenate(inputs, axis=self.dim)]
+
+
+class ChConcatLayer(ConcatLayer):
+    """N->1 concat along the channel dim (layer_impl-inl.hpp:62)."""
+    type_name = "ch_concat"
+    dim = 1
+
+
+class SplitLayer(Layer):
+    """1->N copy forward, summed gradients backward
+    (src/layer/split_layer-inl.hpp:12)."""
+
+    type_name = "split"
+
+    def __init__(self, n_out: int = 2):
+        super().__init__()
+        # fan-out; the net sets this from the connection's out-node count
+        # before infer_shape (the reference derives it from nodes_out.size())
+        self.n_out = n_out
+
+    def infer_shape(self, in_shapes):
+        return [in_shapes[0]] * self.n_out
+
+    def apply(self, params, inputs, ctx):
+        return [inputs[0]] * self.n_out
+
+
+class DropoutLayer(Layer):
+    """Inverted dropout, self-loop (src/layer/dropout_layer-inl.hpp:12)."""
+
+    type_name = "dropout"
+    self_loop = True
+
+    def __init__(self):
+        super().__init__()
+        self.threshold = 0.0
+
+    def set_param(self, name, val):
+        if name == "threshold":
+            self.threshold = float(val)
+
+    def infer_shape(self, in_shapes):
+        check(0.0 <= self.threshold < 1.0, "DropoutLayer: invalid dropout threshold")
+        return [in_shapes[0]]
+
+    def apply(self, params, inputs, ctx):
+        x = inputs[0]
+        if not ctx.train:
+            return [x]
+        pkeep = 1.0 - self.threshold
+        mask = (jax.random.uniform(ctx.rng, x.shape, x.dtype) < pkeep) / pkeep
+        return [x * mask]
+
+
+# ---------------------------------------------------------------------------
+# convolution / pooling / normalization
+# ---------------------------------------------------------------------------
+class ConvolutionLayer(Layer):
+    """Grouped 2-D convolution (src/layer/convolution_layer-inl.hpp:13).
+
+    The reference im2cols and GEMMs on a chunked batch; on TPU this is one
+    XLA convolution on the MXU with feature_group_count = ngroup. Weights are
+    stored in the reference's (ngroup, co/g, ci/g*kh*kw) layout for model
+    compatibility and reshaped to OIHW at apply time (a free reshape under
+    jit)."""
+
+    type_name = "conv"
+
+    def infer_shape(self, in_shapes):
+        check(len(in_shapes) == 1, "ConvolutionLayer only support 1-1 connection")
+        p = self.param
+        b, c, h, w = in_shapes[0]
+        check(c % p.num_group == 0, "input channels must divide group size")
+        check(p.num_channel % p.num_group == 0, "output channels must divide group size")
+        check(p.num_channel > 0, "must set nchannel correctly")
+        check(p.kernel_height > 0 and p.kernel_width > 0, "must set kernel_size correctly")
+        check(p.kernel_width <= w and p.kernel_height <= h, "kernel size exceed input")
+        if p.num_input_channel == 0:
+            p.num_input_channel = c
+        else:
+            check(p.num_input_channel == c,
+                  "ConvolutionLayer: number of input channels is not consistent")
+        oh = ops.conv_out_dim(h, p.kernel_height, p.stride, p.pad_y)
+        ow = ops.conv_out_dim(w, p.kernel_width, p.stride, p.pad_x)
+        return [(b, p.num_channel, oh, ow)]
+
+    def init_params(self, rng):
+        p = self.param
+        g = p.num_group
+        shape = (g, p.num_channel // g,
+                 p.num_input_channel // g * p.kernel_height * p.kernel_width)
+        wmat = p.rand_init_weight(rng, shape, in_num=shape[2], out_num=shape[1])
+        out = {"wmat": wmat}
+        if p.no_bias == 0:
+            out["bias"] = np.full((p.num_channel,), p.init_bias, np.float32)
+        return out
+
+    def _kernel_oihw(self, wmat: jnp.ndarray) -> jnp.ndarray:
+        p = self.param
+        return wmat.reshape(p.num_channel, p.num_input_channel // p.num_group,
+                            p.kernel_height, p.kernel_width)
+
+    def apply(self, params, inputs, ctx):
+        p = self.param
+        y = ops.conv2d(inputs[0], self._kernel_oihw(params["wmat"]),
+                       stride=p.stride, pad=(p.pad_y, p.pad_x),
+                       groups=p.num_group)
+        if p.no_bias == 0:
+            y = y + params["bias"].reshape(1, -1, 1, 1)
+        return [y]
+
+    def visit_order(self):
+        if self.param.no_bias == 0:
+            return [("wmat", "wmat"), ("bias", "bias")]
+        return [("wmat", "wmat")]
+
+    def save_model(self, w, params):
+        self.param.save(w)
+        w.write_tensor(params["wmat"])
+        w.write_tensor(params.get("bias",
+                                  np.zeros((self.param.num_channel,), np.float32)))
+
+    def load_model(self, r):
+        self.param.load(r)
+        wmat = r.read_tensor()
+        bias = r.read_tensor()
+        out = {"wmat": wmat}
+        if self.param.no_bias == 0:
+            out["bias"] = bias
+        return out
+
+
+class PoolingLayer(Layer):
+    """max/sum/avg pooling with the reference's ceil-mode shapes
+    (src/layer/pooling_layer-inl.hpp:17)."""
+
+    mode = "max"
+
+    def infer_shape(self, in_shapes):
+        p = self.param
+        b, c, h, w = in_shapes[0]
+        check(p.kernel_height > 0 and p.kernel_width > 0,
+              "must set kernel_size correctly")
+        check(p.kernel_width <= w and p.kernel_height <= h, "kernel size exceed input")
+        oh = ops.pool_out_dim(h, p.kernel_height, p.stride)
+        ow = ops.pool_out_dim(w, p.kernel_width, p.stride)
+        return [(b, c, oh, ow)]
+
+    def _pre(self, x):
+        return x
+
+    def apply(self, params, inputs, ctx):
+        p = self.param
+        x = self._pre(inputs[0])
+        return [ops.pool2d(x, self.mode, (p.kernel_height, p.kernel_width), p.stride)]
+
+
+class MaxPoolingLayer(PoolingLayer):
+    type_name = "max_pooling"
+    mode = "max"
+
+
+class SumPoolingLayer(PoolingLayer):
+    type_name = "sum_pooling"
+    mode = "sum"
+
+
+class AvgPoolingLayer(PoolingLayer):
+    type_name = "avg_pooling"
+    mode = "avg"
+
+
+class ReluMaxPoolingLayer(MaxPoolingLayer):
+    """Fused relu-then-maxpool (layer_impl-inl.hpp:55-56); XLA fuses the relu
+    into the reduce_window."""
+    type_name = "relu_max_pooling"
+
+    def _pre(self, x):
+        return jnp.maximum(x, 0.0)
+
+
+class InsanityPoolingLayer(MaxPoolingLayer):
+    """Stochastic jittered max-pooling
+    (src/layer/insanity_pooling_layer-inl.hpp:13-100): during training each
+    source pixel is, with probability 1-p_keep, displaced one step
+    up/down/left/right (equiprobable, clamped to the image) before the max
+    window reduction. Expressed as a gather + reduce_window — the autodiff
+    gradient equals the reference's InsanityUnPooling. Eval = plain max-pool
+    of the undisplaced input."""
+
+    type_name = "insanity_max_pooling"
+
+    def __init__(self):
+        super().__init__()
+        self.p_keep = 1.0
+
+    def set_param(self, name, val):
+        super().set_param(name, val)
+        if name == "keep":
+            self.p_keep = float(val)
+
+    def apply(self, params, inputs, ctx):
+        p = self.param
+        x = inputs[0]
+        if ctx.train:
+            b, c, h, w = x.shape
+            flag = jax.random.uniform(ctx.rng, x.shape, x.dtype)
+            delta = (1.0 - self.p_keep) / 4.0
+            yy = jnp.arange(h).reshape(1, 1, h, 1)
+            xx = jnp.arange(w).reshape(1, 1, 1, w)
+            loc_y = jnp.broadcast_to(yy, x.shape)
+            loc_x = jnp.broadcast_to(xx, x.shape)
+            loc_y = jnp.where((flag >= self.p_keep) & (flag < self.p_keep + delta),
+                              jnp.maximum(loc_y - 1, 0), loc_y)
+            loc_y = jnp.where((flag >= self.p_keep + delta) & (flag < self.p_keep + 2 * delta),
+                              jnp.minimum(loc_y + 1, h - 1), loc_y)
+            loc_x = jnp.where((flag >= self.p_keep + 2 * delta) & (flag < self.p_keep + 3 * delta),
+                              jnp.maximum(loc_x - 1, 0), loc_x)
+            loc_x = jnp.where(flag >= self.p_keep + 3 * delta,
+                              jnp.minimum(loc_x + 1, w - 1), loc_x)
+            flat_idx = loc_y * w + loc_x
+            xf = x.reshape(b, c, h * w)
+            x = jnp.take_along_axis(xf, flat_idx.reshape(b, c, h * w), axis=2)
+            x = x.reshape(b, c, h, w)
+        return [ops.pool2d(x, "max", (p.kernel_height, p.kernel_width), p.stride)]
+
+
+class LRNLayer(Layer):
+    """AlexNet cross-channel LRN (src/layer/lrn_layer-inl.hpp:12)."""
+
+    type_name = "lrn"
+
+    def __init__(self):
+        super().__init__()
+        self.nsize = 3
+        self.alpha = 0.0
+        self.beta = 0.0
+        self.knorm = 1.0
+
+    def set_param(self, name, val):
+        if name == "local_size":
+            self.nsize = int(val)
+        if name == "alpha":
+            self.alpha = float(val)
+        if name == "beta":
+            self.beta = float(val)
+        if name == "knorm":
+            self.knorm = float(val)
+
+    def infer_shape(self, in_shapes):
+        return [in_shapes[0]]
+
+    def apply(self, params, inputs, ctx):
+        return [ops.lrn(inputs[0], self.nsize, self.alpha, self.beta, self.knorm)]
+
+
+class BatchNormLayer(Layer):
+    """Batch normalization (src/layer/batch_norm_layer-inl.hpp:14).
+
+    Reference quirk reproduced deliberately: eval mode recomputes minibatch
+    statistics — there are no running averages (doc/layer.md caveat)."""
+
+    type_name = "batch_norm"
+
+    def __init__(self):
+        super().__init__()
+        self.init_slope = 1.0
+        self.init_bias = 0.0
+        self.eps = 1e-10
+
+    def set_param(self, name, val):
+        if name == "init_slope":
+            self.init_slope = float(val)
+        if name == "init_bias":
+            self.init_bias = float(val)
+        if name == "eps":
+            self.eps = float(val)
+
+    def infer_shape(self, in_shapes):
+        b, c, h, w = in_shapes[0]
+        self.is_fc = (c == 1)
+        self.channel = w if self.is_fc else c
+        return [in_shapes[0]]
+
+    def init_params(self, rng):
+        return {"slope": np.full((self.channel,), self.init_slope, np.float32),
+                "bias": np.full((self.channel,), self.init_bias, np.float32)}
+
+    def apply(self, params, inputs, ctx):
+        x = inputs[0]
+        axes = (0, 1, 2) if self.is_fc else (0, 2, 3)
+        bshape = (1, 1, 1, self.channel) if self.is_fc else (1, self.channel, 1, 1)
+        mean = jnp.mean(x, axis=axes).reshape(bshape)
+        var = jnp.mean(jnp.square(x - mean), axis=axes).reshape(bshape)
+        xhat = (x - mean) / jnp.sqrt(var + self.eps)
+        slope = params["slope"].reshape(bshape)
+        bias = params["bias"].reshape(bshape)
+        return [xhat * slope + bias]
+
+    def visit_order(self):
+        # reference visits slope under "wmat", bias under "bias"
+        return [("wmat", "slope"), ("bias", "bias")]
+
+    def save_model(self, w, params):
+        w.write_tensor(params["slope"])
+        w.write_tensor(params["bias"])
+
+    def load_model(self, r):
+        return {"slope": r.read_tensor(), "bias": r.read_tensor()}
+
+
+# ---------------------------------------------------------------------------
+# loss layers (self-loop): forward transforms the node, and the scalar loss
+# they contribute has exactly the reference's hand-set gradient:
+#   d loss / d logits = (transformed - target) * grad_scale/(batch*update_period)
+# (reference: loss_layer_base-inl.hpp:55-66 — note we keep the whole thing
+# on-device instead of the reference's CPU roundtrip :88-100)
+# ---------------------------------------------------------------------------
+class LossLayerBase(Layer):
+    self_loop = True
+    is_loss = True
+
+    def __init__(self):
+        super().__init__()
+        self.target = "label"
+        self.batch_size = 1
+        self.update_period = 1
+        self.grad_scale = 1.0
+
+    def set_param(self, name, val):
+        if name == "batch_size":
+            self.batch_size = int(val)
+        if name == "update_period":
+            self.update_period = int(val)
+        if name == "target":
+            self.target = val
+        if name == "grad_scale":
+            self.grad_scale = float(val)
+
+    def infer_shape(self, in_shapes):
+        check(len(in_shapes) == 1, "LossLayer: only support 1-1 connection")
+        return [in_shapes[0]]
+
+    def _scale(self):
+        return self.grad_scale / (self.batch_size * self.update_period)
+
+    def transform(self, x2d):
+        """Forward transform of the node (e.g. softmax)."""
+        return x2d
+
+    def loss_term(self, x2d, label):
+        """Scalar loss whose gradient wrt x2d matches the reference grad."""
+        raise NotImplementedError
+
+    def apply(self, params, inputs, ctx):
+        x = inputs[0]
+        x2d = _flat2d(x)
+        out = self.transform(x2d)
+        if ctx.labels is not None:
+            label = ctx.labels.field(self.target)
+            ctx.losses.append(self.loss_term(x2d, label))
+        return [out.reshape(x.shape)]
+
+
+class SoftmaxLayer(LossLayerBase):
+    """Softmax + cross-entropy (src/layer/loss/softmax_layer-inl.hpp:12).
+    grad = (p - onehot(label)) * scale == d/dlogits of scale * sum_i CE_i."""
+
+    type_name = "softmax"
+
+    def transform(self, x2d):
+        return jax.nn.softmax(x2d, axis=-1)
+
+    def loss_term(self, x2d, label):
+        logp = jax.nn.log_softmax(x2d, axis=-1)
+        idx = label[:, 0].astype(jnp.int32)
+        ce = -jnp.take_along_axis(logp, idx[:, None], axis=1)[:, 0]
+        return jnp.sum(ce) * self._scale()
+
+
+class L2LossLayer(LossLayerBase):
+    """Identity forward; grad = (x - y) * scale
+    (src/layer/loss/l2_loss_layer-inl.hpp:12)."""
+
+    type_name = "l2_loss"
+
+    def loss_term(self, x2d, label):
+        return 0.5 * jnp.sum(jnp.square(x2d - label)) * self._scale()
+
+
+class MultiLogisticLayer(LossLayerBase):
+    """Elementwise sigmoid + logistic loss
+    (src/layer/loss/multi_logistic_layer-inl.hpp:12).
+    grad = (sigmoid(x) - y) * scale."""
+
+    type_name = "multi_logistic"
+
+    def transform(self, x2d):
+        return jax.nn.sigmoid(x2d)
+
+    def loss_term(self, x2d, label):
+        # sum BCE with logits; gradient wrt x2d is sigmoid(x) - y
+        bce = jnp.maximum(x2d, 0) - x2d * label + jnp.log1p(jnp.exp(-jnp.abs(x2d)))
+        return jnp.sum(bce) * self._scale()

@@ -1,0 +1,121 @@
+"""TPU-native op library: the jax/XLA equivalents of the mshadow expressions
+consumed by the reference (inventory: SURVEY.md §2.11).
+
+Each function here replaces one mshadow expression-template kernel:
+conv2d          <- unpack_patch2col + dot + swapaxis   (src/layer/convolution_layer-inl.hpp:79-105)
+pool2d          <- pool<Reducer> / unpool              (src/layer/pooling_layer-inl.hpp)
+chpool_sum      <- chpool<red::sum>                    (LRN, src/layer/lrn_layer-inl.hpp:55-60)
+softmax         <- mshadow::Softmax                    (src/layer/loss/softmax_layer-inl.hpp)
+
+Design notes (TPU):
+* conv lowers to the MXU through lax.conv_general_dilated with
+  feature_group_count for grouped conv (ngroup) — no im2col materialization,
+  XLA tiles directly.
+* pooling/LRN lower to lax.reduce_window; XLA fuses the elementwise pre/post
+  ops into the window reduction.
+* shape semantics replicate the reference exactly (ceil-mode pooling with
+  clamp) so config-declared nets produce identical node shapes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def conv_out_dim(x: int, k: int, s: int, p: int) -> int:
+    """Conv output size, reference: src/layer/convolution_layer-inl.hpp:180-183."""
+    return (x + 2 * p - k) // s + 1
+
+
+def pool_out_dim(x: int, k: int, s: int) -> int:
+    """Pooling output size (ceil-mode with clamp),
+    reference: src/layer/pooling_layer-inl.hpp:104-106."""
+    return min(x - k + s - 1, x - 1) // s + 1
+
+
+def conv2d(x: jnp.ndarray, w: jnp.ndarray, *, stride: int = 1,
+           pad: Tuple[int, int] = (0, 0), groups: int = 1,
+           preferred_dtype=jnp.float32) -> jnp.ndarray:
+    """2-D convolution. x: (N, C, H, W); w: (O, C/groups, KH, KW) OIHW."""
+    return lax.conv_general_dilated(
+        x, w,
+        window_strides=(stride, stride),
+        padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+        preferred_element_type=preferred_dtype,
+    )
+
+
+def _pool_padding(h: int, w: int, k: Tuple[int, int], s: int):
+    oh, ow = pool_out_dim(h, k[0], s), pool_out_dim(w, k[1], s)
+    ph = max((oh - 1) * s + k[0] - h, 0)
+    pw = max((ow - 1) * s + k[1] - w, 0)
+    return (oh, ow), (ph, pw)
+
+
+def pool2d(x: jnp.ndarray, mode: str, kernel: Tuple[int, int], stride: int) -> jnp.ndarray:
+    """Pooling with the reference's ceil-mode output shape.
+
+    mode: 'max' | 'sum' | 'avg'. avg divides by k*k regardless of padding,
+    matching src/layer/pooling_layer-inl.hpp:44-46.
+    """
+    n, c, h, w = x.shape
+    (_, _), (ph, pw) = _pool_padding(h, w, kernel, stride)
+    window = (1, 1, kernel[0], kernel[1])
+    strides = (1, 1, stride, stride)
+    padding = [(0, 0), (0, 0), (0, ph), (0, pw)]
+    if mode == "max":
+        init = -jnp.inf
+        out = lax.reduce_window(x, init, lax.max, window, strides, padding)
+    elif mode in ("sum", "avg"):
+        out = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+        if mode == "avg":
+            out = out * (1.0 / (kernel[0] * kernel[1]))
+    else:
+        raise ValueError("unknown pooling mode %s" % mode)
+    return out
+
+
+def chpool_sum(x: jnp.ndarray, nsize: int) -> jnp.ndarray:
+    """Cross-channel sliding-window sum (mshadow chpool<red::sum>).
+
+    For channel i, sums channels [i - nsize//2, i - nsize//2 + nsize) clipped
+    to the valid range — the AlexNet LRN neighborhood.
+    """
+    pad_lo = nsize // 2
+    pad_hi = nsize - 1 - pad_lo
+    return lax.reduce_window(
+        x, 0.0, lax.add,
+        window_dimensions=(1, nsize, 1, 1),
+        window_strides=(1, 1, 1, 1),
+        padding=[(0, 0), (pad_lo, pad_hi), (0, 0), (0, 0)],
+    )
+
+
+def lrn(x: jnp.ndarray, nsize: int, alpha: float, beta: float, knorm: float) -> jnp.ndarray:
+    """Local response normalization across channels
+    (reference: src/layer/lrn_layer-inl.hpp:52-60)."""
+    salpha = alpha / nsize
+    norm = chpool_sum(jnp.square(x), nsize) * salpha + knorm
+    return x * jnp.power(norm, -beta)
+
+
+def softmax(x: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    return jax.nn.softmax(x, axis=axis)
+
+
+def xelu(x: jnp.ndarray, b) -> jnp.ndarray:
+    """Leaky relu with *divisor* b (reference op::xelu, src/layer/op.h:56-60)."""
+    return jnp.where(x > 0, x, x / b)
+
+
+def mxelu(x: jnp.ndarray, m) -> jnp.ndarray:
+    """Leaky relu with *multiplier* m (reference op::mxelu,
+    src/layer/prelu_layer-inl.hpp:10-14)."""
+    return jnp.where(x > 0, x, x * m)

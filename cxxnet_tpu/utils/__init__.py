@@ -1,0 +1,10 @@
+"""Foundation utilities: config parsing, metrics, binary serialization.
+
+TPU-native counterpart of the reference's src/utils/ module
+(config.h, metric.h, io.h). The device-side pieces of src/utils
+(thread.h, thread_buffer.h) map to the io prefetcher in cxxnet_tpu.io.
+"""
+
+from .config import ConfigIterator, parse_config_string, parse_config_file  # noqa: F401
+from .metric import MetricSet, create_metric  # noqa: F401
+from . import serializer  # noqa: F401

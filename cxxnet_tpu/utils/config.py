@@ -1,0 +1,149 @@
+"""key=value config reader, token-compatible with the reference config format.
+
+Mirrors the tokenizer semantics of cxxnet's ConfigReaderBase
+(reference: src/utils/config.h:20-141):
+
+* tokens are separated by spaces / tabs / newlines
+* ``#`` starts a comment that runs to end of line
+* ``"..."`` is a quoted string token; ``\\`` escapes the next char; a newline
+  inside a double-quoted string is an error
+* ``'...'`` is a multi-line quoted string token
+* ``=`` always delimits its own token (``a=b`` tokenizes as ``a``, ``=``, ``b``)
+* the stream is consumed as (name, '=', value) triples
+
+The result is an ordered list of (name, value) pairs — order matters for the
+netconfig DSL and iterator sections, and keys may repeat.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Tuple
+
+
+class ConfigError(ValueError):
+    pass
+
+
+def _tokenize(text: str) -> Iterator[str]:
+    i, n = 0, len(text)
+    tok: List[str] = []
+
+    def flush():
+        if tok:
+            yield_val = "".join(tok)
+            tok.clear()
+            return yield_val
+        return None
+
+    while i < n:
+        c = text[i]
+        if c == "#":
+            out = flush()
+            if out is not None:
+                yield out
+            while i < n and text[i] not in "\r\n":
+                i += 1
+        elif c == '"':
+            if tok:
+                raise ConfigError("ConfigReader: token followed directly by string")
+            i += 1
+            s: List[str] = []
+            while True:
+                if i >= n:
+                    raise ConfigError("ConfigReader: unterminated string")
+                ch = text[i]
+                if ch == "\\":
+                    i += 1
+                    if i < n:
+                        s.append(text[i])
+                    i += 1
+                elif ch == '"':
+                    i += 1
+                    break
+                elif ch in "\r\n":
+                    raise ConfigError("ConfigReader: unterminated string")
+                else:
+                    s.append(ch)
+                    i += 1
+            yield "".join(s)
+        elif c == "'":
+            if tok:
+                raise ConfigError("ConfigReader: token followed directly by string")
+            i += 1
+            s = []
+            while True:
+                if i >= n:
+                    raise ConfigError("ConfigReader: unterminated string")
+                ch = text[i]
+                if ch == "\\":
+                    i += 1
+                    if i < n:
+                        s.append(text[i])
+                    i += 1
+                elif ch == "'":
+                    i += 1
+                    break
+                else:
+                    s.append(ch)
+                    i += 1
+            yield "".join(s)
+        elif c == "=":
+            out = flush()
+            if out is not None:
+                yield out
+            yield "="
+            i += 1
+        elif c in " \t\r\n":
+            out = flush()
+            if out is not None:
+                yield out
+            i += 1
+        else:
+            tok.append(c)
+            i += 1
+    out = flush()
+    if out is not None:
+        yield out
+
+
+def parse_config_string(text: str) -> List[Tuple[str, str]]:
+    """Parse config text into an ordered list of (name, value) pairs."""
+    toks = list(_tokenize(text))
+    cfg: List[Tuple[str, str]] = []
+    i = 0
+    while i < len(toks):
+        name = toks[i]
+        if name == "=":
+            raise ConfigError("ConfigReader: stray '='")
+        if i + 1 >= len(toks) or toks[i + 1] != "=":
+            raise ConfigError("ConfigReader: expected '=' after %r" % name)
+        if i + 2 >= len(toks) or toks[i + 2] == "=":
+            raise ConfigError("ConfigReader: expected value after %r =" % name)
+        cfg.append((name, toks[i + 2]))
+        i += 3
+    return cfg
+
+
+def parse_config_file(fname: str) -> List[Tuple[str, str]]:
+    with open(fname, "r") as f:
+        return parse_config_string(f.read())
+
+
+class ConfigIterator:
+    """Iterator over (name, value) pairs of a config file.
+
+    Equivalent of the reference's utils::ConfigIterator
+    (src/utils/config.h:169-189), including argv-style overrides appended at
+    the end (src/cxxnet_main.cpp:63-72).
+    """
+
+    def __init__(self, fname: str, argv_overrides: List[str] = ()):  # type: ignore[assignment]
+        self.pairs = parse_config_file(fname)
+        for arg in argv_overrides:
+            if "=" not in arg:
+                raise ConfigError("override must be key=value, got %r" % arg)
+            k, v = arg.split("=", 1)
+            self.pairs.append((k.strip(), v.strip()))
+
+    def __iter__(self):
+        return iter(self.pairs)

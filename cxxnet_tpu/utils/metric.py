@@ -1,0 +1,173 @@
+"""Evaluation metrics: error / rmse / logloss / rec@n, and MetricSet.
+
+Behavioral counterpart of the reference's src/utils/metric.h:
+* error  — argmax mismatch; for 1-wide predictions, thresholds at 0
+  (metric.h MetricError)
+* rmse   — mean of per-row squared-error sums (metric.h MetricRMSE; note the
+  reference returns sum of squared diffs per row averaged over rows, without
+  a square root — we reproduce that)
+* logloss — negative log of the predicted probability of the target class,
+  clipped to [1e-15, 1-1e-15] (metric.h MetricLogloss)
+* rec@n  — fraction of the row's label set hit in the top-n scores
+  (metric.h MetricRecall)
+
+MetricSet aggregates several metrics, each bound to a label field
+(``metric[field] = name`` config syntax), and prints
+``\\t{evname}-{metric}[{field}]:{value}`` per metric (metric.h:220-231).
+
+Metrics run on host over numpy arrays — they sit outside the jitted step, on
+batch-sized outputs only, so there is no need to keep them on TPU.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+import numpy as np
+
+
+class IMetric:
+    name = "none"
+
+    def clear(self) -> None:
+        self.sum_metric = 0.0
+        self.cnt_inst = 0
+
+    def add_eval(self, pred: np.ndarray, labels: np.ndarray) -> None:
+        """pred: (n, k) scores; labels: (n, label_width) label field."""
+        raise NotImplementedError
+
+    def get(self) -> float:
+        return self.sum_metric / max(self.cnt_inst, 1)
+
+
+class MetricError(IMetric):
+    name = "error"
+
+    def __init__(self):
+        self.clear()
+
+    def add_eval(self, pred, labels):
+        pred = np.asarray(pred)
+        if pred.shape[1] != 1:
+            maxidx = np.argmax(pred, axis=1)
+        else:
+            maxidx = (pred[:, 0] > 0.0).astype(np.int64)
+        self.sum_metric += float(np.sum(maxidx != labels[:, 0].astype(np.int64)))
+        self.cnt_inst += pred.shape[0]
+
+
+class MetricRMSE(IMetric):
+    name = "rmse"
+
+    def __init__(self):
+        self.clear()
+
+    def add_eval(self, pred, labels):
+        pred = np.asarray(pred)
+        if pred.shape != labels.shape:
+            raise ValueError("rmse: pred and label shape mismatch")
+        diff = np.sum((pred - labels) ** 2, axis=1)
+        self.sum_metric += float(np.sum(diff))
+        self.cnt_inst += pred.shape[0]
+
+
+class MetricLogloss(IMetric):
+    name = "logloss"
+
+    def __init__(self):
+        self.clear()
+
+    def add_eval(self, pred, labels):
+        pred = np.asarray(pred)
+        n = pred.shape[0]
+        if pred.shape[1] != 1:
+            tgt = labels[:, 0].astype(np.int64)
+            p = np.clip(pred[np.arange(n), tgt], 1e-15, 1.0 - 1e-15)
+            self.sum_metric += float(-np.sum(np.log(p)))
+        else:
+            p = np.clip(pred[:, 0], 1e-15, 1.0 - 1e-15)
+            y = labels[:, 0]
+            res = -(y * np.log(p) + (1.0 - y) * np.log(1.0 - p))
+            if np.any(np.isnan(res)):
+                raise FloatingPointError("NaN detected in logloss")
+            self.sum_metric += float(np.sum(res))
+        self.cnt_inst += n
+
+
+class MetricRecall(IMetric):
+    def __init__(self, name: str):
+        m = re.match(r"rec@(\d+)$", name)
+        if not m:
+            raise ValueError("must specify n for rec@n")
+        self.topn = int(m.group(1))
+        self.name = name
+        self.clear()
+
+    def add_eval(self, pred, labels):
+        pred = np.asarray(pred)
+        n, k = pred.shape
+        if k < self.topn:
+            raise ValueError(
+                "rec@%d meaningless for prediction list of length %d" % (self.topn, k))
+        # top-n indices by score (ties broken arbitrarily, matching the
+        # reference's shuffled sort)
+        top = np.argpartition(-pred, self.topn - 1, axis=1)[:, : self.topn]
+        for i in range(n):
+            lab = labels[i].astype(np.int64)
+            hit = np.isin(lab, top[i]).sum()
+            self.sum_metric += float(hit) / lab.shape[0]
+        self.cnt_inst += n
+
+
+def create_metric(name: str) -> Optional[IMetric]:
+    if name == "rmse":
+        return MetricRMSE()
+    if name == "error":
+        return MetricError()
+    if name == "logloss":
+        return MetricLogloss()
+    if name.startswith("rec@"):
+        return MetricRecall(name)
+    return None
+
+
+class MetricSet:
+    """A set of evaluators, each bound to a label field name."""
+
+    def __init__(self):
+        self.evals: List[IMetric] = []
+        self.label_fields: List[str] = []
+
+    def add_metric(self, name: str, field: str = "label") -> None:
+        m = create_metric(name)
+        if m is None:
+            raise ValueError("Metric: unknown metric name: %s" % name)
+        self.evals.append(m)
+        self.label_fields.append(field)
+
+    def clear(self) -> None:
+        for e in self.evals:
+            e.clear()
+
+    def add_eval(self, predscores: List[np.ndarray], label_info) -> None:
+        """predscores: one prediction array per metric; label_info: LabelInfo."""
+        assert len(predscores) == len(self.evals), \
+            "number of predict scores must equal number of metrics"
+        for i, e in enumerate(self.evals):
+            field = self.label_fields[i]
+            e.add_eval(predscores[i], label_info.field(field))
+
+    def print_str(self, evname: str) -> str:
+        out = []
+        for i, e in enumerate(self.evals):
+            s = "\t%s-%s" % (evname, e.name)
+            if self.label_fields[i] != "label":
+                s += "[%s]" % self.label_fields[i]
+            s += ":%g" % e.get()
+            out.append(s)
+        return "".join(out)
+
+    def __len__(self):
+        return len(self.evals)

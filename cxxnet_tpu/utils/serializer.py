@@ -1,0 +1,115 @@
+"""Binary stream serialization, wire-compatible with the reference model files.
+
+Reproduces the reference's utils::IStream helper encodings
+(src/utils/io.h:36-103):
+
+* std::string  -> uint64-LE length + raw bytes
+* std::vector<T> -> uint64-LE element count + packed elements
+* POD structs  -> raw little-endian bytes (we pack with struct)
+
+Tensors: the reference serializes weights with mshadow's
+``TensorContainer::SaveBinary`` (e.g. src/layer/fullc_layer-inl.hpp:47-49).
+mshadow is an external dependency not vendored in the reference tree, so
+bit-compatibility cannot be verified; we use the documented mshadow-1.0 layout:
+``int32 ndim`` followed by ``ndim × uint32`` shape dims, then raw float32 data
+in row-major order.
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+from typing import BinaryIO, List, Sequence
+
+import numpy as np
+
+
+class Writer:
+    def __init__(self, stream: BinaryIO = None):
+        self.f = stream if stream is not None else io.BytesIO()
+
+    def write_raw(self, data: bytes) -> None:
+        self.f.write(data)
+
+    def write_int32(self, v: int) -> None:
+        self.f.write(struct.pack("<i", v))
+
+    def write_uint32(self, v: int) -> None:
+        self.f.write(struct.pack("<I", v))
+
+    def write_uint64(self, v: int) -> None:
+        self.f.write(struct.pack("<Q", v))
+
+    def write_float(self, v: float) -> None:
+        self.f.write(struct.pack("<f", v))
+
+    def write_string(self, s: str) -> None:
+        b = s.encode("utf-8")
+        self.write_uint64(len(b))
+        self.f.write(b)
+
+    def write_int_vector(self, vec: Sequence[int]) -> None:
+        self.write_uint64(len(vec))
+        if vec:
+            self.f.write(struct.pack("<%di" % len(vec), *vec))
+
+    def write_tensor(self, arr: np.ndarray) -> None:
+        arr = np.ascontiguousarray(arr, dtype=np.float32)
+        self.write_int32(arr.ndim)
+        for d in arr.shape:
+            self.write_uint32(d)
+        self.f.write(arr.tobytes())
+
+    def getvalue(self) -> bytes:
+        return self.f.getvalue()
+
+
+class Reader:
+    def __init__(self, data):
+        if isinstance(data, (bytes, bytearray)):
+            self.f: BinaryIO = io.BytesIO(data)
+        else:
+            self.f = data
+
+    def read_raw(self, size: int) -> bytes:
+        b = self.f.read(size)
+        if len(b) != size:
+            raise EOFError("unexpected end of model file")
+        return b
+
+    def read_int32(self) -> int:
+        return struct.unpack("<i", self.read_raw(4))[0]
+
+    def read_uint32(self) -> int:
+        return struct.unpack("<I", self.read_raw(4))[0]
+
+    def read_uint64(self) -> int:
+        return struct.unpack("<Q", self.read_raw(8))[0]
+
+    def read_float(self) -> float:
+        return struct.unpack("<f", self.read_raw(4))[0]
+
+    def read_string(self) -> str:
+        n = self.read_uint64()
+        return self.read_raw(n).decode("utf-8")
+
+    def read_int_vector(self) -> List[int]:
+        n = self.read_uint64()
+        if n == 0:
+            return []
+        return list(struct.unpack("<%di" % n, self.read_raw(4 * n)))
+
+    def read_tensor(self) -> np.ndarray:
+        ndim = self.read_int32()
+        shape = tuple(self.read_uint32() for _ in range(ndim))
+        count = int(np.prod(shape)) if shape else 1
+        data = np.frombuffer(self.read_raw(4 * count), dtype="<f4").copy()
+        return data.reshape(shape)
+
+    def at_eof(self) -> bool:
+        pos = self.f.tell()
+        b = self.f.read(1)
+        if b:
+            self.f.seek(pos)
+            return False
+        return True

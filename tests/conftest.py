@@ -1,0 +1,27 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Multi-chip sharding is tested without TPU hardware via
+xla_force_host_platform_device_count, as the driver does for
+__graft_entry__.dryrun_multichip.
+
+Note: the environment pins JAX_PLATFORMS=axon (TPU tunnel) and preloads jax,
+so the env var alone is not enough — we must override via jax.config before
+the backend initializes.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# full-precision matmuls on CPU for golden tests
+jax.config.update("jax_default_matmul_precision", "highest")
+
+assert len(jax.devices()) == 8, (
+    "tests require 8 virtual CPU devices, got %s" % jax.devices())

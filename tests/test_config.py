@@ -1,0 +1,62 @@
+"""Config tokenizer tests — semantics of the reference config format
+(src/utils/config.h)."""
+
+import pytest
+
+from cxxnet_tpu.utils.config import ConfigError, parse_config_string
+
+
+def test_basic_pairs():
+    cfg = parse_config_string("a = 1\nb=2\n  c   =    hello\n")
+    assert cfg == [("a", "1"), ("b", "2"), ("c", "hello")]
+
+
+def test_comments_and_blank_lines():
+    cfg = parse_config_string("# comment\na = 1 # trailing\n\n#x=9\nb = 2\n")
+    assert cfg == [("a", "1"), ("b", "2")]
+
+
+def test_quoted_strings():
+    cfg = parse_config_string('path = "./data/my file.bin"\n')
+    assert cfg == [("path", "./data/my file.bin")]
+
+
+def test_escaped_quote():
+    cfg = parse_config_string(r'path = "a\"b"')
+    assert cfg == [("path", 'a"b')]
+
+
+def test_multiline_single_quote():
+    cfg = parse_config_string("doc = 'line1\nline2'\n")
+    assert cfg == [("doc", "line1\nline2")]
+
+
+def test_repeat_keys_keep_order():
+    cfg = parse_config_string("iter = mnist\nshuffle = 1\niter = end\n")
+    assert cfg == [("iter", "mnist"), ("shuffle", "1"), ("iter", "end")]
+
+
+def test_no_space_around_equals():
+    cfg = parse_config_string("layer[0->1]=conv:cv1\n")
+    assert cfg == [("layer[0->1]", "conv:cv1")]
+
+
+def test_unterminated_string_raises():
+    with pytest.raises(ConfigError):
+        parse_config_string('a = "unterminated\n')
+
+
+def test_netconfig_section_tokens():
+    text = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 100
+layer[+0] = softmax
+netconfig=end
+"""
+    cfg = parse_config_string(text)
+    assert cfg[0] == ("netconfig", "start")
+    assert cfg[1] == ("layer[+1:fc1]", "fullc:fc1")
+    assert cfg[2] == ("nhidden", "100")
+    assert cfg[3] == ("layer[+0]", "softmax")
+    assert cfg[4] == ("netconfig", "end")
