@@ -1,0 +1,69 @@
+"""Data pipeline ABI: DataInst / DataBatch / IIterator.
+
+Mirrors src/io/data.h:18-188. Iterators compose into chains declared in
+config (``iter = mnist .. iter = threadbuffer .. iter = end``); the factory
+lives in cxxnet_tpu.io (create_iterator).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+class DataInst:
+    """Single instance (src/io/data.h:41)."""
+
+    def __init__(self, data: np.ndarray, label: np.ndarray, index: int = 0):
+        self.data = data          # (c, h, w)
+        self.label = label        # (label_width,)
+        self.index = index
+
+
+class DataBatch:
+    """Batch of instances (src/io/data.h:79): dense 4-D data + 2-D label +
+    optional extra data + padding count."""
+
+    def __init__(self):
+        self.data: Optional[np.ndarray] = None       # (b, c, h, w) float32
+        self.label: Optional[np.ndarray] = None      # (b, label_width) float32
+        self.inst_index: Optional[np.ndarray] = None  # (b,) uint32
+        self.batch_size: int = 0
+        self.num_batch_padd: int = 0
+        self.extra_data: List[np.ndarray] = []
+
+    def shallow_copy(self) -> "DataBatch":
+        out = DataBatch()
+        out.data, out.label = self.data, self.label
+        out.inst_index = self.inst_index
+        out.batch_size = self.batch_size
+        out.num_batch_padd = self.num_batch_padd
+        out.extra_data = list(self.extra_data)
+        return out
+
+
+class IIterator:
+    """Iterator ABI (src/io/data.h:18-38): SetParam / Init / BeforeFirst /
+    Next / Value."""
+
+    def set_param(self, name: str, val: str) -> None:
+        pass
+
+    def init(self) -> None:
+        pass
+
+    def before_first(self) -> None:
+        raise NotImplementedError
+
+    def next(self) -> bool:
+        raise NotImplementedError
+
+    def value(self):
+        raise NotImplementedError
+
+    # python iteration sugar
+    def __iter__(self):
+        self.before_first()
+        while self.next():
+            yield self.value()

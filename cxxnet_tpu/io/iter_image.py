@@ -1,0 +1,528 @@
+"""Image iterators: imgbin / imgbinx page readers, plain img iterator, and
+the augmentation adapters.
+
+Reference mapping:
+* ImagePageIterator      <- ThreadImagePageIterator/X
+  (src/io/iter_thread_imbin-inl.hpp:16, iter_thread_imbin_x-inl.hpp:18):
+  BinaryPage packs of jpeg records + .lst label files; multi-part lists via
+  image_conf_prefix/image_conf_ids; distributed file sharding by
+  dist_num_worker/dist_worker_rank (env PS_RANK).
+* ImageIterator          <- src/io/iter_img-inl.hpp:16 (per-file loading)
+* GeometricAugmenter     <- src/io/image_augmenter-inl.hpp:13 (one cv2
+  warpAffine combining rotation/shear/scale/aspect, then crop)
+* AugmentIterator        <- src/io/iter_augment_proc-inl.hpp:21 (crop/mirror/
+  mean-subtract with on-the-fly mean-image creation + caching, divideby,
+  random contrast/illumination)
+
+Images are decoded to float32 RGB (c, h, w) in [0, 255] like the reference
+(iter_thread_imbin-inl.hpp:125-143); `divideby`/`scale` rescales afterward.
+Decode uses cv2 (the reference's decoder); jpeg bytes are produced by
+tools/im2bin.py.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from ..utils.binary_page import BinaryPage, KPAGE_INTS
+from .data import DataBatch, DataInst, IIterator
+from .batch import BatchAdaptIterator
+
+
+def _decode_rgb_chw(buf: bytes) -> np.ndarray:
+    import cv2
+    arr = np.frombuffer(buf, dtype=np.uint8)
+    bgr = cv2.imdecode(arr, cv2.IMREAD_COLOR)
+    assert bgr is not None, "decoding fail"
+    rgb = bgr[:, :, ::-1]
+    return np.ascontiguousarray(
+        rgb.transpose(2, 0, 1).astype(np.float32))
+
+
+class _ListReader:
+    """Reads .lst files: lines of ``index label[ label..] filename``."""
+
+    def __init__(self, paths: List[str], label_width: int):
+        self.paths = paths
+        self.label_width = label_width
+        self.reset()
+
+    def reset(self):
+        self.idx = 0
+        self.f = open(self.paths[0])
+
+    def next_record(self):
+        while True:
+            line = self.f.readline()
+            if line.strip():
+                toks = line.split()
+                index = int(toks[0])
+                label = np.asarray(
+                    [float(x) for x in toks[1:1 + self.label_width]],
+                    np.float32)
+                fname = toks[1 + self.label_width] \
+                    if len(toks) > 1 + self.label_width else ""
+                return index, label, fname
+            if not line:
+                self.idx += 1
+                if self.idx >= len(self.paths):
+                    return None
+                self.f.close()
+                self.f = open(self.paths[self.idx])
+
+
+class ImagePageIterator(IIterator):
+    """imgbin/imgbinx: jpeg records from BinaryPage packs + .lst labels."""
+
+    def __init__(self):
+        self.silent = 0
+        self.label_width = 1
+        self.path_imglst: List[str] = []
+        self.path_imgbin: List[str] = []
+        self.img_conf_prefix = ""
+        self.img_conf_ids = ""
+        self.dist_num_worker = 0
+        self.dist_worker_rank = 0
+        self.page_ints = KPAGE_INTS
+        self.lst: Optional[_ListReader] = None
+
+    def set_param(self, name, val):
+        if name == "image_list":
+            self.path_imglst.append(val)
+        if name == "image_bin":
+            self.path_imgbin.append(val)
+        if name == "image_conf_prefix":
+            self.img_conf_prefix = val
+        if name == "image_conf_ids":
+            self.img_conf_ids = val
+        if name == "dist_num_worker":
+            self.dist_num_worker = int(val)
+        if name == "dist_worker_rank":
+            self.dist_worker_rank = int(val)
+        if name == "silent":
+            self.silent = int(val)
+        if name == "label_width":
+            self.label_width = int(val)
+        if name == "page_size":
+            self.page_ints = int(val)
+
+    def _parse_image_conf(self):
+        """Multi-part list + distributed sharding
+        (reference ParseImageConf, iter_thread_imbin-inl.hpp:189-220)."""
+        ps_rank = os.environ.get("PS_RANK")
+        if ps_rank is not None:
+            self.dist_worker_rank = int(ps_rank)
+        if not self.img_conf_prefix:
+            return
+        assert not self.path_imglst and not self.path_imgbin, \
+            "you can either set image_conf_prefix or image_bin/image_list"
+        lb, ub = (int(x) for x in self.img_conf_ids.split("-"))
+        n = ub + 1 - lb
+        if self.dist_num_worker > 1:
+            step = (n + self.dist_num_worker - 1) // self.dist_num_worker
+            begin = min(self.dist_worker_rank * step, n) + lb
+            end = min((self.dist_worker_rank + 1) * step, n) + lb
+            lb, ub = begin, end - 1
+            assert lb <= ub, ("ThreadImagePageIterator: too many workers "
+                              "such that idlist cannot be divided between them")
+        for i in range(lb, ub + 1):
+            tmp = self.img_conf_prefix % i
+            self.path_imglst.append(tmp + ".lst")
+            self.path_imgbin.append(tmp + ".bin")
+
+    def init(self):
+        self._parse_image_conf()
+        assert len(self.path_imgbin) == len(self.path_imglst), \
+            "List/Bin number not consist"
+        if self.silent == 0:
+            print("ImagePageIterator: image_list=%s, bin=%s" %
+                  (",".join(self.path_imglst), ",".join(self.path_imgbin)))
+        self.lst = _ListReader(self.path_imglst, self.label_width)
+        self.before_first()
+
+    def before_first(self):
+        self.lst.reset()
+        self.bin_idx = 0
+        self.fbin = open(self.path_imgbin[0], "rb")
+        self.page = None
+        self.ptop = 0
+
+    def _next_buffer(self) -> bytes:
+        while self.page is None or self.ptop >= self.page.size():
+            page = BinaryPage.load(self.fbin, self.page_ints)
+            if page is None:
+                self.bin_idx += 1
+                assert self.bin_idx < len(self.path_imgbin), \
+                    "binary pack exhausted before list file"
+                self.fbin.close()
+                self.fbin = open(self.path_imgbin[self.bin_idx], "rb")
+                continue
+            self.page = page
+            self.ptop = 0
+        obj = self.page[self.ptop]
+        self.ptop += 1
+        return obj
+
+    def next(self) -> bool:
+        rec = self.lst.next_record()
+        if rec is None:
+            return False
+        index, label, _ = rec
+        buf = self._next_buffer()
+        self.out = DataInst(_decode_rgb_chw(buf), label, index)
+        return True
+
+    def value(self) -> DataInst:
+        return self.out
+
+
+class ImageIterator(IIterator):
+    """img: plain per-file image list iterator (src/io/iter_img-inl.hpp:16)."""
+
+    def __init__(self):
+        self.silent = 0
+        self.label_width = 1
+        self.path_imglst = ""
+        self.path_root = ""
+        self.shuffle = 0
+        self.seed = 0
+
+    def set_param(self, name, val):
+        if name == "image_list":
+            self.path_imglst = val
+        if name == "image_root":
+            self.path_root = val
+        if name == "silent":
+            self.silent = int(val)
+        if name == "label_width":
+            self.label_width = int(val)
+        if name == "shuffle":
+            self.shuffle = int(val)
+        if name == "seed_data":
+            self.seed = int(val)
+
+    def init(self):
+        self.records = []
+        with open(self.path_imglst) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                toks = line.split()
+                index = int(toks[0])
+                label = np.asarray(
+                    [float(x) for x in toks[1:1 + self.label_width]],
+                    np.float32)
+                fname = toks[1 + self.label_width]
+                self.records.append((index, label, fname))
+        if self.shuffle:
+            np.random.RandomState(self.seed).shuffle(self.records)
+        self.loc = 0
+
+    def before_first(self):
+        self.loc = 0
+
+    def next(self) -> bool:
+        if self.loc >= len(self.records):
+            return False
+        index, label, fname = self.records[self.loc]
+        self.loc += 1
+        path = os.path.join(self.path_root, fname) if self.path_root else fname
+        with open(path, "rb") as f:
+            data = _decode_rgb_chw(f.read())
+        self.out = DataInst(data, label, index)
+        return True
+
+    def value(self) -> DataInst:
+        return self.out
+
+
+class GeometricAugmenter:
+    """cv2 affine pipeline: rotation (+rotate_list), shear, aspect ratio,
+    random scale, crop-size range, fill value — one warpAffine
+    (reference ImageAugmenter, image_augmenter-inl.hpp:13-140)."""
+
+    def __init__(self):
+        self.shape = (0, 0, 0)
+        self.rand_crop = 0
+        self.max_rotate_angle = 0.0
+        self.max_aspect_ratio = 0.0
+        self.max_shear_ratio = 0.0
+        self.min_crop_size = -1
+        self.max_crop_size = -1
+        self.rotate = -1.0
+        self.rotate_list: List[int] = []
+        self.max_random_scale = 1.0
+        self.min_random_scale = 1.0
+        self.min_img_size = 0.0
+        self.max_img_size = 1e10
+        self.fill_value = 255
+        self.mirror = 0
+
+    def set_param(self, name, val):
+        if name == "input_shape":
+            self.shape = tuple(int(x) for x in val.split(","))
+        if name == "rand_crop":
+            self.rand_crop = int(val)
+        if name == "max_rotate_angle":
+            self.max_rotate_angle = float(val)
+        if name == "max_shear_ratio":
+            self.max_shear_ratio = float(val)
+        if name == "max_aspect_ratio":
+            self.max_aspect_ratio = float(val)
+        if name == "min_crop_size":
+            self.min_crop_size = int(val)
+        if name == "max_crop_size":
+            self.max_crop_size = int(val)
+        if name == "min_random_scale":
+            self.min_random_scale = float(val)
+        if name == "max_random_scale":
+            self.max_random_scale = float(val)
+        if name == "min_img_size":
+            self.min_img_size = float(val)
+        if name == "max_img_size":
+            self.max_img_size = float(val)
+        if name == "fill_value":
+            self.fill_value = int(val)
+        if name == "rotate":
+            self.rotate = int(val)
+        if name == "rotate_list":
+            self.rotate_list = [int(x) for x in val.split(",") if x]
+
+    def need_process(self) -> bool:
+        return (self.max_rotate_angle > 0 or self.max_shear_ratio > 0
+                or self.max_aspect_ratio > 0 or self.rotate > 0
+                or len(self.rotate_list) > 0
+                or self.max_random_scale != 1.0 or self.min_random_scale != 1.0
+                or self.min_crop_size > 0)
+
+    def process(self, data: np.ndarray, rnd: np.random.RandomState) -> np.ndarray:
+        """data: (3, h, w) float RGB in [0,255]; returns augmented (3, H, W)."""
+        if not self.need_process():
+            return data
+        import cv2
+        # to HWC BGR uint8 for cv2
+        src = data.transpose(1, 2, 0)[:, :, ::-1].astype(np.uint8)
+        s = rnd.rand() * self.max_shear_ratio * 2 - self.max_shear_ratio
+        angle = (rnd.randint(0, max(int(self.max_rotate_angle * 2), 1))
+                 - self.max_rotate_angle)
+        if self.rotate > 0:
+            angle = self.rotate
+        if self.rotate_list:
+            angle = self.rotate_list[rnd.randint(0, len(self.rotate_list))]
+        a = math.cos(angle / 180.0 * math.pi)
+        b = math.sin(angle / 180.0 * math.pi)
+        scale = rnd.rand() * (self.max_random_scale
+                              - self.min_random_scale) + self.min_random_scale
+        ratio = rnd.rand() * self.max_aspect_ratio * 2 \
+            - self.max_aspect_ratio + 1
+        hs = 2 * scale / (1 + ratio)
+        ws = ratio * hs
+        new_w = max(self.min_img_size, min(self.max_img_size,
+                                           scale * src.shape[1]))
+        new_h = max(self.min_img_size, min(self.max_img_size,
+                                           scale * src.shape[0]))
+        M = np.zeros((2, 3), np.float32)
+        M[0, 0] = hs * a - s * b * ws
+        M[1, 0] = -b * ws
+        M[0, 1] = hs * b + s * a * ws
+        M[1, 1] = a * ws
+        ori_cw = M[0, 0] * src.shape[1] + M[0, 1] * src.shape[0]
+        ori_ch = M[1, 0] * src.shape[1] + M[1, 1] * src.shape[0]
+        M[0, 2] = (new_w - ori_cw) / 2
+        M[1, 2] = (new_h - ori_ch) / 2
+        temp = cv2.warpAffine(
+            src, M, (int(new_w), int(new_h)), flags=cv2.INTER_CUBIC,
+            borderMode=cv2.BORDER_CONSTANT,
+            borderValue=(self.fill_value,) * 3)
+        # crop to input_shape (reference crops (shape_[1], shape_[2]))
+        ch, cw = self.shape[1], self.shape[2]
+        y = max(temp.shape[0] - ch, 0)
+        x = max(temp.shape[1] - cw, 0)
+        if self.rand_crop != 0:
+            y = rnd.randint(0, y + 1)
+            x = rnd.randint(0, x + 1)
+        else:
+            y //= 2
+            x //= 2
+        res = temp[y: y + ch, x: x + cw]
+        return np.ascontiguousarray(
+            res[:, :, ::-1].transpose(2, 0, 1).astype(np.float32))
+
+
+class AugmentIterator(IIterator):
+    """Per-instance augmentation: crop (random/centered/fixed), mirror,
+    scale, mean-image / mean-value subtraction, random contrast and
+    illumination (reference AugmentIterator)."""
+
+    kRandMagic = 0
+
+    def __init__(self, base: IIterator):
+        self.base = base
+        self.rand_crop = 0
+        self.rand_mirror = 0
+        self.crop_y_start = -1
+        self.crop_x_start = -1
+        self.scale = 1.0
+        self.silent = 0
+        self.name_meanimg = ""
+        self.mean_r = 0.0
+        self.mean_g = 0.0
+        self.mean_b = 0.0
+        self.mirror = 0
+        self.max_random_illumination = 0.0
+        self.max_random_contrast = 0.0
+        self.shape = (0, 0, 0)
+        self.aug = GeometricAugmenter()
+        self.rnd = np.random.RandomState(self.kRandMagic)
+
+    def set_param(self, name, val):
+        self.base.set_param(name, val)
+        if name == "input_shape":
+            self.shape = tuple(int(x) for x in val.split(","))
+        if name == "seed_data":
+            self.rnd = np.random.RandomState(self.kRandMagic + int(val))
+        if name == "rand_crop":
+            self.rand_crop = int(val)
+        if name == "silent":
+            self.silent = int(val)
+        if name == "divideby":
+            self.scale = 1.0 / float(val)
+        if name == "scale":
+            self.scale = float(val)
+        if name == "image_mean":
+            self.name_meanimg = val
+        if name == "crop_y_start":
+            self.crop_y_start = int(val)
+        if name == "crop_x_start":
+            self.crop_x_start = int(val)
+        if name == "rand_mirror":
+            self.rand_mirror = int(val)
+        if name == "mirror":
+            self.mirror = int(val)
+        if name == "max_random_contrast":
+            self.max_random_contrast = float(val)
+        if name == "max_random_illumination":
+            self.max_random_illumination = float(val)
+        if name == "mean_value":
+            self.mean_b, self.mean_g, self.mean_r = \
+                (float(x) for x in val.split(","))
+        self.aug.set_param(name, val)
+
+    def init(self):
+        self.base.init()
+        self.meanfile_ready = False
+        self.meanimg = None
+        if self.name_meanimg:
+            if os.path.exists(self.name_meanimg):
+                if self.silent == 0:
+                    print("loading mean image from %s" % self.name_meanimg)
+                from ..utils import serializer
+                with open(self.name_meanimg, "rb") as f:
+                    self.meanimg = serializer.Reader(f).read_tensor()
+                self.meanfile_ready = True
+            else:
+                self._create_mean_img()
+
+    def before_first(self):
+        self.base.before_first()
+
+    def _set_data(self, d: DataInst):
+        data = d.data
+        data = self.aug.process(data, self.rnd)
+        c, th, tw = self.shape
+        if th == 1:
+            img = data.reshape(data.shape[0], 1, -1) if data.ndim == 3 \
+                else data
+            out = img * self.scale
+            self.out = DataInst(out.astype(np.float32), d.label, d.index)
+            return
+        assert data.shape[1] >= th and data.shape[2] >= tw, \
+            "Data size must be bigger than the input size to net."
+        yy = data.shape[1] - th
+        xx = data.shape[2] - tw
+        if self.rand_crop != 0 and (yy != 0 or xx != 0):
+            yy = self.rnd.randint(0, yy + 1)
+            xx = self.rnd.randint(0, xx + 1)
+        else:
+            yy //= 2
+            xx //= 2
+        if data.shape[1] != th and self.crop_y_start != -1:
+            yy = self.crop_y_start
+        if data.shape[2] != tw and self.crop_x_start != -1:
+            xx = self.crop_x_start
+        contrast = (self.rnd.rand() * self.max_random_contrast * 2
+                    - self.max_random_contrast + 1)
+        illumination = (self.rnd.rand() * self.max_random_illumination * 2
+                        - self.max_random_illumination)
+        do_mirror = (self.rand_mirror != 0 and self.rnd.rand() < 0.5) \
+            or self.mirror == 1
+        if self.mean_r > 0.0 or self.mean_g > 0.0 or self.mean_b > 0.0:
+            base = data.copy()
+            base[0] -= self.mean_b
+            base[1] -= self.mean_g
+            base[2] -= self.mean_r
+            img = base[:, yy: yy + th, xx: xx + tw] * contrast + illumination
+        elif not self.meanfile_ready or not self.name_meanimg:
+            img = data[:, yy: yy + th, xx: xx + tw].astype(np.float32)
+            contrast, illumination = 1.0, 0.0  # reference applies none here
+        else:
+            if data.shape == self.meanimg.shape:
+                img = ((data - self.meanimg)[:, yy: yy + th, xx: xx + tw]
+                       * contrast + illumination)
+            else:
+                img = ((data[:, yy: yy + th, xx: xx + tw] - self.meanimg)
+                       * contrast + illumination)
+        if do_mirror:
+            img = img[:, :, ::-1]
+        self.out = DataInst(
+            np.ascontiguousarray(img * self.scale, dtype=np.float32),
+            d.label, d.index)
+
+    def next(self) -> bool:
+        if not self.base.next():
+            return False
+        self._set_data(self.base.value())
+        return True
+
+    def value(self) -> DataInst:
+        return self.out
+
+    def _create_mean_img(self):
+        """Compute and cache the dataset mean image
+        (reference CreateMeanImg, iter_augment_proc-inl.hpp:171-198)."""
+        if self.silent == 0:
+            print("cannot find %s: create mean image, this will take "
+                  "some time..." % self.name_meanimg)
+        self.base.before_first()
+        mean = None
+        cnt = 0
+        while self.base.next():
+            d = self.base.value().data
+            if mean is None:
+                mean = d.astype(np.float64).copy()
+            else:
+                mean += d
+            cnt += 1
+        assert cnt > 0, "input iterator failed."
+        self.meanimg = (mean / cnt).astype(np.float32)
+        from ..utils import serializer
+        with open(self.name_meanimg, "wb") as f:
+            serializer.Writer(f).write_tensor(self.meanimg)
+        if self.silent == 0:
+            print("save mean image to %s.." % self.name_meanimg)
+        self.meanfile_ready = True
+        self.base.before_first()
+
+
+def create_image_base(kind: str) -> IIterator:
+    """imgbin chains come pre-wrapped Batch(Augment(PageReader))
+    (reference data.cpp:35-50)."""
+    if kind in ("imgbin", "imgbinx"):
+        return BatchAdaptIterator(AugmentIterator(ImagePageIterator()))
+    if kind == "img":
+        return BatchAdaptIterator(AugmentIterator(ImageIterator()))
+    raise ValueError("unknown image iterator %s" % kind)

@@ -1,0 +1,111 @@
+"""MNIST idx-gz iterator (src/io/iter_mnist-inl.hpp:14-158).
+
+Loads the gzipped idx files fully into RAM, normalizes to [0,1), optional
+shuffle, and serves zero-copy full batches (the tail that doesn't fill a
+batch is dropped, matching the reference's Next())."""
+
+from __future__ import annotations
+
+import gzip
+import struct
+
+import numpy as np
+
+from .data import DataBatch, IIterator
+
+kRandMagic = 0  # reference seeds rnd with a fixed magic
+
+
+class MNISTIterator(IIterator):
+    def __init__(self):
+        self.mode = 1           # input_flat
+        self.inst_offset = 0
+        self.silent = 0
+        self.shuffle = 0
+        self.batch_size = 0
+        self.path_img = ""
+        self.path_label = ""
+        self.seed = kRandMagic
+        self.loc = 0
+
+    def set_param(self, name, val):
+        if name == "silent":
+            self.silent = int(val)
+        if name == "batch_size":
+            self.batch_size = int(val)
+        if name == "input_flat":
+            self.mode = int(val)
+        if name == "shuffle":
+            self.shuffle = int(val)
+        if name == "index_offset":
+            self.inst_offset = int(val)
+        if name == "path_img":
+            self.path_img = val
+        if name == "path_label":
+            self.path_label = val
+        if name == "seed_data":
+            self.seed = kRandMagic + int(val)
+
+    def init(self):
+        self._load_image()
+        self._load_label()
+        assert self.img.shape[0] == self.labels.shape[0], \
+            "MNISTIterator: image/label count mismatch"
+        self.inst = np.arange(self.img.shape[0], dtype=np.uint32) + self.inst_offset
+        if self.shuffle:
+            self._shuffle()
+        if self.mode == 1:
+            self.data_view = self.img.reshape(
+                self.img.shape[0], 1, 1, self.img.shape[1] * self.img.shape[2])
+        else:
+            self.data_view = self.img.reshape(
+                self.img.shape[0], 1, self.img.shape[1], self.img.shape[2])
+        if self.silent == 0:
+            print("MNISTIterator: load %d images, shuffle=%d, shape=%s" %
+                  (self.img.shape[0], self.shuffle,
+                   (self.batch_size,) + self.data_view.shape[1:]))
+        self.loc = 0
+
+    def _open(self, path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+    def _load_image(self):
+        with self._open(self.path_img) as f:
+            _, count, rows, cols = struct.unpack(">iiii", f.read(16))
+            raw = np.frombuffer(f.read(count * rows * cols), dtype=np.uint8)
+        self.img = (raw.reshape(count, rows, cols).astype(np.float32)
+                    * (1.0 / 256.0))
+
+    def _load_label(self):
+        with self._open(self.path_label) as f:
+            _, count = struct.unpack(">ii", f.read(8))
+            raw = np.frombuffer(f.read(count), dtype=np.uint8)
+        self.labels = raw.astype(np.float32)
+
+    def _shuffle(self):
+        """Shuffle keeping inst_index consistent: row i's inst names its
+        original instance (reference Shuffle, iter_mnist-inl.hpp:110-122)."""
+        rnd = np.random.RandomState(self.seed)
+        perm = np.arange(self.img.shape[0])
+        rnd.shuffle(perm)
+        self.img = self.img[perm]
+        self.labels = self.labels[perm]
+        self.inst = (perm + self.inst_offset).astype(np.uint32)
+
+    def before_first(self):
+        self.loc = 0
+
+    def next(self) -> bool:
+        if self.loc + self.batch_size <= self.img.shape[0]:
+            self.out = DataBatch()
+            self.out.data = self.data_view[self.loc: self.loc + self.batch_size]
+            self.out.label = self.labels[self.loc: self.loc + self.batch_size] \
+                .reshape(self.batch_size, 1)
+            self.out.inst_index = self.inst[self.loc: self.loc + self.batch_size]
+            self.out.batch_size = self.batch_size
+            self.loc += self.batch_size
+            return True
+        return False
+
+    def value(self) -> DataBatch:
+        return self.out

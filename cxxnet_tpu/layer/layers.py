@@ -9,15 +9,11 @@ forward functions, which our golden tests verify (tests/test_layers.py).
 
 from __future__ import annotations
 
-import math
-from typing import Dict, List, Tuple
-
 import numpy as np
 import jax
 import jax.numpy as jnp
 
 from .. import ops
-from ..utils import serializer
 from .base import ApplyContext, Layer, LayerParam, Shape4, check
 
 
@@ -125,12 +121,26 @@ class FixConnectLayer(Layer):
               "FixConnLayer: fixconn_weight shape do not match architecture")
         for i in range(nnz):
             x, y, v = int(toks[3 + 3 * i]), int(toks[4 + 3 * i]), float(toks[5 + 3 * i])
+            check(0 <= x < wm.shape[0] and 0 <= y < wm.shape[1],
+                  "FixConnLayer: fixconn_weight index exceed matrix shape")
             wm[x, y] = v
         self._wmat = wm
         return [(b, 1, 1, self.param.num_hidden)]
 
     def init_params(self, rng):
         return {"wmat": self._wmat}
+
+    # the frozen weight still travels with the model so a loaded net runs
+    # without re-reading the sparse text file
+    def save_model(self, w, params):
+        self.param.save(w)
+        w.write_tensor(params["wmat"])
+
+    def load_model(self, r):
+        self.param.load(r)
+        wmat = r.read_tensor()
+        self._wmat = wmat
+        return {"wmat": wmat}
 
     def apply(self, params, inputs, ctx):
         w = jax.lax.stop_gradient(params["wmat"])

@@ -1,0 +1,321 @@
+"""The learn task driver: train / finetune / pred / extract from a config file.
+
+Reimplements CXXNetLearnTask (src/cxxnet_main.cpp:16-478) — same config keys,
+task loop, checkpoint naming (models/%04d.model with a leading net_type int),
+``continue=1`` auto-resume scan, pred/extract output formats — driving the
+TPU trainer instead of GPU worker threads.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .io import create_iterator
+from .nnet.trainer import Trainer, create_net
+from .utils import serializer
+from .utils.config import ConfigIterator
+
+
+class LearnTask:
+    def __init__(self):
+        self.task = "train"
+        self.net_type = 0
+        self.reset_net_type = -1
+        self.net_trainer: Optional[Trainer] = None
+        self.itr_train = None
+        self.itr_pred = None
+        self.itr_evals = []
+        self.eval_names: List[str] = []
+        self.name_model_dir = "models"
+        self.num_round = 10
+        self.test_io = 0
+        self.silent = 0
+        self.start_counter = 0
+        self.max_round = 1 << 31
+        self.continue_training = 0
+        self.save_period = 1
+        self.name_model_in = "NULL"
+        self.name_pred = "pred.txt"
+        self.print_step = 100
+        self.extract_node_name = ""
+        self.output_format = 1
+        self.device = "tpu"
+        self.cfg: List[Tuple[str, str]] = [("dev", "tpu")]
+
+    # ------------------------------------------------------------------
+    def run(self, argv: List[str]) -> int:
+        if len(argv) < 1:
+            print("Usage: <config>")
+            return 0
+        for name, val in ConfigIterator(argv[0], argv[1:]):
+            self.set_param(name, val)
+        self.init()
+        if not self.silent:
+            print("initializing end, start working")
+        if self.task in ("train", "finetune"):
+            self.task_train()
+        elif self.task == "pred":
+            self.task_predict()
+        elif self.task == "extract":
+            self.task_extract_feature()
+        return 0
+
+    def set_param(self, name: str, val: str) -> None:
+        if val == "default":
+            return
+        if name == "net_type":
+            self.net_type = int(val)
+        if name == "reset_net_type":
+            self.reset_net_type = int(val)
+        if name == "print_step":
+            self.print_step = int(val)
+        if name == "continue":
+            self.continue_training = int(val)
+        if name == "save_model":
+            self.save_period = int(val)
+        if name == "start_counter":
+            self.start_counter = int(val)
+        if name == "model_in":
+            self.name_model_in = val
+        if name == "model_dir":
+            self.name_model_dir = val
+        if name == "num_round":
+            self.num_round = int(val)
+        if name == "max_round":
+            self.max_round = int(val)
+        if name == "silent":
+            self.silent = int(val)
+        if name == "task":
+            self.task = val
+        if name == "dev":
+            self.device = val
+        if name == "test_io":
+            self.test_io = int(val)
+        if name == "extract_node_name":
+            self.extract_node_name = val
+        if name == "output_format":
+            self.output_format = 1 if val == "txt" else 0
+        self.cfg.append((name, val))
+
+    # ------------------------------------------------------------------
+    def init(self) -> None:
+        if self.task == "train" and self.continue_training:
+            if self._sync_latest_model() == 0:
+                raise RuntimeError(
+                    "Init: Cannot find models for continue training. "
+                    "Please specify it by model_in instead.")
+            print("Init: Continue training from round %d" % self.start_counter)
+            self._create_iterators()
+            return
+        self.continue_training = 0
+        if self.name_model_in == "NULL":
+            assert self.task == "train", "must specify model_in if not training"
+            self.net_trainer = self._create_net()
+            self.net_trainer.init_model()
+        elif self.task == "finetune":
+            self._copy_model()
+        else:
+            self._load_model()
+        self._create_iterators()
+
+    def _model_path(self, counter: int) -> str:
+        return os.path.join(self.name_model_dir, "%04d.model" % counter)
+
+    def _sync_latest_model(self) -> int:
+        """Scan model_dir for the newest %04d.model (reference :135-157)."""
+        s_counter = self.start_counter
+        last = None
+        while os.path.exists(self._model_path(s_counter)):
+            last = self._model_path(s_counter)
+            s_counter += 1
+        if last is None:
+            return 0
+        with open(last, "rb") as f:
+            r = serializer.Reader(f)
+            self.net_type = r.read_int32()
+            self.net_trainer = self._create_net()
+            self.net_trainer.load_model(r)
+        self.start_counter = s_counter
+        return 1
+
+    def _load_model(self) -> None:
+        base = os.path.basename(self.name_model_in)
+        try:
+            self.start_counter = int(base.split(".")[0])
+        except ValueError:
+            print("WARNING: Cannot infer start_counter from model name. "
+                  "Specify it in config if needed")
+        with open(self.name_model_in, "rb") as f:
+            r = serializer.Reader(f)
+            self.net_type = r.read_int32()
+            self.net_trainer = self._create_net()
+            self.net_trainer.load_model(r)
+        self.start_counter += 1
+
+    def _copy_model(self) -> None:
+        with open(self.name_model_in, "rb") as f:
+            r = serializer.Reader(f)
+            self.net_type = r.read_int32()
+            self.net_trainer = self._create_net()
+            self.net_trainer.copy_model_from(r)
+
+    def _save_model(self) -> None:
+        name = self._model_path(self.start_counter)
+        self.start_counter += 1
+        if self.save_period == 0 or self.start_counter % self.save_period != 0:
+            return
+        os.makedirs(self.name_model_dir, exist_ok=True)
+        with open(name, "wb") as f:
+            w = serializer.Writer(f)
+            w.write_int32(self.net_type)
+            self.net_trainer.save_model(w)
+
+    def _create_net(self) -> Trainer:
+        if self.reset_net_type != -1:
+            self.net_type = self.reset_net_type
+        net = create_net(self.net_type)
+        for k, v in self.cfg:
+            net.set_param(k, v)
+        return net
+
+    def _create_iterators(self) -> None:
+        """Sectioned iterator parsing (reference :214-264): data=/eval=/pred=
+        blocks terminated by iter=end; keys outside blocks are defaults
+        applied to every iterator."""
+        flag = 0
+        evname = ""
+        itcfg: List[Tuple[str, str]] = []
+        defcfg: List[Tuple[str, str]] = []
+        for name, val in self.cfg:
+            if name == "data":
+                flag = 1
+                continue
+            if name == "eval":
+                evname = val
+                flag = 2
+                continue
+            if name == "pred":
+                flag = 3
+                self.name_pred = val
+                continue
+            if name == "iter" and val == "end":
+                assert flag != 0, "wrong configuration file"
+                if flag == 1 and self.task != "pred":
+                    assert self.itr_train is None, "can only have one data"
+                    self.itr_train = create_iterator(itcfg)
+                if flag == 2 and self.task != "pred":
+                    self.itr_evals.append(create_iterator(itcfg))
+                    self.eval_names.append(evname)
+                if flag == 3 and self.task in ("pred", "pred_raw", "extract"):
+                    assert self.itr_pred is None, "can only have one data:test"
+                    self.itr_pred = create_iterator(itcfg)
+                flag = 0
+                itcfg = []
+                continue
+            if flag == 0:
+                defcfg.append((name, val))
+            else:
+                itcfg.append((name, val))
+        for itr in ([self.itr_train] if self.itr_train else []) + \
+                ([self.itr_pred] if self.itr_pred else []) + self.itr_evals:
+            for k, v in defcfg:
+                itr.set_param(k, v)
+            itr.init()
+
+    # ------------------------------------------------------------------
+    def task_train(self) -> None:
+        start = time.time()
+        if self.continue_training == 0 and self.name_model_in == "NULL":
+            self._save_model()
+        else:
+            if not self.silent:
+                print("continuing from round %d" % (self.start_counter - 1))
+            for itr, nm in zip(self.itr_evals, self.eval_names):
+                sys.stderr.write(self.net_trainer.evaluate(itr, nm))
+            sys.stderr.write("\n")
+            sys.stderr.flush()
+        if self.itr_train is None:
+            return
+        if self.test_io != 0:
+            print("start I/O test")
+        cc = self.max_round
+        while self.start_counter <= self.num_round and cc > 0:
+            cc -= 1
+            if not self.silent:
+                print("update round %d" % (self.start_counter - 1))
+            sample_counter = 0
+            self.net_trainer.start_round(self.start_counter)
+            self.itr_train.before_first()
+            while self.itr_train.next():
+                if self.test_io == 0:
+                    self.net_trainer.update(self.itr_train.value())
+                sample_counter += 1
+                if sample_counter % self.print_step == 0 and not self.silent:
+                    print("round %8d:[%8d] %.0f sec elapsed" %
+                          (self.start_counter - 1, sample_counter,
+                           time.time() - start))
+            if self.test_io == 0:
+                sys.stderr.write("[%d]" % self.start_counter)
+                if not self.itr_evals:
+                    sys.stderr.write(self.net_trainer.evaluate(None, "train"))
+                for itr, nm in zip(self.itr_evals, self.eval_names):
+                    sys.stderr.write(self.net_trainer.evaluate(itr, nm))
+                sys.stderr.write("\n")
+                sys.stderr.flush()
+            self._save_model()
+        if not self.silent:
+            print("updating end, %.0f sec in all" % (time.time() - start))
+
+    def task_predict(self) -> None:
+        assert self.itr_pred is not None, \
+            "must specify a predict iterator to generate predictions"
+        print("start predicting...")
+        with open(self.name_pred, "w") as fo:
+            self.itr_pred.before_first()
+            while self.itr_pred.next():
+                batch = self.itr_pred.value()
+                pred = self.net_trainer.predict(batch)
+                assert batch.num_batch_padd < batch.batch_size, \
+                    "num batch pad must be smaller"
+                for v in pred[: len(pred) - batch.num_batch_padd]:
+                    fo.write("%g\n" % v)
+        print("finished prediction, write into %s" % self.name_pred)
+
+    def task_extract_feature(self) -> None:
+        assert self.itr_pred is not None, \
+            "must specify a predict iterator to generate predictions"
+        assert self.extract_node_name != "", \
+            "extract node name must be specified in task extract_feature."
+        print("start predicting...")
+        name_meta = self.name_pred + ".meta"
+        nrow = 0
+        dshape = (0, 0, 0)
+        mode = "w" if self.output_format else "wb"
+        with open(self.name_pred, mode) as fo:
+            self.itr_pred.before_first()
+            while self.itr_pred.next():
+                batch = self.itr_pred.value()
+                pred = self.net_trainer.extract_feature(
+                    batch, self.extract_node_name)
+                sz = pred.shape[0] - batch.num_batch_padd
+                nrow += sz
+                for j in range(sz):
+                    row = pred[j].reshape(-1)
+                    if self.output_format:
+                        fo.write(" ".join("%g" % x for x in row) + " \n")
+                    else:
+                        fo.write(row.astype("<f4").tobytes())
+                if sz:
+                    dshape = pred.shape[1:]
+        with open(name_meta, "w") as fm:
+            fm.write("%d,%d,%d,%d\n" % (nrow, dshape[0], dshape[1], dshape[2]))
+        print("finished prediction, write into %s" % self.name_pred)
+
+
+def main(argv: List[str]) -> int:
+    return LearnTask().run(argv)

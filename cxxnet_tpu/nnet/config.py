@@ -1,0 +1,281 @@
+"""NetConfig: the ``netconfig=start .. end`` layer DSL -> node/layer DAG.
+
+Reimplements the reference's NetConfig (src/nnet/nnet_config.h:26-411):
+* ``layer[+1:name] = type:tag`` / ``layer[+0] = type`` / ``layer[a->b] = type``
+  / ``layer[a,b->c] = type`` connection grammar (GetLayerInfo :303-360)
+* node name allocation ("in" = node 0, extra data in_1..in_k, numeric names)
+* per-layer config capture (keys after a layer line bind to that layer) and
+  global defaults (defcfg) applied to every layer (:280-286)
+* ``label_vec[a,b) = name`` label-field ranges (SetGlobalParam :192-203)
+* binary SaveNet/LoadNet with the reference's exact struct layout
+  (NetParam = 152 bytes incl. reserved[31]; :126-191)
+"""
+
+from __future__ import annotations
+
+import re
+import struct
+from typing import Dict, List, Tuple
+
+from ..layer import factory
+from ..utils import serializer
+from ..layer.base import check
+
+Pair = Tuple[str, str]
+
+
+class LayerInfo:
+    def __init__(self):
+        self.type = 0
+        self.primary_layer_index = -1
+        self.name = ""
+        self.nindex_in: List[int] = []
+        self.nindex_out: List[int] = []
+
+    def __eq__(self, other):
+        return (self.type == other.type
+                and self.primary_layer_index == other.primary_layer_index
+                and self.name == other.name
+                and self.nindex_in == other.nindex_in
+                and self.nindex_out == other.nindex_out)
+
+
+class NetParam:
+    def __init__(self):
+        self.num_nodes = 0
+        self.num_layers = 0
+        self.input_shape = (0, 0, 0)  # (c, h, w), batch not included
+        self.init_end = 0
+        self.extra_data_num = 0
+
+    _FMT = "<ii3Iii"  # + reserved[31]
+
+    def save(self, w: serializer.Writer):
+        w.write_raw(struct.pack(self._FMT, self.num_nodes, self.num_layers,
+                                *self.input_shape, self.init_end,
+                                self.extra_data_num))
+        w.write_raw(b"\x00" * (31 * 4))
+
+    def load(self, r: serializer.Reader):
+        vals = struct.unpack(self._FMT, r.read_raw(struct.calcsize(self._FMT)))
+        self.num_nodes, self.num_layers = vals[0], vals[1]
+        self.input_shape = tuple(vals[2:5])
+        self.init_end, self.extra_data_num = vals[5], vals[6]
+        r.read_raw(31 * 4)
+
+
+class NetConfig:
+    def __init__(self):
+        self.param = NetParam()
+        self.layers: List[LayerInfo] = []
+        self.node_names: List[str] = []
+        self.node_name_map: Dict[str, int] = {}
+        self.layer_name_map: Dict[str, int] = {}
+        self.updater_type = "sgd"
+        self.sync_type = "simple"
+        self.label_name_map: Dict[str, int] = {"label": 0}
+        self.label_range: List[Tuple[int, int]] = [(0, 1)]
+        self.defcfg: List[Pair] = []
+        self.layercfg: List[List[Pair]] = []
+        self.extra_shape: List[int] = []
+
+    # ------------------------------------------------------------------
+    def set_global_param(self, name: str, val: str) -> None:
+        if name == "updater":
+            self.updater_type = val
+        if name == "sync":
+            self.sync_type = val
+        m = re.match(r"label_vec\[(\d+),(\d+)\)$", name)
+        if m:
+            self.label_range.append((int(m.group(1)), int(m.group(2))))
+            self.label_name_map[val] = len(self.label_range) - 1
+
+    def configure(self, cfg: List[Pair]) -> None:
+        """Parse an ordered (name, value) config list (reference Configure,
+        nnet_config.h:207-289)."""
+        self._clear_config()
+        if not self.node_names and not self.node_name_map:
+            self.node_names.append("in")
+            self.node_name_map["in"] = 0
+        self.node_name_map["0"] = 0
+        netcfg_mode = 0
+        cfg_top_node = 0
+        cfg_layer_index = 0
+        for name, val in cfg:
+            if name == "extra_data_num":
+                num = int(val)
+                for i in range(num):
+                    nm = "in_%d" % (i + 1)
+                    if nm not in self.node_name_map:
+                        self.node_names.append(nm)
+                        self.node_name_map[nm] = i + 1
+                self.param.extra_data_num = num
+            if name.startswith("extra_data_shape[") and self.param.init_end == 0:
+                # only while the structure is still being defined — a
+                # load_net-then-configure cycle must not re-append dims
+                dims = [int(x) for x in val.split(",")]
+                check(len(dims) == 3, "extra data shape config incorrect")
+                self.extra_shape.extend(dims)
+            if self.param.init_end == 0 and name == "input_shape":
+                zyx = [int(x) for x in val.split(",")]
+                check(len(zyx) == 3,
+                      "input_shape must be three consecutive integers "
+                      "without space example: 1,1,200")
+                self.param.input_shape = tuple(zyx)
+            if netcfg_mode != 2:
+                self.set_global_param(name, val)
+            if name == "netconfig" and val == "start":
+                netcfg_mode = 1
+            if name == "netconfig" and val == "end":
+                netcfg_mode = 0
+            if name.startswith("layer["):
+                info = self._get_layer_info(name, val, cfg_top_node, cfg_layer_index)
+                netcfg_mode = 2
+                if self.param.init_end == 0:
+                    assert len(self.layers) == cfg_layer_index, "NetConfig inconsistent"
+                    self.layers.append(info)
+                    while len(self.layercfg) < len(self.layers):
+                        self.layercfg.append([])
+                else:
+                    check(cfg_layer_index < len(self.layers),
+                          "config layer index exceed bound")
+                    check(info == self.layers[cfg_layer_index],
+                          "config setting does not match existing network structure")
+                cfg_top_node = info.nindex_out[0] if len(info.nindex_out) == 1 else -1
+                cfg_layer_index += 1
+                continue
+            if netcfg_mode == 2:
+                check(self.layers[cfg_layer_index - 1].type != factory.kSharedLayer,
+                      "please do not set parameters in shared layer, "
+                      "set them in primary layer")
+                self.layercfg[cfg_layer_index - 1].append((name, val))
+            else:
+                self.defcfg.append((name, val))
+        if self.param.init_end == 0:
+            self._init_net()
+
+    def get_layer_index(self, name: str) -> int:
+        if name not in self.layer_name_map:
+            raise ValueError("unknown layer name %s" % name)
+        return self.layer_name_map[name]
+
+    # ------------------------------------------------------------------
+    def _get_layer_info(self, name: str, val: str,
+                        top_node: int, cfg_layer_index: int) -> LayerInfo:
+        inf = LayerInfo()
+        m_inc = re.match(r"layer\[\+(\d+)(?::([^\]]+))?\]$", name)
+        m_arrow = re.match(r"layer\[([^\]]+)->([^\]]+)\]$", name)
+        if m_inc:
+            check(top_node >= 0,
+                  "ConfigError: layer[+1] is used, but last layer has more "
+                  "than one output; use layer[input-name->output-name] instead")
+            inc = int(m_inc.group(1))
+            inf.nindex_in.append(top_node)
+            if m_inc.group(2):
+                inf.nindex_out.append(self._get_node_index(m_inc.group(2), True))
+            elif inc == 0:
+                inf.nindex_out.append(top_node)
+            else:
+                tag = "!node-after-%d" % top_node
+                inf.nindex_out.append(self._get_node_index(tag, True))
+        elif m_arrow:
+            for tok in m_arrow.group(1).split(","):
+                inf.nindex_in.append(self._get_node_index(tok, False))
+            for tok in m_arrow.group(2).split(","):
+                inf.nindex_out.append(self._get_node_index(tok, True))
+        else:
+            raise ValueError("ConfigError: invalid layer format %s" % name)
+
+        if ":" in val:
+            ltype, layer_name = val.split(":", 1)
+        else:
+            ltype, layer_name = val, ""
+        inf.type = factory.get_layer_type(ltype)
+        if inf.type == factory.kSharedLayer:
+            m = re.match(r"share\[([^\]]+)\]$", ltype)
+            check(m is not None,
+                  "ConfigError: shared layer must specify tag of layer to share with")
+            s_tag = m.group(1)
+            check(s_tag in self.layer_name_map,
+                  "ConfigError: shared layer tag %s is not defined before" % s_tag)
+            inf.primary_layer_index = self.layer_name_map[s_tag]
+        elif layer_name:
+            if layer_name in self.layer_name_map:
+                check(self.layer_name_map[layer_name] == cfg_layer_index,
+                      "ConfigError: layer name in the configuration file does "
+                      "not match the name stored in model")
+            else:
+                self.layer_name_map[layer_name] = cfg_layer_index
+            inf.name = layer_name
+        return inf
+
+    def _get_node_index(self, name: str, alloc_unknown: bool) -> int:
+        name = name.strip()
+        if name in self.node_name_map:
+            return self.node_name_map[name]
+        check(alloc_unknown,
+              "ConfigError: undefined node name %s; input node of a layer must "
+              "be specified as output of another layer presented before the "
+              "layer declaration" % name)
+        value = len(self.node_names)
+        self.node_name_map[name] = value
+        self.node_names.append(name)
+        return value
+
+    def _init_net(self) -> None:
+        self.param.num_nodes = 0
+        self.param.num_layers = len(self.layers)
+        for info in self.layers:
+            for j in info.nindex_in + info.nindex_out:
+                self.param.num_nodes = max(j + 1, self.param.num_nodes)
+        assert self.param.num_nodes == len(self.node_names), \
+            "num_nodes is inconsistent with node_names"
+        self.param.init_end = 1
+
+    def _clear_config(self) -> None:
+        self.defcfg = []
+        self.layercfg = [[] for _ in self.layercfg]
+
+    # ------------------------------------------------------------------
+    # binary serialization (SaveNet/LoadNet, nnet_config.h:126-191)
+    def save_net(self, w: serializer.Writer) -> None:
+        self.param.save(w)
+        if self.param.extra_data_num != 0:
+            w.write_int_vector(self.extra_shape)
+        assert self.param.num_layers == len(self.layers), "model inconsistent"
+        assert self.param.num_nodes == len(self.node_names), \
+            "num_nodes is inconsistent with node_names"
+        for nm in self.node_names:
+            w.write_string(nm)
+        for info in self.layers:
+            w.write_int32(info.type)
+            w.write_int32(info.primary_layer_index)
+            w.write_string(info.name)
+            w.write_int_vector(info.nindex_in)
+            w.write_int_vector(info.nindex_out)
+
+    def load_net(self, r: serializer.Reader) -> None:
+        self.param.load(r)
+        if self.param.extra_data_num != 0:
+            self.extra_shape = r.read_int_vector()
+        self.node_names = [r.read_string() for _ in range(self.param.num_nodes)]
+        self.node_name_map = {nm: i for i, nm in enumerate(self.node_names)}
+        self.layers = []
+        self.layer_name_map = {}
+        for i in range(self.param.num_layers):
+            info = LayerInfo()
+            info.type = r.read_int32()
+            info.primary_layer_index = r.read_int32()
+            info.name = r.read_string()
+            info.nindex_in = r.read_int_vector()
+            info.nindex_out = r.read_int_vector()
+            if info.type == factory.kSharedLayer:
+                check(info.name == "", "SharedLayer must not have name")
+            elif info.name:
+                check(info.name not in self.layer_name_map,
+                      "NetConfig: invalid model file, duplicated layer name: %s"
+                      % info.name)
+                self.layer_name_map[info.name] = i
+            self.layers.append(info)
+        self.layercfg = [[] for _ in range(self.param.num_layers)]
+        self._clear_config()

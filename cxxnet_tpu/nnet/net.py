@@ -1,0 +1,176 @@
+"""NeuralNet: the assembled DAG as a pure forward function over a params pytree.
+
+TPU-native counterpart of NeuralNet<xpu> (src/nnet/neural_net-inl.hpp:23-297).
+The reference owns device nodes and mutates them through per-connection
+Forward/Backprop with per-tensor async PS sync; here the whole forward (and,
+via jax.grad, backward) is one traceable function executed inside a single
+jitted train step — XLA handles scheduling, fusion and collective overlap.
+
+Weight sharing (``share:<tag>``) maps to connections applying the primary
+connection's layer object with the primary's params — autodiff then sums the
+shared gradients, matching the reference's accumulation into one gwmat.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..layer import factory
+from ..layer.base import ApplyContext, LabelInfo, Layer, check
+from ..utils import serializer
+from .config import NetConfig
+
+Params = List[Dict[str, jnp.ndarray]]
+
+
+class NeuralNet:
+    def __init__(self, cfg: NetConfig, batch_size: int,
+                 infer_shapes: bool = True):
+        """infer_shapes=False skips shape inference entirely — used for the
+        weight-copy (finetune) path, which only deserializes params and never
+        runs the net (reference CopyModelFrom, nnet_impl-inl.hpp:101-134)."""
+        self.cfg = cfg
+        self.max_batch = batch_size
+        self.layers: List[Layer] = []        # one per connection (shared -> primary obj)
+        self.is_shared: List[bool] = []
+        self.node_shapes: List[Tuple[int, int, int, int]] = []
+        self._build_layers()
+        if infer_shapes:
+            self._infer_shapes()
+
+    # ------------------------------------------------------------------
+    def _build_layers(self) -> None:
+        cfg = self.cfg
+        for i, info in enumerate(cfg.layers):
+            if info.type == factory.kSharedLayer:
+                assert info.primary_layer_index >= 0, "primary_layer_index problem"
+                check(info.primary_layer_index < len(self.layers),
+                      "shared layer primary_layer_index exceed bound")
+                self.layers.append(self.layers[info.primary_layer_index])
+                self.is_shared.append(True)
+                continue
+            lay = factory.create_layer(info.type)
+            if hasattr(lay, "n_out"):  # split: fan-out = connection's out arity
+                lay.n_out = max(len(info.nindex_out), 1)
+            for k, v in cfg.defcfg:
+                lay.set_param(k, v)
+            for k, v in cfg.layercfg[i]:
+                lay.set_param(k, v)
+            self.layers.append(lay)
+            self.is_shared.append(False)
+
+    def _infer_shapes(self) -> None:
+        """Shape inference sweep (InitConnection semantics)."""
+        cfg = self.cfg
+        shapes: List[Optional[Tuple[int, int, int, int]]] = \
+            [None] * cfg.param.num_nodes
+        c, h, w = cfg.param.input_shape
+        shapes[0] = (self.max_batch, c, h, w)
+        for i in range(cfg.param.extra_data_num):
+            es = cfg.extra_shape[i * 3: i * 3 + 3]
+            shapes[i + 1] = (self.max_batch, es[0], es[1], es[2])
+        for i, info in enumerate(cfg.layers):
+            in_shapes = []
+            for j in info.nindex_in:
+                check(shapes[j] is not None,
+                      "node %d used before defined" % j)
+                in_shapes.append(shapes[j])
+            out_shapes = self.layers[i].infer_shape(in_shapes)
+            check(len(out_shapes) == len(info.nindex_out),
+                  "layer %d: output arity mismatch" % i)
+            for j, s in zip(info.nindex_out, out_shapes):
+                shapes[j] = s
+        self.node_shapes = shapes  # type: ignore[assignment]
+
+    # ------------------------------------------------------------------
+    def init_params(self, seed: int = 0) -> Params:
+        params: Params = []
+        for i, lay in enumerate(self.layers):
+            if self.is_shared[i]:
+                params.append({})
+            else:
+                rng = np.random.RandomState(seed + i * 9973)
+                params.append(lay.init_params(rng))
+        return params
+
+    def forward(self, params: Params, data, extra_data=(),
+                labels: Optional[LabelInfo] = None, train: bool = False,
+                rng=None, epoch=0):
+        """Run the DAG; returns (node_values list, total_loss scalar)."""
+        cfg = self.cfg
+        values: List[Optional[jnp.ndarray]] = [None] * cfg.param.num_nodes
+        values[0] = jnp.asarray(data)
+        for i, ex in enumerate(extra_data):
+            values[i + 1] = jnp.asarray(ex)
+        ctx = ApplyContext(train=train, labels=labels, epoch=epoch)
+        base_rng = rng if rng is not None else jax.random.PRNGKey(0)
+        for i, info in enumerate(cfg.layers):
+            lay = self.layers[i]
+            pidx = (cfg.layers[i].primary_layer_index
+                    if self.is_shared[i] else i)
+            ctx.rng = jax.random.fold_in(base_rng, i)
+            ins = [values[j] for j in info.nindex_in]
+            outs = lay.apply(params[pidx], ins, ctx)
+            for j, v in zip(info.nindex_out, outs):
+                values[j] = v
+        total_loss = sum(ctx.losses) if ctx.losses else jnp.zeros(())
+        self._last_pairtest_diffs = getattr(ctx, "pairtest_diffs", [])
+        return values, total_loss
+
+    # ------------------------------------------------------------------
+    def label_info_from(self, label_batch, as_numpy: bool = False) -> LabelInfo:
+        """Build named label fields from a (batch, label_width) matrix using
+        the config's label_vec ranges (GetLabelInfo, nnet_impl-inl.hpp:257-272).
+
+        as_numpy=True keeps fields as host arrays (for metrics); default
+        wraps them as jnp for use inside the jitted step."""
+        fields = {}
+        lb = np.asarray(label_batch) if as_numpy else jnp.asarray(label_batch)
+        for name, idx in self.cfg.label_name_map.items():
+            begin, end = self.cfg.label_range[idx]
+            fields[name] = lb[:, begin:end]
+        return LabelInfo(fields)
+
+    # ------------------------------------------------------------------
+    def save_model_blob(self, params: Params) -> bytes:
+        w = serializer.Writer()
+        for i, lay in enumerate(self.layers):
+            if not self.is_shared[i]:
+                lay.save_model(w, jax.device_get(params[i]))
+        return w.getvalue()
+
+    def load_model_blob(self, blob: bytes) -> Params:
+        r = serializer.Reader(blob)
+        params: Params = []
+        for i, lay in enumerate(self.layers):
+            if self.is_shared[i]:
+                params.append({})
+            else:
+                params.append({k: v for k, v in lay.load_model(r).items()})
+        return params
+
+    # weight access (SetWeight/GetWeight, nnet_impl-inl.hpp:243-270)
+    def get_weight(self, params: Params, layer_name: str, tag: str):
+        idx = self.cfg.get_layer_index(layer_name)
+        for t, key in self.layers[idx].visit_order():
+            if t == tag:
+                arr = np.asarray(jax.device_get(params[idx][key]))
+                shape = list(arr.shape)
+                return arr.reshape(arr.shape[0], -1) if arr.ndim > 1 \
+                    else arr.reshape(1, -1), shape
+        raise ValueError("layer %s has no weight tag %s" % (layer_name, tag))
+
+    def set_weight(self, params: Params, value: np.ndarray,
+                   layer_name: str, tag: str) -> None:
+        idx = self.cfg.get_layer_index(layer_name)
+        for t, key in self.layers[idx].visit_order():
+            if t == tag:
+                cur = params[idx][key]
+                params[idx][key] = jnp.asarray(
+                    np.asarray(value).reshape(np.shape(cur)), jnp.float32)
+                return
+        raise ValueError("layer %s has no weight tag %s" % (layer_name, tag))

@@ -1,0 +1,19 @@
+"""Parallelism: device meshes, sharding rules, and collectives.
+
+TPU-native replacement for the reference's entire distribution stack —
+mshadow-ps push/pull parameter server + per-GPU worker threads
+(SURVEY.md §2.9-§2.10). Strategy mapping:
+
+* single-node multi-GPU data parallelism (dev=gpu:a-b, batch split across
+  NeuralNetThreads, PS "local" sync)      -> batch sharded over the mesh
+  'data' axis; XLA inserts the gradient all-reduce over ICI
+* distributed PS (param_server=dist, update_on_server=1, server-side
+  optimizer)                              -> ZeRO-style sharded optimizer
+  state (weight-update sharding) over the data axis
+* per-tensor async push/pull overlap      -> XLA latency-hiding scheduler
+  within the single jitted train step
+"""
+
+from .mesh import create_mesh, parse_device_spec  # noqa: F401
+from .sharding import (batch_sharding, replicated, shard_opt_state,  # noqa: F401
+                       zero_sharding)

@@ -1,0 +1,48 @@
+"""Device mesh creation and ``dev=`` spec parsing.
+
+Replaces the reference's device-thread spawning (CXXNetThreadTrainer dev
+parsing, src/nnet/nnet_impl-inl.hpp:32-51): ``dev=gpu:0-3`` meant four GPU
+worker threads; here it selects devices for a 1-D data mesh (higher-dim
+meshes for tensor/pipeline parallelism are built by passing axis specs).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+def parse_device_spec(spec: str) -> Tuple[str, List[int]]:
+    """Parse ``cpu`` / ``gpu`` / ``tpu`` / ``tpu:0-3`` / ``gpu:0,2`` into
+    (kind, device_ids). Empty id list means "all available"."""
+    if ":" not in spec:
+        return spec, []
+    kind, ids = spec.split(":", 1)
+    if "-" in ids:
+        a, b = ids.split("-")
+        return kind, list(range(int(a), int(b) + 1))
+    return kind, [int(x) for x in ids.split(",")]
+
+
+def create_mesh(device_ids: Optional[Sequence[int]] = None,
+                axes: Tuple[str, ...] = ("data",),
+                shape: Optional[Tuple[int, ...]] = None) -> Mesh:
+    """Create a mesh over the given devices (default: all).
+
+    axes/shape allow multi-axis meshes, e.g. axes=("data", "model"),
+    shape=(4, 2). A 1-D data mesh reproduces the reference's data-parallel
+    topology with ICI all-reduce instead of the PS.
+    """
+    devs = jax.devices()
+    if device_ids:
+        id_map = {d.id: d for d in devs}
+        devs = [id_map[i] for i in device_ids if i in id_map]
+        if not devs:
+            devs = jax.devices()[: len(device_ids)]
+    if shape is None:
+        shape = (len(devs),) + (1,) * (len(axes) - 1)
+    arr = np.array(devs[: int(np.prod(shape))]).reshape(shape)
+    return Mesh(arr, axes)

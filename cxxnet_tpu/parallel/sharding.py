@@ -1,0 +1,44 @@
+"""Sharding rules: batch sharding, replication, and ZeRO-style optimizer
+state sharding (the reference's ``update_on_server`` equivalent).
+
+The reference runs the optimizer on parameter-server processes with the
+weights partitioned by key (src/nnet/nnet_ps_server.cpp); the TPU-native
+analogue is weight-update sharding: optimizer state (and the update compute)
+is sharded across the data axis, with XLA emitting reduce-scatter +
+all-gather instead of all-reduce (see PAPERS.md "Automatic Cross-Replica
+Sharding of Weight Update in Data-Parallel Training").
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Shard the leading (batch) dim across the data axis."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def zero_sharding(mesh: Mesh, x: Any, axis: str = "data") -> NamedSharding:
+    """Sharding for one optimizer-state tensor: split the first dim across
+    the data axis when divisible, else replicate."""
+    n = mesh.shape[axis]
+    shape = getattr(x, "shape", ())
+    if len(shape) > 0 and shape[0] % n == 0 and shape[0] >= n:
+        return NamedSharding(mesh, P(axis))
+    return NamedSharding(mesh, P())
+
+
+def shard_opt_state(mesh: Mesh, opt_state: Any, axis: str = "data") -> Any:
+    """Apply ZeRO-style sharding constraints to an optimizer-state pytree
+    inside jit (weight-update sharding)."""
+    def constrain(x):
+        return jax.lax.with_sharding_constraint(x, zero_sharding(mesh, x, axis))
+    return jax.tree.map(constrain, opt_state)

@@ -1,0 +1,12 @@
+"""Optimizers (updaters) with reference-compatible semantics and schedules.
+
+TPU-native counterpart of src/updater/: one Updater per weight tensor
+(created per layer via the visitor walk, src/updater/updater_impl-inl.hpp:49),
+but expressed as pure functions folded into the jitted train step — the
+reference's per-tensor AsyncUpdater push/pull overlap
+(src/updater/async_updater-inl.hpp) is subsumed by XLA's latency-hiding
+scheduler once gradients+updates live in one compiled program.
+"""
+
+from .param import UpdaterParam  # noqa: F401
+from .updaters import Updater, create_updater, encode_data_key, decode_tag  # noqa: F401

@@ -1,0 +1,105 @@
+"""Updater hyper-parameters and learning-rate / momentum schedules.
+
+Mirrors src/updater/param.h:13-136, including:
+* tag-scoped overrides — ``wmat:lr = 0.01`` applies only to updaters whose
+  tag is ``wmat`` (param.h:100-104)
+* schedules (param.h:76-95): constant / expdecay / polydecay / factor
+* unconditional clamp of momentum to final_momentum and of lr to lr_minimum
+  (reference behavior, reproduced)
+
+schedule_epoch() is jit-safe: ``epoch`` may be a traced jnp scalar, so one
+compiled train step serves every epoch without recompilation.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class UpdaterParam:
+    def __init__(self, tag: str = ""):
+        self.tag = tag
+        self.silent = 0
+        self.base_lr = 0.01
+        self.wd = 0.0
+        self.momentum = 0.9
+        self.lr_schedule = 0
+        self.momentum_schedule = 0
+        self.lr_step = 1
+        self.lr_gamma = 0.5
+        self.lr_alpha = 0.5
+        self.lr_factor = 0.1
+        self.lr_minimum = 0.00001
+        self.start_epoch = 0
+        self.base_momentum = 0.5
+        self.final_momentum = 0.90
+        self.saturation_epoch = 0
+        self.clip_gradient = 0.0
+
+    def set_param(self, name: str, val: str) -> None:
+        # tag-scoped override: "wmat:lr" applies when tag == "wmat"
+        if self.tag and name.startswith(self.tag):
+            if len(name) > len(self.tag) and name[len(self.tag)] == ":":
+                name = name[len(self.tag) + 1:]
+        if name in ("lr", "eta"):
+            self.base_lr = float(val)
+        if name == "wd":
+            self.wd = float(val)
+        if name == "momentum":
+            self.momentum = float(val)
+        if name == "silent":
+            self.silent = int(val)
+        if name == "momentum_schedule":
+            self.momentum_schedule = int(val)
+        if name == "clip_gradient":
+            self.clip_gradient = float(val)
+        if name == "final_momentum":
+            self.final_momentum = float(val)
+        if name == "base_momentum":
+            self.base_momentum = float(val)
+        if name == "saturation_epoch":
+            self.saturation_epoch = int(val)
+        if name.startswith("lr:") or name.startswith("eta:"):
+            sub = name.split(":", 1)[1]
+            if sub == "schedule":
+                self.lr_schedule = {"constant": 0, "expdecay": 1,
+                                    "polydecay": 2, "factor": 3}.get(val, self.lr_schedule)
+            if sub == "gamma":
+                self.lr_gamma = float(val)
+            if sub == "alpha":
+                self.lr_alpha = float(val)
+            if sub == "step":
+                self.lr_step = int(val)
+            if sub == "factor":
+                self.lr_factor = float(val)
+            if sub == "minimum_lr":
+                self.lr_minimum = float(val)
+            if sub == "start_epoch":
+                self.start_epoch = int(val)
+
+    def schedule_epoch(self, epoch):
+        """Return (learning_rate, momentum) at `epoch` updates
+        (param.h ScheduleEpoch; epoch counts optimizer updates, not rounds).
+        jit-safe in `epoch`."""
+        e = jnp.asarray(epoch, jnp.float32)
+        if self.lr_schedule == 0:
+            lr = jnp.asarray(self.base_lr, jnp.float32)
+        elif self.lr_schedule == 1:
+            lr = self.base_lr * jnp.power(self.lr_gamma, e / self.lr_step)
+        elif self.lr_schedule == 2:
+            lr = self.base_lr * jnp.power(
+                1.0 + jnp.floor(e / self.lr_step) * self.lr_gamma, -self.lr_alpha)
+        elif self.lr_schedule == 3:
+            lr = self.base_lr * jnp.power(self.lr_factor, jnp.floor(e / self.lr_step))
+        else:
+            raise ValueError("unknown schedule type")
+        momentum = jnp.asarray(self.momentum, jnp.float32)
+        if self.momentum_schedule and self.saturation_epoch:
+            # intended linear warmup toward final_momentum (the reference's
+            # stateful accumulation saturates to the same fixed point)
+            momentum = self.base_momentum + \
+                (self.final_momentum - self.base_momentum) / self.saturation_epoch * e
+        momentum = jnp.minimum(momentum, self.final_momentum)
+        lr = jnp.maximum(lr, self.lr_minimum)
+        lr = jnp.where(e < self.start_epoch, self.base_lr, lr)
+        return lr, momentum

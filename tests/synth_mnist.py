@@ -1,0 +1,53 @@
+"""Generate tiny synthetic MNIST-format idx.gz files for end-to-end tests.
+
+Images are class-dependent blobs so a small net can learn the mapping; the
+format is bit-identical to the real MNIST idx files consumed by the
+reference's mnist iterator.
+"""
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+
+def write_idx_images(path: str, images: np.ndarray) -> None:
+    n, h, w = images.shape
+    with gzip.open(path, "wb") as f:
+        f.write(struct.pack(">iiii", 2051, n, h, w))
+        f.write(images.astype(np.uint8).tobytes())
+
+
+def write_idx_labels(path: str, labels: np.ndarray) -> None:
+    with gzip.open(path, "wb") as f:
+        f.write(struct.pack(">ii", 2049, len(labels)))
+        f.write(labels.astype(np.uint8).tobytes())
+
+
+def make_dataset(dirname: str, n_train: int = 600, n_test: int = 200,
+                 n_class: int = 10, hw: int = 28, seed: int = 0):
+    """Create train/test idx.gz files; returns the four paths."""
+    rs = np.random.RandomState(seed)
+    protos = rs.rand(n_class, hw, hw) * 200
+
+    def gen(n, seed2):
+        rs2 = np.random.RandomState(seed2)
+        labels = rs2.randint(0, n_class, n)
+        imgs = protos[labels] + rs2.randn(n, hw, hw) * 20
+        return np.clip(imgs, 0, 255).astype(np.uint8), labels
+
+    os.makedirs(dirname, exist_ok=True)
+    tr_img, tr_lab = gen(n_train, seed + 1)
+    te_img, te_lab = gen(n_test, seed + 2)
+    paths = {
+        "train_img": os.path.join(dirname, "train-images-idx3-ubyte.gz"),
+        "train_lab": os.path.join(dirname, "train-labels-idx1-ubyte.gz"),
+        "test_img": os.path.join(dirname, "t10k-images-idx3-ubyte.gz"),
+        "test_lab": os.path.join(dirname, "t10k-labels-idx1-ubyte.gz"),
+    }
+    write_idx_images(paths["train_img"], tr_img)
+    write_idx_labels(paths["train_lab"], tr_lab)
+    write_idx_images(paths["test_img"], te_img)
+    write_idx_labels(paths["test_lab"], te_lab)
+    return paths

@@ -1,0 +1,244 @@
+"""End-to-end tests: config file -> CLI task driver -> trained model.
+
+This is the framework's version of the reference's "example configs as
+integration tests" strategy (SURVEY.md §4.4): MNIST-format data, the MNIST
+MLP/conv configs, train/continue/pred/extract tasks.
+"""
+
+import os
+import re
+import sys
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.learn_task import LearnTask
+
+from . import synth_mnist
+
+
+MLP_CONF = """
+data = train
+iter = mnist
+    path_img = "{train_img}"
+    path_label = "{train_lab}"
+    shuffle = 1
+iter = end
+eval = test
+iter = mnist
+    path_img = "{test_img}"
+    path_label = "{test_lab}"
+iter = end
+
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 64
+  init_sigma = 0.01
+layer[+1:sg1] = sigmoid:se1
+layer[sg1->fc2] = fullc:fc2
+  nhidden = 10
+  init_sigma = 0.01
+layer[+0] = softmax
+netconfig=end
+
+input_shape = 1,1,784
+batch_size = 100
+
+dev = cpu
+save_model = 1
+model_dir = {model_dir}
+num_round = {num_round}
+max_round = {num_round}
+train_eval = 1
+random_type = gaussian
+eta = 0.2
+momentum = 0.9
+wd  = 0.0
+metric = error
+eval_train = 1
+silent = 1
+"""
+
+CONV_CONF = """
+data = train
+iter = mnist
+    path_img = "{train_img}"
+    path_label = "{train_lab}"
+    input_flat = 0
+    shuffle = 1
+iter = end
+eval = test
+iter = mnist
+    input_flat = 0
+    path_img = "{test_img}"
+    path_label = "{test_lab}"
+iter = end
+
+netconfig=start
+layer[0->1] = conv:cv1
+  kernel_size = 3
+  pad = 1
+  stride = 2
+  nchannel = 16
+  random_type = xavier
+layer[1->2] = max_pooling
+  kernel_size = 3
+  stride = 2
+layer[2->3] = flatten
+layer[3->3] = dropout
+  threshold = 0.2
+layer[3->4] = fullc:fc1
+  nhidden = 32
+  init_sigma = 0.1
+layer[4->5] = relu
+layer[5->6] = fullc:fc2
+  nhidden = 10
+  init_sigma = 0.1
+layer[6->6] = softmax
+netconfig=end
+
+input_shape = 1,28,28
+batch_size = 100
+dev = cpu
+save_model = 15
+model_dir = {model_dir}
+num_round = {num_round}
+max_round = {num_round}
+eta = 0.1
+momentum = 0.9
+clip_gradient = 5.0
+wd  = 0.0
+metric = error
+eval_train = 1
+silent = 1
+"""
+
+
+@pytest.fixture(scope="module")
+def mnist_data(tmp_path_factory):
+    d = tmp_path_factory.mktemp("mnist_data")
+    return synth_mnist.make_dataset(str(d))
+
+
+def write_conf(tmp_path, template, data, num_round=3, **extra):
+    conf = template.format(model_dir=str(tmp_path / "models"),
+                           num_round=num_round, **data, **extra)
+    p = tmp_path / "test.conf"
+    p.write_text(conf)
+    return str(p)
+
+
+def run_task(conf_path, *overrides):
+    task = LearnTask()
+    task.run([conf_path] + list(overrides))
+    return task
+
+
+def final_eval_error(task):
+    return {name: m.get() for name, m in
+            zip(["test"], task.net_trainer.metric.evals)}
+
+
+def test_mnist_mlp_trains(tmp_path, mnist_data, capsys):
+    conf = write_conf(tmp_path, MLP_CONF, mnist_data, num_round=4)
+    task = run_task(conf)
+    # model files written with reference naming
+    assert os.path.exists(str(tmp_path / "models" / "0001.model"))
+    # final eval error must be far below chance (0.9)
+    err = task.net_trainer.metric.evals[0].get()
+    assert err < 0.35, "eval error %f did not improve" % err
+
+
+def test_mnist_mlp_continue_resume(tmp_path, mnist_data):
+    conf = write_conf(tmp_path, MLP_CONF, mnist_data, num_round=2)
+    run_task(conf)
+    assert os.path.exists(str(tmp_path / "models" / "0002.model"))
+    # continue training picks up the newest model
+    task2 = run_task(conf, "continue=1", "num_round=3")
+    assert task2.start_counter == 4
+    assert os.path.exists(str(tmp_path / "models" / "0003.model"))
+
+
+def test_mnist_pred_task(tmp_path, mnist_data):
+    conf = write_conf(tmp_path, MLP_CONF, mnist_data, num_round=2)
+    run_task(conf)
+    pred_file = str(tmp_path / "pred.txt")
+    conf2 = conf  # reuse; add pred section via overrides is messy — write new conf
+    text = open(conf).read().replace(
+        "data = train", "pred = %s\niter = mnist\n  path_img = \"%s\"\n"
+        "  path_label = \"%s\"\niter = end\ndata = train" %
+        (pred_file, mnist_data["test_img"], mnist_data["test_lab"]))
+    p = tmp_path / "pred.conf"
+    p.write_text(text)
+    run_task(str(p), "task=pred", "model_in=%s" %
+             str(tmp_path / "models" / "0002.model"))
+    preds = np.loadtxt(pred_file)
+    assert preds.shape[0] == 200
+    assert set(np.unique(preds)).issubset(set(range(10)))
+
+
+def test_mnist_extract_task(tmp_path, mnist_data):
+    conf = write_conf(tmp_path, MLP_CONF, mnist_data, num_round=1)
+    run_task(conf)
+    out_file = str(tmp_path / "feat.txt")
+    text = open(conf).read().replace(
+        "data = train", "pred = %s\niter = mnist\n  path_img = \"%s\"\n"
+        "  path_label = \"%s\"\niter = end\ndata = train" %
+        (out_file, mnist_data["test_img"], mnist_data["test_lab"]))
+    p = tmp_path / "extract.conf"
+    p.write_text(text)
+    run_task(str(p), "task=extract", "extract_node_name=sg1",
+             "model_in=%s" % str(tmp_path / "models" / "0001.model"))
+    feats = np.loadtxt(out_file)
+    assert feats.shape == (200, 64)
+    meta = open(out_file + ".meta").read().strip()
+    assert meta == "200,1,1,64"
+
+
+def test_mnist_finetune_task(tmp_path, mnist_data):
+    conf = write_conf(tmp_path, MLP_CONF, mnist_data, num_round=2)
+    run_task(conf)
+    task = run_task(conf, "task=finetune",
+                    "model_in=%s" % str(tmp_path / "models" / "0002.model"),
+                    "num_round=1", "model_dir=%s" % str(tmp_path / "models_ft"))
+    err = task.net_trainer.metric.evals[0].get()
+    assert err < 0.5  # finetuning from a trained model stays good
+
+
+def test_mnist_conv_trains(tmp_path, mnist_data):
+    conf = write_conf(tmp_path, CONV_CONF, mnist_data, num_round=4)
+    task = run_task(conf)
+    err = task.net_trainer.metric.evals[0].get()
+    assert err < 0.5, "conv eval error %f did not improve" % err
+
+
+def test_mnist_mlp_multidevice(tmp_path, mnist_data):
+    """Data-parallel over the virtual 8-device CPU mesh (dev=tpu:0-3 maps to
+    4 devices; replaces the reference's dev=gpu:0-3 worker threads)."""
+    conf = write_conf(tmp_path, MLP_CONF, mnist_data, num_round=4)
+    task = run_task(conf, "dev=tpu:0-3")
+    assert task.net_trainer.mesh is not None
+    assert task.net_trainer.mesh.devices.size == 4
+    err = task.net_trainer.metric.evals[0].get()
+    assert err < 0.35, "multi-device eval error %f" % err
+
+
+def test_update_period_accumulation(tmp_path, mnist_data):
+    conf = write_conf(tmp_path, MLP_CONF, mnist_data, num_round=6)
+    task = run_task(conf, "update_period=2", "eta=0.4")
+    err = task.net_trainer.metric.evals[0].get()
+    assert err < 0.5
+    # epoch counter counts updates: 6 rounds * 6 batches / 2
+    assert task.net_trainer.epoch_counter == 18
+
+
+def test_threadbuffer_chain(tmp_path, mnist_data):
+    conf = write_conf(tmp_path, MLP_CONF, mnist_data, num_round=4)
+    text = open(conf).read().replace(
+        "    shuffle = 1\niter = end",
+        "    shuffle = 1\niter = threadbuffer\niter = end")
+    p = tmp_path / "tb.conf"
+    p.write_text(text)
+    task = run_task(str(p))
+    err = task.net_trainer.metric.evals[0].get()
+    assert err < 0.5

@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""im2bin: pack an image list into BinaryPage bins (reference tools/im2bin.cpp).
+
+Usage: im2bin.py <image.lst> <image_root> <out.bin> [page_ints]
+
+Reads lines of ``index label[ label..] filename`` from the list, appends each
+image file's raw bytes as one object per record into fixed-size BinaryPages
+(default page size matches the reference's 64 MiB pages).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from cxxnet_tpu.utils.binary_page import BinaryPage, KPAGE_INTS
+
+
+def im2bin(lst_path: str, image_root: str, out_path: str,
+           page_ints: int = KPAGE_INTS) -> int:
+    count = 0
+    with open(out_path, "wb") as fo:
+        page = BinaryPage(page_ints)
+        with open(lst_path) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                fname = line.split()[-1]
+                path = os.path.join(image_root, fname) if image_root else fname
+                with open(path, "rb") as fimg:
+                    data = fimg.read()
+                if not page.push(data):
+                    page.save(fo)
+                    page.clear()
+                    assert page.push(data), \
+                        "image %s larger than a page" % fname
+                count += 1
+        if page.size():
+            page.save(fo)
+    return count
+
+
+if __name__ == "__main__":
+    if len(sys.argv) < 4:
+        print(__doc__)
+        sys.exit(1)
+    pi = int(sys.argv[4]) if len(sys.argv) > 4 else KPAGE_INTS
+    n = im2bin(sys.argv[1], sys.argv[2], sys.argv[3], pi)
+    print("packed %d images into %s" % (n, sys.argv[3]))
