@@ -53,6 +53,7 @@ class Trainer:
         self.dev_spec = "tpu"
         self.type_pserver = "UNSPECIFIED"
         self.update_on_server = 0
+        self.model_parallel = 1
         self.metric = MetricSet()
         self.train_metric = MetricSet()
         self.eval_node_names: List[Optional[str]] = []  # None -> last node
@@ -82,6 +83,8 @@ class Trainer:
             self.type_pserver = val
         if name == "update_on_server":
             self.update_on_server = int(val)
+        if name == "model_parallel":
+            self.model_parallel = int(val)
         if name.startswith("metric"):
             m = re.match(r"metric\[([^,\]]+)(?:,([^\]]+))?\]$", name)
             if m:
@@ -102,12 +105,42 @@ class Trainer:
         n_avail = len(jax.devices())
         n = len(ids) if ids else 1
         n = min(max(n, 1), n_avail)
-        if n > 1:
+        mp = self.model_parallel
+        if mp > 1:
+            check(n % mp == 0, "device count must be divisible by model_parallel")
+            dp = n // mp
+            check(dp == 1 or self.batch_size % dp == 0,
+                  "batch_size must be divisible by the data-parallel degree")
+            self.mesh = parallel.create_mesh(ids[:n] if ids else None,
+                                             ("data", "model"), (dp, mp))
+        elif n > 1:
             check(self.batch_size % n == 0,
                   "batch_size must be divisible by number of devices")
             self.mesh = parallel.create_mesh(ids[:n] if ids else None, ("data",))
         else:
             self.mesh = None
+
+    def _place_params(self) -> None:
+        """Tensor-parallel placement: device_put params (and matching opt
+        state) with the model-axis shardings; GSPMD partitions the matmuls."""
+        self._tp_shardings = None
+        if self.mesh is None or "model" not in self.mesh.axis_names:
+            return
+        from ..parallel.sharding import param_shardings
+        shards = param_shardings(self.mesh, self.net.layers, self.params)
+        self._tp_shardings = shards
+        self.params = [
+            {k: jax.device_put(jnp.asarray(v), shards[i][k])
+             for k, v in p.items()}
+            for i, p in enumerate(self.params)]
+        if self.opt_state is not None:
+            self.opt_state = [
+                {k: jax.tree.map(
+                    lambda s: jax.device_put(jnp.asarray(s), shards[i][k])
+                    if getattr(s, "shape", None) == self.params[i][k].shape
+                    else s, st)
+                 for k, st in p.items()}
+                for i, p in enumerate(self.opt_state)]
 
     def _init_net_structure(self) -> None:
         self.net_cfg.configure(self.cfg_pairs)
@@ -158,6 +191,7 @@ class Trainer:
             self.opt_state.append(st)
         self.grad_accum = None
         self.sample_counter = 0
+        self._place_params()
 
     # ------------------------------------------------------------------
     # checkpointing (reference SaveModel/LoadModel, nnet_impl-inl.hpp:81-100)
@@ -171,9 +205,13 @@ class Trainer:
     def load_model(self, r: serializer.Reader) -> None:
         self.net_cfg.load_net(r)
         self.epoch_counter = int(np.frombuffer(r.read_raw(8), np.int64)[0])
-        # rebuild with training cfg applied on top of the loaded structure
+        # rebuild with training cfg applied on top of the loaded structure;
+        # shape inference must wait until the model blob restores each
+        # layer's LayerParam (nhidden etc.) — the reference likewise loads
+        # params before InitConnection (neural_net-inl.hpp LoadModel)
         self.net_cfg.configure(self.cfg_pairs)
-        self.net = NeuralNet(self.net_cfg, self.batch_size)
+        self.net = NeuralNet(self.net_cfg, self.batch_size,
+                             infer_shapes=False)
         self._setup_mesh()
         self.eval_nodes = [self.net_cfg.param.num_nodes - 1 if nm is None
                            else self.net_cfg.node_name_map[nm]
@@ -182,6 +220,7 @@ class Trainer:
         self._jit_cache.clear()
         nbytes = r.read_uint64()
         self.params = self.net.load_model_blob(r.read_raw(nbytes))
+        self.net._infer_shapes()
         self._init_opt()
 
     def copy_model_from(self, r: serializer.Reader) -> None:
@@ -230,7 +269,9 @@ class Trainer:
                 new_params[i][key] = w
                 new_opt[i][key] = st
         if self.mesh is not None and self.update_on_server:
-            new_opt = parallel.shard_opt_state(self.mesh, new_opt)
+            from ..parallel.sharding import shard_opt_state_with_specs
+            new_opt = shard_opt_state_with_specs(
+                self.mesh, new_opt, getattr(self, "_tp_shardings", None))
         return new_params, new_opt
 
     def _make_train_step(self, do_update: bool, accumulate: bool):

@@ -1,0 +1,27 @@
+"""shard_map compatibility: jax >= 0.8 moved it to jax.shard_map and renamed
+check_rep -> check_vma. Collective-heavy bodies (ring scans, pipelines) mix
+axis-varying and invariant carries, so the replication/vma check is disabled
+either way."""
+
+from __future__ import annotations
+
+import inspect
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_params = inspect.signature(_shard_map).parameters
+if "check_vma" in _params:
+    _CHECK_KW = "check_vma"
+elif "check_rep" in _params:
+    _CHECK_KW = "check_rep"
+else:  # pragma: no cover
+    _CHECK_KW = None
+
+
+def shard_map(f, mesh, in_specs, out_specs):
+    kwargs = {_CHECK_KW: False} if _CHECK_KW else {}
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kwargs)

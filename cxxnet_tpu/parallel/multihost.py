@@ -1,0 +1,61 @@
+"""Multi-host / multi-slice support: process init and hybrid DCN x ICI meshes.
+
+Replaces the reference's distributed launch story — parameter-server
+processes started through MPI (`example/MNIST/mpi.conf`, `bin/cxxnet.ps`,
+SURVEY.md §2.9 row 2) — with the jax runtime's multi-controller model: every
+host runs the same program, `jax.distributed.initialize` forms the cluster,
+and a hybrid mesh lays data parallelism across DCN (slices) while
+tensor/sequence axes stay inside a slice on ICI. Workers shard input data by
+process index exactly like the reference's `dist_num_worker`/`PS_RANK`
+scheme (src/io/iter_thread_imbin-inl.hpp:189-211) — see
+`worker_shard_params`.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Join the multi-host cluster. No-op for single-process runs; args
+    default from the standard env (JAX_COORDINATOR_ADDRESS etc. or TPU
+    metadata)."""
+    if num_processes is None:
+        num_processes = int(os.environ.get("CXXNET_NUM_WORKER", "0")) or None
+    if process_id is None:
+        pid = os.environ.get("CXXNET_WORKER_RANK", os.environ.get("PS_RANK"))
+        process_id = int(pid) if pid is not None else None
+    if num_processes in (None, 0, 1) and coordinator_address is None:
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def create_hybrid_mesh(ici_shape: Sequence[int],
+                       dcn_shape: Sequence[int],
+                       axes: Tuple[str, ...]) -> Mesh:
+    """Mesh whose leading factors split across DCN (slices) and trailing
+    across ICI, so collectives on ICI axes never cross slice boundaries.
+
+    Example: 2 slices x 8 chips, axes=("data","model"):
+        create_hybrid_mesh(ici_shape=(1, 8), dcn_shape=(2, 1), axes)
+    puts 'data' over DCN and 'model' over in-slice ICI.
+    """
+    from jax.experimental import mesh_utils
+    devices = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=tuple(ici_shape), dcn_mesh_shape=tuple(dcn_shape))
+    return Mesh(devices, axes)
+
+
+def worker_shard_params() -> Tuple[int, int]:
+    """(num_workers, rank) for input sharding — the reference's
+    dist_num_worker / dist_worker_rank derived from the process topology."""
+    return jax.process_count(), jax.process_index()

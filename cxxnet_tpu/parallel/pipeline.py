@@ -1,0 +1,94 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a ``pipe``
+mesh axis.
+
+Green-field for the TPU build (the reference has no model partitioning at
+all, SURVEY.md §2.9 "Not present"). The design is the standard TPU
+collective-permute pipeline: stage s lives on device s of the ``pipe`` axis;
+activations hop one ICI neighbor per tick via ppermute; a scan over
+n_micro + n_stages - 1 ticks drains the bubble. The whole schedule is one
+jitted program, so XLA overlaps the hop with the next microbatch's compute.
+
+Constraint (documented, checked): stage boundaries must share one activation
+shape — stages are "equal-width", e.g. repeated blocks of a deep MLP/resnet
+trunk. That is the shape-uniformity XLA needs to trace one stage body for
+all devices.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ._compat import shard_map
+
+
+def stage_sharding(mesh: Mesh, axis: str = "pipe") -> NamedSharding:
+    """Sharding for stacked per-stage params: leading dim = stage index."""
+    return NamedSharding(mesh, P(axis))
+
+
+def _pipeline_local(params, x, *, axis_name: str, n_micro: int,
+                    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray]):
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    # local slice of the stacked stage params: leading dim 1 -> this stage
+    params = jax.tree.map(lambda p: p[0], params)
+    outbuf = jnp.zeros_like(x)
+    cur = jnp.zeros_like(x[0])
+    # forward hop: stage s -> s+1 (no wraparound; device 0 ingests fresh
+    # microbatches, so its incoming edge is unused)
+    perm = [(i, i + 1) for i in range(n - 1)]
+
+    def tick(carry, t):
+        cur, outbuf = carry
+        x_t = lax.dynamic_index_in_dim(x, jnp.clip(t, 0, n_micro - 1),
+                                       axis=0, keepdims=False)
+        inp = jnp.where(idx == 0, x_t, cur)
+        y = stage_fn(params, inp)
+        done_t = t - (n - 1)
+        pos = jnp.clip(done_t, 0, n_micro - 1)
+        valid = (done_t >= 0) & (idx == n - 1)
+        slot = lax.dynamic_index_in_dim(outbuf, pos, axis=0, keepdims=False)
+        outbuf = lax.dynamic_update_index_in_dim(
+            outbuf, jnp.where(valid, y, slot), pos, axis=0)
+        cur = lax.ppermute(y, axis_name, perm)
+        return (cur, outbuf), None
+
+    (_, outbuf), _ = lax.scan(tick, (cur, outbuf),
+                              jnp.arange(n_micro + n - 1))
+    # only the last stage wrote real outputs; psum broadcasts them (the other
+    # shards are zeros)
+    return lax.psum(outbuf, axis_name)
+
+
+def pipeline_apply(stage_fn, stacked_params, x, mesh: Mesh, *,
+                   axis: str = "pipe"):
+    """Run microbatches through a pipeline of stages.
+
+    stage_fn(params_s, act) -> act     one stage's forward
+    stacked_params: pytree whose leaves have leading dim n_stages (sharded
+                    or shardable on ``axis``)
+    x: (n_micro, microbatch, ...) input microbatches
+
+    Returns (n_micro, microbatch, ...) outputs, replicated. Differentiable —
+    the backward pipeline runs as the transposed scan with reversed hops.
+    """
+    n_stages = mesh.shape[axis]
+    for leaf in jax.tree.leaves(stacked_params):
+        if leaf.shape[0] != n_stages:
+            raise ValueError(
+                "pipeline_apply: stacked params leading dim %d != %d stages "
+                "on mesh axis %r" % (leaf.shape[0], n_stages, axis))
+    n_micro = x.shape[0]
+    fn = shard_map(
+        functools.partial(_pipeline_local, axis_name=axis, n_micro=n_micro,
+                          stage_fn=stage_fn),
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P())
+    return fn(stacked_params, x)

@@ -1,0 +1,142 @@
+"""Sequence / context parallelism: ring attention and Ulysses all-to-all.
+
+The reference framework is a 2014 CNN trainer with no sequence axis
+(SURVEY.md §5 "Long-context: ABSENT"), so this module is green-field
+TPU-first design: long sequences are sharded over a mesh ``sp`` axis and
+attention runs either as
+
+* **ring attention** — K/V blocks rotate around the ICI ring via ppermute
+  while each device keeps its local Q block and accumulates the softmax
+  online (numerically stable log-sum-exp carry). Comm per step is one
+  neighbor hop, fully overlappable with the block matmul; memory is
+  O(seq/n_devices) per device, enabling sequences that don't fit one chip.
+* **Ulysses** — one all-to-all swaps sequence sharding for head sharding,
+  attention runs dense locally, and a second all-to-all swaps back. Cheaper
+  at moderate sequence lengths when heads >= devices.
+
+Everything is expressed with shard_map + lax collectives so XLA schedules
+the ICI transfers; the scan over ring steps is reverse-differentiable
+(ppermute has a transpose rule), so the same code serves training.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ._compat import shard_map
+
+
+def attention_reference(q, k, v, *, causal: bool = False,
+                        scale: Optional[float] = None):
+    """Plain single-device attention, the golden model for the parallel
+    variants. q,k,v: (batch, heads, seq, head_dim)."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        sq, skv = q.shape[2], k.shape[2]
+        qpos = jnp.arange(sq)[:, None]
+        kpos = jnp.arange(skv)[None, :]
+        s = jnp.where(qpos >= kpos, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
+                          scale: float):
+    """Per-shard body: online-softmax over rotating K/V blocks.
+
+    q: (b, h, sq, d) local query block; k, v: (b, h, skv, d) local key/value
+    blocks. Runs axis_size steps; at step t the device holds the K/V block
+    originally on device (idx - t) mod n.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    q_off = idx * sq
+
+    m0 = jnp.full((b, h, sq), -jnp.inf, q.dtype)
+    l0 = jnp.zeros((b, h, sq), q.dtype)
+    acc0 = jnp.zeros((b, h, sq, d), q.dtype)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, t):
+        k_blk, v_blk, m, l, acc = carry
+        src = (idx - t) % n  # whose block we hold this step
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
+        if causal:
+            qpos = q_off + jnp.arange(sq)[:, None]
+            kpos = src * skv + jnp.arange(skv)[None, :]
+            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # guard fully-masked rows (all -inf): exp(-inf - -inf) -> use where
+        alpha = jnp.where(jnp.isinf(m) & jnp.isinf(m_new),
+                          jnp.zeros_like(m), jnp.exp(m - m_new))
+        p = jnp.exp(s - m_new[..., None])
+        p = jnp.where(jnp.isinf(s) & (s < 0), jnp.zeros_like(p), p)
+        l = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
+        # rotate K/V to the next device on the ring (skippable on the last
+        # step, but keeping it unconditional keeps the scan body uniform)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, m_new, l, acc), None
+
+    (_, _, _, l, acc), _ = lax.scan(step, (k, v, m0, l0, acc0),
+                                    jnp.arange(n))
+    return acc / jnp.maximum(l, 1e-30)[..., None]
+
+
+def ring_attention(q, k, v, mesh: Mesh, *, axis_name: str = "sp",
+                   causal: bool = False, scale: Optional[float] = None):
+    """Ring attention over sequence-sharded q, k, v: (b, h, seq, d) with seq
+    sharded on ``axis_name``. Returns output with the same sharding."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    spec = P(None, None, axis_name, None)
+    fn = shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis_name,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
+    n = lax.axis_size(axis_name)
+
+    def seq_to_heads(x):
+        # (b, h, s/n, d) -> (b, h/n, s, d): split heads, gather sequence
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = attention_reference(qh, kh, vh, causal=causal, scale=scale)
+    return heads_to_seq(out)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, *, axis_name: str = "sp",
+                      causal: bool = False, scale: Optional[float] = None):
+    """Ulysses sequence parallelism: all-to-all seq->heads, dense local
+    attention, all-to-all back. Requires heads % axis_size == 0."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    n = mesh.shape[axis_name]
+    assert q.shape[1] % n == 0, (
+        "ulysses needs heads (%d) divisible by sp axis (%d)" % (q.shape[1], n))
+    spec = P(None, None, axis_name, None)
+    fn = shard_map(
+        functools.partial(_ulysses_local, axis_name=axis_name,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

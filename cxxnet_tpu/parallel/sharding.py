@@ -26,14 +26,73 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def zero_sharding(mesh: Mesh, x: Any, axis: str = "data") -> NamedSharding:
+def zero_sharding(mesh: Mesh, x: Any, axis: str = "data",
+                  base_spec: P = None) -> NamedSharding:
     """Sharding for one optimizer-state tensor: split the first dim across
-    the data axis when divisible, else replicate."""
+    the data axis when divisible, else replicate.
+
+    base_spec carries an existing tensor-parallel placement (e.g.
+    P('model', None) for a TP fullc weight): the ZeRO split composes with it
+    — dim 0 sharded over ('data', 'model') jointly when divisible — instead
+    of overriding it, which would force an all-to-all reshard every step."""
     n = mesh.shape[axis]
     shape = getattr(x, "shape", ())
+    if (base_spec and len(base_spec) > 0 and base_spec[0] is not None
+            and len(shape) == len(base_spec)
+            and shape[0] % mesh.shape[base_spec[0]] == 0):
+        tp_axis = base_spec[0]
+        joint = n * mesh.shape[tp_axis]
+        if shape[0] % joint == 0:
+            return NamedSharding(mesh, P((axis, tp_axis), *base_spec[1:]))
+        return NamedSharding(mesh, base_spec)
     if len(shape) > 0 and shape[0] % n == 0 and shape[0] >= n:
         return NamedSharding(mesh, P(axis))
     return NamedSharding(mesh, P())
+
+
+def shard_opt_state_with_specs(mesh: Mesh, opt_state, base_shardings,
+                               axis: str = "data"):
+    """ZeRO constraint for the trainer's per-layer opt-state structure
+    (list of {weight key: state pytree}), composing with the TP placements
+    in base_shardings (same structure as params, or None)."""
+    out = []
+    for i, layer_state in enumerate(opt_state):
+        d = {}
+        for key, st in layer_state.items():
+            base = None
+            if base_shardings is not None:
+                nsh = base_shardings[i].get(key)
+                base = nsh.spec if nsh is not None else None
+
+            def constrain(x, base=base):
+                return jax.lax.with_sharding_constraint(
+                    x, zero_sharding(mesh, x, axis, base_spec=base))
+
+            d[key] = jax.tree.map(constrain, st)
+        out.append(d)
+    return out
+
+
+def param_shardings(mesh: Mesh, layers, params, axis: str = "model"):
+    """Per-layer weight shardings for tensor parallelism (``model_parallel``
+    config key): fullc weights are split on the output dim — the TP
+    generalization of the reference's ``fullc_gather`` giant-FC trick
+    (src/updater/async_updater-inl.hpp:67-92) — everything else replicated;
+    XLA/GSPMD propagates activation shardings and inserts the collectives."""
+    n = mesh.shape[axis]
+    out = []
+    for lay, p in zip(layers, params):
+        shard = {}
+        for key, val in p.items():
+            shape = getattr(val, "shape", ())
+            if (getattr(lay, "type_name", "") == "fullc" and len(shape) >= 1
+                    and shape[0] % n == 0):
+                spec = P(axis, *([None] * (len(shape) - 1)))
+            else:
+                spec = P()
+            shard[key] = NamedSharding(mesh, spec)
+        out.append(shard)
+    return out
 
 
 def shard_opt_state(mesh: Mesh, opt_state: Any, axis: str = "data") -> Any:

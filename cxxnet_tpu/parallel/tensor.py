@@ -1,0 +1,106 @@
+"""Tensor parallelism: sharded dense layers over a mesh ``model`` axis.
+
+The reference's closest trick is ``fullc_gather`` (SURVEY.md §2.9): for a
+giant FC layer it allgathers (input, output-grad) activation pairs through
+the parameter server and recomputes the weight gradient locally, instead of
+syncing the huge weight gradient (src/updater/async_updater-inl.hpp:67-92).
+The TPU-native generalization is to shard the FC weight itself across the
+``model`` axis — Megatron-style column/row parallelism — so neither the
+weight nor its gradient is ever materialized unsharded; XLA inserts the one
+all-reduce (row-parallel) or none (column-parallel feeding row-parallel).
+
+Two usage modes:
+* GSPMD: just place the weight with `fullc_sharding()` and let XLA partition
+  the matmul — this is what the Trainer does for `model_parallel > 1`.
+* explicit shard_map: `column_parallel_dense` / `row_parallel_dense` below,
+  for code that wants the collectives visible (tests, custom schedules).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ._compat import shard_map
+
+
+def fullc_sharding(mesh: Mesh, axis: str = "model") -> NamedSharding:
+    """Sharding for a fullc weight stored (num_hidden, num_input) — shard the
+    output dim (column parallel in Megatron terms)."""
+    return NamedSharding(mesh, P(axis, None))
+
+
+def bias_sharding(mesh: Mesh, axis: str = "model") -> NamedSharding:
+    return NamedSharding(mesh, P(axis))
+
+
+def _colp(x, w, b, axis_name):
+    # x replicated, w: (out/n, in) shard -> y: (batch, out/n) shard
+    y = x @ w.T
+    if b is not None:
+        y = y + b
+    return y
+
+
+def _rowp(x, w, b, axis_name):
+    # x: (batch, in/n) shard, w: (out, in/n) shard -> partial sums all-reduced
+    y = lax.psum(x @ w.T, axis_name)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def column_parallel_dense(x, w, b, mesh: Mesh, *, axis: str = "model"):
+    """y = x @ w.T + b with w sharded on the output dim. x replicated in,
+    y sharded (axis) out. No collective on the forward path."""
+    fn = shard_map(functools.partial(_colp, axis_name=axis), mesh=mesh,
+                   in_specs=(P(), P(axis, None),
+                             P(axis) if b is not None else None),
+                   out_specs=P(None, axis))
+    return fn(x, w, b)
+
+
+def row_parallel_dense(x, w, b, mesh: Mesh, *, axis: str = "model"):
+    """y = x @ w.T + b with w sharded on the input dim and x sharded to
+    match; one psum produces the replicated output — the canonical second
+    half of a Megatron pair."""
+    in_specs = (P(None, axis), P(None, axis), P() if b is not None else None)
+    fn = shard_map(functools.partial(_rowp, axis_name=axis), mesh=mesh,
+                   in_specs=in_specs, out_specs=P())
+    return fn(x, w, b)
+
+
+def _ep_local(x, w_exp, gates, *, axis_name):
+    # x: (B, din) replicated; w_exp: (E/n, din, dout) local experts;
+    # gates: (B, E/n) local gate probabilities for this device's experts
+    y = jnp.einsum("bi,eio->ebo", x, w_exp)          # every expert, dense
+    y = jnp.maximum(y, 0.0)                          # expert FFN activation
+    out = jnp.einsum("ebo,be->bo", y, gates)         # gate-weighted combine
+    return lax.psum(out, axis_name)                  # sum over expert shards
+
+
+def expert_parallel_ffn(x, w_experts, gate_probs, mesh: Mesh, *,
+                        axis: str = "ep"):
+    """Expert parallelism: experts sharded over the ``axis`` mesh dim, each
+    device runs its local experts densely over all tokens and one psum
+    combines the gate-weighted outputs.
+
+    x: (batch, d_in); w_experts: (n_experts, d_in, d_out); gate_probs:
+    (batch, n_experts). Dense dispatch (every expert sees every token,
+    zeroed by the gate) is the XLA-friendly form — static shapes, MXU-sized
+    matmuls — and is exact for soft gating; top-k gating just passes
+    sparse gate_probs.
+    """
+    n = mesh.shape[axis]
+    if w_experts.shape[0] % n != 0:
+        raise ValueError("expert_parallel_ffn: n_experts %d not divisible by "
+                         "mesh axis %r size %d" % (w_experts.shape[0], axis, n))
+    fn = shard_map(functools.partial(_ep_local, axis_name=axis), mesh=mesh,
+                   in_specs=(P(), P(axis, None, None), P(None, axis)),
+                   out_specs=P())
+    return fn(x, w_experts, gate_probs)
