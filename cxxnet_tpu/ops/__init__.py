@@ -98,12 +98,39 @@ def chpool_sum(x: jnp.ndarray, nsize: int) -> jnp.ndarray:
     )
 
 
-def lrn(x: jnp.ndarray, nsize: int, alpha: float, beta: float, knorm: float) -> jnp.ndarray:
-    """Local response normalization across channels
-    (reference: src/layer/lrn_layer-inl.hpp:52-60)."""
+def lrn_xla(x: jnp.ndarray, nsize: int, alpha: float, beta: float,
+            knorm: float) -> jnp.ndarray:
+    """Pure-XLA LRN (reduce_window channel sum), the golden model for the
+    Pallas kernel and the non-TPU fallback."""
     salpha = alpha / nsize
     norm = chpool_sum(jnp.square(x), nsize) * salpha + knorm
     return x * jnp.power(norm, -beta)
+
+
+_use_pallas = None  # tri-state: None = auto (TPU only), True/False = forced
+
+
+def set_use_pallas(flag) -> None:
+    """Force (True/False) or reset (None = auto) Pallas kernel dispatch."""
+    global _use_pallas
+    _use_pallas = flag
+
+
+def use_pallas() -> bool:
+    if _use_pallas is not None:
+        return _use_pallas
+    return jax.default_backend() == "tpu"
+
+
+def lrn(x: jnp.ndarray, nsize: int, alpha: float, beta: float, knorm: float) -> jnp.ndarray:
+    """Local response normalization across channels
+    (reference: src/layer/lrn_layer-inl.hpp:52-60). Dispatches to the fused
+    Pallas kernel on TPU (banded-matmul window sum on the MXU), XLA
+    reduce_window elsewhere."""
+    if use_pallas():
+        from . import pallas_kernels
+        return pallas_kernels.lrn(x, nsize, alpha, beta, knorm)
+    return lrn_xla(x, nsize, alpha, beta, knorm)
 
 
 def softmax(x: jnp.ndarray, axis: int = -1) -> jnp.ndarray:

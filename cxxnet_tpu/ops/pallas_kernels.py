@@ -1,0 +1,163 @@
+"""Pallas TPU kernels for ops XLA doesn't fuse well.
+
+The reference proves it needs a custom-kernel escape hatch (the hand-written
+`InsanityPoolingExp` Plan::Eval, src/layer/insanity_pooling_layer-inl.hpp:13-100,
+and mshadow's chpool for LRN); on TPU that escape hatch is Pallas
+(SURVEY.md §2.11). Kernels here:
+
+* ``lrn``: AlexNet cross-channel LRN, forward + analytic backward fused into
+  one VMEM pass each. The channel-window sum is expressed as a static banded
+  0/1 matrix multiplied on the MXU — (c, c) x (c, h*w) — instead of nsize
+  shifted adds on the VPU: one systolic pass computes the whole window sum,
+  and the band matrix transposes for the mirrored-window term in backward.
+* ``rrelu``: the insanity layer's per-element random negative slope drawn
+  with the on-core PRNG (pltpu.prng_random_bits) — no HBM round trip for the
+  mask; the slope mask is returned for the backward pass.
+
+Each kernel has an `interpret` switch so the numerics are unit-tested on CPU
+(tests/test_pallas.py) against the pure-XLA implementations in ops/__init__.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+
+def _band_matrix(c: int, nsize: int) -> np.ndarray:
+    """W[i, j] = 1 iff channel j is in i's LRN window
+    [i - nsize//2, i - nsize//2 + nsize) — mshadow chpool's neighborhood."""
+    lo = nsize // 2
+    w = np.zeros((c, c), np.float32)
+    for i in range(c):
+        w[i, max(0, i - lo): min(c, i - lo + nsize)] = 1.0
+    return w
+
+
+def _lrn_fwd_kernel(x_ref, band_ref, o_ref, n_ref, *, salpha, beta, knorm):
+    x = x_ref[0]
+    sq = x * x
+    norm = knorm + salpha * jnp.dot(band_ref[...], sq,
+                                    preferred_element_type=jnp.float32)
+    n_ref[0] = norm
+    o_ref[0] = x * norm ** (-beta)
+
+
+def _lrn_bwd_kernel(x_ref, band_ref, n_ref, g_ref, dx_ref, *, salpha, beta):
+    x = x_ref[0]
+    norm = n_ref[0]
+    g = g_ref[0]
+    # dx_m = g_m n_m^-b - 2 a b x_m * sum_{i: m in w(i)} g_i x_i n_i^{-b-1}
+    # the mirrored window is the band transpose
+    inner = g * x * norm ** (-beta - 1.0)
+    s = jax.lax.dot_general(band_ref[...], inner,
+                            dimension_numbers=(((0,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    dx_ref[0] = g * norm ** (-beta) - (2.0 * salpha * beta) * x * s
+
+
+def _lrn_call(x4d, nsize, salpha, beta, knorm, interpret):
+    b, c, h, w = x4d.shape
+    x = x4d.reshape(b, c, h * w)
+    band = jnp.asarray(_band_matrix(c, nsize))
+    out, norm = pl.pallas_call(
+        functools.partial(_lrn_fwd_kernel, salpha=salpha, beta=beta,
+                          knorm=knorm),
+        grid=(b,),
+        in_specs=[pl.BlockSpec((1, c, h * w), lambda i: (i, 0, 0)),
+                  pl.BlockSpec((c, c), lambda i: (0, 0))],
+        out_specs=[pl.BlockSpec((1, c, h * w), lambda i: (i, 0, 0)),
+                   pl.BlockSpec((1, c, h * w), lambda i: (i, 0, 0))],
+        out_shape=[jax.ShapeDtypeStruct((b, c, h * w), x.dtype),
+                   jax.ShapeDtypeStruct((b, c, h * w), x.dtype)],
+        interpret=interpret,
+    )(x, band)
+    return out.reshape(b, c, h, w), norm
+
+
+def _lrn_bwd_call(x4d, norm, g4d, nsize, salpha, beta, interpret):
+    b, c, h, w = x4d.shape
+    x = x4d.reshape(b, c, h * w)
+    g = g4d.reshape(b, c, h * w)
+    band = jnp.asarray(_band_matrix(c, nsize))
+    dx = pl.pallas_call(
+        functools.partial(_lrn_bwd_kernel, salpha=salpha, beta=beta),
+        grid=(b,),
+        in_specs=[pl.BlockSpec((1, c, h * w), lambda i: (i, 0, 0)),
+                  pl.BlockSpec((c, c), lambda i: (0, 0)),
+                  pl.BlockSpec((1, c, h * w), lambda i: (i, 0, 0)),
+                  pl.BlockSpec((1, c, h * w), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((1, c, h * w), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, c, h * w), x.dtype),
+        interpret=interpret,
+    )(x, band, norm, g)
+    return dx.reshape(b, c, h, w)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+def lrn(x, nsize: int, alpha: float, beta: float, knorm: float,
+        interpret: bool = False):
+    """Fused Pallas LRN (reference numerics: src/layer/lrn_layer-inl.hpp:52-60,
+    salpha = alpha / nsize)."""
+    out, _ = _lrn_call(x, nsize, alpha / nsize, beta, knorm, interpret)
+    return out
+
+
+def _lrn_fwd(x, nsize, alpha, beta, knorm, interpret):
+    out, norm = _lrn_call(x, nsize, alpha / nsize, beta, knorm, interpret)
+    return out, (x, norm)
+
+
+def _lrn_bwd(nsize, alpha, beta, knorm, interpret, res, g):
+    x, norm = res
+    dx = _lrn_bwd_call(x, norm, g, nsize, alpha / nsize, beta, interpret)
+    return (dx,)
+
+
+lrn.defvjp(_lrn_fwd, _lrn_bwd)
+
+
+# ---------------------------------------------------------------------------
+# RReLU (insanity layer) with in-kernel PRNG
+# ---------------------------------------------------------------------------
+def _rrelu_kernel(seed_ref, x_ref, o_ref, m_ref, *, lb, ub):
+    pltpu.prng_seed(seed_ref[0])
+    x = x_ref[...]
+    # prng_random_bits yields int32; shift logically as uint32, then bitcast
+    # back to int32 (top byte now zero) since Mosaic can't cast uint32->f32.
+    # 24 high bits -> exact float32 uniform [0, 1) ladder.
+    bits = pltpu.bitcast(pltpu.prng_random_bits(x.shape), jnp.uint32) >> 8
+    u = pltpu.bitcast(bits, jnp.int32).astype(jnp.float32) * (1.0 / (1 << 24))
+    slope = u * (ub - lb) + lb
+    m_ref[...] = slope
+    o_ref[...] = jnp.where(x > 0, x, x / slope)
+
+
+def rrelu(x, seed, lb: float, ub: float) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Training-mode insanity/RReLU forward: per-element random slope drawn
+    on-core (reference src/layer/insanity_layer-inl.hpp:14 divides the
+    negative part by U[lb, ub]). Returns (out, slope_mask); the mask is the
+    residual for the backward's xelu gradient. TPU-only (on-core PRNG)."""
+    b = x.shape[0]
+    flat = x.reshape(b, -1)
+    seed_arr = jnp.asarray([seed], jnp.int32)
+    out, mask = pl.pallas_call(
+        functools.partial(_rrelu_kernel, lb=lb, ub=ub),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_shape=[jax.ShapeDtypeStruct(flat.shape, x.dtype),
+                   jax.ShapeDtypeStruct(flat.shape, x.dtype)],
+    )(seed_arr, flat)
+    return out.reshape(x.shape), mask.reshape(x.shape)

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import collectives
 from ._compat import shard_map
 
 
@@ -56,14 +57,14 @@ def _pipeline_local(params, x, *, axis_name: str, n_micro: int,
         slot = lax.dynamic_index_in_dim(outbuf, pos, axis=0, keepdims=False)
         outbuf = lax.dynamic_update_index_in_dim(
             outbuf, jnp.where(valid, y, slot), pos, axis=0)
-        cur = lax.ppermute(y, axis_name, perm)
+        cur = collectives.ppermute(y, axis_name, perm)
         return (cur, outbuf), None
 
     (_, outbuf), _ = lax.scan(tick, (cur, outbuf),
                               jnp.arange(n_micro + n - 1))
     # only the last stage wrote real outputs; psum broadcasts them (the other
     # shards are zeros)
-    return lax.psum(outbuf, axis_name)
+    return collectives.psum(outbuf, axis_name)
 
 
 def pipeline_apply(stage_fn, stacked_params, x, mesh: Mesh, *,

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import collectives
 from ._compat import shard_map
 
 
@@ -65,7 +66,6 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
     m0 = jnp.full((b, h, sq), -jnp.inf, q.dtype)
     l0 = jnp.zeros((b, h, sq), q.dtype)
     acc0 = jnp.zeros((b, h, sq, d), q.dtype)
-    perm = [(i, (i + 1) % n) for i in range(n)]
 
     def step(carry, t):
         k_blk, v_blk, m, l, acc = carry
@@ -85,8 +85,8 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
         acc = acc * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
         # rotate K/V to the next device on the ring (skippable on the last
         # step, but keeping it unconditional keeps the scan body uniform)
-        k_blk = lax.ppermute(k_blk, axis_name, perm)
-        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        k_blk = collectives.ring_shift(k_blk, axis_name)
+        v_blk = collectives.ring_shift(v_blk, axis_name)
         return (k_blk, v_blk, m_new, l, acc), None
 
     (_, _, _, l, acc), _ = lax.scan(step, (k, v, m0, l0, acc0),
@@ -132,8 +132,9 @@ def ulysses_attention(q, k, v, mesh: Mesh, *, axis_name: str = "sp",
     if scale is None:
         scale = q.shape[-1] ** -0.5
     n = mesh.shape[axis_name]
-    assert q.shape[1] % n == 0, (
-        "ulysses needs heads (%d) divisible by sp axis (%d)" % (q.shape[1], n))
+    if q.shape[1] % n != 0:
+        raise ValueError("ulysses needs heads (%d) divisible by sp axis (%d)"
+                         % (q.shape[1], n))
     spec = P(None, None, axis_name, None)
     fn = shard_map(
         functools.partial(_ulysses_local, axis_name=axis_name,

@@ -43,7 +43,9 @@ def zero_sharding(mesh: Mesh, x: Any, axis: str = "data",
         tp_axis = base_spec[0]
         joint = n * mesh.shape[tp_axis]
         if shape[0] % joint == 0:
-            return NamedSharding(mesh, P((axis, tp_axis), *base_spec[1:]))
+            # tp axis major: each device's opt-state shard nests inside its
+            # own param shard, so no cross-model-shard reshard per step
+            return NamedSharding(mesh, P((tp_axis, axis), *base_spec[1:]))
         return NamedSharding(mesh, base_spec)
     if len(shape) > 0 and shape[0] % n == 0 and shape[0] >= n:
         return NamedSharding(mesh, P(axis))

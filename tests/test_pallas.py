@@ -1,0 +1,56 @@
+"""Pallas kernel numerics vs the pure-XLA goldens, run in interpreter mode
+on CPU (the same kernels compile for TPU; bench.py exercises them there)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cxxnet_tpu import ops
+from cxxnet_tpu.ops import pallas_kernels
+
+
+class TestLRNPallas:
+    def _x(self, seed=0, shape=(2, 16, 5, 5)):
+        return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+    @pytest.mark.parametrize("nsize", [3, 5])
+    def test_forward_matches_xla(self, nsize):
+        x = self._x()
+        out = pallas_kernels.lrn(x, nsize, 0.001, 0.75, 1.0, True)
+        ref = ops.lrn_xla(x, nsize, 0.001, 0.75, 1.0)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_grad_matches_xla(self):
+        x = self._x(1)
+
+        def f_pl(x):
+            return jnp.sum(jnp.square(
+                pallas_kernels.lrn(x, 5, 0.001, 0.75, 1.0, True)))
+
+        def f_xla(x):
+            return jnp.sum(jnp.square(ops.lrn_xla(x, 5, 0.001, 0.75, 1.0)))
+
+        g = jax.grad(f_pl)(x)
+        g_ref = jax.grad(f_xla)(x)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_band_matrix_window(self):
+        # channel 0's window is clipped at the bottom like mshadow chpool
+        w = pallas_kernels._band_matrix(6, 5)
+        np.testing.assert_array_equal(w[0], [1, 1, 1, 0, 0, 0])
+        np.testing.assert_array_equal(w[3], [0, 1, 1, 1, 1, 1])
+        np.testing.assert_array_equal(w[5], [0, 0, 0, 1, 1, 1])
+
+    def test_dispatch_flag(self):
+        x = self._x(2)
+        ops.set_use_pallas(False)
+        try:
+            a = ops.lrn(x, 3, 0.001, 0.75, 1.0)
+        finally:
+            ops.set_use_pallas(None)
+        np.testing.assert_allclose(np.asarray(a),
+                                   np.asarray(ops.lrn_xla(x, 3, 0.001, 0.75, 1.0)))
+        assert ops.use_pallas() == (jax.default_backend() == "tpu")
