@@ -1,0 +1,26 @@
+# Builds the native runtime of cxxnet_tpu:
+#   lib/libcxxnet_tpu_core.so  — config parser, BinaryPage io, threaded reader
+#   bin/im2bin                 — corpus packer (tools/im2bin.cc)
+# The Python package auto-loads the .so when present and falls back to the
+# pure-Python implementations otherwise (cxxnet_tpu/utils/native.py).
+
+CXX ?= g++
+CXXFLAGS ?= -O2 -std=c++17 -Wall -fPIC -pthread
+
+CORE_SRC = src/core/config.cc src/core/binary_page.cc
+CORE_HDR = src/core/cxn_core.h
+
+all: lib/libcxxnet_tpu_core.so bin/im2bin
+
+lib/libcxxnet_tpu_core.so: $(CORE_SRC) $(CORE_HDR)
+	@mkdir -p lib
+	$(CXX) $(CXXFLAGS) -shared -o $@ $(CORE_SRC)
+
+bin/im2bin: tools/im2bin.cc $(CORE_SRC) $(CORE_HDR)
+	@mkdir -p bin
+	$(CXX) $(CXXFLAGS) -o $@ tools/im2bin.cc $(CORE_SRC)
+
+clean:
+	rm -f lib/libcxxnet_tpu_core.so bin/im2bin
+
+.PHONY: all clean

@@ -89,6 +89,8 @@ class ImagePageIterator(IIterator):
         self.dist_worker_rank = 0
         self.page_ints = KPAGE_INTS
         self.lst: Optional[_ListReader] = None
+        self.native_reader = None
+        self.fbin = None
 
     def set_param(self, name, val):
         if name == "image_list":
@@ -146,12 +148,33 @@ class ImagePageIterator(IIterator):
 
     def before_first(self):
         self.lst.reset()
+        if self.native_reader is None:
+            from ..utils import native
+            if native.load() is not None:
+                try:
+                    self.native_reader = native.NativePageReader(
+                        self.path_imgbin, self.page_ints)
+                except (IOError, RuntimeError):
+                    self.native_reader = None
+        else:
+            self.native_reader.before_first()
         self.bin_idx = 0
-        self.fbin = open(self.path_imgbin[0], "rb")
         self.page = None
         self.ptop = 0
+        if getattr(self, "fbin", None) is not None:
+            self.fbin.close()
+            self.fbin = None
+        if self.native_reader is None:
+            self.fbin = open(self.path_imgbin[0], "rb")
 
     def _next_buffer(self) -> bytes:
+        # native path: C++ read-ahead thread parses pages off-GIL
+        # (src/core/binary_page.cc PageReader)
+        if self.native_reader is not None:
+            obj = self.native_reader.next_obj()
+            assert obj is not None, \
+                "binary pack exhausted before list file"
+            return obj
         while self.page is None or self.ptop >= self.page.size():
             page = BinaryPage.load(self.fbin, self.page_ints)
             if page is None:

@@ -17,6 +17,14 @@ from .. import ops
 from .base import ApplyContext, Layer, LayerParam, Shape4, check
 
 
+def _seed_from_key(key) -> jnp.ndarray:
+    """int32 seed scalar from a PRNG key (typed or raw uint32 pair), for
+    kernels that use the on-core TPU PRNG."""
+    if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+        key = jax.random.key_data(key)
+    return key.reshape(-1)[-1].astype(jnp.int32)
+
+
 def _flat2d(x: jnp.ndarray) -> jnp.ndarray:
     return x.reshape(x.shape[0], -1)
 
@@ -289,8 +297,17 @@ class InsanityLayer(Layer):
         x = inputs[0]
         lb, ub = self._bounds(ctx.epoch)
         if ctx.train:
-            u = jax.random.uniform(ctx.rng, x.shape, x.dtype)
-            mask = u * (ub - lb) + lb
+            if ops.use_pallas():
+                # draw the slope with the on-core TPU PRNG (no HBM round
+                # trip for the random bits); stop_gradient as the mask is a
+                # constant of the draw, not a function of x
+                from ..ops import pallas_kernels
+                seed = _seed_from_key(ctx.rng)
+                mask = jax.lax.stop_gradient(pallas_kernels.rrelu_mask(
+                    seed, x.shape, lb, ub, x.dtype))
+            else:
+                u = jax.random.uniform(ctx.rng, x.shape, x.dtype)
+                mask = u * (ub - lb) + lb
             return [ops.xelu(x, mask)]
         return [ops.xelu(x, (self.lb + self.ub) / 2.0)]
 

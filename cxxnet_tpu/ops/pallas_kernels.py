@@ -10,12 +10,14 @@ and mshadow's chpool for LRN); on TPU that escape hatch is Pallas
   0/1 matrix multiplied on the MXU — (c, c) x (c, h*w) — instead of nsize
   shifted adds on the VPU: one systolic pass computes the whole window sum,
   and the band matrix transposes for the mirrored-window term in backward.
-* ``rrelu``: the insanity layer's per-element random negative slope drawn
-  with the on-core PRNG (pltpu.prng_random_bits) — no HBM round trip for the
-  mask; the slope mask is returned for the backward pass.
+* ``uniform`` / ``rrelu_mask``: the insanity layer's per-element random
+  negative slope drawn with the on-core PRNG (pltpu.prng_random_bits) — no
+  HBM round trip for the mask.
 
-Each kernel has an `interpret` switch so the numerics are unit-tested on CPU
-(tests/test_pallas.py) against the pure-XLA implementations in ops/__init__.
+The LRN kernels have an `interpret` switch so their numerics are unit-tested
+on CPU (tests/test_pallas.py) against the pure-XLA implementations in
+ops/__init__. The PRNG kernels are TPU-only (pltpu's PRNG primitives have no
+CPU interpret path) and are validated on-device by tools/check_tpu_kernels.py.
 """
 
 from __future__ import annotations
@@ -45,25 +47,28 @@ def _band_matrix(c: int, nsize: int) -> np.ndarray:
 
 
 def _lrn_fwd_kernel(x_ref, band_ref, o_ref, n_ref, *, salpha, beta, knorm):
-    x = x_ref[0]
+    # compute in f32 regardless of the activation dtype (bf16 nets); the
+    # norm residual n_ref stays f32, the output is cast back
+    x = x_ref[0].astype(jnp.float32)
     sq = x * x
     norm = knorm + salpha * jnp.dot(band_ref[...], sq,
                                     preferred_element_type=jnp.float32)
     n_ref[0] = norm
-    o_ref[0] = x * norm ** (-beta)
+    o_ref[0] = (x * norm ** (-beta)).astype(o_ref.dtype)
 
 
 def _lrn_bwd_kernel(x_ref, band_ref, n_ref, g_ref, dx_ref, *, salpha, beta):
-    x = x_ref[0]
+    x = x_ref[0].astype(jnp.float32)
     norm = n_ref[0]
-    g = g_ref[0]
+    g = g_ref[0].astype(jnp.float32)
     # dx_m = g_m n_m^-b - 2 a b x_m * sum_{i: m in w(i)} g_i x_i n_i^{-b-1}
     # the mirrored window is the band transpose
     inner = g * x * norm ** (-beta - 1.0)
     s = jax.lax.dot_general(band_ref[...], inner,
                             dimension_numbers=(((0,), (0,)), ((), ())),
                             preferred_element_type=jnp.float32)
-    dx_ref[0] = g * norm ** (-beta) - (2.0 * salpha * beta) * x * s
+    dx_ref[0] = (g * norm ** (-beta)
+                 - (2.0 * salpha * beta) * x * s).astype(dx_ref.dtype)
 
 
 def _lrn_call(x4d, nsize, salpha, beta, knorm, interpret):
@@ -79,7 +84,7 @@ def _lrn_call(x4d, nsize, salpha, beta, knorm, interpret):
         out_specs=[pl.BlockSpec((1, c, h * w), lambda i: (i, 0, 0)),
                    pl.BlockSpec((1, c, h * w), lambda i: (i, 0, 0))],
         out_shape=[jax.ShapeDtypeStruct((b, c, h * w), x.dtype),
-                   jax.ShapeDtypeStruct((b, c, h * w), x.dtype)],
+                   jax.ShapeDtypeStruct((b, c, h * w), jnp.float32)],
         interpret=interpret,
     )(x, band)
     return out.reshape(b, c, h, w), norm
@@ -130,34 +135,52 @@ lrn.defvjp(_lrn_fwd, _lrn_bwd)
 # ---------------------------------------------------------------------------
 # RReLU (insanity layer) with in-kernel PRNG
 # ---------------------------------------------------------------------------
-def _rrelu_kernel(seed_ref, x_ref, o_ref, m_ref, *, lb, ub):
+def _uniform_kernel(seed_ref, u_ref):
     pltpu.prng_seed(seed_ref[0])
-    x = x_ref[...]
     # prng_random_bits yields int32; shift logically as uint32, then bitcast
     # back to int32 (top byte now zero) since Mosaic can't cast uint32->f32.
     # 24 high bits -> exact float32 uniform [0, 1) ladder.
-    bits = pltpu.bitcast(pltpu.prng_random_bits(x.shape), jnp.uint32) >> 8
+    bits = pltpu.bitcast(pltpu.prng_random_bits(u_ref.shape), jnp.uint32) >> 8
     u = pltpu.bitcast(bits, jnp.int32).astype(jnp.float32) * (1.0 / (1 << 24))
-    slope = u * (ub - lb) + lb
-    m_ref[...] = slope
-    o_ref[...] = jnp.where(x > 0, x, x / slope)
+    u_ref[...] = u.astype(u_ref.dtype)
+
+
+def uniform(seed, shape, dtype=jnp.float32) -> jnp.ndarray:
+    """U[0, 1) tensor drawn with the on-core TPU PRNG — no HBM round trip
+    for the random bits. `seed` may be a traced int32 scalar. TPU-only:
+    pltpu's PRNG primitives have no CPU interpret path, so this kernel is
+    validated on-device (tools/check_tpu_kernels.py) rather than in the CPU
+    suite."""
+    if pltpu is None:
+        raise RuntimeError(
+            "pallas uniform needs TPU support (jax.experimental.pallas.tpu)")
+    flat = int(np.prod(shape))
+    # pad the flat draw up to a (rows, 128) lane tile
+    cols = 128
+    rows = -(-flat // cols)
+    seed_arr = jnp.asarray([seed], jnp.int32).reshape((1,))
+    u = pl.pallas_call(
+        _uniform_kernel,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows, cols), dtype),
+    )(seed_arr)
+    return u.reshape(-1)[:flat].reshape(shape)
+
+
+def rrelu_mask(seed, shape, lb, ub, dtype=jnp.float32) -> jnp.ndarray:
+    """Per-element random slope in [lb, ub) — the insanity/RReLU divisor
+    (reference src/layer/insanity_layer-inl.hpp:14 divides the negative part
+    by U[lb, ub]); the consumer applies ops.xelu(x, mask). The affine
+    transform runs in XLA (fuses with the consumer) so lb/ub may be traced
+    (calm_start/calm_end annealing)."""
+    u = uniform(seed, shape, dtype)
+    return u * (ub - lb) + lb
 
 
 def rrelu(x, seed, lb: float, ub: float) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Training-mode insanity/RReLU forward: per-element random slope drawn
-    on-core (reference src/layer/insanity_layer-inl.hpp:14 divides the
-    negative part by U[lb, ub]). Returns (out, slope_mask); the mask is the
-    residual for the backward's xelu gradient. TPU-only (on-core PRNG)."""
-    b = x.shape[0]
-    flat = x.reshape(b, -1)
-    seed_arr = jnp.asarray([seed], jnp.int32)
-    out, mask = pl.pallas_call(
-        functools.partial(_rrelu_kernel, lb=lb, ub=ub),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
-                  pl.BlockSpec(memory_space=pltpu.VMEM)],
-        out_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
-                   pl.BlockSpec(memory_space=pltpu.VMEM)],
-        out_shape=[jax.ShapeDtypeStruct(flat.shape, x.dtype),
-                   jax.ShapeDtypeStruct(flat.shape, x.dtype)],
-    )(seed_arr, flat)
-    return out.reshape(x.shape), mask.reshape(x.shape)
+    """Training-mode insanity/RReLU forward. Returns (out, slope_mask); the
+    slope draw happens in-kernel, the elementwise division stays in XLA so
+    autodiff gives the xelu gradient for free."""
+    mask = rrelu_mask(seed, x.shape, lb, ub, x.dtype)
+    return jnp.where(x > 0, x, x / mask), mask

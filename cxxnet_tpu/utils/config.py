@@ -106,8 +106,9 @@ def _tokenize(text: str) -> Iterator[str]:
         yield out
 
 
-def parse_config_string(text: str) -> List[Tuple[str, str]]:
-    """Parse config text into an ordered list of (name, value) pairs."""
+def parse_config_string_py(text: str) -> List[Tuple[str, str]]:
+    """Pure-Python parse: the fallback path and the parity reference for the
+    native tokenizer (tests/test_native.py)."""
     toks = list(_tokenize(text))
     cfg: List[Tuple[str, str]] = []
     i = 0
@@ -122,6 +123,19 @@ def parse_config_string(text: str) -> List[Tuple[str, str]]:
         cfg.append((name, toks[i + 2]))
         i += 3
     return cfg
+
+
+def parse_config_string(text: str) -> List[Tuple[str, str]]:
+    """Parse config text into an ordered list of (name, value) pairs.
+
+    Uses the native tokenizer (src/core/config.cc via
+    lib/libcxxnet_tpu_core.so) when built; pure Python otherwise."""
+    from . import native
+    if native.load() is not None:
+        out = native.parse_config_string(text)
+        if out is not None:
+            return out
+    return parse_config_string_py(text)
 
 
 def parse_config_file(fname: str) -> List[Tuple[str, str]]:

@@ -1,0 +1,161 @@
+"""ctypes loader for the native runtime core (lib/libcxxnet_tpu_core.so).
+
+The native library implements the host-side runtime the reference keeps in
+C++ (config tokenizer, BinaryPage packing, a background-threaded page
+reader — reference: src/utils/config.h, src/utils/io.h:254,
+src/utils/thread_buffer.h). Build with `make` at the repo root. Everything
+here degrades gracefully: when the .so is absent (or CXXNET_TPU_NATIVE=0),
+callers use the pure-Python implementations instead.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional, Tuple
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_LIB_PATH = os.path.join(_REPO_ROOT, "lib", "libcxxnet_tpu_core.so")
+
+_lib = None
+_load_attempted = False
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.CXNCoreVersion.restype = ctypes.c_int64
+    lib.CXNConfigParse.restype = ctypes.c_void_p
+    lib.CXNConfigParse.argtypes = [ctypes.c_char_p,
+                                   ctypes.POINTER(ctypes.c_char_p)]
+    lib.CXNConfigCount.restype = ctypes.c_int64
+    lib.CXNConfigCount.argtypes = [ctypes.c_void_p]
+    lib.CXNConfigGet.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                 ctypes.POINTER(ctypes.c_char_p),
+                                 ctypes.POINTER(ctypes.c_char_p)]
+    lib.CXNConfigFree.argtypes = [ctypes.c_void_p]
+
+    lib.CXNPageCreate.restype = ctypes.c_void_p
+    lib.CXNPageCreate.argtypes = [ctypes.c_int64]
+    lib.CXNPagePush.restype = ctypes.c_int
+    lib.CXNPagePush.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_int64]
+    lib.CXNPageCount.restype = ctypes.c_int64
+    lib.CXNPageCount.argtypes = [ctypes.c_void_p]
+    lib.CXNPageClear.argtypes = [ctypes.c_void_p]
+    lib.CXNPageSave.restype = ctypes.c_int
+    lib.CXNPageSave.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_int]
+    lib.CXNPageFree.argtypes = [ctypes.c_void_p]
+
+    lib.CXNPageReaderCreate.restype = ctypes.c_void_p
+    lib.CXNPageReaderCreate.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64]
+    lib.CXNPageReaderBeforeFirst.argtypes = [ctypes.c_void_p]
+    lib.CXNPageReaderNext.restype = ctypes.c_int64
+    lib.CXNPageReaderNext.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_void_p)]
+    lib.CXNPageReaderFree.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (once) and return the native library, or None."""
+    global _lib, _load_attempted
+    if _load_attempted:
+        return _lib
+    _load_attempted = True
+    if os.environ.get("CXXNET_TPU_NATIVE", "1") == "0":
+        return None
+    path = os.environ.get("CXXNET_TPU_NATIVE_LIB", _LIB_PATH)
+    if not os.path.exists(path):
+        return None
+    try:
+        _lib = _bind(ctypes.CDLL(path))
+    except OSError:
+        _lib = None
+    return _lib
+
+
+def build(quiet: bool = True) -> bool:
+    """Compile the native library via `make` (used by tests/dev). True on
+    success."""
+    global _load_attempted
+    try:
+        subprocess.run(
+            ["make", "lib/libcxxnet_tpu_core.so"], cwd=_REPO_ROOT,
+            check=True,
+            stdout=subprocess.DEVNULL if quiet else None,
+            stderr=subprocess.DEVNULL if quiet else None)
+    except (OSError, subprocess.CalledProcessError):
+        return False
+    _load_attempted = False  # allow re-load
+    return load() is not None
+
+
+def parse_config_string(text: str) -> Optional[List[Tuple[str, str]]]:
+    """Native config parse; None if the library is unavailable.
+    Raises ValueError on malformed config (same cases as the Python
+    tokenizer in cxxnet_tpu.utils.config)."""
+    lib = load()
+    if lib is None:
+        return None
+    err = ctypes.c_char_p()
+    h = lib.CXNConfigParse(text.encode("utf-8"), ctypes.byref(err))
+    if not h:
+        from .config import ConfigError
+        raise ConfigError((err.value or b"parse error").decode())
+    try:
+        n = lib.CXNConfigCount(h)
+        out = []
+        name = ctypes.c_char_p()
+        val = ctypes.c_char_p()
+        for i in range(n):
+            lib.CXNConfigGet(h, i, ctypes.byref(name), ctypes.byref(val))
+            out.append((name.value.decode(), val.value.decode()))
+        return out
+    finally:
+        lib.CXNConfigFree(h)
+
+
+class NativePageReader:
+    """Iterates objects from a chain of BinaryPage .bin files with a C++
+    read-ahead thread. Drop-in for the sequential Python page loop in
+    ImagePageIterator."""
+
+    def __init__(self, paths: List[str], page_ints: int, lookahead: int = 4):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library not available")
+        self._lib = lib
+        arr = (ctypes.c_char_p * len(paths))(
+            *[p.encode("utf-8") for p in paths])
+        self._h = lib.CXNPageReaderCreate(arr, len(paths), page_ints,
+                                          lookahead)
+        if not self._h:
+            raise IOError("cannot open bin files: %s" % paths)
+
+    def before_first(self) -> None:
+        self._lib.CXNPageReaderBeforeFirst(self._h)
+
+    def next_obj(self) -> Optional[bytes]:
+        """Next object's bytes, or None at end of data."""
+        out = ctypes.c_void_p()
+        sz = self._lib.CXNPageReaderNext(self._h, ctypes.byref(out))
+        if sz == -1:
+            return None
+        if sz < 0:
+            raise IOError("native page reader: read/parse error")
+        return ctypes.string_at(out, sz)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.CXNPageReaderFree(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

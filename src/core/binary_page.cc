@@ -1,0 +1,238 @@
+/*!
+ * binary_page.cc — packed-image page format + background-threaded reader.
+ *
+ * Byte-compatible with the reference's BinaryPage (reference:
+ * src/utils/io.h:254-327) and with cxxnet_tpu/utils/binary_page.py:
+ * a page is page_ints little-endian int32 words; word 0 is the object
+ * count n, words 1..n+1 the cumulative object sizes (word 1 = 0), and
+ * object r's payload occupies [page_bytes - cum[r+1], page_bytes - cum[r])
+ * — payloads pack backward from the end of the page.
+ *
+ * The threaded reader generalizes the reference's double-buffered
+ * ThreadBuffer loader thread (reference: src/utils/thread_buffer.h:22,150):
+ * a producer std::thread reads + parses pages from the .bin file chain into
+ * a bounded queue; the consumer (the Python io pipeline, calling through
+ * ctypes with the GIL released) pops objects. This gives file read-ahead
+ * that overlaps JPEG decode and the device step.
+ */
+#include "cxn_core.h"
+
+#include <condition_variable>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Page {
+  std::vector<char> buf;            // page_bytes raw bytes
+  std::vector<int64_t> off, len;    // per-object payload offset/size
+  bool Parse(int64_t page_ints) {
+    const int64_t page_bytes = page_ints * 4;
+    int32_t n;
+    std::memcpy(&n, buf.data(), 4);
+    if (n < 0 || int64_t(n) + 2 > page_ints) return false;
+    off.clear();
+    len.clear();
+    int32_t prev = 0;
+    for (int32_t r = 0; r < n; ++r) {
+      int32_t cum;
+      std::memcpy(&cum, buf.data() + 4 * (r + 2), 4);
+      if (cum < prev || int64_t(cum) > page_bytes) return false;
+      off.push_back(page_bytes - cum);
+      len.push_back(cum - prev);
+      prev = cum;
+    }
+    return true;
+  }
+};
+
+struct PageWriter {
+  int64_t page_ints;
+  std::vector<std::string> objs;
+  int64_t used_payload = 0;
+
+  explicit PageWriter(int64_t pi) : page_ints(pi) {}
+  int64_t FreeBytes() const {
+    return (page_ints - (int64_t(objs.size()) + 2)) * 4 - used_payload;
+  }
+  bool Push(const void *data, int64_t size) {
+    if (FreeBytes() < size + 4) return false;
+    objs.emplace_back(static_cast<const char *>(data), size);
+    used_payload += size;
+    return true;
+  }
+  bool Save(const char *path, bool append) {
+    const int64_t page_bytes = page_ints * 4;
+    std::vector<char> buf(page_bytes, 0);
+    int32_t n = int32_t(objs.size());
+    std::memcpy(buf.data(), &n, 4);
+    int32_t cum = 0;
+    std::memcpy(buf.data() + 4, &cum, 4);
+    for (size_t r = 0; r < objs.size(); ++r) {
+      cum += int32_t(objs[r].size());
+      std::memcpy(buf.data() + 4 * (r + 2), &cum, 4);
+      std::memcpy(buf.data() + page_bytes - cum, objs[r].data(),
+                  objs[r].size());
+    }
+    FILE *f = std::fopen(path, append ? "ab" : "wb");
+    if (!f) return false;
+    size_t wrote = std::fwrite(buf.data(), 1, buf.size(), f);
+    std::fclose(f);
+    return wrote == buf.size();
+  }
+};
+
+struct PageReader {
+  std::vector<std::string> paths;
+  int64_t page_ints;
+  size_t lookahead;
+
+  std::thread worker;
+  std::mutex mu;
+  std::condition_variable cv_prod, cv_cons;
+  std::deque<std::unique_ptr<Page> > queue;
+  bool eof = false, error = false, stop = false;
+
+  std::unique_ptr<Page> cur;   // page being consumed
+  size_t cur_obj = 0;
+
+  PageReader(std::vector<std::string> p, int64_t pi, size_t la)
+      : paths(std::move(p)), page_ints(pi), lookahead(la) {
+    Start();
+  }
+
+  void Start() {
+    eof = error = stop = false;
+    queue.clear();
+    cur.reset();
+    cur_obj = 0;
+    worker = std::thread([this] { Run(); });
+  }
+
+  void Stop() {
+    {
+      std::unique_lock<std::mutex> lk(mu);
+      stop = true;
+      cv_prod.notify_all();
+    }
+    if (worker.joinable()) worker.join();
+  }
+
+  void Run() {
+    const int64_t page_bytes = page_ints * 4;
+    for (const std::string &path : paths) {
+      FILE *f = std::fopen(path.c_str(), "rb");
+      if (!f) {
+        Finish(/*err=*/true);
+        return;
+      }
+      for (;;) {
+        auto page = std::make_unique<Page>();
+        page->buf.resize(page_bytes);
+        size_t got = std::fread(page->buf.data(), 1, page_bytes, f);
+        if (got < size_t(page_bytes)) break;  // next file
+        if (!page->Parse(page_ints)) {
+          std::fclose(f);
+          Finish(/*err=*/true);
+          return;
+        }
+        std::unique_lock<std::mutex> lk(mu);
+        cv_prod.wait(lk, [this] { return queue.size() < lookahead || stop; });
+        if (stop) {
+          std::fclose(f);
+          return;
+        }
+        queue.push_back(std::move(page));
+        cv_cons.notify_all();
+      }
+      std::fclose(f);
+    }
+    Finish(/*err=*/false);
+  }
+
+  void Finish(bool err) {
+    std::unique_lock<std::mutex> lk(mu);
+    eof = true;
+    error = err;
+    cv_cons.notify_all();
+  }
+
+  int64_t Next(const void **out) {
+    while (!cur || cur_obj >= cur->off.size()) {
+      std::unique_lock<std::mutex> lk(mu);
+      cv_cons.wait(lk, [this] { return !queue.empty() || eof; });
+      if (queue.empty()) return error ? -2 : -1;
+      cur = std::move(queue.front());
+      queue.pop_front();
+      cur_obj = 0;
+      cv_prod.notify_all();
+    }
+    *out = cur->buf.data() + cur->off[cur_obj];
+    int64_t sz = cur->len[cur_obj];
+    ++cur_obj;
+    return sz;
+  }
+
+  ~PageReader() { Stop(); }
+};
+
+}  // namespace
+
+extern "C" void *CXNPageCreate(int64_t page_ints) {
+  return new PageWriter(page_ints);
+}
+
+extern "C" int CXNPagePush(void *handle, const void *data, int64_t size) {
+  return static_cast<PageWriter *>(handle)->Push(data, size) ? 1 : 0;
+}
+
+extern "C" int64_t CXNPageCount(void *handle) {
+  return int64_t(static_cast<PageWriter *>(handle)->objs.size());
+}
+
+extern "C" void CXNPageClear(void *handle) {
+  PageWriter *w = static_cast<PageWriter *>(handle);
+  w->objs.clear();
+  w->used_payload = 0;
+}
+
+extern "C" int CXNPageSave(void *handle, const char *path, int append) {
+  return static_cast<PageWriter *>(handle)->Save(path, append != 0) ? 1 : 0;
+}
+
+extern "C" void CXNPageFree(void *handle) {
+  delete static_cast<PageWriter *>(handle);
+}
+
+extern "C" void *CXNPageReaderCreate(const char *const *paths, int64_t npath,
+                                     int64_t page_ints, int64_t lookahead) {
+  std::vector<std::string> p;
+  for (int64_t i = 0; i < npath; ++i) {
+    FILE *f = std::fopen(paths[i], "rb");
+    if (!f) return nullptr;
+    std::fclose(f);
+    p.emplace_back(paths[i]);
+  }
+  if (lookahead < 2) lookahead = 2;
+  return new PageReader(std::move(p), page_ints, size_t(lookahead));
+}
+
+extern "C" void CXNPageReaderBeforeFirst(void *handle) {
+  PageReader *r = static_cast<PageReader *>(handle);
+  r->Stop();
+  r->Start();
+}
+
+extern "C" int64_t CXNPageReaderNext(void *handle, const void **out) {
+  return static_cast<PageReader *>(handle)->Next(out);
+}
+
+extern "C" void CXNPageReaderFree(void *handle) {
+  delete static_cast<PageReader *>(handle);
+}

@@ -1,0 +1,138 @@
+/*!
+ * config.cc — native key=value config tokenizer.
+ *
+ * Token-compatible with the reference's ConfigReaderBase
+ * (reference: src/utils/config.h:20-141) and with the pure-Python
+ * implementation in cxxnet_tpu/utils/config.py (the two are parity-tested):
+ *   - '#' comments to end of line
+ *   - "..." single-line quoted token ('\' escapes; newline inside is an error)
+ *   - '...' multi-line quoted token
+ *   - '=' always its own token; stream consumed as (name, '=', value)
+ */
+#include "cxn_core.h"
+
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace {
+
+struct Config {
+  std::vector<std::pair<std::string, std::string> > pairs;
+};
+
+thread_local std::string g_err;
+
+bool Tokenize(const std::string &text, std::vector<std::string> *toks,
+              std::string *err) {
+  size_t i = 0, n = text.size();
+  std::string tok;
+  auto flush = [&]() {
+    if (!tok.empty()) {
+      toks->push_back(tok);
+      tok.clear();
+    }
+  };
+  while (i < n) {
+    char c = text[i];
+    if (c == '#') {
+      flush();
+      while (i < n && text[i] != '\r' && text[i] != '\n') ++i;
+    } else if (c == '"' || c == '\'') {
+      if (!tok.empty()) {
+        *err = "ConfigReader: token followed directly by string";
+        return false;
+      }
+      char quote = c;
+      ++i;
+      std::string s;
+      for (;;) {
+        if (i >= n) {
+          *err = "ConfigReader: unterminated string";
+          return false;
+        }
+        char ch = text[i];
+        if (ch == '\\') {
+          ++i;
+          if (i < n) s.push_back(text[i]);
+          ++i;
+        } else if (ch == quote) {
+          ++i;
+          break;
+        } else if (quote == '"' && (ch == '\r' || ch == '\n')) {
+          *err = "ConfigReader: unterminated string";
+          return false;
+        } else {
+          s.push_back(ch);
+          ++i;
+        }
+      }
+      toks->push_back(s);
+    } else if (c == '=') {
+      flush();
+      toks->push_back("=");
+      ++i;
+    } else if (c == ' ' || c == '\t' || c == '\r' || c == '\n') {
+      flush();
+      ++i;
+    } else {
+      tok.push_back(c);
+      ++i;
+    }
+  }
+  flush();
+  return true;
+}
+
+}  // namespace
+
+extern "C" void *CXNConfigParse(const char *text, const char **err_out) {
+  std::vector<std::string> toks;
+  std::string err;
+  if (!Tokenize(text ? text : "", &toks, &err)) {
+    g_err = err;
+    if (err_out) *err_out = g_err.c_str();
+    return nullptr;
+  }
+  Config *cfg = new Config();
+  for (size_t i = 0; i < toks.size();) {
+    if (toks[i] == "=") {
+      g_err = "ConfigReader: stray '='";
+      if (err_out) *err_out = g_err.c_str();
+      delete cfg;
+      return nullptr;
+    }
+    if (i + 1 >= toks.size() || toks[i + 1] != "=") {
+      g_err = "ConfigReader: expected '=' after '" + toks[i] + "'";
+      if (err_out) *err_out = g_err.c_str();
+      delete cfg;
+      return nullptr;
+    }
+    if (i + 2 >= toks.size() || toks[i + 2] == "=") {
+      g_err = "ConfigReader: expected value after '" + toks[i] + "' =";
+      if (err_out) *err_out = g_err.c_str();
+      delete cfg;
+      return nullptr;
+    }
+    cfg->pairs.emplace_back(toks[i], toks[i + 2]);
+    i += 3;
+  }
+  return cfg;
+}
+
+extern "C" int64_t CXNConfigCount(void *handle) {
+  return static_cast<int64_t>(static_cast<Config *>(handle)->pairs.size());
+}
+
+extern "C" void CXNConfigGet(void *handle, int64_t i,
+                             const char **name_out, const char **val_out) {
+  Config *cfg = static_cast<Config *>(handle);
+  *name_out = cfg->pairs[i].first.c_str();
+  *val_out = cfg->pairs[i].second.c_str();
+}
+
+extern "C" void CXNConfigFree(void *handle) {
+  delete static_cast<Config *>(handle);
+}
+
+extern "C" int64_t CXNCoreVersion(void) { return 1; }

@@ -1,0 +1,76 @@
+/*!
+ * cxn_core.h — C ABI of the native runtime core of cxxnet_tpu.
+ *
+ * TPU-native reimagining of the reference's native utils layer
+ * (reference: src/utils/config.h, src/utils/io.h:254, src/utils/thread_buffer.h).
+ * The device compute path is JAX/XLA; this library is the host-side runtime:
+ * config tokenization, the packed BinaryPage corpus format, and a
+ * background-threaded page reader whose blocking calls run outside the
+ * Python GIL (ctypes releases the GIL around foreign calls), giving the io
+ * pipeline true read-ahead the way the reference's ThreadBuffer loader
+ * thread does.
+ *
+ * All functions are thread-compatible: one handle must not be used from two
+ * threads at once, distinct handles are independent.
+ */
+#ifndef CXN_CORE_H_
+#define CXN_CORE_H_
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---- config parser (reference: src/utils/config.h:20-141) ---- */
+
+/*!
+ * Parse config text into an ordered (name, value) pair list.
+ * Returns a handle, or NULL on error with *err_out set to a static-lifetime
+ * (until next call on this thread) message.
+ */
+void *CXNConfigParse(const char *text, const char **err_out);
+int64_t CXNConfigCount(void *handle);
+void CXNConfigGet(void *handle, int64_t i,
+                  const char **name_out, const char **val_out);
+void CXNConfigFree(void *handle);
+
+/* ---- BinaryPage writer (reference: src/utils/io.h:254-327) ---- */
+
+void *CXNPageCreate(int64_t page_ints);
+/*! Append one object; returns 0 if the page is full, 1 on success. */
+int CXNPagePush(void *handle, const void *data, int64_t size);
+int64_t CXNPageCount(void *handle);
+void CXNPageClear(void *handle);
+/*! Serialize the page (fixed page_ints*4 bytes) to an open file appended at
+ *  the end; returns 1 on success, 0 on io error. */
+int CXNPageSave(void *handle, const char *path, int append);
+void CXNPageFree(void *handle);
+
+/* ---- threaded page reader ---- */
+
+/*!
+ * Create a reader over a chain of .bin files. A background thread loads and
+ * parses pages ahead of the consumer through a bounded queue (depth
+ * `lookahead` pages, i.e. the reference's double-buffer generalized).
+ * Returns NULL if any file cannot be opened.
+ */
+void *CXNPageReaderCreate(const char *const *paths, int64_t npath,
+                          int64_t page_ints, int64_t lookahead);
+/*! Restart from the first object of the first file. */
+void CXNPageReaderBeforeFirst(void *handle);
+/*!
+ * Fetch the next object. Returns its size and sets *out to a pointer valid
+ * until the next call; returns -1 at end of data, -2 on read error.
+ */
+int64_t CXNPageReaderNext(void *handle, const void **out);
+void CXNPageReaderFree(void *handle);
+
+/*! Library ABI version — bump on incompatible change. */
+int64_t CXNCoreVersion(void);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* CXN_CORE_H_ */

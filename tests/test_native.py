@@ -1,0 +1,180 @@
+"""Native core (src/core/*.cc) — build, parity with the Python fallbacks,
+and the im2bin / partition tool chain end-to-end.
+
+The parity tests are the framework's version of the reference's PairTest
+differential-testing idea (SURVEY.md §4.1) applied to the native/Python
+implementation pair.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from cxxnet_tpu.utils import native
+from cxxnet_tpu.utils.binary_page import BinaryPage
+from cxxnet_tpu.utils.config import ConfigError
+from cxxnet_tpu.utils.config import parse_config_string_py as _parse_py
+
+
+@pytest.fixture(scope="module")
+def lib():
+    if native.load() is None and not native.build():
+        pytest.skip("native toolchain unavailable")
+    return native.load()
+
+
+GOOD_CONFIGS = [
+    "a = b",
+    "a=b\nc = d  # comment\n",
+    'key = "quoted value with = and #"',
+    "multi = 'line1\nline2'\nnext = 1",
+    "netconfig = start\nlayer[0->1] = fullc:fc1\n  nhidden = 100\n"
+    "netconfig = end\n",
+    "",
+    "# only a comment\n",
+    'esc = "a\\"b"',
+]
+
+BAD_CONFIGS = ["a", "= b", "a = = b", 'a = "unterminated', 'a = "nl\n"']
+
+
+def test_config_parity(lib):
+    for text in GOOD_CONFIGS:
+        assert native.parse_config_string(text) == _parse_py(text), text
+    for text in BAD_CONFIGS:
+        with pytest.raises(ConfigError):
+            native.parse_config_string(text)
+        with pytest.raises(ConfigError):
+            _parse_py(text)
+
+
+def test_page_reader_parity(lib, tmp_path):
+    rs = np.random.RandomState(3)
+    page_ints = 128
+    objs = [rs.bytes(int(rs.randint(1, 300))) for _ in range(200)]
+    path = str(tmp_path / "t.bin")
+    with open(path, "wb") as f:
+        p = BinaryPage(page_ints)
+        for o in objs:
+            if not p.push(o):
+                p.save(f)
+                p.clear()
+                assert p.push(o)
+        if p.size():
+            p.save(f)
+    r = native.NativePageReader([path], page_ints)
+    got = []
+    while True:
+        o = r.next_obj()
+        if o is None:
+            break
+        got.append(o)
+    assert got == objs
+    # restart semantics (BeforeFirst)
+    r.before_first()
+    assert r.next_obj() == objs[0]
+    r.close()
+
+
+def test_page_reader_multi_file_chain(lib, tmp_path):
+    page_ints = 64
+    paths = []
+    all_objs = []
+    for k in range(3):
+        objs = [bytes([k * 40 + i]) * (i + 1) for i in range(20)]
+        all_objs += objs
+        path = str(tmp_path / ("part%d.bin" % k))
+        paths.append(path)
+        with open(path, "wb") as f:
+            p = BinaryPage(page_ints)
+            for o in objs:
+                if not p.push(o):
+                    p.save(f)
+                    p.clear()
+                    assert p.push(o)
+            if p.size():
+                p.save(f)
+    r = native.NativePageReader(paths, page_ints)
+    got = []
+    while True:
+        o = r.next_obj()
+        if o is None:
+            break
+        got.append(o)
+    r.close()
+    assert got == all_objs
+
+
+def test_im2bin_cc_tool(tmp_path):
+    """C++ im2bin output must be readable by the Python BinaryPage loader."""
+    try:
+        subprocess.run(["make", "bin/im2bin"], cwd=REPO, check=True,
+                       stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    except (OSError, subprocess.CalledProcessError):
+        pytest.skip("native toolchain unavailable")
+    rs = np.random.RandomState(7)
+    files = []
+    for i in range(10):
+        data = rs.bytes(int(rs.randint(10, 200)))
+        fp = tmp_path / ("img%d.dat" % i)
+        fp.write_bytes(data)
+        files.append((fp.name, data))
+    lst = tmp_path / "corpus.lst"
+    lst.write_text("".join("%d\t%d\t%s\n" % (i, i % 3, name)
+                           for i, (name, _) in enumerate(files)))
+    out = tmp_path / "corpus.bin"
+    page_ints = 256
+    subprocess.run(
+        [os.path.join(REPO, "bin", "im2bin"), str(lst),
+         str(tmp_path) + "/", str(out), "1", str(page_ints)],
+        check=True, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    got = []
+    with open(out, "rb") as f:
+        while True:
+            pg = BinaryPage.load(f, page_ints)
+            if pg is None:
+                break
+            got += [pg[r] for r in range(pg.size())]
+    assert got == [d for _, d in files]
+
+
+def test_partition_maker(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import imgbin_partition_maker as pm
+
+    page_ints = 128
+    rs = np.random.RandomState(11)
+    objs = [rs.bytes(int(rs.randint(5, 100))) for _ in range(23)]
+    lst = tmp_path / "c.lst"
+    lst.write_text("".join("%d\t0\timg%d.jpg\n" % (i, i)
+                           for i in range(len(objs))))
+    binp = tmp_path / "c.bin"
+    with open(binp, "wb") as f:
+        p = BinaryPage(page_ints)
+        for o in objs:
+            if not p.push(o):
+                p.save(f)
+                p.clear()
+                assert p.push(o)
+        if p.size():
+            p.save(f)
+    prefix = str(tmp_path / "shard_%d")
+    n = pm.partition(str(lst), str(binp), 4, prefix, page_ints)
+    assert n == 23
+    got_lines, got_objs = [], []
+    for i in range(4):
+        got_lines += open((prefix % i) + ".lst").readlines()
+        with open((prefix % i) + ".bin", "rb") as f:
+            while True:
+                pg = BinaryPage.load(f, page_ints)
+                if pg is None:
+                    break
+                got_objs += [pg[r] for r in range(pg.size())]
+    assert got_lines == lst.read_text().splitlines(keepends=True)
+    assert got_objs == objs

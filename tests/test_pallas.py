@@ -54,3 +54,29 @@ class TestLRNPallas:
         np.testing.assert_allclose(np.asarray(a),
                                    np.asarray(ops.lrn_xla(x, 3, 0.001, 0.75, 1.0)))
         assert ops.use_pallas() == (jax.default_backend() == "tpu")
+
+
+class TestLRNBf16:
+    def test_bf16_forward_and_grad(self):
+        """bf16 activations must work through the Pallas LRN (computation is
+        promoted to f32 in-kernel, outputs cast back)."""
+        x = np.random.RandomState(3).randn(2, 8, 4, 4).astype(np.float32)
+        xb = jnp.asarray(x, jnp.bfloat16)
+        out = pallas_kernels.lrn(xb, 5, 0.001, 0.75, 1.0, True)
+        assert out.dtype == jnp.bfloat16
+        ref = ops.lrn_xla(jnp.asarray(x), 5, 0.001, 0.75, 1.0)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref), rtol=2e-2,
+            atol=1e-2)
+
+        def f(xb):
+            return jnp.sum(jnp.square(
+                pallas_kernels.lrn(xb, 5, 0.001, 0.75, 1.0, True)))
+
+        g = jax.grad(f)(xb)
+        assert g.dtype == jnp.bfloat16
+        g_ref = jax.grad(lambda x: jnp.sum(jnp.square(
+            ops.lrn_xla(x, 5, 0.001, 0.75, 1.0))))(jnp.asarray(x))
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), np.asarray(g_ref), rtol=5e-2,
+            atol=5e-2)

@@ -1,0 +1,92 @@
+/*!
+ * im2bin — pack an image list into BinaryPage .bin files.
+ *
+ * Native counterpart of the reference tool (reference: tools/im2bin.cpp):
+ * reads a .lst file of `index label... filename` lines, appends each image
+ * file's raw bytes into fixed-size BinaryPages, and writes the page stream
+ * to the output .bin. The produced files are interchangeable with the
+ * Python tools/im2bin.py and readable by the imgbin/imgbinx iterators.
+ *
+ * Usage: im2bin image.lst image_root output.bin [label_width] [page_ints]
+ */
+#include "../src/core/cxn_core.h"
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+int main(int argc, char *argv[]) {
+  if (argc < 4) {
+    std::fprintf(stderr,
+                 "usage: im2bin image.lst image_root output.bin "
+                 "[label_width=1] [page_ints=%lld]\n",
+                 static_cast<long long>(64 << 18));
+    return 1;
+  }
+  const std::string lst_path = argv[1];
+  const std::string root = argv[2];
+  const std::string out_path = argv[3];
+  const int label_width = argc > 4 ? std::atoi(argv[4]) : 1;
+  const int64_t page_ints = argc > 5 ? std::atoll(argv[5]) : (64 << 18);
+
+  std::ifstream lst(lst_path);
+  if (!lst) {
+    std::fprintf(stderr, "im2bin: cannot open %s\n", lst_path.c_str());
+    return 1;
+  }
+  void *page = CXNPageCreate(page_ints);
+  // first page truncates the output, later pages append
+  bool first = true;
+  int64_t count = 0;
+  std::string line;
+  std::vector<char> bytes;
+  while (std::getline(lst, line)) {
+    if (line.find_first_not_of(" \t\r\n") == std::string::npos) continue;
+    std::istringstream ss(line);
+    std::string tok, fname;
+    ss >> tok;  // index
+    for (int i = 0; i < label_width; ++i) ss >> tok;  // labels
+    ss >> fname;
+    std::string path = root + fname;
+    std::ifstream img(path, std::ios::binary);
+    if (!img) {
+      std::fprintf(stderr, "im2bin: cannot open image %s\n", path.c_str());
+      return 1;
+    }
+    img.seekg(0, std::ios::end);
+    std::streamoff sz = img.tellg();
+    img.seekg(0);
+    bytes.resize(size_t(sz));
+    img.read(bytes.data(), sz);
+    if (!CXNPagePush(page, bytes.data(), sz)) {
+      if (!CXNPageSave(page, out_path.c_str(), first ? 0 : 1)) {
+        std::fprintf(stderr, "im2bin: write error on %s\n", out_path.c_str());
+        return 1;
+      }
+      first = false;
+      CXNPageClear(page);
+      if (!CXNPagePush(page, bytes.data(), sz)) {
+        std::fprintf(stderr, "im2bin: image larger than a page: %s\n",
+                     path.c_str());
+        return 1;
+      }
+    }
+    if (++count % 1000 == 0)
+      std::fprintf(stderr, "%lld images packed\n",
+                   static_cast<long long>(count));
+  }
+  if (CXNPageCount(page) != 0) {
+    if (!CXNPageSave(page, out_path.c_str(), first ? 0 : 1)) {
+      std::fprintf(stderr, "im2bin: write error on %s\n", out_path.c_str());
+      return 1;
+    }
+  }
+  CXNPageFree(page);
+  std::fprintf(stderr, "im2bin: packed %lld images into %s\n",
+               static_cast<long long>(count), out_path.c_str());
+  return 0;
+}

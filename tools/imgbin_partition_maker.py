@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Shard an imgbin corpus (.lst + .bin) into N worker partitions.
+
+Counterpart of the reference's tools/imgbin-partition-maker.py, which emits a
+Makefile re-packing an image list into per-worker shards for distributed
+training (consumed via ``image_conf_prefix``/``image_conf_ids`` +
+``dist_num_worker``, reference: src/io/iter_thread_imbin-inl.hpp:189-220).
+This version shards directly: records are split round-robin-by-block so each
+partition i gets ``out_prefix%i.lst`` + ``out_prefix%i.bin``, readable by the
+imgbin/imgbinx iterators with ``image_conf_prefix=out_prefix`` and
+``image_conf_ids=0-<n-1>``.
+
+Usage: imgbin_partition_maker.py <in.lst> <in.bin> <npart> <out_prefix>
+       [page_ints]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from cxxnet_tpu.utils.binary_page import BinaryPage, KPAGE_INTS
+
+
+def partition(lst_path: str, bin_path: str, npart: int, out_prefix: str,
+              page_ints: int = KPAGE_INTS) -> int:
+    """Split records contiguously: partition i gets records
+    [i*ceil(n/npart), (i+1)*ceil(n/npart)), matching the contiguous
+    rank-sharding the iterators use for multi-part lists."""
+    lines = [ln for ln in open(lst_path) if ln.strip()]
+    n = len(lines)
+    step = (n + npart - 1) // npart
+    # stream records out of the source bin in list order
+    fbin = open(bin_path, "rb")
+    page = None
+    ptop = 0
+
+    def next_obj():
+        nonlocal page, ptop
+        while page is None or ptop >= page.size():
+            page = BinaryPage.load(fbin, page_ints)
+            assert page is not None, "bin exhausted before list"
+            ptop = 0
+        obj = page[ptop]
+        ptop += 1
+        return obj
+
+    for i in range(npart):
+        lo, hi = min(i * step, n), min((i + 1) * step, n)
+        out_lst = (out_prefix % i) + ".lst"
+        out_bin = (out_prefix % i) + ".bin"
+        with open(out_lst, "w") as fl:
+            fl.writelines(lines[lo:hi])
+        with open(out_bin, "wb") as fo:
+            opage = BinaryPage(page_ints)
+            for _ in range(lo, hi):
+                data = next_obj()
+                if not opage.push(data):
+                    opage.save(fo)
+                    opage.clear()
+                    assert opage.push(data), "record larger than a page"
+            if opage.size():
+                opage.save(fo)
+    fbin.close()
+    return n
+
+
+def main(argv):
+    if len(argv) < 5:
+        print(__doc__)
+        return 1
+    lst, binf, npart, prefix = argv[1], argv[2], int(argv[3]), argv[4]
+    page_ints = int(argv[5]) if len(argv) > 5 else KPAGE_INTS
+    if "%" not in prefix:
+        prefix += "_%d"
+    n = partition(lst, binf, npart, prefix, page_ints)
+    print("partitioned %d records into %d shards at %s" % (n, npart, prefix))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
