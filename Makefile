@@ -10,7 +10,18 @@ CXXFLAGS ?= -O2 -std=c++17 -Wall -fPIC -pthread
 CORE_SRC = src/core/config.cc src/core/binary_page.cc
 CORE_HDR = src/core/cxn_core.h
 
-all: lib/libcxxnet_tpu_core.so bin/im2bin
+PY_INCLUDES := $(shell python3-config --includes)
+PY_LDFLAGS := $(shell python3-config --ldflags --embed)
+
+all: lib/libcxxnet_tpu_core.so bin/im2bin lib/libcxxnetwrapper.so
+
+lib/libcxxnetwrapper.so: wrapper/cxxnet_wrapper.cc wrapper/cxxnet_wrapper.h
+	@mkdir -p lib
+	$(CXX) $(CXXFLAGS) $(PY_INCLUDES) -shared -o $@ wrapper/cxxnet_wrapper.cc $(PY_LDFLAGS)
+
+bin/test_wrapper_c: wrapper/test_wrapper.c lib/libcxxnetwrapper.so
+	@mkdir -p bin
+	$(CC) -O2 -Wall -o $@ wrapper/test_wrapper.c -Llib -lcxxnetwrapper -Wl,-rpath,'$$ORIGIN/../lib'
 
 lib/libcxxnet_tpu_core.so: $(CORE_SRC) $(CORE_HDR)
 	@mkdir -p lib
@@ -21,6 +32,6 @@ bin/im2bin: tools/im2bin.cc $(CORE_SRC) $(CORE_HDR)
 	$(CXX) $(CXXFLAGS) -o $@ tools/im2bin.cc $(CORE_SRC)
 
 clean:
-	rm -f lib/libcxxnet_tpu_core.so bin/im2bin
+	rm -f lib/libcxxnet_tpu_core.so lib/libcxxnetwrapper.so bin/im2bin bin/test_wrapper_c
 
 .PHONY: all clean

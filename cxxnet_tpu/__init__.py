@@ -18,3 +18,11 @@ C++/CUDA convnet trainer built on mshadow/mshadow-ps), redesigned for TPU:
 __version__ = "0.1.0"
 
 from . import utils  # noqa: F401
+
+
+def __getattr__(name):
+    # lazy: api pulls in jax/io; keep bare `import cxxnet_tpu` light
+    if name == "api":
+        import importlib
+        return importlib.import_module(".api", __name__)
+    raise AttributeError(name)

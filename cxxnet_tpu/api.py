@@ -1,0 +1,214 @@
+"""Embeddable numpy API: DataIter / Net / train.
+
+The framework-surface equivalent of the reference's language binding
+(wrapper/cxxnet.py:64-307 over the C API of wrapper/cxxnet_wrapper.h:36-230).
+Here the compute path is already Python/JAX, so Python users get this module
+directly; the handle-based C ABI for C/C++ embedders
+(wrapper/cxxnet_wrapper.cc -> libcxxnetwrapper.so) calls into this same
+module through an embedded interpreter — one implementation, two ABIs.
+
+Semantics mirror the reference:
+
+* ``DataIter(cfg)`` — iterator chain from a config-section string; `next`
+  advances, `get_data`/`get_label` expose the current batch as numpy.
+* ``Net(dev, cfg)`` — config-string-driven net; `update` takes either the
+  DataIter's current batch or raw numpy (data, label); predict/extract/
+  evaluate/weight-io round-trip numpy; save/load use the checkpoint format
+  (net_type int32 header + model blob, reference wrapper/cxxnet_wrapper.cpp
+  LoadModel/SaveModel).
+* ``train(cfg, data, num_round, param, eval_data)`` — the small driver loop.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple, Union
+
+import numpy as np
+
+from . import io as io_mod
+from .io.data import DataBatch
+from .nnet import trainer as trainer_mod
+from .utils import serializer
+from .utils.config import parse_config_string
+
+
+class DataIter:
+    """Data iterator built from a config-section string, e.g.::
+
+        iter = mnist
+            path_img = "data/train-images-idx3-ubyte.gz"
+            path_label = "data/train-labels-idx1-ubyte.gz"
+        iter = end
+    """
+
+    def __init__(self, cfg: str):
+        pairs = [(k, v) for k, v in parse_config_string(cfg)
+                 if not (k == "iter" and v == "end")]
+        self.handle = io_mod.create_iterator(pairs)
+        self.handle.init()
+
+    def next(self) -> bool:
+        """Advance to the next batch; False at end of epoch."""
+        return self.handle.next()
+
+    def before_first(self) -> None:
+        self.handle.before_first()
+
+    def check_valid(self) -> DataBatch:
+        try:
+            batch = self.handle.value()
+        except AttributeError:
+            batch = None
+        assert batch is not None, "iterator has no current batch; call next()"
+        return batch
+
+    def get_data(self) -> np.ndarray:
+        """Current batch data as (batch, channel, h, w) numpy."""
+        return np.asarray(self.check_valid().data)
+
+    def get_label(self) -> np.ndarray:
+        """Current batch labels as (batch, label_width) numpy."""
+        return np.asarray(self.check_valid().label)
+
+
+def _as_batch(data: np.ndarray, label: Optional[np.ndarray]) -> DataBatch:
+    """Wrap raw numpy into a DataBatch (reference CXNNetUpdateBatch path:
+    wrapper/cxxnet_wrapper.cpp:295-311). 2-D data is viewed as flat
+    (b, 1, 1, n) nodes."""
+    data = np.ascontiguousarray(data, np.float32)
+    if data.ndim == 2:
+        data = data.reshape(data.shape[0], 1, 1, data.shape[1])
+    assert data.ndim == 4, "data must be 2-D or 4-D, got %s" % (data.shape,)
+    batch = DataBatch()
+    batch.data = data
+    batch.batch_size = data.shape[0]
+    if label is not None:
+        label = np.ascontiguousarray(label, np.float32)
+        if label.ndim == 1:
+            label = label.reshape(-1, 1)
+        batch.label = label
+    return batch
+
+
+class Net:
+    """A neural net driven by a netconfig config string."""
+
+    def __init__(self, dev: str = "tpu", cfg: str = ""):
+        self.cfg: List[Tuple[str, str]] = []
+        self.net_type = 0
+        self.net_: Optional[trainer_mod.Trainer] = None
+        for k, v in parse_config_string(cfg):
+            self.set_param(k, v)
+        if dev:
+            self.set_param("dev", dev)
+
+    # -- configuration ------------------------------------------------
+    def set_param(self, name: str, value) -> None:
+        value = str(value)
+        if name == "net_type" and self.net_ is not None:
+            self.net_type = int(value)
+            return
+        if self.net_ is not None:
+            self.net_.set_param(name, value)
+        self.cfg.append((name, value))
+
+    def _create_net(self) -> trainer_mod.Trainer:
+        net = trainer_mod.create_net(self.net_type)
+        for k, v in self.cfg:
+            if k == "net_type":
+                self.net_type = int(v)
+                continue
+            net.set_param(k, v)
+        return net
+
+    # -- model lifecycle ----------------------------------------------
+    def init_model(self) -> None:
+        self.net_ = self._create_net()
+        self.net_.init_model()
+
+    def load_model(self, fname: str) -> None:
+        with open(fname, "rb") as f:
+            r = serializer.Reader(f)
+            self.net_type = r.read_int32()
+            self.net_ = self._create_net()
+            self.net_.load_model(r)
+
+    def save_model(self, fname: str) -> None:
+        assert self.net_ is not None, "model not initialized"
+        with open(fname, "wb") as f:
+            w = serializer.Writer(f)
+            w.write_int32(self.net_type)
+            self.net_.save_model(w)
+
+    def start_round(self, round_counter: int) -> None:
+        assert self.net_ is not None, "model not initialized"
+        self.net_.start_round(round_counter)
+
+    # -- training / inference -----------------------------------------
+    def _resolve_batch(self, data, label=None) -> DataBatch:
+        if isinstance(data, DataIter):
+            assert label is None, "label only applies to numpy data"
+            return data.check_valid()
+        return _as_batch(np.asarray(data), label)
+
+    def update(self, data, label=None) -> None:
+        """One gradient step on the DataIter's current batch or on raw
+        numpy (data, label)."""
+        assert self.net_ is not None, "model not initialized"
+        self.net_.update(self._resolve_batch(data, label))
+
+    def evaluate(self, data: DataIter, name: str) -> str:
+        assert self.net_ is not None, "model not initialized"
+        return self.net_.evaluate(data.handle, name)
+
+    def predict(self, data) -> np.ndarray:
+        """Per-row prediction (argmax over the output when it is a
+        distribution — reference TransformPred)."""
+        assert self.net_ is not None, "model not initialized"
+        return self.net_.predict(self._resolve_batch(data))
+
+    def extract(self, data, name: str) -> np.ndarray:
+        """Activations of the named node (or `top[-k]`) for the batch."""
+        assert self.net_ is not None, "model not initialized"
+        return self.net_.extract_feature(self._resolve_batch(data), name)
+
+    # -- weight io ----------------------------------------------------
+    def set_weight(self, weight: np.ndarray, layer_name: str,
+                   tag: str = "wmat") -> None:
+        assert self.net_ is not None, "model not initialized"
+        self.net_.set_weight(np.asarray(weight, np.float32), layer_name, tag)
+
+    def get_weight(self, layer_name: str, tag: str = "wmat") -> np.ndarray:
+        """Weight as a 2-D (out, in-flat) array (reference CXNNetGetWeight
+        returns the flattened view + shape)."""
+        assert self.net_ is not None, "model not initialized"
+        weight, _shape = self.net_.get_weight(layer_name, tag)
+        return np.asarray(weight)
+
+
+def train(cfg: str, data, num_round: int,
+          param: Union[Dict[str, str], Iterable[Tuple[str, str]]],
+          eval_data: Optional[DataIter] = None,
+          label: Optional[np.ndarray] = None,
+          dev: str = "tpu") -> Net:
+    """Small training driver over the API (reference wrapper/cxxnet.py:281)."""
+    net = Net(dev=dev, cfg=cfg)
+    if isinstance(param, dict):
+        param = param.items()
+    for k, v in param:
+        net.set_param(k, v)
+    net.init_model()
+    for r in range(num_round):
+        net.start_round(r)
+        if isinstance(data, DataIter):
+            data.before_first()
+            scounter = 0
+            while data.next():
+                net.update(data)
+                scounter += 1
+            if eval_data is not None:
+                import sys
+                sys.stderr.write(net.evaluate(eval_data, "eval") + "\n")
+        else:
+            net.update(data=data, label=label)
+    return net

@@ -1,0 +1,141 @@
+"""The embeddable numpy API (cxxnet_tpu.api): DataIter / Net / train —
+reference wrapper surface wrapper/cxxnet.py:64-307."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from cxxnet_tpu import api
+from tests.synth_mnist import make_dataset
+
+NET_CFG = """
+netconfig = start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 32
+  init_sigma = 0.05
+layer[+1] = relu
+layer[+1:fc2] = fullc:fc2
+  nhidden = 10
+  init_sigma = 0.05
+layer[+0] = softmax
+netconfig = end
+input_shape = 1,1,784
+batch_size = 25
+eta = 0.1
+momentum = 0.9
+metric = error
+"""
+
+
+@pytest.fixture(scope="module")
+def mnist_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("mnist")
+    make_dataset(str(d), n_train=200, n_test=100)
+    return str(d)
+
+
+def _iter_cfg(d, split="train-images-idx3-ubyte.gz",
+              labels="train-labels-idx1-ubyte.gz"):
+    return """
+iter = mnist
+  path_img = "%s/%s"
+  path_label = "%s/%s"
+  batch_size = 25
+iter = end
+""" % (d, split, d, labels)
+
+
+class TestDataIter:
+    def test_iterate(self, mnist_dir):
+        it = api.DataIter(_iter_cfg(mnist_dir))
+        n = 0
+        while it.next():
+            data, label = it.get_data(), it.get_label()
+            assert data.shape == (25, 1, 1, 784)
+            assert label.shape == (25, 1)
+            n += 1
+        assert n == 8
+        it.before_first()
+        assert it.next()
+
+    def test_check_valid_before_next(self, mnist_dir):
+        it = api.DataIter(_iter_cfg(mnist_dir))
+        with pytest.raises(AssertionError):
+            it.get_data()
+
+
+class TestNet:
+    def test_train_memorize_and_predict(self, mnist_dir):
+        it = api.DataIter(_iter_cfg(mnist_dir))
+        net = api.Net(dev="cpu", cfg=NET_CFG)
+        net.init_model()
+        for r in range(12):
+            net.start_round(r)
+            it.before_first()
+            while it.next():
+                net.update(it)
+        ev = net.evaluate(api.DataIter(_iter_cfg(mnist_dir)), "train")
+        err = float(ev.split("train-error:")[1])
+        assert err < 0.1, ev
+
+        it.before_first()
+        it.next()
+        pred = net.predict(it)
+        assert pred.shape == (25,)
+        labels = it.get_label()[:, 0]
+        assert (pred == labels).mean() > 0.9
+
+    def test_update_raw_numpy(self):
+        rs = np.random.RandomState(0)
+        x = rs.rand(25, 784).astype(np.float32)
+        y = rs.randint(0, 10, 25).astype(np.float32)
+        net = api.Net(dev="cpu", cfg=NET_CFG)
+        net.init_model()
+        for _ in range(150):
+            net.update(x, y)
+        pred = net.predict(x)
+        assert (pred == y).mean() > 0.9, "should memorize one fixed batch"
+
+    def test_extract_and_weights(self):
+        net = api.Net(dev="cpu", cfg=NET_CFG)
+        net.init_model()
+        x = np.random.RandomState(1).rand(25, 784).astype(np.float32)
+        feat = net.extract(x, "fc1")
+        assert feat.reshape(25, -1).shape == (25, 32)
+        top = net.extract(x, "top[-1]")
+        np.testing.assert_allclose(top.reshape(25, -1).sum(-1),
+                                   np.ones(25), rtol=1e-5)
+        w = net.get_weight("fc1", "wmat")
+        assert w.shape == (32, 784)
+        net.set_weight(np.zeros_like(w), "fc1", "wmat")
+        assert np.all(net.get_weight("fc1", "wmat") == 0)
+        feat0 = net.extract(x, "fc1")
+        assert np.all(feat0 == 0)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        net = api.Net(dev="cpu", cfg=NET_CFG)
+        net.init_model()
+        x = np.random.RandomState(2).rand(25, 784).astype(np.float32)
+        y = np.zeros(25, np.float32)
+        net.update(x, y)
+        p1 = net.extract(x, "top[-1]")
+        path = str(tmp_path / "m.model")
+        net.save_model(path)
+        net2 = api.Net(dev="cpu", cfg="")
+        net2.load_model(path)
+        p2 = net2.extract(x, "top[-1]")
+        np.testing.assert_allclose(np.asarray(p1), np.asarray(p2),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_train_driver(mnist_dir):
+    it = api.DataIter(_iter_cfg(mnist_dir))
+    ev = api.DataIter(_iter_cfg(mnist_dir))
+    net = api.train(NET_CFG, it, 6, {"eta": "0.2"}, eval_data=ev, dev="cpu")
+    s = net.evaluate(ev, "final")
+    assert float(s.split("final-error:")[1]) < 0.2, s
