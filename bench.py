@@ -24,8 +24,11 @@ def main():
     from cxxnet_tpu.io.data import DataBatch
 
     batch = 256
+    # bf16 mixed precision is the TPU-native recipe: activations and layer
+    # params run the MXU's native dtype, master weights/optimizer stay f32
     tr = alexnet_trainer(batch_size=batch, input_hw=227, dev="tpu",
-                         extra_cfg="eval_train = 0\n")
+                         extra_cfg="eval_train = 0\n"
+                                   "compute_dtype = bfloat16\n")
 
     rs = np.random.RandomState(0)
     b = DataBatch()

@@ -29,12 +29,19 @@ Params = List[Dict[str, jnp.ndarray]]
 
 class NeuralNet:
     def __init__(self, cfg: NetConfig, batch_size: int,
-                 infer_shapes: bool = True):
+                 infer_shapes: bool = True,
+                 compute_dtype: Optional[jnp.dtype] = None):
         """infer_shapes=False skips shape inference entirely — used for the
         weight-copy (finetune) path, which only deserializes params and never
-        runs the net (reference CopyModelFrom, nnet_impl-inl.hpp:101-134)."""
+        runs the net (reference CopyModelFrom, nnet_impl-inl.hpp:101-134).
+
+        compute_dtype=bfloat16 enables mixed precision (a TPU-first feature
+        beyond the reference): activations and the layer-visible params are
+        cast to bf16 so matmuls/convs run the MXU's native dtype, while the
+        master params, the loss layers, and the optimizer stay float32."""
         self.cfg = cfg
         self.max_batch = batch_size
+        self.compute_dtype = compute_dtype
         self.layers: List[Layer] = []        # one per connection (shared -> primary obj)
         self.is_shared: List[bool] = []
         self.node_shapes: List[Tuple[int, int, int, int]] = []
@@ -102,10 +109,18 @@ class NeuralNet:
                 rng=None, epoch=0):
         """Run the DAG; returns (node_values list, total_loss scalar)."""
         cfg = self.cfg
+        cdt = self.compute_dtype
         values: List[Optional[jnp.ndarray]] = [None] * cfg.param.num_nodes
         values[0] = jnp.asarray(data)
         for i, ex in enumerate(extra_data):
             values[i + 1] = jnp.asarray(ex)
+        if cdt is not None:
+            values = [None if v is None else v.astype(cdt) for v in values]
+            # cast through f32 master params; grads flow back in f32
+            params = jax.tree_util.tree_map(
+                lambda a: a.astype(cdt)
+                if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
+                params)
         ctx = ApplyContext(train=train, labels=labels, epoch=epoch)
         base_rng = rng if rng is not None else jax.random.PRNGKey(0)
         for i, info in enumerate(cfg.layers):
@@ -114,6 +129,9 @@ class NeuralNet:
                     if self.is_shared[i] else i)
             ctx.rng = jax.random.fold_in(base_rng, i)
             ins = [values[j] for j in info.nindex_in]
+            if cdt is not None and lay.is_loss:
+                # losses always in f32 (softmax/log numerics)
+                ins = [x.astype(jnp.float32) for x in ins]
             outs = lay.apply(params[pidx], ins, ctx)
             for j, v in zip(info.nindex_out, outs):
                 values[j] = v

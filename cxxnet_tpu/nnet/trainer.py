@@ -45,6 +45,7 @@ class Trainer:
         self.net: Optional[NeuralNet] = None
         self.batch_size = 100
         self.update_period = 1
+        self.compute_dtype = None
         self.sample_counter = 0
         self.eval_train = 1
         self.epoch_counter = 0
@@ -85,6 +86,11 @@ class Trainer:
             self.update_on_server = int(val)
         if name == "model_parallel":
             self.model_parallel = int(val)
+        if name == "compute_dtype":
+            check(val in ("float32", "bfloat16", "bf16"),
+                  "compute_dtype must be float32 or bfloat16")
+            self.compute_dtype = (jnp.bfloat16 if val in ("bfloat16", "bf16")
+                                  else None)
         if name.startswith("metric"):
             m = re.match(r"metric\[([^,\]]+)(?:,([^\]]+))?\]$", name)
             if m:
@@ -144,7 +150,8 @@ class Trainer:
 
     def _init_net_structure(self) -> None:
         self.net_cfg.configure(self.cfg_pairs)
-        self.net = NeuralNet(self.net_cfg, self.batch_size)
+        self.net = NeuralNet(self.net_cfg, self.batch_size,
+                             compute_dtype=self.compute_dtype)
         self._setup_mesh()
         # resolve eval nodes (metric[label,node] -> node id; default last)
         self.eval_nodes: List[int] = []
@@ -211,7 +218,8 @@ class Trainer:
         # params before InitConnection (neural_net-inl.hpp LoadModel)
         self.net_cfg.configure(self.cfg_pairs)
         self.net = NeuralNet(self.net_cfg, self.batch_size,
-                             infer_shapes=False)
+                             infer_shapes=False,
+                             compute_dtype=self.compute_dtype)
         self._setup_mesh()
         self.eval_nodes = [self.net_cfg.param.num_nodes - 1 if nm is None
                            else self.net_cfg.node_name_map[nm]

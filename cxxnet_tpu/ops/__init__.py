@@ -39,16 +39,19 @@ def pool_out_dim(x: int, k: int, s: int) -> int:
 
 
 def conv2d(x: jnp.ndarray, w: jnp.ndarray, *, stride: int = 1,
-           pad: Tuple[int, int] = (0, 0), groups: int = 1,
-           preferred_dtype=jnp.float32) -> jnp.ndarray:
-    """2-D convolution. x: (N, C, H, W); w: (O, C/groups, KH, KW) OIHW."""
+           pad: Tuple[int, int] = (0, 0), groups: int = 1) -> jnp.ndarray:
+    """2-D convolution. x: (N, C, H, W); w: (O, C/groups, KH, KW) OIHW.
+
+    Result dtype follows the inputs: under bf16 mixed precision the MXU
+    still accumulates each pass in f32 internally, and keeping the output
+    bf16 gives JAX's conv transpose matching dtypes (a forced f32
+    preferred_element_type breaks the backward pass for bf16 operands)."""
     return lax.conv_general_dilated(
         x, w,
         window_strides=(stride, stride),
         padding=[(pad[0], pad[0]), (pad[1], pad[1])],
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         feature_group_count=groups,
-        preferred_element_type=preferred_dtype,
     )
 
 
