@@ -34,6 +34,11 @@ class LearnTask:
         self.name_model_dir = "models"
         self.num_round = 10
         self.test_io = 0
+        # profile_dir=<dir>: capture a jax profiler (xprof) trace of the
+        # second training round into <dir> (the first round compiles).
+        # Replaces the reference's wall-clock-only observability
+        # (SURVEY.md §5 tracing/profiling).
+        self.profile_dir = ""
         self.silent = 0
         self.start_counter = 0
         self.max_round = 1 << 31
@@ -96,6 +101,8 @@ class LearnTask:
             self.device = val
         if name == "test_io":
             self.test_io = int(val)
+        if name == "profile_dir":
+            self.profile_dir = val
         if name == "extract_node_name":
             self.extract_node_name = val
         if name == "output_format":
@@ -244,8 +251,14 @@ class LearnTask:
         if self.test_io != 0:
             print("start I/O test")
         cc = self.max_round
+        rounds_done = 0
+        profiling = False
         while self.start_counter <= self.num_round and cc > 0:
             cc -= 1
+            if self.profile_dir and rounds_done == 1:
+                import jax
+                jax.profiler.start_trace(self.profile_dir)
+                profiling = True
             if not self.silent:
                 print("update round %d" % (self.start_counter - 1))
             sample_counter = 0
@@ -268,6 +281,13 @@ class LearnTask:
                 sys.stderr.write("\n")
                 sys.stderr.flush()
             self._save_model()
+            rounds_done += 1
+            if profiling:
+                import jax
+                jax.profiler.stop_trace()
+                profiling = False
+                if not self.silent:
+                    print("profiler trace written to %s" % self.profile_dir)
         if not self.silent:
             print("updating end, %.0f sec in all" % (time.time() - start))
 

@@ -46,6 +46,7 @@ class Trainer:
         self.batch_size = 100
         self.update_period = 1
         self.compute_dtype = None
+        self.test_on_server = 0
         self.sample_counter = 0
         self.eval_train = 1
         self.epoch_counter = 0
@@ -86,6 +87,8 @@ class Trainer:
             self.update_on_server = int(val)
         if name == "model_parallel":
             self.model_parallel = int(val)
+        if name == "test_on_server":
+            self.test_on_server = int(val)
         if name == "compute_dtype":
             check(val in ("float32", "bfloat16", "bf16"),
                   "compute_dtype must be float32 or bfloat16")
@@ -256,6 +259,40 @@ class Trainer:
     # ------------------------------------------------------------------
     def start_round(self, round_: int) -> None:
         self.round = round_
+        if self.test_on_server:
+            self.check_replica_consistency()
+
+    def check_replica_consistency(self, atol: float = 0.0) -> None:
+        """Distributed-consistency check (the reference's `test_on_server`,
+        src/updater/async_updater-inl.hpp:148-153: workers pull the server's
+        weights each round and CheckWeight them against local replicas).
+        TPU equivalent: parameters replicated across the mesh must hold
+        bitwise-identical shards on every device; sharded axes are skipped
+        (each device owns a distinct slice)."""
+        if self.mesh is None:
+            return
+        for i, p in enumerate(self.params):
+            for key, v in p.items():
+                arr = jnp.asarray(v)
+                shards = getattr(arr, "addressable_shards", None)
+                if not shards or len(shards) < 2:
+                    continue
+                # only compare shards covering the same index range
+                by_index = {}
+                for s in shards:
+                    by_index.setdefault(str(s.index), []).append(s)
+                for idx, group in by_index.items():
+                    if len(group) < 2:
+                        continue
+                    ref = np.asarray(group[0].data)
+                    for s in group[1:]:
+                        diff = np.max(np.abs(np.asarray(s.data) - ref)) \
+                            if ref.size else 0.0
+                        check(diff <= atol,
+                              "TestSync: layer %d %s replicas diverged on "
+                              "devices %s vs %s (max |diff| = %g)"
+                              % (i, key, group[0].device, s.device,
+                                 float(diff)))
 
     # ------------------------------------------------------------------
     # the jitted steps

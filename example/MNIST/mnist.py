@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""MNIST via the Python numpy API (counterpart of the reference's
+example/MNIST/mnist.py over wrapper/cxxnet.py).
+
+Expects the idx .gz files under ./data (see README.md for the download).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+from cxxnet_tpu import api
+
+
+def iter_cfg(img, label, batch_size=100, extra=""):
+    return """
+iter = mnist
+  path_img = "%s"
+  path_label = "%s"
+  batch_size = %d
+%s
+iter = end
+""" % (img, label, batch_size, extra)
+
+
+NET_CFG = """
+netconfig = start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 100
+  init_sigma = 0.01
+layer[+1:sg1] = sigmoid:se1
+layer[sg1->fc2] = fullc:fc2
+  nhidden = 10
+  init_sigma = 0.01
+layer[+0] = softmax
+netconfig = end
+input_shape = 1,1,784
+batch_size = 100
+eta = 0.1
+momentum = 0.9
+wd = 0.0
+metric = error
+"""
+
+
+def main():
+    data_dir = sys.argv[1] if len(sys.argv) > 1 else "./data"
+    train_iter = api.DataIter(iter_cfg(
+        os.path.join(data_dir, "train-images-idx3-ubyte.gz"),
+        os.path.join(data_dir, "train-labels-idx1-ubyte.gz"),
+        extra="  shuffle = 1"))
+    test_iter = api.DataIter(iter_cfg(
+        os.path.join(data_dir, "t10k-images-idx3-ubyte.gz"),
+        os.path.join(data_dir, "t10k-labels-idx1-ubyte.gz")))
+    net = api.train(NET_CFG, train_iter, num_round=15,
+                    param={}, eval_data=test_iter)
+    print(net.evaluate(test_iter, "final"))
+
+
+if __name__ == "__main__":
+    main()

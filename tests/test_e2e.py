@@ -242,3 +242,31 @@ def test_threadbuffer_chain(tmp_path, mnist_data):
     task = run_task(str(p))
     err = task.net_trainer.metric.evals[0].get()
     assert err < 0.5
+
+
+def test_test_on_server_consistency(tmp_path, mnist_data):
+    """test_on_server=1: every StartRound asserts data-parallel replicas are
+    bitwise in sync across the mesh (reference semantics:
+    async_updater-inl.hpp:148-153 CheckWeight against the server copy)."""
+    conf = write_conf(tmp_path, MLP_CONF, mnist_data, num_round=2)
+    task = run_task(conf, "dev=tpu:0-3", "test_on_server=1")
+    tr = task.net_trainer
+    # the explicit call must also pass after training
+    tr.check_replica_consistency()
+    # and it must detect forced divergence
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    key = next(iter(tr.params[0]))
+    arr = np.asarray(tr.params[0][key])
+    devs = tr.mesh.devices.reshape(-1)
+    shards = []
+    for i, d in enumerate(devs):
+        a = arr.copy()
+        if i == 1:
+            a[(0,) * a.ndim] += 1.0  # poison one replica
+        shards.append(jax.device_put(a, d))
+    tr.params[0][key] = jax.make_array_from_single_device_arrays(
+        arr.shape, NamedSharding(tr.mesh, P()), shards)
+    with pytest.raises(ValueError, match="TestSync"):
+        tr.check_replica_consistency()
