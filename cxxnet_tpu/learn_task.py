@@ -50,6 +50,14 @@ class LearnTask:
         self.extract_node_name = ""
         self.output_format = 1
         self.device = "tpu"
+        # multi-host launch (replaces the reference's PS/MPI launcher,
+        # bin/cxxnet.ps + mpi.conf): coordinator/num_worker/worker_rank
+        # bring up the jax distributed runtime before device init; the
+        # values also default from env (CXXNET_NUM_WORKER,
+        # CXXNET_WORKER_RANK / PS_RANK)
+        self.coordinator = ""
+        self.num_worker = 0
+        self.worker_rank = -1
         self.cfg: List[Tuple[str, str]] = [("dev", "tpu")]
 
     # ------------------------------------------------------------------
@@ -59,6 +67,13 @@ class LearnTask:
             return 0
         for name, val in ConfigIterator(argv[0], argv[1:]):
             self.set_param(name, val)
+        if self.coordinator or self.num_worker > 1:
+            from .parallel import init_distributed
+            init_distributed(
+                coordinator_address=self.coordinator or None,
+                num_processes=self.num_worker or None,
+                process_id=self.worker_rank if self.worker_rank >= 0
+                else None)
         self.init()
         if not self.silent:
             print("initializing end, start working")
@@ -103,6 +118,12 @@ class LearnTask:
             self.test_io = int(val)
         if name == "profile_dir":
             self.profile_dir = val
+        if name == "coordinator":
+            self.coordinator = val
+        if name == "num_worker":
+            self.num_worker = int(val)
+        if name == "worker_rank":
+            self.worker_rank = int(val)
         if name == "extract_node_name":
             self.extract_node_name = val
         if name == "output_format":
