@@ -260,3 +260,87 @@ def test_round_batch_padding(tmp_path):
     it.before_first()
     count = sum(1 for _ in iter(it))
     assert count == 3
+
+
+def test_cc_im2bin_imgbinx_train_chain(tmp_path):
+    """The native toolchain end-to-end: C++ im2bin packs the corpus, the
+    C++ read-ahead page reader feeds iter=imgbinx, and a conv net trains
+    through the CLI — the full ImageNet-shaped path."""
+    import subprocess
+    from cxxnet_tpu.learn_task import LearnTask
+
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    try:
+        subprocess.run(["make", "bin/im2bin", "lib/libcxxnet_tpu_core.so"],
+                       cwd=repo, check=True, stdout=subprocess.DEVNULL,
+                       stderr=subprocess.DEVNULL)
+    except (OSError, subprocess.CalledProcessError):
+        pytest.skip("native toolchain unavailable")
+
+    d = str(tmp_path / "imgs")
+    lst = make_images(d, n=48)
+    bin_path = str(tmp_path / "pack.bin")
+    subprocess.run(
+        [os.path.join(repo, "bin", "im2bin"), lst, d + os.sep, bin_path,
+         "1", str(PAGE_INTS)],
+        check=True, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    conf = """
+data = train
+iter = imgbinx
+  image_list = "{lst}"
+  image_bin = "{bin}"
+  page_size = {page}
+  rand_crop = 1
+  rand_mirror = 1
+  divideby = 256
+iter = threadbuffer
+iter = end
+eval = test
+iter = imgbinx
+  image_list = "{lst}"
+  image_bin = "{bin}"
+  page_size = {page}
+  divideby = 256
+iter = end
+netconfig=start
+layer[0->1] = conv:cv1
+  kernel_size = 5
+  stride = 2
+  nchannel = 8
+  random_type = xavier
+layer[1->2] = relu
+layer[2->3] = flatten
+layer[3->4] = fullc:fc
+  nhidden = 3
+  init_sigma = 0.1
+layer[4->4] = softmax
+netconfig=end
+input_shape = 3,32,32
+batch_size = 16
+round_batch = 1
+dev = cpu
+eta = 0.1
+momentum = 0.9
+clip_gradient = 5.0
+metric = error
+eval_train = 1
+num_round = 6
+max_round = 6
+save_model = 0
+model_dir = {mdir}
+silent = 1
+""".format(lst=lst, bin=bin_path, page=PAGE_INTS, mdir=str(tmp_path / "m"))
+    p = tmp_path / "imgx.conf"
+    p.write_text(conf)
+    task = LearnTask()
+    task.run([str(p)])
+    # native reader must actually be active when the lib is built
+    from cxxnet_tpu.utils import native
+    if native.load() is not None:
+        base = task.itr_train
+        while not isinstance(base, ImagePageIterator):
+            base = getattr(base, "base", None) or base.base_
+        assert base.native_reader is not None
+    err = task.net_trainer.metric.evals[0].get()
+    assert err < 0.2, "imgbinx conv error %f" % err
