@@ -113,3 +113,77 @@ def alexnet_trainer(batch_size: int = 256, input_hw: int = 227,
         tr.set_param(k, v)
     tr.init_model()
     return tr
+
+
+def _inception_block(idx: int, node_in: str, nch: int) -> Tuple[str, str]:
+    """One inception-style module: split 1->3, parallel 1x1/3x3/5x5 conv
+    towers, ch_concat 3->1 (reference DAG features:
+    src/layer/split_layer-inl.hpp, ch_concat at layer_impl-inl.hpp:61-62).
+    Returns (netconfig text, output node name)."""
+    p = "i%d" % idx
+    txt = f"""
+layer[{node_in}->{p}a,{p}b,{p}c] = split
+layer[{p}a->{p}t1] = conv:{p}_1x1
+  kernel_size = 1
+  nchannel = {nch}
+layer[{p}t1->{p}r1] = relu
+layer[{p}b->{p}t3] = conv:{p}_3x3
+  kernel_size = 3
+  pad = 1
+  nchannel = {nch}
+layer[{p}t3->{p}r3] = relu
+layer[{p}c->{p}t5] = conv:{p}_5x5
+  kernel_size = 5
+  pad = 2
+  nchannel = {nch}
+layer[{p}t5->{p}r5] = relu
+layer[{p}r1,{p}r3,{p}r5->{p}out] = ch_concat
+"""
+    return txt, p + "out"
+
+
+def inception_small_netconfig(n_blocks: int = 2, nch: int = 16,
+                              n_class: int = 10) -> str:
+    """A small GoogLeNet-flavored net: stem conv, n inception modules,
+    global pooling head. Exercises split / parallel towers / ch_concat."""
+    txt = """
+netconfig=start
+layer[0->stem] = conv:stem
+  kernel_size = 3
+  stride = 1
+  pad = 1
+  nchannel = %d
+layer[stem->stemr] = relu
+""" % nch
+    node = "stemr"
+    for i in range(n_blocks):
+        blk, node = _inception_block(i, node, nch)
+        txt += blk
+    txt += """
+layer[%s->gp] = avg_pooling
+  kernel_size = 4
+  stride = 4
+layer[gp->fl] = flatten
+layer[fl->out] = fullc:head
+  nhidden = %d
+layer[+0] = softmax
+netconfig=end
+random_type = xavier
+metric = error
+""" % (node, n_class)
+    return txt
+
+
+def inception_trainer(batch_size: int = 16, input_hw: int = 16,
+                      dev: str = "cpu", n_blocks: int = 2,
+                      extra_cfg: str = "") -> Trainer:
+    conf = (inception_small_netconfig(n_blocks=n_blocks) +
+            "input_shape = 3,%d,%d\n" % (input_hw, input_hw) +
+            "batch_size = %d\n" % batch_size +
+            "eta = 0.05\nmomentum = 0.0\n" +
+            "dev = %s\n" % dev + extra_cfg)
+    tr = Trainer()
+    for k, v in parse_config_string(conf):
+        tr.set_param(k, v)
+    tr.init_model()
+    return tr

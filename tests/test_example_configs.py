@@ -1,0 +1,66 @@
+"""Shipped example configs must parse and build their nets (the reference's
+example/ recipes are its integration surface — SURVEY.md §4.4).
+
+Data files aren't present, so iterators are skipped: we parse each conf,
+strip the io sections, and run model init + one synthetic update on CPU.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.nnet.trainer import create_net
+from cxxnet_tpu.utils.config import parse_config_file
+
+
+def build_from_conf(path, batch_size=4):
+    pairs = parse_config_file(path)
+    # strip iterator sections (data=/eval=/pred= .. iter=end)
+    kept, in_section = [], False
+    for k, v in pairs:
+        if k in ("data", "eval", "pred"):
+            in_section = True
+            continue
+        if in_section:
+            if k == "iter" and v == "end":
+                in_section = False
+            continue
+        kept.append((k, v))
+    tr = create_net(0)
+    for k, v in kept:
+        if k in ("dev", "batch_size", "num_round", "max_round", "save_model",
+                 "model_dir", "continue"):
+            continue
+        tr.set_param(k, v)
+    tr.set_param("dev", "cpu")
+    tr.set_param("batch_size", str(batch_size))
+    tr.init_model()
+    return tr, dict(kept)
+
+
+@pytest.mark.parametrize("conf,shape,nclass", [
+    ("example/MNIST/MNIST.conf", (1, 1, 784), 10),
+    ("example/MNIST/MNIST_CONV.conf", (1, 28, 28), 10),
+    ("example/MNIST/multichip.conf", (1, 1, 784), 10),
+    ("example/kaggle_bowl/bowl.conf", (3, 40, 40), 121),
+    ("example/ImageNet/ImageNet.conf", (3, 227, 227), 1000),
+])
+def test_example_conf_builds_and_steps(conf, shape, nclass):
+    tr, cfg = build_from_conf(os.path.join(REPO, conf))
+    got_shape = tuple(int(x) for x in cfg["input_shape"].split(","))
+    assert got_shape == shape, "input_shape drifted from the recipe"
+    rs = np.random.RandomState(0)
+    b = DataBatch()
+    b.data = rs.rand(4, *shape).astype(np.float32)
+    b.label = rs.randint(0, nclass, (4, 1)).astype(np.float32)
+    b.batch_size = 4
+    tr.update(b)
+    out = tr.predict(b)
+    assert out.shape == (4,)
+    assert (0 <= out).all() and (out < nclass).all()

@@ -238,3 +238,21 @@ def test_tag_scoped_params():
     assert up_b.param.base_lr == 0.1
     assert up_w.param.wd == 0.0
     assert up_b.param.wd == 0.25
+
+
+def test_inception_dag_memorizes():
+    """GoogLeNet-flavored DAG (split -> parallel conv towers -> ch_concat)
+    built purely from the netconfig DSL trains to memorization."""
+    import numpy as np
+    from cxxnet_tpu.models import inception_trainer
+    from cxxnet_tpu.io.data import DataBatch
+
+    tr = inception_trainer()
+    rs = np.random.RandomState(0)
+    b = DataBatch()
+    b.data = rs.rand(16, 3, 16, 16).astype(np.float32)
+    b.label = rs.randint(0, 10, (16, 1)).astype(np.float32)
+    b.batch_size = 16
+    for _ in range(400):
+        tr.update(b)
+    assert (tr.predict(b) == b.label[:, 0]).mean() == 1.0
