@@ -174,6 +174,9 @@ class ApplyContext:
     losses: List[jnp.ndarray] = field(default_factory=list)
     # number of optimizer steps taken, for annealing layers (insanity)
     epoch: jnp.ndarray = 0
+    # device mesh of the running trainer (None single-device); layers with
+    # sharded algorithms (attention w/ sequence parallelism) read it
+    mesh: object = None
 
 
 class Layer:

@@ -45,6 +45,7 @@ kChConcat = 28
 kPRelu = 29
 kBatchNorm = 30
 kFixConnect = 31
+kAttention = 32
 kPairTestGap = 1024
 
 _NAME2TYPE = {
@@ -75,6 +76,7 @@ _NAME2TYPE = {
     "ch_concat": kChConcat,
     "prelu": kPRelu,
     "batch_norm": kBatchNorm,
+    "attention": kAttention,
 }
 
 _TYPE2CLS = {
@@ -105,6 +107,7 @@ _TYPE2CLS = {
     kChConcat: L.ChConcatLayer,
     kPRelu: L.PReluLayer,
     kBatchNorm: L.BatchNormLayer,
+    kAttention: L.AttentionLayer,
 }
 
 

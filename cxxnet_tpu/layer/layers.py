@@ -836,3 +836,102 @@ class MultiLogisticLayer(LossLayerBase):
         # sum BCE with logits; gradient wrt x2d is sigmoid(x) - y
         bce = jnp.maximum(x2d, 0) - x2d * label + jnp.log1p(jnp.exp(-jnp.abs(x2d)))
         return jnp.sum(bce) * self._scale()
+
+
+class AttentionLayer(Layer):
+    """Multi-head self-attention over sequence nodes (b, D, 1, L) — channels
+    hold d_model so `conv kernel_size=1` serves as the position-wise FFN in
+    transformer stacks. Beyond the reference (a CNN framework with no
+    sequence axis); the long-context path of this framework.
+
+    With a mesh carrying an "sp" axis (trainer config `seq_parallel = k`) the
+    sequence dimension is sharded and attention runs as ring attention (K/V
+    blocks rotating over ICI, `sp_mode = ring`, the default) or Ulysses
+    all-to-all (`sp_mode = ulysses`); single-device it is plain dense
+    attention. Numerics match attention_reference in all modes
+    (tests/test_parallel.py, tests/test_layers.py)."""
+
+    type_name = "attention"
+
+    def __init__(self):
+        super().__init__()
+        self.nhead = 1
+        self.causal = 0
+        self.sp_mode = "ring"
+
+    def set_param(self, name, val):
+        super().set_param(name, val)
+        if name == "nhead":
+            self.nhead = int(val)
+        if name == "causal":
+            self.causal = int(val)
+        if name == "sp_mode":
+            check(val in ("ring", "ulysses"),
+                  "sp_mode must be ring or ulysses")
+            self.sp_mode = val
+
+    def infer_shape(self, in_shapes):
+        check(len(in_shapes) == 1, "AttentionLayer only support 1-1 connection")
+        b, d, h, L = in_shapes[0]
+        check(h == 1, "attention input must be (batch, d_model, 1, seq)")
+        check(d % self.nhead == 0, "nhead must divide d_model")
+        self.param.num_input_channel = d
+        return [in_shapes[0]]
+
+    def init_params(self, rng):
+        d = self.param.num_input_channel
+        return {"wqkv": self.param.rand_init_weight(
+                    rng, (d, 3 * d), in_num=d, out_num=3 * d),
+                "wo": self.param.rand_init_weight(
+                    rng, (d, d), in_num=d, out_num=d)}
+
+    def save_model(self, w, params):
+        self.param.save(w)
+        w.write_tensor(params["wqkv"])
+        w.write_tensor(params["wo"])
+
+    def load_model(self, r):
+        self.param.load(r)
+        return {"wqkv": r.read_tensor(), "wo": r.read_tensor()}
+
+    def visit_order(self):
+        # wo gets its own tag: one array per tag so the GetWeight/SetWeight
+        # ABI (and per-tag updater scoping, e.g. wo:lr) can reach both
+        return [("wmat", "wqkv"), ("wo", "wo")]
+
+    def apply(self, params, inputs, ctx):
+        from ..parallel import (attention_reference, ring_attention,
+                                ulysses_attention)
+        x = inputs[0]
+        b, d, _, L = x.shape
+        nh, dh = self.nhead, d // self.nhead
+        seq = x.reshape(b, d, L).transpose(0, 2, 1)          # (b, L, d)
+        qkv = jnp.dot(seq, params["wqkv"])                    # (b, L, 3d)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):  # (b, L, d) -> (b, nh, L, dh)
+            return t.reshape(b, L, nh, dh).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        mesh = ctx.mesh
+        if mesh is not None and "sp" in getattr(mesh, "axis_names", ()):
+            sp = mesh.shape["sp"]
+            check(L % sp == 0,
+                  "attention: seq length %d must be divisible by "
+                  "seq_parallel %d" % (L, sp))
+            if self.sp_mode == "ulysses":
+                check(nh % sp == 0,
+                      "ulysses: nhead %d must be divisible by "
+                      "seq_parallel %d" % (nh, sp))
+            fn = ring_attention if self.sp_mode == "ring" \
+                else ulysses_attention
+            # shard batch over 'data' too when present — otherwise the
+            # attention block would replicate the global batch per chip
+            batch_axis = "data" if "data" in mesh.axis_names else None
+            out = fn(q, k, v, mesh, causal=bool(self.causal),
+                     batch_axis=batch_axis)
+        else:
+            out = attention_reference(q, k, v, causal=bool(self.causal))
+        out = out.transpose(0, 2, 1, 3).reshape(b, L, d)      # merge heads
+        out = jnp.dot(out, params["wo"])
+        return [out.transpose(0, 2, 1).reshape(b, d, 1, L)]

@@ -106,7 +106,7 @@ class NeuralNet:
 
     def forward(self, params: Params, data, extra_data=(),
                 labels: Optional[LabelInfo] = None, train: bool = False,
-                rng=None, epoch=0):
+                rng=None, epoch=0, mesh=None):
         """Run the DAG; returns (node_values list, total_loss scalar)."""
         cfg = self.cfg
         cdt = self.compute_dtype
@@ -121,7 +121,8 @@ class NeuralNet:
                 lambda a: a.astype(cdt)
                 if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
                 params)
-        ctx = ApplyContext(train=train, labels=labels, epoch=epoch)
+        ctx = ApplyContext(train=train, labels=labels, epoch=epoch,
+                           mesh=mesh)
         base_rng = rng if rng is not None else jax.random.PRNGKey(0)
         for i, info in enumerate(cfg.layers):
             lay = self.layers[i]

@@ -56,6 +56,7 @@ class Trainer:
         self.type_pserver = "UNSPECIFIED"
         self.update_on_server = 0
         self.model_parallel = 1
+        self.seq_parallel = 1
         self.metric = MetricSet()
         self.train_metric = MetricSet()
         self.eval_node_names: List[Optional[str]] = []  # None -> last node
@@ -87,6 +88,8 @@ class Trainer:
             self.update_on_server = int(val)
         if name == "model_parallel":
             self.model_parallel = int(val)
+        if name == "seq_parallel":
+            self.seq_parallel = int(val)
         if name == "test_on_server":
             self.test_on_server = int(val)
         if name == "compute_dtype":
@@ -115,7 +118,17 @@ class Trainer:
         n = len(ids) if ids else 1
         n = min(max(n, 1), n_avail)
         mp = self.model_parallel
-        if mp > 1:
+        sp = self.seq_parallel
+        check(mp == 1 or sp == 1,
+              "model_parallel and seq_parallel cannot be combined yet")
+        if sp > 1:
+            check(n % sp == 0, "device count must be divisible by seq_parallel")
+            dp = n // sp
+            check(dp == 1 or self.batch_size % dp == 0,
+                  "batch_size must be divisible by the data-parallel degree")
+            self.mesh = parallel.create_mesh(ids[:n] if ids else None,
+                                             ("data", "sp"), (dp, sp))
+        elif mp > 1:
             check(n % mp == 0, "device count must be divisible by model_parallel")
             dp = n // mp
             check(dp == 1 or self.batch_size % dp == 0,
@@ -299,7 +312,8 @@ class Trainer:
     def _loss_fn(self, params, data, label, rng, epoch):
         labels = self.net.label_info_from(label)
         values, loss = self.net.forward(params, data, labels=labels,
-                                        train=True, rng=rng, epoch=epoch)
+                                        train=True, rng=rng, epoch=epoch,
+                                        mesh=self.mesh)
         eval_outs = [values[n].reshape(values[n].shape[0], -1)
                      for n in self.eval_nodes]
         return loss, eval_outs
@@ -380,7 +394,8 @@ class Trainer:
         k = ("fwd", node_ids)
         if k not in self._jit_cache:
             def fwd(params, data, rng):
-                values, _ = self.net.forward(params, data, train=False, rng=rng)
+                values, _ = self.net.forward(params, data, train=False,
+                                             rng=rng, mesh=self.mesh)
                 return [values[n] for n in node_ids]
             self._jit_cache[k] = jax.jit(fwd)
         data = self._shard_batch(batch.data)
@@ -436,13 +451,13 @@ class Trainer:
 
     # ------------------------------------------------------------------
     def set_weight(self, value: np.ndarray, layer_name: str, tag: str) -> None:
-        check(tag in ("wmat", "bias"),
-              "SetWeight: weight tag can only be bias or wmat")
+        check(tag in ("wmat", "bias", "wo"),
+              "SetWeight: weight tag can only be bias, wmat, or wo")
         self.net.set_weight(self.params, value, layer_name, tag)
 
     def get_weight(self, layer_name: str, tag: str):
-        check(tag in ("wmat", "bias"),
-              "GetWeight: weight tag can only be bias or wmat")
+        check(tag in ("wmat", "bias", "wo"),
+              "GetWeight: weight tag can only be bias, wmat, or wo")
         return self.net.get_weight(self.params, layer_name, tag)
 
 

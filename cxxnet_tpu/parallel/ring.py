@@ -95,12 +95,16 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
 
 
 def ring_attention(q, k, v, mesh: Mesh, *, axis_name: str = "sp",
-                   causal: bool = False, scale: Optional[float] = None):
+                   causal: bool = False, scale: Optional[float] = None,
+                   batch_axis: Optional[str] = None):
     """Ring attention over sequence-sharded q, k, v: (b, h, seq, d) with seq
-    sharded on ``axis_name``. Returns output with the same sharding."""
+    sharded on ``axis_name``. Returns output with the same sharding.
+    ``batch_axis`` names a mesh axis to shard the batch dim over (pass the
+    trainer's "data" axis on a (data, sp) mesh — a None batch spec would
+    replicate the global batch on every chip)."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    spec = P(None, None, axis_name, None)
+    spec = P(batch_axis, None, axis_name, None)
     fn = shard_map(
         functools.partial(_ring_attention_local, axis_name=axis_name,
                           causal=causal, scale=scale),
@@ -126,16 +130,18 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
 
 
 def ulysses_attention(q, k, v, mesh: Mesh, *, axis_name: str = "sp",
-                      causal: bool = False, scale: Optional[float] = None):
+                      causal: bool = False, scale: Optional[float] = None,
+                      batch_axis: Optional[str] = None):
     """Ulysses sequence parallelism: all-to-all seq->heads, dense local
-    attention, all-to-all back. Requires heads % axis_size == 0."""
+    attention, all-to-all back. Requires heads % axis_size == 0.
+    ``batch_axis`` as in ring_attention."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     n = mesh.shape[axis_name]
     if q.shape[1] % n != 0:
         raise ValueError("ulysses needs heads (%d) divisible by sp axis (%d)"
                          % (q.shape[1], n))
-    spec = P(None, None, axis_name, None)
+    spec = P(batch_axis, None, axis_name, None)
     fn = shard_map(
         functools.partial(_ulysses_local, axis_name=axis_name,
                           causal=causal, scale=scale),

@@ -1,0 +1,120 @@
+"""The attention layer (long-context path): DSL integration, causal masking,
+and sequence parallelism (ring / Ulysses over the mesh "sp" axis) matching
+the single-device numerics."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from cxxnet_tpu import api
+
+CFG = """
+netconfig = start
+layer[+1:att1] = attention:att1
+  nhead = 4
+  causal = %(causal)d
+  sp_mode = %(sp_mode)s
+  init_sigma = 0.1
+layer[+1:ffn] = conv:ffn
+  kernel_size = 1
+  nchannel = 16
+  init_sigma = 0.1
+layer[+1] = relu
+layer[+1] = flatten
+layer[+1:head] = fullc:head
+  nhidden = 5
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig = end
+input_shape = 16,1,16
+batch_size = 8
+eta = 0.1
+momentum = 0.0
+seed = 7
+"""
+
+
+def _data(seed=0):
+    rs = np.random.RandomState(seed)
+    return (rs.rand(8, 16, 1, 16).astype(np.float32),
+            rs.randint(0, 5, 8).astype(np.float32))
+
+
+def _build(dev, causal=0, sp_mode="ring", extra=""):
+    net = api.Net(dev=dev, cfg=CFG % {"causal": causal, "sp_mode": sp_mode}
+                  + extra)
+    net.init_model()
+    return net
+
+
+def test_attention_net_memorizes():
+    x, y = _data()
+    net = _build("cpu")
+    for _ in range(400):
+        net.update(x, y)
+    assert (net.predict(x) == y).mean() >= 0.85
+
+
+@pytest.mark.parametrize("sp_mode", ["ring", "ulysses"])
+@pytest.mark.parametrize("causal", [0, 1])
+def test_seq_parallel_matches_single_device(sp_mode, causal):
+    """seq_parallel=4 over the virtual mesh must reproduce single-device
+    outputs (same seed => same init params)."""
+    x, _ = _data(1)
+    single = _build("cpu", causal=causal, sp_mode=sp_mode)
+    sharded = _build("tpu:0-7", causal=causal, sp_mode=sp_mode,
+                     extra="seq_parallel = 4\n")
+    assert sharded.net_.mesh is not None
+    assert dict(zip(sharded.net_.mesh.axis_names,
+                    sharded.net_.mesh.devices.shape)) == {"data": 2, "sp": 4}
+    a = np.asarray(single.extract(x, "top[-1]"), np.float32)
+    b = np.asarray(sharded.extract(x, "top[-1]"), np.float32)
+    np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
+
+
+def test_seq_parallel_trains():
+    # same data as the single-device memorize test: the sharded trainer must
+    # reach the same fit (seed-2 data happens to be a hard draw at this eta
+    # on a single device too, so it is not used here)
+    x, y = _data()
+    net = _build("tpu:0-7", extra="seq_parallel = 4\n")
+    for _ in range(400):
+        net.update(x, y)
+    assert (net.predict(x) == y).mean() >= 0.85
+
+
+def test_attention_save_load_and_weight_tags(tmp_path):
+    x, _ = _data(3)
+    net = _build("cpu")
+    p1 = net.extract(x, "top[-1]")
+    path = str(tmp_path / "att.model")
+    net.save_model(path)
+    net2 = api.Net(dev="cpu", cfg=CFG % {"causal": 0, "sp_mode": "ring"})
+    net2.load_model(path)
+    p2 = net2.extract(x, "top[-1]")
+    np.testing.assert_allclose(np.asarray(p1), np.asarray(p2),
+                               rtol=1e-5, atol=1e-6)
+    # both attention weights reachable through the weight ABI
+    wqkv = net.get_weight("att1", "wmat")
+    wo = net.get_weight("att1", "wo")
+    assert wqkv.shape == (16, 48)
+    assert wo.shape == (16, 16)
+    net.set_weight(np.zeros_like(wo), "att1", "wo")
+    assert np.all(net.get_weight("att1", "wo") == 0)
+
+
+def test_seq_len_divisibility_error():
+    bad = CFG.replace("input_shape = 16,1,16", "input_shape = 16,1,10")
+    net = api.Net(dev="tpu:0-7",
+                  cfg=bad % {"causal": 0, "sp_mode": "ring"}
+                  + "seq_parallel = 4\nbatch_size = 8\n")
+    net.init_model()
+    x = np.random.RandomState(0).rand(8, 16, 1, 10).astype(np.float32)
+    y = np.zeros(8, np.float32)
+    with pytest.raises(ValueError, match="divisible by"):
+        net.update(x, y)
