@@ -7,8 +7,9 @@
 CXX ?= g++
 CXXFLAGS ?= -O2 -std=c++17 -Wall -fPIC -pthread
 
-CORE_SRC = src/core/config.cc src/core/binary_page.cc
+CORE_SRC = src/core/config.cc src/core/binary_page.cc src/core/jpeg_decode.cc
 CORE_HDR = src/core/cxn_core.h
+CORE_LIBS = -ljpeg
 
 PY_INCLUDES := $(shell python3-config --includes)
 PY_LDFLAGS := $(shell python3-config --ldflags --embed)
@@ -25,11 +26,11 @@ bin/test_wrapper_c: wrapper/test_wrapper.c lib/libcxxnetwrapper.so
 
 lib/libcxxnet_tpu_core.so: $(CORE_SRC) $(CORE_HDR)
 	@mkdir -p lib
-	$(CXX) $(CXXFLAGS) -shared -o $@ $(CORE_SRC)
+	$(CXX) $(CXXFLAGS) -shared -o $@ $(CORE_SRC) $(CORE_LIBS)
 
 bin/im2bin: tools/im2bin.cc $(CORE_SRC) $(CORE_HDR)
 	@mkdir -p bin
-	$(CXX) $(CXXFLAGS) -o $@ tools/im2bin.cc $(CORE_SRC)
+	$(CXX) $(CXXFLAGS) -o $@ tools/im2bin.cc $(CORE_SRC) $(CORE_LIBS)
 
 clean:
 	rm -f lib/libcxxnet_tpu_core.so lib/libcxxnetwrapper.so bin/im2bin bin/test_wrapper_c

@@ -34,6 +34,13 @@ from .batch import BatchAdaptIterator
 
 
 def _decode_rgb_chw(buf: bytes) -> np.ndarray:
+    # native path first: libjpeg decode + float CHW conversion in C++,
+    # entirely off-GIL (src/core/jpeg_decode.cc) — this is what lets the
+    # imgbinx decode thread pool scale
+    from ..utils import native
+    out = native.decode_jpeg_chw(buf)
+    if out is not None:
+        return out
     import cv2
     arr = np.frombuffer(buf, dtype=np.uint8)
     bgr = cv2.imdecode(arr, cv2.IMREAD_COLOR)
@@ -91,6 +98,15 @@ class ImagePageIterator(IIterator):
         self.lst: Optional[_ListReader] = None
         self.native_reader = None
         self.fbin = None
+        # decode pipeline (the reference imgbinx two-stage ThreadBuffer,
+        # iter_thread_imbin_x-inl.hpp): decode_thread workers decode jpegs
+        # ahead of the consumer (cv2.imdecode releases the GIL), depth
+        # buffer_size records. decode_thread=1 = synchronous decode (imgbin)
+        self.decode_thread = 1
+        self.buffer_size = 64
+        self._pool = None
+        self._pending = None
+        self._lst_done = False
 
     def set_param(self, name, val):
         if name == "image_list":
@@ -111,6 +127,10 @@ class ImagePageIterator(IIterator):
             self.label_width = int(val)
         if name == "page_size":
             self.page_ints = int(val)
+        if name == "decode_thread":
+            self.decode_thread = int(val)
+        if name == "buffer_size":
+            self.buffer_size = int(val)
 
     def _parse_image_conf(self):
         """Multi-part list + distributed sharding
@@ -161,6 +181,9 @@ class ImagePageIterator(IIterator):
         self.bin_idx = 0
         self.page = None
         self.ptop = 0
+        from collections import deque
+        self._pending = deque()
+        self._lst_done = False
         if getattr(self, "fbin", None) is not None:
             self.fbin.close()
             self.fbin = None
@@ -190,7 +213,30 @@ class ImagePageIterator(IIterator):
         self.ptop += 1
         return obj
 
+    def _fill_decode_pipeline(self) -> None:
+        while len(self._pending) < self.buffer_size and not self._lst_done:
+            rec = self.lst.next_record()
+            if rec is None:
+                self._lst_done = True
+                return
+            index, label, _ = rec
+            buf = self._next_buffer()
+            self._pending.append(
+                (index, label, self._pool.submit(_decode_rgb_chw, buf)))
+
     def next(self) -> bool:
+        if self.decode_thread > 1:
+            if self._pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self.decode_thread,
+                    thread_name_prefix="cxn-decode")
+            self._fill_decode_pipeline()
+            if not self._pending:
+                return False
+            index, label, fut = self._pending.popleft()
+            self.out = DataInst(fut.result(), label, index)
+            return True
         rec = self.lst.next_record()
         if rec is None:
             return False
@@ -545,7 +591,11 @@ def create_image_base(kind: str) -> IIterator:
     """imgbin chains come pre-wrapped Batch(Augment(PageReader))
     (reference data.cpp:35-50)."""
     if kind in ("imgbin", "imgbinx"):
-        return BatchAdaptIterator(AugmentIterator(ImagePageIterator()))
+        page_it = ImagePageIterator()
+        if kind == "imgbinx":
+            # imgbinx is the pipelined variant: decode pool on by default
+            page_it.decode_thread = 4
+        return BatchAdaptIterator(AugmentIterator(page_it))
     if kind == "img":
         return BatchAdaptIterator(AugmentIterator(ImageIterator()))
     raise ValueError("unknown image iterator %s" % kind)

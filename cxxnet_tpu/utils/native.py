@@ -57,6 +57,16 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.CXNPageReaderNext.argtypes = [ctypes.c_void_p,
                                       ctypes.POINTER(ctypes.c_void_p)]
     lib.CXNPageReaderFree.argtypes = [ctypes.c_void_p]
+
+    lib.CXNJpegDims.restype = ctypes.c_int
+    lib.CXNJpegDims.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                ctypes.POINTER(ctypes.c_int64),
+                                ctypes.POINTER(ctypes.c_int64),
+                                ctypes.POINTER(ctypes.c_int64)]
+    lib.CXNJpegDecodeF32.restype = ctypes.c_int
+    lib.CXNJpegDecodeF32.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                     ctypes.POINTER(ctypes.c_float),
+                                     ctypes.c_int64, ctypes.c_int64]
     return lib
 
 
@@ -117,6 +127,32 @@ def parse_config_string(text: str) -> Optional[List[Tuple[str, str]]]:
         return out
     finally:
         lib.CXNConfigFree(h)
+
+
+def decode_jpeg_chw(buf: bytes):
+    """Decode JPEG bytes to a float32 (3, h, w) RGB array with the native
+    decoder — the whole call (libjpeg decode + float CHW conversion) runs
+    outside the GIL, so a Python thread pool of these parallelizes for
+    real. Returns None if the library is unavailable or the stream is not a
+    JPEG the native path can handle (caller falls back to cv2)."""
+    import numpy as np
+    lib = load()
+    if lib is None:
+        return None
+    h = ctypes.c_int64()
+    w = ctypes.c_int64()
+    c = ctypes.c_int64()
+    n = len(buf)
+    if not lib.CXNJpegDims(buf, n, ctypes.byref(h), ctypes.byref(w),
+                           ctypes.byref(c)):
+        return None
+    out = np.empty((3, h.value, w.value), np.float32)
+    ok = lib.CXNJpegDecodeF32(
+        buf, n, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        h.value, w.value)
+    if not ok:
+        return None
+    return out
 
 
 class NativePageReader:

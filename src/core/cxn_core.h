@@ -67,6 +67,15 @@ void CXNPageReaderBeforeFirst(void *handle);
 int64_t CXNPageReaderNext(void *handle, const void **out);
 void CXNPageReaderFree(void *handle);
 
+/* ---- JPEG decode (reference: src/utils/decoder.h libjpeg path) ---- */
+
+/*! Header-only parse; 1 on success with *h,*w,*c set (c always 3). */
+int CXNJpegDims(const void *buf, int64_t size, int64_t *h, int64_t *w,
+                int64_t *c);
+/*! Decode to caller-allocated float32 CHW RGB planes (0..255). */
+int CXNJpegDecodeF32(const void *buf, int64_t size, float *out,
+                     int64_t h, int64_t w);
+
 /*! Library ABI version — bump on incompatible change. */
 int64_t CXNCoreVersion(void);
 

@@ -1,0 +1,113 @@
+/*!
+ * jpeg_decode.cc — native JPEG decode to float32 CHW RGB.
+ *
+ * The io pipeline's decode stage (reference: src/utils/decoder.h libjpeg
+ * path). Decoding AND the uint8->float CHW conversion happen in C++, so a
+ * Python thread pool calling through ctypes runs them fully outside the
+ * GIL — that is what makes the imgbinx decode pipeline actually parallel
+ * (cv2.imdecode releases the GIL but the numpy transpose/astype after it
+ * does not).
+ */
+#include "cxn_core.h"
+
+#include <csetjmp>
+#include <cstdio>
+#include <cstring>
+
+#include <jpeglib.h>
+
+namespace {
+
+struct ErrMgr {
+  jpeg_error_mgr pub;
+  jmp_buf jb;
+};
+
+void ErrorExit(j_common_ptr cinfo) {
+  ErrMgr *err = reinterpret_cast<ErrMgr *>(cinfo->err);
+  std::longjmp(err->jb, 1);
+}
+
+}  // namespace
+
+extern "C" {
+
+/*! Parse the header only; returns 1 and sets *h,*w,*c on success, 0 on a
+ *  malformed stream. */
+int CXNJpegDims(const void *buf, int64_t size, int64_t *h, int64_t *w,
+                int64_t *c) {
+  jpeg_decompress_struct cinfo;
+  ErrMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = ErrorExit;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return 0;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, static_cast<const unsigned char *>(buf),
+               static_cast<unsigned long>(size));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return 0;
+  }
+  *h = cinfo.image_height;
+  *w = cinfo.image_width;
+  *c = 3;  // we always decode to RGB
+  jpeg_destroy_decompress(&cinfo);
+  return 1;
+}
+
+/*!
+ * Decode one JPEG into caller-allocated float32 CHW RGB planes
+ * (out[plane*h*w + y*w + x], values 0..255). h/w must come from
+ * CXNJpegDims. Returns 1 on success, 0 on decode error.
+ */
+int CXNJpegDecodeF32(const void *buf, int64_t size, float *out,
+                     int64_t h, int64_t w) {
+  jpeg_decompress_struct cinfo;
+  ErrMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = ErrorExit;
+  JSAMPARRAY row = nullptr;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return 0;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, static_cast<const unsigned char *>(buf),
+               static_cast<unsigned long>(size));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return 0;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  if (int64_t(cinfo.output_height) != h || int64_t(cinfo.output_width) != w ||
+      cinfo.output_components != 3) {
+    jpeg_destroy_decompress(&cinfo);
+    return 0;
+  }
+  row = (*cinfo.mem->alloc_sarray)(
+      reinterpret_cast<j_common_ptr>(&cinfo), JPOOL_IMAGE,
+      cinfo.output_width * 3, 1);
+  const int64_t plane = h * w;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    int64_t y = cinfo.output_scanline;
+    jpeg_read_scanlines(&cinfo, row, 1);
+    const JSAMPLE *src = row[0];
+    float *r = out + y * w;
+    float *g = out + plane + y * w;
+    float *b = out + 2 * plane + y * w;
+    for (int64_t x = 0; x < w; ++x) {
+      r[x] = float(src[3 * x + 0]);
+      g[x] = float(src[3 * x + 1]);
+      b[x] = float(src[3 * x + 2]);
+    }
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return 1;
+}
+
+}  // extern "C"

@@ -237,3 +237,21 @@ def test_threadbuffer_iterator_restart_stress(tmp_path):
     while it.next():
         n += 1
     assert n == 8
+
+
+def test_native_jpeg_decode_parity(lib):
+    """Native libjpeg decode must match the cv2 fallback bit-for-bit (both
+    wrap libjpeg) on a round-tripped image."""
+    cv2 = pytest.importorskip("cv2")
+    rs = np.random.RandomState(5)
+    img = rs.randint(0, 255, (64, 48, 3), np.uint8)
+    ok, enc = cv2.imencode(".jpg", img[:, :, ::-1])
+    assert ok
+    buf = enc.tobytes()
+    a = native.decode_jpeg_chw(buf)
+    assert a is not None and a.shape == (3, 64, 48) and a.dtype == np.float32
+    bgr = cv2.imdecode(np.frombuffer(buf, np.uint8), cv2.IMREAD_COLOR)
+    b = bgr[:, :, ::-1].transpose(2, 0, 1).astype(np.float32)
+    np.testing.assert_array_equal(a, b)
+    # malformed stream -> clean None, not a crash
+    assert native.decode_jpeg_chw(b"not a jpeg") is None
