@@ -1,6 +1,7 @@
 """Config tokenizer tests — semantics of the reference config format
 (src/utils/config.h)."""
 
+import numpy as np
 import pytest
 
 from cxxnet_tpu.utils.config import ConfigError, parse_config_string
@@ -60,3 +61,52 @@ netconfig=end
     assert cfg[2] == ("nhidden", "100")
     assert cfg[3] == ("layer[+0]", "softmax")
     assert cfg[4] == ("netconfig", "end")
+
+
+def test_metric_recall_topn():
+    """rec@n: fraction of true labels inside the top-n predictions
+    (reference utils/metric.h MetricRecall)."""
+    from cxxnet_tpu.utils.metric import create_metric
+
+    m = create_metric("rec@2")
+    pred = np.array([[0.1, 0.5, 0.4],     # top-2 = {1, 2}
+                     [0.7, 0.2, 0.1],     # top-2 = {0, 1}
+                     [0.3, 0.3, 0.4]])    # top-2 includes 2
+    labels = np.array([[1.0], [2.0], [2.0]])
+    m.add_eval(pred, labels)
+    assert m.get() == pytest.approx(2.0 / 3.0)
+
+    with pytest.raises(ValueError):
+        create_metric("rec@5").add_eval(np.zeros((2, 3)), np.zeros((2, 1)))
+
+
+def test_dist_worker_corpus_sharding(tmp_path):
+    """dist_num_worker/dist_worker_rank split a multi-part corpus into
+    disjoint contiguous slices covering everything
+    (reference iter_thread_imbin-inl.hpp:189-220)."""
+    from cxxnet_tpu.io.iter_image import ImagePageIterator
+
+    # 4 parts, one record name per part
+    for i in range(4):
+        (tmp_path / ("part_%d.lst" % i)).write_text("%d 0 img%d.jpg\n" % (i, i))
+        (tmp_path / ("part_%d.bin" % i)).write_bytes(b"")
+    seen = []
+    for rank in range(2):
+        it = ImagePageIterator()
+        it.set_param("image_conf_prefix", str(tmp_path / "part_%d"))
+        it.set_param("image_conf_ids", "0-3")
+        it.set_param("dist_num_worker", "2")
+        it.set_param("dist_worker_rank", str(rank))
+        it._parse_image_conf()
+        seen.append([p.split("part_")[-1] for p in it.path_imgbin])
+    assert seen[0] == ["0.bin", "1.bin"]
+    assert seen[1] == ["2.bin", "3.bin"]
+
+    # too many workers for the part list must fail fast
+    it = ImagePageIterator()
+    it.set_param("image_conf_prefix", str(tmp_path / "part_%d"))
+    it.set_param("image_conf_ids", "0-1")
+    it.set_param("dist_num_worker", "5")
+    it.set_param("dist_worker_rank", "4")
+    with pytest.raises(AssertionError):
+        it._parse_image_conf()
