@@ -508,7 +508,9 @@ class ConvolutionLayer(Layer):
         check(p.num_channel % p.num_group == 0, "output channels must divide group size")
         check(p.num_channel > 0, "must set nchannel correctly")
         check(p.kernel_height > 0 and p.kernel_width > 0, "must set kernel_size correctly")
-        check(p.kernel_width <= w and p.kernel_height <= h, "kernel size exceed input")
+        check(p.kernel_width <= w + 2 * p.pad_x
+              and p.kernel_height <= h + 2 * p.pad_y,
+              "kernel size exceed input")
         if p.num_input_channel == 0:
             p.num_input_channel = c
         else:
@@ -575,9 +577,11 @@ class PoolingLayer(Layer):
         b, c, h, w = in_shapes[0]
         check(p.kernel_height > 0 and p.kernel_width > 0,
               "must set kernel_size correctly")
-        check(p.kernel_width <= w and p.kernel_height <= h, "kernel size exceed input")
-        oh = ops.pool_out_dim(h, p.kernel_height, p.stride)
-        ow = ops.pool_out_dim(w, p.kernel_width, p.stride)
+        h2, w2 = h + 2 * p.pad_y, w + 2 * p.pad_x
+        check(p.kernel_width <= w2 and p.kernel_height <= h2,
+              "kernel size exceed input")
+        oh = ops.pool_out_dim(h2, p.kernel_height, p.stride)
+        ow = ops.pool_out_dim(w2, p.kernel_width, p.stride)
         return [(b, c, oh, ow)]
 
     def _pre(self, x):
@@ -586,7 +590,8 @@ class PoolingLayer(Layer):
     def apply(self, params, inputs, ctx):
         p = self.param
         x = self._pre(inputs[0])
-        return [ops.pool2d(x, self.mode, (p.kernel_height, p.kernel_width), p.stride)]
+        return [ops.pool2d(x, self.mode, (p.kernel_height, p.kernel_width),
+                           p.stride, pad=(p.pad_y, p.pad_x))]
 
 
 class MaxPoolingLayer(PoolingLayer):

@@ -187,3 +187,157 @@ def inception_trainer(batch_size: int = 16, input_hw: int = 16,
         tr.set_param(k, v)
     tr.init_model()
     return tr
+
+
+def _gnet_inception(name: str, node_in: str,
+                    c1: int, c3r: int, c3: int, c5r: int, c5: int,
+                    cp: int) -> Tuple[str, str]:
+    """One GoogLeNet (Inception-v1) module: 1x1 / 1x1->3x3 / 1x1->5x5 /
+    3x3-pool->1x1 towers, channel-concatenated (Szegedy et al. 2014).
+    Expressed purely in the netconfig DSL (split + ch_concat)."""
+    p = name
+    txt = f"""
+layer[{node_in}->{p}a,{p}b,{p}c,{p}d] = split
+layer[{p}a->{p}t1] = conv:{p}_1x1
+  kernel_size = 1
+  nchannel = {c1}
+layer[{p}t1->{p}o1] = relu
+layer[{p}b->{p}t3r] = conv:{p}_3x3r
+  kernel_size = 1
+  nchannel = {c3r}
+layer[{p}t3r->{p}r3r] = relu
+layer[{p}r3r->{p}t3] = conv:{p}_3x3
+  kernel_size = 3
+  pad = 1
+  nchannel = {c3}
+layer[{p}t3->{p}o3] = relu
+layer[{p}c->{p}t5r] = conv:{p}_5x5r
+  kernel_size = 1
+  nchannel = {c5r}
+layer[{p}t5r->{p}r5r] = relu
+layer[{p}r5r->{p}t5] = conv:{p}_5x5
+  kernel_size = 5
+  pad = 2
+  nchannel = {c5}
+layer[{p}t5->{p}o5] = relu
+layer[{p}d->{p}pp] = max_pooling
+  kernel_size = 3
+  stride = 1
+  pad = 1
+layer[{p}pp->{p}tp] = conv:{p}_proj
+  kernel_size = 1
+  nchannel = {cp}
+layer[{p}tp->{p}op] = relu
+layer[{p}o1,{p}o3,{p}o5,{p}op->{p}out] = ch_concat
+"""
+    return txt, p + "out"
+
+
+# (c1, c3r, c3, c5r, c5, pool_proj) per module — the paper's Table 1
+GOOGLENET_MODULES = {
+    "i3a": (64, 96, 128, 16, 32, 32),
+    "i3b": (128, 128, 192, 32, 96, 64),
+    "i4a": (192, 96, 208, 16, 48, 64),
+    "i4b": (160, 112, 224, 24, 64, 64),
+    "i4c": (128, 128, 256, 24, 64, 64),
+    "i4d": (112, 144, 288, 32, 64, 64),
+    "i4e": (256, 160, 320, 32, 128, 128),
+    "i5a": (256, 160, 320, 32, 128, 128),
+    "i5b": (384, 192, 384, 48, 128, 128),
+}
+
+
+def googlenet_netconfig(n_class: int = 1000, final_pool: int = 7) -> str:
+    """GoogLeNet / Inception-v1 (the BASELINE.json 'ImageNet GoogLeNet'
+    config): stem, 9 inception modules with maxpools between stages, global
+    avg-pool head. LRN runs the Pallas kernel on TPU."""
+    txt = """
+netconfig=start
+layer[0->n1] = conv:conv1
+  kernel_size = 7
+  stride = 2
+  pad = 3
+  nchannel = 64
+layer[n1->n2] = relu
+layer[n2->n3] = max_pooling
+  kernel_size = 3
+  stride = 2
+layer[n3->n4] = lrn
+  local_size = 5
+  alpha = 0.0001
+  beta = 0.75
+  knorm = 1
+layer[n4->n5] = conv:conv2r
+  kernel_size = 1
+  nchannel = 64
+layer[n5->n6] = relu
+layer[n6->n7] = conv:conv2
+  kernel_size = 3
+  pad = 1
+  nchannel = 192
+layer[n7->n8] = relu
+layer[n8->n9] = lrn
+  local_size = 5
+  alpha = 0.0001
+  beta = 0.75
+  knorm = 1
+layer[n9->n10] = max_pooling
+  kernel_size = 3
+  stride = 2
+"""
+    node = "n10"
+    for mod in ("i3a", "i3b"):
+        blk, node = _gnet_inception(mod, node, *GOOGLENET_MODULES[mod])
+        txt += blk
+    txt += """
+layer[%s->p3] = max_pooling
+  kernel_size = 3
+  stride = 2
+""" % node
+    node = "p3"
+    for mod in ("i4a", "i4b", "i4c", "i4d", "i4e"):
+        blk, node = _gnet_inception(mod, node, *GOOGLENET_MODULES[mod])
+        txt += blk
+    txt += """
+layer[%s->p4] = max_pooling
+  kernel_size = 3
+  stride = 2
+""" % node
+    node = "p4"
+    for mod in ("i5a", "i5b"):
+        blk, node = _gnet_inception(mod, node, *GOOGLENET_MODULES[mod])
+        txt += blk
+    txt += """
+layer[%(node)s->gp] = avg_pooling
+  kernel_size = %(fp)d
+  stride = %(fp)d
+layer[gp->fl] = flatten
+layer[fl->fd] = dropout
+  threshold = 0.4
+layer[fd->out] = fullc:loss_fc
+  nhidden = %(ncls)d
+layer[+0] = softmax
+netconfig=end
+random_type = xavier
+metric = error
+""" % {"node": node, "fp": final_pool, "ncls": n_class}
+    return txt
+
+
+def googlenet_trainer(batch_size: int = 128, input_hw: int = 224,
+                      dev: str = "tpu", n_class: int = 1000,
+                      extra_cfg: str = "") -> Trainer:
+    """GoogLeNet with the standard ImageNet recipe shape (224x224). For
+    tests, input_hw can shrink (>= 32); the final avg-pool adapts."""
+    assert input_hw >= 32
+    final_pool = max(input_hw // 32, 1)
+    conf = (googlenet_netconfig(n_class=n_class, final_pool=final_pool) +
+            "input_shape = 3,%d,%d\n" % (input_hw, input_hw) +
+            "batch_size = %d\n" % batch_size +
+            "eta = 0.01\nmomentum = 0.9\nwd = 0.0002\n" +
+            "dev = %s\n" % dev + extra_cfg)
+    tr = Trainer()
+    for k, v in parse_config_string(conf):
+        tr.set_param(k, v)
+    tr.init_model()
+    return tr

@@ -62,17 +62,22 @@ def _pool_padding(h: int, w: int, k: Tuple[int, int], s: int):
     return (oh, ow), (ph, pw)
 
 
-def pool2d(x: jnp.ndarray, mode: str, kernel: Tuple[int, int], stride: int) -> jnp.ndarray:
+def pool2d(x: jnp.ndarray, mode: str, kernel: Tuple[int, int], stride: int,
+           pad: Tuple[int, int] = (0, 0)) -> jnp.ndarray:
     """Pooling with the reference's ceil-mode output shape.
 
     mode: 'max' | 'sum' | 'avg'. avg divides by k*k regardless of padding,
-    matching src/layer/pooling_layer-inl.hpp:44-46.
+    matching src/layer/pooling_layer-inl.hpp:44-46. ``pad`` adds symmetric
+    input padding first (beyond the reference — needed for same-size pool
+    towers, e.g. GoogLeNet's 3x3/1 pool branch); max pads with -inf, so
+    padding never wins the max.
     """
     n, c, h, w = x.shape
-    (_, _), (ph, pw) = _pool_padding(h, w, kernel, stride)
+    py, px = pad
+    (_, _), (ph, pw) = _pool_padding(h + 2 * py, w + 2 * px, kernel, stride)
     window = (1, 1, kernel[0], kernel[1])
     strides = (1, 1, stride, stride)
-    padding = [(0, 0), (0, 0), (0, ph), (0, pw)]
+    padding = [(0, 0), (0, 0), (py, py + ph), (px, px + pw)]
     if mode == "max":
         init = -jnp.inf
         out = lax.reduce_window(x, init, lax.max, window, strides, padding)

@@ -64,3 +64,22 @@ def test_example_conf_builds_and_steps(conf, shape, nclass):
     out = tr.predict(b)
     assert out.shape == (4,)
     assert (0 <= out).all() and (out < nclass).all()
+
+
+def test_googlenet_conf_builds_and_steps():
+    """The GoogLeNet example (BASELINE config 4): builds the 9-module
+    inception DAG and takes a step at reduced input size."""
+    tr, cfg = build_from_conf(
+        os.path.join(REPO, "example/ImageNet/GoogLeNet.conf"))
+    # shrink: the conf is 224x224; rebuild at 64 via the model zoo to keep
+    # the CPU test fast, asserting the conf's netconfig parses above
+    from cxxnet_tpu.models import googlenet_trainer
+    tr = googlenet_trainer(batch_size=4, input_hw=64, dev="cpu", n_class=10)
+    rs = np.random.RandomState(0)
+    b = DataBatch()
+    b.data = rs.rand(4, 3, 64, 64).astype(np.float32)
+    b.label = rs.randint(0, 10, (4, 1)).astype(np.float32)
+    b.batch_size = 4
+    tr.update(b)
+    out = tr.predict(b)
+    assert out.shape == (4,)
