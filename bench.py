@@ -1,63 +1,193 @@
-"""Benchmark harness: AlexNet ImageNet-shape training throughput on TPU.
+"""Benchmark harness for the BASELINE configs.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Default (no args): AlexNet ImageNet-shape training throughput — prints ONE
+JSON line {"metric", "value", "unit", "vs_baseline"} for the driver.
 Baseline target (BASELINE.md): 2000 images/sec/chip on AlexNet.
 
-Measures the steady-state train step (forward + backward + SGD update on the
-reference AlexNet recipe, batch 256, 3x227x227, f32) with device-resident
-input — the input pipeline overlaps H2D via the threadbuffer prefetcher in
-real training, and per-step train metrics are off (eval_train=0) as they
-would be for a throughput run. The final value fetch forces full device sync
-so async dispatch cannot inflate the number.
+`python bench.py all` additionally benches the other BASELINE configs
+(GoogLeNet, MNIST MLP/conv, kaggle_bowl-shaped net), one JSON line each —
+the AlexNet headline line is always printed LAST so drivers reading the
+final line see the headline metric.
+
+Measures the steady-state train step (forward + backward + SGD update) with
+device-resident input — the input pipeline overlaps H2D via the
+threadbuffer prefetcher in real training, and per-step train metrics are
+off (eval_train=0) as they would be for a throughput run. bf16 mixed
+precision (the TPU-native recipe). The final value fetch forces a full
+device sync so async dispatch cannot inflate the number
+(block_until_ready does not sync through the axon tunnel).
 """
 
 import json
+import sys
 import time
 
 import numpy as np
 
 
-def main():
+def _throughput(tr, shape, nclass, batch, steps=30):
     import jax
     import jax.numpy as jnp
-    from cxxnet_tpu.models import alexnet_trainer
     from cxxnet_tpu.io.data import DataBatch
-
-    batch = 256
-    # bf16 mixed precision is the TPU-native recipe: activations and layer
-    # params run the MXU's native dtype, master weights/optimizer stay f32
-    tr = alexnet_trainer(batch_size=batch, input_hw=227, dev="tpu",
-                         extra_cfg="eval_train = 0\n"
-                                   "compute_dtype = bfloat16\n")
 
     rs = np.random.RandomState(0)
     b = DataBatch()
-    # device-resident batch: steady-state assumes prefetch overlaps H2D
-    b.data = jax.device_put(rs.rand(batch, 3, 227, 227).astype(np.float32))
+    b.data = jax.device_put(rs.rand(batch, *shape).astype(np.float32))
     b.label = jax.device_put(
-        rs.randint(0, 1000, (batch, 1)).astype(np.float32))
+        rs.randint(0, nclass, (batch, 1)).astype(np.float32))
     b.batch_size = batch
-
-    # warmup / compile
     for _ in range(3):
         tr.update(b)
-    float(jnp.sum(tr.params[0]["bias"]))  # full sync
-
-    steps = 30
+    sync_key = next(iter(tr.params[0]))
+    float(jnp.sum(tr.params[0][sync_key]))  # full sync
     t0 = time.perf_counter()
     for _ in range(steps):
         tr.update(b)
-    float(jnp.sum(tr.params[0]["bias"]))  # full sync
-    dt = time.perf_counter() - t0
+    float(jnp.sum(tr.params[0][sync_key]))
+    return steps * batch / (time.perf_counter() - t0)
 
-    ips = steps * batch / dt
-    out = {
+
+BF16 = "eval_train = 0\ncompute_dtype = bfloat16\n"
+
+
+def bench_alexnet():
+    from cxxnet_tpu.models import alexnet_trainer
+    batch = 256
+    tr = alexnet_trainer(batch_size=batch, input_hw=227, dev="tpu",
+                         extra_cfg=BF16)
+    ips = _throughput(tr, (3, 227, 227), 1000, batch)
+    return {
         "metric": "alexnet_imagenet_images_per_sec_per_chip",
         "value": round(ips, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(ips / 2000.0, 4),
     }
-    print(json.dumps(out))
+
+
+def bench_googlenet():
+    from cxxnet_tpu.models import googlenet_trainer
+    batch = 128
+    tr = googlenet_trainer(batch_size=batch, input_hw=224, dev="tpu",
+                           extra_cfg=BF16)
+    ips = _throughput(tr, (3, 224, 224), 1000, batch)
+    return {"metric": "googlenet_imagenet_images_per_sec_per_chip",
+            "value": round(ips, 2), "unit": "images/sec/chip",
+            "vs_baseline": round(ips / 2000.0, 4)}
+
+
+def _conf_trainer(netconfig, shape, batch, extra=""):
+    from cxxnet_tpu.nnet.trainer import Trainer
+    from cxxnet_tpu.utils.config import parse_config_string
+    conf = (netconfig +
+            "input_shape = %s\n" % ",".join(str(s) for s in shape) +
+            "batch_size = %d\ndev = tpu\neta = 0.1\n" % batch + extra)
+    tr = Trainer()
+    for k, v in parse_config_string(conf):
+        tr.set_param(k, v)
+    tr.init_model()
+    return tr
+
+
+MNIST_MLP = """
+netconfig = start
+layer[+1] = fullc:fc1
+  nhidden = 100
+  init_sigma = 0.01
+layer[+1] = sigmoid
+layer[+1] = fullc:fc2
+  nhidden = 10
+  init_sigma = 0.01
+layer[+0] = softmax
+netconfig = end
+"""
+
+MNIST_CONV = """
+netconfig = start
+layer[0->1] = conv:cv1
+  kernel_size = 3
+  pad = 1
+  stride = 2
+  nchannel = 32
+  random_type = xavier
+layer[1->2] = max_pooling
+  kernel_size = 3
+  stride = 2
+layer[2->3] = flatten
+layer[3->3] = dropout
+  threshold = 0.5
+layer[3->4] = fullc:fc1
+  nhidden = 100
+  init_sigma = 0.01
+layer[4->5] = sigmoid
+layer[5->6] = fullc:fc2
+  nhidden = 10
+  init_sigma = 0.01
+layer[6->6] = softmax
+netconfig = end
+"""
+
+BOWL = """
+netconfig = start
+layer[0->1] = conv:c1
+  kernel_size = 5
+  nchannel = 32
+  random_type = xavier
+layer[1->2] = relu
+layer[2->3] = max_pooling
+  kernel_size = 3
+  stride = 2
+layer[3->4] = conv:c2
+  kernel_size = 3
+  nchannel = 64
+  random_type = xavier
+layer[4->5] = relu
+layer[5->6] = max_pooling
+  kernel_size = 3
+  stride = 2
+layer[6->7] = flatten
+layer[7->8] = fullc:f1
+  nhidden = 256
+  random_type = xavier
+layer[8->9] = relu
+layer[9->10] = fullc:f2
+  nhidden = 121
+  random_type = xavier
+layer[10->10] = softmax
+netconfig = end
+"""
+
+
+def bench_mnist_mlp():
+    tr = _conf_trainer(MNIST_MLP, (1, 1, 784), 100, extra=BF16)
+    ips = _throughput(tr, (1, 1, 784), 10, 100, steps=100)
+    return {"metric": "mnist_mlp_images_per_sec_per_chip",
+            "value": round(ips, 2), "unit": "images/sec/chip",
+            "vs_baseline": None}
+
+
+def bench_mnist_conv():
+    tr = _conf_trainer(MNIST_CONV, (1, 28, 28), 100, extra=BF16)
+    ips = _throughput(tr, (1, 28, 28), 10, 100, steps=100)
+    return {"metric": "mnist_conv_images_per_sec_per_chip",
+            "value": round(ips, 2), "unit": "images/sec/chip",
+            "vs_baseline": None}
+
+
+def bench_bowl():
+    tr = _conf_trainer(BOWL, (3, 40, 40), 64, extra=BF16)
+    ips = _throughput(tr, (3, 40, 40), 121, 64, steps=60)
+    # reference: ~5 min to convergence on a GTX 780 (no throughput number)
+    return {"metric": "kaggle_bowl_images_per_sec_per_chip",
+            "value": round(ips, 2), "unit": "images/sec/chip",
+            "vs_baseline": None}
+
+
+def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "all":
+        for fn in (bench_mnist_mlp, bench_mnist_conv, bench_bowl,
+                   bench_googlenet):
+            print(json.dumps(fn()))
+    print(json.dumps(bench_alexnet()))
 
 
 if __name__ == "__main__":
