@@ -39,9 +39,12 @@ def create_mesh(device_ids: Optional[Sequence[int]] = None,
     devs = jax.devices()
     if device_ids:
         id_map = {d.id: d for d in devs}
-        devs = [id_map[i] for i in device_ids if i in id_map]
-        if not devs:
-            devs = jax.devices()[: len(device_ids)]
+        picked = [id_map[i] for i in device_ids if i in id_map]
+        # multi-process runs have non-contiguous global device ids (each
+        # process numbers its own block), so `dev=tpu:0-7` style specs fall
+        # back to positional selection when ids don't all resolve
+        devs = picked if len(picked) == len(device_ids) \
+            else jax.devices()[: len(device_ids)]
     if shape is None:
         shape = (len(devs),) + (1,) * (len(axes) - 1)
     arr = np.array(devs[: int(np.prod(shape))]).reshape(shape)

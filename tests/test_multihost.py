@@ -1,0 +1,95 @@
+"""Real multi-process distributed training: 2 worker processes x 4 virtual
+CPU devices = one 8-device global mesh over the Gloo CPU backend — the
+closest this sandbox gets to multi-host DCN. Validates init_distributed,
+global-mesh trainer steps, and cross-process replica consistency (the
+reference's dist-PS role, SURVEY.md §2.9 row 2)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent('''
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, %(repo)r)
+from cxxnet_tpu.parallel import init_distributed
+rank = int(sys.argv[1])
+init_distributed(%(coord)r, 2, rank)
+assert jax.process_count() == 2
+assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
+
+import numpy as np
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+from cxxnet_tpu.nnet.trainer import Trainer
+from cxxnet_tpu.utils.config import parse_config_string
+from cxxnet_tpu.io.data import DataBatch
+
+conf = """
+netconfig = start
+layer[+1] = fullc:fc1
+  nhidden = 16
+  init_sigma = 0.05
+layer[+1] = relu
+layer[+1] = fullc:fc2
+  nhidden = 10
+  init_sigma = 0.05
+layer[+0] = softmax
+netconfig = end
+input_shape = 1,1,32
+batch_size = 16
+eta = 0.1
+dev = tpu:0-7
+seed = 3
+"""
+tr = Trainer()
+for k, v in parse_config_string(conf):
+    tr.set_param(k, v)
+tr.init_model()
+assert tr.mesh is not None and tr.mesh.devices.size == 8
+
+rs = np.random.RandomState(0)  # identical global batch on both hosts
+b = DataBatch()
+b.data = rs.rand(16, 1, 1, 32).astype(np.float32)
+b.label = rs.randint(0, 10, (16, 1)).astype(np.float32)
+b.batch_size = 16
+for _ in range(5):
+    tr.update(b)
+
+# replica consistency ACROSS processes: every host's local shard of the
+# (replicated) weights must be identical — host-side allgather of numpy
+local = np.asarray(tr.params[0]["wmat"].addressable_shards[0].data)
+gathered = multihost_utils.process_allgather(local)
+assert gathered.shape[0] == 2
+np.testing.assert_array_equal(gathered[0], gathered[1])
+assert np.isfinite(gathered).all()
+print("RANK%%d_OK" %% rank)
+''')
+
+
+def test_two_process_distributed_training(tmp_path):
+    prog = WORKER % {"repo": REPO, "coord": "localhost:45683"}
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", prog, str(r)], stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, env=env) for r in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, "rank %d failed:\n%s" % (r, out[-2000:])
+        assert ("RANK%d_OK" % r) in out
