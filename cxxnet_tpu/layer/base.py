@@ -177,6 +177,13 @@ class ApplyContext:
     # device mesh of the running trainer (None single-device); layers with
     # sharded algorithms (attention w/ sequence parallelism) read it
     mesh: object = None
+    # index of the layer currently applying (its params slot); set by the
+    # net's forward loop
+    layer_index: int = -1
+    # non-gradient parameter updates recorded during the forward (batch-norm
+    # running statistics): {(layer_index, param_key): new_value}; the
+    # trainer merges them into params after the optimizer step
+    state_updates: Dict = field(default_factory=dict)
 
 
 class Layer:

@@ -696,8 +696,12 @@ class LRNLayer(Layer):
 class BatchNormLayer(Layer):
     """Batch normalization (src/layer/batch_norm_layer-inl.hpp:14).
 
-    Reference quirk reproduced deliberately: eval mode recomputes minibatch
-    statistics — there are no running averages (doc/layer.md caveat)."""
+    Reference quirk reproduced by default: eval mode recomputes minibatch
+    statistics — no running averages (doc/layer.md caveat). Opt in to
+    running statistics with ``moving_average = 1`` (+ ``bn_momentum``,
+    default 0.9): training then tracks EMA mean/var (recorded through
+    ctx.state_updates, merged into params by the trainer after the step),
+    and eval normalizes with them — making batch-1 inference sound."""
 
     type_name = "batch_norm"
 
@@ -706,6 +710,8 @@ class BatchNormLayer(Layer):
         self.init_slope = 1.0
         self.init_bias = 0.0
         self.eps = 1e-10
+        self.moving_average = 0
+        self.bn_momentum = 0.9
 
     def set_param(self, name, val):
         if name == "init_slope":
@@ -714,6 +720,10 @@ class BatchNormLayer(Layer):
             self.init_bias = float(val)
         if name == "eps":
             self.eps = float(val)
+        if name == "moving_average":
+            self.moving_average = int(val)
+        if name == "bn_momentum":
+            self.bn_momentum = float(val)
 
     def infer_shape(self, in_shapes):
         b, c, h, w = in_shapes[0]
@@ -722,30 +732,57 @@ class BatchNormLayer(Layer):
         return [in_shapes[0]]
 
     def init_params(self, rng):
-        return {"slope": np.full((self.channel,), self.init_slope, np.float32),
-                "bias": np.full((self.channel,), self.init_bias, np.float32)}
+        out = {"slope": np.full((self.channel,), self.init_slope, np.float32),
+               "bias": np.full((self.channel,), self.init_bias, np.float32)}
+        if self.moving_average:
+            out["running_mean"] = np.zeros((self.channel,), np.float32)
+            out["running_var"] = np.ones((self.channel,), np.float32)
+        return out
 
     def apply(self, params, inputs, ctx):
         x = inputs[0]
         axes = (0, 1, 2) if self.is_fc else (0, 2, 3)
         bshape = (1, 1, 1, self.channel) if self.is_fc else (1, self.channel, 1, 1)
-        mean = jnp.mean(x, axis=axes).reshape(bshape)
-        var = jnp.mean(jnp.square(x - mean), axis=axes).reshape(bshape)
+        use_running = self.moving_average and not ctx.train
+        if use_running:
+            mean = params["running_mean"].reshape(bshape).astype(x.dtype)
+            var = params["running_var"].reshape(bshape).astype(x.dtype)
+        else:
+            mean = jnp.mean(x, axis=axes).reshape(bshape)
+            var = jnp.mean(jnp.square(x - mean), axis=axes).reshape(bshape)
+        if self.moving_average and ctx.train:
+            m = self.bn_momentum
+            new_mean = (m * params["running_mean"]
+                        + (1 - m) * mean.reshape(-1).astype(jnp.float32))
+            new_var = (m * params["running_var"]
+                       + (1 - m) * var.reshape(-1).astype(jnp.float32))
+            ctx.state_updates[(ctx.layer_index, "running_mean")] = \
+                jax.lax.stop_gradient(new_mean)
+            ctx.state_updates[(ctx.layer_index, "running_var")] = \
+                jax.lax.stop_gradient(new_var)
         xhat = (x - mean) / jnp.sqrt(var + self.eps)
         slope = params["slope"].reshape(bshape)
         bias = params["bias"].reshape(bshape)
         return [xhat * slope + bias]
 
     def visit_order(self):
-        # reference visits slope under "wmat", bias under "bias"
+        # reference visits slope under "wmat", bias under "bias"; running
+        # stats are deliberately absent (no optimizer, no weight ABI)
         return [("wmat", "slope"), ("bias", "bias")]
 
     def save_model(self, w, params):
         w.write_tensor(params["slope"])
         w.write_tensor(params["bias"])
+        if self.moving_average:
+            w.write_tensor(params["running_mean"])
+            w.write_tensor(params["running_var"])
 
     def load_model(self, r):
-        return {"slope": r.read_tensor(), "bias": r.read_tensor()}
+        out = {"slope": r.read_tensor(), "bias": r.read_tensor()}
+        if self.moving_average:
+            out["running_mean"] = r.read_tensor()
+            out["running_var"] = r.read_tensor()
+        return out
 
 
 # ---------------------------------------------------------------------------

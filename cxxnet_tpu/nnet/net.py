@@ -116,11 +116,15 @@ class NeuralNet:
             values[i + 1] = jnp.asarray(ex)
         if cdt is not None:
             values = [None if v is None else v.astype(cdt) for v in values]
-            # cast through f32 master params; grads flow back in f32
-            params = jax.tree_util.tree_map(
-                lambda a: a.astype(cdt)
-                if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
-                params)
+            # cast through f32 master params; grads flow back in f32.
+            # running statistics (batch_norm moving_average) stay f32 so
+            # the EMA never accumulates bf16 rounding.
+            params = [
+                {k: (jnp.asarray(v).astype(cdt)
+                     if (jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating)
+                         and not k.startswith("running_")) else v)
+                 for k, v in p.items()}
+                for p in params]
         ctx = ApplyContext(train=train, labels=labels, epoch=epoch,
                            mesh=mesh)
         base_rng = rng if rng is not None else jax.random.PRNGKey(0)
@@ -129,6 +133,7 @@ class NeuralNet:
             pidx = (cfg.layers[i].primary_layer_index
                     if self.is_shared[i] else i)
             ctx.rng = jax.random.fold_in(base_rng, i)
+            ctx.layer_index = pidx
             ins = [values[j] for j in info.nindex_in]
             if cdt is not None and lay.is_loss:
                 # losses always in f32 (softmax/log numerics)
@@ -138,6 +143,9 @@ class NeuralNet:
                 values[j] = v
         total_loss = sum(ctx.losses) if ctx.losses else jnp.zeros(())
         self._last_pairtest_diffs = getattr(ctx, "pairtest_diffs", [])
+        # non-gradient param updates (BN running stats); valid only when
+        # read immediately after this call within the same trace
+        self._last_state_updates = ctx.state_updates
         return values, total_loss
 
     # ------------------------------------------------------------------

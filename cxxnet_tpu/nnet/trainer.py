@@ -316,7 +316,8 @@ class Trainer:
                                         mesh=self.mesh)
         eval_outs = [values[n].reshape(values[n].shape[0], -1)
                      for n in self.eval_nodes]
-        return loss, eval_outs
+        state_ups = getattr(self.net, "_last_state_updates", {})
+        return loss, (eval_outs, state_ups)
 
     def _apply_updates(self, params, grads, opt_state, epoch):
         new_params = [dict(p) for p in params]
@@ -335,7 +336,7 @@ class Trainer:
 
     def _make_train_step(self, do_update: bool, accumulate: bool):
         def step(params, opt_state, grad_accum, data, label, epoch, rng):
-            grads, eval_outs = jax.grad(
+            grads, (eval_outs, state_ups) = jax.grad(
                 self._loss_fn, has_aux=True)(params, data, label, rng, epoch)
             if accumulate:
                 grads = jax.tree.map(jnp.add, grad_accum, grads)
@@ -343,6 +344,11 @@ class Trainer:
                 params, opt_state = self._apply_updates(
                     params, grads, opt_state, epoch)
                 grads = jax.tree.map(jnp.zeros_like, grads)
+            if state_ups:
+                # non-gradient updates (BN running stats): direct assignment
+                params = [dict(p) for p in params]
+                for (i, key), val in state_ups.items():
+                    params[i][key] = val
             return params, opt_state, grads, eval_outs
 
         jitted = jax.jit(step, donate_argnums=(0, 1, 2))

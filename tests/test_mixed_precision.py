@@ -87,3 +87,68 @@ def test_checkpoint_roundtrip_preserves_dtype_config(tmp_path):
     p2 = net2.extract(x, "top[-1]")
     np.testing.assert_allclose(np.asarray(p1), np.asarray(p2),
                                rtol=1e-2, atol=1e-2)
+
+
+def test_bn_moving_average():
+    """moving_average=1: EMA running stats update during training, drive
+    eval-mode normalization (sound batch-1 inference), persist through
+    checkpoints, and stay out of the optimizer/weight ABI."""
+    CFG_MA = """
+netconfig = start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 16
+  init_sigma = 0.1
+layer[+0] = batch_norm
+  moving_average = 1
+  bn_momentum = 0.8
+layer[+1] = relu
+layer[+1:fc2] = fullc:fc2
+  nhidden = 5
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig = end
+input_shape = 1,1,12
+batch_size = 16
+eta = 0.1
+momentum = 0.0
+"""
+    rs = np.random.RandomState(0)
+    x = rs.rand(16, 12).astype(np.float32) * 3 + 1
+    y = rs.randint(0, 5, 16).astype(np.float32)
+    net = api.Net(dev="cpu", cfg=CFG_MA)
+    net.init_model()
+    bn = 1  # layer index of batch_norm
+    rm0 = np.asarray(net.net_.params[bn]["running_mean"]).copy()
+    for _ in range(200):
+        net.update(x, y)
+    rm = np.asarray(net.net_.params[bn]["running_mean"])
+    assert not np.allclose(rm, rm0), "running stats must move"
+    assert (net.predict(x) == y).mean() == 1.0
+    # eval uses the running stats: batch-1 output must equal the same row
+    # from a full-batch eval (pure batch-stats BN would differ wildly)
+    full = np.asarray(net.extract(x, "top[-1]")).reshape(16, -1)
+    one = np.asarray(net.extract(x[:1], "top[-1]")).reshape(1, -1)
+    np.testing.assert_allclose(one[0], full[0], rtol=1e-5, atol=1e-6)
+
+
+def test_bn_default_matches_reference_quirk():
+    """Default BN (no moving_average): eval recomputes batch statistics, so
+    there are no running_* params (reference behavior preserved)."""
+    CFG_REF = """
+netconfig = start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 8
+  init_sigma = 0.1
+layer[+0] = batch_norm
+layer[+1:fc2] = fullc:fc2
+  nhidden = 5
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig = end
+input_shape = 1,1,12
+batch_size = 8
+eta = 0.1
+"""
+    net = api.Net(dev="cpu", cfg=CFG_REF)
+    net.init_model()
+    assert "running_mean" not in net.net_.params[1]
