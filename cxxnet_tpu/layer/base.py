@@ -227,6 +227,13 @@ class Layer:
     def visit_order(self) -> List[Tuple[str, str]]:
         return []
 
+    # non-trainable state param keys (BN running stats and the like):
+    # excluded from visit_order BY the layer, skipped by the bf16 compute
+    # cast, updated through ctx.state_updates — declare them here so the
+    # contract lives in one place
+    def state_keys(self) -> Tuple[str, ...]:
+        return ()
+
 
 def check(cond: bool, msg: str, *args) -> None:
     """Fail-fast invariant check (reference utils::Check, src/utils/utils.h)."""

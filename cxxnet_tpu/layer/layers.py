@@ -752,14 +752,19 @@ class BatchNormLayer(Layer):
             var = jnp.mean(jnp.square(x - mean), axis=axes).reshape(bshape)
         if self.moving_average and ctx.train:
             m = self.bn_momentum
-            new_mean = (m * params["running_mean"]
+            # chain off any pending update so weight-shared BN folds every
+            # shared application's batch stats into the EMA, not just the
+            # last one
+            km, kv = ((ctx.layer_index, "running_mean"),
+                      (ctx.layer_index, "running_var"))
+            base_mean = ctx.state_updates.get(km, params["running_mean"])
+            base_var = ctx.state_updates.get(kv, params["running_var"])
+            new_mean = (m * base_mean
                         + (1 - m) * mean.reshape(-1).astype(jnp.float32))
-            new_var = (m * params["running_var"]
+            new_var = (m * base_var
                        + (1 - m) * var.reshape(-1).astype(jnp.float32))
-            ctx.state_updates[(ctx.layer_index, "running_mean")] = \
-                jax.lax.stop_gradient(new_mean)
-            ctx.state_updates[(ctx.layer_index, "running_var")] = \
-                jax.lax.stop_gradient(new_var)
+            ctx.state_updates[km] = jax.lax.stop_gradient(new_mean)
+            ctx.state_updates[kv] = jax.lax.stop_gradient(new_var)
         xhat = (x - mean) / jnp.sqrt(var + self.eps)
         slope = params["slope"].reshape(bshape)
         bias = params["bias"].reshape(bshape)
@@ -769,6 +774,9 @@ class BatchNormLayer(Layer):
         # reference visits slope under "wmat", bias under "bias"; running
         # stats are deliberately absent (no optimizer, no weight ABI)
         return [("wmat", "slope"), ("bias", "bias")]
+
+    def state_keys(self):
+        return ("running_mean", "running_var") if self.moving_average else ()
 
     def save_model(self, w, params):
         w.write_tensor(params["slope"])

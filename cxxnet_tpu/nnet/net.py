@@ -117,14 +117,14 @@ class NeuralNet:
         if cdt is not None:
             values = [None if v is None else v.astype(cdt) for v in values]
             # cast through f32 master params; grads flow back in f32.
-            # running statistics (batch_norm moving_average) stay f32 so
-            # the EMA never accumulates bf16 rounding.
+            # non-trainable state (layer.state_keys(), e.g. BN running
+            # stats) stays f32 so EMAs never accumulate bf16 rounding.
             params = [
                 {k: (jnp.asarray(v).astype(cdt)
                      if (jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating)
-                         and not k.startswith("running_")) else v)
+                         and k not in self.layers[i].state_keys()) else v)
                  for k, v in p.items()}
-                for p in params]
+                for i, p in enumerate(params)]
         ctx = ApplyContext(train=train, labels=labels, epoch=epoch,
                            mesh=mesh)
         base_rng = rng if rng is not None else jax.random.PRNGKey(0)

@@ -265,8 +265,11 @@ class Trainer:
                 if new_info.name == old_info.name:
                     if self.silent == 0:
                         print("Copying layer %s" % old_info.name)
-                    self.params[j] = {k: jnp.asarray(v)
-                                      for k, v in old_params[i].items()}
+                    # merge, don't replace: init_model may have created
+                    # state keys (BN running stats) the old model lacks
+                    self.params[j].update(
+                        {k: jnp.asarray(v)
+                         for k, v in old_params[i].items()})
         self._init_opt()
 
     # ------------------------------------------------------------------

@@ -152,3 +152,44 @@ eta = 0.1
     net = api.Net(dev="cpu", cfg=CFG_REF)
     net.init_model()
     assert "running_mean" not in net.net_.params[1]
+
+
+def test_bn_finetune_from_model_without_stats(tmp_path):
+    """Finetuning a moving_average=1 config from a checkpoint saved without
+    running stats must keep the freshly initialized stats (merge, not
+    replace) and train without error."""
+    base_cfg = """
+netconfig = start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 8
+  init_sigma = 0.1
+layer[+0:bn1] = batch_norm:bn1
+layer[+1:fc2] = fullc:fc2
+  nhidden = 5
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig = end
+input_shape = 1,1,12
+batch_size = 8
+eta = 0.1
+"""
+    net = api.Net(dev="cpu", cfg=base_cfg)
+    net.init_model()
+    path = str(tmp_path / "nostats.model")
+    net.save_model(path)
+
+    from cxxnet_tpu.learn_task import LearnTask
+    from cxxnet_tpu.utils import serializer
+    ft_cfg = base_cfg.replace("layer[+0:bn1] = batch_norm:bn1",
+                              "layer[+0:bn1] = batch_norm:bn1\n"
+                              "  moving_average = 1")
+    net2 = api.Net(dev="cpu", cfg=ft_cfg)
+    net2.init_model()
+    with open(path, "rb") as f:
+        r = serializer.Reader(f)
+        r.read_int32()  # net_type
+        net2.net_.copy_model_from(r)
+    assert "running_mean" in net2.net_.params[1]
+    x = np.random.RandomState(0).rand(8, 12).astype(np.float32)
+    y = np.zeros(8, np.float32)
+    net2.update(x, y)  # must not KeyError
