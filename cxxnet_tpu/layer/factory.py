@@ -46,6 +46,8 @@ kPRelu = 29
 kBatchNorm = 30
 kFixConnect = 31
 kAttention = 32
+kEmbed = 33
+kAdd = 34
 kPairTestGap = 1024
 
 _NAME2TYPE = {
@@ -77,6 +79,8 @@ _NAME2TYPE = {
     "prelu": kPRelu,
     "batch_norm": kBatchNorm,
     "attention": kAttention,
+    "embed": kEmbed,
+    "add": kAdd,
 }
 
 _TYPE2CLS = {
@@ -108,6 +112,8 @@ _TYPE2CLS = {
     kPRelu: L.PReluLayer,
     kBatchNorm: L.BatchNormLayer,
     kAttention: L.AttentionLayer,
+    kEmbed: L.EmbedLayer,
+    kAdd: L.AddLayer,
 }
 
 

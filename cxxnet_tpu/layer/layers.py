@@ -848,9 +848,23 @@ class LossLayerBase(Layer):
 
 class SoftmaxLayer(LossLayerBase):
     """Softmax + cross-entropy (src/layer/loss/softmax_layer-inl.hpp:12).
-    grad = (p - onehot(label)) * scale == d/dlogits of scale * sum_i CE_i."""
+    grad = (p - onehot(label)) * scale == d/dlogits of scale * sum_i CE_i.
+
+    ``seq = 1`` (beyond the reference) switches to per-position CE for
+    sequence nodes (b, vocab, 1, L): softmax over the channel (vocab) dim at
+    every position, with the target field carrying L labels per row — the
+    language-modeling loss for the attention stack."""
 
     type_name = "softmax"
+
+    def __init__(self):
+        super().__init__()
+        self.seq = 0
+
+    def set_param(self, name, val):
+        super().set_param(name, val)
+        if name == "seq":
+            self.seq = int(val)
 
     def transform(self, x2d):
         return jax.nn.softmax(x2d, axis=-1)
@@ -860,6 +874,25 @@ class SoftmaxLayer(LossLayerBase):
         idx = label[:, 0].astype(jnp.int32)
         ce = -jnp.take_along_axis(logp, idx[:, None], axis=1)[:, 0]
         return jnp.sum(ce) * self._scale()
+
+    def apply(self, params, inputs, ctx):
+        if not self.seq:
+            return super().apply(params, inputs, ctx)
+        x = inputs[0]
+        b, v, h, L = x.shape
+        check(h == 1, "softmax seq=1 needs a (batch, vocab, 1, seq) node")
+        logits = x.reshape(b, v, L).transpose(0, 2, 1)     # (b, L, v)
+        out = jax.nn.softmax(logits, axis=-1)
+        if ctx.labels is not None:
+            label = ctx.labels.field(self.target)          # (b, L)
+            check(label.shape[1] == L,
+                  "softmax seq=1: label field width %d != seq length %d"
+                  % (label.shape[1], L))
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            idx = label.astype(jnp.int32)[..., None]
+            ce = -jnp.take_along_axis(logp, idx, axis=2)[..., 0]
+            ctx.losses.append(jnp.sum(ce) / L * self._scale())
+        return [out.transpose(0, 2, 1).reshape(b, v, 1, L)]
 
 
 class L2LossLayer(LossLayerBase):
@@ -985,3 +1018,92 @@ class AttentionLayer(Layer):
         out = out.transpose(0, 2, 1, 3).reshape(b, L, d)      # merge heads
         out = jnp.dot(out, params["wo"])
         return [out.transpose(0, 2, 1).reshape(b, d, 1, L)]
+
+
+class EmbedLayer(Layer):
+    """Token embedding (beyond the reference — the sequence-model front
+    end): input node (b, 1, 1, L) of token ids (stored as floats, the
+    framework's label convention), output (b, nhidden, 1, L) of embedding
+    vectors. Weight (vocab_size, nhidden) under the standard 'wmat' tag.
+    Gradients flow through jnp.take's scatter-add transpose."""
+
+    type_name = "embed"
+
+    def __init__(self):
+        super().__init__()
+        self.vocab_size = 0
+        self.pos_embed = 0
+        self._seq_len = 0
+
+    def set_param(self, name, val):
+        super().set_param(name, val)
+        if name == "vocab_size":
+            self.vocab_size = int(val)
+        if name == "pos_embed":
+            self.pos_embed = int(val)
+
+    def infer_shape(self, in_shapes):
+        check(len(in_shapes) == 1, "EmbedLayer only support 1-1 connection")
+        b, c, h, L = in_shapes[0]
+        check(c == 1 and h == 1,
+              "embed input must be (batch, 1, 1, seq) token ids")
+        check(self.vocab_size > 0, "must set vocab_size")
+        check(self.param.num_hidden > 0, "must set nhidden (embedding dim)")
+        self._seq_len = L
+        return [(b, self.param.num_hidden, 1, L)]
+
+    def init_params(self, rng):
+        d = self.param.num_hidden
+        out = {"wmat": self.param.rand_init_weight(
+            rng, (self.vocab_size, d), in_num=self.vocab_size, out_num=d)}
+        if self.pos_embed:
+            # learned positional embedding, zero-init (pos_embed = 1)
+            out["pos"] = np.zeros((self._seq_len, d), np.float32)
+        return out
+
+    def save_model(self, w, params):
+        self.param.save(w)
+        w.write_tensor(params["wmat"])
+        if self.pos_embed:
+            w.write_tensor(params["pos"])
+
+    def load_model(self, r):
+        self.param.load(r)
+        out = {"wmat": r.read_tensor()}
+        if self.pos_embed:
+            out["pos"] = r.read_tensor()
+        return out
+
+    def visit_order(self):
+        if self.pos_embed:
+            return [("wmat", "wmat"), ("bias", "pos")]
+        return [("wmat", "wmat")]
+
+    def apply(self, params, inputs, ctx):
+        x = inputs[0]
+        b, _, _, L = x.shape
+        ids = x.reshape(b, L).astype(jnp.int32)
+        emb = jnp.take(params["wmat"], ids, axis=0)        # (b, L, d)
+        if self.pos_embed:
+            emb = emb + params["pos"]
+        return [emb.transpose(0, 2, 1).reshape(b, -1, 1, L)]
+
+
+class AddLayer(Layer):
+    """Elementwise sum of 2-4 same-shaped inputs (beyond the reference,
+    which only ships concat): the residual-connection primitive for
+    transformer stacks. Backward broadcasts the gradient to every input."""
+
+    type_name = "add"
+
+    def infer_shape(self, in_shapes):
+        check(2 <= len(in_shapes) <= 4, "AddLayer takes 2-4 inputs")
+        for s in in_shapes[1:]:
+            check(s == in_shapes[0], "add: input shapes must all match")
+        return [in_shapes[0]]
+
+    def apply(self, params, inputs, ctx):
+        out = inputs[0]
+        for x in inputs[1:]:
+            out = out + x
+        return [out]

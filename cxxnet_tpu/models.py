@@ -180,7 +180,7 @@ def inception_trainer(batch_size: int = 16, input_hw: int = 16,
     conf = (inception_small_netconfig(n_blocks=n_blocks) +
             "input_shape = 3,%d,%d\n" % (input_hw, input_hw) +
             "batch_size = %d\n" % batch_size +
-            "eta = 0.05\nmomentum = 0.0\n" +
+            "updater = adam\neta = 0.003\n" +
             "dev = %s\n" % dev + extra_cfg)
     tr = Trainer()
     for k, v in parse_config_string(conf):
@@ -335,6 +335,74 @@ def googlenet_trainer(batch_size: int = 128, input_hw: int = 224,
             "input_shape = 3,%d,%d\n" % (input_hw, input_hw) +
             "batch_size = %d\n" % batch_size +
             "eta = 0.01\nmomentum = 0.9\nwd = 0.0002\n" +
+            "dev = %s\n" % dev + extra_cfg)
+    tr = Trainer()
+    for k, v in parse_config_string(conf):
+        tr.set_param(k, v)
+    tr.init_model()
+    return tr
+
+
+def transformer_lm_netconfig(vocab: int, dim: int = 64, nhead: int = 4,
+                             nlayer: int = 2, ffn_mult: int = 2) -> str:
+    """Decoder-only transformer LM from the netconfig DSL (beyond the
+    reference — the long-context model family): embed -> n x [causal
+    attention + residual, 1x1-conv FFN + residual] -> vocab head ->
+    per-position softmax (seq = 1). Residuals use the `add` layer."""
+    txt = """
+netconfig = start
+layer[+1:emb] = embed:emb
+  vocab_size = %d
+  nhidden = %d
+  pos_embed = 1
+  init_sigma = 0.05
+""" % (vocab, dim)
+    node = "emb"
+    for i in range(nlayer):
+        p = "blk%d" % i
+        txt += """
+layer[%(in)s->%(p)satt] = attention:%(p)s_att
+  nhead = %(nh)d
+  causal = 1
+  init_sigma = 0.05
+layer[%(in)s,%(p)satt->%(p)sres1] = add
+layer[%(p)sres1->%(p)sf1] = conv:%(p)s_ffn1
+  kernel_size = 1
+  nchannel = %(ffn)d
+  init_sigma = 0.05
+layer[%(p)sf1->%(p)sr] = relu
+layer[%(p)sr->%(p)sf2] = conv:%(p)s_ffn2
+  kernel_size = 1
+  nchannel = %(dim)d
+  init_sigma = 0.05
+layer[%(p)sres1,%(p)sf2->%(p)sout] = add
+""" % {"in": node, "p": p, "nh": nhead, "dim": dim, "ffn": ffn_mult * dim}
+        node = p + "out"
+    txt += """
+layer[%s->logits] = conv:head
+  kernel_size = 1
+  nchannel = %d
+  init_sigma = 0.05
+layer[+0] = softmax
+  seq = 1
+netconfig = end
+metric = seq
+""" % (node, vocab)
+    # `metric = seq` is not a metric — strip it; kept minimal
+    txt = txt.replace("metric = seq\n", "")
+    return txt
+
+
+def transformer_lm_trainer(vocab: int = 50, seq: int = 16,
+                           batch_size: int = 8, dim: int = 64,
+                           nhead: int = 4, nlayer: int = 2,
+                           dev: str = "cpu", extra_cfg: str = "") -> Trainer:
+    conf = (transformer_lm_netconfig(vocab, dim=dim, nhead=nhead,
+                                     nlayer=nlayer) +
+            "input_shape = 1,1,%d\n" % seq +
+            "batch_size = %d\n" % batch_size +
+            "label_vec[0,%d) = label\n" % seq +
+            "updater = adam\neta = 0.003\n" +
             "dev = %s\n" % dev + extra_cfg)
     tr = Trainer()
     for k, v in parse_config_string(conf):
