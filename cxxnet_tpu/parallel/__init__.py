@@ -23,4 +23,4 @@ from .tensor import (column_parallel_dense, expert_parallel_ffn,  # noqa: F401
                      fullc_sharding, row_parallel_dense)
 from .pipeline import pipeline_apply, stage_sharding  # noqa: F401
 from .multihost import (create_hybrid_mesh, init_distributed,  # noqa: F401
-                        worker_shard_params)
+                        virtual_cpu_env, worker_shard_params)

@@ -21,6 +21,22 @@ import jax
 from jax.sharding import Mesh
 
 
+def virtual_cpu_env(n_devices: int, base_env=None) -> dict:
+    """Environment for a subprocess that should see an n-device virtual CPU
+    backend (the sandbox stand-in for a real multi-chip slice; see
+    tests/conftest.py). Starts from ``base_env`` (default: os.environ),
+    forces JAX_PLATFORMS=cpu, and replaces any existing
+    ``xla_force_host_platform_device_count`` flag while preserving other
+    XLA_FLAGS. Must be applied before the child imports jax."""
+    env = dict(os.environ if base_env is None else base_env)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append("--xla_force_host_platform_device_count=%d" % n_devices)
+    env["XLA_FLAGS"] = " ".join(flags)
+    return env
+
+
 def init_distributed(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
                      process_id: Optional[int] = None) -> None:

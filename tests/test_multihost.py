@@ -15,8 +15,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 WORKER = textwrap.dedent('''
 import os, sys
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+# JAX_PLATFORMS / XLA_FLAGS come from the parent via virtual_cpu_env(4)
 import jax
 jax.config.update("jax_platforms", "cpu")
 sys.path.insert(0, %(repo)r)
@@ -77,7 +76,8 @@ print("RANK%%d_OK" %% rank)
 
 def test_two_process_distributed_training(tmp_path):
     prog = WORKER % {"repo": REPO, "coord": "localhost:45683"}
-    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    from cxxnet_tpu.parallel import virtual_cpu_env
+    env = virtual_cpu_env(4)
     procs = [subprocess.Popen(
         [sys.executable, "-c", prog, str(r)], stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT, text=True, env=env) for r in range(2)]
