@@ -36,14 +36,19 @@ def _throughput(tr, shape, nclass, batch, steps=30):
     b.label = jax.device_put(
         rs.randint(0, nclass, (batch, 1)).astype(np.float32))
     b.batch_size = batch
+    def sync():
+        # value-fetch of the first param tensor (first layer may be
+        # weightless) forces a sync through the tunnel
+        # (block_until_ready does not)
+        float(jnp.sum(next(v for p in tr.params for v in p.values())))
+
     for _ in range(3):
         tr.update(b)
-    sync_key = next(iter(tr.params[0]))
-    float(jnp.sum(tr.params[0][sync_key]))  # full sync
+    sync()
     t0 = time.perf_counter()
     for _ in range(steps):
         tr.update(b)
-    float(jnp.sum(tr.params[0][sync_key]))
+    sync()
     return steps * batch / (time.perf_counter() - t0)
 
 

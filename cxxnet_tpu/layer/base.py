@@ -192,6 +192,10 @@ class Layer:
     type_name = "none"
     self_loop = False      # reference self-loop layers: in node == out node
     is_loss = False
+    # True when inputs are integer ids stored as floats (embed): such nodes
+    # must never be cast to a low-precision compute dtype — bf16 cannot
+    # represent ids above ~256 exactly
+    integer_inputs = False
 
     def __init__(self):
         self.param = LayerParam()

@@ -1028,6 +1028,7 @@ class EmbedLayer(Layer):
     Gradients flow through jnp.take's scatter-add transpose."""
 
     type_name = "embed"
+    integer_inputs = True
 
     def __init__(self):
         super().__init__()

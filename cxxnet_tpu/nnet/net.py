@@ -115,7 +115,25 @@ class NeuralNet:
         for i, ex in enumerate(extra_data):
             values[i + 1] = jnp.asarray(ex)
         if cdt is not None:
-            values = [None if v is None else v.astype(cdt) for v in values]
+            # token-id nodes (inputs of integer_inputs layers, e.g. embed)
+            # stay f32: bf16 corrupts ids above ~256. Walk producers
+            # transitively so ids routed through pass-through layers
+            # (split/concat) are protected at the graph input too.
+            id_nodes = set()
+            for i, info in enumerate(cfg.layers):
+                if self.layers[i].integer_inputs:
+                    id_nodes.update(info.nindex_in)
+            changed = bool(id_nodes)
+            while changed:
+                changed = False
+                for info in cfg.layers:
+                    if any(o in id_nodes for o in info.nindex_out):
+                        new = set(info.nindex_in) - id_nodes
+                        if new:
+                            id_nodes |= new
+                            changed = True
+            values = [v if v is None or i in id_nodes else v.astype(cdt)
+                      for i, v in enumerate(values)]
             # cast through f32 master params; grads flow back in f32.
             # non-trainable state (layer.state_keys(), e.g. BN running
             # stats) stays f32 so EMAs never accumulate bf16 rounding.

@@ -136,7 +136,11 @@ lrn.defvjp(_lrn_fwd, _lrn_bwd)
 # RReLU (insanity layer) with in-kernel PRNG
 # ---------------------------------------------------------------------------
 def _uniform_kernel(seed_ref, u_ref):
-    pltpu.prng_seed(seed_ref[0])
+    # one grid step = one (block_rows, 128) tile; re-seed per block so each
+    # tile draws an independent stream and the whole array never has to fit
+    # in VMEM at once. prng_seed hashes its operands, so (seed, block) pairs
+    # never alias across neighboring seeds the way seed+block would.
+    pltpu.prng_seed(seed_ref[0], pl.program_id(0))
     # prng_random_bits yields int32; shift logically as uint32, then bitcast
     # back to int32 (top byte now zero) since Mosaic can't cast uint32->f32.
     # 24 high bits -> exact float32 uniform [0, 1) ladder.
@@ -155,15 +159,20 @@ def uniform(seed, shape, dtype=jnp.float32) -> jnp.ndarray:
         raise RuntimeError(
             "pallas uniform needs TPU support (jax.experimental.pallas.tpu)")
     flat = int(np.prod(shape))
-    # pad the flat draw up to a (rows, 128) lane tile
+    # pad the flat draw up to a (rows, 128) lane tile, then grid over row
+    # blocks so VMEM holds one ~1 MB tile at a time regardless of total size
     cols = 128
     rows = -(-flat // cols)
+    block_rows = min(rows, 2048)
+    grid = -(-rows // block_rows)
     seed_arr = jnp.asarray([seed], jnp.int32).reshape((1,))
     u = pl.pallas_call(
         _uniform_kernel,
+        grid=(grid,),
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((rows, cols), dtype),
+        out_specs=pl.BlockSpec((block_rows, cols), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((grid * block_rows, cols), dtype),
     )(seed_arr)
     return u.reshape(-1)[:flat].reshape(shape)
 

@@ -193,3 +193,42 @@ eta = 0.1
     x = np.random.RandomState(0).rand(8, 12).astype(np.float32)
     y = np.zeros(8, np.float32)
     net2.update(x, y)  # must not KeyError
+
+
+EMBED_CFG = """
+netconfig = start
+layer[+1:emb] = embed:emb
+  vocab_size = 2000
+  nhidden = 8
+  init_sigma = 0.05
+layer[+1] = flatten
+layer[+1:fc] = fullc:fc
+  nhidden = 5
+  init_sigma = 0.05
+layer[+0] = softmax
+netconfig = end
+input_shape = 1,1,4
+batch_size = 8
+eta = 0.1
+compute_dtype = bfloat16
+"""
+
+
+def test_bf16_embed_ids_not_corrupted():
+    """Token-id input nodes must be exempt from the bf16 compute cast:
+    bf16 has 8 mantissa bits, so ids above ~256 would silently round to a
+    neighboring vocab row (e.g. 1003 -> 1000)."""
+    net = api.Net(dev="cpu", cfg=EMBED_CFG)
+    net.init_model()
+    nn = net.net_.net
+    # ids chosen to be non-representable in bf16
+    ids = np.array([[259, 511, 777, 1003],
+                    [1999, 1285, 515, 257]] * 4, np.float32)
+    x = ids.reshape(8, 1, 1, 4)
+    values, _ = nn.forward(net.net_.params, x, train=False)
+    emb = np.asarray(values[1], np.float32)     # (b, 8, 1, 4)
+    wmat = np.asarray(net.net_.params[0]["wmat"], np.float32)
+    want = wmat[ids.astype(np.int64)]           # (b, 4, 8)
+    got = np.moveaxis(emb[:, :, 0, :], 1, 2)    # (b, 4, 8)
+    np.testing.assert_allclose(
+        got, want.astype(jnp.bfloat16).astype(np.float32), atol=1e-6)

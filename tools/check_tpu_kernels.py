@@ -43,6 +43,20 @@ def main():
     assert not np.array_equal(u, u3), "different seed must differ"
     print("uniform kernel: OK (mean=%.4f var=%.4f)" % (u.mean(), u.var()))
 
+    # --- uniform at conv-activation scale: must exceed VMEM (~16 MB) and
+    # still compile thanks to the row-block grid ---
+    big_shape = (64, 96, 55, 55)  # ~74 MB f32, AlexNet conv1-sized
+    ub = np.asarray(jax.jit(
+        lambda s: pallas_kernels.uniform(s, big_shape))(jnp.int32(11)))
+    assert 0.0 <= ub.min() and ub.max() < 1.0
+    assert abs(ub.mean() - 0.5) < 2e-3, ub.mean()
+    # per-block reseeding must not repeat the stream across blocks
+    flat = ub.reshape(-1)
+    assert not np.array_equal(flat[: 2048 * 128],
+                              flat[2048 * 128: 2 * 2048 * 128])
+    print("uniform kernel large (%.0f MB): OK (mean=%.4f)"
+          % (ub.nbytes / 1e6, ub.mean()))
+
     # --- insanity layer train path through the on-core mask ---
     lay = layers.InsanityLayer()
     lay.set_param("lb", "5")

@@ -53,7 +53,11 @@ bool EnsurePython() {
   static std::once_flag once;
   static bool ok = false;
   std::call_once(once, [] {
-    if (!Py_IsInitialized()) Py_InitializeEx(0);
+    bool first_init = false;
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      first_init = true;
+    }
     PyGILState_STATE gil = PyGILState_Ensure();
     const char *bootstrap =
         "import os, sys\n"
@@ -66,17 +70,21 @@ bool EnsurePython() {
         "    jax.config.update('jax_platforms', _plat)\n";
     if (PyRun_SimpleString(bootstrap) != 0) {
       SetError("bootstrap failed");
-      PyGILState_Release(gil);
-      return;
+    } else {
+      g_api = PyImport_ImportModule("cxxnet_tpu.api");
+      if (!g_api) {
+        CapturePyError("import cxxnet_tpu.api");
+      } else {
+        ok = true;
+      }
     }
-    g_api = PyImport_ImportModule("cxxnet_tpu.api");
-    if (!g_api) {
-      CapturePyError("import cxxnet_tpu.api");
-      PyGILState_Release(gil);
-      return;
-    }
-    ok = true;
     PyGILState_Release(gil);
+    /* Py_InitializeEx leaves the GIL held by the initializing thread. If we
+       did the init, hand it back so (a) GilGuard entry points work from any
+       embedder thread and (b) Python worker threads (imgbinx decode pool)
+       run while the host app is outside wrapper calls. An embedder that
+       initialized Python itself manages its own GIL — don't touch it. */
+    if (first_init) (void)PyEval_SaveThread();
   });
   return ok;
 }
@@ -148,9 +156,16 @@ PyObject *MakeArray(const cxn_real_t *data, const cxn_uint *shape, int ndim) {
       PyObject *shp = PyTuple_New(ndim);
       for (int i = 0; i < ndim; ++i)
         PyTuple_SET_ITEM(shp, i, PyLong_FromLong(long(shape[i])));
-      arr = Call(flat, "reshape", Py_BuildValue("(O)", shp));
+      PyObject *view = Call(flat, "reshape", Py_BuildValue("(O)", shp));
       Py_DECREF(shp);
       Py_DECREF(flat);
+      if (view) {
+        /* the trainer dispatches asynchronously (device_put may read the
+           host buffer after this call returns), so the array must own its
+           data — the ABI lets the caller free the buffer immediately */
+        arr = Call(view, "copy", PyTuple_New(0));
+        Py_DECREF(view);
+      }
     } else {
       CapturePyError("numpy.frombuffer");
     }

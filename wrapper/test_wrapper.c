@@ -6,11 +6,14 @@
  * success, prints FAIL + nonzero otherwise. Run with CXXNET_TPU_ROOT set
  * to the repo and (optionally) CXXNET_JAX_PLATFORM=cpu.
  */
+#define _GNU_SOURCE /* pthread_timedjoin_np */
 #include "cxxnet_wrapper.h"
 
+#include <pthread.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
+#include <time.h>
 
 #define CHECK(cond, msg)                                   \
   do {                                                     \
@@ -113,12 +116,60 @@ static int run_batch_leg(void) {
   return 0;
 }
 
+/* Second-thread leg: the ABI promises every entry point takes the GIL, so a
+ * thread other than the one that initialized Python must be able to call in
+ * (the embedded interpreter hands the GIL back after bootstrap). A hang here
+ * means the init thread never released its base GIL hold. */
+struct thread_arg {
+  void *net;
+  const cxn_real_t *data;
+  const cxn_uint *dshape;
+  int ok;
+};
+
+static void *predict_thread(void *p) {
+  struct thread_arg *a = (struct thread_arg *)p;
+  cxn_uint npred = 0;
+  const cxn_real_t *pred = CXNNetPredictBatch(a->net, a->data, a->dshape,
+                                              &npred);
+  a->ok = (pred != NULL && npred == a->dshape[0]);
+  return NULL;
+}
+
+static int run_thread_leg(void) {
+  const int kBatch = 20, kFeat = 64;
+  static cxn_real_t data[20 * 64];
+  for (int i = 0; i < kBatch * kFeat; ++i)
+    data[i] = (cxn_real_t)(i % 97) / 97.0f;
+  const cxn_uint dshape[4] = {20, 1, 1, 64};
+
+  void *net = CXNNetCreate("cpu", kNetCfg);
+  CHECK(net != NULL, "CXNNetCreate (thread leg)");
+  CHECK(CXNNetInitModel(net) == 0, "InitModel (thread leg)");
+
+  struct thread_arg arg = {net, data, dshape, 0};
+  pthread_t th;
+  CHECK(pthread_create(&th, NULL, predict_thread, &arg) == 0,
+        "pthread_create");
+  struct timespec deadline;
+  clock_gettime(CLOCK_REALTIME, &deadline);
+  deadline.tv_sec += 120;
+  CHECK(pthread_timedjoin_np(th, NULL, &deadline) == 0,
+        "second thread deadlocked in wrapper entry point (GIL not released "
+        "after init)");
+  CHECK(arg.ok, "predict from second thread");
+  CXNNetFree(net);
+  fprintf(stderr, "C WRAPPER THREAD LEG PASSED\n");
+  return 0;
+}
+
 /* Iterator-ABI leg, enabled when argv[1] = path to an mnist data dir
  * (idx .gz files named as in example/MNIST). */
 static int run_iter_leg(const char *dir);
 
 int main(int argc, char **argv) {
   int rc = run_batch_leg();
+  if (rc == 0) rc = run_thread_leg();
   if (rc == 0 && argc > 1) rc = run_iter_leg(argv[1]);
   return rc;
 }
