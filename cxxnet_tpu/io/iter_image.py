@@ -62,6 +62,11 @@ class _ListReader:
         self.idx = 0
         self.f = open(self.paths[0])
 
+    def close(self):
+        if self.f is not None:
+            self.f.close()
+            self.f = None
+
     def next_record(self):
         while True:
             line = self.f.readline()
@@ -107,6 +112,19 @@ class ImagePageIterator(IIterator):
         self._pool = None
         self._pending = None
         self._lst_done = False
+        # shuffle=1 (reference iter_thread_imbin_x-inl.hpp:161-195,253-286):
+        # part-file order is re-permuted every epoch, and instances are
+        # shuffled within a seeded sliding window (the TPU-first analog of
+        # the reference's within-page inst_order shuffle — same locality,
+        # but independent of the physical page size and identical across
+        # the native/Python readers). seed_data seeds the stream; the
+        # window advances across epochs so every epoch draws a new order.
+        self.shuffle = 0
+        self.seed_data = 0
+        self.shuffle_window = 1024
+        self._rnd = None
+        self._window: List = []
+        self._part_order: List[int] = []
 
     def set_param(self, name, val):
         if name == "image_list":
@@ -131,6 +149,12 @@ class ImagePageIterator(IIterator):
             self.decode_thread = int(val)
         if name == "buffer_size":
             self.buffer_size = int(val)
+        if name == "shuffle":
+            self.shuffle = int(val)
+        if name == "seed_data":
+            self.seed_data = int(val)
+        if name == "shuffle_window":
+            self.shuffle_window = int(val)
 
     def _parse_image_conf(self):
         """Multi-part list + distributed sharding
@@ -163,32 +187,51 @@ class ImagePageIterator(IIterator):
         if self.silent == 0:
             print("ImagePageIterator: image_list=%s, bin=%s" %
                   (",".join(self.path_imglst), ",".join(self.path_imgbin)))
-        self.lst = _ListReader(self.path_imglst, self.label_width)
+        # kRandMagic = 121, mirroring the reference's sampler seed
+        self._rnd = np.random.RandomState(self.seed_data + 121)
+        self._part_order = list(range(len(self.path_imgbin)))
         self.before_first()
 
+    def _epoch_paths(self):
+        if self.shuffle and len(self._part_order) > 1:
+            self._rnd.shuffle(self._part_order)
+        return ([self.path_imglst[i] for i in self._part_order],
+                [self.path_imgbin[i] for i in self._part_order])
+
     def before_first(self):
-        self.lst.reset()
+        lst_paths, bin_paths = self._epoch_paths()
+        if self.lst is not None:
+            self.lst.close()
+        self.lst = _ListReader(lst_paths, self.label_width)
+        reordered = self.shuffle and len(self._part_order) > 1
+        if self.native_reader is not None and reordered:
+            # per-epoch part order changed: rebuild the native read-ahead
+            # chain over the permuted file list
+            self.native_reader.close()
+            self.native_reader = None
         if self.native_reader is None:
             from ..utils import native
             if native.load() is not None:
                 try:
                     self.native_reader = native.NativePageReader(
-                        self.path_imgbin, self.page_ints)
+                        bin_paths, self.page_ints)
                 except (IOError, RuntimeError):
                     self.native_reader = None
         else:
             self.native_reader.before_first()
+        self._epoch_bin_paths = bin_paths
         self.bin_idx = 0
         self.page = None
         self.ptop = 0
         from collections import deque
         self._pending = deque()
         self._lst_done = False
+        self._window = []
         if getattr(self, "fbin", None) is not None:
             self.fbin.close()
             self.fbin = None
         if self.native_reader is None:
-            self.fbin = open(self.path_imgbin[0], "rb")
+            self.fbin = open(bin_paths[0], "rb")
 
     def _next_buffer(self) -> bytes:
         # native path: C++ read-ahead thread parses pages off-GIL
@@ -202,10 +245,10 @@ class ImagePageIterator(IIterator):
             page = BinaryPage.load(self.fbin, self.page_ints)
             if page is None:
                 self.bin_idx += 1
-                assert self.bin_idx < len(self.path_imgbin), \
+                assert self.bin_idx < len(self._epoch_bin_paths), \
                     "binary pack exhausted before list file"
                 self.fbin.close()
-                self.fbin = open(self.path_imgbin[self.bin_idx], "rb")
+                self.fbin = open(self._epoch_bin_paths[self.bin_idx], "rb")
                 continue
             self.page = page
             self.ptop = 0
@@ -213,16 +256,31 @@ class ImagePageIterator(IIterator):
         self.ptop += 1
         return obj
 
-    def _fill_decode_pipeline(self) -> None:
-        while len(self._pending) < self.buffer_size and not self._lst_done:
-            rec = self.lst.next_record()
-            if rec is None:
-                self._lst_done = True
-                return
-            index, label, _ = rec
-            buf = self._next_buffer()
-            self._pending.append(
-                (index, label, self._pool.submit(_decode_rgb_chw, buf)))
+    def _next_pair(self):
+        """Next (index, label, jpeg-bytes) in on-disk stream order."""
+        rec = self.lst.next_record()
+        if rec is None:
+            return None
+        index, label, _ = rec
+        return index, label, self._next_buffer()
+
+    def _next_shuffled(self):
+        """Instance-level shuffle: draw uniformly from a seeded window of
+        upcoming records (each record enters and leaves exactly once, so an
+        epoch is a permutation of the corpus)."""
+        if not self.shuffle:
+            return self._next_pair()
+        while len(self._window) < self.shuffle_window:
+            p = self._next_pair()
+            if p is None:
+                break
+            self._window.append(p)
+        if not self._window:
+            return None
+        j = int(self._rnd.randint(len(self._window)))
+        self._window[j], self._window[-1] = \
+            self._window[-1], self._window[j]
+        return self._window.pop()
 
     def next(self) -> bool:
         if self.decode_thread > 1:
@@ -231,17 +289,24 @@ class ImagePageIterator(IIterator):
                 self._pool = ThreadPoolExecutor(
                     max_workers=self.decode_thread,
                     thread_name_prefix="cxn-decode")
-            self._fill_decode_pipeline()
+            while (len(self._pending) < self.buffer_size
+                   and not self._lst_done):
+                p = self._next_shuffled()
+                if p is None:
+                    self._lst_done = True
+                    break
+                index, label, buf = p
+                self._pending.append(
+                    (index, label, self._pool.submit(_decode_rgb_chw, buf)))
             if not self._pending:
                 return False
             index, label, fut = self._pending.popleft()
             self.out = DataInst(fut.result(), label, index)
             return True
-        rec = self.lst.next_record()
-        if rec is None:
+        p = self._next_shuffled()
+        if p is None:
             return False
-        index, label, _ = rec
-        buf = self._next_buffer()
+        index, label, buf = p
         self.out = DataInst(_decode_rgb_chw(buf), label, index)
         return True
 

@@ -344,3 +344,90 @@ silent = 1
         assert base.native_reader is not None
     err = task.net_trainer.metric.evals[0].get()
     assert err < 0.2, "imgbinx conv error %f" % err
+
+
+def _two_part_corpus(tmp_path, n=30):
+    """One image dir split into two .lst/.bin parts with unique indices."""
+    d = str(tmp_path / "imgs")
+    lst = make_images(d, n=n)
+    with open(lst) as f:
+        lines = f.read().strip().split("\n")
+    parts = []
+    for k, chunk in enumerate((lines[: n // 2], lines[n // 2:])):
+        lp = str(tmp_path / ("part%d.lst" % k))
+        with open(lp, "w") as f:
+            f.write("\n".join(chunk) + "\n")
+        bp = str(tmp_path / ("part%d.bin" % k))
+        im2bin(lp, d, bp, PAGE_INTS)
+        parts.append((lp, bp))
+    return parts
+
+
+def _make_page_iter(parts, **kv):
+    it = ImagePageIterator()
+    for lp, bp in parts:
+        it.set_param("image_list", lp)
+        it.set_param("image_bin", bp)
+    it.set_param("page_size", str(PAGE_INTS))
+    it.set_param("silent", "1")
+    for k, v in kv.items():
+        it.set_param(k, str(v))
+    it.init()
+    return it
+
+
+def _epoch_order(it):
+    """One pass; returns instance indices, checking label/image pairing."""
+    order = []
+    while it.next():
+        inst = it.value()
+        cls = int(inst.label[0])
+        assert inst.data[cls].mean() > inst.data[(cls + 1) % 3].mean() + 50, \
+            "label/image pairing broken under shuffle"
+        order.append(inst.index)
+    return order
+
+
+def test_imgbin_shuffle_permutes_and_reshuffles(tmp_path):
+    """shuffle=1 (reference iter_thread_imbin_x-inl.hpp:161-195,253-286):
+    every epoch sees each instance exactly once, in a new order, with
+    (label, image) pairs intact across part-order + instance shuffle."""
+    parts = _two_part_corpus(tmp_path)
+    it = _make_page_iter(parts, shuffle=1, shuffle_window=8, seed_data=5)
+    e1 = _epoch_order(it)
+    it.before_first()
+    e2 = _epoch_order(it)
+    want = list(range(30))
+    assert sorted(e1) == want, "epoch must see every instance exactly once"
+    assert sorted(e2) == want
+    assert e1 != want, "shuffle=1 must permute"
+    assert e1 != e2, "each epoch must reshuffle"
+
+
+def test_imgbin_shuffle_seeded_and_off_by_default(tmp_path):
+    parts = _two_part_corpus(tmp_path)
+    # same seed -> same stream
+    a = _epoch_order(_make_page_iter(parts, shuffle=1, shuffle_window=8,
+                                     seed_data=3))
+    b = _epoch_order(_make_page_iter(parts, shuffle=1, shuffle_window=8,
+                                     seed_data=3))
+    assert a == b, "seed_data must make the shuffle reproducible"
+    c = _epoch_order(_make_page_iter(parts, shuffle=1, shuffle_window=8,
+                                     seed_data=4))
+    assert a != c
+    # shuffle defaults off: on-disk order
+    d = _epoch_order(_make_page_iter(parts))
+    assert d == list(range(30))
+
+
+def test_imgbinx_shuffle_through_decode_pool(tmp_path):
+    """Instance shuffle composes with the threaded decode pipeline."""
+    parts = _two_part_corpus(tmp_path)
+    it = _make_page_iter(parts, shuffle=1, shuffle_window=8, seed_data=7,
+                         decode_thread=2, buffer_size=4)
+    e1 = _epoch_order(it)
+    it.before_first()
+    e2 = _epoch_order(it)
+    assert sorted(e1) == list(range(30))
+    assert sorted(e2) == list(range(30))
+    assert e1 != e2
