@@ -155,6 +155,8 @@ class ImagePageIterator(IIterator):
             self.seed_data = int(val)
         if name == "shuffle_window":
             self.shuffle_window = int(val)
+            assert self.shuffle_window >= 1, \
+                "shuffle_window must be >= 1 (1 = stream order)"
 
     def _parse_image_conf(self):
         """Multi-part list + distributed sharding

@@ -64,6 +64,7 @@ class Trainer:
         self.params = None
         self.opt_state = None
         self.grad_accum = None
+        self._metric_accum = None   # on-device (n_metrics, 2) stat sums
         self._rng_counter = 0
         self._jit_cache: Dict = {}
 
@@ -213,6 +214,7 @@ class Trainer:
                 st[key] = up.init_state(np.asarray(self.params[i][key]))
             self.opt_state.append(st)
         self.grad_accum = None
+        self._metric_accum = None
         self.sample_counter = 0
         self._place_params()
 
@@ -312,15 +314,22 @@ class Trainer:
 
     # ------------------------------------------------------------------
     # the jitted steps
-    def _loss_fn(self, params, data, label, rng, epoch):
+    def _loss_fn(self, params, data, label, rng, epoch, with_stats=False):
         labels = self.net.label_info_from(label)
         values, loss = self.net.forward(params, data, labels=labels,
                                         train=True, rng=rng, epoch=epoch,
                                         mesh=self.mesh)
-        eval_outs = [values[n].reshape(values[n].shape[0], -1)
-                     for n in self.eval_nodes]
+        stats = None
+        if with_stats:
+            # train metrics reduce to (sum, count) on device — no per-step
+            # host fetch (the eval_train=1 sync the reference hid in its
+            # worker threads)
+            eval_outs = [
+                values[n].reshape(values[n].shape[0], -1).astype(jnp.float32)
+                for n in self.eval_nodes]
+            stats = self.train_metric.device_stats(eval_outs, labels)
         state_ups = getattr(self.net, "_last_state_updates", {})
-        return loss, (eval_outs, state_ups)
+        return loss, (stats, state_ups)
 
     def _apply_updates(self, params, grads, opt_state, epoch):
         new_params = [dict(p) for p in params]
@@ -337,30 +346,41 @@ class Trainer:
                 self.mesh, new_opt, getattr(self, "_tp_shardings", None))
         return new_params, new_opt
 
-    def _make_train_step(self, do_update: bool, accumulate: bool):
-        def step(params, opt_state, grad_accum, data, label, epoch, rng):
-            grads, (eval_outs, state_ups) = jax.grad(
-                self._loss_fn, has_aux=True)(params, data, label, rng, epoch)
+    def _make_train_step(self, do_update: bool, accumulate: bool,
+                         with_accum: bool, with_stats: bool):
+        def step(params, opt_state, grad_accum, metric_accum,
+                 data, label, epoch, rng):
+            grads, (stats, state_ups) = jax.grad(
+                self._loss_fn, has_aux=True)(params, data, label, rng,
+                                             epoch, with_stats)
             if accumulate:
                 grads = jax.tree.map(jnp.add, grad_accum, grads)
             if do_update:
                 params, opt_state = self._apply_updates(
                     params, grads, opt_state, epoch)
-                grads = jax.tree.map(jnp.zeros_like, grads)
+                if with_accum:
+                    grads = jax.tree.map(jnp.zeros_like, grads)
             if state_ups:
                 # non-gradient updates (BN running stats): direct assignment
                 params = [dict(p) for p in params]
                 for (i, key), val in state_ups.items():
                     params[i][key] = val
-            return params, opt_state, grads, eval_outs
+            if with_stats:
+                metric_accum = metric_accum + stats
+            # when update_period == 1 no grad-accumulator state is carried
+            # at all (no params-sized zero tree in HBM, no donate/add)
+            return (params, opt_state,
+                    grads if with_accum else None, metric_accum)
 
-        jitted = jax.jit(step, donate_argnums=(0, 1, 2))
+        jitted = jax.jit(step, donate_argnums=(0, 1, 2, 3))
         return jitted
 
-    def _get_step(self, do_update: bool, accumulate: bool):
-        k = ("train", do_update, accumulate)
+    def _get_step(self, do_update: bool, accumulate: bool,
+                  with_accum: bool, with_stats: bool):
+        k = ("train", do_update, accumulate, with_accum, with_stats)
         if k not in self._jit_cache:
-            self._jit_cache[k] = self._make_train_step(do_update, accumulate)
+            self._jit_cache[k] = self._make_train_step(
+                do_update, accumulate, with_accum, with_stats)
         return self._jit_cache[k]
 
     def _shard_batch(self, arr):
@@ -378,20 +398,24 @@ class Trainer:
         """One mini-batch (reference Update, nnet_impl-inl.hpp:141-185)."""
         need_update = (self.sample_counter + 1) % self.update_period == 0
         accumulate = self.sample_counter % self.update_period != 0
-        step = self._get_step(need_update, accumulate)
+        with_accum = self.update_period > 1
+        with_stats = self.eval_train != 0 and len(self.train_metric) > 0
+        step = self._get_step(need_update, accumulate, with_accum,
+                              with_stats)
         data = self._shard_batch(batch.data)
         label = self._shard_batch(batch.label)
-        if self.grad_accum is None:
+        if with_accum and self.grad_accum is None:
             self.grad_accum = jax.tree.map(
                 lambda x: jnp.zeros_like(x),
                 [{k: v for k, v in p.items()} for p in self.params])
-        self.params, self.opt_state, self.grad_accum, eval_outs = step(
-            self.params, self.opt_state, self.grad_accum, data, label,
-            jnp.asarray(self.epoch_counter, jnp.int32), self._next_rng())
-        if self.eval_train != 0 and len(self.train_metric):
-            labels = self.net.label_info_from(batch.label, as_numpy=True)
-            scores = [np.asarray(o) for o in eval_outs]
-            self.train_metric.add_eval(scores, labels)
+        if with_stats and self._metric_accum is None:
+            self._metric_accum = jnp.zeros(
+                (len(self.train_metric), 2), jnp.float32)
+        self.params, self.opt_state, self.grad_accum, self._metric_accum = \
+            step(self.params, self.opt_state, self.grad_accum,
+                 self._metric_accum, data, label,
+                 jnp.asarray(self.epoch_counter, jnp.int32),
+                 self._next_rng())
         self.sample_counter += 1
         if self.sample_counter >= self.update_period:
             self.sample_counter = 0
@@ -439,6 +463,10 @@ class Trainer:
         (reference Evaluate, nnet_impl-inl.hpp:224-243)."""
         ret = ""
         if self.eval_train != 0 and len(self.train_metric):
+            if self._metric_accum is not None:
+                # the only host fetch of train-metric state: round boundary
+                self.train_metric.absorb(jax.device_get(self._metric_accum))
+                self._metric_accum = None
             ret += self.train_metric.print_str("train")
             self.train_metric.clear()
         if iter_eval is None:

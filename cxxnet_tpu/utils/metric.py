@@ -15,8 +15,17 @@ MetricSet aggregates several metrics, each bound to a label field
 (``metric[field] = name`` config syntax), and prints
 ``\\t{evname}-{metric}[{field}]:{value}`` per metric (metric.h:220-231).
 
-Metrics run on host over numpy arrays — they sit outside the jitted step, on
-batch-sized outputs only, so there is no need to keep them on TPU.
+Two execution paths:
+* host path (``add_eval``) — numpy, used by the eval-iterator loop where
+  predictions are fetched anyway;
+* device path (``device_stats`` + ``absorb``) — each metric reduces to a
+  (sum, count) sufficient-statistic pair with jnp inside the jitted train
+  step, the trainer accumulates the (n_metrics, 2) array on device, and the
+  host only fetches it at round boundaries. This is what keeps
+  ``eval_train=1`` from forcing a device→host sync every batch (the
+  reference overlapped metric evaluation in its per-GPU worker threads,
+  nnet_impl-inl.hpp:174-180; here the whole computation stays inside the
+  compiled step).
 """
 
 from __future__ import annotations
@@ -38,6 +47,11 @@ class IMetric:
         """pred: (n, k) scores; labels: (n, label_width) label field."""
         raise NotImplementedError
 
+    def device_stats(self, pred, labels):
+        """jnp sufficient statistics (sum_metric, cnt_inst) for one batch;
+        traceable inside jit. Same numerics as add_eval."""
+        raise NotImplementedError
+
     def get(self) -> float:
         return self.sum_metric / max(self.cnt_inst, 1)
 
@@ -57,6 +71,15 @@ class MetricError(IMetric):
         self.sum_metric += float(np.sum(maxidx != labels[:, 0].astype(np.int64)))
         self.cnt_inst += pred.shape[0]
 
+    def device_stats(self, pred, labels):
+        import jax.numpy as jnp
+        if pred.shape[1] != 1:
+            maxidx = jnp.argmax(pred, axis=1)
+        else:
+            maxidx = (pred[:, 0] > 0.0).astype(jnp.int32)
+        wrong = jnp.sum(maxidx != labels[:, 0].astype(jnp.int32))
+        return wrong.astype(jnp.float32), jnp.float32(pred.shape[0])
+
 
 class MetricRMSE(IMetric):
     name = "rmse"
@@ -71,6 +94,13 @@ class MetricRMSE(IMetric):
         diff = np.sum((pred - labels) ** 2, axis=1)
         self.sum_metric += float(np.sum(diff))
         self.cnt_inst += pred.shape[0]
+
+    def device_stats(self, pred, labels):
+        import jax.numpy as jnp
+        if pred.shape != labels.shape:
+            raise ValueError("rmse: pred and label shape mismatch")
+        s = jnp.sum(jnp.square(pred - labels))
+        return s.astype(jnp.float32), jnp.float32(pred.shape[0])
 
 
 class MetricLogloss(IMetric):
@@ -94,6 +124,21 @@ class MetricLogloss(IMetric):
                 raise FloatingPointError("NaN detected in logloss")
             self.sum_metric += float(np.sum(res))
         self.cnt_inst += n
+
+    def device_stats(self, pred, labels):
+        # no in-trace NaN raise (jit can't); NaNs surface in the printed
+        # value instead
+        import jax.numpy as jnp
+        n = pred.shape[0]
+        if pred.shape[1] != 1:
+            tgt = labels[:, 0].astype(jnp.int32)
+            p = jnp.clip(pred[jnp.arange(n), tgt], 1e-15, 1.0 - 1e-15)
+            s = -jnp.sum(jnp.log(p))
+        else:
+            p = jnp.clip(pred[:, 0], 1e-15, 1.0 - 1e-15)
+            y = labels[:, 0]
+            s = -jnp.sum(y * jnp.log(p) + (1.0 - y) * jnp.log(1.0 - p))
+        return s.astype(jnp.float32), jnp.float32(n)
 
 
 class MetricRecall(IMetric):
@@ -119,6 +164,20 @@ class MetricRecall(IMetric):
             hit = np.isin(lab, top[i]).sum()
             self.sum_metric += float(hit) / lab.shape[0]
         self.cnt_inst += n
+
+    def device_stats(self, pred, labels):
+        import jax
+        import jax.numpy as jnp
+        n, k = pred.shape
+        if k < self.topn:
+            raise ValueError(
+                "rec@%d meaningless for prediction list of length %d"
+                % (self.topn, k))
+        top = jax.lax.top_k(pred, self.topn)[1]           # (n, topn)
+        lab = labels.astype(jnp.int32)                    # (n, lw)
+        hit = (lab[:, :, None] == top[:, None, :]).any(-1)  # (n, lw)
+        s = jnp.sum(hit.mean(axis=1, dtype=jnp.float32))
+        return s, jnp.float32(n)
 
 
 def create_metric(name: str) -> Optional[IMetric]:
@@ -158,6 +217,27 @@ class MetricSet:
         for i, e in enumerate(self.evals):
             field = self.label_fields[i]
             e.add_eval(predscores[i], label_info.field(field))
+
+    def device_stats(self, predscores, label_info):
+        """(n_metrics, 2) jnp array of (sum_metric, cnt_inst) per metric;
+        traceable inside the jitted train step."""
+        import jax.numpy as jnp
+        assert len(predscores) == len(self.evals), \
+            "number of predict scores must equal number of metrics"
+        rows = []
+        for i, e in enumerate(self.evals):
+            s, c = e.device_stats(predscores[i],
+                                  label_info.field(self.label_fields[i]))
+            rows.append(jnp.stack([s, c]))
+        return jnp.stack(rows)
+
+    def absorb(self, stats) -> None:
+        """Fold a fetched (n_metrics, 2) stats array (the on-device
+        accumulator) into the host counters."""
+        stats = np.asarray(stats)
+        for i, e in enumerate(self.evals):
+            e.sum_metric += float(stats[i, 0])
+            e.cnt_inst += int(round(float(stats[i, 1])))
 
     def print_str(self, evname: str) -> str:
         out = []
