@@ -104,6 +104,62 @@ class NeuralNet:
                 params.append(lay.init_params(rng))
         return params
 
+    # --- shared numerics rules (used by forward and forward_pipelined) ---
+    def _integer_id_nodes(self) -> set:
+        """Nodes carrying integer ids stored as floats: inputs of
+        integer_inputs layers (embed) plus their transitive producers, so
+        ids routed through pass-through layers (split/concat) are protected
+        at the graph input too. These must never be cast to a low-precision
+        compute dtype — bf16 corrupts ids above ~256."""
+        cfg = self.cfg
+        id_nodes = set()
+        for i, info in enumerate(cfg.layers):
+            if self.layers[i].integer_inputs:
+                id_nodes.update(info.nindex_in)
+        changed = bool(id_nodes)
+        while changed:
+            changed = False
+            for info in cfg.layers:
+                if any(o in id_nodes for o in info.nindex_out):
+                    new = set(info.nindex_in) - id_nodes
+                    if new:
+                        id_nodes |= new
+                        changed = True
+        return id_nodes
+
+    def _cast_params_compute(self, params: Params) -> Params:
+        """Cast master params to the compute dtype for the layer-visible
+        view; grads flow back in f32. Non-trainable state
+        (layer.state_keys(), e.g. BN running stats) stays f32 so EMAs never
+        accumulate bf16 rounding."""
+        cdt = self.compute_dtype
+        return [
+            {k: (jnp.asarray(v).astype(cdt)
+                 if (jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating)
+                     and k not in self.layers[i].state_keys()) else v)
+             for k, v in p.items()}
+            for i, p in enumerate(params)]
+
+    def _apply_layer_range(self, params, values, ctx, base_rng,
+                           lo: int, hi: int) -> None:
+        """Apply layers [lo, hi) in place on the node-values list, with the
+        per-layer rng fold and the losses-run-in-f32 rule."""
+        cfg = self.cfg
+        cdt = self.compute_dtype
+        for i in range(lo, hi):
+            info = cfg.layers[i]
+            lay = self.layers[i]
+            pidx = (info.primary_layer_index if self.is_shared[i] else i)
+            ctx.rng = jax.random.fold_in(base_rng, i)
+            ctx.layer_index = pidx
+            ins = [values[j] for j in info.nindex_in]
+            if cdt is not None and lay.is_loss:
+                # losses always in f32 (softmax/log numerics)
+                ins = [x.astype(jnp.float32) for x in ins]
+            outs = lay.apply(params[pidx], ins, ctx)
+            for j, v in zip(info.nindex_out, outs):
+                values[j] = v
+
     def forward(self, params: Params, data, extra_data=(),
                 labels: Optional[LabelInfo] = None, train: bool = False,
                 rng=None, epoch=0, mesh=None):
@@ -115,55 +171,188 @@ class NeuralNet:
         for i, ex in enumerate(extra_data):
             values[i + 1] = jnp.asarray(ex)
         if cdt is not None:
-            # token-id nodes (inputs of integer_inputs layers, e.g. embed)
-            # stay f32: bf16 corrupts ids above ~256. Walk producers
-            # transitively so ids routed through pass-through layers
-            # (split/concat) are protected at the graph input too.
-            id_nodes = set()
-            for i, info in enumerate(cfg.layers):
-                if self.layers[i].integer_inputs:
-                    id_nodes.update(info.nindex_in)
-            changed = bool(id_nodes)
-            while changed:
-                changed = False
-                for info in cfg.layers:
-                    if any(o in id_nodes for o in info.nindex_out):
-                        new = set(info.nindex_in) - id_nodes
-                        if new:
-                            id_nodes |= new
-                            changed = True
+            id_nodes = self._integer_id_nodes()
             values = [v if v is None or i in id_nodes else v.astype(cdt)
                       for i, v in enumerate(values)]
-            # cast through f32 master params; grads flow back in f32.
-            # non-trainable state (layer.state_keys(), e.g. BN running
-            # stats) stays f32 so EMAs never accumulate bf16 rounding.
-            params = [
-                {k: (jnp.asarray(v).astype(cdt)
-                     if (jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating)
-                         and k not in self.layers[i].state_keys()) else v)
-                 for k, v in p.items()}
-                for i, p in enumerate(params)]
+            params = self._cast_params_compute(params)
         ctx = ApplyContext(train=train, labels=labels, epoch=epoch,
                            mesh=mesh)
         base_rng = rng if rng is not None else jax.random.PRNGKey(0)
-        for i, info in enumerate(cfg.layers):
-            lay = self.layers[i]
-            pidx = (cfg.layers[i].primary_layer_index
-                    if self.is_shared[i] else i)
-            ctx.rng = jax.random.fold_in(base_rng, i)
-            ctx.layer_index = pidx
-            ins = [values[j] for j in info.nindex_in]
-            if cdt is not None and lay.is_loss:
-                # losses always in f32 (softmax/log numerics)
-                ins = [x.astype(jnp.float32) for x in ins]
-            outs = lay.apply(params[pidx], ins, ctx)
-            for j, v in zip(info.nindex_out, outs):
-                values[j] = v
+        self._apply_layer_range(params, values, ctx, base_rng,
+                                0, len(cfg.layers))
         total_loss = sum(ctx.losses) if ctx.losses else jnp.zeros(())
         self._last_pairtest_diffs = getattr(ctx, "pairtest_diffs", [])
         # non-gradient param updates (BN running stats); valid only when
         # read immediately after this call within the same trace
         self._last_state_updates = ctx.state_updates
+        return values, total_loss
+
+    # ------------------------------------------------------------------
+    # pipeline parallelism (config key pipeline_parallel = k)
+    def _pipeline_chain_prefix(self) -> int:
+        """Length of the non-loss prefix, verifying it is a linear 1-1
+        chain (the shape pipeline stages need). Raises otherwise."""
+        cfg = self.cfg
+        first_loss = next(
+            (i for i, lay in enumerate(self.layers) if lay.is_loss),
+            len(cfg.layers))
+        node = 0
+        for i in range(first_loss):
+            info = cfg.layers[i]
+            check(len(info.nindex_in) == 1 and len(info.nindex_out) == 1,
+                  "pipeline_parallel requires a linear 1-in/1-out layer "
+                  "chain; layer %d has fan %d->%d"
+                  % (i, len(info.nindex_in), len(info.nindex_out)))
+            check(info.nindex_in[0] == node,
+                  "pipeline_parallel requires consecutive chaining; layer "
+                  "%d reads node %d, expected %d"
+                  % (i, info.nindex_in[0], node))
+            node = info.nindex_out[0]
+        check(first_loss > 0, "pipeline_parallel: empty non-loss prefix")
+        return first_loss
+
+    def _partition_stages(self, n_layers: int, k: int):
+        """Split layers [0, n_layers) into k contiguous stages minimizing
+        the maximum stage cost (classic linear-partition DP over an
+        activation-elements proxy) — the pipeline's step time is set by its
+        slowest stage."""
+        cfg = self.cfg
+        costs = []
+        for i in range(n_layers):
+            out_node = cfg.layers[i].nindex_out[0]
+            costs.append(int(np.prod(self.node_shapes[out_node][1:])))
+        k = min(k, n_layers)
+        prefix = np.concatenate([[0], np.cumsum(costs, dtype=np.float64)])
+
+        def seg(a, b):
+            return prefix[b] - prefix[a]
+
+        # dp[j][i] = minimal max-stage-cost splitting first i layers into j
+        INF = float("inf")
+        dp = [[INF] * (n_layers + 1) for _ in range(k + 1)]
+        cut = [[0] * (n_layers + 1) for _ in range(k + 1)]
+        dp[0][0] = 0.0
+        for j in range(1, k + 1):
+            for i in range(j, n_layers + 1):
+                for m in range(j - 1, i):
+                    v = max(dp[j - 1][m], seg(m, i))
+                    if v < dp[j][i]:
+                        dp[j][i] = v
+                        cut[j][i] = m
+        bounds = [n_layers]
+        for j in range(k, 0, -1):
+            bounds.append(cut[j][bounds[-1]])
+        bounds.reverse()
+        return [(bounds[s], bounds[s + 1]) for s in range(k)]
+
+    def forward_pipelined(self, params, data, labels=None, train=True,
+                          rng=None, epoch=0, mesh=None, n_micro=None,
+                          axis="pipe"):
+        """GPipe forward: the non-loss prefix of a linear chain runs as a
+        k-stage heterogeneous pipeline over the mesh's ``axis``
+        (parallel.pipeline_apply_stages); the loss layers run replicated on
+        the gathered output, so numerics match the single-device net.
+
+        Green-field beyond the reference (SURVEY.md §2.9 "Not present").
+        Notes: BN batch statistics are per-microbatch (standard GPipe
+        semantics); stage params are replicated across pipeline ranks (XLA
+        places compute by rank via lax.switch), so PP here buys step-time
+        pipelining, not per-device parameter memory."""
+        from .. import parallel as par
+
+        cfg = self.cfg
+        cdt = self.compute_dtype
+        k = mesh.shape[axis]
+        first_loss = self._pipeline_chain_prefix()
+        for i in range(len(cfg.layers)):
+            check(not self.layers[i].state_keys(),
+                  "pipeline_parallel does not support layers with "
+                  "non-gradient state updates (e.g. batch_norm "
+                  "moving_average=1); layer %d %r carries state"
+                  % (i, self.layers[i].type_name))
+        stages = self._partition_stages(first_loss, k)
+        stages += [(first_loss, first_loss)] * (k - len(stages))
+        batch = data.shape[0]
+        if not n_micro:
+            n_micro = k
+        check(batch % n_micro == 0,
+              "pipeline_parallel: batch_size %d not divisible by %d "
+              "microbatches" % (batch, n_micro))
+        mb = batch // n_micro
+
+        if cdt is not None:
+            params = self._cast_params_compute(params)
+        base_rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+        def node_size(n):
+            return int(np.prod(self.node_shapes[n][1:]))
+
+        boundaries = [0]
+        for (lo, hi) in stages:
+            boundaries.append(cfg.layers[hi - 1].nindex_out[0]
+                              if hi > lo else boundaries[-1])
+        F = max(node_size(n) for n in boundaries)
+
+        def run_layers(p, x, lo, hi, micro_id):
+            ctx = ApplyContext(train=train, labels=None, epoch=epoch,
+                               mesh=mesh)
+            vals = [None] * cfg.param.num_nodes
+            vals[boundaries_by_lo[lo]] = x
+            # fold the microbatch index so stochastic layers (dropout,
+            # insanity) draw fresh noise per microbatch, not one shared mask
+            mb_rng = jax.random.fold_in(base_rng, micro_id)
+            self._apply_layer_range(p, vals, ctx, mb_rng, lo, hi)
+            return vals[cfg.layers[hi - 1].nindex_out[0]] if hi > lo else x
+
+        boundaries_by_lo = {lo: boundaries[s]
+                            for s, (lo, hi) in enumerate(stages)}
+
+        # token-id boundaries stay f32 (same protection as forward(); the
+        # padded carry then runs f32 and each stage casts its own input)
+        id_nodes = self._integer_id_nodes()
+        stream_dtype = (jnp.float32 if (cdt is None or 0 in id_nodes)
+                        else cdt)
+
+        def make_stage(s):
+            lo, hi = stages[s]
+            in_n, out_n = boundaries[s], boundaries[s + 1]
+
+            def body(p, padded, micro_id):
+                # batch dim left as -1: under a composed data axis the
+                # shard_map body sees the per-device microbatch shard
+                x = padded[:, : node_size(in_n)].reshape(
+                    (-1,) + tuple(self.node_shapes[in_n][1:]))
+                if cdt is not None and in_n not in id_nodes:
+                    x = x.astype(cdt)
+                y = run_layers(p, x, lo, hi, micro_id)
+                y = y.reshape(y.shape[0], -1).astype(stream_dtype)
+                return jnp.pad(y, ((0, 0), (0, F - y.shape[1])))
+            return body
+
+        xd = jnp.asarray(data).astype(stream_dtype)
+        x_stream = xd.reshape(n_micro, mb, -1)
+        x_stream = jnp.pad(
+            x_stream, ((0, 0), (0, 0), (0, F - x_stream.shape[2])))
+        dp_axis = "data" if (mesh is not None
+                             and "data" in mesh.axis_names
+                             and mesh.shape["data"] > 1) else None
+        out = par.pipeline_apply_stages(
+            [make_stage(s) for s in range(k)], params, x_stream, mesh,
+            axis=axis, batch_spec=dp_axis)
+        out_n = boundaries[-1]
+        y = out[:, :, : node_size(out_n)].reshape(
+            (batch,) + tuple(self.node_shapes[out_n][1:]))
+
+        # loss tail, replicated (tiny compute on (batch, nclass))
+        values = [None] * cfg.param.num_nodes
+        values[out_n] = y
+        ctx = ApplyContext(train=train, labels=labels, epoch=epoch,
+                           mesh=mesh)
+        self._apply_layer_range(params, values, ctx, base_rng,
+                                first_loss, len(cfg.layers))
+        total_loss = sum(ctx.losses) if ctx.losses else jnp.zeros(())
+        self._last_pairtest_diffs = getattr(ctx, "pairtest_diffs", [])
+        self._last_state_updates = {}
         return values, total_loss
 
     # ------------------------------------------------------------------

@@ -57,6 +57,8 @@ class Trainer:
         self.update_on_server = 0
         self.model_parallel = 1
         self.seq_parallel = 1
+        self.pipeline_parallel = 1
+        self.pipeline_micro = 0     # microbatches; 0 -> pipeline_parallel
         self.metric = MetricSet()
         self.train_metric = MetricSet()
         self.eval_node_names: List[Optional[str]] = []  # None -> last node
@@ -91,6 +93,10 @@ class Trainer:
             self.model_parallel = int(val)
         if name == "seq_parallel":
             self.seq_parallel = int(val)
+        if name == "pipeline_parallel":
+            self.pipeline_parallel = int(val)
+        if name == "pipeline_micro":
+            self.pipeline_micro = int(val)
         if name == "test_on_server":
             self.test_on_server = int(val)
         if name == "compute_dtype":
@@ -115,14 +121,30 @@ class Trainer:
     # ------------------------------------------------------------------
     def _setup_mesh(self) -> None:
         kind, ids = parallel.parse_device_spec(self.dev_spec)
+        parallel.ensure_platform(kind)
         n_avail = len(jax.devices())
         n = len(ids) if ids else 1
         n = min(max(n, 1), n_avail)
         mp = self.model_parallel
         sp = self.seq_parallel
-        check(mp == 1 or sp == 1,
-              "model_parallel and seq_parallel cannot be combined yet")
-        if sp > 1:
+        pp = self.pipeline_parallel
+        check(sum(x > 1 for x in (mp, sp, pp)) <= 1,
+              "model_parallel / seq_parallel / pipeline_parallel cannot be "
+              "combined yet")
+        if pp > 1:
+            check(n % pp == 0,
+                  "device count must be divisible by pipeline_parallel")
+            dp = n // pp
+            n_micro = self.pipeline_micro or pp
+            check(self.batch_size % n_micro == 0,
+                  "batch_size must be divisible by the microbatch count "
+                  "(pipeline_micro, default pipeline_parallel)")
+            check(dp == 1 or (self.batch_size // n_micro) % dp == 0,
+                  "microbatch size (batch_size / pipeline_micro) must be "
+                  "divisible by the data-parallel degree")
+            self.mesh = parallel.create_mesh(ids[:n] if ids else None,
+                                             ("data", "pipe"), (dp, pp))
+        elif sp > 1:
             check(n % sp == 0, "device count must be divisible by seq_parallel")
             dp = n // sp
             check(dp == 1 or self.batch_size % dp == 0,
@@ -220,12 +242,60 @@ class Trainer:
 
     # ------------------------------------------------------------------
     # checkpointing (reference SaveModel/LoadModel, nnet_impl-inl.hpp:81-100)
+    _OPT_MAGIC = b"CXNOPT01"
+
     def save_model(self, w: serializer.Writer) -> None:
         self.net_cfg.save_net(w)
         w.write_raw(np.int64(self.epoch_counter).tobytes())
         blob = self.net.save_model_blob(self.params)
         w.write_uint64(len(blob))
         w.write_raw(blob)
+        # versioned optimizer-state section (beyond the reference, which
+        # drops momentum on resume, nnet_impl-inl.hpp:82-87). Appended after
+        # the model blob so readers of the original format still load the
+        # file; load_model restores it when the magic is present.
+        ow = serializer.Writer()
+        ow.write_uint64(len(self.opt_state))
+        for st in self.opt_state:
+            ow.write_uint64(len(st))
+            for key in sorted(st):
+                ow.write_string(key)
+                sub = st[key]
+                ow.write_uint64(len(sub))
+                for sk in sorted(sub):
+                    ow.write_string(sk)
+                    ow.write_tensor(
+                        np.asarray(jax.device_get(sub[sk]), np.float32))
+        blob = ow.getvalue()
+        w.write_raw(self._OPT_MAGIC)
+        w.write_uint64(len(blob))
+        w.write_raw(blob)
+
+    def _load_opt_state(self, r: serializer.Reader) -> None:
+        """Restore the optional optimizer-state section; missing section
+        (pre-optimizer-checkpoint file) leaves the fresh init states."""
+        magic = r.f.read(len(self._OPT_MAGIC))
+        if magic != self._OPT_MAGIC:
+            return
+        r.read_uint64()  # section length (unused; we parse the content)
+        n = r.read_uint64()
+        check(n == len(self.opt_state),
+              "optimizer state layer count %d != %d" % (n, len(self.opt_state)))
+        for st in self.opt_state:
+            nk = r.read_uint64()
+            for _ in range(nk):
+                key = r.read_string()
+                check(key in st, "optimizer state has unknown weight "
+                      "tag %r (updater type changed?)" % key)
+                ns = r.read_uint64()
+                for _ in range(ns):
+                    sk = r.read_string()
+                    val = r.read_tensor()
+                    check(sk in st[key] and
+                          np.shape(st[key][sk]) == val.shape,
+                          "optimizer state %r/%r shape mismatch" % (key, sk))
+                    st[key][sk] = jnp.asarray(val)
+        self._place_params()   # re-apply TP shardings to restored state
 
     def load_model(self, r: serializer.Reader) -> None:
         self.net_cfg.load_net(r)
@@ -248,6 +318,7 @@ class Trainer:
         self.params = self.net.load_model_blob(r.read_raw(nbytes))
         self.net._infer_shapes()
         self._init_opt()
+        self._load_opt_state(r)
 
     def copy_model_from(self, r: serializer.Reader) -> None:
         """Finetune: copy weights of name-matched layers from another model
@@ -316,11 +387,22 @@ class Trainer:
     # the jitted steps
     def _loss_fn(self, params, data, label, rng, epoch, with_stats=False):
         labels = self.net.label_info_from(label)
-        values, loss = self.net.forward(params, data, labels=labels,
-                                        train=True, rng=rng, epoch=epoch,
-                                        mesh=self.mesh)
+        if self.pipeline_parallel > 1:
+            values, loss = self.net.forward_pipelined(
+                params, data, labels=labels, train=True, rng=rng,
+                epoch=epoch, mesh=self.mesh,
+                n_micro=self.pipeline_micro or None)
+        else:
+            values, loss = self.net.forward(params, data, labels=labels,
+                                            train=True, rng=rng, epoch=epoch,
+                                            mesh=self.mesh)
         stats = None
         if with_stats:
+            for n in self.eval_nodes:
+                check(values[n] is not None,
+                      "metric node %d lives inside the pipelined prefix; "
+                      "with pipeline_parallel only the loss-tail nodes are "
+                      "observable" % n)
             # train metrics reduce to (sum, count) on device — no per-step
             # host fetch (the eval_train=1 sync the reference hid in its
             # worker threads)

@@ -14,13 +14,15 @@ mshadow-ps push/pull parameter server + per-GPU worker threads
   within the single jitted train step
 """
 
-from .mesh import create_mesh, parse_device_spec  # noqa: F401
+from .mesh import (backend_initialized, create_mesh,  # noqa: F401
+                   ensure_platform, parse_device_spec)
 from .sharding import (batch_sharding, replicated, shard_opt_state,  # noqa: F401
                        zero_sharding)
 from . import collectives  # noqa: F401
 from .ring import attention_reference, ring_attention, ulysses_attention  # noqa: F401
 from .tensor import (column_parallel_dense, expert_parallel_ffn,  # noqa: F401
                      fullc_sharding, row_parallel_dense)
-from .pipeline import pipeline_apply, stage_sharding  # noqa: F401
+from .pipeline import (pipeline_apply, pipeline_apply_stages,  # noqa: F401
+                       stage_sharding)
 from .multihost import (create_hybrid_mesh, init_distributed,  # noqa: F401
                         virtual_cpu_env, worker_shard_params)

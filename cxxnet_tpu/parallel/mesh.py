@@ -27,6 +27,46 @@ def parse_device_spec(spec: str) -> Tuple[str, List[int]]:
     return kind, [int(x) for x in ids.split(",")]
 
 
+def backend_initialized() -> bool:
+    """True when a jax backend is already live in this process. Peeks at
+    jax's internal registry so the check itself never initializes (and
+    thus never blocks on) a backend."""
+    try:
+        from jax._src import xla_bridge as xb
+        return bool(getattr(xb, "_backends", None))
+    except Exception:
+        return False
+
+
+_cpu_pinned = False
+
+
+def ensure_platform(kind: str) -> None:
+    """Make ``dev = cpu`` actually select the CPU backend even when the
+    environment pins another jax platform (JAX_PLATFORMS is read before
+    user code runs, so the env route cannot be overridden later). No-op
+    unless kind is cpu and no backend has been initialized yet.
+
+    The selection is process-wide (a jax constraint): once a dev=cpu
+    trainer pinned the CPU backend, a later dev=tpu/gpu trainer in the
+    same process would silently run on CPU — that case raises instead."""
+    global _cpu_pinned
+    if kind != "cpu":
+        if _cpu_pinned:
+            raise RuntimeError(
+                "dev=%s requested, but this process already selected the "
+                "CPU backend for an earlier dev=cpu trainer; jax supports "
+                "one platform per process — use a separate process" % kind)
+        return
+    if backend_initialized():
+        return  # backend already live; too late and unnecessary
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        _cpu_pinned = True
+    except Exception:
+        pass
+
+
 def create_mesh(device_ids: Optional[Sequence[int]] = None,
                 axes: Tuple[str, ...] = ("data",),
                 shape: Optional[Tuple[int, ...]] = None) -> Mesh:

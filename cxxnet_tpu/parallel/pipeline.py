@@ -67,6 +67,80 @@ def _pipeline_local(params, x, *, axis_name: str, n_micro: int,
     return collectives.psum(outbuf, axis_name)
 
 
+def _pipeline_local_switch(params, x, *, axis_name: str, n_micro: int,
+                           stage_fns):
+    """Like _pipeline_local, but heterogeneous stages: every device traces
+    all stage bodies once and lax.switch selects its own by pipeline rank.
+    All bodies map a (micro_batch, F) padded boundary vector to another —
+    F = widest stage boundary — so the ppermute hop and the scan carry stay
+    shape-uniform even when the underlying activations are not."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    outbuf = jnp.zeros_like(x)
+    cur = jnp.zeros_like(x[0])
+    perm = [(i, i + 1) for i in range(n - 1)]
+
+    def tick(carry, t):
+        cur, outbuf = carry
+        x_t = lax.dynamic_index_in_dim(x, jnp.clip(t, 0, n_micro - 1),
+                                       axis=0, keepdims=False)
+        inp = jnp.where(idx == 0, x_t, cur)
+        # stage `idx` works on microbatch t - idx at tick t (clipped while
+        # the bubble fills/drains; those results are masked out anyway)
+        micro_id = jnp.clip(t - idx, 0, n_micro - 1)
+        y = lax.switch(idx, stage_fns, params, inp, micro_id)
+        done_t = t - (n - 1)
+        pos = jnp.clip(done_t, 0, n_micro - 1)
+        valid = (done_t >= 0) & (idx == n - 1)
+        slot = lax.dynamic_index_in_dim(outbuf, pos, axis=0, keepdims=False)
+        outbuf = lax.dynamic_update_index_in_dim(
+            outbuf, jnp.where(valid, y, slot), pos, axis=0)
+        cur = collectives.ppermute(y, axis_name, perm)
+        return (cur, outbuf), None
+
+    (_, outbuf), _ = lax.scan(tick, (cur, outbuf),
+                              jnp.arange(n_micro + n - 1))
+    return collectives.psum(outbuf, axis_name)
+
+
+def pipeline_apply_stages(stage_fns, params, x, mesh: Mesh, *,
+                          axis: str = "pipe", batch_spec=None):
+    """Heterogeneous-stage GPipe over the mesh's ``axis``.
+
+    stage_fns: one callable per stage, each
+               (params, padded, micro_id) -> padded where padded is
+               (micro_batch, F) — the stage slices its real input out of
+               the padded vector and re-pads its output. micro_id is the
+               traced index of the microbatch being processed (for
+               per-microbatch rng folds in stochastic layers)
+    params:    pytree passed to every stage (replicated over ``axis``; each
+               body indexes only its own layers' entries)
+    x:         (n_micro, micro_batch, F) padded input microbatches
+    batch_spec: optional mesh axis name to keep the micro_batch dim sharded
+               on (data parallelism composed with the pipeline)
+
+    Returns (n_micro, micro_batch, F), replicated over ``axis``.
+    Differentiable; the backward pipeline is the transposed scan with
+    reversed hops. This is the config-DSL pipeline path (trainer key
+    ``pipeline_parallel``); `pipeline_apply` remains the fast path for
+    uniform repeated-block stacks.
+    """
+    n_stages = mesh.shape[axis]
+    if len(stage_fns) != n_stages:
+        raise ValueError(
+            "pipeline_apply_stages: %d stage fns for %d-way mesh axis %r"
+            % (len(stage_fns), n_stages, axis))
+    n_micro = x.shape[0]
+    bspec = P(None, batch_spec, None) if batch_spec else P()
+    fn = shard_map(
+        functools.partial(_pipeline_local_switch, axis_name=axis,
+                          n_micro=n_micro, stage_fns=tuple(stage_fns)),
+        mesh=mesh,
+        in_specs=(P(), bspec),
+        out_specs=bspec)
+    return fn(params, x)
+
+
 def pipeline_apply(stage_fn, stacked_params, x, mesh: Mesh, *,
                    axis: str = "pipe"):
     """Run microbatches through a pipeline of stages.

@@ -139,3 +139,60 @@ def test_train_driver(mnist_dir):
     net = api.train(NET_CFG, it, 6, {"eta": "0.2"}, eval_data=ev, dev="cpu")
     s = net.evaluate(ev, "final")
     assert float(s.split("final-error:")[1]) < 0.2, s
+
+
+def test_optimizer_state_checkpointed(tmp_path):
+    """Resume from a checkpoint must reproduce uninterrupted training
+    bitwise (the reference dropped momentum on resume,
+    nnet_impl-inl.hpp:82-87 — we checkpoint the optimizer too)."""
+    rs = np.random.RandomState(5)
+    x = rs.rand(25, 784).astype(np.float32)
+    y = rs.randint(0, 10, 25).astype(np.float32)
+    cfg = NET_CFG + "momentum = 0.9\n"
+
+    # uninterrupted: 8 updates
+    ref = api.Net(dev="cpu", cfg=cfg)
+    ref.init_model()
+    for _ in range(8):
+        ref.update(x, y)
+
+    # interrupted after 4, saved, resumed in a fresh Net
+    a = api.Net(dev="cpu", cfg=cfg)
+    a.init_model()
+    for _ in range(4):
+        a.update(x, y)
+    path = str(tmp_path / "mid.model")
+    a.save_model(path)
+    b = api.Net(dev="cpu", cfg=cfg)
+    b.load_model(path)
+    # momentum restored, not re-zeroed
+    m = b.net_.opt_state[0]["wmat"]["m"]
+    assert float(np.abs(np.asarray(m)).max()) > 0
+    for _ in range(4):
+        b.update(x, y)
+
+    for p_ref, p_b in zip(ref.net_.params, b.net_.params):
+        for key in p_ref:
+            np.testing.assert_array_equal(np.asarray(p_ref[key]),
+                                          np.asarray(p_b[key]))
+
+
+def test_old_format_model_still_loads(tmp_path):
+    """Files without the optimizer section (or foreign trailing data) load
+    with fresh optimizer state."""
+    net = api.Net(dev="cpu", cfg=NET_CFG)
+    net.init_model()
+    x = np.random.RandomState(6).rand(25, 784).astype(np.float32)
+    net.update(x, np.zeros(25, np.float32))
+    path = str(tmp_path / "m.model")
+    net.save_model(path)
+    # strip the optimizer section to emulate a round-1 file
+    blob = open(path, "rb").read()
+    cut = blob.rindex(b"CXNOPT01")
+    open(path, "wb").write(blob[:cut])
+    net2 = api.Net(dev="cpu", cfg="")
+    net2.load_model(path)
+    p1 = net.extract(x, "top[-1]")
+    p2 = net2.extract(x, "top[-1]")
+    np.testing.assert_allclose(np.asarray(p1), np.asarray(p2),
+                               rtol=1e-5, atol=1e-6)

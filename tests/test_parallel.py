@@ -227,3 +227,153 @@ class TestZeroSharding:
         sh_small = parallel.zero_sharding(mesh, st["small"])
         assert sh_big.spec == P("data")
         assert sh_small.spec == P()
+
+
+class TestPipelineDSL:
+    """pipeline_parallel=k from the config DSL through the Trainer:
+    heterogeneous-width stages, numerics vs the single-device net."""
+
+    CONF = """
+netconfig = start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 24
+  init_sigma = 0.1
+layer[+1] = relu
+layer[+1:fc2] = fullc:fc2
+  nhidden = 12
+  init_sigma = 0.1
+layer[+1] = relu
+layer[+1:fc3] = fullc:fc3
+  nhidden = 7
+  init_sigma = 0.1
+layer[+1] = relu
+layer[+1:fc4] = fullc:fc4
+  nhidden = 5
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig = end
+input_shape = 1,1,9
+batch_size = 16
+eta = 0.1
+momentum = 0.9
+metric = error
+"""
+
+    def _trainer(self, extra):
+        from cxxnet_tpu.nnet.trainer import Trainer
+        from cxxnet_tpu.utils.config import parse_config_string
+        tr = Trainer()
+        for k, v in parse_config_string(self.CONF + extra):
+            tr.set_param(k, v)
+        tr.init_model()
+        return tr
+
+    def _batches(self, n=6):
+        from cxxnet_tpu.io.data import DataBatch
+        rs = np.random.RandomState(3)
+        out = []
+        for _ in range(n):
+            b = DataBatch()
+            b.data = rs.rand(16, 1, 1, 9).astype(np.float32)
+            b.label = rs.randint(0, 5, (16, 1)).astype(np.float32)
+            b.batch_size = 16
+            out.append(b)
+        return out
+
+    def test_matches_single_device(self):
+        tr_pp = self._trainer("dev = cpu:0-7\npipeline_parallel = 4\n")
+        tr_1 = self._trainer("dev = cpu\n")
+        assert tr_pp.mesh is not None and tr_pp.mesh.shape["pipe"] == 4
+        assert tr_pp.mesh.shape["data"] == 2  # composes with dp
+        for b in self._batches():
+            tr_pp.update(b)
+            tr_1.update(b)
+        for p_pp, p_1 in zip(tr_pp.params, tr_1.params):
+            for key in p_1:
+                np.testing.assert_allclose(
+                    np.asarray(p_pp[key]), np.asarray(p_1[key]),
+                    rtol=2e-4, atol=2e-4)
+        # predictions agree too
+        b = self._batches(1)[0]
+        np.testing.assert_array_equal(tr_pp.predict(b), tr_1.predict(b))
+
+    def test_pipeline_micro_key(self):
+        tr = self._trainer("dev = cpu:0-7\npipeline_parallel = 8\n"
+                           "pipeline_micro = 4\n")
+        for b in self._batches(2):
+            tr.update(b)
+        w = np.asarray(tr.params[0]["wmat"])
+        assert np.isfinite(w).all()
+
+    def test_rejects_nonlinear_chain(self):
+        import pytest as _pytest
+        conf = """
+netconfig = start
+layer[0->1,2] = split
+layer[1->3] = fullc:fa
+  nhidden = 4
+  init_sigma = 0.1
+layer[2->4] = fullc:fb
+  nhidden = 4
+  init_sigma = 0.1
+layer[3,4->5] = concat
+layer[5->6] = fullc:fc
+  nhidden = 3
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig = end
+input_shape = 1,1,6
+batch_size = 8
+eta = 0.1
+dev = cpu:0-7
+pipeline_parallel = 4
+"""
+        from cxxnet_tpu.nnet.trainer import Trainer
+        from cxxnet_tpu.utils.config import parse_config_string
+        from cxxnet_tpu.io.data import DataBatch
+        tr = Trainer()
+        for k, v in parse_config_string(conf):
+            tr.set_param(k, v)
+        tr.init_model()
+        b = DataBatch()
+        rs = np.random.RandomState(0)
+        b.data = rs.rand(8, 1, 1, 6).astype(np.float32)
+        b.label = rs.randint(0, 3, (8, 1)).astype(np.float32)
+        b.batch_size = 8
+        with _pytest.raises(Exception, match="linear|chain"):
+            tr.update(b)
+
+    def test_partition_balances_end_heavy_chains(self):
+        """The linear-partition DP must not collapse widening nets into
+        stage 0 (min-max stage cost, not greedy threshold)."""
+        tr = self._trainer("dev = cpu:0-7\npipeline_parallel = 4\n")
+        first_loss = tr.net._pipeline_chain_prefix()
+        stages = tr.net._partition_stages(first_loss, 4)
+        assert len(stages) == 4
+        assert all(hi > lo for lo, hi in stages), stages
+        # end-heavy synthetic costs: widening activations
+        import numpy as _np
+        shapes_bak = tr.net.node_shapes
+        tr.net.node_shapes = [(16, 1, 1, 2 ** i) for i in range(9)]
+        try:
+            stages2 = tr.net._partition_stages(first_loss, 4)
+        finally:
+            tr.net.node_shapes = shapes_bak
+        assert all(hi > lo for lo, hi in stages2), stages2
+        # the fattest layer sits alone in the last stage
+        assert stages2[-1][1] - stages2[-1][0] == 1
+
+    def test_rejects_stateful_layers(self):
+        conf = self.CONF.replace(
+            "layer[+0] = softmax",
+            "layer[+0] = batch_norm\n  moving_average = 1\nlayer[+0] = softmax")
+        from cxxnet_tpu.nnet.trainer import Trainer
+        from cxxnet_tpu.utils.config import parse_config_string
+        tr = Trainer()
+        for k, v in parse_config_string(
+                conf + "dev = cpu:0-7\npipeline_parallel = 4\n"):
+            tr.set_param(k, v)
+        tr.init_model()
+        b = self._batches(1)[0]
+        with pytest.raises(Exception, match="state"):
+            tr.update(b)
