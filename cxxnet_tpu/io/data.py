@@ -12,6 +12,11 @@ from typing import List, Optional
 import numpy as np
 
 
+# CSR element dtype: feature index + value pairs (reference
+# SparseInst::Entry, src/io/data.h:52-66)
+sparse_entry_t = np.dtype([("findex", np.uint32), ("fvalue", np.float32)])
+
+
 class DataInst:
     """Single instance (src/io/data.h:41)."""
 
@@ -19,6 +24,18 @@ class DataInst:
         self.data = data          # (c, h, w)
         self.label = label        # (label_width,)
         self.index = index
+
+
+class SparseInst:
+    """Single sparse instance (src/io/data.h:48-77): label + CSR entries."""
+
+    def __init__(self, entries: np.ndarray, label: np.ndarray, index: int = 0):
+        self.entries = np.asarray(entries, sparse_entry_t)  # (nnz,)
+        self.label = label
+        self.index = index
+
+    def __len__(self) -> int:
+        return len(self.entries)
 
 
 class DataBatch:
@@ -32,6 +49,10 @@ class DataBatch:
         self.batch_size: int = 0
         self.num_batch_padd: int = 0
         self.extra_data: List[np.ndarray] = []
+        # sparse part, CSR (src/io/data.h:96-100): row_ptr[batch_size+1]
+        # offsets into sparse_data, entries typed sparse_entry_t
+        self.sparse_row_ptr: Optional[np.ndarray] = None   # (b+1,) int64
+        self.sparse_data: Optional[np.ndarray] = None      # (nnz,) sparse_entry_t
 
     def shallow_copy(self) -> "DataBatch":
         out = DataBatch()
@@ -40,6 +61,31 @@ class DataBatch:
         out.batch_size = self.batch_size
         out.num_batch_padd = self.num_batch_padd
         out.extra_data = list(self.extra_data)
+        out.sparse_row_ptr = self.sparse_row_ptr
+        out.sparse_data = self.sparse_data
+        return out
+
+    # --- sparse helpers ----------------------------------------------------
+    def set_sparse(self, insts: List["SparseInst"]) -> None:
+        """Fill the CSR fields from per-instance entry lists."""
+        counts = [len(si) for si in insts]
+        self.sparse_row_ptr = np.zeros(len(insts) + 1, np.int64)
+        np.cumsum(counts, out=self.sparse_row_ptr[1:])
+        if sum(counts):
+            self.sparse_data = np.concatenate(
+                [np.asarray(si.entries, sparse_entry_t) for si in insts])
+        else:
+            self.sparse_data = np.empty(0, sparse_entry_t)
+
+    def sparse_to_dense(self, num_feature: int) -> np.ndarray:
+        """Densify the CSR block to (b, num_feature) float32 — the bridge
+        onto the TPU path (MXU wants dense tiles; scatter the nnz on host)."""
+        assert self.sparse_row_ptr is not None and self.sparse_data is not None
+        b = len(self.sparse_row_ptr) - 1
+        out = np.zeros((b, num_feature), np.float32)
+        rp = self.sparse_row_ptr
+        rows = np.repeat(np.arange(b), np.diff(rp))
+        out[rows, self.sparse_data["findex"]] = self.sparse_data["fvalue"]
         return out
 
 

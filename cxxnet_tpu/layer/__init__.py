@@ -5,5 +5,6 @@ implementations + factory)."""
 
 from .base import ApplyContext, LabelInfo, Layer, LayerParam, Shape4  # noqa: F401
 from .factory import create_layer, get_layer_type, PairTestLayer  # noqa: F401
+from .extern import ExternLayer, register_extern, get_extern  # noqa: F401
 from . import layers  # noqa: F401
 from . import factory  # noqa: F401

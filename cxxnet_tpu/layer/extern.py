@@ -1,0 +1,178 @@
+"""Extern layer: wrap a user-supplied jax op inside the net.
+
+TPU-native answer to the reference's caffe adapter
+(src/plugin/caffe_adapter-inl.hpp:27-200), whose capability is "embed an
+externally implemented layer, with its own weights, into the net". The
+reference shuttles blobs between frameworks and calls hand-written
+Forward/Backward pairs; here the external implementation is a pure jax
+function registered under a name, so it jits/fuses into the same XLA
+program as the rest of the net and the backward pass is autodiff — no
+blob copies, no adapter memory, no hand-written gradients.
+
+Usage::
+
+    from cxxnet_tpu.layer import register_extern
+
+    @register_extern("scale_shift")
+    class ScaleShift:
+        def infer_shape(self, in_shapes, setting):
+            return [in_shapes[0]]
+        def init_params(self, rng, in_shapes, setting):
+            c = in_shapes[0][1]
+            return {"scale": np.ones((c,), np.float32),
+                    "shift": np.zeros((c,), np.float32)}
+        def apply(self, params, inputs, *, train, rng):
+            x = inputs[0]
+            return [x * params["scale"][:, None, None]
+                    + params["shift"][:, None, None]]
+
+    # config DSL:
+    #   layer[+1:ext1] = extern:ext1
+    #     op = scale_shift
+    #     any_key = any_value        # passed through in `setting`
+
+The op's weights are first-class citizens: they are updated by the
+configured updater (visited under tags ``blob0``, ``blob1``, ... in
+sorted-key order, mirroring the reference's blob tags so tag-scoped
+updater params like ``blob0:lr`` work), checkpointed inside the model
+blob, and sharded/replicated like any other layer's.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from ..utils import serializer
+from .base import Layer, check
+
+# name -> op instance (or class; classes are instantiated on registration)
+_EXTERN_REGISTRY: Dict[str, object] = {}
+
+
+def register_extern(name: str, op: object = None):
+    """Register an external op under ``name``. Usable as a decorator
+    (on a class or an instance) or called directly."""
+
+    def _do(op_obj):
+        if isinstance(op_obj, type):
+            op_obj = op_obj()
+        check(hasattr(op_obj, "infer_shape") and hasattr(op_obj, "apply"),
+              "extern op %r must define infer_shape() and apply()" % name)
+        _EXTERN_REGISTRY[name] = op_obj
+        return op_obj
+
+    if op is None:
+        return _do
+    return _do(op)
+
+
+def get_extern(name: str):
+    if name not in _EXTERN_REGISTRY:
+        raise ValueError(
+            "extern op %r is not registered; call "
+            "cxxnet_tpu.layer.register_extern(%r, op) before building the "
+            "net (available: %s)"
+            % (name, name, sorted(_EXTERN_REGISTRY) or "none"))
+    return _EXTERN_REGISTRY[name]
+
+
+class ExternLayer(Layer):
+    """Net-embeddable wrapper over a registered external op.
+
+    Occupies the reference's caffe-plugin slot (type id 20,
+    src/layer/layer.h:296); accepts every ``key = value`` setting pair and
+    hands them to the op verbatim, the way the adapter forwarded the
+    prototxt config to caffe.
+    """
+
+    type_name = "extern"
+
+    def __init__(self):
+        super().__init__()
+        self.op_name = ""
+        self.setting: Dict[str, str] = {}
+        self._in_shapes = None
+        self._param_keys = None
+
+    def set_param(self, name, val):
+        super().set_param(name, val)
+        if name == "op":
+            self.op_name = val
+        else:
+            self.setting[name] = val
+
+    def _op(self):
+        check(bool(self.op_name), "extern layer: must set op = <name>")
+        return get_extern(self.op_name)
+
+    def infer_shape(self, in_shapes):
+        self._in_shapes = list(in_shapes)
+        out = self._op().infer_shape(list(in_shapes), dict(self.setting))
+        return [tuple(int(d) for d in s) for s in out]
+
+    def init_params(self, rng):
+        init = getattr(self._op(), "init_params", None)
+        if init is None:
+            self._param_keys = []
+            return {}
+        out = init(rng, list(self._in_shapes), dict(self.setting))
+        self._param_keys = sorted(out)
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    def apply(self, params, inputs, ctx):
+        out = self._op().apply(params, list(inputs),
+                               train=ctx.train, rng=ctx.rng)
+        check(isinstance(out, (list, tuple)),
+              "extern op %r apply() must return a list of outputs"
+              % self.op_name)
+        return list(out)
+
+    # weights are visible to updaters under blob0, blob1, ... (the
+    # reference's caffe blob tags, caffe_adapter-inl.hpp:46-66)
+    def _sorted_keys(self):
+        if self._param_keys is not None:
+            return self._param_keys
+        init = getattr(self._op(), "init_params", None)
+        if init is None or self._in_shapes is None:
+            return []
+        # updaters can be built before params exist (fresh init_model):
+        # probe the op once to learn the weight-key set
+        probe = init(np.random.RandomState(0), list(self._in_shapes),
+                     dict(self.setting))
+        return sorted(probe)
+
+    def visit_order(self):
+        return [("blob%d" % i, k)
+                for i, k in enumerate(self._sorted_keys())]
+
+    def save_model(self, w: serializer.Writer, params) -> None:
+        self.param.save(w)
+        w.write_string(self.op_name)
+        keys = sorted(params)
+        w.write_uint64(len(self.setting))
+        for k in sorted(self.setting):
+            w.write_string(k)
+            w.write_string(self.setting[k])
+        w.write_uint64(len(keys))
+        for k in keys:
+            w.write_string(k)
+            w.write_tensor(np.asarray(params[k], np.float32))
+
+    def load_model(self, r: serializer.Reader):
+        self.param.load(r)
+        self.op_name = r.read_string()
+        # saved settings restore the op config; config-file pairs applied
+        # later by configure() override them, like every other layer param
+        for _ in range(r.read_uint64()):
+            k = r.read_string()
+            v = r.read_string()
+            self.setting.setdefault(k, v)
+        n = r.read_uint64()
+        out = {}
+        for _ in range(n):
+            k = r.read_string()
+            out[k] = r.read_tensor()
+        self._param_keys = sorted(out)
+        return out

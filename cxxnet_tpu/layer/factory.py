@@ -14,6 +14,7 @@ import jax.numpy as jnp
 
 from . import layers as L
 from .base import Layer, check
+from .extern import ExternLayer
 
 # type ids (src/layer/layer.h:284-315)
 kSharedLayer = 0
@@ -78,6 +79,11 @@ _NAME2TYPE = {
     "ch_concat": kChConcat,
     "prelu": kPRelu,
     "batch_norm": kBatchNorm,
+    # the reference's caffe-plugin slot; "extern" is the native name, and
+    # "caffe" is kept as an alias so reference configs parse (the op itself
+    # must be registered via register_extern — see layer/extern.py)
+    "extern": kCaffe,
+    "caffe": kCaffe,
     "attention": kAttention,
     "embed": kEmbed,
     "add": kAdd,
@@ -111,6 +117,7 @@ _TYPE2CLS = {
     kChConcat: L.ChConcatLayer,
     kPRelu: L.PReluLayer,
     kBatchNorm: L.BatchNormLayer,
+    kCaffe: ExternLayer,
     kAttention: L.AttentionLayer,
     kEmbed: L.EmbedLayer,
     kAdd: L.AddLayer,

@@ -312,11 +312,13 @@ class Trainer:
         self.eval_nodes = [self.net_cfg.param.num_nodes - 1 if nm is None
                            else self.net_cfg.node_name_map[nm]
                            for nm in self.eval_node_names]
-        self._build_updaters()
         self._jit_cache.clear()
         nbytes = r.read_uint64()
         self.params = self.net.load_model_blob(r.read_raw(nbytes))
         self.net._infer_shapes()
+        # updaters after the blob: layers whose weight set is data-dependent
+        # (extern ops) only know their keys once params are restored
+        self._build_updaters()
         self._init_opt()
         self._load_opt_state(r)
 

@@ -1,0 +1,163 @@
+"""Extern layer (the reference's caffe-plugin slot) + sparse DataBatch ABI.
+
+Reference capabilities covered:
+* src/plugin/caffe_adapter-inl.hpp:27-200 — embed an externally implemented
+  layer with its own weights into the net (here: a registered jax op,
+  backward via autodiff).
+* src/io/data.h:48-100 — SparseInst / CSR DataBatch fields.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.io.data import DataBatch, SparseInst, sparse_entry_t
+from cxxnet_tpu.layer import register_extern
+from cxxnet_tpu.layer.extern import _EXTERN_REGISTRY
+from cxxnet_tpu.nnet.trainer import Trainer
+from cxxnet_tpu.utils import serializer
+from cxxnet_tpu.utils.config import parse_config_string
+
+
+@pytest.fixture(autouse=True)
+def _scale_shift_op():
+    """A weighted external op: y = x * scale + shift (per-feature)."""
+
+    class ScaleShift:
+        def infer_shape(self, in_shapes, setting):
+            return [in_shapes[0]]
+
+        def init_params(self, rng, in_shapes, setting):
+            n = in_shapes[0][3]
+            return {"scale": np.full((n,), float(setting.get("gain", 1.0)),
+                                     np.float32),
+                    "shift": np.zeros((n,), np.float32)}
+
+        def apply(self, params, inputs, *, train, rng):
+            return [inputs[0] * params["scale"] + params["shift"]]
+
+    register_extern("scale_shift", ScaleShift)
+    yield
+    _EXTERN_REGISTRY.pop("scale_shift", None)
+
+
+CONF = """
+netconfig = start
+layer[+1:ext1] = extern:ext1
+  op = scale_shift
+  gain = 2.0
+layer[+1:fc1] = fullc:fc1
+  nhidden = 5
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig = end
+input_shape = 1,1,8
+batch_size = 16
+eta = 0.1
+dev = cpu
+"""
+
+
+def _trainer(conf=CONF):
+    tr = Trainer()
+    for k, v in parse_config_string(conf):
+        tr.set_param(k, v)
+    tr.init_model()
+    return tr
+
+
+def _batch(rs, n=16):
+    b = DataBatch()
+    b.data = rs.rand(n, 1, 1, 8).astype(np.float32)
+    b.label = rs.randint(0, 5, (n, 1)).astype(np.float32)
+    b.batch_size = n
+    return b
+
+
+class TestExternLayer:
+    def test_setting_reaches_op(self):
+        tr = _trainer()
+        np.testing.assert_allclose(np.asarray(tr.params[0]["scale"]), 2.0)
+
+    def test_weights_train(self):
+        tr = _trainer()
+        rs = np.random.RandomState(0)
+        before = np.asarray(tr.params[0]["scale"]).copy()
+        for _ in range(3):
+            tr.update(_batch(rs))
+        after = np.asarray(tr.params[0]["scale"])
+        assert not np.allclose(before, after), \
+            "extern weights must be updated by the optimizer (autodiff bwd)"
+
+    def test_blob_tag_scoped_lr(self):
+        # blob tags mirror the caffe adapter's; blob1:lr = 0 freezes `shift`
+        # (sorted keys: blob0=scale, blob1=shift). lr is clamped to
+        # minimum_lr unconditionally (reference param.h behavior), so the
+        # floor must be lowered too.
+        tr = _trainer(CONF.replace(
+            "  gain = 2.0",
+            "  gain = 2.0\n  blob1:lr = 0.0\n  blob1:lr:minimum_lr = 0.0"))
+        rs = np.random.RandomState(0)
+        shift0 = np.asarray(tr.params[0]["shift"]).copy()
+        scale0 = np.asarray(tr.params[0]["scale"]).copy()
+        for _ in range(3):
+            tr.update(_batch(rs))
+        np.testing.assert_allclose(np.asarray(tr.params[0]["shift"]), shift0)
+        assert not np.allclose(np.asarray(tr.params[0]["scale"]), scale0)
+
+    def test_save_load_roundtrip(self):
+        tr = _trainer()
+        rs = np.random.RandomState(0)
+        tr.update(_batch(rs))
+        buf = io.BytesIO()
+        tr.save_model(serializer.Writer(buf))
+        buf.seek(0)
+        tr2 = Trainer()
+        for k, v in parse_config_string(CONF):
+            tr2.set_param(k, v)
+        tr2.load_model(serializer.Reader(buf))
+        np.testing.assert_array_equal(np.asarray(tr.params[0]["scale"]),
+                                      np.asarray(tr2.params[0]["scale"]))
+        np.testing.assert_array_equal(np.asarray(tr.params[0]["shift"]),
+                                      np.asarray(tr2.params[0]["shift"]))
+        # loaded trainer keeps training
+        tr2.update(_batch(rs))
+
+    def test_caffe_alias_parses(self):
+        from cxxnet_tpu.layer import get_layer_type
+        assert get_layer_type("caffe") == get_layer_type("extern") == 20
+
+    def test_unregistered_op_errors(self):
+        with pytest.raises(ValueError, match="not registered"):
+            _trainer(CONF.replace("op = scale_shift", "op = nope"))
+
+
+class TestSparseBatch:
+    def test_csr_fields_roundtrip(self):
+        insts = [
+            SparseInst(np.array([(0, 1.0), (3, 2.0)], sparse_entry_t),
+                       np.array([1.0]), index=0),
+            SparseInst(np.empty(0, sparse_entry_t), np.array([0.0]), index=1),
+            SparseInst(np.array([(2, -1.5)], sparse_entry_t),
+                       np.array([1.0]), index=2),
+        ]
+        b = DataBatch()
+        b.batch_size = 3
+        b.set_sparse(insts)
+        np.testing.assert_array_equal(b.sparse_row_ptr, [0, 2, 2, 3])
+        assert b.sparse_data.dtype == sparse_entry_t
+        dense = b.sparse_to_dense(num_feature=5)
+        expect = np.array([[1, 0, 0, 2, 0],
+                           [0, 0, 0, 0, 0],
+                           [0, 0, -1.5, 0, 0]], np.float32)
+        np.testing.assert_array_equal(dense, expect)
+
+    def test_shallow_copy_carries_sparse(self):
+        b = DataBatch()
+        b.batch_size = 1
+        b.set_sparse([SparseInst(np.array([(1, 4.0)], sparse_entry_t),
+                                 np.array([0.0]))])
+        c = b.shallow_copy()
+        assert c.sparse_row_ptr is b.sparse_row_ptr
+        assert c.sparse_data is b.sparse_data
