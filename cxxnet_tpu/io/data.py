@@ -85,7 +85,9 @@ class DataBatch:
         out = np.zeros((b, num_feature), np.float32)
         rp = self.sparse_row_ptr
         rows = np.repeat(np.arange(b), np.diff(rp))
-        out[rows, self.sparse_data["findex"]] = self.sparse_data["fvalue"]
+        # accumulate duplicates (standard CSR densification semantics)
+        np.add.at(out, (rows, self.sparse_data["findex"].astype(np.int64)),
+                  self.sparse_data["fvalue"])
         return out
 
 

@@ -930,9 +930,11 @@ class AttentionLayer(Layer):
     With a mesh carrying an "sp" axis (trainer config `seq_parallel = k`) the
     sequence dimension is sharded and attention runs as ring attention (K/V
     blocks rotating over ICI, `sp_mode = ring`, the default) or Ulysses
-    all-to-all (`sp_mode = ulysses`); single-device it is plain dense
-    attention. Numerics match attention_reference in all modes
-    (tests/test_parallel.py, tests/test_layers.py)."""
+    all-to-all (`sp_mode = ulysses`). Single-device on TPU it runs the
+    Pallas flash-attention kernel (ops/flash_attn.py — O(L) memory, no
+    (L, L) score matrix) when shapes are tile-aligned, dense attention
+    otherwise. Numerics match attention_reference in all modes
+    (tests/test_parallel.py, tests/test_flash_attention.py)."""
 
     type_name = "attention"
 
@@ -1013,6 +1015,10 @@ class AttentionLayer(Layer):
             batch_axis = "data" if "data" in mesh.axis_names else None
             out = fn(q, k, v, mesh, causal=bool(self.causal),
                      batch_axis=batch_axis)
+        elif ops.use_pallas() and ops.flash_supported(L, dh):
+            # single-chip long-context path: blocked online-softmax Pallas
+            # kernel, O(L) memory instead of the (L, L) score matrix
+            out = ops.flash_attention(q, k, v, causal=bool(self.causal))
         else:
             out = attention_reference(q, k, v, causal=bool(self.causal))
         out = out.transpose(0, 2, 1, 3).reshape(b, L, d)      # merge heads

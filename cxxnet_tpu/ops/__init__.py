@@ -141,6 +141,21 @@ def lrn(x: jnp.ndarray, nsize: int, alpha: float, beta: float, knorm: float) -> 
     return lrn_xla(x, nsize, alpha, beta, knorm)
 
 
+def flash_supported(L: int, d: int) -> bool:
+    """True when (seq, head_dim) fits the Pallas flash-attention tiling."""
+    from . import flash_attn as _fa
+    return _fa.supports(L, d)
+
+
+def flash_attention(q, k, v, *, causal: bool = False, scale=None):
+    """Memory-O(L) blocked attention (ops/flash_attn.py). Off-TPU the
+    kernels run in the Pallas interpreter so forced-on tests (and any CPU
+    debugging) execute the exact kernel code."""
+    from . import flash_attn as _fa
+    interpret = jax.default_backend() != "tpu"
+    return _fa.flash_attention(q, k, v, causal, scale, interpret)
+
+
 def softmax(x: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
     return jax.nn.softmax(x, axis=axis)
 

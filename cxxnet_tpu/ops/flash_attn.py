@@ -1,0 +1,315 @@
+"""Single-chip flash attention: blocked online-softmax fwd + bwd in Pallas.
+
+The framework's long-context story has two tiers (SURVEY.md §5): across
+chips the sequence shards over the mesh "sp" axis (parallel/ring.py); on
+one chip this kernel keeps attention O(L) in memory by never materializing
+the (L, L) score matrix — Q tiles stay resident while K/V tiles stream
+through VMEM and the softmax is accumulated online (running max + sum, the
+same log-sum-exp carry ring attention uses across devices).
+
+Forward: grid (batch*heads, Lq/block_q, Lk/block_k), K/V innermost so the
+(m, l, acc) carry lives in VMEM scratch across the sequential kv steps;
+the MXU sees (block_q, d) x (d, block_k) and (block_q, block_k) x
+(block_k, d) matmuls. Saves the per-row logsumexp for backward.
+
+Backward (FlashAttention-2 factorization): with P = exp(S - lse) the
+gradients are
+    dV = Pᵀ dO
+    dS = P ∘ (dO Vᵀ - D),  D = rowsum(dO ∘ O)
+    dQ = scale · dS K      (kernel: grid over q tiles, kv streams)
+    dK = scale · dSᵀ Q     (kernel: grid over kv tiles, q streams)
+computed by two kernels that recompute S blockwise from the saved lse;
+D is computed once (fused XLA reduce) and streamed in as (bh, L, 1)
+tiles — O(L) memory end to end.
+
+Numerics are golden-tested against the dense reference on CPU
+(interpret=True) in tests/test_flash_attention.py and on the chip by
+tools/check_tpu_kernels.py. The kernel-escape-hatch precedent in the
+reference is the hand-written insanity pooling plan
+(src/layer/insanity_pooling_layer-inl.hpp:13-100).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+NEG_INF = -1e30  # finite stand-in for -inf: keeps exp()/max() NaN-free
+
+
+def _causal_mask(s, q_blk, kv_blk, block_q, block_k):
+    qpos = q_blk * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    kpos = kv_blk * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(qpos >= kpos, s, NEG_INF)
+
+
+def _block_needed(causal, q_blk, kv_blk, block_q, block_k):
+    """False only for kv tiles strictly above the causal diagonal — their
+    matmuls are skipped entirely (the flash causal-speedup)."""
+    if not causal:
+        return True
+    return kv_blk * block_k <= q_blk * block_q + (block_q - 1)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k):
+    kv_i = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+    q_blk = pl.program_id(1)
+
+    @pl.when(kv_i == 0)
+    def _():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(_block_needed(causal, q_blk, kv_i, block_q, block_k))
+    def _():
+        # operands stay in their input dtype (bf16 on the fast MXU path);
+        # every accumulation is f32 via preferred_element_type
+        q, k, v = q_ref[0], k_ref[0], v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale    # (bq, bk) f32
+        if causal:
+            s = _causal_mask(s, q_blk, kv_i, block_q, block_k)
+        m_prev = m_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                              # (bq, bk) f32
+        m_scr[...] = m_new
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+
+    @pl.when(kv_i == n_kv - 1)
+    def _():
+        l = jnp.maximum(l_scr[...], 1e-30)
+        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        # lse = m + log(l): per-row logsumexp for the backward recompute
+        lse_ref[0] = m_scr[...] + jnp.log(l)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, scale, causal, block_q, block_k):
+    kv_i = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+    q_blk = pl.program_id(1)
+
+    @pl.when(kv_i == 0)
+    def _():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    @pl.when(_block_needed(causal, q_blk, kv_i, block_q, block_k))
+    def _():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, q_blk, kv_i, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0])                         # (bq, bk) f32
+        dp = jax.lax.dot_general(
+            do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)             # (bq, bk)
+        ds = p * (dp - delta_ref[0]) * scale
+        dq_scr[...] += jnp.dot(ds.astype(k.dtype), k,
+                               preferred_element_type=jnp.float32)
+
+    @pl.when(kv_i == n_kv - 1)
+    def _():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr,
+                *, scale, causal, block_q, block_k):
+    q_i = pl.program_id(2)
+    n_q = pl.num_programs(2)
+    kv_blk = pl.program_id(1)
+
+    @pl.when(q_i == 0)
+    def _():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    @pl.when(_block_needed(causal, q_i, kv_blk, block_q, block_k))
+    def _():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        delta = delta_ref[0]                                # (bq, 1)
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale     # (bq, bk)
+        if causal:
+            s = _causal_mask(s, q_i, kv_blk, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0])
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # (bk, d)
+        dp = jax.lax.dot_general(
+            do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)             # (bq, bk)
+        ds = p * (dp - delta) * scale
+        dk_scr[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # (bk, d)
+
+    @pl.when(q_i == n_q - 1)
+    def _():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _pick_block(L: int, target: int = 256) -> int:
+    """Largest sequence tile that divides L: lane-aligned (multiple of 128)
+    so the (bq, bk) score tile maps onto the MXU cleanly."""
+    for b in (target, 128):
+        if L % b == 0:
+            return b
+    return 0
+
+
+def supports(L: int, d: int) -> bool:
+    """Shapes the kernel path accepts: lane-aligned sequence tiles and a
+    sublane-aligned head dim."""
+    return (pltpu is not None and L >= 128 and _pick_block(L) > 0
+            and d % 8 == 0)
+
+
+def _dims():
+    # the innermost stream dim carries the scratch accumulator across steps:
+    # must be sequential ("arbitrary"); batch*heads and the tile dim are
+    # parallel (Mosaic may split them over the two TensorCores)
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None, interpret: bool = False):
+    """Memory-O(L) attention. q, k, v: (b, h, L, d) -> (b, h, L, d).
+
+    Same contract as parallel.attention_reference; the caller gates on
+    supports(). `interpret=True` runs the kernels in the Pallas
+    interpreter so CPU tests cover the exact kernel code.
+    """
+    out, _ = _flash_fwd(q, k, v, causal, scale, interpret)
+    return out
+
+
+def _merge_bh(x):
+    b, h, L, d = x.shape
+    return x.reshape(b * h, L, d)
+
+
+def _flash_fwd(q, k, v, causal, scale, interpret):
+    b, h, L, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    bq = bk = _pick_block(L)
+    assert bq > 0, "flash_attention: unsupported seq length %d" % L
+    qf, kf, vf = _merge_bh(q), _merge_bh(k), _merge_bh(v)
+    bh = b * h
+    kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                             block_q=bq, block_k=bk)
+    out, lse = pl.pallas_call(
+        kern,
+        grid=(bh, L // bq, L // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda g, i, j: (g, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, L, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, L, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ] if pltpu is not None else [],
+        compiler_params=None if interpret else _dims(),
+        interpret=interpret,
+    )(qf, kf, vf)
+    out = out.reshape(b, h, L, d)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, scale, interpret, res, g):
+    q, k, v, out, lse = res
+    b, h, L, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    bq = bk = _pick_block(L)
+    qf, kf, vf = _merge_bh(q), _merge_bh(k), _merge_bh(v)
+    dof, of = _merge_bh(g), _merge_bh(out)
+    bh = b * h
+    # D = rowsum(dO ∘ O), computed once here (cheap elementwise + reduce,
+    # XLA fuses it) and streamed to both kernels as a (bh, L, 1) tile input
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    q_spec_i = pl.BlockSpec((1, bq, d), lambda g_, i, j: (g_, i, 0))
+    kv_spec_j = pl.BlockSpec((1, bk, d), lambda g_, i, j: (g_, j, 0))
+    lse_spec_i = pl.BlockSpec((1, bq, 1), lambda g_, i, j: (g_, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        grid=(bh, L // bq, L // bk),
+        in_specs=[q_spec_i, kv_spec_j, kv_spec_j, q_spec_i,
+                  lse_spec_i, lse_spec_i],
+        out_specs=q_spec_i,
+        out_shape=jax.ShapeDtypeStruct((bh, L, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+        ] if pltpu is not None else [],
+        compiler_params=None if interpret else _dims(),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    # dkv: kv tiles are the resident (parallel) dim, q tiles stream
+    q_spec_s = pl.BlockSpec((1, bq, d), lambda g_, j, i: (g_, i, 0))
+    kv_spec_r = pl.BlockSpec((1, bk, d), lambda g_, j, i: (g_, j, 0))
+    lse_spec_s = pl.BlockSpec((1, bq, 1), lambda g_, j, i: (g_, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        grid=(bh, L // bk, L // bq),
+        in_specs=[q_spec_s, kv_spec_r, kv_spec_r, q_spec_s,
+                  lse_spec_s, lse_spec_s],
+        out_specs=[kv_spec_r, kv_spec_r],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, L, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, L, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ] if pltpu is not None else [],
+        compiler_params=None if interpret else _dims(),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    shape = (b, h, L, d)
+    return dq.reshape(shape), dk.reshape(shape), dv.reshape(shape)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -153,6 +153,15 @@ class TestSparseBatch:
                            [0, 0, -1.5, 0, 0]], np.float32)
         np.testing.assert_array_equal(dense, expect)
 
+    def test_duplicate_indices_accumulate(self):
+        # standard CSR densification sums duplicate entries
+        b = DataBatch()
+        b.batch_size = 1
+        b.set_sparse([SparseInst(np.array([(2, 1.0), (2, 3.0)],
+                                          sparse_entry_t), np.array([0.0]))])
+        np.testing.assert_array_equal(b.sparse_to_dense(4),
+                                      [[0, 0, 4.0, 0]])
+
     def test_shallow_copy_carries_sparse(self):
         b = DataBatch()
         b.batch_size = 1
