@@ -65,3 +65,6 @@ class AttachTxtIterator(IIterator):
 
     def value(self) -> DataBatch:
         return self.out
+
+    def close(self) -> None:
+        self.base.close()

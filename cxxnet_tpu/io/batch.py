@@ -76,6 +76,9 @@ class BatchAdaptIterator(IIterator):
     def _store(self, top: int, d: DataInst):
         self.out.label[top] = d.label
         self.out.inst_index[top] = d.index
+        if self.out.data.dtype != d.data.dtype:
+            # follow the producer's dtype (uint8 deferred-normalization path)
+            self.out.data = self.out.data.astype(d.data.dtype)
         self.out.data[top] = d.data.reshape(self.out.data.shape[1:])
 
     def next(self) -> bool:
@@ -110,6 +113,16 @@ class BatchAdaptIterator(IIterator):
     def value(self) -> DataBatch:
         assert self.head == 0, "must call Next to get value"
         return self.out
+
+    def close(self) -> None:
+        self.base.close()
+
+
+class _LoaderError:
+    """Queue marker carrying a producer-thread exception to the consumer."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
 
 
 class ThreadBufferIterator(IIterator):
@@ -147,37 +160,71 @@ class ThreadBufferIterator(IIterator):
         out.extra_data = [np.array(e, copy=True) for e in b.extra_data]
         return out
 
+    def _poll_stop(self) -> bool:
+        try:
+            return self._cmd.get_nowait() == "stop"
+        except queue.Empty:
+            return False
+
     def _loader(self):
         while True:
             cmd = self._cmd.get()
             if cmd == "stop":
                 return
-            # one pass: prefetch until exhausted
-            self.base.before_first()
-            while self.base.next():
-                self.q.put(self._deep_copy(self.base.value()))
-            self.q.put(None)  # end marker
+            # one pass: prefetch until exhausted; poll for a mid-pass stop
+            # (close() during an epoch) so we never block forever on a full
+            # queue nobody is draining
+            try:
+                self.base.before_first()
+                while self.base.next():
+                    item = self._deep_copy(self.base.value())
+                    while True:
+                        if self._poll_stop():
+                            return
+                        try:
+                            self.q.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            pass
+                self.q.put(None)  # end marker
+            except Exception as exc:   # surface in the consumer's next()
+                self.q.put(_LoaderError(exc))
+                return
 
     def _start_loader(self):
         self.q = queue.Queue(maxsize=self.buffer_size)
         self.thread = threading.Thread(target=self._loader, daemon=True)
         self.thread.start()
         self._pass_started = False
+        self._dead = None          # first loader exception; iterator is done
+
+    def _raise_dead(self, item):
+        self._pass_started = False
+        self._dead = item.exc
+        raise item.exc
 
     def before_first(self):
+        if self._dead is not None:
+            raise self._dead
         # drain any in-flight pass
         if self._pass_started:
             while True:
                 item = self.q.get()
                 if item is None:
                     break
+                if isinstance(item, _LoaderError):
+                    self._raise_dead(item)
         self._cmd.put("start")
         self._pass_started = True
 
     def next(self) -> bool:
+        if self._dead is not None:
+            raise self._dead
         if not self._pass_started:
             self.before_first()
         item = self.q.get()
+        if isinstance(item, _LoaderError):
+            self._raise_dead(item)
         if item is None:
             self._pass_started = False
             return False
@@ -186,6 +233,18 @@ class ThreadBufferIterator(IIterator):
 
     def value(self) -> DataBatch:
         return self.out
+
+    def close(self) -> None:
+        if self.thread is not None:
+            self._cmd.put("stop")
+            # the loader polls for the stop between queue puts, so it exits
+            # promptly whether idle, mid-pass, or blocked on a full queue
+            self.thread.join(timeout=5.0)
+            if self.thread.is_alive():
+                # never tear down base under a live producer
+                return
+            self.thread = None
+        self.base.close()
 
     def __del__(self):
         try:
@@ -241,3 +300,6 @@ class DenseBufferIterator(IIterator):
     def value(self) -> DataBatch:
         assert self.data_index > 0, "Iterator.Value: at beginning of iterator"
         return self.buffer[self.data_index - 1]
+
+    def close(self) -> None:
+        self.base.close()

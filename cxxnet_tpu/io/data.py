@@ -110,6 +110,10 @@ class IIterator:
     def value(self):
         raise NotImplementedError
 
+    def close(self) -> None:
+        """Release host resources (threads, pools, files). Wrapper
+        iterators delegate down the chain; safe to call twice."""
+
     # python iteration sugar
     def __iter__(self):
         self.before_first()

@@ -49,6 +49,7 @@ kFixConnect = 31
 kAttention = 32
 kEmbed = 33
 kAdd = 34
+kMoE = 35
 kPairTestGap = 1024
 
 _NAME2TYPE = {
@@ -87,6 +88,7 @@ _NAME2TYPE = {
     "attention": kAttention,
     "embed": kEmbed,
     "add": kAdd,
+    "moe": kMoE,
 }
 
 _TYPE2CLS = {
@@ -121,6 +123,7 @@ _TYPE2CLS = {
     kAttention: L.AttentionLayer,
     kEmbed: L.EmbedLayer,
     kAdd: L.AddLayer,
+    kMoE: L.MoELayer,
 }
 
 

@@ -1016,9 +1016,25 @@ class AttentionLayer(Layer):
             out = fn(q, k, v, mesh, causal=bool(self.causal),
                      batch_axis=batch_axis)
         elif ops.use_pallas() and ops.flash_supported(L, dh):
-            # single-chip long-context path: blocked online-softmax Pallas
-            # kernel, O(L) memory instead of the (L, L) score matrix
-            out = ops.flash_attention(q, k, v, causal=bool(self.causal))
+            # per-chip long-context path: blocked online-softmax Pallas
+            # kernel, O(L) memory instead of the (L, L) score matrix. On a
+            # mesh (no sp axis here) the kernel is batch-pointwise, so it
+            # runs under shard_map with the batch dim left on "data" —
+            # pallas_call has no GSPMD partitioning rule of its own.
+            causal = bool(self.causal)
+            if mesh is None:
+                out = ops.flash_attention(q, k, v, causal=causal)
+            else:
+                from ..parallel._compat import shard_map
+                from jax.sharding import PartitionSpec as P
+                batch_axis = ("data" if "data" in mesh.axis_names
+                              and mesh.shape["data"] > 1 else None)
+                spec = P(batch_axis, None, None, None)
+                out = shard_map(
+                    lambda q_, k_, v_: ops.flash_attention(
+                        q_, k_, v_, causal=causal),
+                    mesh=mesh, in_specs=(spec, spec, spec),
+                    out_specs=spec)(q, k, v)
         else:
             out = attention_reference(q, k, v, causal=bool(self.causal))
         out = out.transpose(0, 2, 1, 3).reshape(b, L, d)      # merge heads
@@ -1114,3 +1130,105 @@ class AddLayer(Layer):
         for x in inputs[1:]:
             out = out + x
         return [out]
+
+
+class MoELayer(Layer):
+    """Mixture-of-experts FFN (beyond the reference — the scale-out sibling
+    of fullc): input (b, 1, 1, d_in) -> (b, 1, 1, nhidden) through nexpert
+    gated expert FFNs (relu inside, reference fullc+relu semantics per
+    expert).
+
+    Gating is dense-dispatch: every expert processes every token and the
+    softmax gate weights the combine — static shapes, MXU-sized matmuls,
+    the XLA-friendly form. ``top_k > 0`` keeps only the top-k gate
+    probabilities (renormalized); the dispatch stays dense so there is no
+    dynamic-shape routing, which is the right trade below thousands of
+    experts on TPU.
+
+    With a mesh carrying an "ep" axis (trainer key ``expert_parallel = k``)
+    the expert dimension shards over the mesh
+    (parallel.expert_parallel_ffn): each device runs its local experts and
+    one psum combines — composes with the "data" axis for dp x ep.
+    """
+
+    type_name = "moe"
+
+    def __init__(self):
+        super().__init__()
+        self.n_expert = 0
+        self.top_k = 0
+
+    def set_param(self, name, val):
+        super().set_param(name, val)
+        if name == "nexpert":
+            self.n_expert = int(val)
+        if name == "top_k":
+            self.top_k = int(val)
+
+    def infer_shape(self, in_shapes):
+        check(len(in_shapes) == 1, "MoELayer only support 1-1 connection")
+        b, c, h, w = in_shapes[0]
+        check(c == 1 and h == 1,
+              "moe input must be flattened (batch, 1, 1, d); add a flatten "
+              "layer first")
+        check(self.n_expert > 0, "must set nexpert")
+        check(self.param.num_hidden > 0, "must set nhidden")
+        check(self.top_k <= self.n_expert, "top_k cannot exceed nexpert")
+        self.param.num_input_node = w
+        return [(b, 1, 1, self.param.num_hidden)]
+
+    def init_params(self, rng):
+        din, dout = self.param.num_input_node, self.param.num_hidden
+        e = self.n_expert
+        return {
+            "gate": self.param.rand_init_weight(
+                rng, (e, din), in_num=din, out_num=e),
+            "experts": self.param.rand_init_weight(
+                rng, (e, din, dout), in_num=din, out_num=dout),
+        }
+
+    def save_model(self, w, params):
+        self.param.save(w)
+        import struct
+        w.write_raw(struct.pack("<ii", self.n_expert, self.top_k))
+        w.write_tensor(params["gate"])
+        w.write_tensor(params["experts"])
+
+    def load_model(self, r):
+        self.param.load(r)
+        import struct
+        self.n_expert, self.top_k = struct.unpack("<ii", r.read_raw(8))
+        return {"gate": r.read_tensor(), "experts": r.read_tensor()}
+
+    def visit_order(self):
+        return [("wmat", "experts"), ("gate", "gate")]
+
+    def _gate_probs(self, x2, gate):
+        logits = x2 @ gate.T                                # (b, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+        if self.top_k and self.top_k < self.n_expert:
+            # exact-k mask from top_k indices (a >=kth-value threshold
+            # would keep every tied expert — common in bf16)
+            _, idx = jax.lax.top_k(probs, self.top_k)       # (b, k)
+            mask = jnp.sum(jax.nn.one_hot(idx, self.n_expert,
+                                          dtype=probs.dtype), axis=1)
+            probs = probs * mask
+            probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+        return probs
+
+    def apply(self, params, inputs, ctx):
+        from ..parallel import expert_parallel_ffn
+        x = inputs[0]
+        b = x.shape[0]
+        x2 = x.reshape(b, -1)
+        probs = self._gate_probs(x2, params["gate"])
+        mesh = ctx.mesh
+        if mesh is not None and "ep" in getattr(mesh, "axis_names", ()):
+            batch_axis = "data" if "data" in mesh.axis_names else None
+            out = expert_parallel_ffn(x2, params["experts"], probs,
+                                      mesh, batch_axis=batch_axis)
+        else:
+            y = jnp.einsum("bi,eio->ebo", x2, params["experts"])
+            y = jnp.maximum(y, 0.0)
+            out = jnp.einsum("ebo,be->bo", y, probs)
+        return [out.reshape(b, 1, 1, self.param.num_hidden)]

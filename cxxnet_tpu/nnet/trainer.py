@@ -59,6 +59,9 @@ class Trainer:
         self.seq_parallel = 1
         self.pipeline_parallel = 1
         self.pipeline_micro = 0     # microbatches; 0 -> pipeline_parallel
+        self.expert_parallel = 1
+        self.input_scale = 1.0      # device-side input normalization
+        self.input_mean = None
         self.metric = MetricSet()
         self.train_metric = MetricSet()
         self.eval_node_names: List[Optional[str]] = []  # None -> last node
@@ -97,6 +100,8 @@ class Trainer:
             self.pipeline_parallel = int(val)
         if name == "pipeline_micro":
             self.pipeline_micro = int(val)
+        if name == "expert_parallel":
+            self.expert_parallel = int(val)
         if name == "test_on_server":
             self.test_on_server = int(val)
         if name == "compute_dtype":
@@ -104,6 +109,14 @@ class Trainer:
                   "compute_dtype must be float32 or bfloat16")
             self.compute_dtype = (jnp.bfloat16 if val in ("bfloat16", "bf16")
                                   else None)
+        # device-side input normalization (pairs with the iterators'
+        # output_uint8=1 deferred-normalization path, doc/io.md)
+        if name == "input_divideby":
+            self.input_scale = 1.0 / float(val)
+        if name == "input_scale":
+            self.input_scale = float(val)
+        if name == "input_mean_value":
+            self.input_mean = [float(x) for x in val.split(",")]
         if name.startswith("metric"):
             m = re.match(r"metric\[([^,\]]+)(?:,([^\]]+))?\]$", name)
             if m:
@@ -128,9 +141,10 @@ class Trainer:
         mp = self.model_parallel
         sp = self.seq_parallel
         pp = self.pipeline_parallel
-        check(sum(x > 1 for x in (mp, sp, pp)) <= 1,
-              "model_parallel / seq_parallel / pipeline_parallel cannot be "
-              "combined yet")
+        ep = self.expert_parallel
+        check(sum(x > 1 for x in (mp, sp, pp, ep)) <= 1,
+              "model_parallel / seq_parallel / pipeline_parallel / "
+              "expert_parallel cannot be combined yet")
         if pp > 1:
             check(n % pp == 0,
                   "device count must be divisible by pipeline_parallel")
@@ -151,6 +165,14 @@ class Trainer:
                   "batch_size must be divisible by the data-parallel degree")
             self.mesh = parallel.create_mesh(ids[:n] if ids else None,
                                              ("data", "sp"), (dp, sp))
+        elif ep > 1:
+            check(n % ep == 0,
+                  "device count must be divisible by expert_parallel")
+            dp = n // ep
+            check(dp == 1 or self.batch_size % dp == 0,
+                  "batch_size must be divisible by the data-parallel degree")
+            self.mesh = parallel.create_mesh(ids[:n] if ids else None,
+                                             ("data", "ep"), (dp, ep))
         elif mp > 1:
             check(n % mp == 0, "device count must be divisible by model_parallel")
             dp = n // mp
@@ -166,13 +188,21 @@ class Trainer:
             self.mesh = None
 
     def _place_params(self) -> None:
-        """Tensor-parallel placement: device_put params (and matching opt
-        state) with the model-axis shardings; GSPMD partitions the matmuls."""
+        """Tensor/expert-parallel placement: device_put params (and matching
+        opt state) with the model/ep-axis shardings; GSPMD partitions the
+        matmuls (shard_map consumes the ep placements directly)."""
         self._tp_shardings = None
-        if self.mesh is None or "model" not in self.mesh.axis_names:
+        if self.mesh is None:
+            return
+        if "model" in self.mesh.axis_names:
+            axis = "model"
+        elif "ep" in self.mesh.axis_names:
+            axis = "ep"
+        else:
             return
         from ..parallel.sharding import param_shardings
-        shards = param_shardings(self.mesh, self.net.layers, self.params)
+        shards = param_shardings(self.mesh, self.net.layers, self.params,
+                                 axis=axis)
         self._tp_shardings = shards
         self.params = [
             {k: jax.device_put(jnp.asarray(v), shards[i][k])
@@ -190,7 +220,9 @@ class Trainer:
     def _init_net_structure(self) -> None:
         self.net_cfg.configure(self.cfg_pairs)
         self.net = NeuralNet(self.net_cfg, self.batch_size,
-                             compute_dtype=self.compute_dtype)
+                             compute_dtype=self.compute_dtype,
+                             input_scale=self.input_scale,
+                             input_mean=self.input_mean)
         self._setup_mesh()
         # resolve eval nodes (metric[label,node] -> node id; default last)
         self.eval_nodes: List[int] = []
@@ -307,7 +339,9 @@ class Trainer:
         self.net_cfg.configure(self.cfg_pairs)
         self.net = NeuralNet(self.net_cfg, self.batch_size,
                              infer_shapes=False,
-                             compute_dtype=self.compute_dtype)
+                             compute_dtype=self.compute_dtype,
+                             input_scale=self.input_scale,
+                             input_mean=self.input_mean)
         self._setup_mesh()
         self.eval_nodes = [self.net_cfg.param.num_nodes - 1 if nm is None
                            else self.net_cfg.node_name_map[nm]

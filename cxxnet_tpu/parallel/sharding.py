@@ -80,16 +80,24 @@ def param_shardings(mesh: Mesh, layers, params, axis: str = "model"):
     config key): fullc weights are split on the output dim — the TP
     generalization of the reference's ``fullc_gather`` giant-FC trick
     (src/updater/async_updater-inl.hpp:67-92) — everything else replicated;
-    XLA/GSPMD propagates activation shardings and inserts the collectives."""
+    XLA/GSPMD propagates activation shardings and inserts the collectives.
+
+    With axis="ep" (``expert_parallel``) the moe layer's expert stack is
+    split on the expert dim instead, matching expert_parallel_ffn's
+    shard_map specs."""
     n = mesh.shape[axis]
     out = []
     for lay, p in zip(layers, params):
         shard = {}
         for key, val in p.items():
             shape = getattr(val, "shape", ())
-            if (getattr(lay, "type_name", "") == "fullc" and len(shape) >= 1
+            tname = getattr(lay, "type_name", "")
+            if (axis == "model" and tname == "fullc" and len(shape) >= 1
                     and shape[0] % n == 0):
                 spec = P(axis, *([None] * (len(shape) - 1)))
+            elif (axis == "ep" and tname == "moe" and key == "experts"
+                    and shape[0] % n == 0):
+                spec = P(axis, None, None)
             else:
                 spec = P()
             shard[key] = NamedSharding(mesh, spec)

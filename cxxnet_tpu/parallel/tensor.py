@@ -76,7 +76,7 @@ def row_parallel_dense(x, w, b, mesh: Mesh, *, axis: str = "model"):
 
 
 def _ep_local(x, w_exp, gates, *, axis_name):
-    # x: (B, din) replicated; w_exp: (E/n, din, dout) local experts;
+    # x: (B, din) batch shard; w_exp: (E/n, din, dout) local experts;
     # gates: (B, E/n) local gate probabilities for this device's experts
     y = jnp.einsum("bi,eio->ebo", x, w_exp)          # every expert, dense
     y = jnp.maximum(y, 0.0)                          # expert FFN activation
@@ -85,7 +85,7 @@ def _ep_local(x, w_exp, gates, *, axis_name):
 
 
 def expert_parallel_ffn(x, w_experts, gate_probs, mesh: Mesh, *,
-                        axis: str = "ep"):
+                        axis: str = "ep", batch_axis: Optional[str] = None):
     """Expert parallelism: experts sharded over the ``axis`` mesh dim, each
     device runs its local experts densely over all tokens and one psum
     combines the gate-weighted outputs.
@@ -94,13 +94,16 @@ def expert_parallel_ffn(x, w_experts, gate_probs, mesh: Mesh, *,
     (batch, n_experts). Dense dispatch (every expert sees every token,
     zeroed by the gate) is the XLA-friendly form — static shapes, MXU-sized
     matmuls — and is exact for soft gating; top-k gating just passes
-    sparse gate_probs.
+    sparse gate_probs. ``batch_axis`` names a mesh axis the batch dim is
+    sharded over (the trainer's "data" axis on a (data, ep) mesh) so EP
+    composes with data parallelism without gathering activations.
     """
     n = mesh.shape[axis]
     if w_experts.shape[0] % n != 0:
         raise ValueError("expert_parallel_ffn: n_experts %d not divisible by "
                          "mesh axis %r size %d" % (w_experts.shape[0], axis, n))
     fn = shard_map(functools.partial(_ep_local, axis_name=axis), mesh=mesh,
-                   in_specs=(P(), P(axis, None, None), P(None, axis)),
-                   out_specs=P())
+                   in_specs=(P(batch_axis, None), P(axis, None, None),
+                             P(batch_axis, axis)),
+                   out_specs=P(batch_axis, None))
     return fn(x, w_experts, gate_probs)

@@ -1,0 +1,134 @@
+"""Deferred input normalization: uint8 host pipeline + on-device scaling.
+
+The TPU-native H2D optimization (doc/io.md): AugmentIterator output_uint8=1
+ships raw pixels, the net applies (x - mean) * scale on device
+(net.py NeuralNet._normalize_input). Training numerics must match the
+all-host-float32 path exactly.
+"""
+
+import numpy as np
+import jax
+import pytest
+
+cv2 = pytest.importorskip("cv2")
+
+from cxxnet_tpu.io import create_iterator
+from cxxnet_tpu.nnet.trainer import Trainer
+from cxxnet_tpu.utils.config import parse_config_string
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+from test_io_image import make_images, PAGE_INTS  # noqa: E402
+from im2bin import im2bin  # noqa: E402
+
+
+NET = """
+netconfig = start
+layer[0->1] = conv:cv1
+  kernel_size = 5
+  stride = 2
+  nchannel = 8
+  init_sigma = 0.1
+layer[1->2] = relu
+layer[2->3] = flatten
+layer[3->4] = fullc:fc
+  nhidden = 3
+  init_sigma = 0.1
+layer[4->4] = softmax
+netconfig = end
+input_shape = 3,32,32
+batch_size = 8
+eta = 0.1
+dev = cpu
+"""
+
+
+def _iter_cfg(lst, bin_path, uint8):
+    aug = ("  output_uint8 = 1\n" if uint8 else
+           "  divideby = 256\n  mean_value = 10,20,30\n")
+    cfg = """
+iter = imgbinx
+  image_list = "%s"
+  image_bin = "%s"
+  page_size = %d
+  seed_data = 1
+%s  batch_size = 8
+  input_shape = 3,32,32
+  round_batch = 1
+  silent = 1
+""" % (lst, bin_path, PAGE_INTS, aug)
+    it = create_iterator(list(parse_config_string(cfg)))
+    it.init()
+    return it
+
+
+def _train(conf_extra, batches, n_pass=2):
+    tr = Trainer()
+    for k, v in parse_config_string(NET + conf_extra):
+        tr.set_param(k, v)
+    tr.init_model()
+    for _ in range(n_pass):
+        for b in batches:
+            tr.update(b)
+    return np.asarray(jax.device_get(tr.params[0]["wmat"]))
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    td = tmp_path_factory.mktemp("defer_norm")
+    d = str(td / "imgs")
+    lst = make_images(d, n=16, hw=32)
+    bin_path = str(td / "pack.bin")
+    im2bin(lst, d, bin_path, PAGE_INTS)
+    return lst, bin_path
+
+
+class TestDeferredNorm:
+    def test_uint8_batches(self, corpus):
+        it = _iter_cfg(*corpus, uint8=True)
+        batches = [b.shallow_copy() for b in it]
+        it.close()
+        assert batches and batches[0].data.dtype == np.uint8
+        # deep-copy data since shallow_copy shares the reused buffer
+        assert batches[0].data.max() > 1  # raw pixel range
+
+    def test_training_matches_host_float_path(self, corpus):
+        lst, bin_path = corpus
+
+        def collect(uint8):
+            it = _iter_cfg(lst, bin_path, uint8)
+            out = []
+            for b in it:
+                c = b.shallow_copy()
+                c.data = np.array(b.data, copy=True)
+                c.label = np.array(b.label, copy=True)
+                out.append(c)
+            it.close()
+            return out
+
+        host_batches = collect(uint8=False)
+        dev_batches = collect(uint8=True)
+        w_host = _train("", host_batches)
+        w_dev = _train("input_divideby = 256\n"
+                       "input_mean_value = 10,20,30\n", dev_batches)
+        np.testing.assert_allclose(w_dev, w_host, rtol=2e-5, atol=2e-5)
+
+    def test_uint8_rejects_host_divideby(self, corpus):
+        lst, bin_path = corpus
+        cfg = """
+iter = imgbin
+  image_list = "%s"
+  image_bin = "%s"
+  page_size = %d
+  output_uint8 = 1
+  divideby = 256
+  batch_size = 8
+  input_shape = 3,32,32
+  silent = 1
+""" % (lst, bin_path, PAGE_INTS)
+        it = create_iterator(list(parse_config_string(cfg)))
+        with pytest.raises(AssertionError, match="input_divideby"):
+            it.init()

@@ -127,3 +127,51 @@ dev = cpu
         w_flash = run(True)    # interpret-mode kernels on CPU
         w_dense = run(False)
         np.testing.assert_allclose(w_flash, w_dense, rtol=2e-4, atol=2e-4)
+
+
+class TestFlashOnMesh:
+    """On a data-parallel mesh (no sp axis) the flash kernel runs under
+    shard_map with the batch left sharded — pallas_call has no GSPMD rule."""
+
+    def test_data_mesh_matches_dense(self):
+        from cxxnet_tpu.io.data import DataBatch
+        from cxxnet_tpu.nnet.trainer import Trainer
+        from cxxnet_tpu.utils.config import parse_config_string
+        conf = """
+netconfig = start
+layer[+1:att1] = attention:att1
+  nhead = 2
+  causal = 1
+  init_sigma = 0.05
+layer[+1] = flatten
+layer[+1:head] = fullc:head
+  nhidden = 4
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig = end
+input_shape = 32,1,256
+batch_size = 8
+eta = 0.1
+dev = cpu:0-3
+"""
+        rs = np.random.RandomState(0)
+        b = DataBatch()
+        b.data = rs.rand(8, 32, 1, 256).astype(np.float32)
+        b.label = rs.randint(0, 4, (8, 1)).astype(np.float32)
+        b.batch_size = 8
+
+        def run(force):
+            ops.set_use_pallas(force)
+            try:
+                tr = Trainer()
+                for key, val in parse_config_string(conf):
+                    tr.set_param(key, val)
+                tr.init_model()
+                assert tr.mesh is not None and "data" in tr.mesh.axis_names
+                tr.update(b)
+                return np.asarray(jax.device_get(tr.params[0]["wqkv"]))
+            finally:
+                ops.set_use_pallas(None)
+
+        np.testing.assert_allclose(run(True), run(False),
+                                   rtol=2e-4, atol=2e-4)

@@ -1,0 +1,69 @@
+"""Iterator-chain robustness tests."""
+
+import numpy as np
+
+def test_threadbuffer_close_mid_pass():
+    """close() during an epoch must stop the loader promptly, not hang or
+    tear down the base under a live producer."""
+    import time as _time
+    from cxxnet_tpu.io.batch import ThreadBufferIterator
+    from cxxnet_tpu.io.data import DataBatch, IIterator
+
+    class Slow(IIterator):
+        def __init__(self):
+            self.n = 0
+            self.closed = False
+
+        def before_first(self):
+            self.n = 0
+
+        def next(self):
+            self.n += 1
+            return self.n < 500
+
+        def value(self):
+            b = DataBatch()
+            b.data = np.zeros((2, 1, 1, 4), np.float32)
+            b.label = np.zeros((2, 1), np.float32)
+            b.batch_size = 2
+            return b
+
+        def close(self):
+            self.closed = True
+
+    base = Slow()
+    it = ThreadBufferIterator(base)
+    it.set_param("silent", "1")
+    it.init()
+    it.before_first()
+    assert it.next()          # pass started; queue fills, loader mid-pass
+    t0 = _time.monotonic()
+    it.close()
+    assert _time.monotonic() - t0 < 5.0
+    assert it.thread is None  # loader actually exited
+    assert base.closed
+
+
+def test_threadbuffer_propagates_loader_error():
+    """An exception in the producer thread must surface in next(), not hang
+    the consumer forever on an empty queue."""
+    import pytest
+    from cxxnet_tpu.io.batch import ThreadBufferIterator
+    from cxxnet_tpu.io.data import IIterator
+
+    class Boom(IIterator):
+        def before_first(self):
+            pass
+
+        def next(self):
+            raise RuntimeError("decode exploded")
+
+        def value(self):  # pragma: no cover
+            return None
+
+    it = ThreadBufferIterator(Boom())
+    it.set_param("silent", "1")
+    it.init()
+    with pytest.raises(RuntimeError, match="decode exploded"):
+        it.next()
+    it.close()

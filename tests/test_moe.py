@@ -1,0 +1,150 @@
+"""MoE layer + expert parallelism from the config DSL.
+
+Completes the §2.9 green-field matrix: expert_parallel = k through the
+Trainer (mesh ("data", "ep")), numerics vs the single-device dense-dispatch
+path. Library-level EP is covered in tests/test_parallel.py.
+"""
+
+import numpy as np
+import jax
+import pytest
+
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.nnet.trainer import Trainer
+from cxxnet_tpu.utils.config import parse_config_string
+
+
+CONF = """
+netconfig = start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 12
+  init_sigma = 0.1
+layer[+1] = relu
+layer[+1:moe1] = moe:moe1
+  nexpert = 8
+  nhidden = 10
+  init_sigma = 0.1
+layer[+1:fc2] = fullc:fc2
+  nhidden = 5
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig = end
+input_shape = 1,1,9
+batch_size = 16
+eta = 0.1
+momentum = 0.9
+metric = error
+"""
+
+
+def _trainer(extra, conf=CONF):
+    tr = Trainer()
+    for k, v in parse_config_string(conf + extra):
+        tr.set_param(k, v)
+    tr.init_model()
+    return tr
+
+
+def _batches(n=6):
+    rs = np.random.RandomState(3)
+    out = []
+    for _ in range(n):
+        b = DataBatch()
+        b.data = rs.rand(16, 1, 1, 9).astype(np.float32)
+        b.label = rs.randint(0, 5, (16, 1)).astype(np.float32)
+        b.batch_size = 16
+        out.append(b)
+    return out
+
+
+class TestMoELayer:
+    def test_shapes_and_training(self):
+        tr = _trainer("dev = cpu\n")
+        assert tr.net.node_shapes[3] == (16, 1, 1, 10)
+        g0 = np.asarray(tr.params[2]["gate"]).copy()
+        e0 = np.asarray(tr.params[2]["experts"]).copy()
+        for b in _batches():
+            tr.update(b)
+        assert not np.allclose(np.asarray(tr.params[2]["gate"]), g0)
+        assert not np.allclose(np.asarray(tr.params[2]["experts"]), e0)
+
+    def test_top_k_gating(self):
+        tr = _trainer("dev = cpu\n",
+                      CONF.replace("  nexpert = 8",
+                                   "  nexpert = 8\n  top_k = 2"))
+        for b in _batches(2):
+            tr.update(b)
+        # gate probs have at most top_k nonzeros per row
+        import jax.numpy as jnp
+        lay = tr.net.layers[2]
+        x2 = np.random.RandomState(0).rand(16, 12).astype(np.float32)
+        probs = np.asarray(lay._gate_probs(
+            jnp.asarray(x2), tr.params[2]["gate"]))
+        assert ((probs > 0).sum(axis=1) <= 2).all()
+        np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-5)
+
+    def test_requires_flat_input(self):
+        conf = CONF.replace("layer[+1:fc1] = fullc:fc1\n  nhidden = 12\n"
+                            "  init_sigma = 0.1\nlayer[+1] = relu\n", "")
+        conf = conf.replace("input_shape = 1,1,9", "input_shape = 3,4,4")
+        with pytest.raises(ValueError, match="flatten"):
+            _trainer("dev = cpu\n", conf)
+
+    def test_save_load_roundtrip(self):
+        import io
+        from cxxnet_tpu.utils import serializer
+        tr = _trainer("dev = cpu\n")
+        tr.update(_batches(1)[0])
+        buf = io.BytesIO()
+        tr.save_model(serializer.Writer(buf))
+        buf.seek(0)
+        tr2 = Trainer()
+        for k, v in parse_config_string(CONF + "dev = cpu\n"):
+            tr2.set_param(k, v)
+        tr2.load_model(serializer.Reader(buf))
+        np.testing.assert_array_equal(np.asarray(tr.params[2]["experts"]),
+                                      np.asarray(tr2.params[2]["experts"]))
+        assert tr2.net.layers[2].n_expert == 8
+
+
+class TestExpertParallelDSL:
+    def test_matches_single_device(self):
+        tr_ep = _trainer("dev = cpu:0-7\nexpert_parallel = 4\n")
+        tr_1 = _trainer("dev = cpu\n")
+        assert "ep" in tr_ep.mesh.axis_names
+        assert tr_ep.mesh.shape["ep"] == 4 and tr_ep.mesh.shape["data"] == 2
+        for b in _batches():
+            tr_ep.update(b)
+            tr_1.update(b)
+        for i in (0, 2, 3):
+            for k in tr_1.params[i]:
+                np.testing.assert_allclose(
+                    np.asarray(jax.device_get(tr_ep.params[i][k])),
+                    np.asarray(jax.device_get(tr_1.params[i][k])),
+                    rtol=2e-4, atol=2e-4,
+                    err_msg="layer %d key %s" % (i, k))
+
+    def test_experts_actually_sharded(self):
+        tr = _trainer("dev = cpu:0-7\nexpert_parallel = 8\n")
+        sh = tr.params[2]["experts"].sharding
+        assert "ep" in (sh.spec[0] if isinstance(sh.spec[0], tuple)
+                        else (sh.spec[0],))
+
+    def test_rejects_indivisible(self):
+        with pytest.raises(ValueError, match="divisible"):
+            _trainer("dev = cpu:0-7\nexpert_parallel = 3\n")
+
+
+class TestTopKTies:
+    def test_exact_k_under_ties(self):
+        import jax.numpy as jnp
+        from cxxnet_tpu.layer.layers import MoELayer
+        lay = MoELayer()
+        lay.n_expert = 6
+        lay.top_k = 2
+        lay.param.num_hidden = 4
+        # uniform gate -> all probabilities exactly tied
+        probs = np.asarray(lay._gate_probs(
+            jnp.zeros((5, 3)), jnp.zeros((6, 3))))
+        assert ((probs > 0).sum(axis=1) == 2).all()
+        np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-6)
