@@ -684,7 +684,14 @@ class AugmentIterator(IIterator):
 
     def _create_mean_img(self):
         """Compute and cache the dataset mean image
-        (reference CreateMeanImg, iter_augment_proc-inl.hpp:171-198)."""
+        (reference CreateMeanImg, iter_augment_proc-inl.hpp:171-198).
+
+        The mean lives in the NET-INPUT shape: it averages the augmented,
+        cropped, scaled outputs of one pass (meanfile_ready is False here,
+        so _set_data takes the no-subtract branch) — the reference sizes
+        meanimg_ to shape_ and accumulates img_, which is what makes
+        subtraction valid when geometric augmentation changes the raw
+        image size."""
         if self.silent == 0:
             print("cannot find %s: create mean image, this will take "
                   "some time..." % self.name_meanimg)
@@ -692,7 +699,8 @@ class AugmentIterator(IIterator):
         mean = None
         cnt = 0
         while self.base.next():
-            d = self.base.value().data
+            self._set_data(self.base.value())
+            d = self.out.data
             if mean is None:
                 mean = d.astype(np.float64).copy()
             else:
@@ -701,6 +709,9 @@ class AugmentIterator(IIterator):
         assert cnt > 0, "input iterator failed."
         self.meanimg = (mean / cnt).astype(np.float32)
         from ..utils import serializer
+        parent = os.path.dirname(self.name_meanimg)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
         with open(self.name_meanimg, "wb") as f:
             serializer.Writer(f).write_tensor(self.meanimg)
         if self.silent == 0:

@@ -195,6 +195,17 @@ class NeuralNet:
         cdt = self.compute_dtype
         values: List[Optional[jnp.ndarray]] = [None] * cfg.param.num_nodes
         values[0] = self._normalize_input(jnp.asarray(data))
+        if self.node_shapes:
+            # fail fast on iterator/net shape drift (e.g. a flat mnist
+            # iterator feeding a conv net declared 1,28,28) instead of
+            # letting a zero-sized conv output surface as a confusing
+            # matmul error downstream
+            check(tuple(values[0].shape[1:]) == tuple(self.node_shapes[0][1:]),
+                  "input batch shape %r does not match the declared "
+                  "input_shape %r — check the iterator configuration "
+                  "(e.g. mnist input_flat)"
+                  % (tuple(values[0].shape[1:]),
+                     tuple(self.node_shapes[0][1:])))
         for i, ex in enumerate(extra_data):
             values[i + 1] = jnp.asarray(ex)
         if cdt is not None:

@@ -1,0 +1,22 @@
+#!/bin/bash
+# Fetch MNIST and run the 15-round MLP recipe (reference example/MNIST/run.sh).
+# Offline (no network): pass --synth to generate a bit-identical-format
+# synthetic corpus instead (tests/synth_mnist.py).
+set -e
+cd "$(dirname "$0")"
+REPO=../..
+
+mkdir -p data
+if [ "$1" = "--synth" ]; then
+    python -c "import sys; sys.path.insert(0, '$REPO/tests'); \
+from synth_mnist import make_dataset; make_dataset('data')"
+else
+    for f in train-images-idx3-ubyte.gz train-labels-idx1-ubyte.gz \
+             t10k-images-idx3-ubyte.gz t10k-labels-idx1-ubyte.gz; do
+        [ -f "data/$f" ] || \
+            wget -P data "https://ossci-datasets.s3.amazonaws.com/mnist/$f"
+    done
+fi
+
+mkdir -p models
+python "$REPO/bin/cxxnet" MNIST.conf

@@ -1,0 +1,42 @@
+#!/bin/bash
+# Prepare the National Data Science Bowl plankton corpus and train
+# (reference example/kaggle_bowl/README.md). The raw data needs a Kaggle
+# account: download train.zip from
+#   https://www.kaggle.com/c/datasciencebowl/data
+# into this directory first, then run this script. Offline (no data): pass
+# --synth to train the same net on a generated image corpus.
+set -e
+cd "$(dirname "$0")"
+REPO=../..
+
+if [ "$1" = "--synth" ]; then
+    python - <<'EOF'
+import os
+import sys
+sys.path.insert(0, os.path.join("..", "..", "tests"))
+sys.path.insert(0, os.path.join("..", "..", "tools"))
+from test_io_image import make_images
+from im2bin import im2bin
+# class-colored jpegs stand in for the 121 plankton classes
+make_images("imgs", n=1210, n_class=121, hw=48)
+lines = open(os.path.join("imgs", "img.lst")).readlines()
+open("tr.lst", "w").writelines(lines[:1100])
+open("va.lst", "w").writelines(lines[1100:])
+print("packed", im2bin("tr.lst", "imgs", "tr.bin"), "train /",
+      im2bin("va.lst", "imgs", "va.bin"), "val images")
+EOF
+    mkdir -p models
+    # a short smoke run on the generated corpus; drop the override to
+    # train the full 100-round recipe
+    python "$REPO/bin/cxxnet" bowl.conf max_round=3
+    exit 0
+fi
+
+[ -f train.zip ] || { echo "download train.zip from Kaggle first"; exit 1; }
+unzip -qn train.zip
+python "$REPO/tools/make_imglist.py" train tr.lst 0.1 va.lst
+python "$REPO/tools/im2bin.py" tr.lst train/ tr.bin
+python "$REPO/tools/im2bin.py" va.lst train/ va.bin
+
+mkdir -p models
+python "$REPO/bin/cxxnet" bowl.conf

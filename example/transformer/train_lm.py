@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Train the lm.conf transformer on a synthetic character grammar.
+
+The corpus is deterministic-but-nontrivial: each sequence is a cyclic
+alphabet walk with a random phase and stride, so the next character is
+exactly predictable from the prefix — a trained causal LM must reach
+~100% next-token accuracy, an untrained one sits near 1/vocab.
+
+Usage: python train_lm.py [steps]   (~400 adam steps reach 100%)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.nnet.trainer import Trainer
+from cxxnet_tpu.utils.config import ConfigIterator
+
+VOCAB = 28
+SEQ = 64
+
+
+def make_batch(rs, batch=16):
+    """Cyclic walks: tok[t] = (phase + stride * t) % VOCAB."""
+    phase = rs.randint(0, VOCAB, (batch, 1))
+    stride = rs.randint(1, 5, (batch, 1))
+    t = np.arange(SEQ + 1)[None, :]
+    toks = (phase + stride * t) % VOCAB          # (b, SEQ+1)
+    b = DataBatch()
+    b.data = toks[:, :SEQ].reshape(batch, 1, 1, SEQ).astype(np.float32)
+    b.label = toks[:, 1:].astype(np.float32)     # next-token targets (b, SEQ)
+    b.batch_size = batch
+    return b
+
+
+def next_token_accuracy(tr, batch):
+    probs = tr.extract_feature(batch, "top[-1]")   # (b, VOCAB, 1, SEQ)
+    pred = probs.reshape(probs.shape[0], VOCAB, SEQ).argmax(axis=1)
+    # score the second half: the prefix there always determines the walk
+    half = SEQ // 2
+    return float((pred[:, half:] == batch.label[:, half:]).mean())
+
+
+def main(steps=400, dev=None):
+    conf = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lm.conf")
+    tr = Trainer()
+    for k, v in ConfigIterator(conf, ["dev=%s" % dev] if dev else []):
+        tr.set_param(k, v)
+    tr.init_model()
+    rs = np.random.RandomState(0)
+    eval_b = make_batch(np.random.RandomState(999))
+    print("accuracy before: %.3f" % next_token_accuracy(tr, eval_b))
+    for i in range(steps):
+        tr.update(make_batch(rs))
+        if (i + 1) % 50 == 0:
+            print("step %d: accuracy %.3f"
+                  % (i + 1, next_token_accuracy(tr, eval_b)))
+    acc = next_token_accuracy(tr, eval_b)
+    print("final next-token accuracy: %.3f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 400)

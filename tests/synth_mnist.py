@@ -25,18 +25,8 @@ def write_idx_labels(path: str, labels: np.ndarray) -> None:
         f.write(labels.astype(np.uint8).tobytes())
 
 
-def make_dataset(dirname: str, n_train: int = 600, n_test: int = 200,
-                 n_class: int = 10, hw: int = 28, seed: int = 0):
-    """Create train/test idx.gz files; returns the four paths."""
-    rs = np.random.RandomState(seed)
-    protos = rs.rand(n_class, hw, hw) * 200
-
-    def gen(n, seed2):
-        rs2 = np.random.RandomState(seed2)
-        labels = rs2.randint(0, n_class, n)
-        imgs = protos[labels] + rs2.randn(n, hw, hw) * 20
-        return np.clip(imgs, 0, 255).astype(np.uint8), labels
-
+def _write_corpus(dirname, gen, n_train, n_test, seed):
+    """Shared idx-file layout for the corpus generators."""
     os.makedirs(dirname, exist_ok=True)
     tr_img, tr_lab = gen(n_train, seed + 1)
     te_img, te_lab = gen(n_test, seed + 2)
@@ -51,3 +41,59 @@ def make_dataset(dirname: str, n_train: int = 600, n_test: int = 200,
     write_idx_images(paths["test_img"], te_img)
     write_idx_labels(paths["test_lab"], te_lab)
     return paths
+
+
+def make_dataset(dirname: str, n_train: int = 600, n_test: int = 200,
+                 n_class: int = 10, hw: int = 28, seed: int = 0,
+                 noise: float = 20.0, class_sep: float = None):
+    """Create train/test idx.gz files; returns the four paths.
+
+    ``noise`` is the per-pixel gaussian corruption; ``class_sep`` (when
+    set) draws class prototypes within ±class_sep of a common base image,
+    so the aggregate signal-to-noise over hw*hw pixels — not just the
+    per-pixel SNR — controls the Bayes error. tools/quality_run.py uses
+    this to build a corpus with irreducible test error, the quality axis
+    real MNIST exercises."""
+    rs = np.random.RandomState(seed)
+    if class_sep is None:
+        protos = rs.rand(n_class, hw, hw) * 200
+    else:
+        base = rs.rand(hw, hw) * 120 + 40
+        protos = base + rs.uniform(-class_sep, class_sep,
+                                   (n_class, hw, hw))
+
+    def gen(n, seed2):
+        rs2 = np.random.RandomState(seed2)
+        labels = rs2.randint(0, n_class, n)
+        imgs = protos[labels] + rs2.randn(n, hw, hw) * noise
+        return np.clip(imgs, 0, 255).astype(np.uint8), labels
+
+    return _write_corpus(dirname, gen, n_train, n_test, seed)
+
+
+def make_glyph_dataset(dirname: str, n_train: int = 10000,
+                       n_test: int = 2000, n_class: int = 10, hw: int = 28,
+                       seed: int = 0, jitter: int = 5, noise: float = 60.0,
+                       amp: float = 100.0):
+    """MNIST-structured corpus: each class is a distinct glyph (random
+    coarse binary shape) drawn at a jittered position over pixel noise.
+    Translation jitter + noise make test error land in the low percents
+    and reward convolutional inductive bias the way real digits do
+    (tools/quality_run.py hard corpus)."""
+    assert hw % 2 == 0, "glyph corpus needs an even image size"
+    rs = np.random.RandomState(seed)
+    g = hw // 2                      # coarse glyph canvas, upsampled 2x
+    glyphs = (rs.rand(n_class, g, g) < 0.45).astype(np.float32)
+    glyphs = glyphs.repeat(2, axis=1).repeat(2, axis=2)  # (n_class, hw, hw)
+
+    def gen(n, seed2):
+        rs2 = np.random.RandomState(seed2)
+        labels = rs2.randint(0, n_class, n)
+        imgs = rs2.randn(n, hw, hw) * noise + 30
+        for i, lab in enumerate(labels):
+            dy, dx = rs2.randint(-jitter, jitter + 1, 2)
+            gl = np.roll(np.roll(glyphs[lab], dy, axis=0), dx, axis=1)
+            imgs[i] += gl * amp
+        return np.clip(imgs, 0, 255).astype(np.uint8), labels
+
+    return _write_corpus(dirname, gen, n_train, n_test, seed)

@@ -21,17 +21,27 @@ PAGE_INTS = 1 << 14  # 64 KiB test pages
 
 
 def make_images(dirname, n=24, n_class=3, hw=36, seed=0):
-    """Class-colored jpegs + a reference-format .lst file."""
+    """Class-separable jpegs + a reference-format .lst file. Up to 3
+    classes get one bright RGB channel each (the original scheme the io
+    tests assert on); more classes get per-class random proto textures."""
     rs = np.random.RandomState(seed)
     os.makedirs(dirname, exist_ok=True)
     lst_path = os.path.join(dirname, "img.lst")
+    protos = None
+    if n_class > 3:
+        protos = rs.randint(30, 220, (n_class, hw, hw, 3)).astype(np.uint8)
     with open(lst_path, "w") as lst:
         for i in range(n):
             label = i % n_class
-            img = np.zeros((hw, hw, 3), np.uint8)
-            # cv2.imwrite takes BGR; make RGB channel `label` the bright one
-            img[:, :, 2 - label] = 200
-            img += rs.randint(0, 40, img.shape).astype(np.uint8)
+            if protos is None:
+                img = np.zeros((hw, hw, 3), np.uint8)
+                # cv2.imwrite takes BGR; RGB channel `label` is the bright one
+                img[:, :, 2 - label] = 200
+                img += rs.randint(0, 40, img.shape).astype(np.uint8)
+            else:
+                img = np.clip(protos[label].astype(np.int32) +
+                              rs.randint(-20, 20, (hw, hw, 3)),
+                              0, 255).astype(np.uint8)
             fname = "img_%03d.jpg" % i
             cv2.imwrite(os.path.join(dirname, fname), img)
             lst.write("%d %d %s\n" % (i, label, fname))

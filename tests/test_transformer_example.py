@@ -1,0 +1,32 @@
+"""The example/transformer LM: DSL-built causal transformer learns a
+deterministic grammar (exercises embed/attention/add/conv-FFN/seq-softmax
+end to end, incl. the softmax seq=1 loss)."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                "..", "example", "transformer"))
+
+import train_lm  # noqa: E402
+
+
+def test_lm_learns_grammar():
+    from cxxnet_tpu.nnet.trainer import Trainer
+    from cxxnet_tpu.utils.config import ConfigIterator
+    conf = os.path.join(os.path.dirname(__file__), "..",
+                        "example", "transformer", "lm.conf")
+    tr = Trainer()
+    for k, v in ConfigIterator(conf, ["dev=cpu"]):
+        tr.set_param(k, v)
+    tr.init_model()
+    rs = np.random.RandomState(0)
+    eval_b = train_lm.make_batch(np.random.RandomState(999))
+    before = train_lm.next_token_accuracy(tr, eval_b)
+    assert before < 0.2, "untrained accuracy should be near chance"
+    for _ in range(120):
+        tr.update(train_lm.make_batch(rs))
+    after = train_lm.next_token_accuracy(tr, eval_b)
+    assert after > 0.7, "LM failed to learn the grammar: %.3f" % after

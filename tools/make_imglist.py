@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Build a reference-format .lst (``index label relpath``) from a
+class-per-directory image tree, optionally holding out a validation split.
+
+Usage: make_imglist.py <image_root> <train.lst> [val_frac] [val.lst]
+
+Counterpart of the ad-hoc list-building steps in the reference's example
+READMEs (example/kaggle_bowl/README.md, example/ImageNet/README.md); class
+ids are assigned by sorted directory name, and the split is a seeded
+Bernoulli draw per file (reproducible; with very small classes a class can
+land entirely in train — acceptable for held-out evaluation).
+"""
+
+import os
+import sys
+
+EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def build(root, train_lst, val_frac=0.0, val_lst=None, seed=42):
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    assert classes, "no class directories under %s" % root
+    import random
+    rnd = random.Random(seed)
+    idx = 0
+    n_tr = n_va = 0
+    ftr = open(train_lst, "w")
+    fva = open(val_lst, "w") if val_lst else None
+    try:
+        for label, cname in enumerate(classes):
+            cdir = os.path.join(root, cname)
+            for fname in sorted(os.listdir(cdir)):
+                if not fname.lower().endswith(EXTS):
+                    continue
+                line = "%d\t%d\t%s\n" % (idx, label,
+                                         os.path.join(cname, fname))
+                if fva is not None and rnd.random() < val_frac:
+                    fva.write(line)
+                    n_va += 1
+                else:
+                    ftr.write(line)
+                    n_tr += 1
+                idx += 1
+    finally:
+        ftr.close()
+        if fva:
+            fva.close()
+    return len(classes), n_tr, n_va
+
+
+if __name__ == "__main__":
+    if len(sys.argv) < 3:
+        print(__doc__)
+        sys.exit(1)
+    root, train_lst = sys.argv[1], sys.argv[2]
+    val_frac = float(sys.argv[3]) if len(sys.argv) > 3 else 0.0
+    val_lst = sys.argv[4] if len(sys.argv) > 4 else None
+    nc, ntr, nva = build(root, train_lst, val_frac, val_lst)
+    print("%d classes, %d train, %d val" % (nc, ntr, nva))
