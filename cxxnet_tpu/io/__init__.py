@@ -26,6 +26,11 @@ def create_iterator(cfg: List[Tuple[str, str]]) -> IIterator:
                 assert it is None, "mnist can not chain over other iterator"
                 it = MNISTIterator()
                 continue
+            if val == "libsvm":
+                assert it is None, "libsvm can not chain over other iterator"
+                from .iter_libsvm import LibSVMIterator
+                it = LibSVMIterator()
+                continue
             if val in ("imgbin", "imgbinx", "img"):
                 assert it is None, \
                     "image iterators can not chain over other iterator"

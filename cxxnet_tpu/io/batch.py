@@ -158,6 +158,9 @@ class ThreadBufferIterator(IIterator):
         out.batch_size = b.batch_size
         out.num_batch_padd = b.num_batch_padd
         out.extra_data = [np.array(e, copy=True) for e in b.extra_data]
+        if b.sparse_row_ptr is not None:
+            out.sparse_row_ptr = np.array(b.sparse_row_ptr, copy=True)
+            out.sparse_data = np.array(b.sparse_data, copy=True)
         return out
 
     def _poll_stop(self) -> bool:
@@ -282,6 +285,9 @@ class DenseBufferIterator(IIterator):
                               if b.inst_index is not None else None)
             out.batch_size = b.batch_size
             out.num_batch_padd = b.num_batch_padd
+            if b.sparse_row_ptr is not None:
+                out.sparse_row_ptr = np.array(b.sparse_row_ptr, copy=True)
+                out.sparse_data = np.array(b.sparse_data, copy=True)
             self.buffer.append(out)
             if len(self.buffer) >= self.max_nbatch:
                 break

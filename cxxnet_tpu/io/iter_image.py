@@ -687,25 +687,35 @@ class AugmentIterator(IIterator):
         (reference CreateMeanImg, iter_augment_proc-inl.hpp:171-198).
 
         The mean lives in the NET-INPUT shape: it averages the augmented,
-        cropped, scaled outputs of one pass (meanfile_ready is False here,
-        so _set_data takes the no-subtract branch) — the reference sizes
+        cropped outputs of one pass (meanfile_ready is False here, so
+        _set_data takes the no-subtract branch) — the reference sizes
         meanimg_ to shape_ and accumulates img_, which is what makes
         subtraction valid when geometric augmentation changes the raw
-        image size."""
+        image size. One deliberate divergence: the reference accumulates
+        img_ AFTER `* scale_` yet subtracts it from raw pixels at use
+        (iter_augment_proc-inl.hpp:142,148 — with divideby set, mean
+        centering is silently ~nullified); we accumulate unscaled values
+        so (x - mean) * scale means what it says. The cached file format
+        is ours (utils/serializer), not mshadow's, so no interchange is
+        lost."""
         if self.silent == 0:
             print("cannot find %s: create mean image, this will take "
                   "some time..." % self.name_meanimg)
         self.base.before_first()
         mean = None
         cnt = 0
-        while self.base.next():
-            self._set_data(self.base.value())
-            d = self.out.data
-            if mean is None:
-                mean = d.astype(np.float64).copy()
-            else:
-                mean += d
-            cnt += 1
+        saved_scale, self.scale = self.scale, 1.0
+        try:
+            while self.base.next():
+                self._set_data(self.base.value())
+                d = self.out.data
+                if mean is None:
+                    mean = d.astype(np.float64).copy()
+                else:
+                    mean += d
+                cnt += 1
+        finally:
+            self.scale = saved_scale
         assert cnt > 0, "input iterator failed."
         self.meanimg = (mean / cnt).astype(np.float32)
         from ..utils import serializer

@@ -170,3 +170,102 @@ class TestSparseBatch:
         c = b.shallow_copy()
         assert c.sparse_row_ptr is b.sparse_row_ptr
         assert c.sparse_data is b.sparse_data
+
+
+class TestLibSVMIterator:
+    """The CSR producer: libsvm text -> sparse batches -> dense bridge ->
+    a net trains through the CLI-style chain."""
+
+    def _write_corpus(self, path, n=200, nf=20, seed=0):
+        rs = np.random.RandomState(seed)
+        with open(path, "w") as f:
+            for _ in range(n):
+                label = rs.randint(0, 2)
+                # class-dependent sparse features
+                base = 0 if label == 0 else nf // 2
+                idxs = sorted(rs.choice(nf // 2, 4, replace=False) + base)
+                f.write("%d %s\n" % (label, " ".join(
+                    "%d:%.3f" % (i, rs.rand() + 0.5) for i in idxs)))
+
+    def test_batches_carry_csr_and_dense(self, tmp_path):
+        from cxxnet_tpu.io import create_iterator
+        p = str(tmp_path / "t.svm")
+        self._write_corpus(p)
+        it = create_iterator(list(parse_config_string("""
+iter = libsvm
+  path_data = "%s"
+  num_feature = 20
+  batch_size = 32
+  shuffle = 1
+  round_batch = 1
+""" % p)))
+        it.init()
+        seen = 0
+        for b in it:
+            assert b.sparse_row_ptr is not None
+            assert b.sparse_data.dtype == sparse_entry_t
+            assert b.data.shape == (32, 1, 1, 20)
+            # dense view must agree with the CSR block
+            np.testing.assert_array_equal(
+                b.data.reshape(32, 20), b.sparse_to_dense(20))
+            seen += b.batch_size - b.num_batch_padd
+        assert seen == 200
+
+    def test_trains_through_trainer(self, tmp_path):
+        from cxxnet_tpu.io import create_iterator
+        p = str(tmp_path / "t.svm")
+        self._write_corpus(p)
+        it = create_iterator(list(parse_config_string("""
+iter = libsvm
+  path_data = "%s"
+  num_feature = 20
+  batch_size = 32
+  shuffle = 1
+  round_batch = 1
+  silent = 1
+""" % p)))
+        it.init()
+        tr = _trainer("""
+netconfig = start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 16
+  init_sigma = 0.3
+layer[+1] = relu
+layer[+1:fc2] = fullc:fc2
+  nhidden = 2
+  init_sigma = 0.3
+layer[+0] = softmax
+netconfig = end
+input_shape = 1,1,20
+batch_size = 32
+eta = 0.3
+dev = cpu
+""")
+        for _ in range(6):
+            for b in it:
+                tr.update(b)
+        errs = []
+        for b in it:
+            pred = tr.predict(b)
+            keep = b.batch_size - b.num_batch_padd
+            errs.append((pred[:keep] != b.label[:keep, 0]).mean())
+        assert np.mean(errs) < 0.05, np.mean(errs)
+
+    def test_csr_survives_threadbuffer(self, tmp_path):
+        from cxxnet_tpu.io import create_iterator
+        p = str(tmp_path / "t.svm")
+        self._write_corpus(p, n=64)
+        it = create_iterator(list(parse_config_string("""
+iter = libsvm
+  path_data = "%s"
+  num_feature = 20
+  batch_size = 32
+  silent = 1
+iter = threadbuffer
+""" % p)))
+        it.init()
+        for b in it:
+            assert b.sparse_row_ptr is not None
+            np.testing.assert_array_equal(
+                b.data.reshape(32, 20), b.sparse_to_dense(20))
+        it.close()

@@ -18,6 +18,8 @@ EXTS = (".jpg", ".jpeg", ".png", ".bmp")
 
 
 def build(root, train_lst, val_frac=0.0, val_lst=None, seed=42):
+    assert val_frac == 0.0 or val_lst, \
+        "val_frac set but no val.lst path given — the split would be lost"
     classes = sorted(d for d in os.listdir(root)
                      if os.path.isdir(os.path.join(root, d)))
     assert classes, "no class directories under %s" % root
