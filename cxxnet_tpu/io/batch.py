@@ -149,19 +149,6 @@ class ThreadBufferIterator(IIterator):
             print("ThreadBufferIterator: buffer_size=%d" % self.buffer_size)
         self._start_loader()
 
-    def _deep_copy(self, b: DataBatch) -> DataBatch:
-        out = DataBatch()
-        out.data = np.array(b.data, copy=True)
-        out.label = np.array(b.label, copy=True)
-        out.inst_index = (np.array(b.inst_index, copy=True)
-                          if b.inst_index is not None else None)
-        out.batch_size = b.batch_size
-        out.num_batch_padd = b.num_batch_padd
-        out.extra_data = [np.array(e, copy=True) for e in b.extra_data]
-        if b.sparse_row_ptr is not None:
-            out.sparse_row_ptr = np.array(b.sparse_row_ptr, copy=True)
-            out.sparse_data = np.array(b.sparse_data, copy=True)
-        return out
 
     def _poll_stop(self) -> bool:
         try:
@@ -180,7 +167,7 @@ class ThreadBufferIterator(IIterator):
             try:
                 self.base.before_first()
                 while self.base.next():
-                    item = self._deep_copy(self.base.value())
+                    item = self.base.value().deep_copy()
                     while True:
                         if self._poll_stop():
                             return
@@ -277,18 +264,7 @@ class DenseBufferIterator(IIterator):
         self.buffer = []
         self.base.before_first()
         while self.base.next():
-            b = self.base.value()
-            out = DataBatch()
-            out.data = np.array(b.data, copy=True)
-            out.label = np.array(b.label, copy=True)
-            out.inst_index = (np.array(b.inst_index, copy=True)
-                              if b.inst_index is not None else None)
-            out.batch_size = b.batch_size
-            out.num_batch_padd = b.num_batch_padd
-            if b.sparse_row_ptr is not None:
-                out.sparse_row_ptr = np.array(b.sparse_row_ptr, copy=True)
-                out.sparse_data = np.array(b.sparse_data, copy=True)
-            self.buffer.append(out)
+            self.buffer.append(self.base.value().deep_copy())
             if len(self.buffer) >= self.max_nbatch:
                 break
         if self.silent == 0:

@@ -65,6 +65,23 @@ class DataBatch:
         out.sparse_data = self.sparse_data
         return out
 
+    def deep_copy(self) -> "DataBatch":
+        """Field-complete copy for buffering iterators (threadbuffer /
+        membuffer) — one definition so new fields can't silently diverge
+        between the adapters' copies."""
+        out = DataBatch()
+        out.data = np.array(self.data, copy=True)
+        out.label = np.array(self.label, copy=True)
+        out.inst_index = (np.array(self.inst_index, copy=True)
+                          if self.inst_index is not None else None)
+        out.batch_size = self.batch_size
+        out.num_batch_padd = self.num_batch_padd
+        out.extra_data = [np.array(e, copy=True) for e in self.extra_data]
+        if self.sparse_row_ptr is not None:
+            out.sparse_row_ptr = np.array(self.sparse_row_ptr, copy=True)
+            out.sparse_data = np.array(self.sparse_data, copy=True)
+        return out
+
     # --- sparse helpers ----------------------------------------------------
     def set_sparse(self, insts: List["SparseInst"]) -> None:
         """Fill the CSR fields from per-instance entry lists."""

@@ -514,6 +514,8 @@ class AugmentIterator(IIterator):
     illumination (reference AugmentIterator)."""
 
     kRandMagic = 0
+    # mean-image cache header; bump when the stored semantics change
+    _MEAN_MAGIC = b"CXNMEAN2"
 
     def __init__(self, base: IIterator):
         self.base = base
@@ -593,12 +595,23 @@ class AugmentIterator(IIterator):
         self.meanimg = None
         if self.name_meanimg:
             if os.path.exists(self.name_meanimg):
-                if self.silent == 0:
-                    print("loading mean image from %s" % self.name_meanimg)
                 from ..utils import serializer
                 with open(self.name_meanimg, "rb") as f:
-                    self.meanimg = serializer.Reader(f).read_tensor()
-                self.meanfile_ready = True
+                    magic = f.read(len(self._MEAN_MAGIC))
+                    if magic == self._MEAN_MAGIC:
+                        if self.silent == 0:
+                            print("loading mean image from %s"
+                                  % self.name_meanimg)
+                        self.meanimg = serializer.Reader(f).read_tensor()
+                        self.meanfile_ready = True
+                    else:
+                        # pre-versioned cache: written with scaled-mean
+                        # semantics (and possibly the raw-image shape) —
+                        # regenerate rather than silently mis-subtract
+                        print("mean image %s predates the versioned "
+                              "format; regenerating" % self.name_meanimg)
+                if not self.meanfile_ready:
+                    self._create_mean_img()
             else:
                 self._create_mean_img()
 
@@ -723,6 +736,7 @@ class AugmentIterator(IIterator):
         if parent:
             os.makedirs(parent, exist_ok=True)
         with open(self.name_meanimg, "wb") as f:
+            f.write(self._MEAN_MAGIC)
             serializer.Writer(f).write_tensor(self.meanimg)
         if self.silent == 0:
             print("save mean image to %s.." % self.name_meanimg)

@@ -409,3 +409,110 @@ def transformer_lm_trainer(vocab: int = 50, seq: int = 16,
         tr.set_param(k, v)
     tr.init_model()
     return tr
+
+
+def _res_block(idx: int, node_in: str, nch: int, stride: int = 1,
+               project: bool = False) -> Tuple[str, str]:
+    """Basic residual block (two 3x3 convs + batch_norm, identity or
+    1x1-projection shortcut, post-add relu), expressed in the layer DSL —
+    beyond the reference's era (it ships concat but no residual nets); the
+    `add` layer makes the family expressible."""
+    p = "rb%d" % idx
+    main_in = "%s_s0" % p
+    short_in = "%s_s1" % p
+    txt = "layer[%s->%s,%s] = split\n" % (node_in, main_in, short_in)
+    txt += """layer[{mi}->{p}_c1] = conv:{p}_c1
+  kernel_size = 3
+  pad = 1
+  stride = {stride}
+  nchannel = {nch}
+  random_type = kaiming
+  no_bias = 1
+layer[{p}_c1->{p}_b1] = batch_norm:{p}_b1
+layer[{p}_b1->{p}_r1] = relu
+layer[{p}_r1->{p}_c2] = conv:{p}_c2
+  kernel_size = 3
+  pad = 1
+  nchannel = {nch}
+  random_type = kaiming
+  no_bias = 1
+layer[{p}_c2->{p}_b2] = batch_norm:{p}_b2
+""".format(p=p, mi=main_in, nch=nch, stride=stride)
+    if project:
+        txt += """layer[{si}->{p}_sc] = conv:{p}_sc
+  kernel_size = 1
+  stride = {stride}
+  nchannel = {nch}
+  random_type = kaiming
+  no_bias = 1
+layer[{p}_sc->{p}_sb] = batch_norm:{p}_sb
+layer[{p}_b2,{p}_sb->{p}_add] = add
+""".format(p=p, si=short_in, nch=nch, stride=stride)
+    else:
+        txt += "layer[%s_b2,%s->%s_add] = add\n" % (p, short_in, p)
+    txt += "layer[%s_add->%s_out] = relu\n" % (p, p)
+    return txt, "%s_out" % p
+
+
+def resnet_netconfig(depths=(2, 2, 2, 2), base_ch: int = 64,
+                     n_class: int = 1000, final_pool: int = 7) -> str:
+    """ResNet-18-shaped netconfig (depths=(2,2,2,2)); shrink depths/base_ch
+    for tests."""
+    txt = "netconfig = start\n"
+    txt += """layer[0->stem] = conv:stem
+  kernel_size = 7
+  pad = 3
+  stride = 2
+  nchannel = %d
+  random_type = kaiming
+  no_bias = 1
+layer[stem->stem_b] = batch_norm:stem_b
+layer[stem_b->stem_r] = relu
+layer[stem_r->stem_p] = max_pooling
+  kernel_size = 3
+  stride = 2
+""" % base_ch
+    node = "stem_p"
+    idx = 0
+    for stage, n_blocks in enumerate(depths):
+        nch = base_ch * (2 ** stage)
+        for b in range(n_blocks):
+            first = (b == 0 and stage > 0)
+            blk, node = _res_block(idx, node, nch,
+                                   stride=2 if first else 1,
+                                   project=first)
+            txt += blk
+            idx += 1
+    txt += """layer[%s->gap] = avg_pooling
+  kernel_size = %d
+  stride = %d
+layer[gap->flat] = flatten
+layer[flat->fc] = fullc:fc
+  nhidden = %d
+  random_type = kaiming
+layer[fc->fc] = softmax
+netconfig = end
+""" % (node, final_pool, final_pool, n_class)
+    return txt
+
+
+def resnet_trainer(batch_size: int = 128, input_hw: int = 224,
+                   dev: str = "tpu", n_class: int = 1000,
+                   depths=(2, 2, 2, 2), base_ch: int = 64,
+                   extra_cfg: str = "") -> Trainer:
+    """ResNet-18-shaped trainer (shrink depths/base_ch/input_hw for
+    tests)."""
+    # stem(2) * pool(2) * one stride-2 per stage after the first
+    downsample = 4 * (2 ** (len(depths) - 1))
+    final_pool = max(input_hw // downsample, 1)
+    conf = (resnet_netconfig(depths, base_ch, n_class,
+                             final_pool=final_pool) +
+            "input_shape = 3,%d,%d\n" % (input_hw, input_hw) +
+            "batch_size = %d\n" % batch_size +
+            "eta = 0.1\nmomentum = 0.9\nwd = 0.0001\n" +
+            "dev = %s\n" % dev + extra_cfg)
+    tr = Trainer()
+    for k, v in parse_config_string(conf):
+        tr.set_param(k, v)
+    tr.init_model()
+    return tr

@@ -1,0 +1,31 @@
+
+
+class TestResNet:
+    def test_memorizes_batch(self):
+        import numpy as np
+        from cxxnet_tpu.models import resnet_trainer
+        from cxxnet_tpu.io.data import DataBatch
+        tr = resnet_trainer(batch_size=8, input_hw=32, dev="cpu",
+                            n_class=4, depths=(1, 1), base_ch=8,
+                            extra_cfg="eta = 0.05\n")
+        rs = np.random.RandomState(0)
+        b = DataBatch()
+        b.data = rs.rand(8, 3, 32, 32).astype(np.float32)
+        b.label = rs.randint(0, 4, (8, 1)).astype(np.float32)
+        b.batch_size = 8
+        for _ in range(40):
+            tr.update(b)
+        pred = tr.predict(b)
+        assert (pred == b.label[:, 0]).mean() == 1.0
+
+    def test_resnet18_shape_stack(self):
+        from cxxnet_tpu.models import resnet_netconfig
+        from cxxnet_tpu.nnet.config import NetConfig
+        from cxxnet_tpu.nnet.net import NeuralNet
+        from cxxnet_tpu.utils.config import parse_config_string
+        conf = resnet_netconfig() + "input_shape = 3,224,224\n"
+        cfg = NetConfig()
+        cfg.configure(parse_config_string(conf))
+        net = NeuralNet(cfg, 2)
+        # stem/2 + pool/2 + three stage-first strides -> 224/32 = 7
+        assert net.node_shapes[cfg.node_name_map["gap"]] == (2, 512, 1, 1)
