@@ -46,12 +46,18 @@ except ImportError:  # pragma: no cover
 NEG_INF = -1e30  # finite stand-in for -inf: keeps exp()/max() NaN-free
 
 
-def _causal_mask(s, q_blk, kv_blk, block_q, block_k):
-    qpos = q_blk * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
+def _mask(s, q_blk, kv_blk, block_q, block_k, causal, kv_len):
+    """Causal and/or padded-tail masking of a score tile. kv_len is the
+    true (pre-padding) sequence length — static, so the where() folds away
+    entirely for tile-aligned inputs."""
     kpos = kv_blk * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
-    return jnp.where(qpos >= kpos, s, NEG_INF)
+    keep = kpos < kv_len
+    if causal:
+        qpos = q_blk * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        keep = jnp.logical_and(keep, qpos >= kpos)
+    return jnp.where(keep, s, NEG_INF)
 
 
 def _block_needed(causal, q_blk, kv_blk, block_q, block_k):
@@ -63,7 +69,8 @@ def _block_needed(causal, q_blk, kv_blk, block_q, block_k):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k):
+                m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k,
+                kv_len, padded):
     kv_i = pl.program_id(2)
     n_kv = pl.num_programs(2)
     q_blk = pl.program_id(1)
@@ -82,8 +89,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale    # (bq, bk) f32
-        if causal:
-            s = _causal_mask(s, q_blk, kv_i, block_q, block_k)
+        if causal or padded:
+            s = _mask(s, q_blk, kv_i, block_q, block_k, causal, kv_len)
         m_prev = m_scr[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
@@ -102,7 +109,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, scale, causal, block_q, block_k):
+               dq_scr, *, scale, causal, block_q, block_k, kv_len, padded):
     kv_i = pl.program_id(2)
     n_kv = pl.num_programs(2)
     q_blk = pl.program_id(1)
@@ -117,8 +124,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(
             q, k, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal:
-            s = _causal_mask(s, q_blk, kv_i, block_q, block_k)
+        if causal or padded:
+            s = _mask(s, q_blk, kv_i, block_q, block_k, causal, kv_len)
         p = jnp.exp(s - lse_ref[0])                         # (bq, bk) f32
         dp = jax.lax.dot_general(
             do, v, dimension_numbers=(((1,), (1,)), ((), ())),
@@ -134,7 +141,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr,
-                *, scale, causal, block_q, block_k):
+                *, scale, causal, block_q, block_k, kv_len, padded):
     q_i = pl.program_id(2)
     n_q = pl.num_programs(2)
     kv_blk = pl.program_id(1)
@@ -151,8 +158,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale     # (bq, bk)
-        if causal:
-            s = _causal_mask(s, q_i, kv_blk, block_q, block_k)
+        if causal or padded:
+            s = _mask(s, q_i, kv_blk, block_q, block_k, causal, kv_len)
         p = jnp.exp(s - lse_ref[0])
         dv_scr[...] += jax.lax.dot_general(
             p.astype(do.dtype), do,
@@ -174,19 +181,19 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _pick_block(L: int, target: int = 256) -> int:
-    """Largest sequence tile that divides L: lane-aligned (multiple of 128)
-    so the (bq, bk) score tile maps onto the MXU cleanly."""
-    for b in (target, 128):
-        if L % b == 0:
-            return b
-    return 0
+    """Sequence tile: lane-aligned (multiple of 128) so the (bq, bk) score
+    tile maps onto the MXU cleanly; L is padded up to a tile multiple."""
+    return target if L >= target else 128
+
+
+def _padded_len(L: int, block: int) -> int:
+    return -(-L // block) * block
 
 
 def supports(L: int, d: int) -> bool:
-    """Shapes the kernel path accepts: lane-aligned sequence tiles and a
-    sublane-aligned head dim."""
-    return (pltpu is not None and L >= 128 and _pick_block(L) > 0
-            and d % 8 == 0)
+    """Shapes the kernel path accepts: any L >= 128 (padded to a lane-
+    aligned tile, tail masked in-kernel) and a sublane-aligned head dim."""
+    return pltpu is not None and L >= 128 and d % 8 == 0
 
 
 def _dims():
@@ -215,19 +222,26 @@ def _merge_bh(x):
     return x.reshape(b * h, L, d)
 
 
+def _pad_seq(x, Lp):
+    L = x.shape[1]
+    if L == Lp:
+        return x
+    return jnp.pad(x, ((0, 0), (0, Lp - L), (0, 0)))
+
+
 def _flash_fwd(q, k, v, causal, scale, interpret):
     b, h, L, d = q.shape
     if scale is None:
         scale = d ** -0.5
     bq = bk = _pick_block(L)
-    assert bq > 0, "flash_attention: unsupported seq length %d" % L
-    qf, kf, vf = _merge_bh(q), _merge_bh(k), _merge_bh(v)
+    Lp = _padded_len(L, bq)
+    qf, kf, vf = (_pad_seq(_merge_bh(t), Lp) for t in (q, k, v))
     bh = b * h
     kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                             block_q=bq, block_k=bk)
+                             block_q=bq, block_k=bk, kv_len=L, padded=Lp > L)
     out, lse = pl.pallas_call(
         kern,
-        grid=(bh, L // bq, L // bk),
+        grid=(bh, Lp // bq, Lp // bk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0)),
             pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0)),
@@ -238,8 +252,8 @@ def _flash_fwd(q, k, v, causal, scale, interpret):
             pl.BlockSpec((1, bq, 1), lambda g, i, j: (g, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, L, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, L, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, Lp, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, Lp, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -249,7 +263,7 @@ def _flash_fwd(q, k, v, causal, scale, interpret):
         compiler_params=None if interpret else _dims(),
         interpret=interpret,
     )(qf, kf, vf)
-    out = out.reshape(b, h, L, d)
+    out = out[:, :L].reshape(b, h, L, d)
     return out, (q, k, v, out, lse)
 
 
@@ -259,25 +273,29 @@ def _flash_bwd(causal, scale, interpret, res, g):
     if scale is None:
         scale = d ** -0.5
     bq = bk = _pick_block(L)
-    qf, kf, vf = _merge_bh(q), _merge_bh(k), _merge_bh(v)
-    dof, of = _merge_bh(g), _merge_bh(out)
+    Lp = _padded_len(L, bq)
+    qf, kf, vf = (_pad_seq(_merge_bh(t), Lp) for t in (q, k, v))
+    dof, of = (_pad_seq(_merge_bh(t), Lp) for t in (g, out))
     bh = b * h
     # D = rowsum(dO ∘ O), computed once here (cheap elementwise + reduce,
-    # XLA fuses it) and streamed to both kernels as a (bh, L, 1) tile input
+    # XLA fuses it) and streamed to both kernels as a (bh, Lp, 1) tile
+    # input; padded rows have dO = 0 so their D is 0 and every padded-row
+    # contribution to dk/dv vanishes
     delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32),
                     axis=-1, keepdims=True)
+    lse = _pad_seq(lse, Lp)
 
     q_spec_i = pl.BlockSpec((1, bq, d), lambda g_, i, j: (g_, i, 0))
     kv_spec_j = pl.BlockSpec((1, bk, d), lambda g_, i, j: (g_, j, 0))
     lse_spec_i = pl.BlockSpec((1, bq, 1), lambda g_, i, j: (g_, i, 0))
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk),
-        grid=(bh, L // bq, L // bk),
+                          block_q=bq, block_k=bk, kv_len=L, padded=Lp > L),
+        grid=(bh, Lp // bq, Lp // bk),
         in_specs=[q_spec_i, kv_spec_j, kv_spec_j, q_spec_i,
                   lse_spec_i, lse_spec_i],
         out_specs=q_spec_i,
-        out_shape=jax.ShapeDtypeStruct((bh, L, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, Lp, d), q.dtype),
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),
         ] if pltpu is not None else [],
@@ -291,14 +309,14 @@ def _flash_bwd(causal, scale, interpret, res, g):
     lse_spec_s = pl.BlockSpec((1, bq, 1), lambda g_, j, i: (g_, i, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk),
-        grid=(bh, L // bk, L // bq),
+                          block_q=bq, block_k=bk, kv_len=L, padded=Lp > L),
+        grid=(bh, Lp // bk, Lp // bq),
         in_specs=[q_spec_s, kv_spec_r, kv_spec_r, q_spec_s,
                   lse_spec_s, lse_spec_s],
         out_specs=[kv_spec_r, kv_spec_r],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, L, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, L, d), v.dtype),
+            jax.ShapeDtypeStruct((bh, Lp, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, Lp, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
@@ -309,7 +327,8 @@ def _flash_bwd(causal, scale, interpret, res, g):
     )(qf, kf, vf, dof, lse, delta)
 
     shape = (b, h, L, d)
-    return dq.reshape(shape), dk.reshape(shape), dv.reshape(shape)
+    return (dq[:, :L].reshape(shape), dk[:, :L].reshape(shape),
+            dv[:, :L].reshape(shape))
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

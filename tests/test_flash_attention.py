@@ -73,9 +73,25 @@ class TestFlashKernel:
     def test_supports(self):
         assert supports(256, 64)
         assert supports(8192, 128)
-        assert not supports(200, 64)     # not tileable
-        assert not supports(64, 64)      # too short
+        assert supports(200, 64)         # unaligned L: padded + tail-masked
+        assert not supports(64, 64)      # too short (dense is fine there)
         assert not supports(256, 63)     # unaligned head dim
+
+    @pytest.mark.parametrize("L", [200, 300])
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_unaligned_length_padded(self, L, causal):
+        q, k, v = _rand_qkv(np.random.RandomState(5), L=L)
+        out = flash_attention(q, k, v, causal, None, True)
+        ref = attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+        gf = jax.grad(lambda q, k, v: jnp.sum(jnp.sin(flash_attention(
+            q, k, v, causal, None, True))), argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda q, k, v: jnp.sum(jnp.sin(attention_reference(
+            q, k, v, causal=causal))), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
 
 
 class TestLayerDispatch:
