@@ -182,7 +182,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _pick_block(L: int, target: int = 256) -> int:
     """Sequence tile: lane-aligned (multiple of 128) so the (bq, bk) score
-    tile maps onto the MXU cleanly; L is padded up to a tile multiple."""
+    tile maps onto the MXU cleanly. Exact divisors are preferred (zero
+    padding); otherwise L is padded up to a multiple of the tile."""
+    for b in (target, 128):
+        if L % b == 0:
+            return b
     return target if L >= target else 128
 
 
@@ -283,7 +287,7 @@ def _flash_bwd(causal, scale, interpret, res, g):
     # contribution to dk/dv vanishes
     delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32),
                     axis=-1, keepdims=True)
-    lse = _pad_seq(lse, Lp)
+    # the saved lse residual is already padded: (bh, Lp, 1)
 
     q_spec_i = pl.BlockSpec((1, bq, d), lambda g_, i, j: (g_, i, 0))
     kv_spec_j = pl.BlockSpec((1, bk, d), lambda g_, i, j: (g_, j, 0))

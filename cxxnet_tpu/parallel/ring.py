@@ -49,8 +49,15 @@ def attention_reference(q, k, v, *, causal: bool = False,
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
+# per-step score tiles are capped at (RING_Q_CHUNK, skv): the local block
+# computation runs as a sequential lax.map over query chunks, so memory per
+# device stays O(chunk * skv) instead of O((L/n)^2) — the single-chip flash
+# kernel's tiling idea applied inside the ring step
+RING_Q_CHUNK = 1024
+
+
 def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
-                          scale: float):
+                          scale: float, q_chunk: int = 0):
     """Per-shard body: online-softmax over rotating K/V blocks.
 
     q: (b, h, sq, d) local query block; k, v: (b, h, skv, d) local key/value
@@ -62,52 +69,82 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
     b, h, sq, d = q.shape
     skv = k.shape[2]
     q_off = idx * sq
+    q_chunk = min(sq, q_chunk if q_chunk > 0 else RING_Q_CHUNK)
+    while sq % q_chunk != 0:     # largest divisor <= requested chunk
+        q_chunk -= 1
+    n_chunks = sq // q_chunk
 
-    m0 = jnp.full((b, h, sq), -jnp.inf, q.dtype)
-    l0 = jnp.zeros((b, h, sq), q.dtype)
-    acc0 = jnp.zeros((b, h, sq, d), q.dtype)
+    def chunked(arr):
+        # (b, h, sq, ...) -> (n_chunks, b, h, q_chunk, ...): lax.map's
+        # leading axis, so one (q_chunk, skv) score tile is live at a time
+        return arr.reshape(arr.shape[:2] + (n_chunks, q_chunk) +
+                           arr.shape[3:]).transpose(
+                               (2, 0, 1, 3) + tuple(
+                                   4 + i for i in range(arr.ndim - 3)))
+
+    # q and the (m, l, acc) carry live in chunked layout for the whole
+    # scan — the transposes happen once outside, not per ring step
+    q_ch = chunked(q)                                    # (nc, b, h, qc, d)
+    m0 = jnp.full((n_chunks, b, h, q_chunk), -jnp.inf, q.dtype)
+    l0 = jnp.zeros((n_chunks, b, h, q_chunk), q.dtype)
+    acc0 = jnp.zeros((n_chunks, b, h, q_chunk, d), q.dtype)
 
     def step(carry, t):
         k_blk, v_blk, m, l, acc = carry
         src = (idx - t) % n  # whose block we hold this step
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
-        if causal:
-            qpos = q_off + jnp.arange(sq)[:, None]
-            kpos = src * skv + jnp.arange(skv)[None, :]
-            s = jnp.where(qpos >= kpos, s, -jnp.inf)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        # guard fully-masked rows (all -inf): exp(-inf - -inf) -> use where
-        alpha = jnp.where(jnp.isinf(m) & jnp.isinf(m_new),
-                          jnp.zeros_like(m), jnp.exp(m - m_new))
-        p = jnp.exp(s - m_new[..., None])
-        p = jnp.where(jnp.isinf(s) & (s < 0), jnp.zeros_like(p), p)
-        l = l * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
+        kpos = src * skv + jnp.arange(skv)[None, :]
+
+        def one_chunk(args):
+            ci, q_c, m_c, l_c, acc_c = args
+            s = jnp.einsum("bhqd,bhkd->bhqk", q_c, k_blk) * scale
+            if causal:
+                qpos = (q_off + ci * q_chunk +
+                        jnp.arange(q_chunk)[:, None])
+                s = jnp.where(qpos >= kpos, s, -jnp.inf)
+            m_new = jnp.maximum(m_c, jnp.max(s, axis=-1))
+            # guard fully-masked rows (all -inf): exp(-inf - -inf)
+            alpha = jnp.where(jnp.isinf(m_c) & jnp.isinf(m_new),
+                              jnp.zeros_like(m_c), jnp.exp(m_c - m_new))
+            p = jnp.exp(s - m_new[..., None])
+            p = jnp.where(jnp.isinf(s) & (s < 0), jnp.zeros_like(p), p)
+            l_new = l_c * alpha + jnp.sum(p, axis=-1)
+            acc_new = acc_c * alpha[..., None] + \
+                jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
+            return m_new, l_new, acc_new
+
+        # remat: without it AD would save every chunk's (qc, skv) p tile,
+        # re-materializing the O(sq*skv) residual the chunking removes —
+        # the backward pass recomputes s/p per chunk instead
+        m, l, acc = lax.map(jax.checkpoint(one_chunk),
+                            (jnp.arange(n_chunks), q_ch, m, l, acc))
         # rotate K/V to the next device on the ring (skippable on the last
         # step, but keeping it unconditional keeps the scan body uniform)
         k_blk = collectives.ring_shift(k_blk, axis_name)
         v_blk = collectives.ring_shift(v_blk, axis_name)
-        return (k_blk, v_blk, m_new, l, acc), None
+        return (k_blk, v_blk, m, l, acc), None
 
     (_, _, _, l, acc), _ = lax.scan(step, (k, v, m0, l0, acc0),
                                     jnp.arange(n))
-    return acc / jnp.maximum(l, 1e-30)[..., None]
+    # back to (b, h, sq, d), normalized
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(1, 2, 0, 3, 4).reshape(b, h, sq, d)
 
 
 def ring_attention(q, k, v, mesh: Mesh, *, axis_name: str = "sp",
                    causal: bool = False, scale: Optional[float] = None,
-                   batch_axis: Optional[str] = None):
+                   batch_axis: Optional[str] = None, q_chunk: int = 0):
     """Ring attention over sequence-sharded q, k, v: (b, h, seq, d) with seq
     sharded on ``axis_name``. Returns output with the same sharding.
     ``batch_axis`` names a mesh axis to shard the batch dim over (pass the
     trainer's "data" axis on a (data, sp) mesh — a None batch spec would
-    replicate the global batch on every chip)."""
+    replicate the global batch on every chip). ``q_chunk`` caps the live
+    score tile per ring step (default RING_Q_CHUNK)."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     spec = P(batch_axis, None, axis_name, None)
     fn = shard_map(
         functools.partial(_ring_attention_local, axis_name=axis_name,
-                          causal=causal, scale=scale),
+                          causal=causal, scale=scale, q_chunk=q_chunk),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
 
