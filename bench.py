@@ -93,6 +93,18 @@ def bench_googlenet():
             "vs_baseline": round(ips / 2000.0, 4)}
 
 
+def bench_resnet():
+    from cxxnet_tpu.models import resnet_trainer
+    batch = 128
+    tr = resnet_trainer(batch_size=batch, input_hw=224, dev="tpu",
+                        extra_cfg=BF16)
+    ips = _throughput(tr, (3, 224, 224), 1000, batch)
+    # no reference baseline: the family postdates the reference
+    return {"metric": "resnet18_imagenet_images_per_sec_per_chip",
+            "value": round(ips, 2), "unit": "images/sec/chip",
+            "vs_baseline": None}
+
+
 def _conf_trainer(netconfig, shape, batch, extra=""):
     from cxxnet_tpu.nnet.trainer import Trainer
     from cxxnet_tpu.utils.config import parse_config_string
@@ -299,7 +311,7 @@ def bench_alexnet_pipeline():
 def main():
     if len(sys.argv) > 1 and sys.argv[1] == "all":
         for fn in (bench_mnist_mlp, bench_mnist_conv, bench_bowl,
-                   bench_googlenet):
+                   bench_googlenet, bench_resnet):
             print(json.dumps(fn()))
     if len(sys.argv) > 1 and sys.argv[1] in ("all", "pipeline"):
         for line in bench_alexnet_pipeline():

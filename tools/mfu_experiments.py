@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""MFU experiment sweep for the bench models (VERDICT r1 item 4).
+
+Runs the synthetic train-step benchmark over a variant matrix (batch size,
+compute dtype) and prints one JSON line per variant — the fast way to find
+the throughput knee on real hardware before/after kernel or layout work.
+
+Usage: python tools/mfu_experiments.py [alexnet|googlenet|resnet|all]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+
+BF16 = "eval_train = 0\ncompute_dtype = bfloat16\n"
+F32 = "eval_train = 0\n"
+
+
+def measure(tr, shape, nclass, batch, steps=30):
+    import jax
+    import jax.numpy as jnp
+    from cxxnet_tpu.io.data import DataBatch
+    rs = np.random.RandomState(0)
+    b = DataBatch()
+    b.data = jax.device_put(rs.rand(batch, *shape).astype(np.float32))
+    b.label = jax.device_put(
+        rs.randint(0, nclass, (batch, 1)).astype(np.float32))
+    b.batch_size = batch
+
+    def sync():
+        float(jnp.sum(next(v for p in tr.params for v in p.values())))
+
+    for _ in range(3):
+        tr.update(b)
+    sync()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        tr.update(b)
+    sync()
+    return steps * batch / (time.perf_counter() - t0)
+
+
+def sweep(model):
+    from cxxnet_tpu.models import (alexnet_trainer, googlenet_trainer,
+                                   resnet_trainer)
+    if model == "alexnet":
+        build, shape, variants = alexnet_trainer, (3, 227, 227), [
+            (256, BF16), (512, BF16), (1024, BF16), (256, F32)]
+    elif model == "googlenet":
+        build, shape, variants = googlenet_trainer, (3, 224, 224), [
+            (128, BF16), (256, BF16), (512, BF16)]
+    else:
+        build, shape, variants = resnet_trainer, (3, 224, 224), [
+            (128, BF16), (256, BF16)]
+    hw = shape[1]
+    for batch, extra in variants:
+        try:
+            tr = build(batch_size=batch, input_hw=hw, dev="tpu",
+                       extra_cfg=extra)
+            ips = measure(tr, shape, 1000, batch)
+            del tr
+            print(json.dumps({
+                "model": model, "batch": batch,
+                "dtype": "bf16" if "bfloat16" in extra else "f32",
+                "images_per_sec": round(ips, 1)}), flush=True)
+        except Exception as exc:   # OOM etc: record and continue the sweep
+            print(json.dumps({"model": model, "batch": batch,
+                              "error": str(exc)[:200]}), flush=True)
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    models = ("alexnet", "googlenet", "resnet") if which == "all" \
+        else (which,)
+    for m in models:
+        sweep(m)
+
+
+if __name__ == "__main__":
+    main()
