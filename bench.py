@@ -308,7 +308,30 @@ def bench_alexnet_pipeline():
     return out
 
 
+def _wait_for_backend(retries=10, probe_timeout=60):
+    """The axon TPU tunnel can be down for stretches (jax then HANGS rather
+    than erroring). Probe it in a subprocess and retry for a while so a
+    transient outage delays the bench instead of wedging it silently."""
+    import subprocess
+    for i in range(retries):
+        try:
+            p = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                capture_output=True, timeout=probe_timeout)
+            if p.returncode == 0:
+                return True
+        except subprocess.TimeoutExpired:
+            pass
+        print("backend unreachable (attempt %d/%d); retrying in 60s"
+              % (i + 1, retries), file=sys.stderr, flush=True)
+        time.sleep(60)
+    print("backend still unreachable; proceeding anyway", file=sys.stderr,
+          flush=True)
+    return False
+
+
 def main():
+    _wait_for_backend()
     if len(sys.argv) > 1 and sys.argv[1] == "all":
         for fn in (bench_mnist_mlp, bench_mnist_conv, bench_bowl,
                    bench_googlenet, bench_resnet):
