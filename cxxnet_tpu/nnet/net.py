@@ -19,8 +19,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import ops
 from ..layer import factory
 from ..layer.base import ApplyContext, LabelInfo, Layer, check
+from ..layer.layers import ConvolutionLayer, SplitLayer
 from ..utils import serializer
 from .config import NetConfig
 
@@ -32,7 +34,8 @@ class NeuralNet:
                  infer_shapes: bool = True,
                  compute_dtype: Optional[jnp.dtype] = None,
                  input_scale: float = 1.0,
-                 input_mean=None):
+                 input_mean=None,
+                 fuse_siblings: bool = True):
         """infer_shapes=False skips shape inference entirely — used for the
         weight-copy (finetune) path, which only deserializes params and never
         runs the net (reference CopyModelFrom, nnet_impl-inl.hpp:101-134).
@@ -50,6 +53,8 @@ class NeuralNet:
         self.cfg = cfg
         self.max_batch = batch_size
         self.compute_dtype = compute_dtype
+        self.fuse_siblings = fuse_siblings
+        self._fuse_plan: Optional[Dict[int, List[int]]] = None
         self.input_scale = float(input_scale)
         self.input_mean = None if input_mean is None else \
             np.asarray(input_mean, np.float32)
@@ -151,13 +156,119 @@ class NeuralNet:
              for k, v in p.items()}
             for i, p in enumerate(params)]
 
+    # --- sibling-conv fusion (TPU perf pass; beyond the reference) ---
+    def _sibling_conv_plan(self) -> Dict[int, List[int]]:
+        """Groups of distinct convolutions that read the same value (same
+        input node, or nodes aliased through identity ``split`` fan-outs)
+        with identical geometry. Each group runs as ONE wider conv at apply
+        time — inception-style 1x1 branch/reduce convs (e.g. GoogLeNet's
+        three per module) are individually too narrow to fill the MXU's
+        128-wide systolic dimension; concatenated along the output-channel
+        dim they become a single large matmul with per-channel-identical
+        numerics. Keyed by leader (first member) layer index."""
+        if self._fuse_plan is not None:
+            return self._fuse_plan
+        groups: Dict[int, List[int]] = {}
+        cfg = self.cfg
+        if self.fuse_siblings:
+            # writers per node; graph inputs (data + extra_data) carry an
+            # implicit writer (-1) — the harness sets them before layer 0
+            writers: Dict[int, List[int]] = {
+                n: [-1] for n in range(1 + cfg.param.extra_data_num)}
+            for i, info in enumerate(cfg.layers):
+                for o in info.nindex_out:
+                    writers.setdefault(o, []).append(i)
+
+            def immutable(n):
+                # value never changes after first definition: at most one
+                # writer (a second writer is a self-loop rewrite hazard)
+                return len(writers.get(n, ())) <= 1
+
+            alias = {}
+            for i, info in enumerate(cfg.layers):
+                if isinstance(self.layers[i], SplitLayer) \
+                        and not self.is_shared[i]:
+                    for o in info.nindex_out:
+                        if o != info.nindex_in[0]:
+                            alias[o] = info.nindex_in[0]
+
+            def chain(n):
+                """Alias chain n -> canonical through split copies; None if
+                any node on it can be rewritten (fusion members must read a
+                value that is immutable AND shared with their siblings)."""
+                seen = set()
+                while True:
+                    if not immutable(n):
+                        return None
+                    if n not in alias or n in seen:
+                        return n
+                    seen.add(n)
+                    n = alias[n]
+
+            by_key: Dict[tuple, List[int]] = {}
+            for i, info in enumerate(cfg.layers):
+                lay = self.layers[i]
+                if (self.is_shared[i]
+                        or type(lay) is not ConvolutionLayer
+                        or len(info.nindex_in) != 1
+                        or len(info.nindex_out) != 1):
+                    continue
+                p = lay.param
+                if p.num_group != 1:
+                    continue
+                root = chain(info.nindex_in[0])
+                # the out node must be ours alone: a second writer would
+                # overwrite the (early) fused result in a different order
+                if root is None or not immutable(info.nindex_out[0]):
+                    continue
+                key = (root, p.kernel_height, p.kernel_width,
+                       p.stride, p.pad_y, p.pad_x, p.no_bias)
+                by_key.setdefault(key, []).append(i)
+
+            for cand in by_key.values():
+                # single-writer chains make every member's input value
+                # immutable and identical, so fusing at the leader's
+                # position is safe regardless of where members sit
+                if len(cand) >= 2:
+                    groups[cand[0]] = list(cand)
+        self._fuse_plan = groups
+        return groups
+
+    def _apply_fused_siblings(self, g: List[int], params, values) -> None:
+        """One conv over the concatenated (along O) member kernels, sliced
+        back to each member's output node."""
+        cfg = self.cfg
+        p0 = self.layers[g[0]].param
+        x = values[cfg.layers[g[0]].nindex_in[0]]
+        w = jnp.concatenate(
+            [self.layers[j]._kernel_oihw(params[j]["wmat"]) for j in g],
+            axis=0)
+        y = ops.conv2d(x, w, stride=p0.stride, pad=(p0.pad_y, p0.pad_x))
+        if p0.no_bias == 0:
+            b = jnp.concatenate([params[j]["bias"] for j in g])
+            y = y + b.reshape(1, -1, 1, 1)
+        off = 0
+        for j in g:
+            n = self.layers[j].param.num_channel
+            values[cfg.layers[j].nindex_out[0]] = y[:, off:off + n]
+            off += n
+
     def _apply_layer_range(self, params, values, ctx, base_rng,
                            lo: int, hi: int) -> None:
         """Apply layers [lo, hi) in place on the node-values list, with the
         per-layer rng fold and the losses-run-in-f32 rule."""
         cfg = self.cfg
         cdt = self.compute_dtype
+        fuse_groups = self._sibling_conv_plan()
+        fused_done: set = set()
         for i in range(lo, hi):
+            if i in fused_done:
+                continue
+            g = fuse_groups.get(i)
+            if g is not None and g[-1] < hi:
+                self._apply_fused_siblings(g, params, values)
+                fused_done.update(g)
+                continue
             info = cfg.layers[i]
             lay = self.layers[i]
             pidx = (info.primary_layer_index if self.is_shared[i] else i)

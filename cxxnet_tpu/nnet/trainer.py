@@ -62,6 +62,7 @@ class Trainer:
         self.expert_parallel = 1
         self.input_scale = 1.0      # device-side input normalization
         self.input_mean = None
+        self.fuse_sibling_convs = 1  # sibling-conv fusion pass (net.py)
         self.metric = MetricSet()
         self.train_metric = MetricSet()
         self.eval_node_names: List[Optional[str]] = []  # None -> last node
@@ -104,6 +105,8 @@ class Trainer:
             self.expert_parallel = int(val)
         if name == "test_on_server":
             self.test_on_server = int(val)
+        if name == "fuse_sibling_convs":
+            self.fuse_sibling_convs = int(val)
         if name == "compute_dtype":
             check(val in ("float32", "bfloat16", "bf16"),
                   "compute_dtype must be float32 or bfloat16")
@@ -222,7 +225,8 @@ class Trainer:
         self.net = NeuralNet(self.net_cfg, self.batch_size,
                              compute_dtype=self.compute_dtype,
                              input_scale=self.input_scale,
-                             input_mean=self.input_mean)
+                             input_mean=self.input_mean,
+                             fuse_siblings=bool(self.fuse_sibling_convs))
         self._setup_mesh()
         # resolve eval nodes (metric[label,node] -> node id; default last)
         self.eval_nodes: List[int] = []
@@ -341,7 +345,8 @@ class Trainer:
                              infer_shapes=False,
                              compute_dtype=self.compute_dtype,
                              input_scale=self.input_scale,
-                             input_mean=self.input_mean)
+                             input_mean=self.input_mean,
+                             fuse_siblings=bool(self.fuse_sibling_convs))
         self._setup_mesh()
         self.eval_nodes = [self.net_cfg.param.num_nodes - 1 if nm is None
                            else self.net_cfg.node_name_map[nm]
