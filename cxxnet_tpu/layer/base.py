@@ -199,9 +199,16 @@ class Layer:
 
     def __init__(self):
         self.param = LayerParam()
+        # rematerialization flag (config key ``remat``): when set, this
+        # layer's activations are recomputed in the backward pass instead
+        # of saved — the TPU HBM<->FLOPs trade (jax.checkpoint). Set
+        # globally (before the first layer line) or per layer.
+        self.remat = 0
 
     # --- configuration -----------------------------------------------------
     def set_param(self, name: str, val: str) -> None:
+        if name == "remat":
+            self.remat = int(val)
         self.param.set_param(name, val)
 
     # --- graph assembly ----------------------------------------------------

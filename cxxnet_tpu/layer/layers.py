@@ -242,6 +242,7 @@ class XeluLayer(Layer):
         self.b = 5.0
 
     def set_param(self, name, val):
+        super().set_param(name, val)
         if name == "b":
             self.b = float(val)
 
@@ -270,6 +271,7 @@ class InsanityLayer(Layer):
         self.calm_end = 0
 
     def set_param(self, name, val):
+        super().set_param(name, val)
         if name == "lb":
             self.lb = float(val)
         if name == "ub":
@@ -326,6 +328,7 @@ class PReluLayer(Layer):
         self.random = 0.0
 
     def set_param(self, name, val):
+        super().set_param(name, val)
         if name == "init_slope":
             self.init_slope = float(val)
         if name == "random_slope":
@@ -470,6 +473,7 @@ class DropoutLayer(Layer):
         self.threshold = 0.0
 
     def set_param(self, name, val):
+        super().set_param(name, val)
         if name == "threshold":
             self.threshold = float(val)
 
@@ -677,6 +681,7 @@ class LRNLayer(Layer):
         self.knorm = 1.0
 
     def set_param(self, name, val):
+        super().set_param(name, val)
         if name == "local_size":
             self.nsize = int(val)
         if name == "alpha":
@@ -714,6 +719,7 @@ class BatchNormLayer(Layer):
         self.bn_momentum = 0.9
 
     def set_param(self, name, val):
+        super().set_param(name, val)
         if name == "init_slope":
             self.init_slope = float(val)
         if name == "init_bias":
@@ -812,6 +818,7 @@ class LossLayerBase(Layer):
         self.grad_scale = 1.0
 
     def set_param(self, name, val):
+        super().set_param(name, val)
         if name == "batch_size":
             self.batch_size = int(val)
         if name == "update_period":

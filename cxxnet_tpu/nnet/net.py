@@ -236,22 +236,49 @@ class NeuralNet:
 
     def _apply_fused_siblings(self, g: List[int], params, values) -> None:
         """One conv over the concatenated (along O) member kernels, sliced
-        back to each member's output node."""
+        back to each member's output node. When every member asks for
+        ``remat``, the fused conv is checkpointed as a unit."""
         cfg = self.cfg
         p0 = self.layers[g[0]].param
         x = values[cfg.layers[g[0]].nindex_in[0]]
-        w = jnp.concatenate(
-            [self.layers[j]._kernel_oihw(params[j]["wmat"]) for j in g],
-            axis=0)
-        y = ops.conv2d(x, w, stride=p0.stride, pad=(p0.pad_y, p0.pad_x))
-        if p0.no_bias == 0:
-            b = jnp.concatenate([params[j]["bias"] for j in g])
-            y = y + b.reshape(1, -1, 1, 1)
+
+        def fused(xv, member_params):
+            w = jnp.concatenate(
+                [self.layers[j]._kernel_oihw(member_params[k]["wmat"])
+                 for k, j in enumerate(g)], axis=0)
+            y = ops.conv2d(xv, w, stride=p0.stride,
+                           pad=(p0.pad_y, p0.pad_x))
+            if p0.no_bias == 0:
+                b = jnp.concatenate(
+                    [member_params[k]["bias"] for k in range(len(g))])
+                y = y + b.reshape(1, -1, 1, 1)
+            return y
+
+        if all(self.layers[j].remat for j in g):
+            fused = jax.checkpoint(fused)
+        y = fused(x, [params[j] for j in g])
         off = 0
         for j in g:
             n = self.layers[j].param.num_channel
             values[cfg.layers[j].nindex_out[0]] = y[:, off:off + n]
             off += n
+
+    def _apply_remat(self, lay, pidx, p, ins, ctx):
+        """jax.checkpoint around a pure layer apply (config key ``remat``):
+        the layer's activations are recomputed during the backward pass
+        instead of saved, trading FLOPs for HBM — how deep stacks and long
+        contexts fit on a chip. Only side-effect-free layers qualify (no
+        loss accumulation, no state updates, no pairtest diffs); the rng
+        and epoch are passed as arguments so the recompute replays the
+        identical stochastic draw."""
+        def pure(pp, xs, rng, epoch):
+            c2 = ApplyContext(train=ctx.train, labels=None,
+                              epoch=epoch, mesh=ctx.mesh)
+            c2.rng = rng
+            c2.layer_index = getattr(ctx, "layer_index", pidx)
+            return tuple(lay.apply(pp, list(xs), c2))
+        return list(jax.checkpoint(pure)(
+            p, tuple(ins), ctx.rng, ctx.epoch))
 
     def _apply_layer_range(self, params, values, ctx, base_rng,
                            lo: int, hi: int) -> None:
@@ -278,7 +305,11 @@ class NeuralNet:
             if cdt is not None and lay.is_loss:
                 # losses always in f32 (softmax/log numerics)
                 ins = [x.astype(jnp.float32) for x in ins]
-            outs = lay.apply(params[pidx], ins, ctx)
+            if (lay.remat and not lay.is_loss and not lay.state_keys()
+                    and not isinstance(lay, factory.PairTestLayer)):
+                outs = self._apply_remat(lay, pidx, params[pidx], ins, ctx)
+            else:
+                outs = lay.apply(params[pidx], ins, ctx)
             for j, v in zip(info.nindex_out, outs):
                 values[j] = v
 

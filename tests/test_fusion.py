@@ -104,6 +104,23 @@ def _loss_and_grads(tr, x, y):
     return loss, grads
 
 
+def _assert_matches_unfused(conf, seed=3):
+    tr1 = _trainer(conf)
+    tr0 = _trainer(conf, "fuse_sibling_convs = 0\n")
+    rs = np.random.RandomState(seed)
+    x = rs.rand(4, 3, 8, 8).astype(np.float32)
+    y = rs.randint(0, 5, (4, 1)).astype(np.float32)
+    l1, g1 = _loss_and_grads(tr1, x, y)
+    l0, g0 = _loss_and_grads(tr0, x, y)
+    np.testing.assert_allclose(float(l1), float(l0), rtol=1e-6)
+    flat1 = jax.tree_util.tree_leaves(g1)
+    flat0 = jax.tree_util.tree_leaves(g0)
+    assert len(flat1) == len(flat0)
+    for a, b in zip(flat1, flat0):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
 def test_plan_groups_sibling_1x1s():
     tr = _trainer(MODULE_CONF)
     plan = tr.net._sibling_conv_plan()
@@ -118,20 +135,7 @@ def test_plan_disabled_by_key():
 
 
 def test_fused_matches_unfused_forward_and_grads():
-    tr1 = _trainer(MODULE_CONF)
-    tr0 = _trainer(MODULE_CONF, "fuse_sibling_convs = 0\n")
-    rs = np.random.RandomState(0)
-    x = rs.rand(4, 3, 8, 8).astype(np.float32)
-    y = rs.randint(0, 5, (4, 1)).astype(np.float32)
-    l1, g1 = _loss_and_grads(tr1, x, y)
-    l0, g0 = _loss_and_grads(tr0, x, y)
-    np.testing.assert_allclose(float(l1), float(l0), rtol=1e-6)
-    flat1 = jax.tree_util.tree_leaves(g1)
-    flat0 = jax.tree_util.tree_leaves(g0)
-    assert len(flat1) == len(flat0)
-    for a, b in zip(flat1, flat0):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=1e-5, atol=1e-6)
+    _assert_matches_unfused(MODULE_CONF, seed=0)
 
 
 def test_self_loop_mutation_cuts_group():
@@ -142,32 +146,7 @@ def test_self_loop_mutation_cuts_group():
     # conv:c5r reads sc AFTER the self-loop relu rewrote it; fusing it with
     # the pre-mutation siblings would read the stale value
     assert group == _conv_indices(tr, ["b1", "b3r"])
-    tr0 = _trainer(MUTATED_CONF, "fuse_sibling_convs = 0\n")
-    rs = np.random.RandomState(1)
-    x = rs.rand(4, 3, 8, 8).astype(np.float32)
-    y = rs.randint(0, 5, (4, 1)).astype(np.float32)
-    l1, g1 = _loss_and_grads(tr, x, y)
-    l0, g0 = _loss_and_grads(tr0, x, y)
-    np.testing.assert_allclose(float(l1), float(l0), rtol=1e-6)
-    for a, b in zip(jax.tree_util.tree_leaves(g1),
-                    jax.tree_util.tree_leaves(g0)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=1e-5, atol=1e-6)
-
-
-def _assert_matches_unfused(conf):
-    tr1 = _trainer(conf)
-    tr0 = _trainer(conf, "fuse_sibling_convs = 0\n")
-    rs = np.random.RandomState(3)
-    x = rs.rand(4, 3, 8, 8).astype(np.float32)
-    y = rs.randint(0, 5, (4, 1)).astype(np.float32)
-    l1, g1 = _loss_and_grads(tr1, x, y)
-    l0, g0 = _loss_and_grads(tr0, x, y)
-    np.testing.assert_allclose(float(l1), float(l0), rtol=1e-6)
-    for a, b in zip(jax.tree_util.tree_leaves(g1),
-                    jax.tree_util.tree_leaves(g0)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=1e-5, atol=1e-6)
+    _assert_matches_unfused(MUTATED_CONF, seed=1)
 
 
 def test_mutation_before_leader_excludes_member():

@@ -1,0 +1,137 @@
+"""Rematerialization (config key ``remat`` -> jax.checkpoint per layer).
+
+Pins: (a) remat layers appear as checkpoint regions in the jaxpr, (b) loss
+and gradients are identical with and without remat (including stochastic
+layers — the rng is an argument of the checkpointed fn so the backward
+recompute replays the same draw), (c) per-layer opt-in works, and (d)
+side-effectful layers (loss, batch_norm state) are never wrapped.
+"""
+
+import numpy as np
+import jax
+
+from cxxnet_tpu.nnet.trainer import Trainer
+from cxxnet_tpu.utils.config import parse_config_string
+
+BODY = """
+layer[0->c1] = conv:c1
+  kernel_size = 3
+  pad = 1
+  nchannel = 6
+layer[c1->r1] = relu
+layer[r1->d1] = dropout
+  threshold = 0.3
+layer[d1->fl] = flatten
+layer[fl->out] = fullc:head
+  nhidden = 5
+layer[+0] = softmax
+netconfig=end
+random_type = xavier
+metric = error
+input_shape = 3,8,8
+batch_size = 4
+dev = cpu
+eta = 0.05
+"""
+
+GLOBAL_REMAT = "netconfig=start\nremat = 1\n" + BODY
+NO_REMAT = "netconfig=start\n" + BODY
+PER_LAYER = NO_REMAT.replace("  kernel_size = 3",
+                             "  remat = 1\n  kernel_size = 3")
+
+
+def _trainer(conf):
+    tr = Trainer()
+    for k, v in parse_config_string(conf):
+        tr.set_param(k, v)
+    tr.init_model()
+    return tr
+
+
+def _loss_fn(tr, x, y):
+    li = tr.net.label_info_from(y)
+
+    def f(params):
+        _, loss = tr.net.forward(params, x, labels=li, train=True,
+                                 rng=jax.random.PRNGKey(5))
+        return loss
+    return f
+
+
+def _data():
+    rs = np.random.RandomState(0)
+    return (rs.rand(4, 3, 8, 8).astype(np.float32),
+            rs.randint(0, 5, (4, 1)).astype(np.float32))
+
+
+def test_remat_appears_in_jaxpr():
+    x, y = _data()
+    tr1 = _trainer(GLOBAL_REMAT)
+    tr0 = _trainer(NO_REMAT)
+    jp1 = str(jax.make_jaxpr(_loss_fn(tr1, x, y))(tr1.params))
+    jp0 = str(jax.make_jaxpr(_loss_fn(tr0, x, y))(tr0.params))
+    assert "remat" in jp1 or "checkpoint" in jp1
+    assert "remat" not in jp0 and "checkpoint" not in jp0
+
+
+def test_per_layer_remat():
+    x, y = _data()
+    tr = _trainer(PER_LAYER)
+    assert tr.net.layers[0].remat == 1
+    assert all(l.remat == 0 for l in tr.net.layers[1:])
+    jp = str(jax.make_jaxpr(_loss_fn(tr, x, y))(tr.params))
+    assert "remat" in jp or "checkpoint" in jp
+
+
+def test_remat_matches_no_remat():
+    x, y = _data()
+    tr1 = _trainer(GLOBAL_REMAT)
+    tr0 = _trainer(NO_REMAT)
+    l1, g1 = jax.value_and_grad(_loss_fn(tr1, x, y))(tr1.params)
+    l0, g0 = jax.value_and_grad(_loss_fn(tr0, x, y))(tr0.params)
+    np.testing.assert_allclose(float(l1), float(l0), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g1),
+                    jax.tree_util.tree_leaves(g0)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_global_remat_reaches_override_layers():
+    """Layers whose set_param overrides the base (dropout, batch_norm,
+    lrn, ...) must still receive the global remat flag through super()."""
+    conf = GLOBAL_REMAT.replace(
+        "layer[c1->r1] = relu",
+        "layer[c1->bn] = batch_norm\nlayer[bn->r1] = relu")
+    tr = _trainer(conf)
+    assert all(l.remat == 1 for l in tr.net.layers)
+
+
+def test_fused_siblings_honor_remat():
+    """A sibling-conv fusion group where every member asks for remat is
+    checkpointed as a unit (and still matches unfused numerics)."""
+    from tests.test_fusion import MODULE_CONF, _assert_matches_unfused
+    conf = MODULE_CONF.replace("netconfig=start", "netconfig=start\nremat = 1")
+    tr = _trainer(conf)
+    assert tr.net._sibling_conv_plan()  # group still forms
+    x, y = _data()
+    jp = str(jax.make_jaxpr(_loss_fn(tr, x, y))(tr.params))
+    assert "remat" in jp or "checkpoint" in jp
+    _assert_matches_unfused(conf)
+
+
+def test_loss_and_stateful_layers_not_wrapped():
+    """remat=1 globally must leave softmax (loss) and batch_norm with
+    moving averages (state updates) unwrapped — their side channels
+    (ctx.losses / ctx.state_updates) cannot cross a checkpoint boundary."""
+    conf = GLOBAL_REMAT.replace(
+        "layer[c1->r1] = relu",
+        "layer[c1->bn] = batch_norm\n  moving_average = 1\n"
+        "layer[bn->r1] = relu")
+    tr = _trainer(conf)
+    x, y = _data()
+    li = tr.net.label_info_from(y)
+    # forward must still record the loss and the BN state update
+    _, loss = tr.net.forward(tr.params, x, labels=li, train=True,
+                             rng=jax.random.PRNGKey(5))
+    assert float(loss) > 0.0
+    assert tr.net._last_state_updates  # BN running stats recorded
