@@ -516,3 +516,79 @@ def resnet_trainer(batch_size: int = 128, input_hw: int = 224,
         tr.set_param(k, v)
     tr.init_model()
     return tr
+
+
+# VGG (Simonyan & Zisserman 2014) — contemporary of the reference's era;
+# deep uniform 3x3 stacks, the natural customer of `remat = 1` (13 conv
+# activations at 224x224 otherwise dominate HBM)
+VGG_STAGES = {
+    "vgg11": ((64,), (128,), (256, 256), (512, 512), (512, 512)),
+    "vgg16": ((64, 64), (128, 128), (256, 256, 256),
+              (512, 512, 512), (512, 512, 512)),
+}
+
+
+def vgg_netconfig(arch: str = "vgg16", n_class: int = 1000,
+                  fc_dim: int = 4096, remat: int = 0,
+                  dropout: float = 0.5) -> str:
+    """VGG in the layer DSL: 5 stages of 3x3/pad-1 conv+relu stacks, each
+    followed by a 2x2/stride-2 max pool, then fc-relu-dropout x2 and the
+    classifier head."""
+    txt = "netconfig=start\n"
+    if remat:
+        txt += "remat = 1\n"
+    node = "0"
+    for s, widths in enumerate(VGG_STAGES[arch]):
+        for c, width in enumerate(widths):
+            name = "conv%d_%d" % (s + 1, c + 1)
+            txt += """layer[%s->%s] = conv:%s
+  kernel_size = 3
+  pad = 1
+  nchannel = %d
+layer[%s->%sr] = relu
+""" % (node, name, name, width, name, name)
+            node = name + "r"
+        txt += """layer[%s->pool%d] = max_pooling
+  kernel_size = 2
+  stride = 2
+""" % (node, s + 1)
+        node = "pool%d" % (s + 1)
+    txt += "layer[%s->fl] = flatten\n" % node
+    node = "fl"
+    for i in (6, 7):
+        txt += """layer[%s->fc%d] = fullc:fc%d
+  nhidden = %d
+layer[fc%d->fc%dr] = relu
+layer[fc%dr->fc%dr] = dropout
+  threshold = %g
+""" % (node, i, i, fc_dim, i, i, i, i, dropout)
+        node = "fc%dr" % i
+    txt += """layer[%s->out] = fullc:head
+  nhidden = %d
+layer[+0] = softmax
+netconfig=end
+random_type = kaiming
+metric = error
+""" % (node, n_class)
+    return txt
+
+
+def vgg_trainer(batch_size: int = 64, input_hw: int = 224,
+                dev: str = "tpu", n_class: int = 1000,
+                arch: str = "vgg16", fc_dim: int = 4096,
+                remat: int = 0, dropout: float = 0.5,
+                extra_cfg: str = "") -> Trainer:
+    """VGG trainer with the paper recipe; shrink input_hw/fc_dim for
+    tests (input must be a multiple of 32 to survive the 5 pools)."""
+    assert input_hw % 32 == 0, "VGG needs input divisible by 32"
+    conf = (vgg_netconfig(arch, n_class, fc_dim=fc_dim,
+                      remat=remat, dropout=dropout) +
+            "input_shape = 3,%d,%d\n" % (input_hw, input_hw) +
+            "batch_size = %d\n" % batch_size +
+            "eta = 0.01\nmomentum = 0.9\nwd = 0.0005\n" +
+            "dev = %s\n" % dev + extra_cfg)
+    tr = Trainer()
+    for k, v in parse_config_string(conf):
+        tr.set_param(k, v)
+    tr.init_model()
+    return tr

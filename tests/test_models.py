@@ -29,3 +29,36 @@ class TestResNet:
         net = NeuralNet(cfg, 2)
         # stem/2 + pool/2 + three stage-first strides -> 224/32 = 7
         assert net.node_shapes[cfg.node_name_map["gap"]] == (2, 512, 1, 1)
+
+
+class TestVGG:
+    def test_vgg16_shape_stack(self):
+        from cxxnet_tpu.models import vgg_netconfig
+        from cxxnet_tpu.nnet.config import NetConfig
+        from cxxnet_tpu.nnet.net import NeuralNet
+        from cxxnet_tpu.utils.config import parse_config_string
+        conf = vgg_netconfig() + "input_shape = 3,224,224\n"
+        cfg = NetConfig()
+        cfg.configure(parse_config_string(conf))
+        net = NeuralNet(cfg, 2)
+        # five 2x2/s2 pools: 224/32 = 7
+        assert net.node_shapes[cfg.node_name_map["pool5"]] == (2, 512, 7, 7)
+        assert net.node_shapes[cfg.node_name_map["out"]] == (2, 1, 1, 1000)
+
+    def test_memorizes_batch_with_remat(self):
+        import numpy as np
+        from cxxnet_tpu.models import vgg_trainer
+        from cxxnet_tpu.io.data import DataBatch
+        tr = vgg_trainer(batch_size=8, input_hw=32, dev="cpu", n_class=4,
+                         arch="vgg11", fc_dim=32, remat=1, dropout=0.0,
+                         extra_cfg="updater = adam\neta = 0.001\n")
+        assert all(l.remat == 1 for l in tr.net.layers)
+        rs = np.random.RandomState(0)
+        b = DataBatch()
+        b.data = rs.rand(8, 3, 32, 32).astype(np.float32)
+        b.label = rs.randint(0, 4, (8, 1)).astype(np.float32)
+        b.batch_size = 8
+        for _ in range(60):
+            tr.update(b)
+        pred = tr.predict(b)
+        assert (pred == b.label[:, 0]).mean() == 1.0

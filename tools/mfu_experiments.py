@@ -54,7 +54,9 @@ def sweep(model):
             (256, BF16), (512, BF16), (1024, BF16), (256, F32)]
     elif model == "googlenet":
         build, shape, variants = googlenet_trainer, (3, 224, 224), [
-            (128, BF16), (256, BF16), (512, BF16)]
+            (128, BF16), (256, BF16), (512, BF16),
+            # fusion ablation: sibling 1x1s as one wide conv vs separate
+            (256, BF16 + "fuse_sibling_convs = 0\n")]
     else:
         build, shape, variants = resnet_trainer, (3, 224, 224), [
             (128, BF16), (256, BF16)]
@@ -68,6 +70,7 @@ def sweep(model):
             print(json.dumps({
                 "model": model, "batch": batch,
                 "dtype": "bf16" if "bfloat16" in extra else "f32",
+                "fused": 0 if "fuse_sibling_convs = 0" in extra else 1,
                 "images_per_sec": round(ips, 1)}), flush=True)
         except Exception as exc:   # OOM etc: record and continue the sweep
             print(json.dumps({"model": model, "batch": batch,
