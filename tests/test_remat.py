@@ -119,6 +119,22 @@ def test_fused_siblings_honor_remat():
     _assert_matches_unfused(conf)
 
 
+def test_remat_composes_with_attention_and_sp():
+    """jax.checkpoint wrapping the attention layer must compose with the
+    shard_map ring path under seq_parallel."""
+    from cxxnet_tpu.models import transformer_lm_trainer
+    from cxxnet_tpu.io.data import DataBatch
+    rs = np.random.RandomState(0)
+    b = DataBatch()
+    b.data = rs.randint(0, 50, (8, 1, 1, 16)).astype(np.float32)
+    b.label = rs.randint(0, 50, (8, 16)).astype(np.float32)
+    b.batch_size = 8
+    for extra in ("remat = 1\n", "remat = 1\nseq_parallel = 2\n"):
+        dev = "cpu" if "seq" not in extra else "cpu:0-7"
+        tr = transformer_lm_trainer(dev=dev, extra_cfg=extra)
+        tr.update(b)
+
+
 def test_loss_and_stateful_layers_not_wrapped():
     """remat=1 globally must leave softmax (loss) and batch_norm with
     moving averages (state updates) unwrapped — their side channels
