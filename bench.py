@@ -105,6 +105,18 @@ def bench_resnet():
             "vs_baseline": None}
 
 
+def bench_vgg():
+    from cxxnet_tpu.models import vgg_trainer
+    batch = 64
+    tr = vgg_trainer(batch_size=batch, input_hw=224, dev="tpu",
+                     remat=1, extra_cfg=BF16)
+    ips = _throughput(tr, (3, 224, 224), 1000, batch)
+    # no reference baseline: VGG postdates the reference's example set
+    return {"metric": "vgg16_imagenet_images_per_sec_per_chip",
+            "value": round(ips, 2), "unit": "images/sec/chip",
+            "vs_baseline": None}
+
+
 def _conf_trainer(netconfig, shape, batch, extra=""):
     from cxxnet_tpu.nnet.trainer import Trainer
     from cxxnet_tpu.utils.config import parse_config_string
@@ -334,7 +346,7 @@ def main():
     _wait_for_backend()
     if len(sys.argv) > 1 and sys.argv[1] == "all":
         for fn in (bench_mnist_mlp, bench_mnist_conv, bench_bowl,
-                   bench_googlenet, bench_resnet):
+                   bench_googlenet, bench_resnet, bench_vgg):
             print(json.dumps(fn()))
     if len(sys.argv) > 1 and sys.argv[1] in ("all", "pipeline"):
         for line in bench_alexnet_pipeline():

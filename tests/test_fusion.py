@@ -253,3 +253,26 @@ def test_training_equivalence_over_steps():
     assert len(outs[0]) == len(outs[1])
     for a, b_ in zip(outs[0], outs[1]):
         np.testing.assert_allclose(a, b_, rtol=1e-4, atol=1e-5)
+
+
+def test_fusion_on_data_parallel_mesh():
+    """Fused sibling convs under dev=cpu:0-7 (replicated weights, sharded
+    batch) train and match the single-device loss trajectory."""
+    from cxxnet_tpu.io.data import DataBatch
+    rs = np.random.RandomState(4)
+    x = rs.rand(8, 3, 8, 8).astype(np.float32)
+    y = rs.randint(0, 5, (8, 1)).astype(np.float32)
+    losses = []
+    for dev in ("cpu", "cpu:0-7"):
+        tr = _trainer(MODULE_CONF.replace("batch_size = 4",
+                                          "batch_size = 8")
+                      .replace("dev = cpu", "dev = %s" % dev))
+        assert tr.net._sibling_conv_plan()
+        b = DataBatch()
+        b.data, b.label, b.batch_size = x, y, 8
+        for _ in range(3):
+            tr.update(b)
+        li = tr.net.label_info_from(y)
+        _, loss = tr.net.forward(tr.params, x, labels=li, train=False)
+        losses.append(float(loss))
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-4)
