@@ -343,6 +343,8 @@ def _wait_for_backend(retries=10, probe_timeout=60):
 
 
 def main():
+    from cxxnet_tpu.utils import enable_compile_cache
+    enable_compile_cache()
     _wait_for_backend()
     if len(sys.argv) > 1 and sys.argv[1] == "all":
         for fn in (bench_mnist_mlp, bench_mnist_conv, bench_bowl,

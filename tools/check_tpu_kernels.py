@@ -23,6 +23,8 @@ import jax.numpy as jnp
 
 
 def main():
+    from cxxnet_tpu.utils import enable_compile_cache
+    enable_compile_cache()
     assert jax.default_backend() not in ("cpu",), \
         "this checker needs a TPU backend, got %s" % jax.default_backend()
     from cxxnet_tpu import ops

@@ -78,6 +78,8 @@ def sweep(model):
 
 
 def main():
+    from cxxnet_tpu.utils import enable_compile_cache
+    enable_compile_cache()
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     models = ("alexnet", "googlenet", "resnet") if which == "all" \
         else (which,)

@@ -18,6 +18,8 @@ import numpy as np
 
 
 def main():
+    from cxxnet_tpu.utils import enable_compile_cache
+    enable_compile_cache()
     model = sys.argv[1] if len(sys.argv) > 1 else "alexnet"
     outdir = sys.argv[2] if len(sys.argv) > 2 else \
         os.path.join("profile_out", model)

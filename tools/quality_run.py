@@ -110,6 +110,8 @@ def backend():
 
 
 def main():
+    from cxxnet_tpu.utils import enable_compile_cache
+    enable_compile_cache()
     out_path = sys.argv[1] if len(sys.argv) > 1 else \
         os.path.join(REPO, "QUALITY.md")
     from synth_mnist import make_dataset, make_glyph_dataset
