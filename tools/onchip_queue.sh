@@ -1,0 +1,54 @@
+#!/bin/bash
+# Drive the queued on-chip verifications through the axon tunnel, in
+# priority order, one TPU process at a time (two concurrent TPU processes
+# can wedge the tunnel). Waits for the tunnel first, then runs each step
+# with its own timeout, logging to onchip_logs/<step>.log and appending a
+# one-line status to onchip_logs/STATUS. Safe to rerun: the persistent
+# compile cache makes repeats cheap, and completed steps can be skipped
+# with SKIP="kernels bench ...".
+#
+# Usage: bash tools/onchip_queue.sh [max_wait_seconds]
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p onchip_logs
+MAX_WAIT=${1:-21600}
+SKIP=${SKIP:-}
+
+note() { echo "$(date -u +%F' '%T) $*" | tee -a onchip_logs/STATUS; }
+
+# --- wait for the tunnel -------------------------------------------------
+note "queue start; waiting for tunnel (max ${MAX_WAIT}s)"
+waited=0
+while true; do
+  if timeout 3 bash -c 'echo > /dev/tcp/127.0.0.1/8093' 2>/dev/null; then
+    if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+      note "tunnel UP (after ${waited}s)"
+      break
+    fi
+  fi
+  sleep 30; waited=$((waited + 30))
+  if [ "$waited" -ge "$MAX_WAIT" ]; then
+    note "tunnel still down after ${MAX_WAIT}s; giving up"
+    exit 1
+  fi
+done
+
+# --- steps ---------------------------------------------------------------
+run() {
+  name=$1; tmo=$2; shift 2
+  case " $SKIP " in *" $name "*) note "$name SKIPPED"; return;; esac
+  note "$name START: $*"
+  timeout "$tmo" "$@" > "onchip_logs/$name.log" 2>&1
+  rc=$?
+  note "$name DONE rc=$rc: $(tail -1 "onchip_logs/$name.log" | cut -c1-160)"
+}
+
+run kernels  900  python tools/check_tpu_kernels.py
+run bench    900  python bench.py
+run mfu      5400 python tools/mfu_experiments.py all
+run pipeline 1200 python bench.py pipeline
+run quality  3600 python tools/quality_run.py
+run profile  1200 python tools/profile_bench.py googlenet
+run benchall 3600 python bench.py all
+
+note "queue finished"
