@@ -551,10 +551,12 @@ class NeuralNet:
 
     # ------------------------------------------------------------------
     def save_model_blob(self, params: Params) -> bytes:
+        from ..parallel import fetch_global
         w = serializer.Writer()
         for i, lay in enumerate(self.layers):
             if not self.is_shared[i]:
-                lay.save_model(w, jax.device_get(params[i]))
+                lay.save_model(w, {k: fetch_global(v)
+                                   for k, v in params[i].items()})
         return w.getvalue()
 
     def load_model_blob(self, blob: bytes) -> Params:
@@ -572,7 +574,8 @@ class NeuralNet:
         idx = self.cfg.get_layer_index(layer_name)
         for t, key in self.layers[idx].visit_order():
             if t == tag:
-                arr = np.asarray(jax.device_get(params[idx][key]))
+                from ..parallel import fetch_global
+                arr = fetch_global(params[idx][key])
                 shape = list(arr.shape)
                 return arr.reshape(arr.shape[0], -1) if arr.ndim > 1 \
                     else arr.reshape(1, -1), shape

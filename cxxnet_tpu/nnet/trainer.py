@@ -300,8 +300,8 @@ class Trainer:
                 ow.write_uint64(len(sub))
                 for sk in sorted(sub):
                     ow.write_string(sk)
-                    ow.write_tensor(
-                        np.asarray(jax.device_get(sub[sk]), np.float32))
+                    ow.write_tensor(np.asarray(
+                        parallel.fetch_global(sub[sk]), np.float32))
         blob = ow.getvalue()
         w.write_raw(self._OPT_MAGIC)
         w.write_uint64(len(blob))
@@ -555,7 +555,16 @@ class Trainer:
                 return [values[n] for n in node_ids]
             self._jit_cache[k] = jax.jit(fwd)
         data = self._shard_batch(batch.data)
-        return self._jit_cache[k](self.params, data, self._next_rng())
+        outs = self._jit_cache[k](self.params, data, self._next_rng())
+        if jax.process_count() > 1:
+            # outputs are sharded over the GLOBAL mesh: a plain np.asarray
+            # cannot see other processes' shards — gather to host so
+            # evaluate/predict/extract keep single-host semantics (every
+            # process holds the full global batch result)
+            from jax.experimental import multihost_utils
+            outs = [multihost_utils.process_allgather(o, tiled=True)
+                    for o in outs]
+        return outs
 
     def predict(self, batch) -> np.ndarray:
         """Argmax (or scalar) prediction per row of the last node

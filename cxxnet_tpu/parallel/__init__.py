@@ -24,5 +24,6 @@ from .tensor import (column_parallel_dense, expert_parallel_ffn,  # noqa: F401
                      fullc_sharding, row_parallel_dense)
 from .pipeline import (pipeline_apply, pipeline_apply_stages,  # noqa: F401
                        stage_sharding)
-from .multihost import (create_hybrid_mesh, init_distributed,  # noqa: F401
+from .multihost import (create_hybrid_mesh, fetch_global,  # noqa: F401
+                        init_distributed,
                         virtual_cpu_env, worker_shard_params)

@@ -75,3 +75,18 @@ def worker_shard_params() -> Tuple[int, int]:
     """(num_workers, rank) for input sharding — the reference's
     dist_num_worker / dist_worker_rank derived from the process topology."""
     return jax.process_count(), jax.process_index()
+
+
+def fetch_global(x) -> "np.ndarray":
+    """Host numpy value of a possibly process-spanning jax.Array.
+
+    In multi-process training, arrays sharded over the global mesh (ZeRO
+    optimizer shards, TP weights, eval outputs) span non-addressable
+    devices; a plain device_get raises. Fully-replicated or local arrays
+    fetch directly; anything else is allgathered to every host first."""
+    import numpy as np
+    if isinstance(x, jax.Array) and not x.is_fully_addressable \
+            and not x.sharding.is_fully_replicated:
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(jax.device_get(x))

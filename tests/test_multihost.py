@@ -71,6 +71,26 @@ assert gathered.shape[0] == 2
 np.testing.assert_array_equal(gathered[0], gathered[1])
 assert np.isfinite(gathered).all()
 print("RANK%%d_OK" %% rank)
+
+# multi-process fetch paths: predict gathers the mesh-sharded forward
+# output to every host; save_model serializes ZeRO-sharded (update_on_server)
+# optimizer state through parallel.fetch_global
+tr2 = Trainer()
+for k, v in parse_config_string(conf + "update_on_server = 1\\n"):
+    tr2.set_param(k, v)
+tr2.init_model()
+for _ in range(2):
+    tr2.update(b)
+pred = tr2.predict(b)
+assert pred.shape == (16,)
+from cxxnet_tpu.utils import serializer
+w = serializer.Writer()
+tr2.save_model(w)
+blob = w.getvalue()
+assert len(blob) > 1000
+gathered_pred = multihost_utils.process_allgather(pred)
+np.testing.assert_array_equal(gathered_pred[0], gathered_pred[1])
+print("RANK%%d_SAVE_OK" %% rank)
 ''')
 
 
@@ -93,3 +113,4 @@ def test_two_process_distributed_training(tmp_path):
     for r, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, "rank %d failed:\n%s" % (r, out[-2000:])
         assert ("RANK%d_OK" % r) in out
+        assert ("RANK%d_SAVE_OK" % r) in out
