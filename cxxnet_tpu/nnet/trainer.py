@@ -509,8 +509,19 @@ class Trainer:
     def _shard_batch(self, arr):
         if self.mesh is None:
             return jnp.asarray(arr)
-        return jax.device_put(jnp.asarray(arr),
-                              NamedSharding(self.mesh, P("data")))
+        sh = NamedSharding(self.mesh, P("data"))
+        nproc = jax.process_count()
+        if nproc > 1:
+            a = np.asarray(arr)
+            if a.shape[0] * nproc == self.batch_size:
+                # per-host LOCAL shard (dist_num_worker-sharded corpora:
+                # each host decodes only its slice of the global batch);
+                # assemble the global array from process-local rows
+                return jax.make_array_from_process_local_data(sh, a)
+            # else: every host carries the identical global batch and
+            # device_put places the local rows (valid only when hosts
+            # read the same unsharded data stream)
+        return jax.device_put(jnp.asarray(arr), sh)
 
     def _next_rng(self):
         self._rng_counter += 1
@@ -609,11 +620,23 @@ class Trainer:
         while iter_eval.next():
             batch = iter_eval.value()
             outs = self._forward_nodes(batch, node_ids)
-            n_valid = batch.data.shape[0] - batch.num_batch_padd
-            scores = [np.asarray(o).reshape(o.shape[0], -1)[:n_valid]
+            local_n = batch.data.shape[0]
+            mask = np.zeros(local_n, bool)
+            mask[:local_n - batch.num_batch_padd] = True
+            labels_np = np.asarray(batch.label)
+            if outs[0].shape[0] != local_n:
+                # per-host shard mode: scores came back for the GLOBAL
+                # batch — gather the labels and the validity mask the
+                # same way so rows line up
+                from jax.experimental import multihost_utils
+                labels_np = np.asarray(multihost_utils.process_allgather(
+                    labels_np, tiled=True))
+                mask = np.asarray(multihost_utils.process_allgather(
+                    mask, tiled=True))
+            scores = [np.asarray(o).reshape(o.shape[0], -1)[mask]
                       for o in outs]
-            labels = self.net.label_info_from(
-                np.asarray(batch.label)[:n_valid], as_numpy=True)
+            labels = self.net.label_info_from(labels_np[mask],
+                                              as_numpy=True)
             self.metric.add_eval(scores, labels)
         ret += self.metric.print_str(data_name)
         return ret

@@ -91,6 +91,28 @@ assert len(blob) > 1000
 gathered_pred = multihost_utils.process_allgather(pred)
 np.testing.assert_array_equal(gathered_pred[0], gathered_pred[1])
 print("RANK%%d_SAVE_OK" %% rank)
+
+# per-host LOCAL-shard feeding (dist_num_worker-sharded corpora): each
+# host supplies only its 8-row slice of the 16-row global batch;
+# make_array_from_process_local_data must assemble the same global batch,
+# so training matches the identical-global-batch run exactly
+tr3 = Trainer()
+for k, v in parse_config_string(conf):
+    tr3.set_param(k, v)
+tr3.init_model()
+lo = rank * 8
+b3 = DataBatch()
+b3.data = b.data[lo:lo + 8]
+b3.label = b.label[lo:lo + 8]
+b3.batch_size = 16
+for _ in range(5):
+    tr3.update(b3)
+w_full = np.asarray(tr.params[0]["wmat"].addressable_shards[0].data)
+w_shard = np.asarray(tr3.params[0]["wmat"].addressable_shards[0].data)
+np.testing.assert_allclose(w_shard, w_full, rtol=1e-6, atol=1e-7)
+pred3 = tr3.predict(b3)          # shard-fed predict returns GLOBAL rows
+assert pred3.shape == (16,)
+print("RANK%%d_SHARD_OK" %% rank)
 ''')
 
 
@@ -114,3 +136,4 @@ def test_two_process_distributed_training(tmp_path):
         assert p.returncode == 0, "rank %d failed:\n%s" % (r, out[-2000:])
         assert ("RANK%d_OK" % r) in out
         assert ("RANK%d_SAVE_OK" % r) in out
+        assert ("RANK%d_SHARD_OK" % r) in out
