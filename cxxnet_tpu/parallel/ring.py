@@ -162,7 +162,14 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
                               tiled=True)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    out = attention_reference(qh, kh, vh, causal=causal, scale=scale)
+    # after the all-to-all each device holds h/n full-length heads — the
+    # single-chip flash kernel applies as-is, keeping the local attention
+    # O(L) in memory instead of materializing the (L, L) score matrix
+    from .. import ops
+    if ops.use_pallas() and ops.flash_supported(qh.shape[2], qh.shape[3]):
+        out = ops.flash_attention(qh, kh, vh, causal=causal, scale=scale)
+    else:
+        out = attention_reference(qh, kh, vh, causal=causal, scale=scale)
     return heads_to_seq(out)
 
 

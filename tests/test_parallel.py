@@ -116,6 +116,22 @@ class TestUlysses:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
 
+    def test_flash_local_matches_dense(self):
+        """With Pallas forced on, the ulysses local attention runs the
+        flash kernel (interpret mode on CPU) after the all-to-all."""
+        from cxxnet_tpu import ops
+        q, k, v = _qkv(b=1, h=8, s=128, seed=5)   # flash needs L >= 128
+        mesh = _mesh()
+        assert ops.flash_supported(q.shape[2], q.shape[3])
+        ops.set_use_pallas(True)
+        try:
+            out = ring.ulysses_attention(q, k, v, mesh, causal=True)
+        finally:
+            ops.set_use_pallas(None)
+        ref = ring.attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
 
 class TestTensorParallel:
     def test_column_parallel(self):
