@@ -134,8 +134,12 @@ def lrn(x: jnp.ndarray, nsize: int, alpha: float, beta: float, knorm: float) -> 
     """Local response normalization across channels
     (reference: src/layer/lrn_layer-inl.hpp:52-60). Dispatches to the fused
     Pallas kernel on TPU (banded-matmul window sum on the MXU), XLA
-    reduce_window elsewhere."""
-    if use_pallas():
+    reduce_window elsewhere. CXXNET_LRN=xla forces the reduce_window path
+    on TPU too — the banded matmul costs O(C^2) MACs per pixel (conv-sized
+    at AlexNet's C=256), so which wins is measured, not assumed
+    (tools/mfu_experiments.py ablation)."""
+    import os
+    if use_pallas() and os.environ.get("CXXNET_LRN") != "xla":
         from . import pallas_kernels
         return pallas_kernels.lrn(x, nsize, alpha, beta, knorm)
     return lrn_xla(x, nsize, alpha, beta, knorm)

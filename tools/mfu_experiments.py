@@ -51,7 +51,9 @@ def sweep(model):
                                    resnet_trainer)
     if model == "alexnet":
         build, shape, variants = alexnet_trainer, (3, 227, 227), [
-            (256, BF16), (512, BF16), (1024, BF16), (256, F32)]
+            (256, BF16), (512, BF16), (1024, BF16), (256, F32),
+            # LRN ablation: Pallas banded matmul vs XLA reduce_window
+            (256, BF16 + "#lrn=xla\n")]
     elif model == "googlenet":
         build, shape, variants = googlenet_trainer, (3, 224, 224), [
             (128, BF16), (256, BF16), (512, BF16),
@@ -62,6 +64,10 @@ def sweep(model):
             (128, BF16), (256, BF16)]
     hw = shape[1]
     for batch, extra in variants:
+        lrn_xla = "#lrn=xla" in extra
+        if lrn_xla:
+            os.environ["CXXNET_LRN"] = "xla"
+            extra = extra.replace("#lrn=xla\n", "")
         try:
             tr = build(batch_size=batch, input_hw=hw, dev="tpu",
                        extra_cfg=extra)
@@ -71,10 +77,13 @@ def sweep(model):
                 "model": model, "batch": batch,
                 "dtype": "bf16" if "bfloat16" in extra else "f32",
                 "fused": 0 if "fuse_sibling_convs = 0" in extra else 1,
+                "lrn": "xla" if lrn_xla else "default",
                 "images_per_sec": round(ips, 1)}), flush=True)
         except Exception as exc:   # OOM etc: record and continue the sweep
             print(json.dumps({"model": model, "batch": batch,
                               "error": str(exc)[:200]}), flush=True)
+        finally:
+            os.environ.pop("CXXNET_LRN", None)
 
 
 def main():
