@@ -1,0 +1,71 @@
+"""Gradient-accumulation equivalence: update_period=k on 1/k-size batches
+must reproduce the single large-batch update exactly (the reference's
+need_sync/need_update contract, src/nnet/nnet_impl-inl.hpp:146-185, with
+loss pre-scaled by 1/(batch*update_period))."""
+
+import numpy as np
+import jax
+
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.nnet.trainer import Trainer
+from cxxnet_tpu.utils.config import parse_config_string
+
+CONF = """
+netconfig=start
+layer[0->c1] = conv:c1
+  kernel_size = 3
+  pad = 1
+  nchannel = 4
+layer[c1->r1] = relu
+layer[r1->fl] = flatten
+layer[fl->out] = fullc:head
+  nhidden = 5
+layer[+0] = softmax
+netconfig=end
+random_type = xavier
+metric = error
+input_shape = 3,6,6
+dev = cpu
+eta = 0.1
+momentum = 0.9
+wd = 0.0001
+eval_train = 0
+seed = 11
+"""
+
+
+def _trainer(extra):
+    tr = Trainer()
+    for k, v in parse_config_string(CONF + extra):
+        tr.set_param(k, v)
+    tr.init_model()
+    return tr
+
+
+def _batch(x, y):
+    b = DataBatch()
+    b.data, b.label, b.batch_size = x, y, x.shape[0]
+    return b
+
+
+def test_update_period_matches_large_batch():
+    rs = np.random.RandomState(0)
+    x = rs.rand(8, 3, 6, 6).astype(np.float32)
+    y = rs.randint(0, 5, (8, 1)).astype(np.float32)
+
+    big = _trainer("batch_size = 8\n")
+    small = _trainer("batch_size = 4\nupdate_period = 2\n")
+
+    for step in range(3):
+        big.update(_batch(x, y))
+        small.update(_batch(x[:4], y[:4]))
+        small.update(_batch(x[4:], y[4:]))
+        assert small.epoch_counter == big.epoch_counter == step + 1
+
+    for pb, ps in zip(big.params, small.params):
+        assert sorted(pb) == sorted(ps)
+        for k in pb:
+            np.testing.assert_allclose(
+                np.asarray(jax.device_get(pb[k])),
+                np.asarray(jax.device_get(ps[k])),
+                rtol=1e-5, atol=1e-6)
