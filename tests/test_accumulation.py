@@ -69,3 +69,25 @@ def test_update_period_matches_large_batch():
                 np.asarray(jax.device_get(pb[k])),
                 np.asarray(jax.device_get(ps[k])),
                 rtol=1e-5, atol=1e-6)
+
+
+def test_zero_sharded_optimizer_matches_plain():
+    """update_on_server=1 (ZeRO weight-update sharding) is a layout change,
+    not a math change: params after k steps match the replicated-optimizer
+    run exactly (reference capability: server-side optimizer,
+    src/nnet/nnet_ps_server.cpp:83-138)."""
+    rs = np.random.RandomState(1)
+    x = rs.rand(8, 3, 6, 6).astype(np.float32)
+    y = rs.randint(0, 5, (8, 1)).astype(np.float32)
+
+    plain = _trainer("batch_size = 8\ndev = cpu:0-7\n")
+    zero = _trainer("batch_size = 8\ndev = cpu:0-7\nupdate_on_server = 1\n")
+    for _ in range(3):
+        plain.update(_batch(x, y))
+        zero.update(_batch(x, y))
+    for pp, pz in zip(plain.params, zero.params):
+        for k in pp:
+            np.testing.assert_allclose(
+                np.asarray(jax.device_get(pp[k])),
+                np.asarray(jax.device_get(pz[k])),
+                rtol=1e-5, atol=1e-6)
