@@ -35,6 +35,8 @@ class UpdaterParam:
         self.final_momentum = 0.90
         self.saturation_epoch = 0
         self.clip_gradient = 0.0
+        self.lr_warmup = 0      # linear warmup steps (0 -> none)
+        self.lr_total = 0       # cosine horizon in updates (required)
 
     def set_param(self, name: str, val: str) -> None:
         # tag-scoped override: "wmat:lr" applies when tag == "wmat"
@@ -63,7 +65,8 @@ class UpdaterParam:
             sub = name.split(":", 1)[1]
             if sub == "schedule":
                 self.lr_schedule = {"constant": 0, "expdecay": 1,
-                                    "polydecay": 2, "factor": 3}.get(val, self.lr_schedule)
+                                    "polydecay": 2, "factor": 3,
+                                    "cosine": 4}.get(val, self.lr_schedule)
             if sub == "gamma":
                 self.lr_gamma = float(val)
             if sub == "alpha":
@@ -76,6 +79,10 @@ class UpdaterParam:
                 self.lr_minimum = float(val)
             if sub == "start_epoch":
                 self.start_epoch = int(val)
+            if sub == "warmup":
+                self.lr_warmup = int(val)
+            if sub == "total":
+                self.lr_total = int(val)
 
     def schedule_epoch(self, epoch):
         """Return (learning_rate, momentum) at `epoch` updates
@@ -91,6 +98,13 @@ class UpdaterParam:
                 1.0 + jnp.floor(e / self.lr_step) * self.lr_gamma, -self.lr_alpha)
         elif self.lr_schedule == 3:
             lr = self.base_lr * jnp.power(self.lr_factor, jnp.floor(e / self.lr_step))
+        elif self.lr_schedule == 4:
+            # cosine decay to lr_minimum over lr:total updates (beyond the
+            # reference's schedule set; the transformer-era default)
+            total = max(self.lr_total, 1)
+            frac = jnp.clip(e / total, 0.0, 1.0)
+            lr = self.lr_minimum + 0.5 * (self.base_lr - self.lr_minimum) \
+                * (1.0 + jnp.cos(jnp.pi * frac))
         else:
             raise ValueError("unknown schedule type")
         momentum = jnp.asarray(self.momentum, jnp.float32)
@@ -102,4 +116,7 @@ class UpdaterParam:
         momentum = jnp.minimum(momentum, self.final_momentum)
         lr = jnp.maximum(lr, self.lr_minimum)
         lr = jnp.where(e < self.start_epoch, self.base_lr, lr)
+        if self.lr_warmup > 0:
+            # linear ramp 0 -> scheduled lr over the first lr:warmup updates
+            lr = lr * jnp.clip((e + 1.0) / self.lr_warmup, 0.0, 1.0)
         return lr, momentum

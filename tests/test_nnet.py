@@ -227,6 +227,23 @@ def test_lr_schedules():
     np.testing.assert_allclose(float(lr), 1e-5, rtol=1e-4)  # clamped to minimum
 
 
+def test_cosine_schedule_with_warmup():
+    up = create_updater("sgd", "wmat")
+    up.set_param("eta", "0.1")
+    up.set_param("lr:schedule", "cosine")
+    up.set_param("lr:total", "1000")
+    up.set_param("lr:minimum_lr", "0.001")
+    up.set_param("lr:warmup", "10")
+    lr0, _ = up.param.schedule_epoch(0)          # first warmup step: lr/10
+    np.testing.assert_allclose(float(lr0), 0.1 * (1 / 10.0), rtol=1e-5)
+    lr_mid, _ = up.param.schedule_epoch(500)     # cosine midpoint
+    np.testing.assert_allclose(float(lr_mid), (0.1 + 0.001) / 2, rtol=1e-4)
+    lr_end, _ = up.param.schedule_epoch(1000)    # floor at lr:minimum_lr
+    np.testing.assert_allclose(float(lr_end), 0.001, rtol=1e-4)
+    lr_past, _ = up.param.schedule_epoch(5000)   # clamped past the horizon
+    np.testing.assert_allclose(float(lr_past), 0.001, rtol=1e-4)
+
+
 def test_tag_scoped_params():
     up_w = create_updater("sgd", "wmat")
     up_b = create_updater("sgd", "bias")
