@@ -63,6 +63,8 @@ class Trainer:
         self.input_scale = 1.0      # device-side input normalization
         self.input_mean = None
         self.fuse_sibling_convs = 1  # sibling-conv fusion pass (net.py)
+        self.clip_global_norm = 0.0  # 0 -> off (per-tensor clip_gradient
+        #                              remains the reference-parity knob)
         self.metric = MetricSet()
         self.train_metric = MetricSet()
         self.eval_node_names: List[Optional[str]] = []  # None -> last node
@@ -107,6 +109,8 @@ class Trainer:
             self.test_on_server = int(val)
         if name == "fuse_sibling_convs":
             self.fuse_sibling_convs = int(val)
+        if name == "clip_global_norm":
+            self.clip_global_norm = float(val)
         if name == "compute_dtype":
             check(val in ("float32", "bfloat16", "bf16"),
                   "compute_dtype must be float32 or bfloat16")
@@ -479,6 +483,15 @@ class Trainer:
             if accumulate:
                 grads = jax.tree.map(jnp.add, grad_accum, grads)
             if do_update:
+                if self.clip_global_norm > 0:
+                    # whole-model norm clip (beyond the reference's
+                    # per-tensor clip_gradient): one scale for every
+                    # tensor preserves the gradient direction
+                    leaves = jax.tree_util.tree_leaves(grads)
+                    gn = jnp.sqrt(sum(jnp.vdot(g, g) for g in leaves))
+                    scale = jnp.minimum(
+                        1.0, self.clip_global_norm / jnp.maximum(gn, 1e-12))
+                    grads = jax.tree.map(lambda g: g * scale, grads)
                 params, opt_state = self._apply_updates(
                     params, grads, opt_state, epoch)
                 if with_accum:

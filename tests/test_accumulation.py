@@ -91,3 +91,24 @@ def test_zero_sharded_optimizer_matches_plain():
                 np.asarray(jax.device_get(pp[k])),
                 np.asarray(jax.device_get(pz[k])),
                 rtol=1e-5, atol=1e-6)
+
+
+def test_clip_global_norm():
+    """clip_global_norm=c with plain SGD (no momentum/wd): the parameter
+    step has global norm exactly lr*c when the raw gradient norm exceeds
+    c (one shared scale preserves direction across tensors)."""
+    extra = ("batch_size = 8\nmomentum = 0\nwd = 0\neta = 0.5\n"
+             "clip_global_norm = 0.001\n")
+    rs = np.random.RandomState(2)
+    x = rs.rand(8, 3, 6, 6).astype(np.float32)
+    y = rs.randint(0, 5, (8, 1)).astype(np.float32)
+    tr = _trainer(extra)
+    before = [{k: np.asarray(jax.device_get(v)) for k, v in p.items()}
+              for p in tr.params]
+    tr.update(_batch(x, y))
+    delta_sq = 0.0
+    for pb, pa in zip(before, tr.params):
+        for k in pb:
+            d = np.asarray(jax.device_get(pa[k])) - pb[k]
+            delta_sq += float((d * d).sum())
+    np.testing.assert_allclose(np.sqrt(delta_sq), 0.5 * 0.001, rtol=1e-4)
