@@ -867,20 +867,35 @@ class SoftmaxLayer(LossLayerBase):
     def __init__(self):
         super().__init__()
         self.seq = 0
+        # label_smooth = eps (beyond the reference): targets become
+        # (1-eps) one-hot + eps/K uniform; grad = (p - smoothed) * scale
+        self.label_smooth = 0.0
 
     def set_param(self, name, val):
         super().set_param(name, val)
         if name == "seq":
             self.seq = int(val)
+        if name == "label_smooth":
+            self.label_smooth = float(val)
+            check(0.0 <= self.label_smooth < 1.0,
+                  "label_smooth must be in [0, 1)")
 
     def transform(self, x2d):
         return jax.nn.softmax(x2d, axis=-1)
 
+    def _ce(self, logp, target_logp_row):
+        eps = self.label_smooth
+        if eps == 0.0:
+            return -target_logp_row
+        k = logp.shape[-1]
+        return -((1.0 - eps) * target_logp_row
+                 + eps / k * jnp.sum(logp, axis=-1))
+
     def loss_term(self, x2d, label):
         logp = jax.nn.log_softmax(x2d, axis=-1)
         idx = label[:, 0].astype(jnp.int32)
-        ce = -jnp.take_along_axis(logp, idx[:, None], axis=1)[:, 0]
-        return jnp.sum(ce) * self._scale()
+        tgt = jnp.take_along_axis(logp, idx[:, None], axis=1)[:, 0]
+        return jnp.sum(self._ce(logp, tgt)) * self._scale()
 
     def apply(self, params, inputs, ctx):
         if not self.seq:
@@ -897,7 +912,8 @@ class SoftmaxLayer(LossLayerBase):
                   % (label.shape[1], L))
             logp = jax.nn.log_softmax(logits, axis=-1)
             idx = label.astype(jnp.int32)[..., None]
-            ce = -jnp.take_along_axis(logp, idx, axis=2)[..., 0]
+            tgt = jnp.take_along_axis(logp, idx, axis=2)[..., 0]
+            ce = self._ce(logp, tgt)
             ctx.losses.append(jnp.sum(ce) / L * self._scale())
         return [out.transpose(0, 2, 1).reshape(b, v, 1, L)]
 

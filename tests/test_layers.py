@@ -396,3 +396,36 @@ def test_pairtest_layer_runs():
     y = pt.apply({}, [jnp.asarray(x)], c)[0]
     np.testing.assert_allclose(np.asarray(y), np.maximum(x, 0))
     assert float(c.pairtest_diffs[0]) < 1e-5
+
+
+def test_softmax_label_smoothing():
+    """label_smooth=eps: loss equals (1-eps)*CE + eps*uniform-CE, and the
+    logit gradient is p - ((1-eps)*onehot + eps/K)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from cxxnet_tpu.layer import factory
+    from cxxnet_tpu.layer.base import ApplyContext, LabelInfo
+
+    rs = np.random.RandomState(0)
+    logits = rs.randn(4, 5).astype(np.float32)
+    y = rs.randint(0, 5, (4, 1)).astype(np.float32)
+    eps = 0.1
+
+    lay = factory.create_layer(factory.get_layer_type("softmax"))
+    lay.set_param("label_smooth", str(eps))
+    lay.set_param("batch_size", "4")
+    lay.infer_shape([(4, 1, 1, 5)])
+
+    def loss(x):
+        ctx = ApplyContext(train=True, labels=LabelInfo({"label": jnp.asarray(y)}))
+        lay.apply({}, [x.reshape(4, 1, 1, 5)], ctx)
+        return sum(ctx.losses)
+
+    g = jax.grad(loss)(jnp.asarray(logits)).reshape(4, 5)
+    p = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+    smoothed = np.full((4, 5), eps / 5, np.float32)
+    smoothed[np.arange(4), y[:, 0].astype(int)] += 1 - eps
+    # loss layers scale by grad_scale/batch (=1/4 here)
+    np.testing.assert_allclose(np.asarray(g), (p - smoothed) / 4,
+                               rtol=1e-5, atol=1e-6)
