@@ -130,6 +130,107 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
     return out.transpose(1, 2, 0, 3, 4).reshape(b, h, sq, d)
 
 
+# ---------------------------------------------------------------------------
+# flash-kernel ring step (opt-in: CXXNET_RING=flash) — ops/ring_flash.py
+# runs each ring step's online-softmax update fully in VMEM; backward is a
+# second ring pass (dq accumulates locally, dk/dv travel with their block)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_flash_local(q, k, v, axis_name, causal, scale, interpret):
+    out, _ = _ring_flash_fwd(q, k, v, axis_name, causal, scale, interpret)
+    return out
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, scale, interpret):
+    from ..ops import ring_flash as rf
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    bh = b * h
+    qf, kf, vf = (t.reshape(bh, t.shape[2], d) for t in (q, k, v))
+    from ..ops.flash_attn import NEG_INF
+    m0 = jnp.full((bh, sq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bh, sq, 1), jnp.float32)
+    acc0 = jnp.zeros((bh, sq, d), jnp.float32)
+
+    def step(carry, t):
+        k_blk, v_blk, m, l, acc = carry
+        src = (idx - t) % n
+        offs = jnp.stack([idx * sq, src * skv]).astype(jnp.int32)
+        m, l, acc = rf.fwd_step(qf, k_blk, v_blk, m, l, acc, offs,
+                                causal=causal, scale=scale,
+                                interpret=interpret)
+        k_blk = collectives.ring_shift(k_blk, axis_name)
+        v_blk = collectives.ring_shift(v_blk, axis_name)
+        return (k_blk, v_blk, m, l, acc), None
+
+    (_, _, m, l, acc), _ = lax.scan(step, (kf, vf, m0, l0, acc0),
+                                    jnp.arange(n))
+    l_safe = jnp.maximum(l, 1e-30)
+    out = (acc / l_safe).astype(q.dtype).reshape(b, h, sq, d)
+    lse = m + jnp.log(l_safe)                                # (bh, sq, 1)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, scale, interpret, res, g):
+    from ..ops import ring_flash as rf
+    q, k, v, out, lse = res
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    bh = b * h
+    qf, kf, vf = (t.reshape(bh, t.shape[2], d) for t in (q, k, v))
+    dof = g.reshape(bh, sq, d)
+    of = out.reshape(bh, sq, d)
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32),
+                    axis=-1, keepdims=True)                  # (bh, sq, 1)
+    dq0 = jnp.zeros((bh, sq, d), jnp.float32)
+    dkv0 = jnp.zeros((bh, skv, d), jnp.float32)
+
+    def step(carry, t):
+        k_blk, v_blk, dk_blk, dv_blk, dq = carry
+        src = (idx - t) % n
+        offs = jnp.stack([idx * sq, src * skv]).astype(jnp.int32)
+        dq = rf.dq_step(qf, k_blk, v_blk, dof, lse, delta, dq, offs,
+                        causal=causal, scale=scale, interpret=interpret)
+        dk_blk, dv_blk = rf.dkv_step(qf, k_blk, v_blk, dof, lse, delta,
+                                     dk_blk, dv_blk, offs, causal=causal,
+                                     scale=scale, interpret=interpret)
+        # rotate the K/V block together with its gradient accumulators:
+        # after n shifts each block is home with every device's
+        # contribution summed in
+        k_blk = collectives.ring_shift(k_blk, axis_name)
+        v_blk = collectives.ring_shift(v_blk, axis_name)
+        dk_blk = collectives.ring_shift(dk_blk, axis_name)
+        dv_blk = collectives.ring_shift(dv_blk, axis_name)
+        return (k_blk, v_blk, dk_blk, dv_blk, dq), None
+
+    (_, _, dk, dv, dq), _ = lax.scan(
+        step, (kf, vf, dkv0, dkv0, dq0), jnp.arange(n))
+    shape_q = (b, h, sq, d)
+    shape_kv = (b, h, skv, d)
+    return (dq.astype(q.dtype).reshape(shape_q),
+            dk.astype(k.dtype).reshape(shape_kv),
+            dv.astype(v.dtype).reshape(shape_kv))
+
+
+_ring_flash_local.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def _ring_flash_enabled(sq: int, skv: int, d: int) -> bool:
+    import os
+    if os.environ.get("CXXNET_RING") != "flash":
+        return False
+    from .. import ops as _ops
+    if not _ops.use_pallas():
+        # honor the global Pallas kill-switch (ops.set_use_pallas(False))
+        # like every other kernel path
+        return False
+    from ..ops import ring_flash as rf
+    return rf.supports(sq, skv, d)
+
+
 def ring_attention(q, k, v, mesh: Mesh, *, axis_name: str = "sp",
                    causal: bool = False, scale: Optional[float] = None,
                    batch_axis: Optional[str] = None, q_chunk: int = 0):
@@ -142,6 +243,15 @@ def ring_attention(q, k, v, mesh: Mesh, *, axis_name: str = "sp",
     if scale is None:
         scale = q.shape[-1] ** -0.5
     spec = P(batch_axis, None, axis_name, None)
+    n = mesh.shape[axis_name]
+    sq = q.shape[2] // n
+    if _ring_flash_enabled(sq, k.shape[2] // n, q.shape[-1]):
+        interpret = jax.default_backend() != "tpu"
+        fn = shard_map(
+            lambda q_, k_, v_: _ring_flash_local(
+                q_, k_, v_, axis_name, causal, scale, interpret),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        return fn(q, k, v)
     fn = shard_map(
         functools.partial(_ring_attention_local, axis_name=axis_name,
                           causal=causal, scale=scale, q_chunk=q_chunk),
