@@ -97,3 +97,25 @@ def test_unsupported_shape_falls_back(flash_ring_env):
     ref = ring.attention_reference(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_trainer_sp_path_with_ring_flash(flash_ring_env):
+    """End-to-end DSL attention under seq_parallel=2 with the flash ring
+    step: one train step runs and produces a finite loss."""
+    import numpy as np
+    from cxxnet_tpu.models import transformer_lm_trainer
+    from cxxnet_tpu.io.data import DataBatch
+    rs = np.random.RandomState(0)
+    tr = transformer_lm_trainer(vocab=50, seq=512, batch_size=2, dim=64,
+                                nhead=4, nlayer=1, dev="cpu:0-1",
+                                extra_cfg="seq_parallel = 2\n"
+                                          "eval_train = 0\n")
+    b = DataBatch()
+    b.data = rs.randint(0, 50, (2, 1, 1, 512)).astype(np.float32)
+    b.label = rs.randint(0, 50, (2, 512)).astype(np.float32)
+    b.batch_size = 2
+    tr.update(b)
+    li = tr.net.label_info_from(b.label)
+    _, loss = tr.net.forward(tr.params, b.data, labels=li, train=False,
+                             mesh=tr.mesh)
+    assert np.isfinite(float(loss))
