@@ -123,14 +123,21 @@ class TestUlysses:
         q, k, v = _qkv(b=1, h=8, s=128, seed=5)   # flash needs L >= 128
         mesh = _mesh()
         assert ops.flash_supported(q.shape[2], q.shape[3])
+        w = np.random.RandomState(11).randn(*q.shape).astype(np.float32)
         ops.set_use_pallas(True)
         try:
             out = ring.ulysses_attention(q, k, v, mesh, causal=True)
+            gf = jax.grad(lambda q_: jnp.sum(ring.ulysses_attention(
+                q_, k, v, mesh, causal=True) * w))(q)
         finally:
             ops.set_use_pallas(None)
         ref = ring.attention_reference(q, k, v, causal=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-4, atol=2e-4)
+        gr = jax.grad(lambda q_: jnp.sum(ring.attention_reference(
+            q_, k, v, causal=True) * w))(q)
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=3e-4, atol=3e-4)
 
 
 class TestTensorParallel:
