@@ -966,6 +966,12 @@ class AttentionLayer(Layer):
         self.nhead = 1
         self.causal = 0
         self.sp_mode = "ring"
+        # rope = 1: rotary position embedding on q/k (relative positions
+        # enter through the score phase; composes with every attention
+        # path since the rotation happens before dispatch). Pair with
+        # embed pos_embed = 0.
+        self.rope = 0
+        self.rope_base = 10000.0
 
     def set_param(self, name, val):
         super().set_param(name, val)
@@ -973,6 +979,10 @@ class AttentionLayer(Layer):
             self.nhead = int(val)
         if name == "causal":
             self.causal = int(val)
+        if name == "rope":
+            self.rope = int(val)
+        if name == "rope_base":
+            self.rope_base = float(val)
         if name == "sp_mode":
             check(val in ("ring", "ulysses"),
                   "sp_mode must be ring or ulysses")
@@ -983,8 +993,27 @@ class AttentionLayer(Layer):
         b, d, h, L = in_shapes[0]
         check(h == 1, "attention input must be (batch, d_model, 1, seq)")
         check(d % self.nhead == 0, "nhead must divide d_model")
+        if self.rope:
+            check((d // self.nhead) % 2 == 0,
+                  "rope needs an even head dim")
         self.param.num_input_channel = d
         return [in_shapes[0]]
+
+    def _apply_rope(self, x):
+        """Rotary embedding on (b, nh, L, dh): rotate the (first-half,
+        second-half) feature pairs by position-dependent angles (Su et al.
+        2021) — relative offsets enter the q.k phase directly."""
+        dh = x.shape[-1]
+        half = dh // 2
+        pos = jnp.arange(x.shape[2], dtype=jnp.float32)[:, None]
+        inv = jnp.power(self.rope_base,
+                        -jnp.arange(half, dtype=jnp.float32) / half)
+        ang = pos * inv                                     # (L, half)
+        cos = jnp.cos(ang).astype(x.dtype)
+        sin = jnp.sin(ang).astype(x.dtype)
+        x1, x2 = x[..., :half], x[..., half:]
+        return jnp.concatenate([x1 * cos - x2 * sin,
+                                x1 * sin + x2 * cos], axis=-1)
 
     def init_params(self, rng):
         d = self.param.num_input_channel
@@ -1021,6 +1050,8 @@ class AttentionLayer(Layer):
             return t.reshape(b, L, nh, dh).transpose(0, 2, 1, 3)
 
         q, k, v = heads(q), heads(k), heads(v)
+        if self.rope:
+            q, k = self._apply_rope(q), self._apply_rope(k)
         mesh = ctx.mesh
         if mesh is not None and "sp" in getattr(mesh, "axis_names", ()):
             sp = mesh.shape["sp"]
