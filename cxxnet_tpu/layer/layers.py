@@ -972,6 +972,10 @@ class AttentionLayer(Layer):
         # embed pos_embed = 0.
         self.rope = 0
         self.rope_base = 10000.0
+        # nkvhead < nhead: grouped-query attention — k/v projections carry
+        # only nkvhead heads, broadcast to the query heads at dispatch
+        # (0 -> = nhead, classic MHA)
+        self.nkvhead = 0
 
     def set_param(self, name, val):
         super().set_param(name, val)
@@ -983,6 +987,8 @@ class AttentionLayer(Layer):
             self.rope = int(val)
         if name == "rope_base":
             self.rope_base = float(val)
+        if name == "nkvhead":
+            self.nkvhead = int(val)
         if name == "sp_mode":
             check(val in ("ring", "ulysses"),
                   "sp_mode must be ring or ulysses")
@@ -996,6 +1002,9 @@ class AttentionLayer(Layer):
         if self.rope:
             check((d // self.nhead) % 2 == 0,
                   "rope needs an even head dim")
+        if self.nkvhead:
+            check(self.nhead % self.nkvhead == 0,
+                  "nkvhead must divide nhead")
         self.param.num_input_channel = d
         return [in_shapes[0]]
 
@@ -1015,10 +1024,15 @@ class AttentionLayer(Layer):
         return jnp.concatenate([x1 * cos - x2 * sin,
                                 x1 * sin + x2 * cos], axis=-1)
 
+    def _kv_width(self, d):
+        nkv = self.nkvhead or self.nhead
+        return nkv * (d // self.nhead)
+
     def init_params(self, rng):
         d = self.param.num_input_channel
+        w = d + 2 * self._kv_width(d)    # [q | k | v] columns; 3d for MHA
         return {"wqkv": self.param.rand_init_weight(
-                    rng, (d, 3 * d), in_num=d, out_num=3 * d),
+                    rng, (d, w), in_num=d, out_num=w),
                 "wo": self.param.rand_init_weight(
                     rng, (d, d), in_num=d, out_num=d)}
 
@@ -1042,16 +1056,25 @@ class AttentionLayer(Layer):
         x = inputs[0]
         b, d, _, L = x.shape
         nh, dh = self.nhead, d // self.nhead
+        nkv = self.nkvhead or nh
+        kvw = self._kv_width(d)
         seq = x.reshape(b, d, L).transpose(0, 2, 1)          # (b, L, d)
-        qkv = jnp.dot(seq, params["wqkv"])                    # (b, L, 3d)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        qkv = jnp.dot(seq, params["wqkv"])            # (b, L, d + 2*kvw)
+        q = qkv[..., :d]
+        k = qkv[..., d:d + kvw]
+        v = qkv[..., d + kvw:]
 
-        def heads(t):  # (b, L, d) -> (b, nh, L, dh)
-            return t.reshape(b, L, nh, dh).transpose(0, 2, 1, 3)
+        def heads(t, n):  # (b, L, n*dh) -> (b, n, L, dh)
+            return t.reshape(b, L, n, dh).transpose(0, 2, 1, 3)
 
-        q, k, v = heads(q), heads(k), heads(v)
+        q, k, v = heads(q, nh), heads(k, nkv), heads(v, nkv)
         if self.rope:
             q, k = self._apply_rope(q), self._apply_rope(k)
+        if nkv != nh:
+            # broadcast the kv groups to the query heads; XLA keeps this a
+            # view-ish repeat feeding the attention matmuls
+            k = jnp.repeat(k, nh // nkv, axis=1)
+            v = jnp.repeat(v, nh // nkv, axis=1)
         mesh = ctx.mesh
         if mesh is not None and "sp" in getattr(mesh, "axis_names", ()):
             sp = mesh.shape["sp"]

@@ -195,3 +195,83 @@ metric = error
         li = tr.net.label_info_from(b.label)
         _, loss = tr.net.forward(tr.params, b.data, labels=li, train=False)
         assert float(loss) < 3.0   # learned something vs ~log(30)=3.4
+
+
+class TestGQA:
+    def test_mqa_matches_manual_reference(self):
+        """nkvhead=1 (multi-query): layer output equals dense reference
+        attention with the single k/v head broadcast to all query heads."""
+        import numpy as np
+        import jax.numpy as jnp
+        from cxxnet_tpu.layer import factory
+        from cxxnet_tpu.layer.base import ApplyContext
+        from cxxnet_tpu.parallel import attention_reference
+
+        d, nh, L, b = 16, 4, 8, 2
+        dh = d // nh
+        lay = factory.create_layer(factory.get_layer_type("attention"))
+        lay.set_param("nhead", str(nh))
+        lay.set_param("nkvhead", "1")
+        lay.set_param("causal", "1")
+        lay.infer_shape([(b, d, 1, L)])
+        rs = np.random.RandomState(0)
+        params = lay.init_params(rs)
+        assert params["wqkv"].shape == (d, d + 2 * dh)
+        x = rs.randn(b, d, 1, L).astype(np.float32)
+        (out,) = lay.apply({k: jnp.asarray(v) for k, v in params.items()},
+                           [jnp.asarray(x)], ApplyContext(train=False))
+
+        seq = x.reshape(b, d, L).transpose(0, 2, 1)
+        qkv = seq @ params["wqkv"]
+        q = qkv[..., :d].reshape(b, L, nh, dh).transpose(0, 2, 1, 3)
+        k = qkv[..., d:d + dh].reshape(b, L, 1, dh).transpose(0, 2, 1, 3)
+        v = qkv[..., d + dh:].reshape(b, L, 1, dh).transpose(0, 2, 1, 3)
+        k = np.broadcast_to(k, (b, nh, L, dh))
+        v = np.broadcast_to(v, (b, nh, L, dh))
+        att = np.asarray(attention_reference(
+            jnp.asarray(q), jnp.asarray(np.ascontiguousarray(k)),
+            jnp.asarray(np.ascontiguousarray(v)), causal=True))
+        ref = (att.transpose(0, 2, 1, 3).reshape(b, L, d)
+               @ params["wo"]).transpose(0, 2, 1).reshape(b, d, 1, L)
+        np.testing.assert_allclose(np.asarray(out), ref,
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_gqa_trains_and_roundtrips(self):
+        import numpy as np
+        from cxxnet_tpu.models import transformer_lm_trainer
+        from cxxnet_tpu.io.data import DataBatch
+        from cxxnet_tpu.utils import serializer
+        tr = transformer_lm_trainer(
+            vocab=30, seq=16, batch_size=4, dim=32, nhead=4, nlayer=1,
+            dev="cpu", extra_cfg="")
+        # GQA via the DSL requires the key inside the attention layer scope;
+        # easier to pin through a fresh conf
+        from cxxnet_tpu.nnet.trainer import Trainer
+        from cxxnet_tpu.utils.config import parse_config_string
+        from cxxnet_tpu.models import transformer_lm_netconfig
+        conf = transformer_lm_netconfig(30, dim=32, nhead=4, nlayer=1)
+        conf = conf.replace("  causal = 1\n", "  causal = 1\n  nkvhead = 2\n")
+        conf += ("input_shape = 1,1,16\nbatch_size = 4\n"
+                 "label_vec[0,16) = label\nupdater = adam\neta = 0.003\n"
+                 "dev = cpu\n")
+        tr = Trainer()
+        for k, v in parse_config_string(conf):
+            tr.set_param(k, v)
+        tr.init_model()
+        rs = np.random.RandomState(0)
+        b = DataBatch()
+        b.data = rs.randint(0, 30, (4, 1, 1, 16)).astype(np.float32)
+        b.label = rs.randint(0, 30, (4, 16)).astype(np.float32)
+        b.batch_size = 4
+        for _ in range(3):
+            tr.update(b)
+        w = serializer.Writer()
+        tr.save_model(w)
+        blob = w.getvalue()
+        tr2 = Trainer()
+        for k, v in parse_config_string(conf):
+            tr2.set_param(k, v)
+        tr2.load_model(serializer.Reader(blob))
+        p1 = np.asarray(tr.params[1]["wqkv"])
+        p2 = np.asarray(tr2.params[1]["wqkv"])
+        np.testing.assert_array_equal(p1, p2)
