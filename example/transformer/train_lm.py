@@ -46,6 +46,23 @@ def next_token_accuracy(tr, batch):
     return float((pred[:, half:] == batch.label[:, half:]).mean())
 
 
+def generate(tr, prompts, n_new):
+    """Greedy autoregressive continuation of a (batch, prefix_len) prompt
+    matrix. Recomputes the full prefix each step (no KV cache — the demo
+    path; causal masking makes the padded tail inert)."""
+    batch, plen = prompts.shape
+    toks = np.zeros((batch, SEQ), np.int64)
+    toks[:, :plen] = prompts
+    for t in range(plen, min(plen + n_new, SEQ)):
+        b = DataBatch()
+        b.data = toks.reshape(batch, 1, 1, SEQ).astype(np.float32)
+        b.label = np.zeros((batch, SEQ), np.float32)
+        b.batch_size = batch
+        probs = tr.extract_feature(b, "top[-1]")     # (b, VOCAB, 1, SEQ)
+        toks[:, t] = probs.reshape(batch, VOCAB, SEQ)[:, :, t - 1].argmax(1)
+    return toks[:, plen:plen + n_new]
+
+
 def main(steps=400, dev=None):
     conf = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "lm.conf")
@@ -63,6 +80,15 @@ def main(steps=400, dev=None):
                   % (i + 1, next_token_accuracy(tr, eval_b)))
     acc = next_token_accuracy(tr, eval_b)
     print("final next-token accuracy: %.3f" % acc)
+    # greedy generation demo: continue the eval walks from their first half
+    half = SEQ // 2
+    prompts = np.asarray(eval_b.data).reshape(-1, SEQ)[:, :half].astype(np.int64)
+    cont = generate(tr, prompts, half)
+    truth = np.concatenate(
+        [np.asarray(eval_b.data).reshape(-1, SEQ)[:, half:],
+         np.asarray(eval_b.label)[:, -1:]], axis=1)[:, :half]
+    gen_acc = float((cont == truth).mean())
+    print("greedy generation accuracy over %d tokens: %.3f" % (half, gen_acc))
     return acc
 
 
