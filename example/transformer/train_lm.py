@@ -63,11 +63,16 @@ def generate(tr, prompts, n_new):
     return toks[:, plen:plen + n_new]
 
 
-def main(steps=400, dev=None):
+def main(steps=400, dev=None, seed=None):
     conf = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "lm.conf")
+    overrides = []
+    if dev:
+        overrides.append("dev=%s" % dev)
+    if seed is not None:
+        overrides.append("seed=%d" % seed)
     tr = Trainer()
-    for k, v in ConfigIterator(conf, ["dev=%s" % dev] if dev else []):
+    for k, v in ConfigIterator(conf, overrides):
         tr.set_param(k, v)
     tr.init_model()
     rs = np.random.RandomState(0)

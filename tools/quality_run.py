@@ -154,6 +154,18 @@ def main():
                 results.append(r)
                 print(r, flush=True)
 
+    # transformer-LM recipe (the long-context family, beyond the
+    # reference): cyclic-walk corpus, 400 adam steps, next-token accuracy
+    sys.path.insert(0, os.path.join(REPO, "example", "transformer"))
+    import train_lm
+    lm_rows = []
+    for seed in (0, 1, 2):
+        t0 = time.time()
+        acc = train_lm.main(steps=400, dev=dev, seed=seed)
+        lm_rows.append(dict(seed=seed, steps=400, acc=acc,
+                            wall_s=time.time() - t0))
+        print(lm_rows[-1], flush=True)
+
     lines = [
         "# QUALITY — convergence evidence",
         "",
@@ -171,6 +183,16 @@ def main():
         lines.append("| %s | %s | %d | %d | %.4f | %.4f | %.1f |" % (
             r["recipe"], r["corpus"], r["seed"], r["rounds"],
             r["train_err"], r["test_err"], r["wall_s"]))
+
+    lines.append("")
+    lines.append("Transformer LM (example/transformer, cyclic-walk corpus, "
+                 "400 adam steps):")
+    lines.append("")
+    lines.append("| recipe | seed | steps | next-token acc | wall s |")
+    lines.append("|---|---|---|---|---|")
+    for r in lm_rows:
+        lines.append("| transformer_lm | %d | %d | %.4f | %.1f |" % (
+            r["seed"], r["steps"], r["acc"], r["wall_s"]))
 
     # aggregate check lines
     import statistics as st
@@ -208,6 +230,12 @@ def main():
         if max(errs) - min(errs) > 0.1:
             bad.append("%s hard error unstable across seeds: %s"
                        % (rec, errs))
+    lm_accs = [r["acc"] for r in lm_rows]
+    if min(lm_accs) < 0.90:
+        bad.append("transformer_lm next-token acc below 0.90: %s" % lm_accs)
+    if st.mean(lm_accs) < 0.93:
+        bad.append("transformer_lm mean acc %.3f below 0.93"
+                   % st.mean(lm_accs))
     if bad:
         print("QUALITY REGRESSION:\n  " + "\n  ".join(bad))
         sys.exit(1)
