@@ -83,3 +83,22 @@ def test_googlenet_conf_builds_and_steps():
     tr.update(b)
     out = tr.predict(b)
     assert out.shape == (4,)
+
+
+def test_vgg_conf_builds_and_steps():
+    """The VGG-16 example: parses (incl. the remat=1 netcfg default) and a
+    reduced vgg11 takes a training step."""
+    tr, cfg = build_from_conf(
+        os.path.join(REPO, "example/ImageNet/VGG.conf"))
+    assert all(l.remat == 1 for l in tr.net.layers)
+    from cxxnet_tpu.models import vgg_trainer
+    tr = vgg_trainer(batch_size=4, input_hw=32, dev="cpu", n_class=10,
+                     arch="vgg11", fc_dim=32, dropout=0.0)
+    rs = np.random.RandomState(0)
+    b = DataBatch()
+    b.data = rs.rand(4, 3, 32, 32).astype(np.float32)
+    b.label = rs.randint(0, 10, (4, 1)).astype(np.float32)
+    b.batch_size = 4
+    tr.update(b)
+    out = tr.predict(b)
+    assert out.shape == (4,)
