@@ -46,6 +46,44 @@ def measure(tr, shape, nclass, batch, steps=30):
     return steps * batch / (time.perf_counter() - t0)
 
 
+def sweep_transformer():
+    """Long-context LM throughput: tokens/sec at L=2048, bf16 flash
+    attention (the attention path has no CNN-style img/s equivalent)."""
+    import jax
+    import jax.numpy as jnp
+    from cxxnet_tpu.models import transformer_lm_trainer
+    from cxxnet_tpu.io.data import DataBatch
+    for batch, L in ((8, 2048), (4, 8192)):
+        try:
+            tr = transformer_lm_trainer(
+                vocab=8192, seq=L, batch_size=batch, dim=512, nhead=8,
+                nlayer=4, dev="tpu",
+                extra_cfg="eval_train = 0\ncompute_dtype = bfloat16\n")
+            rs = np.random.RandomState(0)
+            b = DataBatch()
+            b.data = rs.randint(0, 8192, (batch, 1, 1, L)).astype(
+                np.float32)
+            b.label = rs.randint(0, 8192, (batch, L)).astype(np.float32)
+            b.batch_size = batch
+            for _ in range(3):
+                tr.update(b)
+            float(jnp.sum(next(v for p in tr.params for v in p.values())))
+            t0 = time.perf_counter()
+            steps = 20
+            for _ in range(steps):
+                tr.update(b)
+            float(jnp.sum(next(v for p in tr.params for v in p.values())))
+            tps = steps * batch * L / (time.perf_counter() - t0)
+            del tr
+            print(json.dumps({"model": "transformer_lm", "batch": batch,
+                              "seq": L, "dtype": "bf16",
+                              "tokens_per_sec": round(tps, 1)}), flush=True)
+        except Exception as exc:
+            print(json.dumps({"model": "transformer_lm", "batch": batch,
+                              "seq": L, "error": str(exc)[:200]}),
+                  flush=True)
+
+
 def sweep(model):
     from cxxnet_tpu.models import (alexnet_trainer, googlenet_trainer,
                                    resnet_trainer)
@@ -90,10 +128,15 @@ def main():
     from cxxnet_tpu.utils import enable_compile_cache
     enable_compile_cache()
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which == "transformer":
+        sweep_transformer()
+        return
     models = ("alexnet", "googlenet", "resnet") if which == "all" \
         else (which,)
     for m in models:
         sweep(m)
+    if which == "all":
+        sweep_transformer()
 
 
 if __name__ == "__main__":
