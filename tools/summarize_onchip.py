@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Summarize onchip_logs/ (produced by tools/onchip_queue.sh) into a
+markdown block for ROUND_NOTES.md: bench lines, the MFU sweep table with
+fusion/LRN ablation ratios, pipeline lines, and per-step status.
+
+Usage: python tools/summarize_onchip.py [onchip_logs]
+"""
+
+import json
+import os
+import sys
+
+
+def read_json_lines(path):
+    rows = []
+    if not os.path.isfile(path):
+        return rows
+    for line in open(path):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rows.append(json.loads(line))
+            except ValueError:
+                pass
+    return rows
+
+
+def main():
+    d = sys.argv[1] if len(sys.argv) > 1 else "onchip_logs"
+    out = []
+
+    status = os.path.join(d, "STATUS")
+    if os.path.isfile(status):
+        out.append("## Queue status")
+        out.append("```")
+        out.extend(open(status).read().strip().splitlines())
+        out.append("```")
+
+    for name in ("bench", "pipeline", "benchall"):
+        rows = read_json_lines(os.path.join(d, "%s.log" % name))
+        if rows:
+            out.append("## %s" % name)
+            for r in rows:
+                out.append("- `%s`" % json.dumps(r))
+
+    mfu = read_json_lines(os.path.join(d, "mfu.log"))
+    if mfu:
+        out.append("## MFU sweep")
+        out.append("| model | batch | dtype | fused | lrn | img/s or tok/s |")
+        out.append("|---|---|---|---|---|---|")
+        for r in mfu:
+            out.append("| %s | %s | %s | %s | %s | %s |" % (
+                r.get("model"), r.get("batch"),
+                r.get("dtype", "-"), r.get("fused", "-"),
+                r.get("lrn", "-"),
+                r.get("images_per_sec") or r.get("tokens_per_sec")
+                or ("ERR: " + str(r.get("error"))[:60])))
+        # ablation ratios
+        def find(model, batch, **kw):
+            for r in mfu:
+                if r.get("model") == model and r.get("batch") == batch \
+                        and all(r.get(k) == v for k, v in kw.items()) \
+                        and "images_per_sec" in r:
+                    return r["images_per_sec"]
+            return None
+        gf = find("googlenet", 256, fused=1, lrn="default")
+        gu = find("googlenet", 256, fused=0)
+        if gf and gu:
+            out.append("")
+            out.append("- sibling-conv fusion: %.2fx on GoogLeNet b256 "
+                       "(%.0f vs %.0f img/s)" % (gf / gu, gf, gu))
+        ap = find("alexnet", 256, lrn="default", dtype="bf16")
+        ax = find("alexnet", 256, lrn="xla")
+        if ap and ax:
+            out.append("- LRN pallas-vs-xla on AlexNet b256: %.2fx "
+                       "(%.0f vs %.0f img/s)" % (ap / ax, ap, ax))
+
+    kern = os.path.join(d, "kernels.log")
+    if os.path.isfile(kern):
+        tail = open(kern).read().strip().splitlines()[-1:]
+        out.append("## kernels: %s" % (tail[0] if tail else "?"))
+
+    print("\n".join(out))
+
+
+if __name__ == "__main__":
+    main()
