@@ -119,3 +119,16 @@ def test_trainer_sp_path_with_ring_flash(flash_ring_env):
     _, loss = tr.net.forward(tr.params, b.data, labels=li, train=False,
                              mesh=tr.mesh)
     assert np.isfinite(float(loss))
+
+
+def test_bf16_forward_close_to_f32(flash_ring_env):
+    """bf16 operands (the trainer's compute dtype) stay within bf16
+    tolerance of the f32 dense reference — accumulation is f32 in-kernel."""
+    q, k, v = _qkv(seed=6)
+    mesh = _mesh()
+    qb, kb, vb = (jnp.asarray(x, jnp.bfloat16) for x in (q, k, v))
+    out = ring.ring_attention(qb, kb, vb, mesh, causal=True)
+    assert out.dtype == jnp.bfloat16
+    ref = ring.attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), rtol=0.05, atol=0.05)
