@@ -976,6 +976,10 @@ class AttentionLayer(Layer):
         # only nkvhead heads, broadcast to the query heads at dispatch
         # (0 -> = nhead, classic MHA)
         self.nkvhead = 0
+        # attn_window > 0 (causal only): sliding-window attention — each
+        # query sees only the last attn_window keys; flash kernels skip
+        # out-of-window tiles wholesale
+        self.attn_window = 0
 
     def set_param(self, name, val):
         super().set_param(name, val)
@@ -989,6 +993,8 @@ class AttentionLayer(Layer):
             self.rope_base = float(val)
         if name == "nkvhead":
             self.nkvhead = int(val)
+        if name == "attn_window":
+            self.attn_window = int(val)
         if name == "sp_mode":
             check(val in ("ring", "ulysses"),
                   "sp_mode must be ring or ulysses")
@@ -1005,6 +1011,9 @@ class AttentionLayer(Layer):
         if self.nkvhead:
             check(self.nhead % self.nkvhead == 0,
                   "nkvhead must divide nhead")
+        if self.attn_window:
+            check(self.attn_window > 0, "attn_window must be positive")
+            check(self.causal, "attn_window requires causal = 1")
         self.param.num_input_channel = d
         return [in_shapes[0]]
 
@@ -1091,7 +1100,7 @@ class AttentionLayer(Layer):
             # attention block would replicate the global batch per chip
             batch_axis = "data" if "data" in mesh.axis_names else None
             out = fn(q, k, v, mesh, causal=bool(self.causal),
-                     batch_axis=batch_axis)
+                     batch_axis=batch_axis, window=self.attn_window)
         elif ops.use_pallas() and ops.flash_supported(L, dh):
             # per-chip long-context path: blocked online-softmax Pallas
             # kernel, O(L) memory instead of the (L, L) score matrix. On a
@@ -1100,20 +1109,23 @@ class AttentionLayer(Layer):
             # pallas_call has no GSPMD partitioning rule of its own.
             causal = bool(self.causal)
             if mesh is None:
-                out = ops.flash_attention(q, k, v, causal=causal)
+                out = ops.flash_attention(q, k, v, causal=causal,
+                                          window=self.attn_window)
             else:
                 from ..parallel._compat import shard_map
                 from jax.sharding import PartitionSpec as P
                 batch_axis = ("data" if "data" in mesh.axis_names
                               and mesh.shape["data"] > 1 else None)
                 spec = P(batch_axis, None, None, None)
+                win = self.attn_window
                 out = shard_map(
                     lambda q_, k_, v_: ops.flash_attention(
-                        q_, k_, v_, causal=causal),
+                        q_, k_, v_, causal=causal, window=win),
                     mesh=mesh, in_specs=(spec, spec, spec),
                     out_specs=spec)(q, k, v)
         else:
-            out = attention_reference(q, k, v, causal=bool(self.causal))
+            out = attention_reference(q, k, v, causal=bool(self.causal),
+                                      window=self.attn_window)
         out = out.transpose(0, 2, 1, 3).reshape(b, L, d)      # merge heads
         out = jnp.dot(out, params["wo"])
         return [out.transpose(0, 2, 1).reshape(b, d, 1, L)]

@@ -151,13 +151,16 @@ def flash_supported(L: int, d: int) -> bool:
     return _fa.supports(L, d)
 
 
-def flash_attention(q, k, v, *, causal: bool = False, scale=None):
+def flash_attention(q, k, v, *, causal: bool = False, scale=None,
+                    window: int = 0):
     """Memory-O(L) blocked attention (ops/flash_attn.py). Off-TPU the
     kernels run in the Pallas interpreter so forced-on tests (and any CPU
-    debugging) execute the exact kernel code."""
+    debugging) execute the exact kernel code. window > 0 (causal only)
+    keeps the last ``window`` keys per query — sliding-window attention;
+    out-of-window kv tiles are skipped wholesale."""
     from . import flash_attn as _fa
     interpret = jax.default_backend() != "tpu"
-    return _fa.flash_attention(q, k, v, causal, scale, interpret)
+    return _fa.flash_attention(q, k, v, causal, scale, interpret, window)
 
 
 def softmax(x: jnp.ndarray, axis: int = -1) -> jnp.ndarray:

@@ -46,10 +46,11 @@ except ImportError:  # pragma: no cover
 NEG_INF = -1e30  # finite stand-in for -inf: keeps exp()/max() NaN-free
 
 
-def _mask(s, q_blk, kv_blk, block_q, block_k, causal, kv_len):
-    """Causal and/or padded-tail masking of a score tile. kv_len is the
-    true (pre-padding) sequence length — static, so the where() folds away
-    entirely for tile-aligned inputs."""
+def _mask(s, q_blk, kv_blk, block_q, block_k, causal, kv_len, window=0):
+    """Causal / sliding-window / padded-tail masking of a score tile.
+    kv_len is the true (pre-padding) sequence length — static, so the
+    where() folds away entirely for tile-aligned inputs. window > 0 keeps
+    only the last ``window`` keys per query (requires causal)."""
     kpos = kv_blk * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
     keep = kpos < kv_len
@@ -57,20 +58,30 @@ def _mask(s, q_blk, kv_blk, block_q, block_k, causal, kv_len):
         qpos = q_blk * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         keep = jnp.logical_and(keep, qpos >= kpos)
+        if window > 0:
+            keep = jnp.logical_and(keep, qpos - kpos < window)
     return jnp.where(keep, s, NEG_INF)
 
 
-def _block_needed(causal, q_blk, kv_blk, block_q, block_k):
-    """False only for kv tiles strictly above the causal diagonal — their
-    matmuls are skipped entirely (the flash causal-speedup)."""
+def _block_needed(causal, q_blk, kv_blk, block_q, block_k, window=0):
+    """False for kv tiles strictly above the causal diagonal, and (with a
+    sliding window) for tiles entirely older than the window — both are
+    skipped wholesale (the flash causal/local speedup). q_blk/kv_blk are
+    traced program ids; window is static."""
     if not causal:
         return True
-    return kv_blk * block_k <= q_blk * block_q + (block_q - 1)
+    need = kv_blk * block_k <= q_blk * block_q + (block_q - 1)
+    if window > 0:
+        # newest key of this tile vs oldest query of the q tile
+        need = jnp.logical_and(
+            need,
+            (q_blk * block_q) - (kv_blk * block_k + block_k - 1) < window)
+    return need
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k,
-                kv_len, padded):
+                kv_len, padded, window=0):
     kv_i = pl.program_id(2)
     n_kv = pl.num_programs(2)
     q_blk = pl.program_id(1)
@@ -81,7 +92,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    @pl.when(_block_needed(causal, q_blk, kv_i, block_q, block_k))
+    @pl.when(_block_needed(causal, q_blk, kv_i, block_q, block_k, window))
     def _():
         # operands stay in their input dtype (bf16 on the fast MXU path);
         # every accumulation is f32 via preferred_element_type
@@ -90,7 +101,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             q, k, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale    # (bq, bk) f32
         if causal or padded:
-            s = _mask(s, q_blk, kv_i, block_q, block_k, causal, kv_len)
+            s = _mask(s, q_blk, kv_i, block_q, block_k, causal, kv_len,
+                      window)
         m_prev = m_scr[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
@@ -109,7 +121,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, scale, causal, block_q, block_k, kv_len, padded):
+               dq_scr, *, scale, causal, block_q, block_k, kv_len, padded,
+               window=0):
     kv_i = pl.program_id(2)
     n_kv = pl.num_programs(2)
     q_blk = pl.program_id(1)
@@ -118,14 +131,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    @pl.when(_block_needed(causal, q_blk, kv_i, block_q, block_k))
+    @pl.when(_block_needed(causal, q_blk, kv_i, block_q, block_k, window))
     def _():
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
         s = jax.lax.dot_general(
             q, k, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal or padded:
-            s = _mask(s, q_blk, kv_i, block_q, block_k, causal, kv_len)
+            s = _mask(s, q_blk, kv_i, block_q, block_k, causal, kv_len,
+                      window)
         p = jnp.exp(s - lse_ref[0])                         # (bq, bk) f32
         dp = jax.lax.dot_general(
             do, v, dimension_numbers=(((1,), (1,)), ((), ())),
@@ -141,7 +155,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr,
-                *, scale, causal, block_q, block_k, kv_len, padded):
+                *, scale, causal, block_q, block_k, kv_len, padded,
+                window=0):
     q_i = pl.program_id(2)
     n_q = pl.num_programs(2)
     kv_blk = pl.program_id(1)
@@ -151,7 +166,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    @pl.when(_block_needed(causal, q_i, kv_blk, block_q, block_k))
+    @pl.when(_block_needed(causal, q_i, kv_blk, block_q, block_k, window))
     def _():
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
         delta = delta_ref[0]                                # (bq, 1)
@@ -159,7 +174,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q, k, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale     # (bq, bk)
         if causal or padded:
-            s = _mask(s, q_i, kv_blk, block_q, block_k, causal, kv_len)
+            s = _mask(s, q_i, kv_blk, block_q, block_k, causal, kv_len,
+                      window)
         p = jnp.exp(s - lse_ref[0])
         dv_scr[...] += jax.lax.dot_general(
             p.astype(do.dtype), do,
@@ -208,16 +224,18 @@ def _dims():
         dimension_semantics=("parallel", "parallel", "arbitrary"))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal: bool = False,
-                    scale: Optional[float] = None, interpret: bool = False):
+                    scale: Optional[float] = None, interpret: bool = False,
+                    window: int = 0):
     """Memory-O(L) attention. q, k, v: (b, h, L, d) -> (b, h, L, d).
 
-    Same contract as parallel.attention_reference; the caller gates on
-    supports(). `interpret=True` runs the kernels in the Pallas
-    interpreter so CPU tests cover the exact kernel code.
+    Same contract as parallel.attention_reference (incl. sliding
+    ``window``, causal-only); the caller gates on supports().
+    `interpret=True` runs the kernels in the Pallas interpreter so CPU
+    tests cover the exact kernel code.
     """
-    out, _ = _flash_fwd(q, k, v, causal, scale, interpret)
+    out, _ = _flash_fwd(q, k, v, causal, scale, interpret, window)
     return out
 
 
@@ -233,16 +251,18 @@ def _pad_seq(x, Lp):
     return jnp.pad(x, ((0, 0), (0, Lp - L), (0, 0)))
 
 
-def _flash_fwd(q, k, v, causal, scale, interpret):
+def _flash_fwd(q, k, v, causal, scale, interpret, window=0):
     b, h, L, d = q.shape
     if scale is None:
         scale = d ** -0.5
+    assert window == 0 or causal, "window attention requires causal"
     bq = bk = _pick_block(L)
     Lp = _padded_len(L, bq)
     qf, kf, vf = (_pad_seq(_merge_bh(t), Lp) for t in (q, k, v))
     bh = b * h
     kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                             block_q=bq, block_k=bk, kv_len=L, padded=Lp > L)
+                             block_q=bq, block_k=bk, kv_len=L,
+                             padded=Lp > L, window=window)
     out, lse = pl.pallas_call(
         kern,
         grid=(bh, Lp // bq, Lp // bk),
@@ -271,7 +291,7 @@ def _flash_fwd(q, k, v, causal, scale, interpret):
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, scale, interpret, res, g):
+def _flash_bwd(causal, scale, interpret, window, res, g):
     q, k, v, out, lse = res
     b, h, L, d = q.shape
     if scale is None:
@@ -294,7 +314,8 @@ def _flash_bwd(causal, scale, interpret, res, g):
     lse_spec_i = pl.BlockSpec((1, bq, 1), lambda g_, i, j: (g_, i, 0))
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk, kv_len=L, padded=Lp > L),
+                          block_q=bq, block_k=bk, kv_len=L, padded=Lp > L,
+                          window=window),
         grid=(bh, Lp // bq, Lp // bk),
         in_specs=[q_spec_i, kv_spec_j, kv_spec_j, q_spec_i,
                   lse_spec_i, lse_spec_i],
@@ -313,7 +334,8 @@ def _flash_bwd(causal, scale, interpret, res, g):
     lse_spec_s = pl.BlockSpec((1, bq, 1), lambda g_, j, i: (g_, i, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk, kv_len=L, padded=Lp > L),
+                          block_q=bq, block_k=bk, kv_len=L, padded=Lp > L,
+                          window=window),
         grid=(bh, Lp // bk, Lp // bq),
         in_specs=[q_spec_s, kv_spec_r, kv_spec_r, q_spec_s,
                   lse_spec_s, lse_spec_s],

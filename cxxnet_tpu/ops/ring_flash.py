@@ -49,17 +49,37 @@ def supports(sq: int, skv: int, d: int) -> bool:
             and skv >= 128 and skv % 128 == 0 and d % 8 == 0)
 
 
-def _causal_keep(off_ref, q_blk, kv_blk, block_q, block_k):
+def _causal_keep(off_ref, q_blk, kv_blk, block_q, block_k, window=0):
     qpos = off_ref[0] + q_blk * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     kpos = off_ref[1] + kv_blk * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
-    return qpos >= kpos
+    keep = qpos >= kpos
+    if window > 0:
+        keep = jnp.logical_and(keep, qpos - kpos < window)
+    return keep
+
+
+def _tile_needed(off_ref, q_blk, kv_blk, block_q, block_k, causal,
+                 window=0):
+    """Traced tile-level skip predicate (offsets are dynamic): False when
+    the tile is entirely above the causal diagonal or entirely older than
+    the sliding window — its matmuls are skipped wholesale, which under a
+    causal ring drops roughly half the ring steps' compute."""
+    if not causal:
+        return True
+    q_start = off_ref[0] + q_blk * block_q
+    k_start = off_ref[1] + kv_blk * block_k
+    need = k_start <= q_start + (block_q - 1)
+    if window > 0:
+        need = jnp.logical_and(
+            need, q_start - (k_start + block_k - 1) < window)
+    return need
 
 
 def _fwd_step_kernel(off_ref, q_ref, k_ref, v_ref, m_in, l_in, acc_in,
                      m_out, l_out, acc_out, *, scale, causal,
-                     block_q, block_k):
+                     block_q, block_k, window=0):
     kv_i = pl.program_id(2)
     q_blk = pl.program_id(1)
 
@@ -71,30 +91,33 @@ def _fwd_step_kernel(off_ref, q_ref, k_ref, v_ref, m_in, l_in, acc_in,
         l_out[...] = l_in[...]
         acc_out[...] = acc_in[...]
 
-    q, k, v = q_ref[0], k_ref[0], v_ref[0]
-    s = jax.lax.dot_general(
-        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale          # (bq, bk) f32
-    if causal:
-        s = jnp.where(_causal_keep(off_ref, q_blk, kv_i, block_q, block_k),
-                      s, NEG_INF)
-    m_prev = m_out[0]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-    alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new)
-    if causal:
-        # fully-masked tiles leave m_new at NEG_INF where exp(s - m_new)
-        # would be exp(0); kill that mass explicitly
-        p = jnp.where(s <= NEG_INF * 0.5, 0.0, p)
-    m_out[0] = m_new
-    l_out[0] = l_out[0] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-    acc_out[0] = acc_out[0] * alpha + jnp.dot(
-        p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+    @pl.when(_tile_needed(off_ref, q_blk, kv_i, block_q, block_k,
+                          causal, window))
+    def _():
+        q, k, v = q_ref[0], k_ref[0], v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale      # (bq, bk) f32
+        if causal:
+            s = jnp.where(_causal_keep(off_ref, q_blk, kv_i, block_q,
+                                       block_k, window), s, NEG_INF)
+        m_prev = m_out[0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        if causal:
+            # partially-masked rows whose m is still NEG_INF would get
+            # exp(0) mass on masked entries; kill it explicitly
+            p = jnp.where(s <= NEG_INF * 0.5, 0.0, p)
+        m_out[0] = m_new
+        l_out[0] = l_out[0] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_out[0] = acc_out[0] * alpha + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
 
 
 def _dq_step_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                     delta_ref, dq_in, dq_out, *, scale, causal,
-                    block_q, block_k):
+                    block_q, block_k, window=0):
     kv_i = pl.program_id(2)
     q_blk = pl.program_id(1)
 
@@ -102,25 +125,28 @@ def _dq_step_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     def _():
         dq_out[...] = dq_in[...]
 
-    q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-    s = jax.lax.dot_general(
-        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale
-    if causal:
-        s = jnp.where(_causal_keep(off_ref, q_blk, kv_i, block_q, block_k),
-                      s, NEG_INF)
-    p = jnp.exp(s - lse_ref[0])            # masked: exp(-1e30 - lse) == 0
-    dp = jax.lax.dot_general(
-        do, v, dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    ds = p * (dp - delta_ref[0]) * scale
-    dq_out[0] += jnp.dot(ds.astype(k.dtype), k,
-                         preferred_element_type=jnp.float32)
+    @pl.when(_tile_needed(off_ref, q_blk, kv_i, block_q, block_k,
+                          causal, window))
+    def _():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = jnp.where(_causal_keep(off_ref, q_blk, kv_i, block_q,
+                                       block_k, window), s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0])        # masked: exp(-1e30 - lse) == 0
+        dp = jax.lax.dot_general(
+            do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0]) * scale
+        dq_out[0] += jnp.dot(ds.astype(k.dtype), k,
+                             preferred_element_type=jnp.float32)
 
 
 def _dkv_step_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                      delta_ref, dk_in, dv_in, dk_out, dv_out,
-                     *, scale, causal, block_q, block_k):
+                     *, scale, causal, block_q, block_k, window=0):
     q_i = pl.program_id(2)
     kv_blk = pl.program_id(1)
 
@@ -129,26 +155,29 @@ def _dkv_step_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dk_out[...] = dk_in[...]
         dv_out[...] = dv_in[...]
 
-    q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-    s = jax.lax.dot_general(
-        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale          # (bq, bk)
-    if causal:
-        s = jnp.where(_causal_keep(off_ref, q_i, kv_blk, block_q, block_k),
-                      s, NEG_INF)
-    p = jnp.exp(s - lse_ref[0])
-    dv_out[0] += jax.lax.dot_general(
-        p.astype(do.dtype), do,
-        dimension_numbers=(((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)                  # (bk, d)
-    dp = jax.lax.dot_general(
-        do, v, dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    ds = p * (dp - delta_ref[0]) * scale
-    dk_out[0] += jax.lax.dot_general(
-        ds.astype(q.dtype), q,
-        dimension_numbers=(((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)                  # (bk, d)
+    @pl.when(_tile_needed(off_ref, q_i, kv_blk, block_q, block_k,
+                          causal, window))
+    def _():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale      # (bq, bk)
+        if causal:
+            s = jnp.where(_causal_keep(off_ref, q_i, kv_blk, block_q,
+                                       block_k, window), s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0])
+        dv_out[0] += jax.lax.dot_general(
+            p.astype(do.dtype), do,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (bk, d)
+        dp = jax.lax.dot_general(
+            do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0]) * scale
+        dk_out[0] += jax.lax.dot_general(
+            ds.astype(q.dtype), q,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (bk, d)
 
 
 def _smem_spec():
@@ -157,7 +186,7 @@ def _smem_spec():
 
 
 def fwd_step(q, k_blk, v_blk, m, l, acc, offs, *, causal, scale,
-             interpret):
+             interpret, window=0):
     """One ring step's online-softmax update.
 
     q: (bh, sq, d); k_blk/v_blk: (bh, skv, d); m/l: (bh, sq, 1) f32;
@@ -167,7 +196,7 @@ def fwd_step(q, k_blk, v_blk, m, l, acc, offs, *, causal, scale,
     skv = k_blk.shape[1]
     bq, bk = _pick_block(sq), _pick_block(skv)
     kern = functools.partial(_fwd_step_kernel, scale=scale, causal=causal,
-                             block_q=bq, block_k=bk)
+                             block_q=bq, block_k=bk, window=window)
     q_spec = pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0))
     kv_spec = pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0))
     m_spec = pl.BlockSpec((1, bq, 1), lambda g, i, j: (g, i, 0))
@@ -190,13 +219,13 @@ def fwd_step(q, k_blk, v_blk, m, l, acc, offs, *, causal, scale,
 
 
 def dq_step(q, k_blk, v_blk, do, lse, delta, dq, offs, *, causal, scale,
-            interpret):
+            interpret, window=0):
     """Accumulate one ring step's dq contribution into ``dq`` (f32)."""
     bh, sq, d = q.shape
     skv = k_blk.shape[1]
     bq, bk = _pick_block(sq), _pick_block(skv)
     kern = functools.partial(_dq_step_kernel, scale=scale, causal=causal,
-                             block_q=bq, block_k=bk)
+                             block_q=bq, block_k=bk, window=window)
     q_spec = pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0))
     kv_spec = pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0))
     r_spec = pl.BlockSpec((1, bq, 1), lambda g, i, j: (g, i, 0))
@@ -214,14 +243,14 @@ def dq_step(q, k_blk, v_blk, do, lse, delta, dq, offs, *, causal, scale,
 
 
 def dkv_step(q, k_blk, v_blk, do, lse, delta, dk, dv, offs, *, causal,
-             scale, interpret):
+             scale, interpret, window=0):
     """Accumulate one ring step's (dk, dv) contributions for the rotating
     K/V block into ``dk``/``dv`` (f32, travel with the block)."""
     bh, sq, d = q.shape
     skv = k_blk.shape[1]
     bq, bk = _pick_block(sq), _pick_block(skv)
     kern = functools.partial(_dkv_step_kernel, scale=scale, causal=causal,
-                             block_q=bq, block_k=bk)
+                             block_q=bq, block_k=bk, window=window)
     # grid: kv tile resident (dim 1), q tiles stream (dim 2)
     q_spec = pl.BlockSpec((1, bq, d), lambda g, j, i: (g, i, 0))
     kv_spec = pl.BlockSpec((1, bk, d), lambda g, j, i: (g, j, 0))

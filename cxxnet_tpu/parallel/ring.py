@@ -34,17 +34,23 @@ from ._compat import shard_map
 
 
 def attention_reference(q, k, v, *, causal: bool = False,
-                        scale: Optional[float] = None):
+                        scale: Optional[float] = None, window: int = 0):
     """Plain single-device attention, the golden model for the parallel
-    variants. q,k,v: (batch, heads, seq, head_dim)."""
+    variants. q,k,v: (batch, heads, seq, head_dim). window > 0 (requires
+    causal) keeps only the last ``window`` keys per query — sliding-window
+    attention (Mistral-style local attention)."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    assert window == 0 or causal, "window attention requires causal"
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if causal:
         sq, skv = q.shape[2], k.shape[2]
         qpos = jnp.arange(sq)[:, None]
         kpos = jnp.arange(skv)[None, :]
-        s = jnp.where(qpos >= kpos, s, -jnp.inf)
+        keep = qpos >= kpos
+        if window > 0:
+            keep = jnp.logical_and(keep, qpos - kpos < window)
+        s = jnp.where(keep, s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
@@ -57,7 +63,7 @@ RING_Q_CHUNK = 1024
 
 
 def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
-                          scale: float, q_chunk: int = 0):
+                          scale: float, q_chunk: int = 0, window: int = 0):
     """Per-shard body: online-softmax over rotating K/V blocks.
 
     q: (b, h, sq, d) local query block; k, v: (b, h, skv, d) local key/value
@@ -96,21 +102,45 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
 
         def one_chunk(args):
             ci, q_c, m_c, l_c, acc_c = args
-            s = jnp.einsum("bhqd,bhkd->bhqk", q_c, k_blk) * scale
-            if causal:
-                qpos = (q_off + ci * q_chunk +
-                        jnp.arange(q_chunk)[:, None])
-                s = jnp.where(qpos >= kpos, s, -jnp.inf)
-            m_new = jnp.maximum(m_c, jnp.max(s, axis=-1))
-            # guard fully-masked rows (all -inf): exp(-inf - -inf)
-            alpha = jnp.where(jnp.isinf(m_c) & jnp.isinf(m_new),
-                              jnp.zeros_like(m_c), jnp.exp(m_c - m_new))
-            p = jnp.exp(s - m_new[..., None])
-            p = jnp.where(jnp.isinf(s) & (s < 0), jnp.zeros_like(p), p)
-            l_new = l_c * alpha + jnp.sum(p, axis=-1)
-            acc_new = acc_c * alpha[..., None] + \
-                jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
-            return m_new, l_new, acc_new
+
+            def compute(_):
+                s = jnp.einsum("bhqd,bhkd->bhqk", q_c, k_blk) * scale
+                if causal:
+                    qpos = (q_off + ci * q_chunk +
+                            jnp.arange(q_chunk)[:, None])
+                    keep = qpos >= kpos
+                    if window > 0:
+                        keep = jnp.logical_and(keep, qpos - kpos < window)
+                    s_ = jnp.where(keep, s, -jnp.inf)
+                else:
+                    s_ = s
+                m_new = jnp.maximum(m_c, jnp.max(s_, axis=-1))
+                # guard fully-masked rows (all -inf): exp(-inf - -inf)
+                alpha = jnp.where(jnp.isinf(m_c) & jnp.isinf(m_new),
+                                  jnp.zeros_like(m_c),
+                                  jnp.exp(m_c - m_new))
+                p = jnp.exp(s_ - m_new[..., None])
+                p = jnp.where(jnp.isinf(s_) & (s_ < 0),
+                              jnp.zeros_like(p), p)
+                l_new = l_c * alpha + jnp.sum(p, axis=-1)
+                acc_new = acc_c * alpha[..., None] + \
+                    jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
+                return m_new, l_new, acc_new
+
+            if not causal:
+                return compute(None)
+            # skip the whole chunk x block tile when it is entirely above
+            # the causal diagonal or entirely older than the window — the
+            # chunk map is a sequential lax.map, so cond executes one
+            # branch (roughly halving causal ring compute)
+            q_start = q_off + ci * q_chunk
+            k_start = src * skv
+            need = k_start <= q_start + (q_chunk - 1)
+            if window > 0:
+                need = jnp.logical_and(
+                    need, q_start - (k_start + skv - 1) < window)
+            return lax.cond(need, compute,
+                            lambda _: (m_c, l_c, acc_c), None)
 
         # remat: without it AD would save every chunk's (qc, skv) p tile,
         # re-materializing the O(sq*skv) residual the chunking removes —
@@ -134,13 +164,16 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
 # flash-kernel ring step (opt-in: CXXNET_RING=flash) — ops/ring_flash.py
 # runs each ring step's online-softmax update fully in VMEM; backward is a
 # second ring pass (dq accumulates locally, dk/dv travel with their block)
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _ring_flash_local(q, k, v, axis_name, causal, scale, interpret):
-    out, _ = _ring_flash_fwd(q, k, v, axis_name, causal, scale, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash_local(q, k, v, axis_name, causal, scale, interpret,
+                      window=0):
+    out, _ = _ring_flash_fwd(q, k, v, axis_name, causal, scale, interpret,
+                             window)
     return out
 
 
-def _ring_flash_fwd(q, k, v, axis_name, causal, scale, interpret):
+def _ring_flash_fwd(q, k, v, axis_name, causal, scale, interpret,
+                    window=0):
     from ..ops import ring_flash as rf
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
@@ -159,7 +192,7 @@ def _ring_flash_fwd(q, k, v, axis_name, causal, scale, interpret):
         offs = jnp.stack([idx * sq, src * skv]).astype(jnp.int32)
         m, l, acc = rf.fwd_step(qf, k_blk, v_blk, m, l, acc, offs,
                                 causal=causal, scale=scale,
-                                interpret=interpret)
+                                interpret=interpret, window=window)
         k_blk = collectives.ring_shift(k_blk, axis_name)
         v_blk = collectives.ring_shift(v_blk, axis_name)
         return (k_blk, v_blk, m, l, acc), None
@@ -172,7 +205,7 @@ def _ring_flash_fwd(q, k, v, axis_name, causal, scale, interpret):
     return out, (q, k, v, out, lse)
 
 
-def _ring_flash_bwd(axis_name, causal, scale, interpret, res, g):
+def _ring_flash_bwd(axis_name, causal, scale, interpret, window, res, g):
     from ..ops import ring_flash as rf
     q, k, v, out, lse = res
     n = lax.axis_size(axis_name)
@@ -193,10 +226,12 @@ def _ring_flash_bwd(axis_name, causal, scale, interpret, res, g):
         src = (idx - t) % n
         offs = jnp.stack([idx * sq, src * skv]).astype(jnp.int32)
         dq = rf.dq_step(qf, k_blk, v_blk, dof, lse, delta, dq, offs,
-                        causal=causal, scale=scale, interpret=interpret)
+                        causal=causal, scale=scale, interpret=interpret,
+                        window=window)
         dk_blk, dv_blk = rf.dkv_step(qf, k_blk, v_blk, dof, lse, delta,
                                      dk_blk, dv_blk, offs, causal=causal,
-                                     scale=scale, interpret=interpret)
+                                     scale=scale, interpret=interpret,
+                                     window=window)
         # rotate the K/V block together with its gradient accumulators:
         # after n shifts each block is home with every device's
         # contribution summed in
@@ -233,7 +268,8 @@ def _ring_flash_enabled(sq: int, skv: int, d: int) -> bool:
 
 def ring_attention(q, k, v, mesh: Mesh, *, axis_name: str = "sp",
                    causal: bool = False, scale: Optional[float] = None,
-                   batch_axis: Optional[str] = None, q_chunk: int = 0):
+                   batch_axis: Optional[str] = None, q_chunk: int = 0,
+                   window: int = 0):
     """Ring attention over sequence-sharded q, k, v: (b, h, seq, d) with seq
     sharded on ``axis_name``. Returns output with the same sharding.
     ``batch_axis`` names a mesh axis to shard the batch dim over (pass the
@@ -249,17 +285,19 @@ def ring_attention(q, k, v, mesh: Mesh, *, axis_name: str = "sp",
         interpret = jax.default_backend() != "tpu"
         fn = shard_map(
             lambda q_, k_, v_: _ring_flash_local(
-                q_, k_, v_, axis_name, causal, scale, interpret),
+                q_, k_, v_, axis_name, causal, scale, interpret, window),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
         return fn(q, k, v)
     fn = shard_map(
         functools.partial(_ring_attention_local, axis_name=axis_name,
-                          causal=causal, scale=scale, q_chunk=q_chunk),
+                          causal=causal, scale=scale, q_chunk=q_chunk,
+                          window=window),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
 
 
-def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
+def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, scale: float,
+                   window: int = 0):
     n = lax.axis_size(axis_name)
 
     def seq_to_heads(x):
@@ -277,15 +315,17 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
     # O(L) in memory instead of materializing the (L, L) score matrix
     from .. import ops
     if ops.use_pallas() and ops.flash_supported(qh.shape[2], qh.shape[3]):
-        out = ops.flash_attention(qh, kh, vh, causal=causal, scale=scale)
+        out = ops.flash_attention(qh, kh, vh, causal=causal, scale=scale,
+                                  window=window)
     else:
-        out = attention_reference(qh, kh, vh, causal=causal, scale=scale)
+        out = attention_reference(qh, kh, vh, causal=causal, scale=scale,
+                                  window=window)
     return heads_to_seq(out)
 
 
 def ulysses_attention(q, k, v, mesh: Mesh, *, axis_name: str = "sp",
                       causal: bool = False, scale: Optional[float] = None,
-                      batch_axis: Optional[str] = None):
+                      batch_axis: Optional[str] = None, window: int = 0):
     """Ulysses sequence parallelism: all-to-all seq->heads, dense local
     attention, all-to-all back. Requires heads % axis_size == 0.
     ``batch_axis`` as in ring_attention."""
@@ -298,6 +338,6 @@ def ulysses_attention(q, k, v, mesh: Mesh, *, axis_name: str = "sp",
     spec = P(batch_axis, None, axis_name, None)
     fn = shard_map(
         functools.partial(_ulysses_local, axis_name=axis_name,
-                          causal=causal, scale=scale),
+                          causal=causal, scale=scale, window=window),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)

@@ -121,6 +121,12 @@ def main():
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=5e-2, atol=5e-2)
         print("flash attention on TPU (causal=%s): OK" % causal)
+    # sliding window: out-of-window tiles statically skipped, compiled
+    outw = np.asarray(flash_attn.flash_attention(
+        q, k, v, True, None, False, 96))
+    refw = np.asarray(attention_reference(q, k, v, causal=True, window=96))
+    np.testing.assert_allclose(outw, refw, rtol=2e-2, atol=2e-2)
+    print("flash attention window=96 on TPU: OK")
     # unaligned length: padded tiles + in-kernel tail mask, compiled
     q2 = jnp.asarray(rs.randn(1, 2, 300, 64), jnp.float32)
     out = np.asarray(flash_attn.flash_attention(q2, q2, q2, True))
