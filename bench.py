@@ -325,12 +325,24 @@ def bench_alexnet_pipeline():
     return out
 
 
-def _wait_for_backend(retries=10, probe_timeout=60):
+def _error_line(msg):
+    """The one-JSON-line contract, structured-failure form: the driver
+    records a parseable line instead of a hang/timeout."""
+    return json.dumps({
+        "metric": "alexnet_imagenet_images_per_sec_per_chip",
+        "value": None, "unit": "images/sec/chip", "vs_baseline": None,
+        "error": msg,
+    })
+
+
+def _probe_backend(attempts=4, probe_timeout=45, sleep_s=30):
     """The axon TPU tunnel can be down for stretches (jax then HANGS rather
-    than erroring). Probe it in a subprocess and retry for a while so a
-    transient outage delays the bench instead of wedging it silently."""
+    than erroring). Probe it in a bounded subprocess with a few short
+    retries; the caller FAILS FAST with a structured error line if the
+    backend never answers — never 'proceed anyway' into a hang."""
     import subprocess
-    for i in range(retries):
+    attempts = int(os.environ.get("CXXNET_BENCH_PROBE_ATTEMPTS", attempts))
+    for i in range(attempts):
         try:
             p = subprocess.run(
                 [sys.executable, "-c", "import jax; jax.devices()"],
@@ -339,26 +351,77 @@ def _wait_for_backend(retries=10, probe_timeout=60):
                 return True
         except subprocess.TimeoutExpired:
             pass
-        print("backend unreachable (attempt %d/%d); retrying in 60s"
-              % (i + 1, retries), file=sys.stderr, flush=True)
-        time.sleep(60)
-    print("backend still unreachable; proceeding anyway", file=sys.stderr,
-          flush=True)
+        if i + 1 < attempts:
+            print("backend unreachable (attempt %d/%d); retrying in %ds"
+                  % (i + 1, attempts, sleep_s), file=sys.stderr, flush=True)
+            time.sleep(sleep_s)
     return False
 
 
-def main():
+def _bench_main():
     from cxxnet_tpu.utils import enable_compile_cache
     enable_compile_cache()
-    _wait_for_backend()
     if len(sys.argv) > 1 and sys.argv[1] == "all":
         for fn in (bench_mnist_mlp, bench_mnist_conv, bench_bowl,
                    bench_googlenet, bench_resnet, bench_vgg):
-            print(json.dumps(fn()))
+            print(json.dumps(fn()), flush=True)
     if len(sys.argv) > 1 and sys.argv[1] in ("all", "pipeline"):
         for line in bench_alexnet_pipeline():
-            print(json.dumps(line))
-    print(json.dumps(bench_alexnet()))
+            print(json.dumps(line), flush=True)
+    print(json.dumps(bench_alexnet()), flush=True)
+
+
+def main():
+    """Probe, then run the measurements in a watchdogged child process.
+
+    Two failure modes become structured one-line JSON errors + nonzero
+    exit instead of hangs: (a) backend unreachable at start (tunnel
+    down), (b) backend wedges MID-RUN (child exceeds the watchdog)."""
+    import signal
+    import subprocess
+    if os.environ.get("_CXXNET_BENCH_CHILD") == "1":
+        _bench_main()
+        return
+    t0 = time.perf_counter()
+    if not _probe_backend():
+        print("backend unreachable; failing fast", file=sys.stderr,
+              flush=True)
+        print(_error_line("backend unreachable (TPU tunnel down)"),
+              flush=True)
+        sys.exit(1)
+    # watchdog budget scales with the mode and sits BELOW the outer
+    # timeouts tools/onchip_queue.sh allots each step, so the structured
+    # error line is emitted before any outer kill fires; probe retries
+    # spend from the same budget (the outer clock started with them)
+    mode = sys.argv[1] if len(sys.argv) > 1 else ""
+    limit = int(os.environ.get(
+        "CXXNET_BENCH_TIMEOUT",
+        {"all": 3300, "pipeline": 1080}.get(mode, 780)))
+    limit = max(min(limit, 60), limit - int(time.perf_counter() - t0))
+    env = dict(os.environ, _CXXNET_BENCH_CHILD="1")
+    proc = subprocess.Popen([sys.executable] + sys.argv, env=env)
+
+    def _reap(msg):
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        print(_error_line(msg), flush=True)
+        sys.exit(1)
+
+    # an outer `timeout` (e.g. the on-chip queue's) signals only this
+    # parent — reap the TPU-holding child so it can't run concurrently
+    # with the queue's next step and wedge the tunnel
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda s, f: _reap("bench killed by signal"))
+    try:
+        rc = proc.wait(timeout=limit)
+    except subprocess.TimeoutExpired:
+        _reap("bench exceeded %ds watchdog (backend wedged mid-run?)"
+              % limit)
+    sys.exit(rc)
 
 
 if __name__ == "__main__":

@@ -38,7 +38,7 @@ run() {
   name=$1; tmo=$2; shift 2
   case " $SKIP " in *" $name "*) note "$name SKIPPED"; return;; esac
   note "$name START: $*"
-  timeout "$tmo" "$@" > "onchip_logs/$name.log" 2>&1
+  timeout -k 60 "$tmo" "$@" > "onchip_logs/$name.log" 2>&1
   rc=$?
   note "$name DONE rc=$rc: $(tail -1 "onchip_logs/$name.log" | cut -c1-160)"
 }
