@@ -1079,11 +1079,6 @@ class AttentionLayer(Layer):
         q, k, v = heads(q, nh), heads(k, nkv), heads(v, nkv)
         if self.rope:
             q, k = self._apply_rope(q), self._apply_rope(k)
-        if nkv != nh:
-            # broadcast the kv groups to the query heads; XLA keeps this a
-            # view-ish repeat feeding the attention matmuls
-            k = jnp.repeat(k, nh // nkv, axis=1)
-            v = jnp.repeat(v, nh // nkv, axis=1)
         mesh = ctx.mesh
         if mesh is not None and "sp" in getattr(mesh, "axis_names", ()):
             sp = mesh.shape["sp"]
@@ -1094,6 +1089,14 @@ class AttentionLayer(Layer):
                 check(nh % sp == 0,
                       "ulysses: nhead %d must be divisible by "
                       "seq_parallel %d" % (nh, sp))
+                if nkv != nh and nkv % sp != 0:
+                    # ulysses' head-split all-to-all needs sp | kv heads;
+                    # broadcast up front when the grouping doesn't divide
+                    k = jnp.repeat(k, nh // nkv, axis=1)
+                    v = jnp.repeat(v, nh // nkv, axis=1)
+            # ring (and divisible ulysses) consume grouped k/v directly:
+            # the ICI hops move nkvhead-sized blocks — GQA's bandwidth
+            # saving applies to the sequence-parallel comm
             fn = ring_attention if self.sp_mode == "ring" \
                 else ulysses_attention
             # shard batch over 'data' too when present — otherwise the
@@ -1107,6 +1110,11 @@ class AttentionLayer(Layer):
             # mesh (no sp axis here) the kernel is batch-pointwise, so it
             # runs under shard_map with the batch dim left on "data" —
             # pallas_call has no GSPMD partitioning rule of its own.
+            # GQA: the kernel wants matching head counts; broadcast here
+            # (nkvhead still shrank wqkv and the projection FLOPs)
+            if nkv != nh:
+                k = jnp.repeat(k, nh // nkv, axis=1)
+                v = jnp.repeat(v, nh // nkv, axis=1)
             causal = bool(self.causal)
             if mesh is None:
                 out = ops.flash_attention(q, k, v, causal=causal,

@@ -391,16 +391,28 @@ class NeuralNet:
         check(first_loss > 0, "pipeline_parallel: empty non-loss prefix")
         return first_loss
 
-    def _partition_stages(self, n_layers: int, k: int):
+    def _partition_stages(self, n_layers: int, k: int, param_sizes=None):
         """Split layers [0, n_layers) into k contiguous stages minimizing
-        the maximum stage cost (classic linear-partition DP over an
-        activation-elements proxy) — the pipeline's step time is set by its
-        slowest stage."""
+        the maximum stage cost — the pipeline's step time is set by its
+        slowest stage.
+
+        Cost proxy per layer: output activation elements (cheap elementwise
+        work) plus, when ``param_sizes`` is given, params x output spatial
+        extent — the per-sample MAC count of a conv/dense layer. The MAC
+        term both balances compute and spreads parameter bytes across
+        stages (each rank OWNS its stage's params in the packed PP mode, so
+        a stage hoarding the param-heavy tail would defeat the memory
+        scaling)."""
         cfg = self.cfg
         costs = []
         for i in range(n_layers):
             out_node = cfg.layers[i].nindex_out[0]
-            costs.append(int(np.prod(self.node_shapes[out_node][1:])))
+            shape = self.node_shapes[out_node]
+            c = int(np.prod(shape[1:]))
+            if param_sizes is not None:
+                spatial = int(shape[2]) * int(shape[3])
+                c += int(param_sizes[i]) * spatial
+            costs.append(c)
         k = min(k, n_layers)
         prefix = np.concatenate([[0], np.cumsum(costs, dtype=np.float64)])
 
@@ -425,33 +437,58 @@ class NeuralNet:
         bounds.reverse()
         return [(bounds[s], bounds[s + 1]) for s in range(k)]
 
+    def pipeline_plan(self, params, k):
+        """The stage partition shared by the Trainer's parameter packing
+        and forward_pipelined — ONE source of truth for stage boundaries
+        (the packed-entry offsets are built from the same plan). Returns
+        (stages, first_loss); validates the chain shape and rejects
+        stateful layers."""
+        first_loss = self._pipeline_chain_prefix()
+        for i, lay in enumerate(self.layers):
+            check(not lay.state_keys(),
+                  "pipeline_parallel does not support layers with "
+                  "non-gradient state updates (e.g. batch_norm "
+                  "moving_average=1); layer %d %r carries state"
+                  % (i, lay.type_name))
+        psizes = [sum(int(np.prod(np.shape(v)))
+                      for v in params[i].values())
+                  for i in range(first_loss)]
+        stages = self._partition_stages(first_loss, k, param_sizes=psizes)
+        stages += [(first_loss, first_loss)] * (k - len(stages))
+        return stages, first_loss
+
     def forward_pipelined(self, params, data, labels=None, train=True,
                           rng=None, epoch=0, mesh=None, n_micro=None,
-                          axis="pipe"):
+                          axis="pipe", packed_entries=None, stages=None):
         """GPipe forward: the non-loss prefix of a linear chain runs as a
         k-stage heterogeneous pipeline over the mesh's ``axis``
         (parallel.pipeline_apply_stages); the loss layers run replicated on
         the gathered output, so numerics match the single-device net.
 
         Green-field beyond the reference (SURVEY.md §2.9 "Not present").
-        Notes: BN batch statistics are per-microbatch (standard GPipe
-        semantics); stage params are replicated across pipeline ranks (XLA
-        places compute by rank via lax.switch), so PP here buys step-time
-        pipelining, not per-device parameter memory."""
+        Note: BN batch statistics are per-microbatch (standard GPipe
+        semantics).
+
+        ``packed_entries`` (the Trainer's stage-packing plan, a list per
+        stage of (layer, key, offset, shape) tuples) selects the
+        PARAMETER-SHARDED mode: ``params[-1]["__pp_packed__"]`` is a
+        (k, F_p) flat array sharded over the pipe axis — each rank owns
+        exactly its own stage's parameter bytes (the per-device model
+        ownership of the reference's worker threads,
+        src/nnet/neural_net-inl.hpp:304-628) and unpacks its row locally,
+        with zero parameter communication. Without it stage params ride
+        in replicated (the small-model fast path)."""
         from .. import parallel as par
+        from ..parallel._compat import _patch_key_zeros
+        _patch_key_zeros()   # grad-of-switch PRNG workaround (see _compat)
 
         cfg = self.cfg
         cdt = self.compute_dtype
         k = mesh.shape[axis]
-        first_loss = self._pipeline_chain_prefix()
-        for i in range(len(cfg.layers)):
-            check(not self.layers[i].state_keys(),
-                  "pipeline_parallel does not support layers with "
-                  "non-gradient state updates (e.g. batch_norm "
-                  "moving_average=1); layer %d %r carries state"
-                  % (i, self.layers[i].type_name))
-        stages = self._partition_stages(first_loss, k)
-        stages += [(first_loss, first_loss)] * (k - len(stages))
+        if stages is None:
+            stages, first_loss = self.pipeline_plan(params, k)
+        else:
+            first_loss = self._pipeline_chain_prefix()
         batch = data.shape[0]
         if not n_micro:
             n_micro = k
@@ -460,8 +497,15 @@ class NeuralNet:
               "microbatches" % (batch, n_micro))
         mb = batch // n_micro
 
+        packed = None
+        if packed_entries is not None:
+            packed = params[-1]["__pp_packed__"]
         if cdt is not None:
-            params = self._cast_params_compute(params)
+            # cast only the per-layer entries (loss tail runs f32 anyway;
+            # packed stage params are cast after the in-stage unpack)
+            params = self._cast_params_compute(
+                params[: len(self.layers)]) + list(
+                    params[len(self.layers):])
         base_rng = rng if rng is not None else jax.random.PRNGKey(0)
 
         def node_size(n):
@@ -493,6 +537,18 @@ class NeuralNet:
         stream_dtype = (jnp.float32 if (cdt is None or 0 in id_nodes)
                         else cdt)
 
+        def unpack_stage(s, row):
+            """Rebuild stage s's per-layer param dicts from its flat row
+            (static offsets — pure slicing, stays on the owning rank)."""
+            pl: List[Dict[str, jnp.ndarray]] = \
+                [{} for _ in range(len(self.layers))]
+            for (li, key, off, shape) in packed_entries[s]:
+                v = row[off: off + int(np.prod(shape))].reshape(shape)
+                if cdt is not None:
+                    v = v.astype(cdt)
+                pl[li][key] = v
+            return pl
+
         def make_stage(s):
             lo, hi = stages[s]
             in_n, out_n = boundaries[s], boundaries[s + 1]
@@ -504,10 +560,20 @@ class NeuralNet:
                     (-1,) + tuple(self.node_shapes[in_n][1:]))
                 if cdt is not None and in_n not in id_nodes:
                     x = x.astype(cdt)
+                if packed is not None:
+                    # p is this rank's (1, F_p) packed row
+                    p = unpack_stage(s, p[0])
                 y = run_layers(p, x, lo, hi, micro_id)
                 y = y.reshape(y.shape[0], -1).astype(stream_dtype)
                 return jnp.pad(y, ((0, 0), (0, F - y.shape[1])))
-            return body
+            # GPipe re-materialization: each stage's activations are
+            # recomputed in the backward pipeline instead of saved —
+            # O(boundary) live memory per stage. It also keeps every
+            # lax.switch branch's residual set = its (shape-uniform)
+            # inputs, which jax's cond partial-eval requires (internal
+            # PRNG-key residuals from stochastic layers differ per branch
+            # otherwise and trip its typematch invariant, jax 0.9).
+            return jax.checkpoint(body)
 
         xd = self._normalize_input(jnp.asarray(data)).astype(stream_dtype)
         x_stream = xd.reshape(n_micro, mb, -1)
@@ -516,9 +582,12 @@ class NeuralNet:
         dp_axis = "data" if (mesh is not None
                              and "data" in mesh.axis_names
                              and mesh.shape["data"] > 1) else None
+        from jax.sharding import PartitionSpec as P
         out = par.pipeline_apply_stages(
-            [make_stage(s) for s in range(k)], params, x_stream, mesh,
-            axis=axis, batch_spec=dp_axis)
+            [make_stage(s) for s in range(k)],
+            packed if packed is not None else params, x_stream, mesh,
+            axis=axis, batch_spec=dp_axis,
+            params_spec=P(axis, None) if packed is not None else None)
         out_n = boundaries[-1]
         y = out[:, :, : node_size(out_n)].reshape(
             (batch,) + tuple(self.node_shapes[out_n][1:]))

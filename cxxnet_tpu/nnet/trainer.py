@@ -71,6 +71,7 @@ class Trainer:
         self.mesh = None
         self.params = None
         self.opt_state = None
+        self._pp_entries = None   # stage-packing plan (pipeline_parallel)
         self.grad_accum = None
         self._metric_accum = None   # on-device (n_metrics, 2) stat sums
         self._rng_counter = 0
@@ -140,6 +141,28 @@ class Trainer:
 
     # ------------------------------------------------------------------
     def _setup_mesh(self) -> None:
+        """Build ONE mesh composing every requested parallelism axis.
+
+        The reference composes its two strategies freely — DP over device
+        threads plus in-layer model splitting (ngroup grouped conv,
+        src/nnet/nnet_impl-inl.hpp:146-172 +
+        src/layer/convolution_layer-inl.hpp:92-96); the TPU equivalent is
+        one device mesh whose axes each carry one strategy:
+
+            (data, [pipe], [ep], [sp], [model])
+
+        Axis order puts 'data' outermost (its gradient all-reduce is the
+        least frequent collective, so it may ride DCN across slices) and
+        'model' innermost (per-layer TP collectives want adjacent chips on
+        ICI). Axes of size 1 are omitted so single-strategy configs keep
+        their existing 2-D meshes. dp is derived: whatever device count
+        remains after the explicit axes divide it.
+
+        pipeline_parallel composes with data parallelism only: stage bodies
+        run inside a shard_map over the pipe axis, and nesting another
+        manual collective axis (model/sp/ep) inside a stage body is not
+        supported.
+        """
         kind, ids = parallel.parse_device_spec(self.dev_spec)
         parallel.ensure_platform(kind)
         n_avail = len(jax.devices())
@@ -149,13 +172,17 @@ class Trainer:
         sp = self.seq_parallel
         pp = self.pipeline_parallel
         ep = self.expert_parallel
-        check(sum(x > 1 for x in (mp, sp, pp, ep)) <= 1,
-              "model_parallel / seq_parallel / pipeline_parallel / "
-              "expert_parallel cannot be combined yet")
+        check(pp == 1 or (mp == 1 and sp == 1 and ep == 1),
+              "pipeline_parallel composes with data parallelism only; "
+              "model/seq/expert parallelism cannot run inside pipeline "
+              "stages")
+        ways = mp * sp * pp * ep
+        check(n % ways == 0,
+              "device count %d must be divisible by model_parallel * "
+              "seq_parallel * pipeline_parallel * expert_parallel = %d"
+              % (n, ways))
+        dp = n // ways
         if pp > 1:
-            check(n % pp == 0,
-                  "device count must be divisible by pipeline_parallel")
-            dp = n // pp
             n_micro = self.pipeline_micro or pp
             check(self.batch_size % n_micro == 0,
                   "batch_size must be divisible by the microbatch count "
@@ -163,53 +190,44 @@ class Trainer:
             check(dp == 1 or (self.batch_size // n_micro) % dp == 0,
                   "microbatch size (batch_size / pipeline_micro) must be "
                   "divisible by the data-parallel degree")
-            self.mesh = parallel.create_mesh(ids[:n] if ids else None,
-                                             ("data", "pipe"), (dp, pp))
-        elif sp > 1:
-            check(n % sp == 0, "device count must be divisible by seq_parallel")
-            dp = n // sp
-            check(dp == 1 or self.batch_size % dp == 0,
-                  "batch_size must be divisible by the data-parallel degree")
-            self.mesh = parallel.create_mesh(ids[:n] if ids else None,
-                                             ("data", "sp"), (dp, sp))
-        elif ep > 1:
-            check(n % ep == 0,
-                  "device count must be divisible by expert_parallel")
-            dp = n // ep
-            check(dp == 1 or self.batch_size % dp == 0,
-                  "batch_size must be divisible by the data-parallel degree")
-            self.mesh = parallel.create_mesh(ids[:n] if ids else None,
-                                             ("data", "ep"), (dp, ep))
-        elif mp > 1:
-            check(n % mp == 0, "device count must be divisible by model_parallel")
-            dp = n // mp
-            check(dp == 1 or self.batch_size % dp == 0,
-                  "batch_size must be divisible by the data-parallel degree")
-            self.mesh = parallel.create_mesh(ids[:n] if ids else None,
-                                             ("data", "model"), (dp, mp))
-        elif n > 1:
-            check(self.batch_size % n == 0,
-                  "batch_size must be divisible by number of devices")
-            self.mesh = parallel.create_mesh(ids[:n] if ids else None, ("data",))
         else:
+            check(dp == 1 or self.batch_size % dp == 0,
+                  "batch_size must be divisible by the data-parallel degree")
+        if n <= 1:
             self.mesh = None
+            return
+        axes, sizes = ["data"], [dp]
+        for name, size in (("pipe", pp), ("ep", ep), ("sp", sp),
+                           ("model", mp)):
+            if size > 1:
+                axes.append(name)
+                sizes.append(size)
+        nproc = jax.process_count()
+        if nproc > 1 and n == n_avail and dp % nproc == 0:
+            # multi-host: hybrid DCN x ICI layout — the data axis splits
+            # across processes (slices) first, so the model/sp/ep/pipe
+            # collectives never cross a host boundary (the reference's
+            # dist-PS only ever crossed hosts for gradients too,
+            # src/nnet/nnet_ps_server.cpp)
+            self.mesh = parallel.create_hybrid_mesh(
+                (dp // nproc,) + tuple(sizes[1:]),
+                (nproc,) + (1,) * (len(sizes) - 1),
+                tuple(axes))
+        else:
+            self.mesh = parallel.create_mesh(ids[:n] if ids else None,
+                                             tuple(axes), tuple(sizes))
 
     def _place_params(self) -> None:
         """Tensor/expert-parallel placement: device_put params (and matching
         opt state) with the model/ep-axis shardings; GSPMD partitions the
         matmuls (shard_map consumes the ep placements directly)."""
         self._tp_shardings = None
-        if self.mesh is None:
-            return
-        if "model" in self.mesh.axis_names:
-            axis = "model"
-        elif "ep" in self.mesh.axis_names:
-            axis = "ep"
-        else:
+        if self.mesh is None or not (
+                "model" in self.mesh.axis_names
+                or "ep" in self.mesh.axis_names):
             return
         from ..parallel.sharding import param_shardings
-        shards = param_shardings(self.mesh, self.net.layers, self.params,
-                                 axis=axis)
+        shards = param_shardings(self.mesh, self.net.layers, self.params)
         self._tp_shardings = shards
         self.params = [
             {k: jax.device_put(jnp.asarray(v), shards[i][k])
@@ -267,6 +285,136 @@ class Trainer:
         self._init_net_structure()
         self.params = self.net.init_params(self.seed)
         self._init_opt()
+        self._pp_pack()
+
+    # ------------------------------------------------------------------
+    # pipeline-parallel parameter packing: each pipe rank OWNS its stage's
+    # parameter (and optimizer-state) bytes — the per-device model
+    # ownership the reference gets from one NeuralNet per worker thread
+    # (src/nnet/neural_net-inl.hpp:304-628). Stage params flatten into a
+    # (k, F_p) array sharded P("pipe"); stage bodies slice their own row
+    # locally (zero parameter communication).
+    _PACKED = "__pp_packed__"
+
+    def _pp_plan(self):
+        return self.net.pipeline_plan(self.params,
+                                      self.mesh.shape["pipe"])
+
+    def _pp_pack(self) -> None:
+        """Move prefix-stage params + opt state into the packed arrays.
+        No-op unless pipeline_parallel > 1 on a live mesh."""
+        if self.pipeline_parallel <= 1 or self.mesh is None \
+                or "pipe" not in self.mesh.axis_names:
+            return
+        stages, first_loss = self._pp_plan()
+        stage_of = {}
+        for s, (lo, hi) in enumerate(stages):
+            for i in range(lo, hi):
+                stage_of[i] = s
+        for i in range(first_loss):
+            if self.net.is_shared[i]:
+                pidx = self.net_cfg.layers[i].primary_layer_index
+                check(stage_of.get(pidx) == stage_of.get(i),
+                      "pipeline_parallel: shared layer %d and its primary "
+                      "%d must fall in the same pipeline stage" % (i, pidx))
+        for i in range(first_loss, len(self.net.layers)):
+            if self.net.is_shared[i]:
+                pidx = self.net_cfg.layers[i].primary_layer_index
+                check(pidx >= first_loss,
+                      "pipeline_parallel: loss-tail shared layer %d cannot "
+                      "reference prefix primary %d" % (i, pidx))
+        entries, sizes = [], []
+        for (lo, hi) in stages:
+            off, es = 0, []
+            for i in range(lo, hi):
+                if self.net.is_shared[i]:
+                    continue
+                for key in sorted(self.params[i]):
+                    shape = tuple(np.shape(self.params[i][key]))
+                    es.append((i, key, off, shape))
+                    off += int(np.prod(shape)) if shape else 1
+            entries.append(es)
+            sizes.append(off)
+        F_p = max(1, max(sizes))
+        sh = NamedSharding(self.mesh, P("pipe", None))
+
+        def build(getv):
+            rows = []
+            for es in entries:
+                vec = np.zeros(F_p, np.float32)
+                for (i, key, off, shape) in es:
+                    v = getv(i, key)
+                    if v is None:      # no state for this tensor: zeros
+                        continue
+                    a = np.asarray(v, np.float32).ravel()
+                    vec[off: off + a.size] = a
+                rows.append(vec)
+            return jax.device_put(np.stack(rows), sh)
+
+        packed = build(lambda i, k_: parallel.fetch_global(
+            self.params[i][k_]))
+        # frozen params (fixconn) carry no optimizer state: pack zeros for
+        # them and remember which (layer, key) pairs really have state
+        self._pp_opt_keys = {(i, key) for es in entries
+                             for (i, key, _, _) in es
+                             if key in self.opt_state[i]}
+        sub_keys = sorted({sk for es in entries for (i, key, _, _) in es
+                           for sk in self.opt_state[i].get(key, {})})
+        packed_opt = {sk: build(
+            lambda i, k_: parallel.fetch_global(self.opt_state[i][k_][sk])
+            if k_ in self.opt_state[i] else None)
+            for sk in sub_keys}
+        for es in entries:
+            for (i, key, _, _) in es:
+                del self.params[i][key]
+                self.opt_state[i].pop(key, None)
+        self.params.append({self._PACKED: packed})
+        self.opt_state.append({self._PACKED: packed_opt})
+        self._pp_entries = entries
+        self._pp_stages = stages
+        self.grad_accum = None   # tree structure changed
+        self._jit_cache.clear()
+
+    def _pp_unpack(self) -> None:
+        """Restore canonical per-layer params/opt state (host-side)."""
+        if self._pp_entries is None:
+            return
+        self.params = self.canonical_params()
+        self.opt_state = self._canonical_opt_state()
+        self._pp_entries = None
+        self._pp_stages = None
+        self.grad_accum = None   # tree structure changed
+        self._jit_cache.clear()
+
+    def canonical_params(self):
+        """Per-layer params list regardless of the PP packing (the form
+        checkpoints, get_weight, and the C ABI see)."""
+        if self._pp_entries is None:
+            return self.params
+        packed = parallel.fetch_global(self.params[-1][self._PACKED])
+        out = [dict(p) for p in self.params[:-1]]
+        for s, es in enumerate(self._pp_entries):
+            for (i, key, off, shape) in es:
+                size = int(np.prod(shape)) if shape else 1
+                out[i][key] = jnp.asarray(
+                    packed[s, off: off + size].reshape(shape))
+        return out
+
+    def _canonical_opt_state(self):
+        if self._pp_entries is None:
+            return self.opt_state
+        popt = {sk: parallel.fetch_global(v)
+                for sk, v in self.opt_state[-1][self._PACKED].items()}
+        out = [dict(p) for p in self.opt_state[:-1]]
+        for s, es in enumerate(self._pp_entries):
+            for (i, key, off, shape) in es:
+                if (i, key) not in self._pp_opt_keys:
+                    continue
+                size = int(np.prod(shape)) if shape else 1
+                out[i][key] = {
+                    sk: jnp.asarray(v[s, off: off + size].reshape(shape))
+                    for sk, v in popt.items()}
+        return out
 
     def _init_opt(self) -> None:
         self.opt_state = []
@@ -285,9 +433,18 @@ class Trainer:
     _OPT_MAGIC = b"CXNOPT01"
 
     def save_model(self, w: serializer.Writer) -> None:
+        """Serialize net structure + params + optimizer state.
+
+        Multi-process: collective — every process must call it (it gathers
+        mesh-sharded arrays via parallel.fetch_global; a rank-guarded call
+        deadlocks). Write the file on one rank, but CALL on all.
+
+        Checkpoints are always CANONICAL (per-layer tensors): the PP
+        stage-packing is a runtime placement, so a pipeline_parallel=4 run
+        resumes fine as single-device or any other parallelism config."""
         self.net_cfg.save_net(w)
         w.write_raw(np.int64(self.epoch_counter).tobytes())
-        blob = self.net.save_model_blob(self.params)
+        blob = self.net.save_model_blob(self.canonical_params())
         w.write_uint64(len(blob))
         w.write_raw(blob)
         # versioned optimizer-state section (beyond the reference, which
@@ -295,8 +452,9 @@ class Trainer:
         # the model blob so readers of the original format still load the
         # file; load_model restores it when the magic is present.
         ow = serializer.Writer()
-        ow.write_uint64(len(self.opt_state))
-        for st in self.opt_state:
+        opt_state = self._canonical_opt_state()
+        ow.write_uint64(len(opt_state))
+        for st in opt_state:
             ow.write_uint64(len(st))
             for key in sorted(st):
                 ow.write_string(key)
@@ -364,11 +522,13 @@ class Trainer:
         self._build_updaters()
         self._init_opt()
         self._load_opt_state(r)
+        self._pp_pack()
 
     def copy_model_from(self, r: serializer.Reader) -> None:
         """Finetune: copy weights of name-matched layers from another model
         (reference CopyModelFrom, nnet_impl-inl.hpp:101-134)."""
         self.init_model()
+        self._pp_unpack()   # copy into canonical form; repacked below
         old_cfg = NetConfig()
         old_cfg.load_net(r)
         np.frombuffer(r.read_raw(8), np.int64)  # old epoch_counter, discarded
@@ -389,6 +549,7 @@ class Trainer:
                         {k: jnp.asarray(v)
                          for k, v in old_params[i].items()})
         self._init_opt()
+        self._pp_pack()
 
     # ------------------------------------------------------------------
     def start_round(self, round_: int) -> None:
@@ -436,7 +597,9 @@ class Trainer:
             values, loss = self.net.forward_pipelined(
                 params, data, labels=labels, train=True, rng=rng,
                 epoch=epoch, mesh=self.mesh,
-                n_micro=self.pipeline_micro or None)
+                n_micro=self.pipeline_micro or None,
+                packed_entries=self._pp_entries,
+                stages=getattr(self, "_pp_stages", None))
         else:
             values, loss = self.net.forward(params, data, labels=labels,
                                             train=True, rng=rng, epoch=epoch,
@@ -463,14 +626,53 @@ class Trainer:
         new_opt = [dict(s) for s in opt_state]
         for i, ups in enumerate(self.updaters):
             for key, up in ups.items():
+                if key not in params[i]:
+                    continue   # lives in the PP packed array (below)
                 w, st = up.apply(params[i][key], grads[i][key],
                                  opt_state[i][key], epoch)
                 new_params[i][key] = w
                 new_opt[i][key] = st
+        if self._pp_entries is not None:
+            # stage-packed params: run each tensor's updater on its slice
+            # of the (k, F_p) array. Static row/offset indexing — XLA keeps
+            # every update on the rank owning that stage's shard
+            packed = params[-1][self._PACKED]
+            gpk = grads[-1][self._PACKED]
+            spk = opt_state[-1][self._PACKED]
+            new_pk = packed
+            new_spk = {sk: v for sk, v in spk.items()}
+            for s, es in enumerate(self._pp_entries):
+                for (i, key, off, shape) in es:
+                    up = self.updaters[i].get(key)
+                    if up is None:
+                        continue   # frozen weight (fixconn): no update
+                    size = int(np.prod(shape)) if shape else 1
+                    w = packed[s, off:off + size].reshape(shape)
+                    g = gpk[s, off:off + size].reshape(shape)
+                    sub = {sk: v[s, off:off + size].reshape(shape)
+                           for sk, v in spk.items()}
+                    w2, sub2 = up.apply(w, g, sub, epoch)
+                    new_pk = new_pk.at[s, off:off + size].set(
+                        w2.ravel().astype(new_pk.dtype))
+                    for sk, v2 in sub2.items():
+                        new_spk[sk] = new_spk[sk].at[
+                            s, off:off + size].set(
+                                v2.ravel().astype(new_spk[sk].dtype))
+            sh = NamedSharding(self.mesh, P("pipe", None))
+            new_params[-1][self._PACKED] = \
+                jax.lax.with_sharding_constraint(new_pk, sh)
+            new_opt[-1][self._PACKED] = {
+                sk: jax.lax.with_sharding_constraint(v, sh)
+                for sk, v in new_spk.items()}
         if self.mesh is not None and self.update_on_server:
             from ..parallel.sharding import shard_opt_state_with_specs
-            new_opt = shard_opt_state_with_specs(
-                self.mesh, new_opt, getattr(self, "_tp_shardings", None))
+            base = getattr(self, "_tp_shardings", None)
+            if self._pp_entries is not None:
+                sh = NamedSharding(self.mesh, P("pipe", None))
+                base = list(base) if base is not None else \
+                    [{} for _ in range(len(new_opt) - 1)]
+                base = base + [{self._PACKED: sh}]
+            new_opt = shard_opt_state_with_specs(self.mesh, new_opt, base)
         return new_params, new_opt
 
     def _make_train_step(self, do_update: bool, accumulate: bool,
@@ -574,8 +776,20 @@ class Trainer:
         k = ("fwd", node_ids)
         if k not in self._jit_cache:
             def fwd(params, data, rng):
-                values, _ = self.net.forward(params, data, train=False,
-                                             rng=rng, mesh=self.mesh)
+                if self.pipeline_parallel > 1:
+                    values, _ = self.net.forward_pipelined(
+                        params, data, train=False, rng=rng, mesh=self.mesh,
+                        n_micro=self.pipeline_micro or None,
+                        packed_entries=self._pp_entries,
+                        stages=getattr(self, "_pp_stages", None))
+                    for n in node_ids:
+                        check(values[n] is not None,
+                              "node %d lives inside the pipelined prefix; "
+                              "with pipeline_parallel only loss-tail "
+                              "nodes are observable" % n)
+                else:
+                    values, _ = self.net.forward(params, data, train=False,
+                                                 rng=rng, mesh=self.mesh)
                 return [values[n] for n in node_ids]
             self._jit_cache[k] = jax.jit(fwd)
         data = self._shard_batch(batch.data)
@@ -639,13 +853,19 @@ class Trainer:
             labels_np = np.asarray(batch.label)
             if outs[0].shape[0] != local_n:
                 # per-host shard mode: scores came back for the GLOBAL
-                # batch — gather the labels and the validity mask the
-                # same way so rows line up
-                from jax.experimental import multihost_utils
-                labels_np = np.asarray(multihost_utils.process_allgather(
-                    labels_np, tiled=True))
-                mask = np.asarray(multihost_utils.process_allgather(
-                    mask, tiled=True))
+                # batch in mesh data-axis device order. Lift labels and
+                # the validity mask to global arrays with the SAME
+                # NamedSharding used for the data, so their row order
+                # matches the scores by construction — a raw
+                # process_allgather concatenates in process-index order,
+                # which differs from device order on hybrid DCN x ICI
+                # meshes and would silently misalign the metrics
+                sh = NamedSharding(self.mesh, P("data"))
+                labels_np = parallel.fetch_global(
+                    jax.make_array_from_process_local_data(sh, labels_np))
+                mask = parallel.fetch_global(
+                    jax.make_array_from_process_local_data(
+                        sh, mask)).astype(bool)
             scores = [np.asarray(o).reshape(o.shape[0], -1)[mask]
                       for o in outs]
             labels = self.net.label_info_from(labels_np[mask],
@@ -658,12 +878,17 @@ class Trainer:
     def set_weight(self, value: np.ndarray, layer_name: str, tag: str) -> None:
         check(tag in ("wmat", "bias", "wo"),
               "SetWeight: weight tag can only be bias, wmat, or wo")
+        if self._pp_entries is not None:
+            self._pp_unpack()
+            self.net.set_weight(self.params, value, layer_name, tag)
+            self._pp_pack()
+            return
         self.net.set_weight(self.params, value, layer_name, tag)
 
     def get_weight(self, layer_name: str, tag: str):
         check(tag in ("wmat", "bias", "wo"),
               "GetWeight: weight tag can only be bias, wmat, or wo")
-        return self.net.get_weight(self.params, layer_name, tag)
+        return self.net.get_weight(self.canonical_params(), layer_name, tag)
 
 
 def create_net(net_type: int = 0) -> Trainer:

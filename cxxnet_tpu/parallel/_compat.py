@@ -1,11 +1,48 @@
 """shard_map compatibility: jax >= 0.8 moved it to jax.shard_map and renamed
 check_rep -> check_vma. Collective-heavy bodies (ring scans, pipelines) mix
 axis-varying and invariant carries, so the replication/vma check is disabled
-either way."""
+either way.
+
+Also carries a narrow jax-0.9 workaround: differentiating lax.switch whose
+branches sample PRNG noise asymmetrically (a dropout stage next to a
+dropout-free stage in the GPipe pipeline) pads the missing typed-key
+residual with ``zeros_like_aval``, which returns float0 for key avals and
+trips the cond partial-eval typematch invariant
+(jax/_src/lax/control_flow/conditionals.py:619). We teach zeros_like_aval
+to produce a zero KEY instead — the padded residual is dead in the branches
+that receive it, so any well-typed placeholder is correct. The patch is
+applied lazily (first pipelined forward), not at import, so processes that
+never differentiate a pipeline keep stock jax behavior."""
 
 from __future__ import annotations
 
 import inspect
+
+
+def _patch_key_zeros() -> None:
+    try:
+        import jax
+        import jax.numpy as jnp
+        from jax._src import ad_util
+
+        if getattr(ad_util, "_cxxnet_key_zeros_patch", False):
+            return
+        orig = ad_util.zeros_like_aval
+
+        def zeros_like_aval(aval):
+            dt = getattr(aval, "dtype", None)
+            if dt is not None and jax.dtypes.issubdtype(
+                    dt, jax.dtypes.prng_key):
+                impl = dt._impl
+                kd = jnp.zeros(tuple(aval.shape) + tuple(impl.key_shape),
+                               jnp.uint32)
+                return jax.random.wrap_key_data(kd, impl=impl.name)
+            return orig(aval)
+
+        ad_util.zeros_like_aval = zeros_like_aval
+        ad_util._cxxnet_key_zeros_patch = True
+    except Exception:   # pragma: no cover - future jax may not need/fit it
+        pass
 
 try:
     from jax import shard_map as _shard_map

@@ -64,10 +64,24 @@ def create_hybrid_mesh(ici_shape: Sequence[int],
     Example: 2 slices x 8 chips, axes=("data","model"):
         create_hybrid_mesh(ici_shape=(1, 8), dcn_shape=(2, 1), axes)
     puts 'data' over DCN and 'model' over in-slice ICI.
+
+    On platforms whose devices carry no ``slice_index`` (CPU multi-process
+    runs — the sandbox's DCN stand-in) the process is the DCN granule.
+    This is the mesh the Trainer builds automatically for multi-process
+    jobs, so ``model_parallel``/``seq_parallel`` collectives stay inside a
+    process while the data axis crosses hosts.
     """
     from jax.experimental import mesh_utils
+    import numpy as np
+    n_granules = int(np.prod(tuple(dcn_shape)))
+    slices = {getattr(d, "slice_index", None) for d in jax.devices()}
+    # TPU slices are the natural DCN granule; when the platform reports
+    # no (or too few) slices — CPU multi-process runs report one slice —
+    # the process is the granule
+    kw = {} if None not in slices and len(slices) == n_granules \
+        else {"process_is_granule": True}
     devices = mesh_utils.create_hybrid_device_mesh(
-        mesh_shape=tuple(ici_shape), dcn_mesh_shape=tuple(dcn_shape))
+        mesh_shape=tuple(ici_shape), dcn_mesh_shape=tuple(dcn_shape), **kw)
     return Mesh(devices, axes)
 
 
@@ -83,7 +97,15 @@ def fetch_global(x) -> "np.ndarray":
     In multi-process training, arrays sharded over the global mesh (ZeRO
     optimizer shards, TP weights, eval outputs) span non-addressable
     devices; a plain device_get raises. Fully-replicated or local arrays
-    fetch directly; anything else is allgathered to every host first."""
+    fetch directly; anything else is allgathered to every host first.
+
+    COLLECTIVE CONTRACT: the allgather path is a cross-process collective —
+    in multi-process runs EVERY process must call fetch_global on the same
+    array in the same order. Guarding a call site by rank (e.g.
+    ``if process_index() == 0: save_model(...)``) deadlocks the cluster.
+    The same contract therefore applies to every API that uses it:
+    Trainer.save_model / evaluate / predict / extract_feature / get_weight
+    and NeuralNet.save_model_blob."""
     import numpy as np
     if isinstance(x, jax.Array) and not x.is_fully_addressable \
             and not x.sharding.is_fully_replicated:

@@ -104,7 +104,8 @@ def _pipeline_local_switch(params, x, *, axis_name: str, n_micro: int,
 
 
 def pipeline_apply_stages(stage_fns, params, x, mesh: Mesh, *,
-                          axis: str = "pipe", batch_spec=None):
+                          axis: str = "pipe", batch_spec=None,
+                          params_spec=None):
     """Heterogeneous-stage GPipe over the mesh's ``axis``.
 
     stage_fns: one callable per stage, each
@@ -113,8 +114,12 @@ def pipeline_apply_stages(stage_fns, params, x, mesh: Mesh, *,
                the padded vector and re-pads its output. micro_id is the
                traced index of the microbatch being processed (for
                per-microbatch rng folds in stochastic layers)
-    params:    pytree passed to every stage (replicated over ``axis``; each
-               body indexes only its own layers' entries)
+    params:    pytree passed to every stage. By default replicated over
+               ``axis`` (each body indexes only its own layers' entries);
+               with ``params_spec`` (e.g. P(axis, None) for a stage-packed
+               (n_stages, F_p) array) it is SHARDED over the pipe axis and
+               each body receives only its own rank's shard — per-device
+               parameter ownership with zero parameter comm
     x:         (n_micro, micro_batch, F) padded input microbatches
     batch_spec: optional mesh axis name to keep the micro_batch dim sharded
                on (data parallelism composed with the pipeline)
@@ -136,7 +141,7 @@ def pipeline_apply_stages(stage_fns, params, x, mesh: Mesh, *,
         functools.partial(_pipeline_local_switch, axis_name=axis,
                           n_micro=n_micro, stage_fns=tuple(stage_fns)),
         mesh=mesh,
-        in_specs=(P(), bspec),
+        in_specs=(params_spec if params_spec is not None else P(), bspec),
         out_specs=bspec)
     return fn(params, x)
 

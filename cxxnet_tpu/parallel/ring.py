@@ -36,15 +36,23 @@ from ._compat import shard_map
 def attention_reference(q, k, v, *, causal: bool = False,
                         scale: Optional[float] = None, window: int = 0):
     """Plain single-device attention, the golden model for the parallel
-    variants. q,k,v: (batch, heads, seq, head_dim). window > 0 (requires
-    causal) keeps only the last ``window`` keys per query — sliding-window
-    attention (Mistral-style local attention)."""
+    variants. q: (batch, heads, seq, head_dim); k/v may carry FEWER heads
+    (grouped-query attention): nkv must divide nh and each group of
+    nh/nkv query heads attends to one shared k/v head — no materialized
+    broadcast. window > 0 (requires causal) keeps only the last ``window``
+    keys per query — sliding-window attention (Mistral-style local
+    attention)."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     assert window == 0 or causal, "window attention requires causal"
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    b, nh, sq, d = q.shape
+    nkv = k.shape[1]
+    assert nh % nkv == 0, "query heads must be a multiple of kv heads"
+    g = nh // nkv
+    qg = q.reshape(b, nkv, g, sq, d)
+    s = jnp.einsum("bngqd,bnkd->bngqk", qg, k) * scale
     if causal:
-        sq, skv = q.shape[2], k.shape[2]
+        skv = k.shape[2]
         qpos = jnp.arange(sq)[:, None]
         kpos = jnp.arange(skv)[None, :]
         keep = qpos >= kpos
@@ -52,7 +60,7 @@ def attention_reference(q, k, v, *, causal: bool = False,
             keep = jnp.logical_and(keep, qpos - kpos < window)
         s = jnp.where(keep, s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return jnp.einsum("bngqk,bnkd->bngqd", p, v).reshape(b, nh, sq, d)
 
 
 # per-step score tiles are capped at (RING_Q_CHUNK, skv): the local block
@@ -66,14 +74,18 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
                           scale: float, q_chunk: int = 0, window: int = 0):
     """Per-shard body: online-softmax over rotating K/V blocks.
 
-    q: (b, h, sq, d) local query block; k, v: (b, h, skv, d) local key/value
-    blocks. Runs axis_size steps; at step t the device holds the K/V block
-    originally on device (idx - t) mod n.
+    q: (b, h, sq, d) local query block; k, v: (b, nkv, skv, d) local
+    key/value blocks — nkv may be smaller than h (grouped-query attention):
+    the ring then rotates the nkv-sized blocks (GQA's bandwidth saving
+    applies to the ICI hops) and each step broadcasts to the query heads
+    only transiently for the tile compute. Runs axis_size steps; at step t
+    the device holds the K/V block originally on device (idx - t) mod n.
     """
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, h, sq, d = q.shape
     skv = k.shape[2]
+    kv_groups = h // k.shape[1]
     q_off = idx * sq
     q_chunk = min(sq, q_chunk if q_chunk > 0 else RING_Q_CHUNK)
     while sq % q_chunk != 0:     # largest divisor <= requested chunk
@@ -99,12 +111,18 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
         k_blk, v_blk, m, l, acc = carry
         src = (idx - t) % n  # whose block we hold this step
         kpos = src * skv + jnp.arange(skv)[None, :]
+        # GQA: expand kv heads to the query heads for this step's tiles
+        # only — the scan carry (and the ring hop below) stay nkv-sized
+        k_cmp = k_blk if kv_groups == 1 else \
+            jnp.repeat(k_blk, kv_groups, axis=1)
+        v_cmp = v_blk if kv_groups == 1 else \
+            jnp.repeat(v_blk, kv_groups, axis=1)
 
         def one_chunk(args):
             ci, q_c, m_c, l_c, acc_c = args
 
             def compute(_):
-                s = jnp.einsum("bhqd,bhkd->bhqk", q_c, k_blk) * scale
+                s = jnp.einsum("bhqd,bhkd->bhqk", q_c, k_cmp) * scale
                 if causal:
                     qpos = (q_off + ci * q_chunk +
                             jnp.arange(q_chunk)[:, None])
@@ -124,7 +142,7 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
                               jnp.zeros_like(p), p)
                 l_new = l_c * alpha + jnp.sum(p, axis=-1)
                 acc_new = acc_c * alpha[..., None] + \
-                    jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
+                    jnp.einsum("bhqk,bhkd->bhqd", p, v_cmp)
                 return m_new, l_new, acc_new
 
             if not causal:
@@ -179,8 +197,20 @@ def _ring_flash_fwd(q, k, v, axis_name, causal, scale, interpret,
     idx = lax.axis_index(axis_name)
     b, h, sq, d = q.shape
     skv = k.shape[2]
+    nkv = k.shape[1]
+    g = h // nkv
     bh = b * h
-    qf, kf, vf = (t.reshape(bh, t.shape[2], d) for t in (q, k, v))
+    qf = q.reshape(bh, sq, d)
+    kf, vf = (t.reshape(b * nkv, skv, d) for t in (k, v))
+
+    def expand(blk):
+        # GQA: broadcast the nkv kv heads to the query heads for the
+        # kernel call only — the ring hop stays nkv-sized
+        if g == 1:
+            return blk
+        return jnp.repeat(blk.reshape(b, nkv, skv, d), g,
+                          axis=1).reshape(bh, skv, d)
+
     from ..ops.flash_attn import NEG_INF
     m0 = jnp.full((bh, sq, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((bh, sq, 1), jnp.float32)
@@ -190,8 +220,8 @@ def _ring_flash_fwd(q, k, v, axis_name, causal, scale, interpret,
         k_blk, v_blk, m, l, acc = carry
         src = (idx - t) % n
         offs = jnp.stack([idx * sq, src * skv]).astype(jnp.int32)
-        m, l, acc = rf.fwd_step(qf, k_blk, v_blk, m, l, acc, offs,
-                                causal=causal, scale=scale,
+        m, l, acc = rf.fwd_step(qf, expand(k_blk), expand(v_blk), m, l,
+                                acc, offs, causal=causal, scale=scale,
                                 interpret=interpret, window=window)
         k_blk = collectives.ring_shift(k_blk, axis_name)
         v_blk = collectives.ring_shift(v_blk, axis_name)
@@ -212,26 +242,54 @@ def _ring_flash_bwd(axis_name, causal, scale, interpret, window, res, g):
     idx = lax.axis_index(axis_name)
     b, h, sq, d = q.shape
     skv = k.shape[2]
+    nkv = k.shape[1]
+    groups = h // nkv
     bh = b * h
-    qf, kf, vf = (t.reshape(bh, t.shape[2], d) for t in (q, k, v))
+    qf = q.reshape(bh, sq, d)
+    kf, vf = (t.reshape(b * nkv, skv, d) for t in (k, v))
+
+    def expand(blk):
+        if groups == 1:
+            return blk
+        return jnp.repeat(blk.reshape(b, nkv, skv, d), groups,
+                          axis=1).reshape(bh, skv, d)
+
+    def group_sum(full):
+        # (b*h, skv, d) query-head-resolution grads -> kv-head resolution
+        return full.reshape(b, nkv, groups, skv, d).sum(axis=2).reshape(
+            b * nkv, skv, d)
+
     dof = g.reshape(bh, sq, d)
     of = out.reshape(bh, sq, d)
     delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32),
                     axis=-1, keepdims=True)                  # (bh, sq, 1)
     dq0 = jnp.zeros((bh, sq, d), jnp.float32)
-    dkv0 = jnp.zeros((bh, skv, d), jnp.float32)
+    dkv0 = jnp.zeros((b * nkv, skv, d), jnp.float32)
 
     def step(carry, t):
         k_blk, v_blk, dk_blk, dv_blk, dq = carry
         src = (idx - t) % n
         offs = jnp.stack([idx * sq, src * skv]).astype(jnp.int32)
-        dq = rf.dq_step(qf, k_blk, v_blk, dof, lse, delta, dq, offs,
+        k_full, v_full = expand(k_blk), expand(v_blk)
+        dq = rf.dq_step(qf, k_full, v_full, dof, lse, delta, dq, offs,
                         causal=causal, scale=scale, interpret=interpret,
                         window=window)
-        dk_blk, dv_blk = rf.dkv_step(qf, k_blk, v_blk, dof, lse, delta,
-                                     dk_blk, dv_blk, offs, causal=causal,
-                                     scale=scale, interpret=interpret,
-                                     window=window)
+        if groups == 1:
+            dk_blk, dv_blk = rf.dkv_step(
+                qf, k_full, v_full, dof, lse, delta, dk_blk, dv_blk, offs,
+                causal=causal, scale=scale, interpret=interpret,
+                window=window)
+        else:
+            # GQA: the kernel produces query-head-resolution kv grads;
+            # group-sum them into the nkv-sized accumulators that ride
+            # the ring
+            zero = jnp.zeros((bh, skv, d), jnp.float32)
+            dkf, dvf = rf.dkv_step(
+                qf, k_full, v_full, dof, lse, delta, zero, zero, offs,
+                causal=causal, scale=scale, interpret=interpret,
+                window=window)
+            dk_blk = dk_blk + group_sum(dkf)
+            dv_blk = dv_blk + group_sum(dvf)
         # rotate the K/V block together with its gradient accumulators:
         # after n shifts each block is home with every device's
         # contribution summed in
@@ -244,7 +302,7 @@ def _ring_flash_bwd(axis_name, causal, scale, interpret, window, res, g):
     (_, _, dk, dv, dq), _ = lax.scan(
         step, (kf, vf, dkv0, dkv0, dq0), jnp.arange(n))
     shape_q = (b, h, sq, d)
-    shape_kv = (b, h, skv, d)
+    shape_kv = (b, nkv, skv, d)
     return (dq.astype(q.dtype).reshape(shape_q),
             dk.astype(k.dtype).reshape(shape_kv),
             dv.astype(v.dtype).reshape(shape_kv))
@@ -254,13 +312,20 @@ _ring_flash_local.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
 def _ring_flash_enabled(sq: int, skv: int, d: int) -> bool:
+    """Default ON wherever the kernels run (validated on-chip by
+    tools/check_tpu_kernels.py); CXXNET_RING=dense is the opt-out.
+    CXXNET_RING=flash still forces the kernel path off-TPU (Pallas
+    interpreter — how the CPU tests execute the exact kernel code)."""
     import os
-    if os.environ.get("CXXNET_RING") != "flash":
+    mode = os.environ.get("CXXNET_RING", "")
+    if mode in ("dense", "off", "0", "xla"):
         return False
     from .. import ops as _ops
-    if not _ops.use_pallas():
-        # honor the global Pallas kill-switch (ops.set_use_pallas(False))
-        # like every other kernel path
+    if getattr(_ops, "_use_pallas", None) is False:
+        return False   # explicit global kill-switch always wins
+    if not _ops.use_pallas() and mode != "flash":
+        # auto mode follows the global Pallas dispatch (TPU backend, or
+        # tests forcing set_use_pallas(True))
         return False
     from ..ops import ring_flash as rf
     return rf.supports(sq, skv, d)
@@ -312,9 +377,16 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, scale: float,
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     # after the all-to-all each device holds h/n full-length heads — the
     # single-chip flash kernel applies as-is, keeping the local attention
-    # O(L) in memory instead of materializing the (L, L) score matrix
+    # O(L) in memory instead of materializing the (L, L) score matrix.
+    # GQA: the all-to-alls above moved nkv-sized k/v; the flash kernel
+    # wants matching head counts, so broadcast locally (device-local
+    # memory only, no extra comm); the dense reference is grouped-aware.
     from .. import ops
     if ops.use_pallas() and ops.flash_supported(qh.shape[2], qh.shape[3]):
+        groups = qh.shape[1] // kh.shape[1]
+        if groups > 1:
+            kh = jnp.repeat(kh, groups, axis=1)
+            vh = jnp.repeat(vh, groups, axis=1)
         out = ops.flash_attention(qh, kh, vh, causal=causal, scale=scale,
                                   window=window)
     else:
@@ -335,6 +407,10 @@ def ulysses_attention(q, k, v, mesh: Mesh, *, axis_name: str = "sp",
     if q.shape[1] % n != 0:
         raise ValueError("ulysses needs heads (%d) divisible by sp axis (%d)"
                          % (q.shape[1], n))
+    if k.shape[1] % n != 0:
+        raise ValueError("ulysses needs kv heads (%d) divisible by sp axis "
+                         "(%d); broadcast k/v to the query heads first"
+                         % (k.shape[1], n))
     spec = P(batch_axis, None, axis_name, None)
     fn = shard_map(
         functools.partial(_ulysses_local, axis_name=axis_name,

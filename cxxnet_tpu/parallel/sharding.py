@@ -75,29 +75,34 @@ def shard_opt_state_with_specs(mesh: Mesh, opt_state, base_shardings,
     return out
 
 
-def param_shardings(mesh: Mesh, layers, params, axis: str = "model"):
-    """Per-layer weight shardings for tensor parallelism (``model_parallel``
-    config key): fullc weights are split on the output dim — the TP
-    generalization of the reference's ``fullc_gather`` giant-FC trick
-    (src/updater/async_updater-inl.hpp:67-92) — everything else replicated;
-    XLA/GSPMD propagates activation shardings and inserts the collectives.
+def param_shardings(mesh: Mesh, layers, params):
+    """Per-layer weight shardings for tensor/expert parallelism, driven by
+    which axes the mesh carries (so the strategies compose on one mesh):
 
-    With axis="ep" (``expert_parallel``) the moe layer's expert stack is
-    split on the expert dim instead, matching expert_parallel_ffn's
-    shard_map specs."""
-    n = mesh.shape[axis]
+    * ``model`` axis (``model_parallel`` config key): fullc weights split on
+      the output dim — the TP generalization of the reference's
+      ``fullc_gather`` giant-FC trick
+      (src/updater/async_updater-inl.hpp:67-92); XLA/GSPMD propagates
+      activation shardings and inserts the collectives.
+    * ``ep`` axis (``expert_parallel``): the moe layer's expert stack is
+      split on the expert dim, matching expert_parallel_ffn's shard_map
+      specs.
+
+    Everything else is replicated."""
+    has_model = "model" in mesh.axis_names
+    has_ep = "ep" in mesh.axis_names
     out = []
     for lay, p in zip(layers, params):
         shard = {}
         for key, val in p.items():
             shape = getattr(val, "shape", ())
             tname = getattr(lay, "type_name", "")
-            if (axis == "model" and tname == "fullc" and len(shape) >= 1
-                    and shape[0] % n == 0):
-                spec = P(axis, *([None] * (len(shape) - 1)))
-            elif (axis == "ep" and tname == "moe" and key == "experts"
-                    and shape[0] % n == 0):
-                spec = P(axis, None, None)
+            if (has_model and tname == "fullc" and len(shape) >= 1
+                    and shape[0] % mesh.shape["model"] == 0):
+                spec = P("model", *([None] * (len(shape) - 1)))
+            elif (has_ep and tname == "moe" and key == "experts"
+                    and shape[0] % mesh.shape["ep"] == 0):
+                spec = P("ep", None, None)
             else:
                 spec = P()
             shard[key] = NamedSharding(mesh, spec)

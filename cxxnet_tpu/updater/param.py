@@ -101,8 +101,12 @@ class UpdaterParam:
         elif self.lr_schedule == 4:
             # cosine decay to lr_minimum over lr:total updates (beyond the
             # reference's schedule set; the transformer-era default)
-            total = max(self.lr_total, 1)
-            frac = jnp.clip(e / total, 0.0, 1.0)
+            if self.lr_total <= 0:
+                raise ValueError(
+                    "lr_schedule = 4 (cosine) requires lr:total > 0 — "
+                    "without it the schedule would collapse to "
+                    "minimum_lr after the first update")
+            frac = jnp.clip(e / self.lr_total, 0.0, 1.0)
             lr = self.lr_minimum + 0.5 * (self.base_lr - self.lr_minimum) \
                 * (1.0 + jnp.cos(jnp.pi * frac))
         else:

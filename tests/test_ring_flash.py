@@ -132,3 +132,25 @@ def test_bf16_forward_close_to_f32(flash_ring_env):
     ref = ring.attention_reference(q, k, v, causal=True)
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref), rtol=0.05, atol=0.05)
+
+
+def test_default_on_when_pallas_active():
+    """The flash ring step is the DEFAULT wherever the kernels run
+    (CXXNET_RING=dense is the opt-out; =flash still forces interpret)."""
+    from cxxnet_tpu import ops
+    os.environ.pop("CXXNET_RING", None)
+    ops.set_use_pallas(True)
+    try:
+        assert ring._ring_flash_enabled(512, 512, 16)
+        assert not ring._ring_flash_enabled(100, 100, 16)  # unsupported shape
+    finally:
+        ops.set_use_pallas(None)
+    os.environ["CXXNET_RING"] = "dense"
+    ops.set_use_pallas(True)
+    try:
+        assert not ring._ring_flash_enabled(512, 512, 16)
+    finally:
+        ops.set_use_pallas(None)
+        os.environ.pop("CXXNET_RING", None)
+    # auto mode off-TPU without forcing: dense
+    assert not ring._ring_flash_enabled(512, 512, 16)
