@@ -1110,11 +1110,8 @@ class AttentionLayer(Layer):
             # mesh (no sp axis here) the kernel is batch-pointwise, so it
             # runs under shard_map with the batch dim left on "data" —
             # pallas_call has no GSPMD partitioning rule of its own.
-            # GQA: the kernel wants matching head counts; broadcast here
-            # (nkvhead still shrank wqkv and the projection FLOPs)
-            if nkv != nh:
-                k = jnp.repeat(k, nh // nkv, axis=1)
-                v = jnp.repeat(v, nh // nkv, axis=1)
+            # GQA: the kernel reads grouped k/v natively (BlockSpec row
+            # map) — K/V HBM traffic stays nkvhead-sized
             causal = bool(self.causal)
             if mesh is None:
                 out = ops.flash_attention(q, k, v, causal=causal,

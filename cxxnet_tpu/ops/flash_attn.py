@@ -228,7 +228,10 @@ def _dims():
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None, interpret: bool = False,
                     window: int = 0):
-    """Memory-O(L) attention. q, k, v: (b, h, L, d) -> (b, h, L, d).
+    """Memory-O(L) attention. q: (b, h, L, d) -> (b, h, L, d); k/v may
+    carry FEWER heads (grouped-query attention, nkv | h): the kernels read
+    the shared kv head per query group through the BlockSpec index map, so
+    K/V HBM footprint and traffic stay nkv-sized.
 
     Same contract as parallel.attention_reference (incl. sliding
     ``window``, causal-only); the caller gates on supports().
@@ -251,15 +254,30 @@ def _pad_seq(x, Lp):
     return jnp.pad(x, ((0, 0), (0, Lp - L), (0, 0)))
 
 
+def _kv_row_map(nh: int, nkv: int):
+    """Grid row (over b*nh) -> K/V array row (over b*nkv): grouped-query
+    attention reads the SHARED kv head of each query-head group straight
+    from the nkv-sized array — K/V HBM footprint and traffic stay
+    nkv-sized, never broadcast to the query heads."""
+    grp = nh // nkv
+    def to_kv(g):
+        return (g // nh) * nkv + (g % nh) // grp
+    return to_kv
+
+
 def _flash_fwd(q, k, v, causal, scale, interpret, window=0):
     b, h, L, d = q.shape
+    nkv = k.shape[1]
+    assert h % nkv == 0, "query heads must be a multiple of kv heads"
     if scale is None:
         scale = d ** -0.5
     assert window == 0 or causal, "window attention requires causal"
     bq = bk = _pick_block(L)
     Lp = _padded_len(L, bq)
-    qf, kf, vf = (_pad_seq(_merge_bh(t), Lp) for t in (q, k, v))
+    qf = _pad_seq(_merge_bh(q), Lp)
+    kf, vf = (_pad_seq(_merge_bh(t), Lp) for t in (k, v))
     bh = b * h
+    to_kv = _kv_row_map(h, nkv)
     kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                              block_q=bq, block_k=bk, kv_len=L,
                              padded=Lp > L, window=window)
@@ -268,8 +286,8 @@ def _flash_fwd(q, k, v, causal, scale, interpret, window=0):
         grid=(bh, Lp // bq, Lp // bk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda g, i, j: (to_kv(g), j, 0)),
+            pl.BlockSpec((1, bk, d), lambda g, i, j: (to_kv(g), j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0)),
@@ -294,13 +312,17 @@ def _flash_fwd(q, k, v, causal, scale, interpret, window=0):
 def _flash_bwd(causal, scale, interpret, window, res, g):
     q, k, v, out, lse = res
     b, h, L, d = q.shape
+    nkv = k.shape[1]
+    grp = h // nkv
     if scale is None:
         scale = d ** -0.5
     bq = bk = _pick_block(L)
     Lp = _padded_len(L, bq)
-    qf, kf, vf = (_pad_seq(_merge_bh(t), Lp) for t in (q, k, v))
+    qf = _pad_seq(_merge_bh(q), Lp)
+    kf, vf = (_pad_seq(_merge_bh(t), Lp) for t in (k, v))
     dof, of = (_pad_seq(_merge_bh(t), Lp) for t in (g, out))
     bh = b * h
+    to_kv = _kv_row_map(h, nkv)
     # D = rowsum(dO ∘ O), computed once here (cheap elementwise + reduce,
     # XLA fuses it) and streamed to both kernels as a (bh, Lp, 1) tile
     # input; padded rows have dO = 0 so their D is 0 and every padded-row
@@ -310,7 +332,7 @@ def _flash_bwd(causal, scale, interpret, window, res, g):
     # the saved lse residual is already padded: (bh, Lp, 1)
 
     q_spec_i = pl.BlockSpec((1, bq, d), lambda g_, i, j: (g_, i, 0))
-    kv_spec_j = pl.BlockSpec((1, bk, d), lambda g_, i, j: (g_, j, 0))
+    kv_spec_j = pl.BlockSpec((1, bk, d), lambda g_, i, j: (to_kv(g_), j, 0))
     lse_spec_i = pl.BlockSpec((1, bq, 1), lambda g_, i, j: (g_, i, 0))
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
@@ -328,8 +350,14 @@ def _flash_bwd(causal, scale, interpret, window, res, g):
         interpret=interpret,
     )(qf, kf, vf, dof, lse, delta)
 
-    # dkv: kv tiles are the resident (parallel) dim, q tiles stream
+    # dkv: kv tiles are the resident (parallel) dim, q tiles stream. With
+    # GQA the kernel reads k/v via the grouped row map but WRITES dk/dv at
+    # query-head resolution (each grid row owns its output row — no race
+    # across the parallel dim); the group-sum to kv resolution happens
+    # outside as one XLA reduce
     q_spec_s = pl.BlockSpec((1, bq, d), lambda g_, j, i: (g_, i, 0))
+    kv_spec_in = pl.BlockSpec((1, bk, d),
+                              lambda g_, j, i: (to_kv(g_), j, 0))
     kv_spec_r = pl.BlockSpec((1, bk, d), lambda g_, j, i: (g_, j, 0))
     lse_spec_s = pl.BlockSpec((1, bq, 1), lambda g_, j, i: (g_, i, 0))
     dk, dv = pl.pallas_call(
@@ -337,7 +365,7 @@ def _flash_bwd(causal, scale, interpret, window, res, g):
                           block_q=bq, block_k=bk, kv_len=L, padded=Lp > L,
                           window=window),
         grid=(bh, Lp // bk, Lp // bq),
-        in_specs=[q_spec_s, kv_spec_r, kv_spec_r, q_spec_s,
+        in_specs=[q_spec_s, kv_spec_in, kv_spec_in, q_spec_s,
                   lse_spec_s, lse_spec_s],
         out_specs=[kv_spec_r, kv_spec_r],
         out_shape=[
@@ -352,9 +380,10 @@ def _flash_bwd(causal, scale, interpret, window, res, g):
         interpret=interpret,
     )(qf, kf, vf, dof, lse, delta)
 
-    shape = (b, h, L, d)
-    return (dq[:, :L].reshape(shape), dk[:, :L].reshape(shape),
-            dv[:, :L].reshape(shape))
+    dq = dq[:, :L].reshape(b, h, L, d)
+    dk = dk[:, :L].reshape(b, nkv, grp, L, d).sum(axis=2)
+    dv = dv[:, :L].reshape(b, nkv, grp, L, d).sum(axis=2)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

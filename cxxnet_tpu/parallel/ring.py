@@ -378,15 +378,11 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, scale: float,
     # after the all-to-all each device holds h/n full-length heads — the
     # single-chip flash kernel applies as-is, keeping the local attention
     # O(L) in memory instead of materializing the (L, L) score matrix.
-    # GQA: the all-to-alls above moved nkv-sized k/v; the flash kernel
-    # wants matching head counts, so broadcast locally (device-local
-    # memory only, no extra comm); the dense reference is grouped-aware.
+    # GQA: the all-to-alls above moved nkv-sized k/v; both the flash
+    # kernel (grouped BlockSpec row map) and the dense reference consume
+    # grouped k/v natively.
     from .. import ops
     if ops.use_pallas() and ops.flash_supported(qh.shape[2], qh.shape[3]):
-        groups = qh.shape[1] // kh.shape[1]
-        if groups > 1:
-            kh = jnp.repeat(kh, groups, axis=1)
-            vh = jnp.repeat(vh, groups, axis=1)
         out = ops.flash_attention(qh, kh, vh, causal=causal, scale=scale,
                                   window=window)
     else:

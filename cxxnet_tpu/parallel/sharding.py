@@ -37,18 +37,33 @@ def zero_sharding(mesh: Mesh, x: Any, axis: str = "data",
     of overriding it, which would force an all-to-all reshard every step."""
     n = mesh.shape[axis]
     shape = getattr(x, "shape", ())
-    if (base_spec and len(base_spec) > 0 and base_spec[0] is not None
-            and len(shape) == len(base_spec)
-            and shape[0] % mesh.shape[base_spec[0]] == 0):
-        tp_axis = base_spec[0]
-        joint = n * mesh.shape[tp_axis]
-        if shape[0] % joint == 0:
-            # tp axis major: each device's opt-state shard nests inside its
-            # own param shard, so no cross-model-shard reshard per step
-            return NamedSharding(mesh, P((tp_axis, axis), *base_spec[1:]))
+    if (base_spec and any(a is not None for a in base_spec)
+            and len(shape) == len(base_spec)):
+        # extend the TP split with the ZeRO axis on the SAME dim, tp-axis
+        # major, so each device's opt-state shard nests inside its own
+        # param shard (no cross-model-shard reshard per step). Works for
+        # dim-0 TP (fullc wmat), later-dim TP (conv output channels), and
+        # the pipeline's P("pipe", None) packed base alike.
+        d = next(i for i, a in enumerate(base_spec) if a is not None)
+        tp_axis = base_spec[d]
+        if shape[d] % (n * mesh.shape[tp_axis]) == 0:
+            spec = list(base_spec)
+            spec[d] = (tp_axis, axis)
+            return NamedSharding(mesh, P(*spec))
         return NamedSharding(mesh, base_spec)
-    if len(shape) > 0 and shape[0] % n == 0 and shape[0] >= n:
-        return NamedSharding(mesh, P(axis))
+    if len(shape) > 0:
+        # no TP placement: the tensor is replicated over EVERY mesh axis,
+        # so its optimizer state may shard over all of them jointly (each
+        # device owns 1/total of the update) — greedily extend the data
+        # axis with every other axis that keeps dim 0 divisible
+        joint, prod = [], 1
+        for a in (axis,) + tuple(x for x in mesh.axis_names if x != axis):
+            sz = mesh.shape[a]
+            if sz > 1 and shape[0] % (prod * sz) == 0:
+                joint.append(a)
+                prod *= sz
+        if prod > 1 and shape[0] >= prod:
+            return NamedSharding(mesh, P(tuple(joint)))
     return NamedSharding(mesh, P())
 
 
@@ -79,32 +94,45 @@ def param_shardings(mesh: Mesh, layers, params):
     """Per-layer weight shardings for tensor/expert parallelism, driven by
     which axes the mesh carries (so the strategies compose on one mesh):
 
-    * ``model`` axis (``model_parallel`` config key): fullc weights split on
-      the output dim — the TP generalization of the reference's
-      ``fullc_gather`` giant-FC trick
-      (src/updater/async_updater-inl.hpp:67-92); XLA/GSPMD propagates
-      activation shardings and inserts the collectives.
+    * ``model`` axis (``model_parallel`` config key) — Megatron-style
+      splits, generalizing the reference's in-layer model sharding
+      (``ngroup`` grouped conv, src/layer/convolution_layer-inl.hpp:92-96;
+      ``fullc_gather``, src/updater/async_updater-inl.hpp:67-92):
+        - fullc wmat (out, in): split the output dim (column parallel)
+        - conv wmat (g, co/g, ci_khkw): split the output-channel dim —
+          output-feature-sharded convolution
+      Attention projections stay replicated: the fused [q|k|v] column
+      layout cannot align a contiguous model-axis split with the q/k/v
+      block boundaries (GSPMD would re-shard the activation every step);
+      head-level attention parallelism is the sp axis's job (Ulysses
+      all-to-all shards heads exactly).
+      XLA/GSPMD propagates activation shardings and inserts collectives.
     * ``ep`` axis (``expert_parallel``): the moe layer's expert stack is
       split on the expert dim, matching expert_parallel_ffn's shard_map
       specs.
 
-    Everything else is replicated."""
+    Everything else (biases, norms, embeddings) is replicated."""
     has_model = "model" in mesh.axis_names
     has_ep = "ep" in mesh.axis_names
+    n_model = mesh.shape["model"] if has_model else 1
     out = []
     for lay, p in zip(layers, params):
         shard = {}
         for key, val in p.items():
             shape = getattr(val, "shape", ())
             tname = getattr(lay, "type_name", "")
-            if (has_model and tname == "fullc" and len(shape) >= 1
-                    and shape[0] % mesh.shape["model"] == 0):
-                spec = P("model", *([None] * (len(shape) - 1)))
-            elif (has_ep and tname == "moe" and key == "experts"
+            spec = P()
+            if has_model:
+                if (tname == "fullc" and key == "wmat"
+                        and len(shape) == 2 and shape[0] % n_model == 0):
+                    spec = P("model", None)
+                elif (tname == "conv" and key == "wmat"
+                        and len(shape) == 3 and shape[1] % n_model == 0):
+                    spec = P(None, "model", None)
+            if (spec == P() and has_ep and tname == "moe"
+                    and key == "experts"
                     and shape[0] % mesh.shape["ep"] == 0):
                 spec = P("ep", None, None)
-            else:
-                spec = P()
             shard[key] = NamedSharding(mesh, spec)
         out.append(shard)
     return out
