@@ -38,9 +38,33 @@ import time
 import numpy as np
 
 
+def _timed_rate(tr, b, steps, units_per_step):
+    """Shared measurement protocol: 3-step warmup, then two timed passes
+    reporting the better — shared-chip contention skews single runs by
+    +-20% and the steady-state rate is the meaningful one. The sync is a
+    value-fetch of the first param tensor (first layer may be weightless),
+    which forces a sync through the tunnel (block_until_ready does not)."""
+    import jax.numpy as jnp
+
+    def sync():
+        float(jnp.sum(next(v for p in tr.params for v in p.values())))
+
+    for _ in range(3):
+        tr.update(b)
+    sync()
+    best = 0.0
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            tr.update(b)
+        sync()
+        best = max(best, steps * units_per_step
+                   / (time.perf_counter() - t0))
+    return best
+
+
 def _throughput(tr, shape, nclass, batch, steps=30):
     import jax
-    import jax.numpy as jnp
     from cxxnet_tpu.io.data import DataBatch
 
     rs = np.random.RandomState(0)
@@ -49,25 +73,7 @@ def _throughput(tr, shape, nclass, batch, steps=30):
     b.label = jax.device_put(
         rs.randint(0, nclass, (batch, 1)).astype(np.float32))
     b.batch_size = batch
-    def sync():
-        # value-fetch of the first param tensor (first layer may be
-        # weightless) forces a sync through the tunnel
-        # (block_until_ready does not)
-        float(jnp.sum(next(v for p in tr.params for v in p.values())))
-
-    for _ in range(3):
-        tr.update(b)
-    sync()
-    best = 0.0
-    # two timed passes, report the better: shared-chip contention skews
-    # single runs by +-20% and the steady-state rate is the meaningful one
-    for _ in range(2):
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            tr.update(b)
-        sync()
-        best = max(best, steps * batch / (time.perf_counter() - t0))
-    return best
+    return _timed_rate(tr, b, steps, batch)
 
 
 BF16 = "eval_train = 0\ncompute_dtype = bfloat16\n"
@@ -223,33 +229,21 @@ def bench_transformer_lm():
     """Long-context LM training throughput: tokens/sec at L=2048 bf16
     (flash attention path; no reference baseline — the reference is a CNN
     framework with no sequence axis, SURVEY.md §5)."""
-    import jax.numpy as jnp
     from cxxnet_tpu.models import transformer_lm_trainer
     from cxxnet_tpu.io.data import DataBatch
     batch, L = 8, 2048
     tr = transformer_lm_trainer(
         vocab=8192, seq=L, batch_size=batch, dim=512, nhead=8, nlayer=4,
         dev="tpu", extra_cfg=BF16)
+    import jax
     rs = np.random.RandomState(0)
     b = DataBatch()
-    b.data = rs.randint(0, 8192, (batch, 1, 1, L)).astype(np.float32)
-    b.label = rs.randint(0, 8192, (batch, L)).astype(np.float32)
+    b.data = jax.device_put(
+        rs.randint(0, 8192, (batch, 1, 1, L)).astype(np.float32))
+    b.label = jax.device_put(
+        rs.randint(0, 8192, (batch, L)).astype(np.float32))
     b.batch_size = batch
-
-    def sync():
-        float(jnp.sum(next(v for p in tr.params for v in p.values())))
-
-    for _ in range(3):
-        tr.update(b)
-    sync()
-    best = 0.0
-    for _ in range(2):
-        t0 = time.perf_counter()
-        steps = 20
-        for _ in range(steps):
-            tr.update(b)
-        sync()
-        best = max(best, steps * batch * L / (time.perf_counter() - t0))
+    best = _timed_rate(tr, b, steps=20, units_per_step=batch * L)
     return {"metric": "transformer_lm_L2048_tokens_per_sec_per_chip",
             "value": round(best, 1), "unit": "tokens/sec/chip",
             "vs_baseline": None}
@@ -415,12 +409,12 @@ def _bench_main():
     if len(sys.argv) > 1 and sys.argv[1] == "all":
         for fn in (bench_mnist_mlp, bench_mnist_conv, bench_bowl,
                    bench_googlenet, bench_resnet, bench_vgg,
-                   bench_transformer_lm):
+                   bench_transformer_lm, bench_alexnet_b1024):
             print(json.dumps(fn()), flush=True)
     if len(sys.argv) > 1 and sys.argv[1] in ("all", "pipeline"):
         for line in bench_alexnet_pipeline():
             print(json.dumps(line), flush=True)
-    print(json.dumps(bench_alexnet_b1024()), flush=True)
+    # default (driver) mode: exactly ONE JSON line
     print(json.dumps(bench_alexnet()), flush=True)
 
 

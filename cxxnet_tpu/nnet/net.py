@@ -370,26 +370,60 @@ class NeuralNet:
     # ------------------------------------------------------------------
     # pipeline parallelism (config key pipeline_parallel = k)
     def _pipeline_chain_prefix(self) -> int:
-        """Length of the non-loss prefix, verifying it is a linear 1-1
-        chain (the shape pipeline stages need). Raises otherwise."""
+        """Length of the non-loss prefix, verifying it is a topologically
+        ordered DAG: every layer reads only the data node or nodes already
+        written by an earlier layer (in-place rewrites allowed). Branched
+        nets (split / concat / inception-style fan-out) are accepted —
+        stage cuts carry the full live set of boundary nodes
+        (_pipeline_live_set), not a single activation."""
         cfg = self.cfg
         first_loss = next(
             (i for i, lay in enumerate(self.layers) if lay.is_loss),
             len(cfg.layers))
-        node = 0
+        check(first_loss > 0, "pipeline_parallel: empty non-loss prefix")
+        written = {0}
         for i in range(first_loss):
             info = cfg.layers[i]
-            check(len(info.nindex_in) == 1 and len(info.nindex_out) == 1,
-                  "pipeline_parallel requires a linear 1-in/1-out layer "
-                  "chain; layer %d has fan %d->%d"
-                  % (i, len(info.nindex_in), len(info.nindex_out)))
-            check(info.nindex_in[0] == node,
-                  "pipeline_parallel requires consecutive chaining; layer "
-                  "%d reads node %d, expected %d"
-                  % (i, info.nindex_in[0], node))
-            node = info.nindex_out[0]
-        check(first_loss > 0, "pipeline_parallel: empty non-loss prefix")
+            for n in info.nindex_in:
+                check(n in written,
+                      "pipeline_parallel: layer %d (%s) reads node %d "
+                      "before any layer writes it — the prefix must be "
+                      "topologically ordered"
+                      % (i, self.layers[i].type_name, n))
+            written.update(info.nindex_out)
         return first_loss
+
+    def _pipeline_live_set(self, cut: int, first_loss: int):
+        """Nodes whose values must cross the stage boundary after ``cut``
+        layers: nodes holding a value (the data node, or written by a
+        layer < cut) that are still needed — read by a layer >= cut at or
+        before the node's next in-place rewrite (an in-place layer reads
+        its input before overwriting it), or, at the final cut, part of
+        the net's observable output (the last prefix layer's out nodes,
+        which predict/extract_feature read after the loss tail)."""
+        cfg = self.cfg
+        n_layers = len(cfg.layers)
+        writers: Dict[int, List[int]] = {}
+        readers: Dict[int, List[int]] = {}
+        for i, info in enumerate(cfg.layers):
+            for n in info.nindex_in:
+                readers.setdefault(n, []).append(i)
+            for n in info.nindex_out:
+                writers.setdefault(n, []).append(i)
+        final_outs = (set(cfg.layers[first_loss - 1].nindex_out)
+                      if cut >= first_loss else set())
+        live = []
+        for n in range(cfg.param.num_nodes):
+            has_value = (n == 0) or any(w < cut
+                                        for w in writers.get(n, ()))
+            if not has_value:
+                continue
+            nxt = min((w for w in writers.get(n, ()) if w >= cut),
+                      default=n_layers)
+            if (n in final_outs
+                    or any(cut <= r <= nxt for r in readers.get(n, ()))):
+                live.append(n)
+        return tuple(live)
 
     def _partition_stages(self, n_layers: int, k: int, param_sizes=None):
         """Split layers [0, n_layers) into k contiguous stages minimizing
@@ -406,11 +440,11 @@ class NeuralNet:
         cfg = self.cfg
         costs = []
         for i in range(n_layers):
-            out_node = cfg.layers[i].nindex_out[0]
-            shape = self.node_shapes[out_node]
-            c = int(np.prod(shape[1:]))
+            c = sum(int(np.prod(self.node_shapes[n][1:]))
+                    for n in cfg.layers[i].nindex_out)
             if param_sizes is not None:
-                spatial = int(shape[2]) * int(shape[3])
+                shape = self.node_shapes[cfg.layers[i].nindex_out[0]]
+                spatial = (int(np.prod(shape[2:])) if len(shape) > 2 else 1)
                 c += int(param_sizes[i]) * spatial
             costs.append(c)
         k = min(k, n_layers)
@@ -460,10 +494,13 @@ class NeuralNet:
     def forward_pipelined(self, params, data, labels=None, train=True,
                           rng=None, epoch=0, mesh=None, n_micro=None,
                           axis="pipe", packed_entries=None, stages=None):
-        """GPipe forward: the non-loss prefix of a linear chain runs as a
+        """GPipe forward: the non-loss prefix (any topologically ordered
+        DAG — branches, split/concat fan, in-place rewrites) runs as a
         k-stage heterogeneous pipeline over the mesh's ``axis``
-        (parallel.pipeline_apply_stages); the loss layers run replicated on
-        the gathered output, so numerics match the single-device net.
+        (parallel.pipeline_apply_stages); each stage's padded stream
+        carries the flattened concat of the cut's live node set. The loss
+        layers run replicated on the gathered final live set, so numerics
+        match the single-device net.
 
         Green-field beyond the reference (SURVEY.md §2.9 "Not present").
         Note: BN batch statistics are per-microbatch (standard GPipe
@@ -511,31 +548,47 @@ class NeuralNet:
         def node_size(n):
             return int(np.prod(self.node_shapes[n][1:]))
 
-        boundaries = [0]
+        # boundary s = the LIVE SET of nodes crossing the cut before stage
+        # s (a single node for linear chains; several for branched DAGs —
+        # each stage's padded stream carries their flattened concat)
+        boundaries = [self._pipeline_live_set(0, first_loss)]
         for (lo, hi) in stages:
-            boundaries.append(cfg.layers[hi - 1].nindex_out[0]
+            boundaries.append(self._pipeline_live_set(hi, first_loss)
                               if hi > lo else boundaries[-1])
-        F = max(node_size(n) for n in boundaries)
-
-        def run_layers(p, x, lo, hi, micro_id):
-            ctx = ApplyContext(train=train, labels=None, epoch=epoch,
-                               mesh=mesh)
-            vals = [None] * cfg.param.num_nodes
-            vals[boundaries_by_lo[lo]] = x
-            # fold the microbatch index so stochastic layers (dropout,
-            # insanity) draw fresh noise per microbatch, not one shared mask
-            mb_rng = jax.random.fold_in(base_rng, micro_id)
-            self._apply_layer_range(p, vals, ctx, mb_rng, lo, hi)
-            return vals[cfg.layers[hi - 1].nindex_out[0]] if hi > lo else x
-
-        boundaries_by_lo = {lo: boundaries[s]
-                            for s, (lo, hi) in enumerate(stages)}
+        F = max(sum(node_size(n) for n in b) for b in boundaries)
 
         # token-id boundaries stay f32 (same protection as forward(); the
         # padded carry then runs f32 and each stage casts its own input)
         id_nodes = self._integer_id_nodes()
-        stream_dtype = (jnp.float32 if (cdt is None or 0 in id_nodes)
+        boundary_nodes = {n for b in boundaries for n in b}
+        stream_dtype = (jnp.float32
+                        if (cdt is None or (boundary_nodes & id_nodes))
                         else cdt)
+
+        def run_stage_layers(p, padded, s, micro_id):
+            lo, hi = stages[s]
+            ctx = ApplyContext(train=train, labels=None, epoch=epoch,
+                               mesh=mesh)
+            vals = [None] * cfg.param.num_nodes
+            off = 0
+            for n in boundaries[s]:
+                sz = node_size(n)
+                # batch dim left as -1: under a composed data axis the
+                # shard_map body sees the per-device microbatch shard
+                v = padded[:, off: off + sz].reshape(
+                    (-1,) + tuple(self.node_shapes[n][1:]))
+                if cdt is not None and n not in id_nodes:
+                    v = v.astype(cdt)
+                vals[n] = v
+                off += sz
+            # fold the microbatch index so stochastic layers (dropout,
+            # insanity) draw fresh noise per microbatch, not one shared mask
+            mb_rng = jax.random.fold_in(base_rng, micro_id)
+            self._apply_layer_range(p, vals, ctx, mb_rng, lo, hi)
+            ys = [vals[n].reshape(vals[n].shape[0], -1)
+                  .astype(stream_dtype) for n in boundaries[s + 1]]
+            y = jnp.concatenate(ys, axis=1) if len(ys) > 1 else ys[0]
+            return jnp.pad(y, ((0, 0), (0, F - y.shape[1])))
 
         def unpack_stage(s, row):
             """Rebuild stage s's per-layer param dicts from its flat row
@@ -550,22 +603,11 @@ class NeuralNet:
             return pl
 
         def make_stage(s):
-            lo, hi = stages[s]
-            in_n, out_n = boundaries[s], boundaries[s + 1]
-
             def body(p, padded, micro_id):
-                # batch dim left as -1: under a composed data axis the
-                # shard_map body sees the per-device microbatch shard
-                x = padded[:, : node_size(in_n)].reshape(
-                    (-1,) + tuple(self.node_shapes[in_n][1:]))
-                if cdt is not None and in_n not in id_nodes:
-                    x = x.astype(cdt)
                 if packed is not None:
                     # p is this rank's (1, F_p) packed row
                     p = unpack_stage(s, p[0])
-                y = run_layers(p, x, lo, hi, micro_id)
-                y = y.reshape(y.shape[0], -1).astype(stream_dtype)
-                return jnp.pad(y, ((0, 0), (0, F - y.shape[1])))
+                return run_stage_layers(p, padded, s, micro_id)
             # GPipe re-materialization: each stage's activations are
             # recomputed in the backward pipeline instead of saved —
             # O(boundary) live memory per stage. It also keeps every
@@ -588,13 +630,15 @@ class NeuralNet:
             packed if packed is not None else params, x_stream, mesh,
             axis=axis, batch_spec=dp_axis,
             params_spec=P(axis, None) if packed is not None else None)
-        out_n = boundaries[-1]
-        y = out[:, :, : node_size(out_n)].reshape(
-            (batch,) + tuple(self.node_shapes[out_n][1:]))
-
-        # loss tail, replicated (tiny compute on (batch, nclass))
+        # unpack the final live set; loss tail runs replicated on it
+        # (tiny compute on (batch, nclass)-sized nodes)
         values = [None] * cfg.param.num_nodes
-        values[out_n] = y
+        off = 0
+        for n in boundaries[-1]:
+            sz = node_size(n)
+            values[n] = out[:, :, off: off + sz].reshape(
+                (batch,) + tuple(self.node_shapes[n][1:]))
+            off += sz
         ctx = ApplyContext(train=train, labels=labels, epoch=epoch,
                            mesh=mesh)
         self._apply_layer_range(params, values, ctx, base_rng,

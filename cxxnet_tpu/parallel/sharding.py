@@ -42,8 +42,10 @@ def zero_sharding(mesh: Mesh, x: Any, axis: str = "data",
         # extend the TP split with the ZeRO axis on the SAME dim, tp-axis
         # major, so each device's opt-state shard nests inside its own
         # param shard (no cross-model-shard reshard per step). Works for
-        # dim-0 TP (fullc wmat), later-dim TP (conv output channels), and
-        # the pipeline's P("pipe", None) packed base alike.
+        # dim-0 TP (fullc wmat) and later-dim TP (conv output channels).
+        # The pipeline's P("pipe", None) packed base keeps its base_spec:
+        # dim 0 equals the pipe-axis size, so the joint split below never
+        # divides and PP opt state stays sharded by stage only.
         d = next(i for i, a in enumerate(base_spec) if a is not None)
         tp_axis = base_spec[d]
         if shape[d] % (n * mesh.shape[tp_axis]) == 0:
