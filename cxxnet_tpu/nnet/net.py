@@ -475,20 +475,26 @@ class NeuralNet:
         """The stage partition shared by the Trainer's parameter packing
         and forward_pipelined — ONE source of truth for stage boundaries
         (the packed-entry offsets are built from the same plan). Returns
-        (stages, first_loss); validates the chain shape and rejects
-        stateful layers."""
+        (stages, first_loss); validates the chain shape. Stateful layers
+        (BN running stats) are supported — their state rides the
+        pipeline's scan carry (forward_pipelined state slots) — but a
+        SHARED stateful layer must land in its primary's stage so exactly
+        one pipe rank owns (and chains) the slot."""
         first_loss = self._pipeline_chain_prefix()
-        for i, lay in enumerate(self.layers):
-            check(not lay.state_keys(),
-                  "pipeline_parallel does not support layers with "
-                  "non-gradient state updates (e.g. batch_norm "
-                  "moving_average=1); layer %d %r carries state"
-                  % (i, lay.type_name))
         psizes = [sum(int(np.prod(np.shape(v)))
                       for v in params[i].values())
                   for i in range(first_loss)]
         stages = self._partition_stages(first_loss, k, param_sizes=psizes)
         stages += [(first_loss, first_loss)] * (k - len(stages))
+        stage_of = {i: s for s, (lo, hi) in enumerate(stages)
+                    for i in range(lo, hi)}
+        for i in range(first_loss):
+            if self.is_shared[i] and self.layers[i].state_keys():
+                pidx = self.cfg.layers[i].primary_layer_index
+                check(stage_of.get(pidx) == stage_of.get(i),
+                      "pipeline_parallel: shared stateful layer %d must "
+                      "fall in the same stage as its primary %d (one pipe "
+                      "rank must own the state slot)" % (i, pidx))
         return stages, first_loss
 
     def forward_pipelined(self, params, data, labels=None, train=True,
@@ -565,10 +571,62 @@ class NeuralNet:
                         if (cdt is None or (boundary_nodes & id_nodes))
                         else cdt)
 
-        def run_stage_layers(p, padded, s, micro_id):
+        # non-gradient layer state (BN running stats) rides the pipeline's
+        # scan carry as one flat f32 (S,) vector: each stage seeds
+        # ctx.state_updates for its own layers from the incoming vector
+        # (so the EMA chains across microbatches in order, like
+        # single-device sequential batches) and writes the updated slots
+        # back; per-stage slot ownership is combined by pipeline_apply's
+        # state_masks psum, and composed data shards are pmean-ed.
+        entry_at = {}
+        if packed_entries is not None:
+            for s_, es in enumerate(packed_entries):
+                for (li, key, eoff, eshape) in es:
+                    entry_at[(li, key)] = (s_, eoff, eshape)
+        stage_of = {i: s_ for s_, (lo, hi) in enumerate(stages)
+                    for i in range(lo, hi)}
+        state_slots = []   # (layer, key, off, size, shape)
+        soff = 0
+        for i in range(first_loss):
+            if self.is_shared[i]:
+                continue
+            for key in self.layers[i].state_keys():
+                if packed_entries is not None:
+                    shape = tuple(entry_at[(i, key)][2])
+                else:
+                    shape = tuple(np.shape(params[i][key]))
+                sz = int(np.prod(shape)) if shape else 1
+                state_slots.append((i, key, soff, sz, shape))
+                soff += sz
+        S = soff
+        state0 = state_masks = None
+        slots_by_stage: Dict[int, list] = {}
+        if state_slots:
+            parts = []
+            for (i, key, _, sz, shape) in state_slots:
+                if packed_entries is not None:
+                    s_, eoff, _ = entry_at[(i, key)]
+                    v = packed[s_, eoff: eoff + sz]
+                else:
+                    v = jnp.ravel(params[i][key])
+                parts.append(v.astype(jnp.float32))
+            state0 = jnp.concatenate(parts)
+            masks = np.zeros((k, S), bool)
+            for slot in state_slots:
+                i, _, so, sz = slot[0], slot[1], slot[2], slot[3]
+                masks[stage_of[i], so: so + sz] = True
+                slots_by_stage.setdefault(stage_of[i], []).append(slot)
+            state_masks = jnp.asarray(masks)
+
+        def run_stage_layers(p, padded, s, micro_id, state_in=None):
             lo, hi = stages[s]
             ctx = ApplyContext(train=train, labels=None, epoch=epoch,
                                mesh=mesh)
+            own_slots = slots_by_stage.get(s, ())
+            if state_in is not None:
+                for (i, key, so, sz, shape) in own_slots:
+                    ctx.state_updates[(i, key)] = \
+                        state_in[so: so + sz].reshape(shape)
             vals = [None] * cfg.param.num_nodes
             off = 0
             for n in boundaries[s]:
@@ -588,7 +646,15 @@ class NeuralNet:
             ys = [vals[n].reshape(vals[n].shape[0], -1)
                   .astype(stream_dtype) for n in boundaries[s + 1]]
             y = jnp.concatenate(ys, axis=1) if len(ys) > 1 else ys[0]
-            return jnp.pad(y, ((0, 0), (0, F - y.shape[1])))
+            y = jnp.pad(y, ((0, 0), (0, F - y.shape[1])))
+            if state_in is None:
+                return y
+            st_out = state_in
+            for (i, key, so, sz, shape) in own_slots:
+                st_out = st_out.at[so: so + sz].set(
+                    jnp.ravel(ctx.state_updates[(i, key)])
+                    .astype(jnp.float32))
+            return y, st_out
 
         def unpack_stage(s, row):
             """Rebuild stage s's per-layer param dicts from its flat row
@@ -597,17 +663,28 @@ class NeuralNet:
                 [{} for _ in range(len(self.layers))]
             for (li, key, off, shape) in packed_entries[s]:
                 v = row[off: off + int(np.prod(shape))].reshape(shape)
-                if cdt is not None:
+                if (cdt is not None
+                        and key not in self.layers[li].state_keys()):
+                    # non-trainable state (BN running stats) stays f32,
+                    # same rule as _cast_params_compute
                     v = v.astype(cdt)
                 pl[li][key] = v
             return pl
 
         def make_stage(s):
-            def body(p, padded, micro_id):
-                if packed is not None:
-                    # p is this rank's (1, F_p) packed row
-                    p = unpack_stage(s, p[0])
-                return run_stage_layers(p, padded, s, micro_id)
+            if state_slots:
+                def body(p, padded, micro_id, state_in):
+                    if packed is not None:
+                        # p is this rank's (1, F_p) packed row
+                        p = unpack_stage(s, p[0])
+                    return run_stage_layers(p, padded, s, micro_id,
+                                            state_in)
+            else:
+                def body(p, padded, micro_id):
+                    if packed is not None:
+                        # p is this rank's (1, F_p) packed row
+                        p = unpack_stage(s, p[0])
+                    return run_stage_layers(p, padded, s, micro_id)
             # GPipe re-materialization: each stage's activations are
             # recomputed in the backward pipeline instead of saved —
             # O(boundary) live memory per stage. It also keeps every
@@ -629,7 +706,11 @@ class NeuralNet:
             [make_stage(s) for s in range(k)],
             packed if packed is not None else params, x_stream, mesh,
             axis=axis, batch_spec=dp_axis,
-            params_spec=P(axis, None) if packed is not None else None)
+            params_spec=P(axis, None) if packed is not None else None,
+            state0=state0, state_masks=state_masks)
+        st_out = None
+        if state_slots:
+            out, st_out = out
         # unpack the final live set; loss tail runs replicated on it
         # (tiny compute on (batch, nclass)-sized nodes)
         values = [None] * cfg.param.num_nodes
@@ -645,7 +726,13 @@ class NeuralNet:
                                 first_loss, len(cfg.layers))
         total_loss = sum(ctx.losses) if ctx.losses else jnp.zeros(())
         self._last_pairtest_diffs = getattr(ctx, "pairtest_diffs", [])
-        self._last_state_updates = {}
+        # prefix state came back through the pipeline's state carry; tail
+        # layers (replicated) recorded theirs on ctx directly
+        ups = dict(ctx.state_updates)
+        if st_out is not None:
+            for (i, key, so, sz, shape) in state_slots:
+                ups[(i, key)] = st_out[so: so + sz].reshape(shape)
+        self._last_state_updates = ups
         return values, total_loss
 
     # ------------------------------------------------------------------

@@ -72,6 +72,7 @@ class Trainer:
         self.params = None
         self.opt_state = None
         self._pp_entries = None   # stage-packing plan (pipeline_parallel)
+        self._pp_entry_index = {}  # (layer, key) -> (stage, offset, shape)
         self.grad_accum = None
         self._metric_accum = None   # on-device (n_metrics, 2) stat sums
         self._rng_counter = 0
@@ -371,6 +372,9 @@ class Trainer:
         self.params.append({self._PACKED: packed})
         self.opt_state.append({self._PACKED: packed_opt})
         self._pp_entries = entries
+        self._pp_entry_index = {(i, key): (s, off, shape)
+                                for s, es in enumerate(entries)
+                                for (i, key, off, shape) in es}
         self._pp_stages = stages
         self.grad_accum = None   # tree structure changed
         self._jit_cache.clear()
@@ -382,6 +386,7 @@ class Trainer:
         self.params = self.canonical_params()
         self.opt_state = self._canonical_opt_state()
         self._pp_entries = None
+        self._pp_entry_index = {}
         self._pp_stages = None
         self.grad_accum = None   # tree structure changed
         self._jit_cache.clear()
@@ -702,7 +707,18 @@ class Trainer:
                 # non-gradient updates (BN running stats): direct assignment
                 params = [dict(p) for p in params]
                 for (i, key), val in state_ups.items():
-                    params[i][key] = val
+                    if key in params[i]:
+                        params[i][key] = val
+                    else:
+                        # the tensor lives in the PP packed row: write the
+                        # slot in place (static offsets; the .at update
+                        # stays on the rank owning that stage's shard)
+                        s, off, shape = self._pp_entry_index[(i, key)]
+                        size = int(np.prod(shape)) if shape else 1
+                        pk = params[-1][self._PACKED]
+                        params[-1][self._PACKED] = pk.at[
+                            s, off: off + size].set(
+                                jnp.ravel(val).astype(pk.dtype))
             if with_stats:
                 metric_accum = metric_accum + stats
             # when update_period == 1 no grad-accumulator state is carried

@@ -67,28 +67,46 @@ def _pipeline_local(params, x, *, axis_name: str, n_micro: int,
     return collectives.psum(outbuf, axis_name)
 
 
-def _pipeline_local_switch(params, x, *, axis_name: str, n_micro: int,
-                           stage_fns):
+def _pipeline_local_switch(params, x, state0=None, *, axis_name: str,
+                           n_micro: int, stage_fns, state_masks=None,
+                           data_axis=None):
     """Like _pipeline_local, but heterogeneous stages: every device traces
     all stage bodies once and lax.switch selects its own by pipeline rank.
     All bodies map a (micro_batch, F) padded boundary vector to another —
     F = widest stage boundary — so the ppermute hop and the scan carry stay
-    shape-uniform even when the underlying activations are not."""
+    shape-uniform even when the underlying activations are not.
+
+    With ``state0`` (an (S,) vector of non-gradient layer state, e.g. BN
+    running stats), stage bodies take and return the state vector too:
+    each device chains its OWN stage's slots across its microbatches (EMA
+    order matches single-device sequential batches) and the final vector
+    combines the per-stage slots via ``state_masks`` (a (n_stages, S)
+    ownership mask) with a psum over the pipe axis; ``data_axis`` names a
+    composed data axis to pmean per-shard statistics over."""
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     outbuf = jnp.zeros_like(x)
     cur = jnp.zeros_like(x[0])
     perm = [(i, i + 1) for i in range(n - 1)]
+    with_state = state0 is not None
 
     def tick(carry, t):
-        cur, outbuf = carry
+        cur, outbuf, st = carry
         x_t = lax.dynamic_index_in_dim(x, jnp.clip(t, 0, n_micro - 1),
                                        axis=0, keepdims=False)
         inp = jnp.where(idx == 0, x_t, cur)
         # stage `idx` works on microbatch t - idx at tick t (clipped while
         # the bubble fills/drains; those results are masked out anyway)
         micro_id = jnp.clip(t - idx, 0, n_micro - 1)
-        y = lax.switch(idx, stage_fns, params, inp, micro_id)
+        if with_state:
+            y, st_new = lax.switch(idx, stage_fns, params, inp, micro_id,
+                                   st)
+            # only commit state from real microbatches: bubble ticks run on
+            # zeros and drain ticks would re-run (and re-EMA) the last one
+            real = (t - idx >= 0) & (t - idx < n_micro)
+            st = jnp.where(real, st_new, st)
+        else:
+            y = lax.switch(idx, stage_fns, params, inp, micro_id)
         done_t = t - (n - 1)
         pos = jnp.clip(done_t, 0, n_micro - 1)
         valid = (done_t >= 0) & (idx == n - 1)
@@ -96,16 +114,25 @@ def _pipeline_local_switch(params, x, *, axis_name: str, n_micro: int,
         outbuf = lax.dynamic_update_index_in_dim(
             outbuf, jnp.where(valid, y, slot), pos, axis=0)
         cur = collectives.ppermute(y, axis_name, perm)
-        return (cur, outbuf), None
+        return (cur, outbuf, st), None
 
-    (_, outbuf), _ = lax.scan(tick, (cur, outbuf),
-                              jnp.arange(n_micro + n - 1))
-    return collectives.psum(outbuf, axis_name)
+    st0 = state0 if with_state else jnp.zeros((0,), x.dtype)
+    (_, outbuf, st), _ = lax.scan(tick, (cur, outbuf, st0),
+                                  jnp.arange(n_micro + n - 1))
+    out = collectives.psum(outbuf, axis_name)
+    if not with_state:
+        return out
+    own = lax.dynamic_index_in_dim(state_masks, idx, axis=0,
+                                   keepdims=False)
+    st = collectives.psum(jnp.where(own, st, 0.0), axis_name)
+    if data_axis is not None:
+        st = collectives.pmean(st, data_axis)
+    return out, st
 
 
 def pipeline_apply_stages(stage_fns, params, x, mesh: Mesh, *,
                           axis: str = "pipe", batch_spec=None,
-                          params_spec=None):
+                          params_spec=None, state0=None, state_masks=None):
     """Heterogeneous-stage GPipe over the mesh's ``axis``.
 
     stage_fns: one callable per stage, each
@@ -125,6 +152,10 @@ def pipeline_apply_stages(stage_fns, params, x, mesh: Mesh, *,
                on (data parallelism composed with the pipeline)
 
     Returns (n_micro, micro_batch, F), replicated over ``axis``.
+    With ``state0`` + ``state_masks`` (non-gradient layer state, e.g. BN
+    running stats — see _pipeline_local_switch) the stage bodies take and
+    return the (S,) state vector as a fourth argument and the call
+    returns ``(out, state)`` instead.
     Differentiable; the backward pipeline is the transposed scan with
     reversed hops. This is the config-DSL pipeline path (trainer key
     ``pipeline_parallel``); `pipeline_apply` remains the fast path for
@@ -137,13 +168,20 @@ def pipeline_apply_stages(stage_fns, params, x, mesh: Mesh, *,
             % (len(stage_fns), n_stages, axis))
     n_micro = x.shape[0]
     bspec = P(None, batch_spec, None) if batch_spec else P()
+    pspec = params_spec if params_spec is not None else P()
+    if state0 is None:
+        fn = shard_map(
+            functools.partial(_pipeline_local_switch, axis_name=axis,
+                              n_micro=n_micro, stage_fns=tuple(stage_fns)),
+            mesh=mesh, in_specs=(pspec, bspec), out_specs=bspec)
+        return fn(params, x)
     fn = shard_map(
         functools.partial(_pipeline_local_switch, axis_name=axis,
-                          n_micro=n_micro, stage_fns=tuple(stage_fns)),
-        mesh=mesh,
-        in_specs=(params_spec if params_spec is not None else P(), bspec),
-        out_specs=bspec)
-    return fn(params, x)
+                          n_micro=n_micro, stage_fns=tuple(stage_fns),
+                          state_masks=state_masks, data_axis=batch_spec),
+        mesh=mesh, in_specs=(pspec, bspec, P()),
+        out_specs=(bspec, P()))
+    return fn(params, x, state0)
 
 
 def pipeline_apply(stage_fn, stacked_params, x, mesh: Mesh, *,

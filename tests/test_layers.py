@@ -157,6 +157,42 @@ def test_pooling_matches_numpy(mode, cls, hw, k, s):
     np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-5, atol=1e-6)
 
 
+def test_max_pool_mask_backward():
+    """CXXNET_POOL=mask: the equality-mask custom VJP matches XLA autodiff
+    when there are no ties, and gives the reference's unpool semantics
+    (all tied positions receive the full gradient) when there are."""
+    import os
+    from cxxnet_tpu import ops
+
+    def grad_of(f, x):
+        return jax.grad(lambda x_: jnp.sum(jnp.sin(f(x_)) * 1.7))(x)
+
+    for (h, w, k, s, p) in [(13, 13, 3, 2, 0), (8, 8, 2, 2, 0),
+                            (14, 14, 3, 1, 1), (7, 9, 3, 3, 0)]:
+        x = rand((2, 3, h, w), seed=7)
+        f = lambda x_: ops.pool2d(x_, "max", (k, k), s, (p, p))
+        ref = grad_of(f, jnp.asarray(x))          # select-and-scatter
+        fwd_ref = np.asarray(f(jnp.asarray(x)))   # default (XLA) path
+        os.environ["CXXNET_POOL"] = "mask"
+        try:
+            got = grad_of(f, jnp.asarray(x))
+            np.testing.assert_array_equal(np.asarray(f(jnp.asarray(x))),
+                                          fwd_ref)
+        finally:
+            del os.environ["CXXNET_POOL"]
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-6, atol=1e-6)
+    # tie semantics (reference unpool): every max-equal input gets the grad
+    ones = jnp.ones((1, 1, 4, 4), jnp.float32)
+    os.environ["CXXNET_POOL"] = "mask"
+    try:
+        dx = jax.grad(lambda x_: jnp.sum(
+            ops.pool2d(x_, "max", (2, 2), 2)))(ones)
+    finally:
+        del os.environ["CXXNET_POOL"]
+    np.testing.assert_array_equal(np.asarray(dx), np.ones((1, 1, 4, 4)))
+
+
 def test_relu_max_pooling_fused():
     lay = L.ReluMaxPoolingLayer()
     lay.set_param("kernel_size", "2")

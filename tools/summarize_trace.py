@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Summarize a jax profiler trace captured by tools/profile_bench.py.
+
+Usage: python tools/summarize_trace.py <trace-dir-or-trace.json.gz> [top_n]
+
+Reads the Chrome-format trace (plugins/profile/*/**.trace.json.gz),
+aggregates complete events by name across the TensorCore lanes, and
+prints the top-N ops by total self duration — enough to rank hot
+HLO/fusion ops without TensorBoard. No TPU or network needed.
+"""
+
+import gzip
+import glob
+import json
+import os
+import sys
+from collections import defaultdict
+
+
+def find_trace(path: str) -> str:
+    if os.path.isfile(path):
+        return path
+    hits = sorted(glob.glob(os.path.join(
+        path, "plugins", "profile", "*", "*.trace.json.gz")))
+    if not hits:
+        hits = sorted(glob.glob(os.path.join(path, "*.trace.json.gz")))
+    if not hits:
+        raise SystemExit("no *.trace.json.gz under %r" % path)
+    return hits[-1]
+
+
+def main():
+    path = find_trace(sys.argv[1] if len(sys.argv) > 1 else "profile_out")
+    top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 25
+    with gzip.open(path, "rt") as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    # name the process/thread lanes so we can keep device lanes only
+    # (host-side Python/runtime lanes would double-count wall time)
+    pids = {}
+    tids = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pids[e["pid"]] = e["args"].get("name", "")
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            tids[(e["pid"], e.get("tid"))] = e["args"].get("name", "")
+    dur_by_name = defaultdict(float)
+    cnt_by_name = defaultdict(int)
+    total = 0.0
+    for e in events:
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        lane = (pids.get(e["pid"], "")
+                + "/" + tids.get((e["pid"], e.get("tid")), ""))
+        low = lane.lower()
+        if not ("tpu" in low or "xla" in low or "tensorcore" in low
+                or "/device" in low or "sparsecore" in low):
+            continue
+        if "step" in low:   # step-marker lanes duplicate op time
+            continue
+        name = e["name"]
+        dur_by_name[name] += e["dur"]
+        cnt_by_name[name] += 1
+        total += e["dur"]
+    rows = sorted(dur_by_name.items(), key=lambda kv: -kv[1])[:top_n]
+    print("trace: %s" % path)
+    print("device-lane total: %.1f ms over %d distinct ops"
+          % (total / 1e3, len(dur_by_name)))
+    print("%-72s %10s %8s %6s" % ("op", "total_ms", "calls", "pct"))
+    for name, d in rows:
+        print("%-72s %10.2f %8d %5.1f%%"
+              % (name[:72], d / 1e3, cnt_by_name[name],
+                 100.0 * d / max(total, 1e-9)))
+
+
+if __name__ == "__main__":
+    main()
