@@ -81,6 +81,8 @@ class LearnTask:
             self.task_train()
         elif self.task == "pred":
             self.task_predict()
+        elif self.task == "pred_raw":
+            self.task_predict_raw()
         elif self.task == "extract":
             self.task_extract_feature()
         return 0
@@ -325,6 +327,29 @@ class LearnTask:
                     "num batch pad must be smaller"
                 for v in pred[: len(pred) - batch.num_batch_padd]:
                     fo.write("%g\n" % v)
+        print("finished prediction, write into %s" % self.name_pred)
+
+    def task_predict_raw(self) -> None:
+        """task = pred_raw: one space-separated row of raw output-node
+        values (class probabilities after softmax) per input row. The
+        reference ACCEPTS this task string in its iterator wiring
+        (src/cxxnet_main.cpp:242) and its kaggle_bowl example depends on
+        it (example/kaggle_bowl/pred.conf + make_submission.py), but its
+        task dispatch never implements it — implemented here the way the
+        submission maker expects."""
+        assert self.itr_pred is not None, \
+            "must specify a predict iterator to generate predictions"
+        print("start predicting (raw)...")
+        with open(self.name_pred, "w") as fo:
+            self.itr_pred.before_first()
+            while self.itr_pred.next():
+                batch = self.itr_pred.value()
+                out = self.net_trainer.extract_feature(batch, "top[-1]")
+                out = np.asarray(out).reshape(out.shape[0], -1)
+                assert batch.num_batch_padd < batch.batch_size, \
+                    "num batch pad must be smaller"
+                for row in out[: len(out) - batch.num_batch_padd]:
+                    fo.write(" ".join("%g" % v for v in row) + "\n")
         print("finished prediction, write into %s" % self.name_pred)
 
     def task_extract_feature(self) -> None:

@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Assemble the Kaggle NDSB submission CSV from a pred_raw run.
+
+Usage: make_submission.py sample_submission.csv test.lst test.txt out.csv
+
+- sample_submission.csv supplies the header (image column + the 121 class
+  names in the order Kaggle scores them — train the model with class ids
+  assigned in THAT order, e.g. gen_img_list from the same header).
+- test.lst is the image list the pred iterator ran over (index \t label
+  \t path); the file's basename becomes the submission image name.
+- test.txt is the pred_raw output: one space-separated probability row
+  per listed image, same order.
+
+Counterpart of the reference example/kaggle_bowl/make_submission.py
+(rewritten; the reference script is python2 and its pred_raw task was
+never implemented in the reference binary — see
+cxxnet_tpu/learn_task.py task_predict_raw).
+"""
+
+import csv
+import os
+import sys
+
+
+def main(argv):
+    if len(argv) < 4:
+        print("Usage: make_submission.py sample_submission.csv test.lst "
+              "test.txt out.csv")
+        return 1
+    with open(argv[0]) as f:
+        header = next(csv.reader(f))
+    names = []
+    with open(argv[1]) as f:
+        for line in f:
+            # .lst rows are index<TAB>label<TAB>path (space-separated
+            # also accepted, matching the iterators' parsing)
+            parts = line.rstrip("\n").split("\t")
+            if len(parts) == 1:
+                parts = line.split()
+            names.append(os.path.basename(parts[-1]))
+    n_class = len(header) - 1
+    wrote = 0
+    with open(argv[2]) as fi, open(argv[3], "w", newline="") as fo:
+        w = csv.writer(fo)
+        w.writerow(header)
+        for i, line in enumerate(fi):
+            probs = line.split()
+            assert len(probs) == n_class, (
+                "row %d has %d probabilities, expected %d (submission "
+                "header and model nclass disagree?)"
+                % (i, len(probs), n_class))
+            assert i < len(names), (
+                "pred output has more rows than the %d listed images "
+                "(stale test.txt from a previous run?)" % len(names))
+            w.writerow([names[i]] + probs)
+            wrote += 1
+    assert wrote == len(names), (
+        "pred output has %d rows for %d listed images" % (wrote, len(names)))
+    print("wrote %s: %d rows x %d classes" % (argv[3], wrote, n_class))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

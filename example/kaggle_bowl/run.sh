@@ -29,14 +29,42 @@ EOF
     # a short smoke run on the generated corpus; drop the override to
     # train the full 100-round recipe
     python "$REPO/bin/cxxnet" bowl.conf max_round=3
+    # prediction + submission leg: raw probabilities over the val pack,
+    # assembled into a Kaggle-format CSV (the real leg does the same
+    # with test.lst/test.bin and Kaggle's sample_submission.csv)
+    python - <<'EOF'
+import csv
+with open("sample_submission.csv", "w", newline="") as f:
+    w = csv.writer(f)
+    w.writerow(["image"] + ["class%03d" % i for i in range(121)])
+EOF
+    sed -e 's/test\.lst/va.lst/' -e 's/test\.bin/va.bin/' \
+        -e 's|models/0100\.model|models/0003.model|' pred.conf \
+        > pred_synth.conf
+    python "$REPO/bin/cxxnet" pred_synth.conf
+    python make_submission.py sample_submission.csv va.lst test.txt \
+        submission.csv
+    head -2 submission.csv
     exit 0
 fi
 
 [ -f train.zip ] || { echo "download train.zip from Kaggle first"; exit 1; }
 unzip -qn train.zip
-python "$REPO/tools/make_imglist.py" train tr.lst 0.1 va.lst
+# class ids in the submission header's column order, so pred_raw rows
+# line up with Kaggle's scored columns
+python "$REPO/tools/make_imglist.py" --classes-from sample_submission.csv \
+    train tr.lst 0.1 va.lst
 python "$REPO/tools/im2bin.py" tr.lst train/ tr.bin
 python "$REPO/tools/im2bin.py" va.lst train/ va.bin
 
 mkdir -p models
 python "$REPO/bin/cxxnet" bowl.conf
+
+# test-set prediction + submission (needs test.zip unpacked into test/)
+if [ -d test ]; then
+    python "$REPO/tools/make_imglist.py" --flat test test.lst
+    python "$REPO/tools/im2bin.py" test.lst test/ test.bin
+    python "$REPO/bin/cxxnet" pred.conf
+    python make_submission.py sample_submission.csv test.lst test.txt \
+        submission.csv
+fi

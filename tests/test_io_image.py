@@ -173,6 +173,131 @@ silent = 1
     assert err < 0.2, "imgbin conv error %f" % err
 
 
+def test_pred_raw_task_and_submission(tmp_path):
+    """task = pred_raw writes per-row probability vectors, and the
+    kaggle_bowl make_submission script assembles them into the Kaggle
+    CSV (the surface the reference declares but never implemented —
+    src/cxxnet_main.cpp:242 accepts the task string with no dispatch)."""
+    import csv
+    from cxxnet_tpu.learn_task import LearnTask
+
+    d = str(tmp_path / "imgs")
+    lst = make_images(d, n=32)
+    bin_path = str(tmp_path / "pack.bin")
+    im2bin(lst, d, bin_path, PAGE_INTS)
+    net = """
+netconfig=start
+layer[0->1] = flatten
+layer[1->2] = fullc:fc
+  nhidden = 3
+  init_sigma = 0.1
+layer[2->2] = softmax
+netconfig=end
+input_shape = 3,32,32
+batch_size = 16
+round_batch = 1
+dev = cpu
+eta = 0.05
+silent = 1
+"""
+    train_conf = """
+data = train
+iter = imgbin
+  image_list = "{lst}"
+  image_bin = "{bin}"
+  page_size = {page}
+  divideby = 256
+iter = end
+num_round = 2
+max_round = 2
+save_model = 1
+model_dir = {mdir}
+""".format(lst=lst, bin=bin_path, page=PAGE_INTS,
+           mdir=str(tmp_path / "m")) + net
+    p = tmp_path / "train.conf"
+    p.write_text(train_conf)
+    LearnTask().run([str(p)])
+
+    out_txt = str(tmp_path / "test.txt")
+    pred_conf = """
+pred = {out}
+iter = imgbin
+  image_list = "{lst}"
+  image_bin = "{bin}"
+  page_size = {page}
+  divideby = 256
+iter = end
+task = pred_raw
+model_in = {mdir}/0002.model
+""".format(out=out_txt, lst=lst, bin=bin_path, page=PAGE_INTS,
+           mdir=str(tmp_path / "m")) + net
+    p2 = tmp_path / "pred.conf"
+    p2.write_text(pred_conf)
+    LearnTask().run([str(p2)])
+
+    rows = [line.split() for line in open(out_txt)]
+    assert len(rows) == 32 and all(len(r) == 3 for r in rows)
+    probs = np.array(rows, dtype=np.float64)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-4)
+
+    # submission assembly
+    sub_dir = os.path.join(os.path.dirname(__file__), "..",
+                           "example", "kaggle_bowl")
+    sys.path.insert(0, sub_dir)
+    try:
+        import make_submission
+    finally:
+        sys.path.pop(0)
+    sample = str(tmp_path / "sample_submission.csv")
+    with open(sample, "w", newline="") as f:
+        csv.writer(f).writerow(["image", "a", "b", "c"])
+    out_csv = str(tmp_path / "sub.csv")
+    assert make_submission.main([sample, lst, out_txt, out_csv]) == 0
+    with open(out_csv) as f:
+        got = list(csv.reader(f))
+    assert got[0] == ["image", "a", "b", "c"]
+    assert len(got) == 33 and got[1][0] == "img_000.jpg"
+    np.testing.assert_allclose(float(got[1][1]) + float(got[1][2])
+                               + float(got[1][3]), 1.0, atol=1e-4)
+
+
+def test_make_imglist_modes(tmp_path):
+    """--flat and --classes-from modes of tools/make_imglist.py."""
+    import csv
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    try:
+        import make_imglist
+    finally:
+        sys.path.pop(0)
+    root = tmp_path / "tree"
+    for ci, cname in enumerate(["zeta", "alpha", "mid"]):
+        cdir = root / cname
+        cdir.mkdir(parents=True)
+        for i in range(2):
+            (cdir / ("i%d.jpg" % i)).write_bytes(b"x")
+    # flat mode: unlabeled listing of one directory
+    n = make_imglist.build_flat(str(root / "alpha"),
+                                str(tmp_path / "flat.lst"))
+    assert n == 2
+    lines = [l.split("\t") for l in open(tmp_path / "flat.lst")]
+    assert [l[1] for l in lines] == ["0", "0"]
+    # submission-header class order beats sorted-directory order
+    sample = tmp_path / "s.csv"
+    with open(sample, "w", newline="") as f:
+        csv.writer(f).writerow(["image", "zeta", "mid", "alpha"])
+    classes = make_imglist.classes_from_submission(str(sample))
+    assert classes == ["zeta", "mid", "alpha"]
+    make_imglist.build(str(root), str(tmp_path / "tr.lst"),
+                       classes=classes)
+    by_label = {}
+    for line in open(tmp_path / "tr.lst"):
+        _, label, rel = line.rstrip("\n").split("\t")
+        by_label.setdefault(int(label), set()).add(rel.split(os.sep)[0])
+    assert by_label[0] == {"zeta"} and by_label[1] == {"mid"} \
+        and by_label[2] == {"alpha"}
+
+
 def test_augment_mean_image_cache(tmp_path):
     d = str(tmp_path / "imgs")
     lst = make_images(d)

@@ -3,12 +3,22 @@
 class-per-directory image tree, optionally holding out a validation split.
 
 Usage: make_imglist.py <image_root> <train.lst> [val_frac] [val.lst]
+       make_imglist.py --flat <image_dir> <out.lst>
+       make_imglist.py --classes-from <sample_submission.csv> <root> \
+                       <train.lst> [val_frac] [val.lst]
 
 Counterpart of the ad-hoc list-building steps in the reference's example
-READMEs (example/kaggle_bowl/README.md, example/ImageNet/README.md); class
-ids are assigned by sorted directory name, and the split is a seeded
-Bernoulli draw per file (reproducible; with very small classes a class can
-land entirely in train — acceptable for held-out evaluation).
+READMEs (example/kaggle_bowl/README.md + gen_img_list.py,
+example/ImageNet/README.md); class ids are assigned by sorted directory
+name, and the split is a seeded Bernoulli draw per file (reproducible;
+with very small classes a class can land entirely in train — acceptable
+for held-out evaluation).
+
+``--flat`` lists an unlabeled flat directory (label 0 for every file) —
+the test-set mode of the reference's gen_img_list.py, for pred/pred_raw
+iterators. ``--classes-from`` assigns class ids in a Kaggle submission
+header's column order instead of sorted-directory order, so pred_raw
+rows line up with the scored columns without reordering.
 """
 
 import os
@@ -17,12 +27,39 @@ import sys
 EXTS = (".jpg", ".jpeg", ".png", ".bmp")
 
 
-def build(root, train_lst, val_frac=0.0, val_lst=None, seed=42):
+def build_flat(image_dir, out_lst):
+    """Unlabeled flat-directory list (label 0), sorted by filename."""
+    files = sorted(f for f in os.listdir(image_dir)
+                   if f.lower().endswith(EXTS))
+    assert files, "no images under %s" % image_dir
+    with open(out_lst, "w") as fo:
+        for idx, fname in enumerate(files):
+            fo.write("%d\t0\t%s\n" % (idx, fname))
+    return len(files)
+
+
+def classes_from_submission(csv_path):
+    """Class order from a Kaggle sample-submission header (first column
+    is the image name; the rest are class names in scoring order)."""
+    import csv as _csv
+    with open(csv_path) as f:
+        header = next(_csv.reader(f))
+    assert len(header) > 1, "submission header has no class columns"
+    return header[1:]
+
+
+def build(root, train_lst, val_frac=0.0, val_lst=None, seed=42,
+          classes=None):
     assert val_frac == 0.0 or val_lst, \
         "val_frac set but no val.lst path given — the split would be lost"
-    classes = sorted(d for d in os.listdir(root)
-                     if os.path.isdir(os.path.join(root, d)))
+    if classes is None:
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
     assert classes, "no class directories under %s" % root
+    for cname in classes:
+        assert os.path.isdir(os.path.join(root, cname)), (
+            "class %r (from the submission header) has no directory "
+            "under %s" % (cname, root))
     import random
     rnd = random.Random(seed)
     idx = 0
@@ -52,11 +89,27 @@ def build(root, train_lst, val_frac=0.0, val_lst=None, seed=42):
 
 
 if __name__ == "__main__":
-    if len(sys.argv) < 3:
+    args = sys.argv[1:]
+    if args and args[0] == "--flat":
+        if len(args) < 3:
+            print(__doc__)
+            sys.exit(1)
+        n = build_flat(args[1], args[2])
+        print("%d images (flat, label 0)" % n)
+        sys.exit(0)
+    classes = None
+    if args and args[0] == "--classes-from":
+        if len(args) < 2:
+            print(__doc__)
+            sys.exit(1)
+        classes = classes_from_submission(args[1])
+        args = args[2:]
+    if len(args) < 2:
         print(__doc__)
         sys.exit(1)
-    root, train_lst = sys.argv[1], sys.argv[2]
-    val_frac = float(sys.argv[3]) if len(sys.argv) > 3 else 0.0
-    val_lst = sys.argv[4] if len(sys.argv) > 4 else None
-    nc, ntr, nva = build(root, train_lst, val_frac, val_lst)
+    root, train_lst = args[0], args[1]
+    val_frac = float(args[2]) if len(args) > 2 else 0.0
+    val_lst = args[3] if len(args) > 3 else None
+    nc, ntr, nva = build(root, train_lst, val_frac, val_lst,
+                         classes=classes)
     print("%d classes, %d train, %d val" % (nc, ntr, nva))
