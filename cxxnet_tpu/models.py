@@ -344,11 +344,14 @@ def googlenet_trainer(batch_size: int = 128, input_hw: int = 224,
 
 
 def transformer_lm_netconfig(vocab: int, dim: int = 64, nhead: int = 4,
-                             nlayer: int = 2, ffn_mult: int = 2) -> str:
+                             nlayer: int = 2, ffn_mult: int = 2,
+                             attn_extra: str = "") -> str:
     """Decoder-only transformer LM from the netconfig DSL (beyond the
     reference — the long-context model family): embed -> n x [causal
     attention + residual, 1x1-conv FFN + residual] -> vocab head ->
-    per-position softmax (seq = 1). Residuals use the `add` layer."""
+    per-position softmax (seq = 1). Residuals use the `add` layer.
+    ``attn_extra``: extra per-attention-layer keys (e.g. "nkvhead = 2\\n
+    attn_window = 1024\\nrope = 1\\n" for a GQA sliding-window recipe)."""
     txt = """
 netconfig = start
 layer[+1:emb] = embed:emb
@@ -365,7 +368,7 @@ layer[%(in)s->%(p)satt] = attention:%(p)s_att
   nhead = %(nh)d
   causal = 1
   init_sigma = 0.05
-layer[%(in)s,%(p)satt->%(p)sres1] = add
+%(attn_extra)slayer[%(in)s,%(p)satt->%(p)sres1] = add
 layer[%(p)sres1->%(p)sf1] = conv:%(p)s_ffn1
   kernel_size = 1
   nchannel = %(ffn)d
@@ -376,7 +379,11 @@ layer[%(p)sr->%(p)sf2] = conv:%(p)s_ffn2
   nchannel = %(dim)d
   init_sigma = 0.05
 layer[%(p)sres1,%(p)sf2->%(p)sout] = add
-""" % {"in": node, "p": p, "nh": nhead, "dim": dim, "ffn": ffn_mult * dim}
+""" % {"in": node, "p": p, "nh": nhead, "dim": dim,
+       "ffn": ffn_mult * dim,
+       "attn_extra": "".join("  %s\n" % l.strip()
+                             for l in attn_extra.splitlines()
+                             if l.strip())}
         node = p + "out"
     txt += """
 layer[%s->logits] = conv:head
@@ -396,9 +403,11 @@ metric = seq
 def transformer_lm_trainer(vocab: int = 50, seq: int = 16,
                            batch_size: int = 8, dim: int = 64,
                            nhead: int = 4, nlayer: int = 2,
-                           dev: str = "cpu", extra_cfg: str = "") -> Trainer:
+                           dev: str = "cpu", extra_cfg: str = "",
+                           attn_extra: str = "") -> Trainer:
     conf = (transformer_lm_netconfig(vocab, dim=dim, nhead=nhead,
-                                     nlayer=nlayer) +
+                                     nlayer=nlayer,
+                                     attn_extra=attn_extra) +
             "input_shape = 1,1,%d\n" % seq +
             "batch_size = %d\n" % batch_size +
             "label_vec[0,%d) = label\n" % seq +

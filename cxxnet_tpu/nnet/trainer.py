@@ -759,6 +759,20 @@ class Trainer:
         return jax.random.fold_in(jax.random.PRNGKey(self.seed),
                                   self._rng_counter)
 
+    def lower_update(self, batch):
+        """Lower (trace without executing) the standard one-batch train
+        step — tools/memory_report.py compiles the result and reads XLA's
+        per-device HBM memory_analysis()."""
+        step = self._get_step(True, False, False, False)
+        data = self._shard_batch(batch.data)
+        label = self._shard_batch(batch.label)
+        # fixed key: only shape/dtype matter for lowering, and drawing from
+        # _next_rng() here would shift the training RNG stream (breaking
+        # inspect-then-train vs train bit-reproducibility)
+        return step.lower(self.params, self.opt_state, None, None,
+                          data, label, jnp.asarray(0, jnp.int32),
+                          jax.random.PRNGKey(0))
+
     def update(self, batch) -> None:
         """One mini-batch (reference Update, nnet_impl-inl.hpp:141-185)."""
         need_update = (self.sample_counter + 1) % self.update_period == 0

@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Per-model HBM memory report from XLA's compiled-program analysis.
+
+Usage: python tools/memory_report.py [model] [--pp K|--zero|--tp K] [n_devices]
+
+Compiles the model's train step (without executing it) and prints XLA's
+memory_analysis(): argument (param/opt-state) bytes, temp (activation)
+bytes, output bytes — per device. Run on the 8-device virtual CPU mesh
+(no TPU needed: set JAX_PLATFORMS=cpu
+XLA_FLAGS=--xla_force_host_platform_device_count=8) to see how the
+parallelism keys change the per-device footprint:
+
+  python tools/memory_report.py mlp            # replicated baseline
+  python tools/memory_report.py mlp --zero     # ZeRO opt-state sharding
+  python tools/memory_report.py mlp --pp 4     # stage-packed pipeline
+  python tools/memory_report.py alexnet --tp 2 # Megatron fullc sharding
+
+This turns the ZeRO / pipeline memory claims (doc/multichip.md) into
+measured bytes; tests/test_compose.py asserts the shard-size ratios, this
+tool shows the absolute numbers for any config.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
+
+# the env-var route (JAX_PLATFORMS) cannot undo a preloaded tunneled
+# platform; the config route can (same pattern as bin/cxxnet)
+_plat = os.environ.get("CXXNET_JAX_PLATFORM") or (
+    "cpu" if os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
+    else None)
+if _plat:
+    import jax
+    jax.config.update("jax_platforms", _plat)
+
+import numpy as np
+
+
+def build(model, extra):
+    from cxxnet_tpu.models import (alexnet_trainer, googlenet_trainer,
+                                   transformer_lm_trainer)
+    from cxxnet_tpu.nnet.trainer import Trainer
+    from cxxnet_tpu.utils.config import parse_config_string
+    n = "tpu:0-%d" % (int(os.environ.get("_NDEV", "8")) - 1)
+    if model == "alexnet":
+        return alexnet_trainer(batch_size=32, input_hw=67, dev=n,
+                               extra_cfg=extra), (32, 3, 67, 67), 1000
+    if model == "googlenet":
+        return googlenet_trainer(batch_size=16, input_hw=128, dev=n,
+                                 extra_cfg=extra), (16, 3, 128, 128), 1000
+    if model == "lm":
+        tr = transformer_lm_trainer(vocab=512, seq=256, batch_size=8,
+                                    dim=128, nhead=4, nlayer=2, dev=n,
+                                    extra_cfg=extra)
+        return tr, (8, 1, 1, 256), 512
+    conf = """
+netconfig = start
+layer[+1] = fullc:fc1
+  nhidden = 512
+  init_sigma = 0.05
+layer[+1] = relu
+layer[+1] = fullc:fc2
+  nhidden = 256
+  init_sigma = 0.05
+layer[+1] = relu
+layer[+1] = fullc:fc3
+  nhidden = 10
+  init_sigma = 0.05
+layer[+0] = softmax
+netconfig = end
+input_shape = 1,1,784
+batch_size = 64
+eta = 0.1
+momentum = 0.9
+dev = %s
+""" % n + extra
+    tr = Trainer()
+    for k, v in parse_config_string(conf):
+        tr.set_param(k, v)
+    tr.init_model()
+    return tr, (64, 1, 1, 784), 10
+
+
+def main():
+    args = [a for a in sys.argv[1:]]
+    model = args[0] if args and not args[0].startswith("--") else "mlp"
+    extra = ""
+    consumed = set()
+    for flag, key in (("--pp", "pipeline_parallel"),
+                      ("--tp", "model_parallel")):
+        if flag in args:
+            i = args.index(flag)
+            extra += "%s = %s\n" % (key, args[i + 1])
+            consumed.add(i + 1)
+    if "--zero" in args:
+        extra += "update_on_server = 1\n"
+    tail = [a for i, a in enumerate(args)
+            if i > 0 and i not in consumed and a.isdigit()]
+    ndev = int(tail[-1]) if tail else None
+
+    import jax
+    if ndev:
+        os.environ["_NDEV"] = str(ndev)
+    tr, shape, nclass = build(model, extra)
+    from cxxnet_tpu.io.data import DataBatch
+    rs = np.random.RandomState(0)
+    b = DataBatch()
+    if model == "lm":
+        b.data = rs.randint(0, nclass, shape).astype(np.float32)
+        b.label = rs.randint(0, nclass,
+                             (shape[0], shape[3])).astype(np.float32)
+    else:
+        b.data = rs.rand(*shape).astype(np.float32)
+        b.label = rs.randint(0, nclass, (shape[0], 1)).astype(np.float32)
+    b.batch_size = shape[0]
+    lowered = tr.lower_update(b)
+    compiled = lowered.compile()
+    m = compiled.memory_analysis()
+    if m is None:
+        print("backend exposes no memory_analysis()")
+        return
+    def gb(x):
+        return "%.2f MiB" % (x / (1 << 20))
+    print("model=%s extra=%r devices=%d" %
+          (model, extra.replace("\n", " "), tr.mesh.devices.size
+           if tr.mesh is not None else 1))
+    print("  per-device argument (params+opt state):",
+          gb(m.argument_size_in_bytes))
+    print("  per-device temp (activations/workspace):",
+          gb(m.temp_size_in_bytes))
+    print("  per-device output:", gb(m.output_size_in_bytes))
+    print("  generated code:", gb(m.generated_code_size_in_bytes))
+    total = (m.argument_size_in_bytes + m.temp_size_in_bytes
+             + m.output_size_in_bytes)
+    print("  total per device:", gb(total))
+
+
+if __name__ == "__main__":
+    main()
