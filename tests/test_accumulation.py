@@ -71,6 +71,32 @@ def test_update_period_matches_large_batch():
                 rtol=1e-5, atol=1e-6)
 
 
+def test_update_period_composes_with_pipeline():
+    """update_period=2 under pipeline_parallel: the packed stage-param
+    tree accumulates like any other gradient leaf, so two half-batches
+    must reproduce the single large-batch pipelined update."""
+    rs = np.random.RandomState(1)
+    x = rs.rand(16, 3, 6, 6).astype(np.float32)
+    y = rs.randint(0, 5, (16, 1)).astype(np.float32)
+
+    pp = "dev = cpu:0-1\npipeline_parallel = 2\npipeline_micro = 2\n"
+    big = _trainer(pp + "batch_size = 16\n")
+    small = _trainer(pp + "batch_size = 8\nupdate_period = 2\n")
+
+    for _ in range(3):
+        big.update(_batch(x, y))
+        small.update(_batch(x[:8], y[:8]))
+        small.update(_batch(x[8:], y[8:]))
+
+    for pb, ps in zip(big.canonical_params(), small.canonical_params()):
+        assert sorted(pb) == sorted(ps)
+        for k in pb:
+            np.testing.assert_allclose(
+                np.asarray(jax.device_get(pb[k])),
+                np.asarray(jax.device_get(ps[k])),
+                rtol=2e-4, atol=2e-5)
+
+
 def test_zero_sharded_optimizer_matches_plain():
     """update_on_server=1 (ZeRO weight-update sharding) is a layout change,
     not a math change: params after k steps match the replicated-optimizer
