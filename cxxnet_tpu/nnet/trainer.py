@@ -740,7 +740,7 @@ class Trainer:
     def _shard_batch(self, arr):
         if self.mesh is None:
             return jnp.asarray(arr)
-        sh = NamedSharding(self.mesh, P("data"))
+        sh = parallel.batch_sharding(self.mesh)
         nproc = jax.process_count()
         if nproc > 1:
             a = np.asarray(arr)
@@ -890,7 +890,7 @@ class Trainer:
                 # process_allgather concatenates in process-index order,
                 # which differs from device order on hybrid DCN x ICI
                 # meshes and would silently misalign the metrics
-                sh = NamedSharding(self.mesh, P("data"))
+                sh = parallel.batch_sharding(self.mesh)
                 labels_np = parallel.fetch_global(
                     jax.make_array_from_process_local_data(sh, labels_np))
                 mask = parallel.fetch_global(

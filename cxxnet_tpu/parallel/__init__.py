@@ -16,7 +16,7 @@ mshadow-ps push/pull parameter server + per-GPU worker threads
 
 from .mesh import (backend_initialized, create_mesh,  # noqa: F401
                    ensure_platform, parse_device_spec)
-from .sharding import (batch_sharding, replicated, shard_opt_state,  # noqa: F401
+from .sharding import (batch_sharding, replicated,  # noqa: F401
                        zero_sharding)
 from . import collectives  # noqa: F401
 from .ring import attention_reference, ring_attention, ulysses_attention  # noqa: F401

@@ -140,9 +140,3 @@ def param_shardings(mesh: Mesh, layers, params):
     return out
 
 
-def shard_opt_state(mesh: Mesh, opt_state: Any, axis: str = "data") -> Any:
-    """Apply ZeRO-style sharding constraints to an optimizer-state pytree
-    inside jit (weight-update sharding)."""
-    def constrain(x):
-        return jax.lax.with_sharding_constraint(x, zero_sharding(mesh, x, axis))
-    return jax.tree.map(constrain, opt_state)

@@ -157,6 +157,31 @@ err_ref = float(np.mean(pred4 != b.label[:, 0]))
 err_got = float(s.split("hybrid-error:")[1].split()[0])
 assert abs(err_got - err_ref) < 1e-6, (err_got, err_ref)
 print("RANK%%d_HYBRID_OK" %% rank)
+
+# pipeline parallelism across the 2-process mesh: mesh (data=2, pipe=4)
+# puts each pipe group on one process's 4 local devices (ppermute hops
+# ride the intra-process "ICI"; the data all-reduce crosses "DCN"), and
+# stage params pack sharded by pipe rank as in single-process runs
+tr5 = Trainer()
+for k, v in parse_config_string(conf + "pipeline_parallel = 4\\n"):
+    tr5.set_param(k, v)
+tr5.init_model()
+assert tr5.mesh.axis_names == ("data", "pipe")
+assert tr5.mesh.shape["data"] == 2 and tr5.mesh.shape["pipe"] == 4
+for i in range(tr5.mesh.shape["data"]):
+    row_procs = {d.process_index for d in tr5.mesh.devices[i]}
+    assert len(row_procs) == 1, (
+        "pipe group %%d crosses processes: %%r" %% (i, row_procs))
+for _ in range(3):
+    tr5.update(b)
+canon5 = tr5.canonical_params()
+w5 = np.asarray(canon5[0]["wmat"])
+gathered5 = multihost_utils.process_allgather(w5)
+np.testing.assert_array_equal(gathered5[0], gathered5[1])
+assert np.isfinite(gathered5).all()
+pred5 = tr5.predict(b)
+assert pred5.shape == (16,)
+print("RANK%%d_PP_OK" %% rank)
 ''')
 
 
@@ -182,6 +207,7 @@ def test_two_process_distributed_training(tmp_path):
         assert ("RANK%d_SAVE_OK" % r) in out
         assert ("RANK%d_SHARD_OK" % r) in out
         assert ("RANK%d_HYBRID_OK" % r) in out
+        assert ("RANK%d_PP_OK" % r) in out
 
 
 FAULT_WORKER = r'''

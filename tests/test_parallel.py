@@ -220,6 +220,12 @@ class TestPipeline:
         for s in range(n_stages):
             ref = np.tanh(ref @ ws[s])
         np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+        # pre-placing the stacked params with stage_sharding (the public
+        # helper for this layout) is equivalent and keeps each stage's
+        # weights on its own pipe rank with no per-call reshard
+        ws_placed = jax.device_put(ws, parallel.stage_sharding(mesh))
+        out2 = parallel.pipeline_apply(stage, ws_placed, x, mesh)
+        np.testing.assert_array_equal(np.asarray(out2), np.asarray(out))
 
     def test_grad_flows(self):
         n_stages, n_micro, mb, dim = 8, 2, 2, 8
