@@ -184,6 +184,11 @@ class ApplyContext:
     # running statistics): {(layer_index, param_key): new_value}; the
     # trainer merges them into params after the optimizer step
     state_updates: Dict = field(default_factory=dict)
+    # True when the layer's 4-D inputs arrive channels-last (N,H,W,C) —
+    # the TPU-preferred activation layout. Set per layer by the net's
+    # forward loop for layers declaring layout_support == "nhwc"; logical
+    # shapes, params, and checkpoints stay reference-NCHW throughout
+    channels_last: bool = False
 
 
 class Layer:
@@ -196,6 +201,12 @@ class Layer:
     # must never be cast to a low-precision compute dtype — bf16 cannot
     # represent ids above ~256 exactly
     integer_inputs = False
+    # Activation-layout contract under the net's channels_last mode:
+    #   "nchw"  — apply() requires reference (N,C,H,W) inputs (default)
+    #   "any"   — elementwise/routing: runs on either layout unchanged
+    #   "nhwc"  — has a channels-last fast path; apply() reads
+    #             ctx.channels_last to pick its axes
+    layout_support = "nchw"
 
     def __init__(self):
         self.param = LayerParam()

@@ -200,6 +200,7 @@ class ActivationLayer(Layer):
     op structs in src/layer/op.h)."""
 
     fn = staticmethod(lambda x: x)
+    layout_support = "any"
 
     def infer_shape(self, in_shapes):
         check(len(in_shapes) == 1, "ActivationLayer only support 1-1 connection")
@@ -236,6 +237,7 @@ class XeluLayer(Layer):
     (src/layer/xelu_layer-inl.hpp:15)."""
 
     type_name = "xelu"
+    layout_support = "any"
 
     def __init__(self):
         super().__init__()
@@ -262,6 +264,7 @@ class InsanityLayer(Layer):
     counter)."""
 
     type_name = "insanity"
+    layout_support = "any"
 
     def __init__(self):
         super().__init__()
@@ -349,10 +352,14 @@ class PReluLayer(Layer):
             slope = (rng.uniform(0, 1, (self.channel,)) * self.init_slope).astype(np.float32)
         return {"slope": slope}
 
+    layout_support = "nhwc"
+
     def apply(self, params, inputs, ctx):
         x = inputs[0]
         slope = params["slope"]
-        bshape = (1, 1, 1, self.channel) if self.is_fc else (1, self.channel, 1, 1)
+        bshape = ((1, 1, 1, self.channel)
+                  if self.is_fc or ctx.channels_last
+                  else (1, self.channel, 1, 1))
         mask = jnp.broadcast_to(slope.reshape(bshape), x.shape)
         if ctx.train and self.random != 0.0:
             u = jax.random.uniform(ctx.rng, x.shape, x.dtype)
@@ -441,6 +448,11 @@ class ChConcatLayer(ConcatLayer):
     """N->1 concat along the channel dim (layer_impl-inl.hpp:62)."""
     type_name = "ch_concat"
     dim = 1
+    layout_support = "nhwc"
+
+    def apply(self, params, inputs, ctx):
+        axis = 3 if ctx.channels_last else 1
+        return [jnp.concatenate(inputs, axis=axis)]
 
 
 class SplitLayer(Layer):
@@ -448,6 +460,7 @@ class SplitLayer(Layer):
     (src/layer/split_layer-inl.hpp:12)."""
 
     type_name = "split"
+    layout_support = "any"
 
     def __init__(self, n_out: int = 2):
         super().__init__()
@@ -467,6 +480,7 @@ class DropoutLayer(Layer):
 
     type_name = "dropout"
     self_loop = True
+    layout_support = "any"
 
     def __init__(self):
         super().__init__()
@@ -540,13 +554,17 @@ class ConvolutionLayer(Layer):
         return wmat.reshape(p.num_channel, p.num_input_channel // p.num_group,
                             p.kernel_height, p.kernel_width)
 
+    layout_support = "nhwc"
+
     def apply(self, params, inputs, ctx):
         p = self.param
+        layout = "NHWC" if ctx.channels_last else "NCHW"
         y = ops.conv2d(inputs[0], self._kernel_oihw(params["wmat"]),
                        stride=p.stride, pad=(p.pad_y, p.pad_x),
-                       groups=p.num_group)
+                       groups=p.num_group, layout=layout)
         if p.no_bias == 0:
-            y = y + params["bias"].reshape(1, -1, 1, 1)
+            bshape = (1, 1, 1, -1) if ctx.channels_last else (1, -1, 1, 1)
+            y = y + params["bias"].reshape(bshape)
         return [y]
 
     def visit_order(self):
@@ -575,6 +593,7 @@ class PoolingLayer(Layer):
     (src/layer/pooling_layer-inl.hpp:17)."""
 
     mode = "max"
+    layout_support = "nhwc"
 
     def infer_shape(self, in_shapes):
         p = self.param
@@ -594,8 +613,9 @@ class PoolingLayer(Layer):
     def apply(self, params, inputs, ctx):
         p = self.param
         x = self._pre(inputs[0])
+        layout = "NHWC" if ctx.channels_last else "NCHW"
         return [ops.pool2d(x, self.mode, (p.kernel_height, p.kernel_width),
-                           p.stride, pad=(p.pad_y, p.pad_x))]
+                           p.stride, pad=(p.pad_y, p.pad_x), layout=layout)]
 
 
 class MaxPoolingLayer(PoolingLayer):
@@ -632,6 +652,9 @@ class InsanityPoolingLayer(MaxPoolingLayer):
     of the undisplaced input."""
 
     type_name = "insanity_max_pooling"
+    # the displacement gather below indexes flat (c, h*w) planes — NCHW
+    # only; the net auto-converts around it under channels_last
+    layout_support = "nchw"
 
     def __init__(self):
         super().__init__()
@@ -672,6 +695,7 @@ class LRNLayer(Layer):
     """AlexNet cross-channel LRN (src/layer/lrn_layer-inl.hpp:12)."""
 
     type_name = "lrn"
+    layout_support = "nhwc"
 
     def __init__(self):
         super().__init__()
@@ -695,7 +719,9 @@ class LRNLayer(Layer):
         return [in_shapes[0]]
 
     def apply(self, params, inputs, ctx):
-        return [ops.lrn(inputs[0], self.nsize, self.alpha, self.beta, self.knorm)]
+        layout = "NHWC" if ctx.channels_last else "NCHW"
+        return [ops.lrn(inputs[0], self.nsize, self.alpha, self.beta,
+                        self.knorm, layout=layout)]
 
 
 class BatchNormLayer(Layer):
@@ -745,10 +771,17 @@ class BatchNormLayer(Layer):
             out["running_var"] = np.ones((self.channel,), np.float32)
         return out
 
+    layout_support = "nhwc"
+
     def apply(self, params, inputs, ctx):
         x = inputs[0]
-        axes = (0, 1, 2) if self.is_fc else (0, 2, 3)
-        bshape = (1, 1, 1, self.channel) if self.is_fc else (1, self.channel, 1, 1)
+        if self.is_fc or ctx.channels_last:
+            # flat features, or conv-mode channels-last: C is minor
+            axes = (0, 1, 2)
+            bshape = (1, 1, 1, self.channel)
+        else:
+            axes = (0, 2, 3)
+            bshape = (1, self.channel, 1, 1)
         use_running = self.moving_average and not ctx.train
         if use_running:
             mean = params["running_mean"].reshape(bshape).astype(x.dtype)
@@ -1212,6 +1245,7 @@ class AddLayer(Layer):
     transformer stacks. Backward broadcasts the gradient to every input."""
 
     type_name = "add"
+    layout_support = "any"
 
     def infer_shape(self, in_shapes):
         check(2 <= len(in_shapes) <= 4, "AddLayer takes 2-4 inputs")

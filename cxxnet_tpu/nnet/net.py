@@ -35,7 +35,8 @@ class NeuralNet:
                  compute_dtype: Optional[jnp.dtype] = None,
                  input_scale: float = 1.0,
                  input_mean=None,
-                 fuse_siblings: bool = True):
+                 fuse_siblings: bool = True,
+                 channels_last: bool = False):
         """infer_shapes=False skips shape inference entirely — used for the
         weight-copy (finetune) path, which only deserializes params and never
         runs the net (reference CopyModelFrom, nnet_impl-inl.hpp:101-134).
@@ -49,11 +50,22 @@ class NeuralNet:
         input_mean_value) apply ``(x - mean) * scale`` ON DEVICE to the data
         node — the TPU-native deferred-normalization path: the host pipeline
         ships uint8 (AugmentIterator output_uint8=1), quartering H2D
-        bandwidth, and the cast+normalize fuses into the first conv."""
+        bandwidth, and the cast+normalize fuses into the first conv.
+
+        channels_last=True runs the conv stack's activations in the
+        TPU-preferred (N, H, W, C) layout on device (trainer key
+        ``channels_last``; measured +24% raw-jax on the inception topology,
+        tools/layout_experiment.py). Logical node shapes, params, model
+        files, and every user-visible tensor stay reference-NCHW: the
+        forward loop tracks a per-node physical layout, feeds channels-last
+        to layers declaring layout_support "nhwc"/"any", auto-converts
+        around NCHW-only layers, and converts observable node values back
+        before they leave the net."""
         self.cfg = cfg
         self.max_batch = batch_size
         self.compute_dtype = compute_dtype
         self.fuse_siblings = fuse_siblings
+        self.channels_last = bool(channels_last)
         self._fuse_plan: Optional[Dict[int, List[int]]] = None
         self.input_scale = float(input_scale)
         self.input_mean = None if input_mean is None else \
@@ -234,24 +246,49 @@ class NeuralNet:
         self._fuse_plan = groups
         return groups
 
-    def _apply_fused_siblings(self, g: List[int], params, values) -> None:
+    # --- channels-last layout tracking ---
+    def _image_like(self, n: int) -> bool:
+        """Nodes eligible for the channels-last layout: real multi-channel
+        feature maps. Excluded: flat (b,1,1,w) matrices, (b,C,1,1) channel
+        vectors (transposing buys nothing), and single-channel (b,1,h,w)
+        maps — BN/PRelu treat c==1 nodes as per-width fc features
+        (is_fc), which a physical transpose would silently misalign."""
+        b, c, h, w = self.node_shapes[n]
+        return c > 1 and (h > 1 or w > 1)
+
+    @staticmethod
+    def _relayout(v, frm: str, to: str):
+        if frm == to or v.ndim != 4:
+            return v
+        return ops.to_nhwc(v) if to == "NHWC" else ops.to_nchw(v)
+
+    def _apply_fused_siblings(self, g: List[int], params, values,
+                              layouts) -> None:
         """One conv over the concatenated (along O) member kernels, sliced
         back to each member's output node. When every member asks for
         ``remat``, the fused conv is checkpointed as a unit."""
         cfg = self.cfg
         p0 = self.layers[g[0]].param
-        x = values[cfg.layers[g[0]].nindex_in[0]]
+        n_in = cfg.layers[g[0]].nindex_in[0]
+        want = ("NHWC" if (self.channels_last and self._image_like(n_in))
+                else "NCHW")
+        x = values[n_in]
+        if layouts[n_in] != want:
+            x = self._relayout(x, layouts[n_in], want)
+            values[n_in] = x
+            layouts[n_in] = want
 
         def fused(xv, member_params):
             w = jnp.concatenate(
                 [self.layers[j]._kernel_oihw(member_params[k]["wmat"])
                  for k, j in enumerate(g)], axis=0)
             y = ops.conv2d(xv, w, stride=p0.stride,
-                           pad=(p0.pad_y, p0.pad_x))
+                           pad=(p0.pad_y, p0.pad_x), layout=want)
             if p0.no_bias == 0:
                 b = jnp.concatenate(
                     [member_params[k]["bias"] for k in range(len(g))])
-                y = y + b.reshape(1, -1, 1, 1)
+                y = y + b.reshape((1, 1, 1, -1) if want == "NHWC"
+                                  else (1, -1, 1, 1))
             return y
 
         if all(self.layers[j].remat for j in g):
@@ -260,7 +297,10 @@ class NeuralNet:
         off = 0
         for j in g:
             n = self.layers[j].param.num_channel
-            values[cfg.layers[j].nindex_out[0]] = y[:, off:off + n]
+            out_n = cfg.layers[j].nindex_out[0]
+            values[out_n] = (y[..., off:off + n] if want == "NHWC"
+                             else y[:, off:off + n])
+            layouts[out_n] = want
             off += n
 
     def _apply_remat(self, lay, pidx, p, ins, ctx):
@@ -273,7 +313,8 @@ class NeuralNet:
         identical stochastic draw."""
         def pure(pp, xs, rng, epoch):
             c2 = ApplyContext(train=ctx.train, labels=None,
-                              epoch=epoch, mesh=ctx.mesh)
+                              epoch=epoch, mesh=ctx.mesh,
+                              channels_last=ctx.channels_last)
             c2.rng = rng
             c2.layer_index = getattr(ctx, "layer_index", pidx)
             return tuple(lay.apply(pp, list(xs), c2))
@@ -281,11 +322,20 @@ class NeuralNet:
             p, tuple(ins), ctx.rng, ctx.epoch))
 
     def _apply_layer_range(self, params, values, ctx, base_rng,
-                           lo: int, hi: int) -> None:
+                           lo: int, hi: int, layouts=None):
         """Apply layers [lo, hi) in place on the node-values list, with the
-        per-layer rng fold and the losses-run-in-f32 rule."""
+        per-layer rng fold and the losses-run-in-f32 rule.
+
+        ``layouts`` tracks each node value's physical layout
+        ("NCHW"/"NHWC") under channels_last mode; conversions are inserted
+        only at boundaries between layout worlds (in a typical CNN: one
+        transpose of the data node into the first conv and one back at
+        flatten — XLA folds both into the adjacent ops). Returns the
+        layouts list so callers can convert escaping values back."""
         cfg = self.cfg
         cdt = self.compute_dtype
+        if layouts is None:
+            layouts = ["NCHW"] * cfg.param.num_nodes
         fuse_groups = self._sibling_conv_plan()
         fused_done: set = set()
         for i in range(lo, hi):
@@ -293,7 +343,7 @@ class NeuralNet:
                 continue
             g = fuse_groups.get(i)
             if g is not None and g[-1] < hi:
-                self._apply_fused_siblings(g, params, values)
+                self._apply_fused_siblings(g, params, values, layouts)
                 fused_done.update(g)
                 continue
             info = cfg.layers[i]
@@ -301,7 +351,25 @@ class NeuralNet:
             pidx = (info.primary_layer_index if self.is_shared[i] else i)
             ctx.rng = jax.random.fold_in(base_rng, i)
             ctx.layer_index = pidx
-            ins = [values[j] for j in info.nindex_in]
+            sup = lay.layout_support
+            if (self.channels_last and sup == "nhwc"
+                    and all(self._image_like(j) for j in info.nindex_in)):
+                want = "NHWC"
+            elif sup == "any" and info.nindex_in:
+                want = layouts[info.nindex_in[0]]
+            else:
+                want = "NCHW"
+            ctx.channels_last = (want == "NHWC")
+            ins = []
+            for j in info.nindex_in:
+                v = values[j]
+                if layouts[j] != want:
+                    # write the converted value back so further consumers
+                    # of the node reuse one transpose (CSE also catches it)
+                    v = self._relayout(v, layouts[j], want)
+                    values[j] = v
+                    layouts[j] = want
+                ins.append(v)
             if cdt is not None and lay.is_loss:
                 # losses always in f32 (softmax/log numerics)
                 ins = [x.astype(jnp.float32) for x in ins]
@@ -312,6 +380,8 @@ class NeuralNet:
                 outs = lay.apply(params[pidx], ins, ctx)
             for j, v in zip(info.nindex_out, outs):
                 values[j] = v
+                layouts[j] = want if v.ndim == 4 else "NCHW"
+        return layouts
 
     def _normalize_input(self, x):
         """Device-side input normalization ``(x - mean) * scale``. With the
@@ -358,8 +428,13 @@ class NeuralNet:
         ctx = ApplyContext(train=train, labels=labels, epoch=epoch,
                            mesh=mesh)
         base_rng = rng if rng is not None else jax.random.PRNGKey(0)
-        self._apply_layer_range(params, values, ctx, base_rng,
-                                0, len(cfg.layers))
+        layouts = self._apply_layer_range(params, values, ctx, base_rng,
+                                          0, len(cfg.layers))
+        # every escaping node value is reference-NCHW; the transposes of
+        # values the caller never reads are dead code XLA eliminates
+        for n, lo_ in enumerate(layouts):
+            if lo_ == "NHWC" and values[n] is not None:
+                values[n] = ops.to_nchw(values[n])
         total_loss = sum(ctx.losses) if ctx.losses else jnp.zeros(())
         self._last_pairtest_diffs = getattr(ctx, "pairtest_diffs", [])
         # non-gradient param updates (BN running stats); valid only when
@@ -642,7 +717,11 @@ class NeuralNet:
             # fold the microbatch index so stochastic layers (dropout,
             # insanity) draw fresh noise per microbatch, not one shared mask
             mb_rng = jax.random.fold_in(base_rng, micro_id)
-            self._apply_layer_range(p, vals, ctx, mb_rng, lo, hi)
+            louts = self._apply_layer_range(p, vals, ctx, mb_rng, lo, hi)
+            for n in boundaries[s + 1]:
+                if louts[n] == "NHWC":
+                    # the stage stream carries reference-NCHW bytes
+                    vals[n] = ops.to_nchw(vals[n])
             ys = [vals[n].reshape(vals[n].shape[0], -1)
                   .astype(stream_dtype) for n in boundaries[s + 1]]
             y = jnp.concatenate(ys, axis=1) if len(ys) > 1 else ys[0]
@@ -722,8 +801,11 @@ class NeuralNet:
             off += sz
         ctx = ApplyContext(train=train, labels=labels, epoch=epoch,
                            mesh=mesh)
-        self._apply_layer_range(params, values, ctx, base_rng,
-                                first_loss, len(cfg.layers))
+        louts = self._apply_layer_range(params, values, ctx, base_rng,
+                                        first_loss, len(cfg.layers))
+        for n, lo_ in enumerate(louts):
+            if lo_ == "NHWC" and values[n] is not None:
+                values[n] = ops.to_nchw(values[n])
         total_loss = sum(ctx.losses) if ctx.losses else jnp.zeros(())
         self._last_pairtest_diffs = getattr(ctx, "pairtest_diffs", [])
         # prefix state came back through the pipeline's state carry; tail

@@ -63,6 +63,8 @@ class Trainer:
         self.input_scale = 1.0      # device-side input normalization
         self.input_mean = None
         self.fuse_sibling_convs = 1  # sibling-conv fusion pass (net.py)
+        self.channels_last = -1     # NHWC conv-stack layout: -1 auto
+        #                             (on for TPU backends), 0/1 force
         self.clip_global_norm = 0.0  # 0 -> off (per-tensor clip_gradient
         #                              remains the reference-parity knob)
         self.metric = MetricSet()
@@ -111,6 +113,8 @@ class Trainer:
             self.test_on_server = int(val)
         if name == "fuse_sibling_convs":
             self.fuse_sibling_convs = int(val)
+        if name == "channels_last":
+            self.channels_last = int(val)
         if name == "clip_global_norm":
             self.clip_global_norm = float(val)
         if name == "compute_dtype":
@@ -243,13 +247,23 @@ class Trainer:
                  for k, st in p.items()}
                 for i, p in enumerate(self.opt_state)]
 
+    def _resolve_channels_last(self) -> bool:
+        """channels_last = -1 (auto) turns the NHWC conv-stack layout on
+        exactly where it pays: TPU backends (the MXU/VPU want C minor;
+        measured +24% on inception, tools/layout_experiment.py). CPU/GPU
+        keep reference NCHW. 0/1 force either way (the ablation knob)."""
+        if self.channels_last >= 0:
+            return bool(self.channels_last)
+        return jax.default_backend() == "tpu"
+
     def _init_net_structure(self) -> None:
         self.net_cfg.configure(self.cfg_pairs)
         self.net = NeuralNet(self.net_cfg, self.batch_size,
                              compute_dtype=self.compute_dtype,
                              input_scale=self.input_scale,
                              input_mean=self.input_mean,
-                             fuse_siblings=bool(self.fuse_sibling_convs))
+                             fuse_siblings=bool(self.fuse_sibling_convs),
+                             channels_last=self._resolve_channels_last())
         self._setup_mesh()
         # resolve eval nodes (metric[label,node] -> node id; default last)
         self.eval_nodes: List[int] = []
@@ -513,7 +527,8 @@ class Trainer:
                              compute_dtype=self.compute_dtype,
                              input_scale=self.input_scale,
                              input_mean=self.input_mean,
-                             fuse_siblings=bool(self.fuse_sibling_convs))
+                             fuse_siblings=bool(self.fuse_sibling_convs),
+                             channels_last=self._resolve_channels_last())
         self._setup_mesh()
         self.eval_nodes = [self.net_cfg.param.num_nodes - 1 if nm is None
                            else self.net_cfg.node_name_map[nm]

@@ -39,8 +39,14 @@ def pool_out_dim(x: int, k: int, s: int) -> int:
 
 
 def conv2d(x: jnp.ndarray, w: jnp.ndarray, *, stride: int = 1,
-           pad: Tuple[int, int] = (0, 0), groups: int = 1) -> jnp.ndarray:
-    """2-D convolution. x: (N, C, H, W); w: (O, C/groups, KH, KW) OIHW.
+           pad: Tuple[int, int] = (0, 0), groups: int = 1,
+           layout: str = "NCHW") -> jnp.ndarray:
+    """2-D convolution. x: (N, C, H, W) — or (N, H, W, C) with
+    layout="NHWC", the TPU-preferred channels-last activation layout
+    (measured +24% on the inception topology, tools/layout_experiment.py).
+    w is always (O, C/groups, KH, KW) OIHW — the reference's storage layout
+    — so params, checkpoints, and TP shardings are layout-independent; XLA
+    folds the (small) kernel transpose into its conv emitter.
 
     Result dtype follows the inputs: under bf16 mixed precision the MXU
     still accumulates each pass in f32 internally, and keeping the output
@@ -50,9 +56,19 @@ def conv2d(x: jnp.ndarray, w: jnp.ndarray, *, stride: int = 1,
         x, w,
         window_strides=(stride, stride),
         padding=[(pad[0], pad[0]), (pad[1], pad[1])],
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        dimension_numbers=(layout, "OIHW", layout),
         feature_group_count=groups,
     )
+
+
+def to_nhwc(x: jnp.ndarray) -> jnp.ndarray:
+    """(N, C, H, W) -> (N, H, W, C)."""
+    return jnp.transpose(x, (0, 2, 3, 1))
+
+
+def to_nchw(x: jnp.ndarray) -> jnp.ndarray:
+    """(N, H, W, C) -> (N, C, H, W)."""
+    return jnp.transpose(x, (0, 3, 1, 2))
 
 
 def _pool_padding(h: int, w: int, k: Tuple[int, int], s: int):
@@ -116,14 +132,16 @@ _max_pool.defvjp(_max_pool_fwd, _max_pool_bwd)
 
 
 def pool2d(x: jnp.ndarray, mode: str, kernel: Tuple[int, int], stride: int,
-           pad: Tuple[int, int] = (0, 0)) -> jnp.ndarray:
+           pad: Tuple[int, int] = (0, 0),
+           layout: str = "NCHW") -> jnp.ndarray:
     """Pooling with the reference's ceil-mode output shape.
 
     mode: 'max' | 'sum' | 'avg'. avg divides by k*k regardless of padding,
     matching src/layer/pooling_layer-inl.hpp:44-46. ``pad`` adds symmetric
     input padding first (beyond the reference — needed for same-size pool
     towers, e.g. GoogLeNet's 3x3/1 pool branch); max pads with -inf, so
-    padding never wins the max.
+    padding never wins the max. layout="NHWC" pools a channels-last input
+    (window over axes 1,2).
 
     CXXNET_POOL=mask selects the equality-mask custom VJP (_max_pool:
     reference unpool tie semantics, but measured slower on TPU — see its
@@ -131,14 +149,27 @@ def pool2d(x: jnp.ndarray, mode: str, kernel: Tuple[int, int], stride: int,
     (select-and-scatter backward).
     """
     import os
-    n, c, h, w = x.shape
+    if layout == "NHWC":
+        n, h, w, c = x.shape
+    else:
+        n, c, h, w = x.shape
     py, px = pad
     (_, _), (ph, pw) = _pool_padding(h + 2 * py, w + 2 * px, kernel, stride)
-    window = (1, 1, kernel[0], kernel[1])
-    strides = (1, 1, stride, stride)
-    padding = [(0, 0), (0, 0), (py, py + ph), (px, px + pw)]
+    if layout == "NHWC":
+        window = (1, kernel[0], kernel[1], 1)
+        strides = (1, stride, stride, 1)
+        padding = [(0, 0), (py, py + ph), (px, px + pw), (0, 0)]
+    else:
+        window = (1, 1, kernel[0], kernel[1])
+        strides = (1, 1, stride, stride)
+        padding = [(0, 0), (0, 0), (py, py + ph), (px, px + pw)]
     if mode == "max":
         if os.environ.get("CXXNET_POOL") == "mask":
+            # the mask VJP kernel is written for NCHW; wrap for NHWC
+            # (opt-in knob — the transposes are acceptable there)
+            if layout == "NHWC":
+                return to_nhwc(_max_pool(to_nchw(x), kernel, stride,
+                                         ((py, py + ph), (px, px + pw))))
             return _max_pool(x, kernel, stride,
                              ((py, py + ph), (px, px + pw)))
         return lax.reduce_window(x, -jnp.inf, lax.max, window,
@@ -152,19 +183,24 @@ def pool2d(x: jnp.ndarray, mode: str, kernel: Tuple[int, int], stride: int,
     return out
 
 
-def chpool_sum(x: jnp.ndarray, nsize: int) -> jnp.ndarray:
+def chpool_sum(x: jnp.ndarray, nsize: int, axis: int = 1) -> jnp.ndarray:
     """Cross-channel sliding-window sum (mshadow chpool<red::sum>).
 
     For channel i, sums channels [i - nsize//2, i - nsize//2 + nsize) clipped
-    to the valid range — the AlexNet LRN neighborhood.
+    to the valid range — the AlexNet LRN neighborhood. ``axis`` is the
+    channel dimension (1 for NCHW, 3 for NHWC).
     """
     pad_lo = nsize // 2
     pad_hi = nsize - 1 - pad_lo
+    window = [1, 1, 1, 1]
+    window[axis] = nsize
+    padding = [(0, 0)] * 4
+    padding[axis] = (pad_lo, pad_hi)
     return lax.reduce_window(
         x, 0.0, lax.add,
-        window_dimensions=(1, nsize, 1, 1),
+        window_dimensions=tuple(window),
         window_strides=(1, 1, 1, 1),
-        padding=[(0, 0), (pad_lo, pad_hi), (0, 0), (0, 0)],
+        padding=padding,
     )
 
 
@@ -192,15 +228,34 @@ def use_pallas() -> bool:
     return jax.default_backend() == "tpu"
 
 
-def lrn(x: jnp.ndarray, nsize: int, alpha: float, beta: float, knorm: float) -> jnp.ndarray:
+def lrn_nhwc(x: jnp.ndarray, nsize: int, alpha: float, beta: float,
+             knorm: float) -> jnp.ndarray:
+    """Channels-last LRN: with C minor the cross-channel window sum is a
+    reduce_window directly over the last axis — no layout change, no
+    custom kernel, O(C * nsize) work. (A full C x C banded matmul also
+    expresses it but wastes C/nsize of the MXU — measured 45% off
+    AlexNet's step at C=256.)"""
+    salpha = alpha / nsize
+    norm = chpool_sum(jnp.square(x), nsize, axis=3) * salpha + knorm
+    return x * jnp.power(norm, -beta)
+
+
+def lrn(x: jnp.ndarray, nsize: int, alpha: float, beta: float, knorm: float,
+        layout: str = "NCHW") -> jnp.ndarray:
     """Local response normalization across channels
-    (reference: src/layer/lrn_layer-inl.hpp:52-60). Dispatches to the fused
-    Pallas kernel on TPU (banded-matmul window sum on the MXU), XLA
-    reduce_window elsewhere. CXXNET_LRN=xla forces the reduce_window path
-    on TPU too — the banded matmul costs O(C^2) MACs per pixel (conv-sized
-    at AlexNet's C=256), so which wins is measured, not assumed
-    (tools/mfu_experiments.py ablation)."""
+    (reference: src/layer/lrn_layer-inl.hpp:52-60). NHWC inputs window-sum
+    over the minor axis in place (lrn_nhwc — a reduce_window, no layout
+    change). NCHW dispatches to the fused Pallas kernel on TPU
+    (banded-matmul window sum on the MXU), XLA reduce_window elsewhere;
+    CXXNET_LRN=xla forces the reduce_window path on TPU too — the banded
+    matmul costs O(C^2) MACs per pixel (conv-sized at AlexNet's C=256), so
+    which wins is measured, not assumed (tools/mfu_experiments.py
+    ablation)."""
     import os
+    if layout == "NHWC":
+        if os.environ.get("CXXNET_LRN") == "xla":
+            return to_nhwc(lrn_xla(to_nchw(x), nsize, alpha, beta, knorm))
+        return lrn_nhwc(x, nsize, alpha, beta, knorm)
     if use_pallas() and os.environ.get("CXXNET_LRN") != "xla":
         from . import pallas_kernels
         return pallas_kernels.lrn(x, nsize, alpha, beta, knorm)

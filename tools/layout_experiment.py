@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Layout A/B: GoogLeNet fwd+bwd in plain jax, NCHW vs NHWC.
+
+Isolates two questions the xprof trace can't answer directly:
+  1. does an internal channels-last layout change TPU throughput for the
+     inception topology (1x1-heavy, channel concats, stride-1 pool towers)?
+  2. how much of the framework trainer's step time is framework overhead
+     vs raw-jax ceiling for the same math?
+
+Prints one JSON line per variant: {"variant", "img_per_sec"}.
+Usage: python tools/layout_experiment.py [batch]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+
+# (c1, c3r, c3, c5r, c5, pool_proj) per module — Inception-v1 Table 1
+MODULES = [
+    (64, 96, 128, 16, 32, 32),
+    (128, 128, 192, 32, 96, 64),
+    "pool",
+    (192, 96, 208, 16, 48, 64),
+    (160, 112, 224, 24, 64, 64),
+    (128, 128, 256, 24, 64, 64),
+    (112, 144, 288, 32, 64, 64),
+    (256, 160, 320, 32, 128, 128),
+    "pool",
+    (256, 160, 320, 32, 128, 128),
+    (384, 192, 384, 48, 128, 128),
+]
+
+
+def build(layout):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    if layout == "NHWC":
+        dn = ("NHWC", "HWIO", "NHWC")
+        caxis = 3
+        pool_win = (1, 3, 3, 1)
+    else:
+        dn = ("NCHW", "OIHW", "NCHW")
+        caxis = 1
+        pool_win = (1, 1, 3, 3)
+
+    def conv(x, w, stride=1, pad=0):
+        return lax.conv_general_dilated(
+            x, w, (stride, stride), [(pad, pad), (pad, pad)],
+            dimension_numbers=dn)
+
+    def maxpool(x, k=3, stride=2, pad=0):
+        strides = ((1, stride, stride, 1) if caxis == 3
+                   else (1, 1, stride, stride))
+        padding = [(0, 0), (pad, pad), (pad, pad), (0, 0)] if caxis == 3 \
+            else [(0, 0), (0, 0), (pad, pad), (pad, pad)]
+        return lax.reduce_window(x, -jnp.inf, lax.max, pool_win,
+                                 strides, padding)
+
+    rs = np.random.RandomState(0)
+
+    def wshape(kh, kw, cin, cout):
+        if layout == "NHWC":
+            return (kh, kw, cin, cout)
+        return (cout, cin, kh, kw)
+
+    def mkw(kh, kw, cin, cout):
+        return jnp.asarray(
+            rs.randn(*wshape(kh, kw, cin, cout)).astype(np.float32)
+            * (1.0 / np.sqrt(kh * kw * cin)), jnp.bfloat16)
+
+    params = {}
+    params["stem1"] = mkw(7, 7, 3, 64)
+    params["stem2r"] = mkw(1, 1, 64, 64)
+    params["stem2"] = mkw(3, 3, 64, 192)
+    cin = 192
+    for i, m in enumerate(MODULES):
+        if m == "pool":
+            continue
+        c1, c3r, c3, c5r, c5, cp = m
+        params[f"m{i}_1"] = mkw(1, 1, cin, c1)
+        params[f"m{i}_3r"] = mkw(1, 1, cin, c3r)
+        params[f"m{i}_3"] = mkw(3, 3, c3r, c3)
+        params[f"m{i}_5r"] = mkw(1, 1, cin, c5r)
+        params[f"m{i}_5"] = mkw(5, 5, c5r, c5)
+        params[f"m{i}_p"] = mkw(1, 1, cin, cp)
+        cin = c1 + c3 + c5 + cp
+    params["fc"] = jnp.asarray(
+        rs.randn(cin, 1000).astype(np.float32) * 0.02, jnp.bfloat16)
+
+    import jax.nn
+
+    def fwd(params, x, labels):
+        r = jax.nn.relu
+        x = r(conv(x, params["stem1"], 2, 3))
+        x = maxpool(x)
+        x = r(conv(x, params["stem2r"]))
+        x = r(conv(x, params["stem2"], 1, 1))
+        x = maxpool(x)
+        for i, m in enumerate(MODULES):
+            if m == "pool":
+                x = maxpool(x)
+                continue
+            t1 = r(conv(x, params[f"m{i}_1"]))
+            t3 = r(conv(r(conv(x, params[f"m{i}_3r"])),
+                        params[f"m{i}_3"], 1, 1))
+            t5 = r(conv(r(conv(x, params[f"m{i}_5r"])),
+                        params[f"m{i}_5"], 1, 2))
+            tp = r(conv(maxpool(x, 3, 1, 1), params[f"m{i}_p"]))
+            x = jnp.concatenate([t1, t3, t5, tp], axis=caxis)
+        x = jnp.mean(x, axis=(1, 2) if caxis == 3 else (2, 3))
+        logits = (x @ params["fc"]).astype(jnp.float32)
+        lp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(lp, labels[:, None], 1))
+
+    return params, fwd
+
+
+def run(layout, batch, steps=20):
+    import jax
+    import jax.numpy as jnp
+
+    params, fwd = build(layout)
+    shape = (batch, 224, 224, 3) if layout == "NHWC" \
+        else (batch, 3, 224, 224)
+    rs = np.random.RandomState(1)
+    x = jax.device_put(jnp.asarray(rs.rand(*shape), jnp.bfloat16))
+    labels = jax.device_put(jnp.asarray(
+        rs.randint(0, 1000, (batch,)), jnp.int32))
+
+    @jax.jit
+    def step(params, x, labels):
+        g = jax.grad(fwd)(params, x, labels)
+        return jax.tree.map(lambda p, g: p - 0.01 * g, params, g)
+
+    for _ in range(3):
+        params = step(params, x, labels)
+    float(jnp.sum(params["fc"].astype(jnp.float32)))
+    best = 0.0
+    for _ in range(2):
+        t0 = time.perf_counter()
+        p = params
+        for _ in range(steps):
+            p = step(p, x, labels)
+        float(jnp.sum(p["fc"].astype(jnp.float32)))
+        best = max(best, steps * batch / (time.perf_counter() - t0))
+    return best
+
+
+def main():
+    from cxxnet_tpu.utils import enable_compile_cache
+    enable_compile_cache()
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    for layout in ("NCHW", "NHWC"):
+        ips = run(layout, batch)
+        print(json.dumps({"variant": "googlenet_raw_%s_b%d"
+                          % (layout, batch),
+                          "img_per_sec": round(ips, 1)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
