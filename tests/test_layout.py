@@ -230,6 +230,51 @@ netconfig = end
     np.testing.assert_allclose(feats[0], feats[1], rtol=1e-6, atol=1e-7)
 
 
+def test_conv_tp_zero_channels_last():
+    """channels_last composes with dp x tp (+ ZeRO): conv weights stay
+    reference-OIHW, so the output-channel TP sharding is layout-blind —
+    exactness vs the single-device NCHW net."""
+    conf = """
+netconfig = start
+layer[+1:c1] = conv:c1
+  kernel_size = 3
+  pad = 1
+  nchannel = 8
+  random_type = xavier
+layer[+1] = relu
+layer[+1:c2] = conv:c2
+  kernel_size = 3
+  pad = 1
+  nchannel = 8
+  random_type = xavier
+layer[+1] = relu
+layer[+1] = flatten
+layer[+1:fc] = fullc:fc
+  nhidden = 6
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig = end
+"""
+    tr = _trainer(conf, (3, 8, 8), 16,
+                  extra="dev = cpu:0-7\nmodel_parallel = 2\n"
+                        "update_on_server = 1\nchannels_last = 1\n")
+    ref = _trainer(conf, (3, 8, 8), 16, extra="channels_last = 0\n")
+    c1 = next(i for i, lay in enumerate(tr.net.layers)
+              if getattr(lay, "type_name", "") == "conv")
+    assert "model" in str(tr._tp_shardings[c1]["wmat"].spec)
+    b = _batch((3, 8, 8), 16, 6)
+    for _ in range(2):
+        tr.update(b)
+        ref.update(b)
+    from cxxnet_tpu.parallel import fetch_global
+    for i in range(len(ref.params)):
+        for k in ref.params[i]:
+            np.testing.assert_allclose(
+                np.asarray(fetch_global(tr.params[i][k])),
+                np.asarray(jax.device_get(ref.params[i][k])),
+                rtol=2e-5, atol=2e-6, err_msg="layer %d key %s" % (i, k))
+
+
 def test_pipeline_parallel_channels_last():
     """channels_last composes with pipeline_parallel: stage streams carry
     NCHW bytes, stages re-enter NHWC internally."""

@@ -183,6 +183,63 @@ def main():
     finally:
         os.environ.pop("CXXNET_RING", None)
 
+    # --- channels_last conv-stack layout, compiled on-chip -------------
+    # one bf16 train step of a conv->relu->lrn->bn->relu_max_pooling net
+    # with channels_last forced BOTH ways; first-conv weights after the
+    # step must agree — the on-chip compile/parity smoke for the NHWC
+    # paths this chain hits (full per-layer coverage incl. ch_concat and
+    # the sibling fusion is tests/test_layout.py on the CPU mesh)
+    from cxxnet_tpu.nnet.trainer import Trainer
+    from cxxnet_tpu.utils.config import parse_config_string
+    from cxxnet_tpu.io.data import DataBatch
+    cl_conf = """
+netconfig = start
+layer[0->1] = conv:k1
+  kernel_size = 5
+  stride = 2
+  nchannel = 32
+  random_type = xavier
+layer[1->2] = relu
+layer[2->3] = lrn
+  local_size = 5
+  alpha = 0.0001
+  beta = 0.75
+layer[3->4] = batch_norm:kb
+layer[4->5] = relu_max_pooling
+  kernel_size = 3
+  stride = 2
+layer[5->6] = flatten
+layer[6->7] = fullc:kf
+  nhidden = 10
+  init_sigma = 0.01
+layer[7->7] = softmax
+netconfig = end
+input_shape = 3,63,63
+batch_size = 16
+eta = 0.05
+eval_train = 0
+compute_dtype = bfloat16
+dev = tpu
+"""
+    db = DataBatch()
+    db.data = rs.rand(16, 3, 63, 63).astype(np.float32)
+    db.label = (rs.randint(0, 10, (16, 1))).astype(np.float32)
+    db.batch_size = 16
+    weights = []
+    for cl in (0, 1):
+        t2 = Trainer()
+        for k, v in parse_config_string(
+                cl_conf + "channels_last = %d\n" % cl):
+            t2.set_param(k, v)
+        t2.init_model()
+        t2.update(db)
+        weights.append(np.asarray(
+            jax.device_get(t2.params[0]["wmat"]), np.float32))
+    assert np.isfinite(weights[0]).all() and np.isfinite(weights[1]).all()
+    # bf16 step, different physical layouts: close, not bitwise
+    np.testing.assert_allclose(weights[0], weights[1], rtol=2e-2, atol=2e-4)
+    print("channels_last train-step parity on-chip: OK")
+
     print("ALL TPU KERNEL CHECKS PASSED")
 
 
