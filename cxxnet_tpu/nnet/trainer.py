@@ -65,6 +65,7 @@ class Trainer:
         self.fuse_sibling_convs = 1  # sibling-conv fusion pass (net.py)
         self.channels_last = -1     # NHWC conv-stack layout: -1 auto
         #                             (on for TPU backends), 0/1 force
+        self.fsdp = 0               # ZeRO-3 param sharding over data
         self.clip_global_norm = 0.0  # 0 -> off (per-tensor clip_gradient
         #                              remains the reference-parity knob)
         self.metric = MetricSet()
@@ -115,6 +116,8 @@ class Trainer:
             self.fuse_sibling_convs = int(val)
         if name == "channels_last":
             self.channels_last = int(val)
+        if name == "fsdp":
+            self.fsdp = int(val)
         if name == "clip_global_norm":
             self.clip_global_norm = float(val)
         if name == "compute_dtype":
@@ -225,15 +228,33 @@ class Trainer:
     def _place_params(self) -> None:
         """Tensor/expert-parallel placement: device_put params (and matching
         opt state) with the model/ep-axis shardings; GSPMD partitions the
-        matmuls (shard_map consumes the ep placements directly)."""
+        matmuls (shard_map consumes the ep placements directly). With
+        ``fsdp = 1`` the placements additionally split each weight over the
+        data axis (ZeRO-3): GSPMD all-gathers weights just-in-time and
+        reduce-scatters gradients, so param/grad/opt memory scales 1/dp."""
         self._tp_shardings = None
-        if self.mesh is None or not (
-                "model" in self.mesh.axis_names
-                or "ep" in self.mesh.axis_names):
+        self._fsdp_shardings = None
+        check(not (self.fsdp and self.pipeline_parallel > 1),
+              "fsdp does not compose with pipeline_parallel (stage "
+              "packing already owns the parameter placement)")
+        if self.mesh is None:
             return
-        from ..parallel.sharding import param_shardings
-        shards = param_shardings(self.mesh, self.net.layers, self.params)
-        self._tp_shardings = shards
+        # with dp == 1 there is nothing to shard over — fsdp degenerates
+        # to plain placement (callers can assert on _fsdp_shardings)
+        use_fsdp = bool(self.fsdp) and "data" in self.mesh.axis_names \
+            and self.mesh.shape["data"] > 1
+        if not use_fsdp and not ("model" in self.mesh.axis_names
+                                 or "ep" in self.mesh.axis_names):
+            return
+        from ..parallel.sharding import fsdp_shardings, param_shardings
+        shards = None
+        if "model" in self.mesh.axis_names or "ep" in self.mesh.axis_names:
+            shards = param_shardings(self.mesh, self.net.layers, self.params)
+            self._tp_shardings = shards
+        if use_fsdp:
+            shards = fsdp_shardings(self.mesh, self.net.layers,
+                                    self.params, base_shardings=shards)
+            self._fsdp_shardings = shards
         self.params = [
             {k: jax.device_put(jnp.asarray(v), shards[i][k])
              for k, v in p.items()}
@@ -684,7 +705,35 @@ class Trainer:
             new_opt[-1][self._PACKED] = {
                 sk: jax.lax.with_sharding_constraint(v, sh)
                 for sk, v in new_spk.items()}
-        if self.mesh is not None and self.update_on_server:
+        fsdp_sh = getattr(self, "_fsdp_shardings", None)
+        if fsdp_sh is not None:
+            # ZeRO-3: the updated weights and their opt state keep the
+            # fsdp placement (grads arrive reduce-scattered to it; the
+            # elementwise update never leaves the shard). Tensors fsdp
+            # leaves replicated (1-D biases/norm scales, non-divisible
+            # weights) still get their opt state ZeRO-sharded, so the
+            # mode strictly subsumes update_on_server
+            from ..parallel.sharding import zero_sharding
+            for i, sh_map in enumerate(fsdp_sh):
+                for key, sh in sh_map.items():
+                    if key in new_params[i]:
+                        new_params[i][key] = \
+                            jax.lax.with_sharding_constraint(
+                                new_params[i][key], sh)
+                    if key not in new_opt[i]:
+                        continue
+                    if any(a is not None for a in sh.spec):
+                        new_opt[i][key] = jax.tree.map(
+                            lambda x, sh=sh:
+                            jax.lax.with_sharding_constraint(x, sh)
+                            if getattr(x, "ndim", 0) == len(sh.spec) else x,
+                            new_opt[i][key])
+                    else:
+                        new_opt[i][key] = jax.tree.map(
+                            lambda x: jax.lax.with_sharding_constraint(
+                                x, zero_sharding(self.mesh, x)),
+                            new_opt[i][key])
+        elif self.mesh is not None and self.update_on_server:
             from ..parallel.sharding import shard_opt_state_with_specs
             base = getattr(self, "_tp_shardings", None)
             if self._pp_entries is not None:

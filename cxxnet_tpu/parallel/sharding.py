@@ -26,6 +26,25 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def _extend_base_split(mesh: Mesh, shape, base_spec: P, axis: str):
+    """Compose a data-axis split with an existing tensor/expert-parallel
+    placement: extend the split ON THE SAME dim, tp-axis major, so each
+    device's shard nests inside its own TP slice (no cross-shard reshard
+    per step). Works for dim-0 TP (fullc wmat) and later-dim TP (conv
+    output channels). The pipeline's P("pipe", None) packed base keeps its
+    base_spec: dim 0 equals the pipe-axis size, so the joint split never
+    divides. Shared by zero_sharding (opt state) and fsdp_shardings
+    (params) — ONE composition rule, so the two can never drift apart."""
+    n = mesh.shape[axis]
+    d = next(i for i, a in enumerate(base_spec) if a is not None)
+    tp_axis = base_spec[d]
+    if shape[d] % (n * mesh.shape[tp_axis]) == 0:
+        spec = list(base_spec)
+        spec[d] = (tp_axis, axis)
+        return NamedSharding(mesh, P(*spec))
+    return NamedSharding(mesh, base_spec)
+
+
 def zero_sharding(mesh: Mesh, x: Any, axis: str = "data",
                   base_spec: P = None) -> NamedSharding:
     """Sharding for one optimizer-state tensor: split the first dim across
@@ -39,20 +58,7 @@ def zero_sharding(mesh: Mesh, x: Any, axis: str = "data",
     shape = getattr(x, "shape", ())
     if (base_spec and any(a is not None for a in base_spec)
             and len(shape) == len(base_spec)):
-        # extend the TP split with the ZeRO axis on the SAME dim, tp-axis
-        # major, so each device's opt-state shard nests inside its own
-        # param shard (no cross-model-shard reshard per step). Works for
-        # dim-0 TP (fullc wmat) and later-dim TP (conv output channels).
-        # The pipeline's P("pipe", None) packed base keeps its base_spec:
-        # dim 0 equals the pipe-axis size, so the joint split below never
-        # divides and PP opt state stays sharded by stage only.
-        d = next(i for i, a in enumerate(base_spec) if a is not None)
-        tp_axis = base_spec[d]
-        if shape[d] % (n * mesh.shape[tp_axis]) == 0:
-            spec = list(base_spec)
-            spec[d] = (tp_axis, axis)
-            return NamedSharding(mesh, P(*spec))
-        return NamedSharding(mesh, base_spec)
+        return _extend_base_split(mesh, shape, base_spec, axis)
     if len(shape) > 0:
         # no TP placement: the tensor is replicated over EVERY mesh axis,
         # so its optimizer state may shard over all of them jointly (each
@@ -89,6 +95,50 @@ def shard_opt_state_with_specs(mesh: Mesh, opt_state, base_shardings,
 
             d[key] = jax.tree.map(constrain, st)
         out.append(d)
+    return out
+
+
+def fsdp_shardings(mesh: Mesh, layers, params, base_shardings=None,
+                   axis: str = "data"):
+    """Fully-sharded data parallelism (trainer key ``fsdp``): the params
+    THEMSELVES are sharded over the data axis — GSPMD all-gathers each
+    weight just-in-time for its op and reduce-scatters its gradient, so
+    per-device param+grad+opt memory scales 1/dp (ZeRO-3; the logical
+    end point of the reference's bigarray handling,
+    src/updater/async_updater-inl.hpp:165-174, which kept big tensors
+    server-side and pulled them on demand).
+
+    Per tensor: split the first dim divisible by the data-axis size,
+    composing with an existing tensor/expert-parallel placement on the
+    same dim (tp-major, like zero_sharding). Skipped: 1-D tensors
+    (biases/norm scales — sharding saves nothing and complicates their
+    broadcasts) and non-trainable state (BN running stats; direct
+    assignment in the step stays trivially replicated)."""
+    n = mesh.shape[axis]
+    out = []
+    for i, (lay, p) in enumerate(zip(layers, params)):
+        shard = {}
+        state = set(lay.state_keys()) if hasattr(lay, "state_keys") else ()
+        for key, val in p.items():
+            base = None
+            if base_shardings is not None and key in base_shardings[i]:
+                base = base_shardings[i][key].spec
+            shape = getattr(val, "shape", ())
+            if key in state or len(shape) < 2 or n <= 1:
+                shard[key] = NamedSharding(mesh, base or P())
+                continue
+            if base is not None and any(a is not None for a in base):
+                shard[key] = _extend_base_split(mesh, shape, base, axis)
+                continue
+            for d in range(len(shape)):
+                if shape[d] % n == 0 and shape[d] >= n:
+                    spec = [None] * len(shape)
+                    spec[d] = axis
+                    shard[key] = NamedSharding(mesh, P(*spec))
+                    break
+            else:
+                shard[key] = NamedSharding(mesh, P())
+        out.append(shard)
     return out
 
 
