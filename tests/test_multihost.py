@@ -114,6 +114,30 @@ pred3 = tr3.predict(b3)          # shard-fed predict returns GLOBAL rows
 assert pred3.shape == (16,)
 print("RANK%%d_SHARD_OK" %% rank)
 
+# fsdp across processes: params shard over the data axis spanning BOTH
+# hosts (1/8 addressable), numerics match the replicated run, and
+# save_model gathers the cross-process shards through fetch_global
+tr5 = Trainer()
+for k, v in parse_config_string(conf + "fsdp = 1\\n"):
+    tr5.set_param(k, v)
+tr5.init_model()
+w5 = tr5.params[0]["wmat"]
+assert np.asarray(w5.addressable_shards[0].data).size * 8 == w5.size, \
+    w5.sharding
+for _ in range(5):
+    tr5.update(b)
+w5 = tr5.params[0]["wmat"]
+assert np.asarray(w5.addressable_shards[0].data).size * 8 == w5.size, \
+    w5.sharding
+from cxxnet_tpu.parallel import fetch_global
+w5_full = np.asarray(fetch_global(w5))
+np.testing.assert_allclose(w5_full[:, :], np.asarray(
+    fetch_global(tr.params[0]["wmat"])), rtol=1e-6, atol=1e-7)
+w = serializer.Writer()
+tr5.save_model(w)
+assert len(w.getvalue()) > 1000
+print("RANK%%d_FSDP_OK" %% rank)
+
 # hybrid DCN x ICI mesh: with model_parallel the trainer auto-builds the
 # mesh so TP pairs stay INSIDE a process (ICI) while the data axis spans
 # the two processes (DCN) — parallel.create_hybrid_mesh wired end-to-end
@@ -206,6 +230,7 @@ def test_two_process_distributed_training(tmp_path):
         assert ("RANK%d_OK" % r) in out
         assert ("RANK%d_SAVE_OK" % r) in out
         assert ("RANK%d_SHARD_OK" % r) in out
+        assert ("RANK%d_FSDP_OK" % r) in out
         assert ("RANK%d_HYBRID_OK" % r) in out
         assert ("RANK%d_PP_OK" % r) in out
 

@@ -1,7 +1,8 @@
 #!/usr/bin/env python
 """Per-model HBM memory report from XLA's compiled-program analysis.
 
-Usage: python tools/memory_report.py [model] [--pp K|--zero|--tp K] [n_devices]
+Usage: python tools/memory_report.py [model]
+           [--pp K|--zero|--fsdp|--tp K] [n_devices]
 
 Compiles the model's train step (without executing it) and prints XLA's
 memory_analysis(): argument (param/opt-state) bytes, temp (activation)
@@ -96,6 +97,8 @@ def main():
             consumed.add(i + 1)
     if "--zero" in args:
         extra += "update_on_server = 1\n"
+    if "--fsdp" in args:
+        extra += "fsdp = 1\n"
     tail = [a for i, a in enumerate(args)
             if i > 0 and i not in consumed and a.isdigit()]
     ndev = int(tail[-1]) if tail else None
