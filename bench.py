@@ -266,6 +266,34 @@ def bench_transformer_lm_long():
         attn_extra="nkvhead = 2\nattn_window = 1024\nrope = 1\n")
 
 
+def bench_alexnet_infer():
+    """Inference throughput (the reference's `pred` task shape): forward
+    only, argmax on device, batch 256 bf16."""
+    import jax
+    from cxxnet_tpu.models import alexnet_trainer
+    from cxxnet_tpu.io.data import DataBatch
+    batch = 256
+    tr = alexnet_trainer(batch_size=batch, input_hw=227, dev="tpu",
+                         extra_cfg=BF16)
+    rs = np.random.RandomState(0)
+    b = DataBatch()
+    b.data = jax.device_put(rs.rand(batch, 3, 227, 227).astype(np.float32))
+    b.label = jax.device_put(np.zeros((batch, 1), np.float32))
+    b.batch_size = batch
+    for _ in range(3):
+        tr.predict(b)
+    best = 0.0
+    for _ in range(2):
+        t0 = time.perf_counter()
+        n = 20
+        for _ in range(n):
+            pred = tr.predict(b)   # device_get inside forces the sync
+        best = max(best, n * batch / (time.perf_counter() - t0))
+    return {"metric": "alexnet_infer_images_per_sec_per_chip",
+            "value": round(best, 2), "unit": "images/sec/chip",
+            "vs_baseline": None}
+
+
 def bench_mnist_mlp():
     tr = _conf_trainer(MNIST_MLP, (1, 1, 784), 100, extra=BF16)
     ips = _throughput(tr, (1, 1, 784), 10, 100, steps=100)
@@ -427,7 +455,7 @@ def _bench_main():
         for fn in (bench_mnist_mlp, bench_mnist_conv, bench_bowl,
                    bench_googlenet, bench_resnet, bench_vgg,
                    bench_transformer_lm, bench_transformer_lm_long,
-                   bench_alexnet_b1024):
+                   bench_alexnet_b1024, bench_alexnet_infer):
             print(json.dumps(fn()), flush=True)
     if len(sys.argv) > 1 and sys.argv[1] in ("all", "pipeline"):
         for line in bench_alexnet_pipeline():

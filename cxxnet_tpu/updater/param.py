@@ -4,8 +4,10 @@ Mirrors src/updater/param.h:13-136, including:
 * tag-scoped overrides — ``wmat:lr = 0.01`` applies only to updaters whose
   tag is ``wmat`` (param.h:100-104)
 * schedules (param.h:76-95): constant / expdecay / polydecay / factor
-* unconditional clamp of momentum to final_momentum and of lr to lr_minimum
-  (reference behavior, reproduced)
+* clamp of momentum to final_momentum and of lr to lr_minimum (reference
+  behavior — with one deliberate fix: the floor never RAISES lr above the
+  requested eta, so fine-tuning LRs below the 1e-5 default minimum are
+  honored instead of silently clamped up)
 
 schedule_epoch() is jit-safe: ``epoch`` may be a traced jnp scalar, so one
 compiled train step serves every epoch without recompilation.
@@ -118,7 +120,10 @@ class UpdaterParam:
             momentum = self.base_momentum + \
                 (self.final_momentum - self.base_momentum) / self.saturation_epoch * e
         momentum = jnp.minimum(momentum, self.final_momentum)
-        lr = jnp.maximum(lr, self.lr_minimum)
+        # floor at lr_minimum, but never above the requested base lr (a
+        # base_lr below the 1e-5 default minimum must be honored exactly —
+        # fine-tuning at eta = 3e-6 would otherwise silently run 1e-5)
+        lr = jnp.maximum(lr, min(self.lr_minimum, self.base_lr))
         lr = jnp.where(e < self.start_epoch, self.base_lr, lr)
         if self.lr_warmup > 0:
             # linear ramp 0 -> scheduled lr over the first lr:warmup updates

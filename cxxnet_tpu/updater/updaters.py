@@ -59,6 +59,17 @@ class Updater:
 
     def set_param(self, name: str, val: str) -> None:
         self.param.set_param(name, val)
+        # tag-scoped override for subclass keys too: "wmat:beta1" reaches
+        # AdamUpdater/AdamWUpdater as "beta1" (UpdaterParam strips the
+        # prefix only for its own fields)
+        tag = self.param.tag
+        if tag and name.startswith(tag + ":"):
+            name = name[len(tag) + 1:]
+        self._set_extra(name, val)
+
+    def _set_extra(self, name: str, val: str) -> None:
+        """Subclass hook for optimizer-specific keys (tag prefix already
+        stripped)."""
 
     def init_state(self, w: np.ndarray) -> Dict[str, np.ndarray]:
         return {}
@@ -107,8 +118,7 @@ class AdamUpdater(Updater):
         self.decay1 = 0.1
         self.decay2 = 0.001
 
-    def set_param(self, name, val):
-        super().set_param(name, val)
+    def _set_extra(self, name, val):
         if name == "beta1":
             self.decay1 = float(val)
         if name == "beta2":
@@ -132,7 +142,50 @@ class AdamUpdater(Updater):
         return w, {"m1": m1, "m2": m2}
 
 
-_KINDS = {"sgd": SGDUpdater, "nag": NAGUpdater, "adam": AdamUpdater}
+class AdamWUpdater(Updater):
+    """AdamW (beyond the reference): decoupled weight decay — wd scales the
+    weight directly instead of entering the moment estimates (Loshchilov &
+    Hutter 2019) — with the standard beta convention (beta1/beta2 are the
+    RETENTION rates, defaults 0.9/0.999) and the scheduled lr, so cosine /
+    warmup / tag-scoped overrides compose. The transformer-LM recipe's
+    optimizer; ``updater = adam`` stays the reference formulation."""
+
+    kind = "adamw"
+
+    def __init__(self, tag: str):
+        super().__init__(tag)
+        self.beta1 = 0.9
+        self.beta2 = 0.999
+        self.eps = 1e-8
+
+    def _set_extra(self, name, val):
+        if name == "beta1":
+            self.beta1 = float(val)
+        if name == "beta2":
+            self.beta2 = float(val)
+        if name == "adam_eps":
+            self.eps = float(val)
+
+    def init_state(self, w):
+        return {"m1": np.zeros_like(w, dtype=np.float32),
+                "m2": np.zeros_like(w, dtype=np.float32)}
+
+    def apply(self, w, g, state, epoch):
+        p = self.param
+        lr, _ = p.schedule_epoch(epoch)
+        if p.clip_gradient != 0.0:
+            g = _clip_nan(g, p.clip_gradient)
+        e = jnp.asarray(epoch, jnp.float32)
+        m1 = self.beta1 * state["m1"] + (1.0 - self.beta1) * g
+        m2 = self.beta2 * state["m2"] + (1.0 - self.beta2) * jnp.square(g)
+        mhat = m1 / (1.0 - jnp.power(self.beta1, e + 1))
+        vhat = m2 / (1.0 - jnp.power(self.beta2, e + 1))
+        w = w - lr * (mhat / (jnp.sqrt(vhat) + self.eps) + p.wd * w)
+        return w, {"m1": m1, "m2": m2}
+
+
+_KINDS = {"sgd": SGDUpdater, "nag": NAGUpdater, "adam": AdamUpdater,
+          "adamw": AdamWUpdater}
 
 
 def create_updater(kind: str, tag: str) -> Updater:

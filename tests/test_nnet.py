@@ -213,6 +213,85 @@ def test_adam_reference_semantics():
     np.testing.assert_allclose(np.asarray(w1), expect, rtol=1e-5)
 
 
+def test_adamw_decoupled_decay():
+    up = create_updater("adamw", "wmat")
+    up.set_param("eta", "0.01")
+    up.set_param("wd", "0.1")
+    w = np.ones((2,), np.float32)
+    g = np.full((2,), 2.0, np.float32)
+    st = up.init_state(w)
+    w1, st1 = up.apply(jnp.asarray(w), jnp.asarray(g), st, 0)
+    # standard AdamW: m=0.1*2, v=0.001*4, bias-corrected; wd scales w
+    # directly (decoupled), NOT folded into the gradient like 'adam'
+    mhat = (0.1 * 2.0) / (1 - 0.9)
+    vhat = (0.001 * 4.0) / (1 - 0.999)
+    expect = 1 - 0.01 * (mhat / (np.sqrt(vhat) + 1e-8) + 0.1 * 1.0)
+    np.testing.assert_allclose(np.asarray(w1), expect, rtol=1e-5)
+    # second step exercises the state carry
+    w2, _ = up.apply(w1, jnp.asarray(g), st1, 1)
+    assert np.all(np.asarray(w2) < np.asarray(w1))
+
+
+def test_adamw_e2e_trains():
+    from cxxnet_tpu.nnet.trainer import Trainer
+    from cxxnet_tpu.io.data import DataBatch
+    conf = """
+netconfig = start
+layer[+1] = fullc:fc1
+  nhidden = 32
+  init_sigma = 0.1
+layer[+1] = relu
+layer[+1] = fullc:fc2
+  nhidden = 4
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig = end
+input_shape = 1,1,8
+batch_size = 16
+updater = adamw
+eta = 0.01
+wd = 0.01
+"""
+    tr = Trainer()
+    for k, v in parse_config_string(conf):
+        tr.set_param(k, v)
+    tr.init_model()
+    rs = np.random.RandomState(0)
+    b = DataBatch()
+    b.data = rs.rand(16, 1, 1, 8).astype(np.float32)
+    b.label = rs.randint(0, 4, (16, 1)).astype(np.float32)
+    b.batch_size = 16
+    for _ in range(60):
+        tr.update(b)
+    pred = tr.predict(b)
+    acc = float(np.mean(pred == b.label[:, 0]))
+    assert acc >= 0.9, acc
+
+
+def test_tag_scoped_optimizer_keys():
+    """'wmat:beta1' must reach the adam-family updaters with the tag
+    stripped (regression: subclasses compared the raw key)."""
+    up = create_updater("adamw", "wmat")
+    up.set_param("wmat:beta1", "0.95")
+    up.set_param("bias:beta2", "0.5")    # other tag: ignored
+    assert up.beta1 == 0.95
+    assert up.beta2 == 0.999
+    up2 = create_updater("adam", "bias")
+    up2.set_param("bias:beta1", "0.2")
+    assert up2.decay1 == 0.2
+
+
+def test_small_lr_not_clamped_up():
+    """eta below the 1e-5 default lr_minimum is honored exactly — the
+    floor never raises lr above the requested base (regression: 3e-6
+    silently became 1e-5)."""
+    up = create_updater("sgd", "wmat")
+    up.set_param("eta", "3e-6")
+    up.set_param("momentum", "0.0")
+    lr, _ = up.param.schedule_epoch(0)
+    np.testing.assert_allclose(float(lr), 3e-6, rtol=1e-6)
+
+
 def test_lr_schedules():
     up = create_updater("sgd", "wmat")
     up.set_param("eta", "0.1")
