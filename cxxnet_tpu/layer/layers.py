@@ -1092,15 +1092,25 @@ class AttentionLayer(Layer):
         # ABI (and per-tag updater scoping, e.g. wo:lr) can reach both
         return [("wmat", "wqkv"), ("wo", "wo")]
 
+    layout_support = "nhwc"
+
     def apply(self, params, inputs, ctx):
         from ..parallel import (attention_reference, ring_attention,
                                 ulysses_attention)
         x = inputs[0]
-        b, d, _, L = x.shape
+        if ctx.channels_last:
+            # physical (b, 1, L, d) for logical (b, d, 1, L): (b, L, d) is
+            # a pure reshape — channels-last IS attention's native layout,
+            # and the whole transformer block chain (embed-out conversion
+            # aside) then flows NHWC with zero per-block transposes
+            b, _, L, d = x.shape
+            seq = x.reshape(b, L, d)
+        else:
+            b, d, _, L = x.shape
+            seq = x.reshape(b, d, L).transpose(0, 2, 1)      # (b, L, d)
         nh, dh = self.nhead, d // self.nhead
         nkv = self.nkvhead or nh
         kvw = self._kv_width(d)
-        seq = x.reshape(b, d, L).transpose(0, 2, 1)          # (b, L, d)
         qkv = jnp.dot(seq, params["wqkv"])            # (b, L, d + 2*kvw)
         q = qkv[..., :d]
         k = qkv[..., d:d + kvw]
@@ -1166,6 +1176,8 @@ class AttentionLayer(Layer):
                                       window=self.attn_window)
         out = out.transpose(0, 2, 1, 3).reshape(b, L, d)      # merge heads
         out = jnp.dot(out, params["wo"])
+        if ctx.channels_last:
+            return [out.reshape(b, 1, L, d)]
         return [out.transpose(0, 2, 1).reshape(b, d, 1, L)]
 
 

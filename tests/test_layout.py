@@ -230,6 +230,60 @@ netconfig = end
     np.testing.assert_allclose(feats[0], feats[1], rtol=1e-6, atol=1e-7)
 
 
+def test_transformer_lm_channels_last_exact():
+    """The transformer stack under channels_last: attention runs natively
+    on (b, L, d) (physical NHWC of the logical (b, d, 1, L) node), the
+    conv-as-FFN flows NHWC, and numerics match the NCHW run exactly."""
+    from cxxnet_tpu.models import transformer_lm_netconfig
+    conf = transformer_lm_netconfig(20, dim=16, nhead=4, nlayer=2,
+                                    attn_extra="rope = 1\n")
+    conf += ("input_shape = 1,1,12\nbatch_size = 8\n"
+             "label_vec[0,12) = label\nupdater = adamw\neta = 0.003\n")
+    outs = []
+    for cl in (0, 1):
+        tr = Trainer()
+        for k, v in parse_config_string(
+                conf + "channels_last = %d\n" % cl):
+            tr.set_param(k, v)
+        tr.init_model()
+        rs = np.random.RandomState(0)
+        b = DataBatch()
+        b.data = rs.randint(0, 20, (8, 1, 1, 12)).astype(np.float32)
+        b.label = rs.randint(0, 20, (8, 12)).astype(np.float32)
+        b.batch_size = 8
+        for _ in range(3):
+            tr.update(b)
+        outs.append(_flat_params(tr))
+    np.testing.assert_allclose(outs[0], outs[1], rtol=2e-5, atol=2e-6)
+
+
+def test_attention_sp_channels_last():
+    """seq_parallel (ring attention) composed with channels_last matches
+    the single-device NCHW run."""
+    conf = """
+netconfig = start
+layer[+1:att1] = attention:att1
+  nhead = 4
+  causal = 1
+  init_sigma = 0.1
+layer[+1] = flatten
+layer[+1:head] = fullc:head
+  nhidden = 5
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig = end
+"""
+    outs = []
+    for extra in ("channels_last = 0\n",
+                  "channels_last = 1\nseq_parallel = 2\ndev = cpu:0-1\n"):
+        tr = _trainer(conf, (16, 1, 8), 8, extra=extra)
+        b = _batch((16, 1, 8), 8, 5, seed=1)
+        for _ in range(2):
+            tr.update(b)
+        outs.append(_flat_params(tr))
+    np.testing.assert_allclose(outs[0], outs[1], rtol=2e-5, atol=2e-6)
+
+
 def test_conv_tp_zero_channels_last():
     """channels_last composes with dp x tp (+ ZeRO): conv weights stay
     reference-OIHW, so the output-channel TP sharding is layout-blind —
