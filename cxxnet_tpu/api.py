@@ -172,6 +172,17 @@ class Net:
         assert self.net_ is not None, "model not initialized"
         return self.net_.extract_feature(self._resolve_batch(data), name)
 
+    def export(self, fname: str, node_name: str = "",
+               batch_size: int = 0) -> None:
+        """Write the inference forward as a self-contained StableHLO
+        artifact (params baked in); reload anywhere with
+        `load_exported(fname)` — no framework, config, or model file
+        needed at serving time."""
+        assert self.net_ is not None, "model not initialized"
+        with open(fname, "wb") as f:
+            f.write(self.net_.export_forward(node_name=node_name,
+                                             batch_size=batch_size))
+
     # -- weight io ----------------------------------------------------
     def set_weight(self, weight: np.ndarray, layer_name: str,
                    tag: str = "wmat") -> None:
@@ -184,6 +195,22 @@ class Net:
         assert self.net_ is not None, "model not initialized"
         weight, _shape = self.net_.get_weight(layer_name, tag)
         return np.asarray(weight)
+
+
+def load_exported(fname: str):
+    """Load a `Net.export` / `task = export` StableHLO artifact and return
+    a callable `fn(data) -> np.ndarray` (fixed batch shape, params baked
+    in). Runs on whatever jax backend is active — the serving side needs
+    jax only, none of this framework."""
+    from jax import export as jexport
+    with open(fname, "rb") as f:
+        exp = jexport.deserialize(f.read())
+
+    def fn(data) -> np.ndarray:
+        return np.asarray(exp.call(np.asarray(data, np.float32)))
+
+    fn.in_avals = exp.in_avals
+    return fn
 
 
 def train(cfg: str, data, num_round: int,

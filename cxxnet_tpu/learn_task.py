@@ -48,6 +48,8 @@ class LearnTask:
         self.name_pred = "pred.txt"
         self.print_step = 100
         self.extract_node_name = ""
+        self.name_export = "model.stablehlo"
+        self.export_batch = 0
         self.output_format = 1
         self.device = "tpu"
         # multi-host launch (replaces the reference's PS/MPI launcher,
@@ -85,6 +87,8 @@ class LearnTask:
             self.task_predict_raw()
         elif self.task == "extract":
             self.task_extract_feature()
+        elif self.task == "export":
+            self.task_export()
         return 0
 
     def set_param(self, name: str, val: str) -> None:
@@ -128,6 +132,10 @@ class LearnTask:
             self.worker_rank = int(val)
         if name == "extract_node_name":
             self.extract_node_name = val
+        if name == "export_out":
+            self.name_export = val
+        if name == "export_batch":
+            self.export_batch = int(val)
         if name == "output_format":
             self.output_format = 1 if val == "txt" else 0
         self.cfg.append((name, val))
@@ -235,10 +243,10 @@ class LearnTask:
                 continue
             if name == "iter" and val == "end":
                 assert flag != 0, "wrong configuration file"
-                if flag == 1 and self.task != "pred":
+                if flag == 1 and self.task not in ("pred", "export"):
                     assert self.itr_train is None, "can only have one data"
                     self.itr_train = create_iterator(itcfg)
-                if flag == 2 and self.task != "pred":
+                if flag == 2 and self.task not in ("pred", "export"):
                     self.itr_evals.append(create_iterator(itcfg))
                     self.eval_names.append(evname)
                 if flag == 3 and self.task in ("pred", "pred_raw", "extract"):
@@ -381,6 +389,21 @@ class LearnTask:
         with open(name_meta, "w") as fm:
             fm.write("%d,%d,%d,%d\n" % (nrow, dshape[0], dshape[1], dshape[2]))
         print("finished prediction, write into %s" % self.name_pred)
+
+    def task_export(self) -> None:
+        """task = export: AOT-compile the inference forward (params baked
+        in) into a self-contained StableHLO artifact at export_out.
+        extract_node_name selects a named node / top[-k] (default: the
+        last node, the pred surface); export_batch overrides the batch
+        dimension (default batch_size). Reload anywhere with
+        cxxnet_tpu.api.load_exported — serving needs jax only."""
+        blob = self.net_trainer.export_forward(
+            node_name=self.extract_node_name,
+            batch_size=self.export_batch)
+        with open(self.name_export, "wb") as fo:
+            fo.write(blob)
+        print("exported forward (%d bytes) into %s"
+              % (len(blob), self.name_export))
 
 
 def main(argv: List[str]) -> int:

@@ -907,20 +907,65 @@ class Trainer:
             return np.argmax(out, axis=1).astype(np.float32)
         return out[:, 0]
 
-    def extract_feature(self, batch, node_name: str) -> np.ndarray:
+    def _resolve_node(self, node_name: str) -> int:
+        """Node id from a name or a top[-k] offset (reference
+        ExtractFeature resolution, nnet_impl-inl.hpp:204-215)."""
         m = re.match(r"top\[-(\d+)\]$", node_name)
         if m:
             offset = int(m.group(1))
             nnode = self.net_cfg.param.num_nodes
             check(1 <= offset <= nnode,
                   "ExtractFeature: offset must be within num_node range")
-            node_id = nnode - offset
-        else:
-            check(node_name in self.net_cfg.node_name_map,
-                  "ExtractFeature: cannot find node name: %s" % node_name)
-            node_id = self.net_cfg.node_name_map[node_name]
-        out = self._forward_nodes(batch, (node_id,))[0]
+            return nnode - offset
+        check(node_name in self.net_cfg.node_name_map,
+              "ExtractFeature: cannot find node name: %s" % node_name)
+        return self.net_cfg.node_name_map[node_name]
+
+    def extract_feature(self, batch, node_name: str) -> np.ndarray:
+        out = self._forward_nodes(batch, (self._resolve_node(node_name),))[0]
         return np.asarray(out)
+
+    def export_forward(self, node_name: str = "", batch_size: int = 0,
+                       compat: bool = True) -> bytes:
+        """AOT-compile-and-serialize the inference forward as a portable
+        StableHLO artifact (jax.export): trained params are baked in as
+        constants, so the artifact is fully self-contained — loadable in
+        any process with `cxxnet_tpu.api.load_exported` (or plain
+        jax.export.deserialize) and runnable WITHOUT the framework, the
+        config file, or the model file. The TPU-native deployment story
+        the reference covered with its C wrapper + model files
+        (wrapper/cxxnet_wrapper.h:36-230): here the whole net is one
+        compiler artifact.
+
+        node_name: "" = the last node (the pred/pred_raw surface), else a
+        named node or top[-k] (the extract surface). batch_size: 0 = the
+        training batch size. compat=True exports with maximum platform
+        compatibility (CPU + TPU lowering).
+        """
+        from jax import export as jexport
+        check(self.params is not None,
+              "export_forward: init_model/load_model first")
+        node_id = (self.net_cfg.param.num_nodes - 1 if not node_name
+                   else self._resolve_node(node_name))
+        bs = batch_size or self.batch_size
+        c, h, w = self.net_cfg.param.input_shape
+        # a serving artifact is single-device: gather any sharded/packed
+        # params to host canonical form and trace a mesh-free forward
+        params = [{k: np.asarray(parallel.fetch_global(v))
+                   for k, v in p.items()}
+                  for p in self.canonical_params()]
+        net = self.net
+
+        def fwd(data):
+            values, _ = net.forward(params, data, train=False,
+                                    rng=jax.random.PRNGKey(0))
+            return values[node_id]
+
+        spec = jax.ShapeDtypeStruct((bs, c, h, w), jnp.float32)
+        platforms = ("cpu", "tpu") if compat else None
+        exp = jexport.export(jax.jit(fwd),
+                             platforms=platforms)(spec)
+        return exp.serialize()
 
     def evaluate(self, iter_eval, data_name: str) -> str:
         """Run metrics over an eval iterator; padding rows dropped

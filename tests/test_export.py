@@ -1,0 +1,147 @@
+"""AOT export (jax.export / StableHLO): the inference forward with params
+baked in becomes a self-contained serving artifact — loadable with jax
+alone, no framework/config/model file. Deployment-story counterpart of the
+reference's C-wrapper-plus-model-file flow (wrapper/cxxnet_wrapper.h).
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu import api
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.nnet.trainer import Trainer
+from cxxnet_tpu.utils.config import parse_config_string
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CONV_NET = """
+netconfig = start
+layer[0->1] = conv:c1
+  kernel_size = 3
+  nchannel = 6
+  random_type = xavier
+layer[1->2] = relu
+layer[2->feat] = max_pooling
+  kernel_size = 2
+  stride = 2
+layer[feat->4] = flatten
+layer[4->5] = fullc:fc
+  nhidden = 4
+  init_sigma = 0.1
+layer[5->5] = softmax
+netconfig = end
+input_shape = 1,10,10
+batch_size = 8
+eta = 0.1
+dev = cpu
+"""
+
+
+def _trained(extra=""):
+    tr = Trainer()
+    for k, v in parse_config_string(CONV_NET + extra):
+        tr.set_param(k, v)
+    tr.init_model()
+    rs = np.random.RandomState(0)
+    b = DataBatch()
+    b.data = rs.rand(8, 1, 10, 10).astype(np.float32)
+    b.label = rs.randint(0, 4, (8, 1)).astype(np.float32)
+    b.batch_size = 8
+    for _ in range(3):
+        tr.update(b)
+    return tr, b
+
+
+def test_export_matches_forward(tmp_path):
+    tr, b = _trained()
+    path = str(tmp_path / "m.stablehlo")
+    with open(path, "wb") as f:
+        f.write(tr.export_forward())
+    fn = api.load_exported(path)
+    got = fn(b.data).reshape(8, -1)
+    want = np.asarray(tr.extract_feature(b, "top[-1]")).reshape(8, -1)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+
+def test_export_named_node_and_batch_override(tmp_path):
+    tr, b = _trained()
+    path = str(tmp_path / "feat.stablehlo")
+    with open(path, "wb") as f:
+        f.write(tr.export_forward(node_name="feat", batch_size=4))
+    fn = api.load_exported(path)
+    got = fn(b.data[:4])
+    b4 = DataBatch()
+    b4.data = b.data[:4]
+    b4.label = b.label[:4]
+    b4.batch_size = 4
+    want = np.asarray(tr.extract_feature(b4, "feat"))
+    np.testing.assert_allclose(np.asarray(got), want[:4],
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_export_channels_last_artifact_is_nchw(tmp_path):
+    """The artifact's contract is reference-NCHW regardless of the
+    internal device layout it was exported under."""
+    tr, b = _trained(extra="channels_last = 1\n")
+    ref, _ = _trained(extra="channels_last = 0\n")
+    path = str(tmp_path / "cl.stablehlo")
+    with open(path, "wb") as f:
+        f.write(tr.export_forward())
+    fn = api.load_exported(path)
+    got = fn(b.data).reshape(8, -1)
+    want = np.asarray(ref.extract_feature(b, "top[-1]")).reshape(8, -1)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_export_runs_without_framework(tmp_path):
+    """The serving side needs jax only: a fresh interpreter that never
+    imports cxxnet_tpu runs the artifact."""
+    tr, b = _trained()
+    path = str(tmp_path / "standalone.stablehlo")
+    with open(path, "wb") as f:
+        f.write(tr.export_forward())
+    np.save(str(tmp_path / "x.npy"), b.data)
+    code = (
+        "import jax, numpy as np\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from jax import export\n"
+        "import sys\n"
+        "assert not any(m.startswith('cxxnet') for m in sys.modules)\n"
+        "exp = export.deserialize(open(%r, 'rb').read())\n"
+        "out = exp.call(np.load(%r))\n"
+        "np.save(%r, np.asarray(out))\n"
+        % (path, str(tmp_path / "x.npy"), str(tmp_path / "y.npy")))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    subprocess.run([sys.executable, "-c", code], check=True, env=env,
+                   timeout=300)
+    got = np.load(str(tmp_path / "y.npy")).reshape(8, -1)
+    want = np.asarray(tr.extract_feature(b, "top[-1]")).reshape(8, -1)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+
+def test_export_cli_task(tmp_path):
+    """task = export through the CLI: train -> save -> export -> load."""
+    from cxxnet_tpu import learn_task
+    tr, b = _trained()
+    model_path = str(tmp_path / "m.model")
+    from cxxnet_tpu.utils import serializer
+    w = serializer.Writer()
+    w.write_int32(0)   # leading net_type int (learn_task._save_model)
+    tr.save_model(w)
+    with open(model_path, "wb") as f:
+        f.write(w.getvalue())
+    conf_path = str(tmp_path / "export.conf")
+    out_path = str(tmp_path / "cli.stablehlo")
+    with open(conf_path, "w") as f:
+        f.write(CONV_NET + "task = export\nmodel_in = %s\n"
+                "export_out = %s\n" % (model_path, out_path))
+    rc = learn_task.main([conf_path])
+    assert rc == 0
+    fn = api.load_exported(out_path)
+    got = fn(b.data).reshape(8, -1)
+    want = np.asarray(tr.extract_feature(b, "top[-1]")).reshape(8, -1)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
