@@ -131,6 +131,38 @@ def _max_pool_bwd(kernel, stride, padding, res, g):
 _max_pool.defvjp(_max_pool_fwd, _max_pool_bwd)
 
 
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _max_pool_pallas(x, kernel, stride, padding):
+    """NHWC max pool whose FORWARD is XLA reduce_window (already optimal)
+    and whose BACKWARD is the fused Pallas pass
+    (pallas_kernels.maxpool_bwd_nhwc) — reference unpool tie semantics at
+    one-VMEM-pass cost, replacing select-and-scatter. Opt-in via
+    CXXNET_POOL=pallas until the on-chip A/B settles the default."""
+    (py, ph_), (px, pw_) = padding
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max,
+        (1, kernel[0], kernel[1], 1), (1, stride, stride, 1),
+        [(0, 0), (py, ph_), (px, pw_), (0, 0)])
+
+
+def _max_pool_pallas_fwd(x, kernel, stride, padding):
+    y = _max_pool_pallas(x, kernel, stride, padding)
+    return y, (x, y)
+
+
+def _max_pool_pallas_bwd(kernel, stride, padding, res, g):
+    from . import pallas_kernels
+    x, y = res
+    (py, ph_), (px, pw_) = padding
+    dx = pallas_kernels.maxpool_bwd_nhwc(
+        x, y, g, kernel, stride, (py, px), (ph_, pw_),
+        interpret=jax.default_backend() != "tpu")
+    return (dx,)
+
+
+_max_pool_pallas.defvjp(_max_pool_pallas_fwd, _max_pool_pallas_bwd)
+
+
 def pool2d(x: jnp.ndarray, mode: str, kernel: Tuple[int, int], stride: int,
            pad: Tuple[int, int] = (0, 0),
            layout: str = "NCHW") -> jnp.ndarray:
@@ -164,7 +196,13 @@ def pool2d(x: jnp.ndarray, mode: str, kernel: Tuple[int, int], stride: int,
         strides = (1, 1, stride, stride)
         padding = [(0, 0), (0, 0), (py, py + ph), (px, px + pw)]
     if mode == "max":
-        if os.environ.get("CXXNET_POOL") == "mask":
+        pool_knob = os.environ.get("CXXNET_POOL")
+        if pool_knob == "pallas" and layout == "NHWC":
+            from . import pallas_kernels
+            if pallas_kernels.maxpool_bwd_supported(x.shape):
+                return _max_pool_pallas(
+                    x, kernel, stride, ((py, py + ph), (px, px + pw)))
+        if pool_knob == "mask":
             # the mask VJP kernel is written for NCHW; wrap for NHWC
             # (opt-in knob — the transposes are acceptable there)
             if layout == "NHWC":

@@ -46,6 +46,7 @@ run() {
 run kernels  900  python tools/check_tpu_kernels.py
 run bench    900  python bench.py
 run layout   2400 python tools/layout_ab.py default
+run poolab   1500 python tools/pool_ab.py
 run mfu      5400 python tools/mfu_experiments.py all
 run pipeline 1200 python bench.py pipeline
 run quality  3600 python tools/quality_run.py

@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""Paired A/B: XLA select-and-scatter max-pool backward (default) vs the
+fused Pallas backward (CXXNET_POOL=pallas) on GoogLeNet — the pool-heavy
+bench model (select-and-scatter measured ~20% of its NCHW step). Adjacent
+runs so shared-chip drift cancels; one JSON line per variant.
+
+Usage: python tools/pool_ab.py [batch]
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from layout_ab import BF16, measure  # shared A/B measurement protocol
+
+
+def main():
+    from cxxnet_tpu.utils import enable_compile_cache
+    enable_compile_cache()
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    for knob in ("", "pallas"):
+        if knob:
+            os.environ["CXXNET_POOL"] = knob
+        else:
+            os.environ.pop("CXXNET_POOL", None)
+        from cxxnet_tpu.models import googlenet_trainer
+        tr = googlenet_trainer(batch_size=batch, input_hw=224, dev="tpu",
+                               extra_cfg=BF16)
+        ips = measure(tr, (3, 224, 224), 1000, batch, steps=30)
+        print(json.dumps({"variant": "googlenet_b%d_pool_%s"
+                          % (batch, knob or "xla"),
+                          "img_per_sec": round(ips, 1)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
