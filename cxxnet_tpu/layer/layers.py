@@ -396,9 +396,14 @@ class MaxoutLayer(Layer):
         check(c % g == 0, "maxout: input channels must divide ngroup")
         return [(b, c // g, h, w)]
 
+    layout_support = "nhwc"
+
     def apply(self, params, inputs, ctx):
         x = inputs[0]
         g = self.param.num_group
+        if ctx.channels_last:
+            b, h, w, c = x.shape
+            return [jnp.max(x.reshape(b, h, w, c // g, g), axis=4)]
         b, c, h, w = x.shape
         if c == 1:
             return [jnp.max(x.reshape(b, 1, 1, w // g, g), axis=4)]
@@ -652,9 +657,6 @@ class InsanityPoolingLayer(MaxPoolingLayer):
     of the undisplaced input."""
 
     type_name = "insanity_max_pooling"
-    # the displacement gather below indexes flat (c, h*w) planes — NCHW
-    # only; the net auto-converts around it under channels_last
-    layout_support = "nchw"
 
     def __init__(self):
         super().__init__()
@@ -666,14 +668,18 @@ class InsanityPoolingLayer(MaxPoolingLayer):
             self.p_keep = float(val)
 
     def apply(self, params, inputs, ctx):
-        p = self.param
         x = inputs[0]
         if ctx.train:
-            b, c, h, w = x.shape
+            if ctx.channels_last:
+                b, h, w, c = x.shape
+                yy = jnp.arange(h).reshape(1, h, 1, 1)
+                xx = jnp.arange(w).reshape(1, 1, w, 1)
+            else:
+                b, c, h, w = x.shape
+                yy = jnp.arange(h).reshape(1, 1, h, 1)
+                xx = jnp.arange(w).reshape(1, 1, 1, w)
             flag = jax.random.uniform(ctx.rng, x.shape, x.dtype)
             delta = (1.0 - self.p_keep) / 4.0
-            yy = jnp.arange(h).reshape(1, 1, h, 1)
-            xx = jnp.arange(w).reshape(1, 1, 1, w)
             loc_y = jnp.broadcast_to(yy, x.shape)
             loc_x = jnp.broadcast_to(xx, x.shape)
             loc_y = jnp.where((flag >= self.p_keep) & (flag < self.p_keep + delta),
@@ -685,10 +691,21 @@ class InsanityPoolingLayer(MaxPoolingLayer):
             loc_x = jnp.where(flag >= self.p_keep + 3 * delta,
                               jnp.minimum(loc_x + 1, w - 1), loc_x)
             flat_idx = loc_y * w + loc_x
-            xf = x.reshape(b, c, h * w)
-            x = jnp.take_along_axis(xf, flat_idx.reshape(b, c, h * w), axis=2)
-            x = x.reshape(b, c, h, w)
-        return [ops.pool2d(x, "max", (p.kernel_height, p.kernel_width), p.stride)]
+            if ctx.channels_last:
+                # displace over the flattened spatial axis, channels minor
+                xf = x.reshape(b, h * w, c)
+                x = jnp.take_along_axis(
+                    xf, flat_idx.reshape(b, h * w, c), axis=1)
+                x = x.reshape(b, h, w, c)
+            else:
+                xf = x.reshape(b, c, h * w)
+                x = jnp.take_along_axis(
+                    xf, flat_idx.reshape(b, c, h * w), axis=2)
+                x = x.reshape(b, c, h, w)
+        # base-class pooling handles layout AND ceil-mode padding (the
+        # inherited infer_shape accounts for pad_y/pad_x, so apply must
+        # too — a direct pool2d call without pad would shrink the node)
+        return super().apply(params, [x], ctx)
 
 
 class LRNLayer(Layer):

@@ -55,10 +55,10 @@ def _run_pair(netconfig, shape, batch, nclass, extra="", steps=2):
     return outs
 
 
-# every nhwc-fast-path layer + agnostic + auto-converted NCHW-only ones:
-# grouped conv, lrn (banded-matmul NHWC path), prelu, relu_max_pooling,
-# batch_norm w/ EMA state, maxout (nchw-only), insanity_max_pooling
-# (nchw-only, converted around), xelu, split/ch_concat, avg pool
+# every nhwc-fast-path layer + agnostic ones: grouped conv, lrn
+# (minor-axis window NHWC path), prelu, relu_max_pooling, batch_norm w/
+# EMA state, maxout (NHWC adjacent-channel grouping), xelu,
+# split/ch_concat, avg pool
 KITCHEN_SINK = """
 netconfig = start
 layer[0->1] = conv:c1
@@ -115,7 +115,8 @@ def test_kitchen_sink_exact():
 
 def test_insanity_pooling_eval_exact():
     # stochastic layers draw layout-dependent noise in training, so the
-    # cross-layout equality contract is on eval mode
+    # cross-layout equality contract is on eval mode (the NHWC train path
+    # displaces over the channels-minor spatial axis)
     conf = """
 netconfig = start
 layer[0->1] = conv:c1
@@ -139,6 +140,35 @@ netconfig = end
                       extra="channels_last = %d\n" % cl)
         preds.append(tr.predict(_batch((1, 10, 10), 6, 3)))
     assert np.array_equal(preds[0], preds[1])
+
+
+def test_insanity_pooling_respects_pad():
+    """pad on insanity_max_pooling must produce the inferred node shape
+    (regression: apply dropped pad while infer_shape counted it)."""
+    conf = """
+netconfig = start
+layer[0->1] = conv:c1
+  kernel_size = 3
+  nchannel = 4
+  random_type = xavier
+layer[1->2] = insanity_max_pooling
+  kernel_size = 3
+  stride = 1
+  pad = 1
+  keep = 0.8
+layer[2->3] = flatten
+layer[3->4] = fullc:fc
+  nhidden = 3
+  init_sigma = 0.1
+layer[4->4] = softmax
+netconfig = end
+"""
+    for cl in (0, 1):
+        tr = _trainer(conf, (1, 8, 8), 4,
+                      extra="channels_last = %d\n" % cl)
+        b = _batch((1, 8, 8), 4, 3)
+        tr.update(b)     # train mode exercises the displacement gather
+        assert tr.predict(b).shape == (4,)
 
 
 def test_bn_on_grayscale_input():

@@ -20,6 +20,10 @@ BF16 = "eval_train = 0\ncompute_dtype = bfloat16\n"
 
 
 def measure(tr, shape, nclass, batch, steps=15):
+    """Shared A/B measurement protocol (pool_ab.py imports it too so the
+    two tools' numbers stay methodology-comparable): device-resident
+    batch, 3-step warmup, value-fetch sync (block_until_ready does not
+    sync through the axon tunnel), best of two timed passes."""
     import jax
     import jax.numpy as jnp
     from cxxnet_tpu.io.data import DataBatch
