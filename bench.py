@@ -247,6 +247,22 @@ def _bench_lm(metric, L, batch, steps, attn_extra=""):
             "unit": "tokens/sec/chip", "vs_baseline": None}
 
 
+def bench_vit():
+    """ViT-S/16-shaped (224x224, patch 16, dim 384, 12 blocks, 6 heads)
+    training throughput — the DSL-composed vision-transformer family
+    (patch-embed conv -> im2seq -> RoPE attention blocks); no reference
+    baseline (the family postdates the reference)."""
+    from cxxnet_tpu.models import vit_trainer
+    batch = 128
+    tr = vit_trainer(n_class=1000, image_hw=224, patch=16, dim=384,
+                     nhead=6, nlayer=12, ffn_mult=4, batch_size=batch,
+                     dev="tpu", extra_cfg=BF16)
+    ips = _throughput(tr, (3, 224, 224), 1000, batch, steps=15)
+    return {"metric": "vit_s16_images_per_sec_per_chip",
+            "value": round(ips, 2), "unit": "images/sec/chip",
+            "vs_baseline": None}
+
+
 def bench_transformer_lm():
     """Long-context LM training throughput: tokens/sec at L=2048 bf16
     (flash attention path; no reference baseline — the reference is a CNN
@@ -455,7 +471,7 @@ def _bench_main():
         for fn in (bench_mnist_mlp, bench_mnist_conv, bench_bowl,
                    bench_googlenet, bench_resnet, bench_vgg,
                    bench_transformer_lm, bench_transformer_lm_long,
-                   bench_alexnet_b1024, bench_alexnet_infer):
+                   bench_vit, bench_alexnet_b1024, bench_alexnet_infer):
             print(json.dumps(fn()), flush=True)
     if len(sys.argv) > 1 and sys.argv[1] in ("all", "pipeline"):
         for line in bench_alexnet_pipeline():

@@ -50,6 +50,7 @@ kAttention = 32
 kEmbed = 33
 kAdd = 34
 kMoE = 35
+kIm2Seq = 36
 kPairTestGap = 1024
 
 _NAME2TYPE = {
@@ -89,6 +90,7 @@ _NAME2TYPE = {
     "embed": kEmbed,
     "add": kAdd,
     "moe": kMoE,
+    "im2seq": kIm2Seq,
 }
 
 _TYPE2CLS = {
@@ -124,6 +126,7 @@ _TYPE2CLS = {
     kEmbed: L.EmbedLayer,
     kAdd: L.AddLayer,
     kMoE: L.MoELayer,
+    kIm2Seq: L.Im2SeqLayer,
 }
 
 

@@ -1268,6 +1268,33 @@ class EmbedLayer(Layer):
         return [emb.transpose(0, 2, 1).reshape(b, -1, 1, L)]
 
 
+class Im2SeqLayer(Layer):
+    """(b, d, h, w) feature map -> (b, d, 1, h*w) sequence of h*w
+    patch/position vectors (beyond the reference): the bridge from the
+    conv stack to the attention stack — a patch-embedding conv
+    (kernel_size = stride = patch) followed by im2seq is a ViT front end.
+    Position order is row-major (h-major), matching embed's pos_embed
+    indexing. Pure reshape in NCHW; under channels_last the physical
+    (b, h, w, d) flattens to the attention-native (b, 1, hw, d) with the
+    channel axis untouched."""
+
+    type_name = "im2seq"
+    layout_support = "nhwc"
+
+    def infer_shape(self, in_shapes):
+        check(len(in_shapes) == 1, "Im2SeqLayer only support 1-1 connection")
+        b, d, h, w = in_shapes[0]
+        return [(b, d, 1, h * w)]
+
+    def apply(self, params, inputs, ctx):
+        x = inputs[0]
+        if ctx.channels_last:
+            b, h, w, d = x.shape
+            return [x.reshape(b, 1, h * w, d)]
+        b, d, h, w = x.shape
+        return [x.reshape(b, d, 1, h * w)]
+
+
 class AddLayer(Layer):
     """Elementwise sum of 2-4 same-shaped inputs (beyond the reference,
     which only ships concat): the residual-connection primitive for

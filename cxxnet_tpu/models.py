@@ -343,6 +343,47 @@ def googlenet_trainer(batch_size: int = 128, input_hw: int = 224,
     return tr
 
 
+def _transformer_block(p: str, node_in: str, dim: int, nhead: int,
+                       ffn: int, attn_keys: str = "",
+                       norm: bool = False) -> Tuple[str, str]:
+    """One transformer block in the DSL, shared by the LM and ViT
+    builders so the block shape lives in one place. Residuals connect the
+    BLOCK INPUT (pre-norm form): out = x + att(norm(x)), then
+    + ffn(norm(.)). norm=True inserts batch_norm (moving_average) before
+    each sub-block; attn_keys are extra per-attention config lines
+    (causal/rope/GQA/window)."""
+    txt = ""
+    att_in = node_in
+    if norm:
+        txt += ("layer[%(in)s->%(p)sn1] = batch_norm:%(p)s_bn1\n"
+                "  moving_average = 1\n" % {"in": node_in, "p": p})
+        att_in = p + "n1"
+    txt += """layer[%(ai)s->%(p)satt] = attention:%(p)s_att
+  nhead = %(nh)d
+  init_sigma = 0.05
+%(ak)slayer[%(in)s,%(p)satt->%(p)sres1] = add
+""" % {"ai": att_in, "in": node_in, "p": p, "nh": nhead,
+       "ak": "".join("  %s\n" % l.strip()
+                     for l in attn_keys.splitlines() if l.strip())}
+    ffn_in = p + "res1"
+    if norm:
+        txt += ("layer[%(p)sres1->%(p)sn2] = batch_norm:%(p)s_bn2\n"
+                "  moving_average = 1\n" % {"p": p})
+        ffn_in = p + "n2"
+    txt += """layer[%(fi)s->%(p)sf1] = conv:%(p)s_ffn1
+  kernel_size = 1
+  nchannel = %(ffn)d
+  init_sigma = 0.05
+layer[%(p)sf1->%(p)sr] = relu
+layer[%(p)sr->%(p)sf2] = conv:%(p)s_ffn2
+  kernel_size = 1
+  nchannel = %(dim)d
+  init_sigma = 0.05
+layer[%(p)sres1,%(p)sf2->%(p)sout] = add
+""" % {"fi": ffn_in, "p": p, "ffn": ffn, "dim": dim}
+    return txt, p + "out"
+
+
 def transformer_lm_netconfig(vocab: int, dim: int = 64, nhead: int = 4,
                              nlayer: int = 2, ffn_mult: int = 2,
                              attn_extra: str = "") -> str:
@@ -362,29 +403,10 @@ layer[+1:emb] = embed:emb
 """ % (vocab, dim)
     node = "emb"
     for i in range(nlayer):
-        p = "blk%d" % i
-        txt += """
-layer[%(in)s->%(p)satt] = attention:%(p)s_att
-  nhead = %(nh)d
-  causal = 1
-  init_sigma = 0.05
-%(attn_extra)slayer[%(in)s,%(p)satt->%(p)sres1] = add
-layer[%(p)sres1->%(p)sf1] = conv:%(p)s_ffn1
-  kernel_size = 1
-  nchannel = %(ffn)d
-  init_sigma = 0.05
-layer[%(p)sf1->%(p)sr] = relu
-layer[%(p)sr->%(p)sf2] = conv:%(p)s_ffn2
-  kernel_size = 1
-  nchannel = %(dim)d
-  init_sigma = 0.05
-layer[%(p)sres1,%(p)sf2->%(p)sout] = add
-""" % {"in": node, "p": p, "nh": nhead, "dim": dim,
-       "ffn": ffn_mult * dim,
-       "attn_extra": "".join("  %s\n" % l.strip()
-                             for l in attn_extra.splitlines()
-                             if l.strip())}
-        node = p + "out"
+        blk, node = _transformer_block(
+            "blk%d" % i, node, dim, nhead, ffn_mult * dim,
+            attn_keys="causal = 1\n" + attn_extra)
+        txt += "\n" + blk
     txt += """
 layer[%s->logits] = conv:head
   kernel_size = 1
@@ -412,6 +434,67 @@ def transformer_lm_trainer(vocab: int = 50, seq: int = 16,
             "batch_size = %d\n" % batch_size +
             "label_vec[0,%d) = label\n" % seq +
             "updater = adam\neta = 0.003\n" +
+            "dev = %s\n" % dev + extra_cfg)
+    tr = Trainer()
+    for k, v in parse_config_string(conf):
+        tr.set_param(k, v)
+    tr.init_model()
+    return tr
+
+
+def vit_netconfig(n_class: int, image_hw: int = 32, patch: int = 4,
+                  dim: int = 64, nhead: int = 4, nlayer: int = 2,
+                  ffn_mult: int = 2) -> str:
+    """Vision transformer from the netconfig DSL (beyond the reference —
+    composes existing pieces): patch-embedding conv (kernel = stride =
+    patch) -> im2seq -> n x [batch_norm, RoPE attention + residual,
+    1x1-conv FFN + residual] -> mean-pool over positions -> fullc head.
+    RoPE supplies the position signal (row-major patch order, the im2seq
+    flattening), so no learned position table is needed."""
+    check_msg = "vit: patch must divide image_hw"
+    assert image_hw % patch == 0, check_msg
+    npos = (image_hw // patch) ** 2
+    txt = """
+netconfig = start
+layer[0->pe] = conv:patch_embed
+  kernel_size = %d
+  stride = %d
+  nchannel = %d
+  random_type = xavier
+layer[pe->sq] = im2seq
+""" % (patch, patch, dim)
+    node = "sq"
+    for i in range(nlayer):
+        blk, node = _transformer_block(
+            "vb%d" % i, node, dim, nhead, ffn_mult * dim,
+            attn_keys="rope = 1\n", norm=True)
+        txt += "\n" + blk
+    txt += """
+layer[%s->gp] = avg_pooling
+  kernel_height = 1
+  kernel_width = %d
+  stride = %d
+layer[gp->fl] = flatten
+layer[fl->out] = fullc:head
+  nhidden = %d
+  random_type = xavier
+layer[+0] = softmax
+netconfig = end
+""" % (node, npos, npos, n_class)
+    return txt
+
+
+def vit_trainer(n_class: int = 10, image_hw: int = 32, patch: int = 4,
+                batch_size: int = 16, dim: int = 64, nhead: int = 4,
+                nlayer: int = 2, ffn_mult: int = 2, dev: str = "cpu",
+                extra_cfg: str = "") -> Trainer:
+    """Vision-transformer trainer (shrink image_hw/dim/nlayer for tests)."""
+    conf = (vit_netconfig(n_class, image_hw=image_hw, patch=patch,
+                          dim=dim, nhead=nhead, nlayer=nlayer,
+                          ffn_mult=ffn_mult) +
+            "input_shape = 3,%d,%d\n" % (image_hw, image_hw) +
+            "batch_size = %d\n" % batch_size +
+            "updater = adamw\neta = 0.003\nwd = 0.01\n" +
             "dev = %s\n" % dev + extra_cfg)
     tr = Trainer()
     for k, v in parse_config_string(conf):

@@ -398,3 +398,19 @@ netconfig = end
             for p in tr.canonical_params()
             for k, v in sorted(p.items())]))
     np.testing.assert_allclose(flats[0], flats[1], rtol=2e-6, atol=2e-7)
+
+
+def test_vit_channels_last_exact():
+    """im2seq bridges conv-NHWC into attention-NHWC with a pure reshape;
+    the whole ViT forward matches NCHW bitwise-tolerance."""
+    from cxxnet_tpu.models import vit_trainer
+    outs = []
+    for cl in (0, 1):
+        tr = vit_trainer(image_hw=16, patch=4, dim=32, nlayer=1,
+                         batch_size=8,
+                         extra_cfg="channels_last = %d\n" % cl)
+        b = _batch((3, 16, 16), 8, 10, seed=1)
+        for _ in range(2):
+            tr.update(b)
+        outs.append(_flat_params(tr))
+    np.testing.assert_allclose(outs[0], outs[1], rtol=2e-6, atol=2e-7)

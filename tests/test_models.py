@@ -62,3 +62,22 @@ class TestVGG:
             tr.update(b)
         pred = tr.predict(b)
         assert (pred == b.label[:, 0]).mean() == 1.0
+
+
+def test_vit_memorizes():
+    """ViT family: patch-embed conv -> im2seq -> RoPE attention blocks ->
+    mean-pool head, all from the DSL, trains to memorization."""
+    import numpy as np
+    from cxxnet_tpu.models import vit_trainer
+    from cxxnet_tpu.io.data import DataBatch
+
+    tr = vit_trainer(image_hw=16, patch=4, dim=32, nlayer=1,
+                     batch_size=16)
+    rs = np.random.RandomState(0)
+    b = DataBatch()
+    b.data = rs.rand(16, 3, 16, 16).astype(np.float32)
+    b.label = rs.randint(0, 10, (16, 1)).astype(np.float32)
+    b.batch_size = 16
+    for _ in range(150):
+        tr.update(b)
+    assert (tr.predict(b) == b.label[:, 0]).mean() >= 0.9
