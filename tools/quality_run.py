@@ -33,7 +33,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "tests"))
 
-BOWL_CONF = """
+_RECIPE_CONF = """
 data = train
 iter = mnist
     path_img = "{dir}/train-images-idx3-ubyte.gz"
@@ -47,7 +47,15 @@ iter = mnist
     path_img = "{dir}/t10k-images-idx3-ubyte.gz"
     path_label = "{dir}/t10k-labels-idx1-ubyte.gz"
 iter = end
-netconfig=start
+{netconfig}
+input_shape = 1,28,28
+batch_size = 100
+dev = {dev}
+save_model = 0
+{train_params}metric[label] = error
+"""
+
+_BOWL_NET = """netconfig=start
 layer[0->1] = conv:c1
   kernel_size = 5
   nchannel = 16
@@ -65,26 +73,28 @@ layer[6->7] = fullc:f2
   nhidden = 10
   random_type = xavier
 layer[7->7] = softmax
-netconfig=end
-input_shape = 1,28,28
-batch_size = 100
-dev = {dev}
-save_model = 0
-max_round = 12
-num_round = 12
-eta = 0.05
-momentum = 0.9
-wd = 0.0001
-metric[label] = error
-"""
+netconfig=end"""
+
+_BOWL_PARAMS = "max_round = 12\nnum_round = 12\neta = 0.05\n" \
+    "momentum = 0.9\nwd = 0.0001\n"
+_VIT_PARAMS = "max_round = 15\nnum_round = 15\nupdater = adamw\n" \
+    "eta = 0.001\nwd = 0.01\n"
 
 
-def run_cli(conf_path, overrides, cwd):
+
+def run_cli(conf_path, overrides, cwd, dev="cpu"):
     cmd = [sys.executable, os.path.join(REPO, "bin", "cxxnet"),
            conf_path] + overrides
+    env = dict(os.environ)
+    if dev == "cpu":
+        # pin the platform via the config route: with the axon tunnel
+        # down, a cpu run that lets the preloaded plugin autodiscover
+        # hangs in backend init instead of falling back (the env-var
+        # route cannot undo a preloaded platform; this one can)
+        env["CXXNET_JAX_PLATFORM"] = "cpu"
     t0 = time.time()
     p = subprocess.run(cmd, cwd=cwd, capture_output=True, text=True,
-                       timeout=3600)
+                       timeout=3600, env=env)
     assert p.returncode == 0, p.stdout + p.stderr
     text = p.stdout + p.stderr   # metric lines go to stderr (reference)
     rounds = re.findall(
@@ -140,17 +150,33 @@ def main():
                          ["dev=%s" % dev, "seed=%d" % seed,
                           "save_model=0"]),
                 ):
-                    r = run_cli(conf, extra, droot)
+                    r = run_cli(conf, extra, droot, dev=dev)
                     r.update(recipe=name, corpus=corpus, seed=seed)
                     results.append(r)
                     print(r, flush=True)
                 # bowl-shaped conv recipe (kaggle_bowl-like trunk)
                 bowl = os.path.join(droot, "bowl_like.conf")
                 with open(bowl, "w") as f:
-                    f.write(BOWL_CONF.format(dir=os.path.join(droot, "data"),
-                                             dev=dev))
-                r = run_cli(bowl, ["seed=%d" % seed], droot)
+                    f.write(_RECIPE_CONF.format(
+                        dir=os.path.join(droot, "data"), dev=dev,
+                        netconfig=_BOWL_NET, train_params=_BOWL_PARAMS))
+                r = run_cli(bowl, ["seed=%d" % seed], droot, dev=dev)
                 r.update(recipe="bowl_like_conv", corpus=corpus, seed=seed)
+                results.append(r)
+                print(r, flush=True)
+                # ViT recipe (patch-embed conv -> im2seq -> attention):
+                # the DSL-composed vision-transformer family end to end
+                from cxxnet_tpu.models import vit_netconfig
+                vit = os.path.join(droot, "vit_like.conf")
+                with open(vit, "w") as f:
+                    f.write(_RECIPE_CONF.format(
+                        dir=os.path.join(droot, "data"), dev=dev,
+                        netconfig=vit_netconfig(
+                            10, image_hw=28, patch=4, dim=48,
+                            nhead=4, nlayer=2),
+                        train_params=_VIT_PARAMS))
+                r = run_cli(vit, ["seed=%d" % seed], droot, dev=dev)
+                r.update(recipe="vit_like", corpus=corpus, seed=seed)
                 results.append(r)
                 print(r, flush=True)
 
@@ -197,7 +223,8 @@ def main():
     # aggregate check lines
     import statistics as st
     lines.append("")
-    for recipe in ("mnist_mlp", "mnist_conv", "bowl_like_conv"):
+    for recipe in ("mnist_mlp", "mnist_conv", "bowl_like_conv",
+                   "vit_like"):
         hard = [r["test_err"] for r in results
                 if r["recipe"] == recipe and r["corpus"] == "hard"]
         easy = [r["test_err"] for r in results
@@ -220,7 +247,8 @@ def main():
                        % (r["test_err"], r["recipe"], r["seed"]))
     hards = {rec: [r["test_err"] for r in results
                    if r["recipe"] == rec and r["corpus"] == "hard"]
-             for rec in ("mnist_mlp", "mnist_conv", "bowl_like_conv")}
+             for rec in ("mnist_mlp", "mnist_conv", "bowl_like_conv",
+                         "vit_like")}
     if st.mean(hards["mnist_conv"]) > st.mean(hards["mnist_mlp"]):
         bad.append("conv does not beat mlp on the hard corpus")
     if st.mean(hards["mnist_conv"]) > 0.15:
