@@ -278,6 +278,13 @@ class Trainer:
         return jax.default_backend() == "tpu"
 
     def _init_net_structure(self) -> None:
+        # pin the requested platform FIRST: net construction below probes
+        # jax (channels_last auto-resolution), and letting autodiscovery
+        # initialize a tunneled default backend would both hang dev=cpu
+        # runs when the tunnel is down and steal the platform choice from
+        # _setup_mesh's ensure_platform (a first-cut channels_last
+        # regression did exactly that)
+        parallel.ensure_platform(parallel.parse_device_spec(self.dev_spec)[0])
         self.net_cfg.configure(self.cfg_pairs)
         self.net = NeuralNet(self.net_cfg, self.batch_size,
                              compute_dtype=self.compute_dtype,
@@ -542,6 +549,7 @@ class Trainer:
         # shape inference must wait until the model blob restores each
         # layer's LayerParam (nhidden etc.) — the reference likewise loads
         # params before InitConnection (neural_net-inl.hpp LoadModel)
+        parallel.ensure_platform(parallel.parse_device_spec(self.dev_spec)[0])
         self.net_cfg.configure(self.cfg_pairs)
         self.net = NeuralNet(self.net_cfg, self.batch_size,
                              infer_shapes=False,
