@@ -110,6 +110,32 @@ def test_fsdp_composes_with_tp():
     _assert_matches(tr, ref, rtol=2e-5, atol=2e-6)
 
 
+def test_fsdp_with_grad_accumulation():
+    """fsdp composes with update_period: two accumulated half-batches
+    match one full-batch fsdp update exactly (the accumulated grads ride
+    between steps without disturbing the param placement)."""
+    tr_acc = _trainer("dev = cpu:0-7\nfsdp = 1\nupdate_period = 2\n"
+                      "batch_size = 8\n")
+    tr_full = _trainer("dev = cpu:0-7\nfsdp = 1\n")
+    rs = np.random.RandomState(11)
+    data = rs.rand(16, 1, 1, 48).astype(np.float32)
+    label = rs.randint(0, 8, (16, 1)).astype(np.float32)
+    for lo in (0, 8):
+        b = DataBatch()
+        b.data, b.label = data[lo:lo + 8], label[lo:lo + 8]
+        b.batch_size = 8
+        tr_acc.update(b)
+    bf = DataBatch()
+    bf.data, bf.label = data, label
+    bf.batch_size = 16
+    tr_full.update(bf)
+    _assert_matches(tr_acc, tr_full)
+    fc1 = next(i for i, lay in enumerate(tr_acc.net.layers)
+               if getattr(lay, "type_name", "") == "fullc")
+    w = tr_acc.params[fc1]["wmat"]
+    assert np.asarray(w.addressable_shards[0].data).size * 8 == w.size
+
+
 def test_fsdp_checkpoint_roundtrip():
     """save_model gathers the sharded params (fetch_global); reloading
     into a single-device trainer reproduces them bitwise."""
