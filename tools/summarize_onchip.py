@@ -36,7 +36,7 @@ def main():
         out.extend(open(status).read().strip().splitlines())
         out.append("```")
 
-    for name in ("bench", "pipeline", "benchall"):
+    for name in ("bench", "layout", "poolab", "pipeline", "benchall"):
         rows = read_json_lines(os.path.join(d, "%s.log" % name))
         if rows:
             out.append("## %s" % name)
