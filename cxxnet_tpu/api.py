@@ -177,7 +177,8 @@ class Net:
         """Write the inference forward as a self-contained StableHLO
         artifact (params baked in); reload anywhere with
         `load_exported(fname)` — no framework, config, or model file
-        needed at serving time."""
+        needed at serving time. batch_size 0 = training batch;
+        -1 = symbolic batch dim (one artifact serves any n >= 1)."""
         assert self.net_ is not None, "model not initialized"
         with open(fname, "wb") as f:
             f.write(self.net_.export_forward(node_name=node_name,
@@ -199,8 +200,9 @@ class Net:
 
 def load_exported(fname: str):
     """Load a `Net.export` / `task = export` StableHLO artifact and return
-    a callable `fn(data) -> np.ndarray` (fixed batch shape, params baked
-    in). Runs on whatever jax backend is active — the serving side needs
+    a callable `fn(data) -> np.ndarray` (params baked in; batch shape
+    fixed, or any n >= 1 for artifacts exported with batch_size = -1).
+    Runs on whatever jax backend is active — the serving side needs
     jax only, none of this framework."""
     from jax import export as jexport
     with open(fname, "rb") as f:

@@ -74,7 +74,23 @@ class FullConnectLayer(Layer):
 
     def apply(self, params, inputs, ctx):
         x = _flat2d(inputs[0])
-        y = x @ params["wmat"].T
+        w = params["wmat"]
+        if ctx.manual_tp and w.shape[0] % ctx.mesh.shape["model"] == 0:
+            # column parallelism inside a pipeline stage body (manual
+            # shard_map): each model rank computes its slice of the output
+            # features and the group-local all-gather rebuilds the full
+            # row — 1/mp of the matmul FLOPs per device, collectives only
+            # among model pairs at this pipe rank. The weight-grad psum
+            # over model comes from the shard_map transpose (replicated
+            # input ⇒ summed cotangents), mirroring fullc_gather's local
+            # recompute (src/updater/async_updater-inl.hpp:67-92).
+            mp = ctx.mesh.shape["model"]
+            loc = w.shape[0] // mp
+            midx = jax.lax.axis_index("model")
+            w_l = jax.lax.dynamic_slice_in_dim(w, midx * loc, loc, 0)
+            y = jax.lax.all_gather(x @ w_l.T, "model", axis=1, tiled=True)
+        else:
+            y = x @ w.T
         if self.param.no_bias == 0:
             y = y + params["bias"]
         return [y.reshape(y.shape[0], 1, 1, y.shape[1])]

@@ -395,7 +395,8 @@ class LearnTask:
         in) into a self-contained StableHLO artifact at export_out.
         extract_node_name selects a named node / top[-k] (default: the
         last node, the pred surface); export_batch overrides the batch
-        dimension (default batch_size). Reload anywhere with
+        dimension (default batch_size; -1 = symbolic batch, one artifact
+        serves any n >= 1). Reload anywhere with
         cxxnet_tpu.api.load_exported — serving needs jax only."""
         blob = self.net_trainer.export_forward(
             node_name=self.extract_node_name,

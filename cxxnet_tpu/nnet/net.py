@@ -314,7 +314,8 @@ class NeuralNet:
         def pure(pp, xs, rng, epoch):
             c2 = ApplyContext(train=ctx.train, labels=None,
                               epoch=epoch, mesh=ctx.mesh,
-                              channels_last=ctx.channels_last)
+                              channels_last=ctx.channels_last,
+                              manual_tp=ctx.manual_tp)
             c2.rng = rng
             c2.layer_index = getattr(ctx, "layer_index", pidx)
             return tuple(lay.apply(pp, list(xs), c2))
@@ -696,7 +697,9 @@ class NeuralNet:
         def run_stage_layers(p, padded, s, micro_id, state_in=None):
             lo, hi = stages[s]
             ctx = ApplyContext(train=train, labels=None, epoch=epoch,
-                               mesh=mesh)
+                               mesh=mesh,
+                               manual_tp=("model" in mesh.axis_names
+                                          and mesh.shape["model"] > 1))
             own_slots = slots_by_stage.get(s, ())
             if state_in is not None:
                 for (i, key, so, sz, shape) in own_slots:

@@ -36,6 +36,17 @@ from .config import NetConfig
 from .net import NeuralNet
 
 
+def _updater_signature(up):
+    """Hashable hyper-parameter signature for grouping packed-stage tensors
+    whose updates are identical elementwise programs (same kind, same
+    schedule/decay/clip settings — only the tensor data differs). All
+    UpdaterParam and subclass fields are primitives."""
+    pf = tuple(sorted((k, v) for k, v in vars(up.param).items()
+                      if k not in ("tag", "silent")))
+    ex = tuple(sorted((k, v) for k, v in vars(up).items() if k != "param"))
+    return (up.kind,) + pf + ex
+
+
 class Trainer:
     """Net trainer; one instance per training job (reference INetTrainer)."""
 
@@ -166,10 +177,14 @@ class Trainer:
         their existing 2-D meshes. dp is derived: whatever device count
         remains after the explicit axes divide it.
 
-        pipeline_parallel composes with data parallelism only: stage bodies
-        run inside a shard_map over the pipe axis, and nesting another
-        manual collective axis (model/sp/ep) inside a stage body is not
-        supported.
+        pipeline_parallel composes with data AND tensor parallelism: the
+        composed mesh carries a model axis and stage bodies run tp
+        MANUALLY — fullc slices its column shard and all-gathers outputs
+        over model pairs local to its own pipe rank (group-local
+        collectives; an automatic model axis would instead let Shardy put
+        8-wide resharding collectives inside the rank-divergent lax.switch
+        branches — a deadlock). sp/ep cannot run inside pipeline stages:
+        their layers open their OWN shard_map, and shard_map does not nest.
         """
         kind, ids = parallel.parse_device_spec(self.dev_spec)
         parallel.ensure_platform(kind)
@@ -180,10 +195,10 @@ class Trainer:
         sp = self.seq_parallel
         pp = self.pipeline_parallel
         ep = self.expert_parallel
-        check(pp == 1 or (mp == 1 and sp == 1 and ep == 1),
-              "pipeline_parallel composes with data parallelism only; "
-              "model/seq/expert parallelism cannot run inside pipeline "
-              "stages")
+        check(pp == 1 or (sp == 1 and ep == 1),
+              "pipeline_parallel composes with data and model parallelism "
+              "only; seq/expert parallelism cannot run inside pipeline "
+              "stages (their layers open their own shard_map)")
         ways = mp * sp * pp * ep
         check(n % ways == 0,
               "device count %d must be divisible by model_parallel * "
@@ -234,14 +249,16 @@ class Trainer:
         reduce-scatters gradients, so param/grad/opt memory scales 1/dp."""
         self._tp_shardings = None
         self._fsdp_shardings = None
-        check(not (self.fsdp and self.pipeline_parallel > 1),
-              "fsdp does not compose with pipeline_parallel (stage "
-              "packing already owns the parameter placement)")
         if self.mesh is None:
             return
         # with dp == 1 there is nothing to shard over — fsdp degenerates
-        # to plain placement (callers can assert on _fsdp_shardings)
-        use_fsdp = bool(self.fsdp) and "data" in self.mesh.axis_names \
+        # to plain placement (callers can assert on _fsdp_shardings).
+        # fsdp x pipeline: stage packing already owns the parameter bytes
+        # (1/k per pipe rank), so per-layer ZeRO-3 placement is skipped and
+        # fsdp=1 instead means ZeRO-1 on the packed optimizer state — see
+        # _pp_pack/_pp_zero1 (opt bytes scale 1/(k*dp)).
+        use_fsdp = bool(self.fsdp) and self.pipeline_parallel == 1 \
+            and "data" in self.mesh.axis_names \
             and self.mesh.shape["data"] > 1
         if not use_fsdp and not ("model" in self.mesh.axis_names
                                  or "ep" in self.mesh.axis_names):
@@ -343,6 +360,18 @@ class Trainer:
         return self.net.pipeline_plan(self.params,
                                       self.mesh.shape["pipe"])
 
+    def _pp_zero1(self) -> bool:
+        """fsdp composed with pipeline_parallel: ZeRO-1 inside each stage —
+        packed optimizer state sharded (pipe, data), 1/(k*dp) bytes per
+        device. (Stage packing already gives 1/k params per rank; sharding
+        the PARAMS further over data would force an all-gather of the stage
+        weights inside every microbatch tick of the scan, so opt-state
+        sharding is the profitable half of fsdp here.)"""
+        return (bool(self.fsdp) and self.pipeline_parallel > 1
+                and self.mesh is not None
+                and "data" in self.mesh.axis_names
+                and self.mesh.shape["data"] > 1)
+
     def _pp_pack(self) -> None:
         """Move prefix-stage params + opt state into the packed arrays.
         No-op unless pipeline_parallel > 1 on a live mesh."""
@@ -379,6 +408,11 @@ class Trainer:
             entries.append(es)
             sizes.append(off)
         F_p = max(1, max(sizes))
+        if self._pp_zero1():
+            # ZeRO-1 shards the flat dim over data: pad to a multiple of dp
+            # (pad elements are zeros with gid -1 — never updated)
+            dp = self.mesh.shape["data"]
+            F_p = -(-F_p // dp) * dp
         sh = NamedSharding(self.mesh, P("pipe", None))
 
         def build(getv):
@@ -403,10 +437,51 @@ class Trainer:
                              if key in self.opt_state[i]}
         sub_keys = sorted({sk for es in entries for (i, key, _, _) in es
                            for sk in self.opt_state[i].get(key, {})})
-        packed_opt = {sk: build(
+        opt_sh = sh
+        if self._pp_zero1():
+            # fsdp x pp = ZeRO-1 inside each stage: the packed optimizer
+            # state additionally shards its flat dim over the data axis —
+            # each (pipe, data) device owns 1/(k*dp) of the opt bytes and
+            # computes only its slice of the elementwise update; GSPMD
+            # all-gathers the updated params (whose sharding stays
+            # P("pipe", None)). The vectorized group update below is what
+            # makes this clean: it is elementwise over (k, F_p), so the
+            # constraint partitions it with zero resharding.
+            opt_sh = NamedSharding(self.mesh, P("pipe", "data"))
+        packed_opt = {sk: jax.device_put(build(
             lambda i, k_: parallel.fetch_global(self.opt_state[i][k_][sk])
-            if k_ in self.opt_state[i] else None)
+            if k_ in self.opt_state[i] else None), opt_sh)
             for sk in sub_keys}
+        # vectorized update plan: group packed tensors by updater
+        # hyper-parameter signature; the step then runs ONE elementwise
+        # update per group over the whole (k, F_p) array and selects by a
+        # static group-id map — O(#groups) ops instead of O(#tensors)
+        # dynamic-update-slices (a 100-layer trunk compiles the same as a
+        # 5-layer one). Entries with no updater (fixconn frozen weights,
+        # BN running stats) keep gid -1 and are never selected.
+        groups: List[object] = []
+        gid_of: Dict[tuple, int] = {}
+        gid_map = np.full((len(entries), F_p), -1, np.int8)
+        for s, es in enumerate(entries):
+            for (i, key, off, shape) in es:
+                up = self.updaters[i].get(key)
+                if up is None:
+                    continue
+                sig = _updater_signature(up)
+                if sig not in gid_of:
+                    check(len(groups) < 127,
+                          "pipeline_parallel: more than 127 distinct "
+                          "updater configurations in packed stages")
+                    gid_of[sig] = len(groups)
+                    groups.append(up)
+                size = int(np.prod(shape)) if shape else 1
+                gid_map[s, off:off + size] = gid_of[sig]
+        self._pp_groups = groups
+        # device-resident and pipe-sharded: closing over a committed Array
+        # makes it a hoisted jit const that KEEPS its sharding — an inline
+        # np constant would be replicated per device (k*F_p bytes, more
+        # than the 4*F_p param shard it selects over)
+        self._pp_gid = jax.device_put(gid_map, sh)
         for es in entries:
             for (i, key, _, _) in es:
                 del self.params[i][key]
@@ -430,6 +505,8 @@ class Trainer:
         self._pp_entries = None
         self._pp_entry_index = {}
         self._pp_stages = None
+        self._pp_groups = []
+        self._pp_gid = None
         self.grad_accum = None   # tree structure changed
         self._jit_cache.clear()
 
@@ -682,36 +759,34 @@ class Trainer:
                 new_params[i][key] = w
                 new_opt[i][key] = st
         if self._pp_entries is not None:
-            # stage-packed params: run each tensor's updater on its slice
-            # of the (k, F_p) array. Static row/offset indexing — XLA keeps
-            # every update on the rank owning that stage's shard
+            # stage-packed params: ONE vectorized elementwise update per
+            # updater-config group over the whole (k, F_p) array, selected
+            # by the static group-id map built at pack time — compile cost
+            # O(#groups), not O(#tensors), so a 100-layer trunk compiles
+            # like a 5-layer one. gid -1 (fixconn frozen weights, BN
+            # running stats, row padding) is never selected: those elements
+            # keep their values even where their grads are nonzero
+            # (fixconn weights participate in the forward), matching the
+            # reference's frozen-weight skip.
             packed = params[-1][self._PACKED]
             gpk = grads[-1][self._PACKED]
             spk = opt_state[-1][self._PACKED]
+            gid = self._pp_gid   # pipe-sharded device array (see _pp_pack)
             new_pk = packed
-            new_spk = {sk: v for sk, v in spk.items()}
-            for s, es in enumerate(self._pp_entries):
-                for (i, key, off, shape) in es:
-                    up = self.updaters[i].get(key)
-                    if up is None:
-                        continue   # frozen weight (fixconn): no update
-                    size = int(np.prod(shape)) if shape else 1
-                    w = packed[s, off:off + size].reshape(shape)
-                    g = gpk[s, off:off + size].reshape(shape)
-                    sub = {sk: v[s, off:off + size].reshape(shape)
-                           for sk, v in spk.items()}
-                    w2, sub2 = up.apply(w, g, sub, epoch)
-                    new_pk = new_pk.at[s, off:off + size].set(
-                        w2.ravel().astype(new_pk.dtype))
-                    for sk, v2 in sub2.items():
-                        new_spk[sk] = new_spk[sk].at[
-                            s, off:off + size].set(
-                                v2.ravel().astype(new_spk[sk].dtype))
+            new_spk = dict(spk)
+            for g_id, up in enumerate(self._pp_groups):
+                w2, st2 = up.apply(packed, gpk, spk, epoch)
+                sel = gid == np.int8(g_id)
+                new_pk = jnp.where(sel, w2, new_pk)
+                for sk, v2 in st2.items():
+                    new_spk[sk] = jnp.where(sel, v2, new_spk[sk])
             sh = NamedSharding(self.mesh, P("pipe", None))
+            opt_sh = NamedSharding(self.mesh, P("pipe", "data")) \
+                if self._pp_zero1() else sh
             new_params[-1][self._PACKED] = \
                 jax.lax.with_sharding_constraint(new_pk, sh)
             new_opt[-1][self._PACKED] = {
-                sk: jax.lax.with_sharding_constraint(v, sh)
+                sk: jax.lax.with_sharding_constraint(v, opt_sh)
                 for sk, v in new_spk.items()}
         fsdp_sh = getattr(self, "_fsdp_shardings", None)
         if fsdp_sh is not None:
@@ -745,7 +820,12 @@ class Trainer:
             from ..parallel.sharding import shard_opt_state_with_specs
             base = getattr(self, "_tp_shardings", None)
             if self._pp_entries is not None:
-                sh = NamedSharding(self.mesh, P("pipe", None))
+                # keep the ZeRO-1 (pipe, data) placement when fsdp is also
+                # on — update_on_server must not undo the stronger split
+                sh = NamedSharding(
+                    self.mesh,
+                    P("pipe", "data") if self._pp_zero1() else
+                    P("pipe", None))
                 base = list(base) if base is not None else \
                     [{} for _ in range(len(new_opt) - 1)]
                 base = base + [{self._PACKED: sh}]
@@ -947,15 +1027,22 @@ class Trainer:
 
         node_name: "" = the last node (the pred/pred_raw surface), else a
         named node or top[-k] (the extract surface). batch_size: 0 = the
-        training batch size. compat=True exports with maximum platform
-        compatibility (CPU + TPU lowering).
+        training batch size; -1 = a SYMBOLIC batch dim — one artifact
+        serves any batch size n >= 1 (jax.export shape polymorphism; the
+        serving runtime re-specializes per distinct n and caches, so a
+        latency-sensitive deployment still sees fixed-shape executables).
+        compat=True exports with maximum platform compatibility (CPU +
+        TPU lowering).
         """
         from jax import export as jexport
         check(self.params is not None,
               "export_forward: init_model/load_model first")
         node_id = (self.net_cfg.param.num_nodes - 1 if not node_name
                    else self._resolve_node(node_name))
-        bs = batch_size or self.batch_size
+        if batch_size < 0:
+            (bs,) = jexport.symbolic_shape("b")
+        else:
+            bs = batch_size or self.batch_size
         c, h, w = self.net_cfg.param.input_shape
         # a serving artifact is single-device: gather any sharded/packed
         # params to host canonical form and trace a mesh-free forward

@@ -163,6 +163,24 @@ def _max_pool_pallas_bwd(kernel, stride, padding, res, g):
 _max_pool_pallas.defvjp(_max_pool_pallas_fwd, _max_pool_pallas_bwd)
 
 
+# CXXNET_POOL=pallas fall-back accounting: an A/B run must be able to tell
+# which kernel each pool layer actually executed (a silent fall-back to
+# select-and-scatter would be measured as if it were the Pallas kernel).
+# One warning per distinct (reason, shape); the counter is inspectable.
+pool_pallas_fallbacks: dict = {}
+
+
+def _note_pool_fallback(reason: str, shape) -> None:
+    key = (reason, tuple(shape))
+    first = key not in pool_pallas_fallbacks
+    pool_pallas_fallbacks[key] = pool_pallas_fallbacks.get(key, 0) + 1
+    if first:
+        import sys
+        print("cxxnet_tpu: CXXNET_POOL=pallas fell back to "
+              "select-and-scatter for pool input %s (%s)"
+              % (tuple(shape), reason), file=sys.stderr)
+
+
 def pool2d(x: jnp.ndarray, mode: str, kernel: Tuple[int, int], stride: int,
            pad: Tuple[int, int] = (0, 0),
            layout: str = "NCHW") -> jnp.ndarray:
@@ -197,11 +215,17 @@ def pool2d(x: jnp.ndarray, mode: str, kernel: Tuple[int, int], stride: int,
         padding = [(0, 0), (0, 0), (py, py + ph), (px, px + pw)]
     if mode == "max":
         pool_knob = os.environ.get("CXXNET_POOL")
-        if pool_knob == "pallas" and layout == "NHWC":
-            from . import pallas_kernels
-            if pallas_kernels.maxpool_bwd_supported(x.shape):
-                return _max_pool_pallas(
-                    x, kernel, stride, ((py, py + ph), (px, px + pw)))
+        if pool_knob == "pallas":
+            if layout == "NHWC":
+                from . import pallas_kernels
+                if pallas_kernels.maxpool_bwd_supported(
+                        x.shape, kernel, stride, (py, px, ph, pw),
+                        x.dtype.itemsize):
+                    return _max_pool_pallas(
+                        x, kernel, stride, ((py, py + ph), (px, px + pw)))
+                _note_pool_fallback("vmem_gate", x.shape)
+            else:
+                _note_pool_fallback("nchw_layout", x.shape)
         if pool_knob == "mask":
             # the mask VJP kernel is written for NCHW; wrap for NHWC
             # (opt-in knob — the transposes are acceptable there)

@@ -263,11 +263,28 @@ def maxpool_bwd_nhwc(x, y, g, kernel, stride, pad_lo, pad_hi,
     )(x, y, g)
 
 
-def maxpool_bwd_supported(shape_nhwc) -> bool:
-    """Conservative VMEM gate: the kernel holds ~6 plane-sized arrays per
-    grid step; keep the plane under ~2 MB so the whole working set sits
-    in the 16 MB VMEM with headroom. Covers every GoogLeNet inception
-    pool tower and stage pool; the 112x112 stem pool stays on XLA
-    select-and-scatter."""
+def maxpool_bwd_supported(shape_nhwc, kernel=(2, 2), stride=2,
+                          pad=(0, 0, 0, 0), dtype_bytes=4) -> bool:
+    """Conservative VMEM gate sized from the PADDED plane the kernel
+    actually materializes (not the logical input): per grid step it holds
+    the padded input (input dtype), the padded f32 accumulator, the
+    dilated y/g planes when stride > 1 (approaching padded-plane size),
+    and the in/out blocks. Budget 12 MB of the 16 MB VMEM. Covers every
+    GoogLeNet inception pool tower and stage pool; the 112x112 stem pool
+    stays on XLA select-and-scatter."""
     _, h, w, c = shape_nhwc
-    return h * w * c * 4 <= 2 * 1024 * 1024
+    py, px, ph, pw = pad
+    # pool2d pads lo=py, hi=py+ph (symmetric ceil-mode extra): the plane
+    # the kernel materializes is h + 2*py + ph, not h + py + ph
+    hp, wp = h + 2 * py + ph, w + 2 * px + pw
+    plane = hp * wp * c
+    bytes_ = plane * (dtype_bytes      # padded input xp
+                      + 4              # f32 accumulator dxp
+                      + dtype_bytes)   # output block dx
+    if stride > 1:
+        bytes_ += 2 * plane * dtype_bytes   # dilated y and g lattices
+    else:
+        oh = (hp - kernel[0]) // stride + 1
+        ow = (wp - kernel[1]) // stride + 1
+        bytes_ += 2 * oh * ow * c * dtype_bytes   # y and g blocks
+    return bytes_ <= 12 * 1024 * 1024

@@ -22,8 +22,11 @@ K/V block so each block arrives home with every device's contribution.
 
 Validated in interpret mode on CPU against the dense reference
 (tests/test_ring_flash.py) and compiled on the chip by
-tools/check_tpu_kernels.py. Opt-in via CXXNET_RING=flash until the
-on-chip pass blesses it (see doc/multichip.md).
+tools/check_tpu_kernels.py. Default ON wherever the kernels run (the
+on-chip pass blessed it); CXXNET_RING=dense is the opt-out and
+CXXNET_RING=flash forces the kernel path even off-TPU (Pallas
+interpreter) — see parallel/ring.py _ring_flash_enabled and
+doc/performance.md's knob table.
 """
 
 from __future__ import annotations

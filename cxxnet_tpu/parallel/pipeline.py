@@ -169,6 +169,14 @@ def pipeline_apply_stages(stage_fns, params, x, mesh: Mesh, *,
     n_micro = x.shape[0]
     bspec = P(None, batch_spec, None) if batch_spec else P()
     pspec = params_spec if params_spec is not None else P()
+    # Every mesh axis is MANUAL here, including a composed ``model`` axis:
+    # stage bodies do tensor parallelism with explicit group-local
+    # collectives (fullc all-gathers its column-parallel outputs over model
+    # pairs at its own pipe rank). Leaving model automatic instead is a
+    # DEADLOCK: Shardy would insert 8-participant resharding collectives
+    # inside the rank-divergent lax.switch branches, and devices at other
+    # pipe ranks never arrive at them. Manual model collectives lower with
+    # replica groups that never span pipe ranks, so divergence is safe.
     if state0 is None:
         fn = shard_map(
             functools.partial(_pipeline_local_switch, axis_name=axis,

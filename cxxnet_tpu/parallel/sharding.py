@@ -164,29 +164,34 @@ def param_shardings(mesh: Mesh, layers, params):
       specs.
 
     Everything else (biases, norms, embeddings) is replicated."""
-    has_model = "model" in mesh.axis_names
-    has_ep = "ep" in mesh.axis_names
-    n_model = mesh.shape["model"] if has_model else 1
     out = []
     for lay, p in zip(layers, params):
         shard = {}
         for key, val in p.items():
             shape = getattr(val, "shape", ())
-            tname = getattr(lay, "type_name", "")
-            spec = P()
-            if has_model:
-                if (tname == "fullc" and key == "wmat"
-                        and len(shape) == 2 and shape[0] % n_model == 0):
-                    spec = P("model", None)
-                elif (tname == "conv" and key == "wmat"
-                        and len(shape) == 3 and shape[1] % n_model == 0):
-                    spec = P(None, "model", None)
-            if (spec == P() and has_ep and tname == "moe"
-                    and key == "experts"
-                    and shape[0] % mesh.shape["ep"] == 0):
-                spec = P("ep", None, None)
-            shard[key] = NamedSharding(mesh, spec)
+            shard[key] = NamedSharding(mesh, tp_spec(lay, key, shape, mesh))
         out.append(shard)
     return out
+
+
+def tp_spec(lay, key, shape, mesh: Mesh) -> P:
+    """The tensor/expert-parallel PartitionSpec for one weight tensor.
+    Drives the GSPMD placements of the NON-pipelined path; pipelined stage
+    bodies instead do manual TP (layers read ctx.manual_tp and slice +
+    all-gather themselves — see parallel/pipeline.py on why GSPMD
+    placements cannot reach inside the stage shard_map)."""
+    tname = getattr(lay, "type_name", "")
+    if "model" in mesh.axis_names:
+        n_model = mesh.shape["model"]
+        if (tname == "fullc" and key == "wmat"
+                and len(shape) == 2 and shape[0] % n_model == 0):
+            return P("model", None)
+        if (tname == "conv" and key == "wmat"
+                and len(shape) == 3 and shape[1] % n_model == 0):
+            return P(None, "model", None)
+    if (tname == "moe" and key == "experts" and "ep" in mesh.axis_names
+            and len(shape) >= 1 and shape[0] % mesh.shape["ep"] == 0):
+        return P("ep", None, None)
+    return P()
 
 

@@ -155,11 +155,17 @@ class TestComposedMesh:
             ref.update(b)
         _assert_params_match(tr, ref)
 
-    def test_pp_rejects_other_axes(self):
+    def test_pp_rejects_sp_ep_axes(self):
+        """pp composes with dp and tp; sp/ep layers open their own
+        shard_map, which cannot nest inside the pipeline's."""
         with pytest.raises(Exception, match="pipeline_parallel composes"):
             _trainer(ATT_CONF,
                      "dev = cpu:0-7\npipeline_parallel = 2\n"
-                     "model_parallel = 2\n")
+                     "seq_parallel = 2\n")
+        with pytest.raises(Exception, match="pipeline_parallel composes"):
+            _trainer(MOE_CONF,
+                     "dev = cpu:0-7\npipeline_parallel = 2\n"
+                     "expert_parallel = 2\n")
 
     def test_rejects_indivisible_device_count(self):
         with pytest.raises(Exception, match="divisible"):
@@ -377,6 +383,136 @@ eta = 0.1
         assert np.isfinite(
             np.asarray(tr.canonical_params()[0]["wmat"])).all()
 
+    PP_CONF = """
+netconfig = start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 24
+  init_sigma = 0.1
+layer[+1] = relu
+layer[+1:fc2] = fullc:fc2
+  nhidden = 12
+  init_sigma = 0.1
+layer[+1] = relu
+layer[+1:fc3] = fullc:fc3
+  nhidden = 6
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig = end
+input_shape = 1,1,10
+batch_size = 16
+eta = 0.1
+momentum = 0.9
+"""
+
+    def test_pp_tp_dp_three_axis_matches(self):
+        """pp x tp x dp on one mesh: stage bodies run MANUAL column-TP
+        (fullc slices its model-rank's weight rows and all-gathers the
+        outputs over model pairs local to its pipe rank — ctx.manual_tp).
+        Numerics match both the single-device net and the pp-only run."""
+        tr = _trainer(self.PP_CONF,
+                      "dev = cpu:0-7\npipeline_parallel = 2\n"
+                      "model_parallel = 2\n")
+        tr_pp = _trainer(self.PP_CONF,
+                         "dev = cpu:0-3\npipeline_parallel = 2\n")
+        ref = _trainer(self.PP_CONF, "dev = cpu\n")
+        assert tr.mesh.axis_names == ("data", "pipe", "model")
+        assert (tr.mesh.shape["data"], tr.mesh.shape["pipe"],
+                tr.mesh.shape["model"]) == (2, 2, 2)
+        for b in _batches((1, 1, 10), 6):
+            tr.update(b)
+            tr_pp.update(b)
+            ref.update(b)
+        for p_t, p_p, p_r in zip(tr.canonical_params(),
+                                 tr_pp.canonical_params(), ref.params):
+            for key in p_r:
+                np.testing.assert_allclose(
+                    np.asarray(p_t[key]), np.asarray(p_r[key]),
+                    rtol=2e-4, atol=2e-4, err_msg="pp.tp vs 1dev %s" % key)
+                np.testing.assert_allclose(
+                    np.asarray(p_t[key]), np.asarray(p_p[key]),
+                    rtol=2e-4, atol=2e-4, err_msg="pp.tp vs pp %s" % key)
+        b = _batches((1, 1, 10), 6, n=1)[0]
+        np.testing.assert_allclose(tr.predict(b), ref.predict(b))
+
+    def test_pp_fsdp_zero1_opt_bytes_and_numerics(self):
+        """fsdp x pp = ZeRO-1 inside stages: packed optimizer state is
+        sharded (pipe, data) — each device owns 1/(k*dp) of the opt bytes —
+        and numerics still match the plain pp run."""
+        tr = _trainer(self.PP_CONF,
+                      "dev = cpu:0-7\npipeline_parallel = 2\nfsdp = 1\n")
+        ref = _trainer(self.PP_CONF,
+                       "dev = cpu:0-7\npipeline_parallel = 2\n")
+        assert (tr.mesh.shape["data"], tr.mesh.shape["pipe"]) == (4, 2)
+        for b in _batches((1, 1, 10), 6):
+            tr.update(b)
+            ref.update(b)
+        packed_m = tr.opt_state[-1][tr._PACKED]["m"]
+        k, F_p = packed_m.shape
+        shard = packed_m.addressable_shards[0]
+        frac = np.asarray(shard.data).size / packed_m.size
+        assert frac <= 1 / 8 + 1e-9, frac
+        # params themselves stay pipe-sharded only (1/k rows, full F_p)
+        packed_w = tr.params[-1][tr._PACKED]
+        wfrac = np.asarray(packed_w.addressable_shards[0].data).size \
+            / packed_w.size
+        assert abs(wfrac - 1 / 2) < 1e-9, wfrac
+        for p_t, p_r in zip(tr.canonical_params(), ref.canonical_params()):
+            for key in p_r:
+                np.testing.assert_allclose(
+                    np.asarray(p_t[key]), np.asarray(p_r[key]),
+                    rtol=2e-4, atol=2e-4, err_msg=key)
+
+    def test_pp_fsdp_with_update_on_server_keeps_zero1(self):
+        """update_on_server=1 on top of fsdp x pp must not override the
+        stronger (pipe, data) opt-state split back to (pipe, None)."""
+        tr = _trainer(self.PP_CONF,
+                      "dev = cpu:0-7\npipeline_parallel = 2\nfsdp = 1\n"
+                      "update_on_server = 1\n")
+        for b in _batches((1, 1, 10), 6, n=2):
+            tr.update(b)
+        packed_m = tr.opt_state[-1][tr._PACKED]["m"]
+        frac = np.asarray(
+            packed_m.addressable_shards[0].data).size / packed_m.size
+        assert frac <= 1 / 8 + 1e-9, frac
+
+    def test_pp_deep_trunk_compiles_bounded(self):
+        """PP at depth: a 52-layer trunk under pipeline_parallel=4 + bf16
+        compiles in bounded time and trains finitely. The vectorized group
+        update keeps the step program O(#updater groups) — the old
+        per-tensor loop emitted one dynamic-update-slice per tensor per
+        state key, which at this depth would dominate compile time."""
+        import time
+        n_blocks = 26
+        layers = "".join(
+            "layer[+1:d%d] = fullc:d%d\n  nhidden = 32\n"
+            "  init_sigma = 0.1\nlayer[+1] = relu\n" % (i, i)
+            for i in range(n_blocks))
+        CONF = ("netconfig = start\n" + layers +
+                "layer[+1:out] = fullc:out\n  nhidden = 4\n"
+                "  init_sigma = 0.1\nlayer[+0] = softmax\n"
+                "netconfig = end\n"
+                "input_shape = 1,1,32\nbatch_size = 16\neta = 0.05\n"
+                "momentum = 0.9\n")
+        t0 = time.time()
+        tr = _trainer(CONF, "dev = cpu:0-7\npipeline_parallel = 4\n"
+                            "compute_dtype = bfloat16\n")
+        # one updater-config group: the whole 52-tensor packed update is a
+        # single elementwise program + one select
+        assert len(tr._pp_groups) == 1
+        bs = _batches((1, 1, 32), 4, n=3)
+        tr.update(bs[0])
+        dt = time.time() - t0
+        print("deep-pp 52-layer trunk: init+compile+first step %.1fs" % dt)
+        assert dt < 600, "compile time blew up at depth: %.0fs" % dt
+        t1 = time.time()
+        for b in bs[1:]:
+            tr.update(b)
+        assert time.time() - t1 < 30, "steady-state step is not cached"
+        canon = tr.canonical_params()
+        for p in canon:
+            for v in p.values():
+                assert np.isfinite(np.asarray(v, np.float32)).all()
+
     def test_uniform_mlp_bytes_one_kth(self):
         """Uniform deep MLP: balanced stages ⇒ per-device param bytes
         ~1/k of the prefix total."""
@@ -402,6 +538,37 @@ eta = 0.1
             tr.update(b)
         assert np.isfinite(
             np.asarray(tr.canonical_params()[0]["wmat"])).all()
+
+
+class TestViTCompose:
+    """ViT x (tp, sp) exactness (VERDICT r3 item 6: the im2seq/ViT family
+    had no composed-parallelism rows): patch-embed conv -> im2seq ->
+    attention blocks trained on a composed mesh must match the
+    single-device net. AdamW updater (the ViT recipe), so this also pins
+    tp/sp exactness under a second optimizer family."""
+
+    def _vit(self, dev, extra=""):
+        from cxxnet_tpu.models import vit_trainer
+        return vit_trainer(n_class=4, image_hw=8, patch=2, dim=16,
+                           nhead=4, nlayer=2, ffn_mult=2, batch_size=16,
+                           dev=dev, extra_cfg=extra)
+
+    def test_vit_tp_sp_matches_single_device(self):
+        tr = self._vit("cpu:0-7",
+                       "model_parallel = 2\nseq_parallel = 2\n")
+        ref = self._vit("cpu")
+        assert tr.mesh.axis_names == ("data", "sp", "model")
+        # the FFN fullc weights actually carry the model split
+        sh = tr._tp_shardings
+        ffn = [i for i, lay in enumerate(tr.net.layers)
+               if getattr(lay, "type_name", "") == "fullc"]
+        assert any("model" in str(sh[i]["wmat"].spec) for i in ffn)
+        for b in _batches((3, 8, 8), 4):
+            tr.update(b)
+            ref.update(b)
+        _assert_params_match(tr, ref, rtol=5e-4, atol=5e-4)
+        b = _batches((3, 8, 8), 4, n=1)[0]
+        np.testing.assert_array_equal(tr.predict(b), ref.predict(b))
 
 
 class TestWideTensorParallel:

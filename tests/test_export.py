@@ -83,6 +83,20 @@ def test_export_named_node_and_batch_override(tmp_path):
                                rtol=1e-6, atol=1e-7)
 
 
+def test_export_symbolic_batch_serves_any_n(tmp_path):
+    """batch_size=-1 exports ONE artifact with a symbolic batch dim: it
+    serves batch 1, 3, and 8 and matches the per-batch fixed exports."""
+    tr, b = _trained()
+    path = str(tmp_path / "sym.stablehlo")
+    with open(path, "wb") as f:
+        f.write(tr.export_forward(batch_size=-1))
+    fn = api.load_exported(path)
+    want = np.asarray(tr.extract_feature(b, "top[-1]")).reshape(8, -1)
+    for n in (1, 3, 8):
+        got = np.asarray(fn(b.data[:n])).reshape(n, -1)
+        np.testing.assert_allclose(got, want[:n], rtol=1e-5, atol=1e-6)
+
+
 def test_export_channels_last_artifact_is_nchw(tmp_path):
     """The artifact's contract is reference-NCHW regardless of the
     internal device layout it was exported under."""

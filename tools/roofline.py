@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""Analytic FLOPs + MFU accounting for the model zoo (VERDICT r3 items 1/5:
+"no MFU accounting" / "per-model MFU%").
+
+Counts matmul-class FLOPs per image/token from each net's weight shapes and
+node geometry (the same counting rule the scaling literature uses: 2*MACs
+forward; training = 3x forward for the fwd + dgrad + wgrad passes), then
+converts a measured images/sec rate into MFU% against the chip's bf16 peak.
+
+Usage:
+  python tools/roofline.py                # FLOPs/img table for the zoo
+  python tools/roofline.py --bench f.json # + MFU% from bench JSON lines
+                                          #   (BENCH_r*.json or onchip_logs)
+  python tools/roofline.py --rate googlenet=4700 --rate alexnet=18300
+
+The elementwise/pool/norm ops are NOT counted (sub-1% of FLOPs on every zoo
+model); their cost shows up as the gap between MFU% and 100%, which is the
+point of the metric.
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+
+# bf16 peak per chip. v5e ("v5 lite"): 197 TFLOP/s. Override for other
+# generations with CXXNET_PEAK_TFLOPS.
+PEAK_TFLOPS = {"v5e": 197.0, "v5lite": 197.0, "v4": 275.0, "v6e": 918.0}
+
+
+def peak_flops() -> float:
+    env = os.environ.get("CXXNET_PEAK_TFLOPS")
+    if env:
+        return float(env) * 1e12
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e").lower()
+    return PEAK_TFLOPS.get(gen, 197.0) * 1e12
+
+
+def net_flops_per_sample(tr) -> float:
+    """Forward matmul-class FLOPs for ONE sample of the trainer's net.
+
+    conv:   2 * prod(wmat.shape) * Ho * Wo   (wmat is (g, co/g, ci/g*k*k))
+    fullc:  2 * prod(wmat.shape)
+    moe:    2 * E * din * dout (dense dispatch — every expert runs)
+    attention: 4 * L * W * d_model score+AV FLOPs (W = attn_window or L)
+               + 2 * prod per projection weight
+    embed:  0 (gather).  Shared layers count once per APPLICATION.
+    """
+    net, cfg = tr.net, tr.net.cfg
+    batch = float(tr.batch_size)
+    total = 0.0
+    params = tr.canonical_params() if hasattr(tr, "canonical_params") \
+        else tr.params
+    for i, lay in enumerate(net.layers):
+        info = cfg.layers[i]
+        pidx = info.primary_layer_index if net.is_shared[i] else i
+        p = params[pidx]
+        tname = getattr(lay, "type_name", "")
+        if tname == "embed":
+            continue
+        f = 0.0
+        for key, w in p.items():
+            shape = np.shape(w)
+            if key in getattr(lay, "state_keys", lambda: ())():
+                continue
+            if len(shape) < 2:
+                continue
+            f += 2.0 * float(np.prod(shape))
+        if tname == "conv" and info.nindex_out:
+            b, c, h, w_ = net.node_shapes[info.nindex_out[0]]
+            f *= h * w_
+        if tname == "moe":
+            pass   # experts tensor already counted dense: 2*E*din*dout
+        if tname == "attention":
+            b, d, _, L = net.node_shapes[info.nindex_in[0]]
+            win = getattr(lay, "attn_window", 0) or L
+            causal = getattr(lay, "causal", 0)
+            span = min(win, L)
+            # scores + AV: 2 ops each over (L x span x d); causal halves
+            f += (2.0 if causal else 4.0) * L * span * d
+        total += f
+    return total
+
+
+def zoo(models=None):
+    """(name, trainer-builder, unit) for the bench rows. Construct on CPU
+    — FLOPs are shape arithmetic; no TPU needed."""
+    from cxxnet_tpu import models as M
+
+    def lm(L, extra=""):
+        return lambda: M.transformer_lm_trainer(
+            vocab=8192, seq=L, batch_size=2, dim=512, nhead=8, nlayer=4,
+            dev="cpu", extra_cfg="eval_train = 0\n" + extra)
+
+    table = [
+        ("alexnet", lambda: M.alexnet_trainer(8, 227, dev="cpu"), "img"),
+        ("googlenet", lambda: M.googlenet_trainer(8, 224, dev="cpu"), "img"),
+        ("resnet18", lambda: M.resnet_trainer(8, 224, dev="cpu"), "img"),
+        ("vgg16", lambda: M.vgg_trainer(8, 224, dev="cpu"), "img"),
+        ("vit_s16", lambda: M.vit_trainer(
+            n_class=1000, image_hw=224, patch=16, dim=384, nhead=6,
+            nlayer=12, ffn_mult=4, batch_size=8, dev="cpu"), "img"),
+        ("transformer_lm_L2048", lm(2048), "token"),
+        ("transformer_lm_L8192_gqa_window",
+         lm(8192, "nkvhead = 2\nattn_window = 1024\nrope = 1\n"), "token"),
+        ("mnist_mlp", lambda: M.mnist_mlp_trainer(dev="cpu")
+         if hasattr(M, "mnist_mlp_trainer") else None, "img"),
+    ]
+    out = []
+    for name, build, unit in table:
+        if models and name not in models:
+            continue
+        try:
+            tr = build()
+        except Exception as e:   # model not constructible here: skip, say so
+            print("# %s: skipped (%s)" % (name, e), file=sys.stderr)
+            continue
+        if tr is None:
+            continue
+        f = net_flops_per_sample(tr)
+        if unit == "token":
+            f /= tr.net.cfg.param.input_shape[2]   # per-token, not per-seq
+        out.append((name, f, unit))
+    return out
+
+
+_RATE_KEYS = {
+    "alexnet_imagenet_b1024": "alexnet",
+    "alexnet_imagenet": "alexnet",
+    "googlenet_imagenet": "googlenet",
+    "resnet18_imagenet": "resnet18",
+    "vgg16_imagenet": "vgg16",
+    "vit_s16": "vit_s16",
+    "transformer_lm_L2048": "transformer_lm_L2048",
+    "transformer_lm_L8192_gqa_window": "transformer_lm_L8192_gqa_window",
+}
+
+
+def rates_from_bench(paths):
+    """Parse {metric, value} JSON lines (BENCH_r*.json, onchip_logs/*.log);
+    keep the best rate per model."""
+    rates = {}
+    for path in paths:
+        for line in open(path):
+            line = line.strip()
+            if not (line.startswith("{") and '"metric"' in line):
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            v = row.get("value")
+            if not v:
+                continue
+            for prefix, model in _RATE_KEYS.items():
+                if row.get("metric", "").startswith(prefix):
+                    rates[model] = max(rates.get(model, 0.0), float(v))
+                    break
+    return rates
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bench", action="append", default=[],
+                    help="bench JSON-lines file(s) to pull measured rates")
+    ap.add_argument("--rate", action="append", default=[],
+                    help="model=samples_per_sec override")
+    ap.add_argument("models", nargs="*")
+    args = ap.parse_args()
+    os.environ.setdefault("CXXNET_JAX_PLATFORM", "cpu")
+
+    rates = rates_from_bench(args.bench)
+    for spec in args.rate:
+        k, v = spec.split("=")
+        rates[k] = float(v)
+
+    peak = peak_flops()
+    print("| model | fwd GFLOPs/%s | train GFLOPs/%s | measured/s | MFU%% |"
+          % ("sample", "sample"))
+    print("|---|---|---|---|---|")
+    for name, f, unit in zoo(args.models or None):
+        train_f = 3.0 * f
+        r = rates.get(name)
+        mfu = "%.1f%%" % (100.0 * r * train_f / peak) if r else "—"
+        rs = ("%.0f" % r) if r else "—"
+        print("| %s | %.2f | %.2f | %s | %s |"
+              % (name, f / 1e9, train_f / 1e9, rs, mfu))
+    if not rates:
+        print("\n(no measured rates given: pass --bench BENCH_r04.json or "
+              "--rate model=N; MFU = rate * train_flops / %.0fT peak)"
+              % (peak / 1e12))
+
+
+if __name__ == "__main__":
+    main()
