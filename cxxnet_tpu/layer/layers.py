@@ -30,6 +30,62 @@ def _flat2d(x: jnp.ndarray) -> jnp.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# manual tensor parallelism inside pipeline stage bodies (ctx.manual_tp):
+# output-feature sharding with GROUP-LOCAL collectives. The weight's
+# leading (output) dim is a sequence of contiguous row blocks — one for a
+# plain fullc/conv, g for a grouped conv, one per member for a fused
+# sibling conv — and each model rank computes every block's 1/mp share.
+# The tiled all_gather then returns channels in [rank, block] order;
+# manual_tp_unpermute's static permutation restores the canonical
+# [block, rows] order. ONE implementation serves all three layer paths so
+# their pp x tp semantics cannot drift apart.
+# ---------------------------------------------------------------------------
+def manual_tp_blocks(shape0, blocks, mp):
+    """The row-block sizes along the weight's output dim if every block
+    divides by mp, else None (caller falls back to replicated compute)."""
+    if mp <= 1 or any(n % mp for n in blocks) or sum(blocks) != shape0:
+        return None
+    return blocks
+
+
+def manual_tp_local_rows(w, blocks, mp):
+    """Slice this model rank's share of every row block and concatenate."""
+    midx = jax.lax.axis_index("model")
+    parts, off = [], 0
+    for n in blocks:
+        loc = n // mp
+        parts.append(jax.lax.dynamic_slice_in_dim(
+            w, off + midx * loc, loc, 0))
+        off += n
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, 0)
+
+
+def manual_tp_unpermute(blocks, mp):
+    """Static channel permutation mapping the tiled-gather order
+    [rank, block, local-rows] back to canonical [block, rows]; None when
+    the gather order is already canonical (single block)."""
+    if len(blocks) == 1:
+        return None
+    L = sum(n // mp for n in blocks)
+    perm, off_j = [], 0
+    for n in blocks:
+        loc = n // mp
+        for r in range(mp):
+            perm.extend(range(r * L + off_j, r * L + off_j + loc))
+        off_j += loc
+    return np.asarray(perm)
+
+
+def manual_tp_gather(y, blocks, mp, axis):
+    """Group-local all_gather of the sharded output dim + reorder."""
+    y = jax.lax.all_gather(y, "model", axis=axis, tiled=True)
+    perm = manual_tp_unpermute(blocks, mp)
+    if perm is not None:
+        y = jnp.take(y, perm, axis=axis)
+    return y
+
+
+# ---------------------------------------------------------------------------
 # dense layers
 # ---------------------------------------------------------------------------
 class FullConnectLayer(Layer):
@@ -75,7 +131,10 @@ class FullConnectLayer(Layer):
     def apply(self, params, inputs, ctx):
         x = _flat2d(inputs[0])
         w = params["wmat"]
-        if ctx.manual_tp and w.shape[0] % ctx.mesh.shape["model"] == 0:
+        blocks = manual_tp_blocks(
+            w.shape[0], [w.shape[0]],
+            ctx.mesh.shape["model"] if ctx.manual_tp else 1)
+        if blocks:
             # column parallelism inside a pipeline stage body (manual
             # shard_map): each model rank computes its slice of the output
             # features and the group-local all-gather rebuilds the full
@@ -85,10 +144,8 @@ class FullConnectLayer(Layer):
             # input ⇒ summed cotangents), mirroring fullc_gather's local
             # recompute (src/updater/async_updater-inl.hpp:67-92).
             mp = ctx.mesh.shape["model"]
-            loc = w.shape[0] // mp
-            midx = jax.lax.axis_index("model")
-            w_l = jax.lax.dynamic_slice_in_dim(w, midx * loc, loc, 0)
-            y = jax.lax.all_gather(x @ w_l.T, "model", axis=1, tiled=True)
+            y = manual_tp_gather(x @ manual_tp_local_rows(w, blocks, mp).T,
+                                 blocks, mp, axis=1)
         else:
             y = x @ w.T
         if self.param.no_bias == 0:
@@ -580,9 +637,29 @@ class ConvolutionLayer(Layer):
     def apply(self, params, inputs, ctx):
         p = self.param
         layout = "NHWC" if ctx.channels_last else "NCHW"
-        y = ops.conv2d(inputs[0], self._kernel_oihw(params["wmat"]),
-                       stride=p.stride, pad=(p.pad_y, p.pad_x),
-                       groups=p.num_group, layout=layout)
+        w = self._kernel_oihw(params["wmat"])
+        mp = ctx.mesh.shape["model"] if ctx.manual_tp else 1
+        g = p.num_group
+        blocks = manual_tp_blocks(p.num_channel, [p.num_channel // g] * g,
+                                  mp)
+        if blocks:
+            # output-feature-sharded convolution inside a pipeline stage
+            # body (the manual twin of tp_spec's P(None, "model", None)
+            # GSPMD placement): each model rank convolves its 1/mp share
+            # of every group's output channels (group structure survives:
+            # every group shrinks equally) and the group-local all-gather
+            # + unpermute rebuilds the canonical map — same split the
+            # reference's ngroup put in-layer
+            # (src/layer/convolution_layer-inl.hpp:92-96)
+            y = ops.conv2d(inputs[0], manual_tp_local_rows(w, blocks, mp),
+                           stride=p.stride, pad=(p.pad_y, p.pad_x),
+                           groups=g, layout=layout)
+            y = manual_tp_gather(y, blocks, mp,
+                                 axis=3 if ctx.channels_last else 1)
+        else:
+            y = ops.conv2d(inputs[0], w, stride=p.stride,
+                           pad=(p.pad_y, p.pad_x),
+                           groups=g, layout=layout)
         if p.no_bias == 0:
             bshape = (1, 1, 1, -1) if ctx.channels_last else (1, -1, 1, 1)
             y = y + params["bias"].reshape(bshape)

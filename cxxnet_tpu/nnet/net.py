@@ -263,10 +263,16 @@ class NeuralNet:
         return ops.to_nhwc(v) if to == "NHWC" else ops.to_nchw(v)
 
     def _apply_fused_siblings(self, g: List[int], params, values,
-                              layouts) -> None:
+                              layouts, ctx=None) -> None:
         """One conv over the concatenated (along O) member kernels, sliced
         back to each member's output node. When every member asks for
-        ``remat``, the fused conv is checkpointed as a unit."""
+        ``remat``, the fused conv is checkpointed as a unit. Inside a
+        pipeline stage body (ctx.manual_tp) the fused kernel takes the
+        same manual output-feature sharding as a plain conv — each model
+        rank convolves every member's 1/mp share and the group-local
+        gather + unpermute restores the canonical member order."""
+        from ..layer.layers import (manual_tp_blocks, manual_tp_local_rows,
+                                    manual_tp_gather)
         cfg = self.cfg
         p0 = self.layers[g[0]].param
         n_in = cfg.layers[g[0]].nindex_in[0]
@@ -277,13 +283,24 @@ class NeuralNet:
             x = self._relayout(x, layouts[n_in], want)
             values[n_in] = x
             layouts[n_in] = want
+        mp = (ctx.mesh.shape["model"]
+              if ctx is not None and ctx.manual_tp else 1)
+        member_ch = [self.layers[j].param.num_channel for j in g]
+        tp_blocks = manual_tp_blocks(sum(member_ch), member_ch, mp)
 
         def fused(xv, member_params):
             w = jnp.concatenate(
                 [self.layers[j]._kernel_oihw(member_params[k]["wmat"])
                  for k, j in enumerate(g)], axis=0)
-            y = ops.conv2d(xv, w, stride=p0.stride,
-                           pad=(p0.pad_y, p0.pad_x), layout=want)
+            if tp_blocks:
+                y = ops.conv2d(xv, manual_tp_local_rows(w, tp_blocks, mp),
+                               stride=p0.stride, pad=(p0.pad_y, p0.pad_x),
+                               layout=want)
+                y = manual_tp_gather(y, tp_blocks, mp,
+                                     axis=3 if want == "NHWC" else 1)
+            else:
+                y = ops.conv2d(xv, w, stride=p0.stride,
+                               pad=(p0.pad_y, p0.pad_x), layout=want)
             if p0.no_bias == 0:
                 b = jnp.concatenate(
                     [member_params[k]["bias"] for k in range(len(g))])
@@ -344,7 +361,8 @@ class NeuralNet:
                 continue
             g = fuse_groups.get(i)
             if g is not None and g[-1] < hi:
-                self._apply_fused_siblings(g, params, values, layouts)
+                self._apply_fused_siblings(g, params, values, layouts,
+                                           ctx=ctx)
                 fused_done.update(g)
                 continue
             info = cfg.layers[i]
