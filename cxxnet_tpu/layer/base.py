@@ -189,11 +189,12 @@ class ApplyContext:
     # forward loop for layers declaring layout_support == "nhwc"; logical
     # shapes, params, and checkpoints stay reference-NCHW throughout
     channels_last: bool = False
-    # True when the layer is applying INSIDE a pipeline stage body on a
-    # mesh that also carries a ``model`` axis: the body is a manual
-    # shard_map, so tensor parallelism must be explicit — slice the local
-    # weight shard by lax.axis_index("model") and all-gather the outputs
-    # (group-local; see parallel/pipeline.py on why GSPMD can't do it here)
+    # True when the layer is applying INSIDE a pipeline stage body: the
+    # body is a manual shard_map over EVERY mesh axis, so any composed
+    # parallelism must be explicit — a layer whose axis is on the mesh
+    # ("model" for fullc/conv TP, "ep" for moe) slices its local weight
+    # shard by lax.axis_index and combines with group-local collectives
+    # (see parallel/pipeline.py on why GSPMD can't do it here)
     manual_tp: bool = False
 
 

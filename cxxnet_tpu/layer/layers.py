@@ -40,6 +40,15 @@ def _flat2d(x: jnp.ndarray) -> jnp.ndarray:
 # [block, rows] order. ONE implementation serves all three layer paths so
 # their pp x tp semantics cannot drift apart.
 # ---------------------------------------------------------------------------
+def manual_axis_size(ctx, axis):
+    """Size of a composed mesh axis when applying inside a pipeline stage
+    body (ctx.manual_tp), else 1 — layers use it to decide whether their
+    manual-parallel path engages."""
+    if not ctx.manual_tp or ctx.mesh is None:
+        return 1
+    return ctx.mesh.shape[axis] if axis in ctx.mesh.axis_names else 1
+
+
 def manual_tp_blocks(shape0, blocks, mp):
     """The row-block sizes along the weight's output dim if every block
     divides by mp, else None (caller falls back to replicated compute)."""
@@ -131,9 +140,8 @@ class FullConnectLayer(Layer):
     def apply(self, params, inputs, ctx):
         x = _flat2d(inputs[0])
         w = params["wmat"]
-        blocks = manual_tp_blocks(
-            w.shape[0], [w.shape[0]],
-            ctx.mesh.shape["model"] if ctx.manual_tp else 1)
+        mp = manual_axis_size(ctx, "model")
+        blocks = manual_tp_blocks(w.shape[0], [w.shape[0]], mp)
         if blocks:
             # column parallelism inside a pipeline stage body (manual
             # shard_map): each model rank computes its slice of the output
@@ -143,7 +151,6 @@ class FullConnectLayer(Layer):
             # over model comes from the shard_map transpose (replicated
             # input ⇒ summed cotangents), mirroring fullc_gather's local
             # recompute (src/updater/async_updater-inl.hpp:67-92).
-            mp = ctx.mesh.shape["model"]
             y = manual_tp_gather(x @ manual_tp_local_rows(w, blocks, mp).T,
                                  blocks, mp, axis=1)
         else:
@@ -638,7 +645,7 @@ class ConvolutionLayer(Layer):
         p = self.param
         layout = "NHWC" if ctx.channels_last else "NCHW"
         w = self._kernel_oihw(params["wmat"])
-        mp = ctx.mesh.shape["model"] if ctx.manual_tp else 1
+        mp = manual_axis_size(ctx, "model")
         g = p.num_group
         blocks = manual_tp_blocks(p.num_channel, [p.num_channel // g] * g,
                                   mp)
@@ -1233,7 +1240,31 @@ class AttentionLayer(Layer):
         if self.rope:
             q, k = self._apply_rope(q), self._apply_rope(k)
         mesh = ctx.mesh
-        if mesh is not None and "sp" in getattr(mesh, "axis_names", ()):
+        sp_n = manual_axis_size(ctx, "sp")
+        if sp_n > 1:
+            # sequence parallelism inside a pipeline stage body (manual
+            # shard_map): k/v are ALREADY replicated over sp (the pipeline
+            # boundary stream is), so the ring's k/v rotation buys nothing
+            # here — each sp rank computes its own QUERY chunk against the
+            # full k/v with zero communication (global causal offsets via
+            # q_offset) and the group-local gather rebuilds the sequence.
+            # The O(L^2) score memory and FLOPs shard 1/sp per device.
+            # (A ppermute-based ring inside the rank-divergent lax.switch
+            # would deadlock: collective-permute rendezvous is global, not
+            # per-pair — same constraint as the TP design, see
+            # parallel/pipeline.py. psum/all_gather are group-local.)
+            from ..parallel import ring as _ring
+            check(L % sp_n == 0,
+                  "attention: seq length %d must be divisible by "
+                  "seq_parallel %d" % (L, sp_n))
+            sidx = jax.lax.axis_index("sp")
+            chunk = L // sp_n
+            q_l = jax.lax.dynamic_slice_in_dim(q, sidx * chunk, chunk, 2)
+            out_l = _ring.attention_reference(
+                q_l, k, v, causal=bool(self.causal), scale=dh ** -0.5,
+                window=self.attn_window, q_offset=sidx * chunk)
+            out = jax.lax.all_gather(out_l, "sp", axis=2, tiled=True)
+        elif mesh is not None and "sp" in getattr(mesh, "axis_names", ()):
             sp = mesh.shape["sp"]
             check(L % sp == 0,
                   "attention: seq length %d must be divisible by "
@@ -1266,7 +1297,10 @@ class AttentionLayer(Layer):
             # GQA: the kernel reads grouped k/v natively (BlockSpec row
             # map) — K/V HBM traffic stays nkvhead-sized
             causal = bool(self.causal)
-            if mesh is None:
+            if mesh is None or ctx.manual_tp:
+                # inside a pipeline stage body the code is ALREADY
+                # per-device (the stage shard_map sliced the microbatch);
+                # opening another shard_map would nest and fail
                 out = ops.flash_attention(q, k, v, causal=causal,
                                           window=self.attn_window)
             else:
@@ -1500,7 +1534,27 @@ class MoELayer(Layer):
         x2 = x.reshape(b, -1)
         probs = self._gate_probs(x2, params["gate"])
         mesh = ctx.mesh
-        if mesh is not None and "ep" in getattr(mesh, "axis_names", ()):
+        n_ep = manual_axis_size(ctx, "ep")
+        if n_ep > 1:
+            # same contract as expert_parallel_ffn (parallel/tensor.py):
+            # an indivisible expert count fails loudly, not silently dense
+            check(self.n_expert % n_ep == 0,
+                  "expert_parallel_ffn: n_experts %d not divisible by "
+                  "mesh axis 'ep' size %d" % (self.n_expert, n_ep))
+            # expert parallelism inside a pipeline stage body (manual
+            # shard_map): each ep rank runs its slice of the expert stack
+            # densely over all tokens and the group-local psum combines
+            # the gate-weighted outputs — the manual twin of
+            # expert_parallel_ffn's shard_map (which cannot nest here)
+            loc = self.n_expert // n_ep
+            eidx = jax.lax.axis_index("ep")
+            w_l = jax.lax.dynamic_slice_in_dim(params["experts"],
+                                               eidx * loc, loc, 0)
+            p_l = jax.lax.dynamic_slice_in_dim(probs, eidx * loc, loc, 1)
+            y = jnp.maximum(jnp.einsum("bi,eio->ebo", x2, w_l), 0.0)
+            out = jax.lax.psum(jnp.einsum("ebo,be->bo", y, p_l), "ep")
+        elif (not ctx.manual_tp and mesh is not None
+                and "ep" in getattr(mesh, "axis_names", ())):
             batch_axis = "data" if "data" in mesh.axis_names else None
             out = expert_parallel_ffn(x2, params["experts"], probs,
                                       mesh, batch_axis=batch_axis)

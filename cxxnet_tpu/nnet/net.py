@@ -271,8 +271,8 @@ class NeuralNet:
         same manual output-feature sharding as a plain conv — each model
         rank convolves every member's 1/mp share and the group-local
         gather + unpermute restores the canonical member order."""
-        from ..layer.layers import (manual_tp_blocks, manual_tp_local_rows,
-                                    manual_tp_gather)
+        from ..layer.layers import (manual_axis_size, manual_tp_blocks,
+                                    manual_tp_local_rows, manual_tp_gather)
         cfg = self.cfg
         p0 = self.layers[g[0]].param
         n_in = cfg.layers[g[0]].nindex_in[0]
@@ -283,8 +283,7 @@ class NeuralNet:
             x = self._relayout(x, layouts[n_in], want)
             values[n_in] = x
             layouts[n_in] = want
-        mp = (ctx.mesh.shape["model"]
-              if ctx is not None and ctx.manual_tp else 1)
+        mp = manual_axis_size(ctx, "model") if ctx is not None else 1
         member_ch = [self.layers[j].param.num_channel for j in g]
         tp_blocks = manual_tp_blocks(sum(member_ch), member_ch, mp)
 
@@ -715,9 +714,7 @@ class NeuralNet:
         def run_stage_layers(p, padded, s, micro_id, state_in=None):
             lo, hi = stages[s]
             ctx = ApplyContext(train=train, labels=None, epoch=epoch,
-                               mesh=mesh,
-                               manual_tp=("model" in mesh.axis_names
-                                          and mesh.shape["model"] > 1))
+                               mesh=mesh, manual_tp=True)
             own_slots = slots_by_stage.get(s, ())
             if state_in is not None:
                 for (i, key, so, sz, shape) in own_slots:

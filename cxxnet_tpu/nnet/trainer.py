@@ -177,14 +177,18 @@ class Trainer:
         their existing 2-D meshes. dp is derived: whatever device count
         remains after the explicit axes divide it.
 
-        pipeline_parallel composes with data AND tensor parallelism: the
-        composed mesh carries a model axis and stage bodies run tp
-        MANUALLY — fullc slices its column shard and all-gathers outputs
-        over model pairs local to its own pipe rank (group-local
-        collectives; an automatic model axis would instead let Shardy put
-        8-wide resharding collectives inside the rank-divergent lax.switch
-        branches — a deadlock). sp/ep cannot run inside pipeline stages:
-        their layers open their OWN shard_map, and shard_map does not nest.
+        pipeline_parallel composes with EVERY other axis (data, tensor,
+        sequence, expert parallelism): stage bodies run tp/sp/ep MANUALLY
+        — fullc/conv slice their output-feature shard and all-gather over
+        model pairs local to their own pipe rank; attention slices its
+        QUERY chunk and attends to the (already-replicated) full k/v with
+        global causal offsets, sharding the O(L^2) scores 1/sp (NOT a
+        ppermute ring — collective-permute rendezvous is global and would
+        deadlock in the rank-divergent switch branches); moe runs its
+        local expert slice and psums over ep. All collectives are
+        group-local all-reduce/all-gather by construction (an automatic
+        axis would instead let Shardy put mesh-wide resharding
+        collectives inside the divergent branches — a deadlock).
         """
         kind, ids = parallel.parse_device_spec(self.dev_spec)
         parallel.ensure_platform(kind)
@@ -195,10 +199,6 @@ class Trainer:
         sp = self.seq_parallel
         pp = self.pipeline_parallel
         ep = self.expert_parallel
-        check(pp == 1 or (sp == 1 and ep == 1),
-              "pipeline_parallel composes with data and model parallelism "
-              "only; seq/expert parallelism cannot run inside pipeline "
-              "stages (their layers open their own shard_map)")
         ways = mp * sp * pp * ep
         check(n % ways == 0,
               "device count %d must be divisible by model_parallel * "

@@ -34,14 +34,18 @@ from ._compat import shard_map
 
 
 def attention_reference(q, k, v, *, causal: bool = False,
-                        scale: Optional[float] = None, window: int = 0):
+                        scale: Optional[float] = None, window: int = 0,
+                        q_offset=0):
     """Plain single-device attention, the golden model for the parallel
     variants. q: (batch, heads, seq, head_dim); k/v may carry FEWER heads
     (grouped-query attention): nkv must divide nh and each group of
     nh/nkv query heads attends to one shared k/v head — no materialized
     broadcast. window > 0 (requires causal) keeps only the last ``window``
     keys per query — sliding-window attention (Mistral-style local
-    attention)."""
+    attention). ``q_offset`` (static or traced) is the global position of
+    q's first row when q is a chunk of a longer sequence (the in-pipeline
+    sequence-parallel path computes each sp rank's query chunk against
+    the full k/v)."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     assert window == 0 or causal, "window attention requires causal"
@@ -53,7 +57,7 @@ def attention_reference(q, k, v, *, causal: bool = False,
     s = jnp.einsum("bngqd,bnkd->bngqk", qg, k) * scale
     if causal:
         skv = k.shape[2]
-        qpos = jnp.arange(sq)[:, None]
+        qpos = q_offset + jnp.arange(sq)[:, None]
         kpos = jnp.arange(skv)[None, :]
         keep = qpos >= kpos
         if window > 0:

@@ -80,7 +80,7 @@ def _batches(shape, nclass, n=4, batch=16, seed=7):
 
 def _assert_params_match(tr_a, tr_b, rtol=2e-4, atol=2e-4):
     from cxxnet_tpu.parallel import fetch_global
-    for p_a, p_b in zip(tr_a.params, tr_b.params):
+    for p_a, p_b in zip(tr_a.canonical_params(), tr_b.canonical_params()):
         for key in p_b:
             np.testing.assert_allclose(
                 fetch_global(p_a[key]), fetch_global(p_b[key]),
@@ -155,17 +155,53 @@ class TestComposedMesh:
             ref.update(b)
         _assert_params_match(tr, ref)
 
-    def test_pp_rejects_sp_ep_axes(self):
-        """pp composes with dp and tp; sp/ep layers open their own
-        shard_map, which cannot nest inside the pipeline's."""
-        with pytest.raises(Exception, match="pipeline_parallel composes"):
-            _trainer(ATT_CONF,
-                     "dev = cpu:0-7\npipeline_parallel = 2\n"
-                     "seq_parallel = 2\n")
-        with pytest.raises(Exception, match="pipeline_parallel composes"):
-            _trainer(MOE_CONF,
-                     "dev = cpu:0-7\npipeline_parallel = 2\n"
-                     "expert_parallel = 2\n")
+    def test_att_pp_sp_matches_single_device(self):
+        """Attention under pp x sp x dp: the manual in-stage QUERY-chunk
+        slice (vs full replicated k/v, global causal offsets) + gather
+        matches the single-device net — every parallelism axis now
+        composes with the pipeline."""
+        tr = _trainer(ATT_CONF,
+                      "dev = cpu:0-7\npipeline_parallel = 2\n"
+                      "seq_parallel = 2\n")
+        ref = _trainer(ATT_CONF, "dev = cpu\n")
+        assert tr.mesh.axis_names == ("data", "pipe", "sp")
+        for b in _batches((8, 1, 4), 5):
+            tr.update(b)
+            ref.update(b)
+        _assert_params_match(tr, ref)
+        b = _batches((8, 1, 4), 5, n=1)[0]
+        np.testing.assert_array_equal(tr.predict(b), ref.predict(b))
+
+    def test_att_pp_sp_tp_four_axis(self):
+        """The full stack on 8 devices: pipe x sp x model (dp=1) through
+        the attention net, exact vs single-device."""
+        tr = _trainer(ATT_CONF,
+                      "dev = cpu:0-7\npipeline_parallel = 2\n"
+                      "seq_parallel = 2\nmodel_parallel = 2\n")
+        ref = _trainer(ATT_CONF, "dev = cpu\n")
+        assert tr.mesh.axis_names == ("data", "pipe", "sp", "model")
+        assert tr.mesh.shape["data"] == 1
+        for b in _batches((8, 1, 4), 5):
+            tr.update(b)
+            ref.update(b)
+        _assert_params_match(tr, ref)
+
+    def test_moe_pp_ep_matches_single_device(self):
+        """moe under pp x ep x dp: the manual in-stage expert slice + psum
+        matches the single-device dense dispatch."""
+        tr = _trainer(MOE_CONF,
+                      "dev = cpu:0-7\npipeline_parallel = 2\n"
+                      "expert_parallel = 2\n")
+        ref = _trainer(MOE_CONF, "dev = cpu\n")
+        assert tr.mesh.axis_names == ("data", "pipe", "ep")
+        for b in _batches((1, 1, 6), 4):
+            tr.update(b)
+            ref.update(b)
+        for p_t, p_r in zip(tr.canonical_params(), ref.params):
+            for key in p_r:
+                np.testing.assert_allclose(
+                    np.asarray(p_t[key]), np.asarray(p_r[key]),
+                    rtol=2e-4, atol=2e-4, err_msg=key)
 
     def test_rejects_indivisible_device_count(self):
         with pytest.raises(Exception, match="divisible"):
