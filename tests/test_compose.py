@@ -498,6 +498,27 @@ momentum = 0.9
                     np.asarray(p_t[key]), np.asarray(p_r[key]),
                     rtol=2e-4, atol=2e-4, err_msg=key)
 
+    def test_pp_tp_fsdp_three_way(self):
+        """fsdp (ZeRO-1 packed opt state) composed with pp x tp x dp on
+        one mesh: opt bytes 1/(k*dp) per device AND manual in-stage TP,
+        numerics matching the plain pp x tp run."""
+        tr = _trainer(self.PP_CONF,
+                      "dev = cpu:0-7\npipeline_parallel = 2\n"
+                      "model_parallel = 2\nfsdp = 1\n")
+        ref = _trainer(self.PP_CONF,
+                       "dev = cpu:0-7\npipeline_parallel = 2\n"
+                       "model_parallel = 2\n")
+        assert (tr.mesh.shape["data"], tr.mesh.shape["pipe"],
+                tr.mesh.shape["model"]) == (2, 2, 2)
+        for b in _batches((1, 1, 10), 6, n=3):
+            tr.update(b)
+            ref.update(b)
+        packed_m = tr.opt_state[-1][tr._PACKED]["m"]
+        frac = np.asarray(
+            packed_m.addressable_shards[0].data).size / packed_m.size
+        assert frac <= 1 / 4 + 1e-9, frac
+        _assert_params_match(tr, ref)
+
     def test_pp_fsdp_with_update_on_server_keeps_zero1(self):
         """update_on_server=1 on top of fsdp x pp must not override the
         stronger (pipe, data) opt-state split back to (pipe, None)."""
