@@ -80,6 +80,12 @@ def main():
         tail = open(kern).read().strip().splitlines()[-1:]
         out.append("## kernels: %s" % (tail[0] if tail else "?"))
 
+    mfut = os.path.join(d, "mfutable.log")
+    if os.path.isfile(mfut):
+        out.append("## MFU table (tools/roofline.py from this run's logs)")
+        out.extend(l.rstrip() for l in open(mfut)
+                   if l.startswith("|") or l.startswith("#"))
+
     print("\n".join(out))
 
 
