@@ -497,6 +497,21 @@ momentum = 0.9
                 np.testing.assert_allclose(
                     np.asarray(p_t[key]), np.asarray(p_r[key]),
                     rtol=2e-4, atol=2e-4, err_msg=key)
+        # checkpoints stay canonical under the (pipe, data) opt sharding:
+        # a ZeRO-1 run and the plain pp run serialize bitwise-identically
+        from cxxnet_tpu.utils import serializer
+        w1, w2 = serializer.Writer(), serializer.Writer()
+        tr.save_model(w1)
+        ref.save_model(w2)
+        assert w1.getvalue() == w2.getvalue()
+        # and the ZeRO-1 trainer resumes from its own checkpoint
+        tr_r = _trainer(self.PP_CONF,
+                        "dev = cpu:0-7\npipeline_parallel = 2\nfsdp = 1\n")
+        tr_r.load_model(serializer.Reader(w1.getvalue()))
+        b = _batches((1, 1, 10), 6, n=1, seed=13)[0]
+        tr.update(b)
+        tr_r.update(b)
+        _assert_params_match(tr, tr_r, rtol=1e-6, atol=1e-7)
 
     def test_pp_tp_fsdp_three_way(self):
         """fsdp (ZeRO-1 packed opt state) composed with pp x tp x dp on

@@ -51,7 +51,7 @@ run mfu      5400 python tools/mfu_experiments.py all
 run pipeline 1200 python bench.py pipeline
 run quality  3600 python tools/quality_run.py
 run profile  1200 python tools/profile_bench.py googlenet
-run benchall 3600 python bench.py all
+run benchall 4200 python bench.py all
 run mfutable 600  python tools/roofline.py --bench onchip_logs/bench.log --bench onchip_logs/benchall.log
 
 note "queue finished"
