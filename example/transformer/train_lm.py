@@ -6,7 +6,12 @@ alphabet walk with a random phase and stride, so the next character is
 exactly predictable from the prefix — a trained causal LM must reach
 ~100% next-token accuracy, an untrained one sits near 1/vocab.
 
-Usage: python train_lm.py [steps]   (~400 adam steps reach 100%)
+Usage: python train_lm.py [steps] [conf]   (~400 adam steps reach 100%)
+
+``conf`` defaults to lm.conf; pass lm_pipeline.conf to train the deeper
+trunk on the composed pipeline x tensor x data mesh (8 devices — on a
+machine without them, prefix
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu).
 """
 
 import os
@@ -63,9 +68,9 @@ def generate(tr, prompts, n_new):
     return toks[:, plen:plen + n_new]
 
 
-def main(steps=400, dev=None, seed=None):
+def main(steps=400, dev=None, seed=None, conf_name="lm.conf"):
     conf = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "lm.conf")
+                        conf_name)
     overrides = []
     if dev:
         overrides.append("dev=%s" % dev)
@@ -98,4 +103,5 @@ def main(steps=400, dev=None, seed=None):
 
 
 if __name__ == "__main__":
-    main(int(sys.argv[1]) if len(sys.argv) > 1 else 400)
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 400,
+         conf_name=sys.argv[2] if len(sys.argv) > 2 else "lm.conf")
