@@ -1543,16 +1543,16 @@ class MoELayer(Layer):
                   "mesh axis 'ep' size %d" % (self.n_expert, n_ep))
             # expert parallelism inside a pipeline stage body (manual
             # shard_map): each ep rank runs its slice of the expert stack
-            # densely over all tokens and the group-local psum combines
-            # the gate-weighted outputs — the manual twin of
-            # expert_parallel_ffn's shard_map (which cannot nest here)
+            # through the SAME per-device body expert_parallel_ffn wraps
+            # in shard_map (which cannot nest here) — dense local experts,
+            # group-local psum combine
+            from ..parallel.tensor import _ep_local
             loc = self.n_expert // n_ep
             eidx = jax.lax.axis_index("ep")
             w_l = jax.lax.dynamic_slice_in_dim(params["experts"],
                                                eidx * loc, loc, 0)
             p_l = jax.lax.dynamic_slice_in_dim(probs, eidx * loc, loc, 1)
-            y = jnp.maximum(jnp.einsum("bi,eio->ebo", x2, w_l), 0.0)
-            out = jax.lax.psum(jnp.einsum("ebo,be->bo", y, p_l), "ep")
+            out = _ep_local(x2, w_l, p_l, axis_name="ep")
         elif (not ctx.manual_tp and mesh is not None
                 and "ep" in getattr(mesh, "axis_names", ())):
             batch_axis = "data" if "data" in mesh.axis_names else None

@@ -415,7 +415,7 @@ class Trainer:
             F_p = -(-F_p // dp) * dp
         sh = NamedSharding(self.mesh, P("pipe", None))
 
-        def build(getv):
+        def build(getv, sharding=sh):
             rows = []
             for es in entries:
                 vec = np.zeros(F_p, np.float32)
@@ -426,7 +426,7 @@ class Trainer:
                     a = np.asarray(v, np.float32).ravel()
                     vec[off: off + a.size] = a
                 rows.append(vec)
-            return jax.device_put(np.stack(rows), sh)
+            return jax.device_put(np.stack(rows), sharding)
 
         packed = build(lambda i, k_: parallel.fetch_global(
             self.params[i][k_]))
@@ -448,9 +448,9 @@ class Trainer:
             # makes this clean: it is elementwise over (k, F_p), so the
             # constraint partitions it with zero resharding.
             opt_sh = NamedSharding(self.mesh, P("pipe", "data"))
-        packed_opt = {sk: jax.device_put(build(
+        packed_opt = {sk: build(
             lambda i, k_: parallel.fetch_global(self.opt_state[i][k_][sk])
-            if k_ in self.opt_state[i] else None), opt_sh)
+            if k_ in self.opt_state[i] else None, opt_sh)
             for sk in sub_keys}
         # vectorized update plan: group packed tensors by updater
         # hyper-parameter signature; the step then runs ONE elementwise

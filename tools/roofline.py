@@ -75,8 +75,6 @@ def net_flops_per_sample(tr) -> float:
         if tname == "conv" and info.nindex_out:
             b, c, h, w_ = net.node_shapes[info.nindex_out[0]]
             f *= h * w_
-        if tname == "moe":
-            pass   # experts tensor already counted dense: 2*E*din*dout
         if tname == "attention":
             b, d, _, L = net.node_shapes[info.nindex_in[0]]
             win = getattr(lay, "attn_window", 0) or L
@@ -109,8 +107,6 @@ def zoo(models=None):
         ("transformer_lm_L2048", lm(2048), "token"),
         ("transformer_lm_L8192_gqa_window",
          lm(8192, "nkvhead = 2\nattn_window = 1024\nrope = 1\n"), "token"),
-        ("mnist_mlp", lambda: M.mnist_mlp_trainer(dev="cpu")
-         if hasattr(M, "mnist_mlp_trainer") else None, "img"),
     ]
     out = []
     for name, build, unit in table:
@@ -120,8 +116,6 @@ def zoo(models=None):
             tr = build()
         except Exception as e:   # model not constructible here: skip, say so
             print("# %s: skipped (%s)" % (name, e), file=sys.stderr)
-            continue
-        if tr is None:
             continue
         f = net_flops_per_sample(tr)
         if unit == "token":
