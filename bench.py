@@ -353,6 +353,27 @@ def bench_alexnet_latency_b1():
             "vs_baseline": None}
 
 
+def bench_lm_decode():
+    """Serving decode throughput: KV-cached greedy generation
+    (Trainer.generate) on the L=2048 LM — tokens/sec across a batch of 8
+    streams, prompt 64, generating to the full context."""
+    from cxxnet_tpu.models import transformer_lm_trainer
+    batch, L, plen = 8, 2048, 64
+    tr = transformer_lm_trainer(vocab=8192, seq=L, batch_size=batch,
+                                dim=512, nhead=8, nlayer=4, dev="tpu",
+                                extra_cfg=BF16)
+    rs = np.random.RandomState(0)
+    prompts = rs.randint(0, 8192, (batch, plen))
+    n_new = L - plen
+    tr.generate(prompts, n_new)   # compile + warm
+    t0 = time.perf_counter()
+    tr.generate(prompts, n_new)
+    dt = time.perf_counter() - t0
+    return {"metric": "lm_decode_tokens_per_sec_per_chip",
+            "value": round(batch * n_new / dt, 2), "unit": "tokens/sec",
+            "vs_baseline": None}
+
+
 def bench_mnist_mlp():
     tr = _conf_trainer(MNIST_MLP, (1, 1, 784), 100, extra=BF16)
     ips = _throughput(tr, (1, 1, 784), 10, 100, steps=100)
@@ -516,7 +537,7 @@ def _bench_main():
                    bench_resnet, bench_vgg,
                    bench_transformer_lm, bench_transformer_lm_long,
                    bench_vit, bench_alexnet_b1024, bench_alexnet_infer,
-                   bench_alexnet_latency_b1):
+                   bench_alexnet_latency_b1, bench_lm_decode):
             print(json.dumps(fn()), flush=True)
     if len(sys.argv) > 1 and sys.argv[1] in ("all", "pipeline"):
         for line in bench_alexnet_pipeline():

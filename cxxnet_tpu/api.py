@@ -172,6 +172,13 @@ class Net:
         assert self.net_ is not None, "model not initialized"
         return self.net_.extract_feature(self._resolve_batch(data), name)
 
+    def generate(self, prompts: np.ndarray, n_new: int) -> np.ndarray:
+        """KV-cached greedy continuation for sequence nets: (batch,
+        prompt_len) token ids -> (batch, n_new) generated ids (one jitted
+        decode scan; see Trainer.generate)."""
+        assert self.net_ is not None, "model not initialized"
+        return self.net_.generate(prompts, n_new)
+
     def export(self, fname: str, node_name: str = "",
                batch_size: int = 0) -> None:
         """Write the inference forward as a self-contained StableHLO

@@ -180,6 +180,9 @@ class ApplyContext:
     # index of the layer currently applying (its params slot); set by the
     # net's forward loop
     layer_index: int = -1
+    # the CONNECTION index (distinct even when share[...] ties the params
+    # slot): identity for per-application state like KV caches
+    conn_index: int = -1
     # non-gradient parameter updates recorded during the forward (batch-norm
     # running statistics): {(layer_index, param_key): new_value}; the
     # trainer merges them into params after the optimizer step
@@ -196,6 +199,17 @@ class ApplyContext:
     # shard by lax.axis_index and combines with group-local collectives
     # (see parallel/pipeline.py on why GSPMD can't do it here)
     manual_tp: bool = False
+    # KV-cached autoregressive decoding (Trainer.generate): the global
+    # position of the current input's first sequence slot (traced scalar;
+    # None = normal full-sequence forward). Position-aware layers read it
+    # (embed pos rows, RoPE angles) and attention attends its queries
+    # against the cache instead of the in-batch keys
+    decode_pos: object = None
+    # per-attention-layer k/v caches, keyed (layer_index, "k"/"v"):
+    # (b, nkv, L_max, dh) arrays read by attention's decode path; the
+    # position-updated caches are written to cache_updates
+    kv_cache: Dict = field(default_factory=dict)
+    cache_updates: Dict = field(default_factory=dict)
 
 
 class Layer:

@@ -1167,13 +1167,14 @@ class AttentionLayer(Layer):
         self.param.num_input_channel = d
         return [in_shapes[0]]
 
-    def _apply_rope(self, x):
+    def _apply_rope(self, x, offset=0):
         """Rotary embedding on (b, nh, L, dh): rotate the (first-half,
         second-half) feature pairs by position-dependent angles (Su et al.
-        2021) — relative offsets enter the q.k phase directly."""
+        2021) — relative offsets enter the q.k phase directly. ``offset``
+        is the global position of row 0 (KV-cached decode steps)."""
         dh = x.shape[-1]
         half = dh // 2
-        pos = jnp.arange(x.shape[2], dtype=jnp.float32)[:, None]
+        pos = offset + jnp.arange(x.shape[2], dtype=jnp.float32)[:, None]
         inv = jnp.power(self.rope_base,
                         -jnp.arange(half, dtype=jnp.float32) / half)
         ang = pos * inv                                     # (L, half)
@@ -1238,10 +1239,30 @@ class AttentionLayer(Layer):
 
         q, k, v = heads(q, nh), heads(k, nkv), heads(v, nkv)
         if self.rope:
-            q, k = self._apply_rope(q), self._apply_rope(k)
+            off = ctx.decode_pos if ctx.decode_pos is not None else 0
+            q, k = self._apply_rope(q, off), self._apply_rope(k, off)
         mesh = ctx.mesh
-        sp_n = manual_axis_size(ctx, "sp")
-        if sp_n > 1:
+        if ctx.decode_pos is not None:
+            # KV-cached decode step: write this input's k/v into the cache
+            # at [decode_pos, decode_pos + L) and attend the queries
+            # against the WHOLE cache with global causal offsets — future
+            # (unwritten) slots are masked by the same qpos >= kpos rule.
+            # O(L_max * d) per generated token instead of recomputing the
+            # full prefix (Trainer.generate).
+            li = ctx.conn_index
+            ck = ctx.kv_cache[(li, "k")]
+            cv = ctx.kv_cache[(li, "v")]
+            pos = ctx.decode_pos
+            ck = jax.lax.dynamic_update_slice(
+                ck, k.astype(ck.dtype), (0, 0, pos, 0))
+            cv = jax.lax.dynamic_update_slice(
+                cv, v.astype(cv.dtype), (0, 0, pos, 0))
+            ctx.cache_updates[(li, "k")] = ck
+            ctx.cache_updates[(li, "v")] = cv
+            out = attention_reference(
+                q, ck, cv, causal=True, scale=dh ** -0.5,
+                window=self.attn_window, q_offset=pos)
+        elif (sp_n := manual_axis_size(ctx, "sp")) > 1:
             # sequence parallelism inside a pipeline stage body (manual
             # shard_map): k/v are ALREADY replicated over sp (the pipeline
             # boundary stream is), so the ring's k/v rotation buys nothing
@@ -1391,7 +1412,13 @@ class EmbedLayer(Layer):
         ids = x.reshape(b, L).astype(jnp.int32)
         emb = jnp.take(params["wmat"], ids, axis=0)        # (b, L, d)
         if self.pos_embed:
-            emb = emb + params["pos"]
+            pos = params["pos"]
+            if ctx.decode_pos is not None:
+                # decode step: the input covers positions
+                # [decode_pos, decode_pos + L)
+                pos = jax.lax.dynamic_slice_in_dim(
+                    pos, ctx.decode_pos, L, 0)
+            emb = emb + pos
         return [emb.transpose(0, 2, 1).reshape(b, -1, 1, L)]
 
 

@@ -369,6 +369,10 @@ class NeuralNet:
             pidx = (info.primary_layer_index if self.is_shared[i] else i)
             ctx.rng = jax.random.fold_in(base_rng, i)
             ctx.layer_index = pidx
+            # connection identity (distinct even for share-tied layers):
+            # the KV-cache key — two tied attention layers share weights
+            # but must NOT share a cache
+            ctx.conn_index = i
             sup = lay.layout_support
             if (self.channels_last and sup == "nhwc"
                     and all(self._image_like(j) for j in info.nindex_in)):
@@ -392,7 +396,11 @@ class NeuralNet:
                 # losses always in f32 (softmax/log numerics)
                 ins = [x.astype(jnp.float32) for x in ins]
             if (lay.remat and not lay.is_loss and not lay.state_keys()
+                    and ctx.decode_pos is None
                     and not isinstance(lay, factory.PairTestLayer)):
+                # remat is a training-memory trade; the KV-cached decode
+                # forward skips it (no backward — and cache updates could
+                # not escape a jax.checkpoint body anyway)
                 outs = self._apply_remat(lay, pidx, params[pidx], ins, ctx)
             else:
                 outs = lay.apply(params[pidx], ins, ctx)
@@ -419,8 +427,15 @@ class NeuralNet:
 
     def forward(self, params: Params, data, extra_data=(),
                 labels: Optional[LabelInfo] = None, train: bool = False,
-                rng=None, epoch=0, mesh=None):
-        """Run the DAG; returns (node_values list, total_loss scalar)."""
+                rng=None, epoch=0, mesh=None, decode_pos=None,
+                kv_cache=None):
+        """Run the DAG; returns (node_values list, total_loss scalar).
+
+        ``decode_pos``/``kv_cache`` select the KV-cached decode mode
+        (Trainer.generate): the data covers sequence positions
+        [decode_pos, decode_pos + L) and attention layers attend against
+        (and update) the caches; the position-updated caches land in
+        ``self._last_cache_updates``."""
         cfg = self.cfg
         cdt = self.compute_dtype
         values: List[Optional[jnp.ndarray]] = [None] * cfg.param.num_nodes
@@ -444,10 +459,12 @@ class NeuralNet:
                       for i, v in enumerate(values)]
             params = self._cast_params_compute(params)
         ctx = ApplyContext(train=train, labels=labels, epoch=epoch,
-                           mesh=mesh)
+                           mesh=mesh, decode_pos=decode_pos,
+                           kv_cache=kv_cache or {})
         base_rng = rng if rng is not None else jax.random.PRNGKey(0)
         layouts = self._apply_layer_range(params, values, ctx, base_rng,
                                           0, len(cfg.layers))
+        self._last_cache_updates = ctx.cache_updates
         # every escaping node value is reference-NCHW; the transposes of
         # values the caller never reads are dead code XLA eliminates
         for n, lo_ in enumerate(layouts):

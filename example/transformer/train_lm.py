@@ -53,19 +53,10 @@ def next_token_accuracy(tr, batch):
 
 def generate(tr, prompts, n_new):
     """Greedy autoregressive continuation of a (batch, prefix_len) prompt
-    matrix. Recomputes the full prefix each step (no KV cache — the demo
-    path; causal masking makes the padded tail inert)."""
-    batch, plen = prompts.shape
-    toks = np.zeros((batch, SEQ), np.int64)
-    toks[:, :plen] = prompts
-    for t in range(plen, min(plen + n_new, SEQ)):
-        b = DataBatch()
-        b.data = toks.reshape(batch, 1, 1, SEQ).astype(np.float32)
-        b.label = np.zeros((batch, SEQ), np.float32)
-        b.batch_size = batch
-        probs = tr.extract_feature(b, "top[-1]")     # (b, VOCAB, 1, SEQ)
-        toks[:, t] = probs.reshape(batch, VOCAB, SEQ)[:, :, t - 1].argmax(1)
-    return toks[:, plen:plen + n_new]
+    matrix via the KV-cached decode scan (Trainer.generate — one O(L*d)
+    step per token; tests/test_decode.py pins it against the naive
+    full-prefix recompute)."""
+    return tr.generate(prompts, min(n_new, SEQ - prompts.shape[1]))
 
 
 def main(steps=400, dev=None, seed=None, conf_name="lm.conf"):

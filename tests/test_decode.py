@@ -1,0 +1,170 @@
+"""KV-cached autoregressive decoding (Trainer.generate): one decode step
+per token against per-layer k/v caches must reproduce, token for token,
+the naive full-prefix-recompute generation — incl. learned positions,
+RoPE offsets, GQA caches, and sliding-window masking.
+"""
+
+import numpy as np
+
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.nnet.trainer import Trainer
+from cxxnet_tpu.utils.config import parse_config_string
+
+VOCAB, SEQ = 12, 24
+
+LM = """
+netconfig = start
+layer[0->1] = embed:emb
+  vocab_size = %(vocab)d
+  nhidden = 16
+  %(embed_extra)s
+  init_sigma = 0.05
+layer[1->2,3] = split
+layer[2->4] = attention:att1
+  nhead = 4
+  causal = 1
+  init_sigma = 0.05
+%(attn_extra)s
+layer[3,4->5] = add
+layer[5->6] = conv:head
+  kernel_size = 1
+  nchannel = %(vocab)d
+  random_type = kaiming
+layer[6->6] = softmax
+  seq = 1
+netconfig = end
+input_shape = 1,1,%(seq)d
+batch_size = 8
+label_width = %(seq)d
+label_vec[0,%(seq)d) = label
+updater = adam
+eta = 0.01
+dev = cpu
+"""
+
+
+def _trained(embed_extra="pos_embed = 1", attn_extra="", steps=30):
+    conf = LM % {"vocab": VOCAB, "seq": SEQ, "embed_extra": embed_extra,
+                 "attn_extra": attn_extra}
+    tr = Trainer()
+    for k, v in parse_config_string(conf):
+        tr.set_param(k, v)
+    tr.init_model()
+    rs = np.random.RandomState(0)
+    for _ in range(steps):
+        phase = rs.randint(0, VOCAB, (8, 1))
+        t = np.arange(SEQ + 1)[None, :]
+        toks = (phase + t) % VOCAB
+        b = DataBatch()
+        b.data = toks[:, :SEQ].reshape(8, 1, 1, SEQ).astype(np.float32)
+        b.label = toks[:, 1:].astype(np.float32)
+        b.batch_size = 8
+        tr.update(b)
+    return tr
+
+
+def _full_recompute_generate(tr, prompts, n_new):
+    """Reference: greedy continuation recomputing the whole prefix per
+    token through the ordinary padded forward (causal masking makes the
+    zero tail inert)."""
+    b, plen = prompts.shape
+    toks = np.zeros((b, SEQ), np.int64)
+    toks[:, :plen] = prompts
+    for t in range(plen, plen + n_new):
+        db = DataBatch()
+        db.data = toks.reshape(b, 1, 1, SEQ).astype(np.float32)
+        db.label = np.zeros((b, SEQ), np.float32)
+        db.batch_size = b
+        probs = tr.extract_feature(db, "top[-1]")
+        toks[:, t] = probs.reshape(b, VOCAB, SEQ)[:, :, t - 1].argmax(1)
+    return toks[:, plen:plen + n_new]
+
+
+def _check(tr, n_new=8):
+    rs = np.random.RandomState(7)
+    prompts = rs.randint(0, VOCAB, (8, 6))
+    want = _full_recompute_generate(tr, prompts, n_new)
+    got = tr.generate(prompts, n_new)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_decode_matches_full_recompute_learned_pos():
+    _check(_trained())
+
+
+def test_decode_matches_rope_gqa_window():
+    """RoPE decode offsets, grouped-query caches (nkv < nh), and the
+    sliding-window mask over the cache."""
+    tr = _trained(embed_extra="pos_embed = 0",
+                  attn_extra="  rope = 1\n  nkvhead = 2\n"
+                             "  attn_window = 8\n")
+    _check(tr)
+
+
+def test_decode_bounds_checked():
+    import pytest
+    tr = _trained(steps=1)
+    with pytest.raises(Exception, match="exceeds"):
+        tr.generate(np.zeros((8, 20), np.int64), 10)
+
+
+def test_decode_with_remat_attention():
+    """remat=1 attention (the long-context training config): decode skips
+    the checkpoint wrapper (no backward at inference) and still matches
+    the full recompute."""
+    _check(_trained(attn_extra="  remat = 1\n"))
+
+
+WEIGHT_TIED = """
+netconfig = start
+layer[0->1] = embed:emb
+  vocab_size = %(vocab)d
+  nhidden = 16
+  pos_embed = 1
+  init_sigma = 0.05
+layer[1->2,3] = split
+layer[2->4] = attention:att1
+  nhead = 4
+  causal = 1
+  init_sigma = 0.05
+layer[3,4->5] = add
+layer[5->6,7] = split
+layer[6->8] = share[att1]
+layer[7,8->9] = add
+layer[9->10] = conv:head
+  kernel_size = 1
+  nchannel = %(vocab)d
+  random_type = kaiming
+layer[10->10] = softmax
+  seq = 1
+netconfig = end
+input_shape = 1,1,%(seq)d
+batch_size = 8
+label_width = %(seq)d
+label_vec[0,%(seq)d) = label
+updater = adam
+eta = 0.01
+dev = cpu
+"""
+
+
+def test_decode_weight_tied_attention_has_separate_caches():
+    """share[att1] reuses the WEIGHTS at a second depth; each application
+    must keep its own KV cache (keyed by connection index, not params
+    slot) — decode matches the full recompute."""
+    conf = WEIGHT_TIED % {"vocab": VOCAB, "seq": SEQ}
+    tr = Trainer()
+    for k, v in parse_config_string(conf):
+        tr.set_param(k, v)
+    tr.init_model()
+    rs = np.random.RandomState(0)
+    for _ in range(20):
+        phase = rs.randint(0, VOCAB, (8, 1))
+        t = np.arange(SEQ + 1)[None, :]
+        toks = (phase + t) % VOCAB
+        b = DataBatch()
+        b.data = toks[:, :SEQ].reshape(8, 1, 1, SEQ).astype(np.float32)
+        b.label = toks[:, 1:].astype(np.float32)
+        b.batch_size = 8
+        tr.update(b)
+    _check(tr)
