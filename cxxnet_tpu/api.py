@@ -172,12 +172,16 @@ class Net:
         assert self.net_ is not None, "model not initialized"
         return self.net_.extract_feature(self._resolve_batch(data), name)
 
-    def generate(self, prompts: np.ndarray, n_new: int) -> np.ndarray:
-        """KV-cached greedy continuation for sequence nets: (batch,
-        prompt_len) token ids -> (batch, n_new) generated ids (one jitted
-        decode scan; see Trainer.generate)."""
+    def generate(self, prompts: np.ndarray, n_new: int,
+                 temperature: float = 0.0, top_k: int = 0,
+                 seed: int = 0) -> np.ndarray:
+        """KV-cached continuation for sequence nets: (batch, prompt_len)
+        token ids -> (batch, n_new) generated ids (one jitted decode
+        scan; greedy by default, sampled with temperature/top_k — see
+        Trainer.generate)."""
         assert self.net_ is not None, "model not initialized"
-        return self.net_.generate(prompts, n_new)
+        return self.net_.generate(prompts, n_new, temperature=temperature,
+                                  top_k=top_k, seed=seed)
 
     def export(self, fname: str, node_name: str = "",
                batch_size: int = 0) -> None:

@@ -1013,19 +1013,23 @@ class Trainer:
         out = self._forward_nodes(batch, (self._resolve_node(node_name),))[0]
         return np.asarray(out)
 
-    def generate(self, prompts, n_new: int) -> np.ndarray:
-        """KV-cached greedy autoregressive generation for sequence nets
+    def generate(self, prompts, n_new: int, temperature: float = 0.0,
+                 top_k: int = 0, seed: int = 0) -> np.ndarray:
+        """KV-cached autoregressive generation for sequence nets
         (embed/attention stacks): one decode step per new token attends
         against per-layer k/v caches instead of recomputing the full
         prefix — O(L_max * d) per token, the serving decode loop the
         reference's pred task has no analogue of.
 
         prompts: (batch, prompt_len) integer token matrix; returns the
-        (batch, n_new) greedy continuation. The whole generation runs as
-        ONE jitted lax.scan (cached per (batch, prompt_len, n_new)
-        signature); positions are bounded by the training sequence length
-        (the pos-embed table / cache size). Single-device: sharded or
-        stage-packed training params are gathered canonical first.
+        (batch, n_new) continuation. temperature 0 (default) = greedy
+        argmax; > 0 samples from softmax(logits / temperature), optionally
+        truncated to the ``top_k`` most likely tokens first. The whole
+        generation runs as ONE jitted lax.scan (cached per
+        (batch, prompt_len, n_new, sampling) signature); positions are
+        bounded by the training sequence length (the pos-embed table /
+        cache size). Single-device: sharded or stage-packed training
+        params are gathered canonical first.
         """
         prompts = np.asarray(prompts)
         check(prompts.ndim == 2, "generate: prompts must be (batch, len)")
@@ -1072,11 +1076,30 @@ class Trainer:
             check(bool(net2.layers[i].causal),
                   "generate: attention layer %d is not causal" % i)
 
-        fkey = (plen, total)
+        temperature, top_k = float(temperature), int(top_k)
+        check(top_k >= 0, "generate: top_k must be >= 0")
+        fkey = (plen, total, temperature, top_k)
         if fkey not in self._decode_fns:
             last = net2.cfg.param.num_nodes - 1
 
-            def run(params, toks):
+            def pick(probs, step_key):
+                """Next token from the softmax row: greedy, or sampled
+                from log-probs / temperature (top_k-truncated)."""
+                if temperature <= 0.0:
+                    return jnp.argmax(probs, axis=1)
+                lg = jnp.log(jnp.maximum(probs, 1e-30)) / temperature
+                if top_k and top_k < lg.shape[1]:
+                    # exact-k mask from top_k indices (same pattern as the
+                    # moe gate, layers.py — a >=kth-value threshold would
+                    # keep every tied token)
+                    _, idx = jax.lax.top_k(lg, top_k)
+                    keep = jnp.sum(jax.nn.one_hot(idx, lg.shape[1],
+                                                  dtype=jnp.float32),
+                                   axis=1) > 0
+                    lg = jnp.where(keep, lg, -jnp.inf)
+                return jax.random.categorical(step_key, lg, axis=1)
+
+            def run(params, toks, key):
                 caches = {}
                 for i in att_idx:
                     lay = net2.layers[i]
@@ -1094,9 +1117,9 @@ class Trainer:
                     params, pre.reshape(b, 1, 1, plen).astype(jnp.float32),
                     train=False, decode_pos=0, kv_cache=caches)
                 caches = dict(pre_net._last_cache_updates)
-                first = jnp.argmax(
-                    values[last].reshape(b, -1, plen)[:, :, -1],
-                    axis=1).astype(toks.dtype)
+                first = pick(values[last].reshape(b, -1, plen)[:, :, -1],
+                             jax.random.fold_in(key, plen - 1)
+                             ).astype(toks.dtype)
                 toks = jax.lax.dynamic_update_slice(
                     toks, first[:, None], (0, plen))
 
@@ -1107,8 +1130,9 @@ class Trainer:
                     values, _ = net2.forward(params, data, train=False,
                                              decode_pos=t,
                                              kv_cache=caches)
-                    logits = values[last].reshape(b, -1)
-                    nxt = jnp.argmax(logits, axis=1).astype(toks.dtype)
+                    nxt = pick(values[last].reshape(b, -1),
+                               jax.random.fold_in(key, t)
+                               ).astype(toks.dtype)
                     toks = jax.lax.dynamic_update_slice(
                         toks, nxt[:, None], (0, t + 1))
                     return (toks, dict(net2._last_cache_updates)), None
@@ -1122,7 +1146,8 @@ class Trainer:
             self._decode_fns[fkey] = jax.jit(run)
         toks0 = np.zeros((b, l_max), np.int32)
         toks0[:, :plen] = prompts
-        toks = self._decode_fns[fkey](params, jnp.asarray(toks0))
+        toks = self._decode_fns[fkey](params, jnp.asarray(toks0),
+                                      jax.random.PRNGKey(seed))
         return np.asarray(toks)[:, plen:total]
 
     def export_forward(self, node_name: str = "", batch_size: int = 0,

@@ -101,6 +101,23 @@ def test_decode_matches_rope_gqa_window():
     _check(tr)
 
 
+def test_decode_sampling():
+    """temperature > 0 samples valid tokens reproducibly per seed; a tiny
+    temperature concentrates the categorical on the argmax (= greedy)."""
+    tr = _trained()
+    rs = np.random.RandomState(7)
+    prompts = rs.randint(0, VOCAB, (8, 6))
+    greedy = tr.generate(prompts, 8)
+    cold = tr.generate(prompts, 8, temperature=1e-4)
+    np.testing.assert_array_equal(cold, greedy)
+    s1 = tr.generate(prompts, 8, temperature=1.0, top_k=4, seed=1)
+    s2 = tr.generate(prompts, 8, temperature=1.0, top_k=4, seed=1)
+    s3 = tr.generate(prompts, 8, temperature=1.0, top_k=4, seed=2)
+    np.testing.assert_array_equal(s1, s2)
+    assert (s1 != s3).any(), "different seeds produced identical samples"
+    assert s1.min() >= 0 and s1.max() < VOCAB
+
+
 def test_decode_bounds_checked():
     import pytest
     tr = _trained(steps=1)
