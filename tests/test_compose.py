@@ -769,6 +769,21 @@ class TestTransformerPipeline:
                 np.testing.assert_allclose(
                     np.asarray(p_3[key]), np.asarray(p_r[key]),
                     rtol=5e-4, atol=5e-4, err_msg="pp.tp %s" % key)
+        # KV-cached generation from the stage-PACKED trainer: the decode
+        # path gathers canonical params and must match this trainer's own
+        # full-prefix recompute token-for-token
+        prompts = rs.randint(0, 32, (8, 3))
+        got = tr3.generate(prompts, 4)
+        toks = np.zeros((8, 8), np.int64)
+        toks[:, :3] = prompts
+        for t in range(3, 7):
+            db = DataBatch()
+            db.data = toks.reshape(8, 1, 1, 8).astype(np.float32)
+            db.label = np.zeros((8, 8), np.float32)
+            db.batch_size = 8
+            probs = tr3.extract_feature(db, "top[-1]")
+            toks[:, t] = probs.reshape(8, 32, 8)[:, :, t - 1].argmax(1)
+        np.testing.assert_array_equal(got, toks[:, 3:7])
 
 
 class TestViTCompose:
