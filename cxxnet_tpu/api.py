@@ -174,14 +174,15 @@ class Net:
 
     def generate(self, prompts: np.ndarray, n_new: int,
                  temperature: float = 0.0, top_k: int = 0,
-                 seed: int = 0) -> np.ndarray:
+                 seed: int = 0, prompt_lens=None) -> np.ndarray:
         """KV-cached continuation for sequence nets: (batch, prompt_len)
         token ids -> (batch, n_new) generated ids (one jitted decode
-        scan; greedy by default, sampled with temperature/top_k — see
-        Trainer.generate)."""
+        scan; greedy by default, sampled with temperature/top_k; ragged
+        batches via prompt_lens — see Trainer.generate)."""
         assert self.net_ is not None, "model not initialized"
         return self.net_.generate(prompts, n_new, temperature=temperature,
-                                  top_k=top_k, seed=seed)
+                                  top_k=top_k, seed=seed,
+                                  prompt_lens=prompt_lens)
 
     def export(self, fname: str, node_name: str = "",
                batch_size: int = 0) -> None:

@@ -1014,7 +1014,8 @@ class Trainer:
         return np.asarray(out)
 
     def generate(self, prompts, n_new: int, temperature: float = 0.0,
-                 top_k: int = 0, seed: int = 0) -> np.ndarray:
+                 top_k: int = 0, seed: int = 0,
+                 prompt_lens=None) -> np.ndarray:
         """KV-cached autoregressive generation for sequence nets
         (embed/attention stacks): one decode step per new token attends
         against per-layer k/v caches instead of recomputing the full
@@ -1022,23 +1023,38 @@ class Trainer:
         reference's pred task has no analogue of.
 
         prompts: (batch, prompt_len) integer token matrix; returns the
-        (batch, n_new) continuation. temperature 0 (default) = greedy
-        argmax; > 0 samples from softmax(logits / temperature), optionally
-        truncated to the ``top_k`` most likely tokens first. The whole
-        generation runs as ONE jitted lax.scan (cached per
-        (batch, prompt_len, n_new, sampling) signature); positions are
-        bounded by the training sequence length (the pos-embed table /
-        cache size). Single-device: sharded or stage-packed training
-        params are gathered canonical first.
+        (batch, n_new) continuation. ``prompt_lens`` (optional, (batch,)
+        ints <= prompt_len) serves a RAGGED batch: row r's real prompt is
+        its first prompt_lens[r] tokens and its continuation starts
+        there — the shared-length prefix prefills as one chunk, the rest
+        of each prompt streams through the decode steps, and every row's
+        n_new tokens come back aligned. temperature 0 (default) = greedy
+        argmax; > 0 samples from softmax(logits / temperature),
+        optionally truncated to the ``top_k`` most likely tokens first.
+        The whole generation runs as ONE jitted lax.scan (cached per
+        (batch, min/max prompt_len, n_new, sampling) signature — ragged
+        length PATTERNS share compilations); positions are bounded by the
+        training sequence length (the pos-embed table / cache size).
+        Single-device: sharded or stage-packed training params are
+        gathered canonical first.
         """
         prompts = np.asarray(prompts)
         check(prompts.ndim == 2, "generate: prompts must be (batch, len)")
-        b, plen = prompts.shape
+        b, max_p = prompts.shape
+        if prompt_lens is None:
+            lens = np.full(b, max_p, np.int32)
+        else:
+            lens = np.asarray(prompt_lens, np.int32)
+            check(lens.shape == (b,) and lens.min() >= 1
+                  and lens.max() <= max_p,
+                  "generate: prompt_lens must be (batch,) ints in "
+                  "[1, prompts.shape[1]]")
+        plen = int(lens.min())       # shared prefix -> chunked prefill
         l_max = self.net_cfg.param.input_shape[2]
-        total = plen + n_new
+        total = int(lens.max()) + n_new
         check(total <= l_max,
               "generate: prompt_len %d + n_new %d exceeds the net's "
-              "sequence length %d" % (plen, n_new, l_max))
+              "sequence length %d" % (int(lens.max()), n_new, l_max))
         if n_new <= 0:
             return np.zeros((b, 0), np.int32)
 
@@ -1099,7 +1115,7 @@ class Trainer:
                     lg = jnp.where(keep, lg, -jnp.inf)
                 return jax.random.categorical(step_key, lg, axis=1)
 
-            def run(params, toks, key):
+            def run(params, toks, key, lens):
                 caches = {}
                 for i in att_idx:
                     lay = net2.layers[i]
@@ -1110,8 +1126,19 @@ class Trainer:
                     for nm in ("k", "v"):
                         caches[(i, nm)] = jnp.zeros(
                             (b, nkv, l_max, dh), jnp.float32)
-                # chunked prefill: ONE forward covers positions [0, plen)
-                # and fills every cache; its last row yields token plen
+
+                def place(toks, t, picked):
+                    """Column t+1: the row's own prompt token while t+1
+                    is still inside its prompt, else the picked token."""
+                    cur = jax.lax.dynamic_slice(
+                        toks, (0, t + 1), (b, 1))[:, 0]
+                    new = jnp.where(t + 1 < lens, cur, picked)
+                    return jax.lax.dynamic_update_slice(
+                        toks, new[:, None], (0, t + 1))
+
+                # chunked prefill: ONE forward covers the shared prefix
+                # [0, plen) and fills every cache; its last row yields the
+                # candidate token for position plen
                 pre = jax.lax.dynamic_slice(toks, (0, 0), (b, plen))
                 values, _ = pre_net.forward(
                     params, pre.reshape(b, 1, 1, plen).astype(jnp.float32),
@@ -1120,8 +1147,7 @@ class Trainer:
                 first = pick(values[last].reshape(b, -1, plen)[:, :, -1],
                              jax.random.fold_in(key, plen - 1)
                              ).astype(toks.dtype)
-                toks = jax.lax.dynamic_update_slice(
-                    toks, first[:, None], (0, plen))
+                toks = place(toks, plen - 1, first)
 
                 def step(carry, t):
                     toks, caches = carry
@@ -1133,8 +1159,7 @@ class Trainer:
                     nxt = pick(values[last].reshape(b, -1),
                                jax.random.fold_in(key, t)
                                ).astype(toks.dtype)
-                    toks = jax.lax.dynamic_update_slice(
-                        toks, nxt[:, None], (0, t + 1))
+                    toks = place(toks, t, nxt)
                     return (toks, dict(net2._last_cache_updates)), None
 
                 if total > plen + 1:
@@ -1145,10 +1170,16 @@ class Trainer:
 
             self._decode_fns[fkey] = jax.jit(run)
         toks0 = np.zeros((b, l_max), np.int32)
-        toks0[:, :plen] = prompts
-        toks = self._decode_fns[fkey](params, jnp.asarray(toks0),
-                                      jax.random.PRNGKey(seed))
-        return np.asarray(toks)[:, plen:total]
+        toks0[:, :max_p] = prompts
+        # (padding beyond a ragged row's real prompt is never read: the
+        # prefill covers only the shared [0, min(lens)) prefix, and every
+        # later column a step reads was either a real prompt token or
+        # place()-written at the previous step)
+        toks = np.asarray(self._decode_fns[fkey](
+            params, jnp.asarray(toks0), jax.random.PRNGKey(seed),
+            jnp.asarray(lens)))
+        return np.stack([toks[r, lens[r]: lens[r] + n_new]
+                         for r in range(b)])
 
     def export_forward(self, node_name: str = "", batch_size: int = 0,
                        compat: bool = True) -> bytes:
