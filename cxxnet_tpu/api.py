@@ -210,6 +210,55 @@ class Net:
         return np.asarray(weight)
 
 
+def save_decode(net, prefill_fname: str, step_fname: str,
+                batch_size: int = 1, prompt_len: int = 1) -> None:
+    """Write a trained sequence net's KV-cached decode loop as two
+    standalone StableHLO artifacts (Trainer.export_decode)."""
+    pre, step = net.net_.export_decode(batch_size, prompt_len)
+    with open(prefill_fname, "wb") as f:
+        f.write(pre)
+    with open(step_fname, "wb") as f:
+        f.write(step)
+
+
+def load_decode(prefill_fname: str, step_fname: str):
+    """Load export_decode artifacts and return a reference greedy loop
+    `generate(prompts, n_new) -> (batch, n_new) ids` — params baked in,
+    jax-only at serving time (a real deployment drives the two artifacts
+    from its own loop: sampling, stop tokens, scheduling)."""
+    from jax import export as jexport
+    with open(prefill_fname, "rb") as f:
+        pre = jexport.deserialize(f.read())
+    with open(step_fname, "rb") as f:
+        step = jexport.deserialize(f.read())
+    (b, plen) = pre.in_avals[0].shape
+    # cache avals are (b, nkv, l_max, dh): flattened step args are
+    # (token, position, *cache leaves)
+    l_max = step.in_avals[2].shape[2]
+
+    def generate(prompts, n_new: int) -> np.ndarray:
+        prompts = np.asarray(prompts, np.int32)
+        assert prompts.shape == (b, plen), (
+            "this artifact serves (%d, %d) prompts" % (b, plen))
+        if n_new <= 0:
+            return np.zeros((b, 0), np.int32)
+        if plen + n_new > l_max:
+            raise ValueError(
+                "prompt_len %d + n_new %d exceeds the artifact's cache "
+                "length %d" % (plen, n_new, l_max))
+        probs, caches = pre.call(prompts)
+        out = []
+        tok = np.argmax(np.asarray(probs), axis=1).astype(np.int32)
+        out.append(tok)
+        for t in range(plen, plen + n_new - 1):
+            probs, caches = step.call(tok, np.int32(t), caches)
+            tok = np.argmax(np.asarray(probs), axis=1).astype(np.int32)
+            out.append(tok)
+        return np.stack(out, axis=1)
+
+    return generate
+
+
 def load_exported(fname: str):
     """Load a `Net.export` / `task = export` StableHLO artifact and return
     a callable `fn(data) -> np.ndarray` (params baked in; batch shape

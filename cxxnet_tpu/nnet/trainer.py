@@ -1058,22 +1058,16 @@ class Trainer:
         if n_new <= 0:
             return np.zeros((b, 0), np.int32)
 
-        def seq_net(seq_len):
-            import copy
-            cfg2 = copy.deepcopy(self.net_cfg)
-            cfg2.param.input_shape = (1, 1, seq_len)
-            return NeuralNet(cfg2, b)
-
         key = ("decode", b)
         if getattr(self, "_decode_net", None) is None \
                 or self._decode_net[0] != key:
-            self._decode_net = (key, seq_net(1))
+            self._decode_net = (key, self._seq_net(b, 1))
             self._prefill_nets = {}
             self._decode_fns = {}
             self._decode_params = None
         net2 = self._decode_net[1]
         if plen not in self._prefill_nets:
-            self._prefill_nets[plen] = seq_net(plen)
+            self._prefill_nets[plen] = self._seq_net(b, plen)
         pre_net = self._prefill_nets[plen]
         # gathered-canonical params live on device, re-fetched only when
         # training produced a new params list (every serving call after
@@ -1085,12 +1079,8 @@ class Trainer:
                  for k, v in p.items()}
                 for p in self.canonical_params()])
         params = self._decode_params[1]
-        att_idx = [i for i, lay in enumerate(net2.layers)
-                   if getattr(lay, "type_name", "") == "attention"]
-        check(bool(att_idx), "generate: the net has no attention layers")
-        for i in att_idx:
-            check(bool(net2.layers[i].causal),
-                  "generate: attention layer %d is not causal" % i)
+        _, cache_keys, cache_shapes = \
+            self._decode_cache_specs(net2, b, l_max)
 
         temperature, top_k = float(temperature), int(top_k)
         check(top_k >= 0, "generate: top_k must be >= 0")
@@ -1116,16 +1106,8 @@ class Trainer:
                 return jax.random.categorical(step_key, lg, axis=1)
 
             def run(params, toks, key, lens):
-                caches = {}
-                for i in att_idx:
-                    lay = net2.layers[i]
-                    d_in = net2.node_shapes[
-                        net2.cfg.layers[i].nindex_in[0]][1]
-                    dh = d_in // lay.nhead
-                    nkv = lay.nkvhead or lay.nhead
-                    for nm in ("k", "v"):
-                        caches[(i, nm)] = jnp.zeros(
-                            (b, nkv, l_max, dh), jnp.float32)
+                caches = {k: jnp.zeros(sh, jnp.float32)
+                          for k, sh in zip(cache_keys, cache_shapes)}
 
                 def place(toks, t, picked):
                     """Column t+1: the row's own prompt token while t+1
@@ -1180,6 +1162,96 @@ class Trainer:
             jnp.asarray(lens)))
         return np.stack([toks[r, lens[r]: lens[r] + n_new]
                          for r in range(b)])
+
+    def _seq_net(self, batch_size: int, seq_len: int) -> "NeuralNet":
+        """A NeuralNet over the same config at a different sequence
+        length (the decode/prefill nets — weights stay the trainer's)."""
+        import copy
+        cfg2 = copy.deepcopy(self.net_cfg)
+        cfg2.param.input_shape = (1, 1, seq_len)
+        return NeuralNet(cfg2, batch_size)
+
+    @staticmethod
+    def _decode_cache_specs(net2, b: int, l_max: int):
+        """(att_idx, cache_keys, cache_shapes) for a decode net — THE
+        cache layout contract, shared by generate and export_decode so
+        live decoding and exported artifacts cannot drift apart. Also
+        enforces the decode preconditions (attention present, causal)."""
+        att_idx = [i for i, lay in enumerate(net2.layers)
+                   if getattr(lay, "type_name", "") == "attention"]
+        check(bool(att_idx), "decode: the net has no attention layers")
+        for i in att_idx:
+            check(bool(net2.layers[i].causal),
+                  "decode: attention layer %d is not causal" % i)
+        keys, shapes = [], []
+        for i in att_idx:
+            lay = net2.layers[i]
+            d_in = net2.node_shapes[net2.cfg.layers[i].nindex_in[0]][1]
+            for nm in ("k", "v"):
+                keys.append((i, nm))
+                shapes.append((b, lay.nkvhead or lay.nhead, l_max,
+                               d_in // lay.nhead))
+        return att_idx, keys, shapes
+
+    def export_decode(self, batch_size: int, prompt_len: int,
+                      compat: bool = True):
+        """AOT-export the KV-cached decode loop as TWO self-contained
+        StableHLO artifacts (params baked in, jax-only at serving time —
+        the decode counterpart of export_forward):
+
+        * prefill: (batch, prompt_len) int32 tokens ->
+          (last-position softmax row, cache tuple)
+        * step:    ((batch,) int32 token, () int32 position, cache tuple)
+          -> (softmax row, updated cache tuple)
+
+        The serving host drives its own loop (sampling policy, stop
+        conditions, batching) and threads the opaque cache tuple between
+        calls — `api.load_decode` ships a reference loop. Returns
+        (prefill_bytes, step_bytes).
+        """
+        from jax import export as jexport
+        check(self.params is not None,
+              "export_decode: init_model/load_model first")
+        b, plen = int(batch_size), int(prompt_len)
+        l_max = self.net_cfg.param.input_shape[2]
+        check(0 < plen <= l_max,
+              "export_decode: prompt_len must be in [1, %d]" % l_max)
+        net2, pre_net = self._seq_net(b, 1), self._seq_net(b, plen)
+        params = [{k: np.asarray(parallel.fetch_global(v))
+                   for k, v in p.items()}
+                  for p in self.canonical_params()]
+        _, cache_keys, cache_shapes = \
+            self._decode_cache_specs(net2, b, l_max)
+        last = net2.cfg.param.num_nodes - 1
+
+        def prefill(toks):
+            caches = {k: jnp.zeros(sh, jnp.float32)
+                      for k, sh in zip(cache_keys, cache_shapes)}
+            values, _ = pre_net.forward(
+                params, toks.reshape(b, 1, 1, plen).astype(jnp.float32),
+                train=False, decode_pos=0, kv_cache=caches)
+            cu = pre_net._last_cache_updates
+            probs = values[last].reshape(b, -1, plen)[:, :, -1]
+            return probs, tuple(cu[k] for k in cache_keys)
+
+        def step(tok, pos, caches):
+            values, _ = net2.forward(
+                params, tok.reshape(b, 1, 1, 1).astype(jnp.float32),
+                train=False, decode_pos=pos,
+                kv_cache=dict(zip(cache_keys, caches)))
+            cu = net2._last_cache_updates
+            return (values[last].reshape(b, -1),
+                    tuple(cu[k] for k in cache_keys))
+
+        platforms = ("cpu", "tpu") if compat else None
+        cache_specs = tuple(jax.ShapeDtypeStruct(sh, jnp.float32)
+                            for sh in cache_shapes)
+        pre_exp = jexport.export(jax.jit(prefill), platforms=platforms)(
+            jax.ShapeDtypeStruct((b, plen), jnp.int32))
+        step_exp = jexport.export(jax.jit(step), platforms=platforms)(
+            jax.ShapeDtypeStruct((b,), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.int32), cache_specs)
+        return pre_exp.serialize(), step_exp.serialize()
 
     def export_forward(self, node_name: str = "", batch_size: int = 0,
                        compat: bool = True) -> bytes:

@@ -147,11 +147,53 @@ def test_decode_sampling():
     assert s1.min() >= 0 and s1.max() < VOCAB
 
 
+def test_export_decode_artifacts_match(tmp_path):
+    """The exported prefill/step StableHLO pair, driven by the jax-only
+    reference loop, reproduces Trainer.generate token for token."""
+    from cxxnet_tpu import api
+    tr = _trained()
+    rs = np.random.RandomState(9)
+    prompts = rs.randint(0, VOCAB, (4, 6))
+    pre_b, step_b = tr.export_decode(batch_size=4, prompt_len=6)
+    p1, p2 = str(tmp_path / "pre.hlo"), str(tmp_path / "step.hlo")
+    open(p1, "wb").write(pre_b)
+    open(p2, "wb").write(step_b)
+    gen = api.load_decode(p1, p2)
+    got = gen(prompts, 8)
+    want = tr.generate(prompts, 8)
+    np.testing.assert_array_equal(got, want)
+
+
 def test_decode_bounds_checked():
     import pytest
     tr = _trained(steps=1)
     with pytest.raises(Exception, match="exceeds"):
         tr.generate(np.zeros((8, 20), np.int64), 10)
+    # non-causal attention cannot decode or export artifacts
+    conf = (LM % {"vocab": VOCAB, "seq": SEQ, "embed_extra": "pos_embed = 1",
+                  "attn_extra": ""}).replace("causal = 1", "causal = 0")
+    nc = Trainer()
+    for k, v in parse_config_string(conf):
+        nc.set_param(k, v)
+    nc.init_model()
+    with pytest.raises(Exception, match="not causal"):
+        nc.generate(np.zeros((8, 4), np.int64), 2)
+    with pytest.raises(Exception, match="not causal"):
+        nc.export_decode(batch_size=2, prompt_len=4)
+
+
+def test_export_decode_artifact_bounds(tmp_path):
+    from cxxnet_tpu import api
+    import pytest
+    tr = _trained(steps=1)
+    pre_b, step_b = tr.export_decode(batch_size=2, prompt_len=4)
+    p1, p2 = str(tmp_path / "p.hlo"), str(tmp_path / "s.hlo")
+    open(p1, "wb").write(pre_b)
+    open(p2, "wb").write(step_b)
+    gen = api.load_decode(p1, p2)
+    with pytest.raises(ValueError, match="exceeds"):
+        gen(np.zeros((2, 4), np.int64), SEQ)
+    assert gen(np.zeros((2, 4), np.int64), 0).shape == (2, 0)
 
 
 def test_decode_with_remat_attention():
