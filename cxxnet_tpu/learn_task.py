@@ -50,6 +50,12 @@ class LearnTask:
         self.extract_node_name = ""
         self.name_export = "model.stablehlo"
         self.export_batch = 0
+        self.name_prompt_in = "prompts.txt"
+        self.name_gen_out = "gen.txt"
+        self.gen_new = 16
+        self.gen_temperature = 0.0
+        self.gen_topk = 0
+        self.gen_seed = 0
         self.output_format = 1
         self.device = "tpu"
         # multi-host launch (replaces the reference's PS/MPI launcher,
@@ -89,6 +95,8 @@ class LearnTask:
             self.task_extract_feature()
         elif self.task == "export":
             self.task_export()
+        elif self.task == "generate":
+            self.task_generate()
         return 0
 
     def set_param(self, name: str, val: str) -> None:
@@ -136,6 +144,18 @@ class LearnTask:
             self.name_export = val
         if name == "export_batch":
             self.export_batch = int(val)
+        if name == "prompt_in":
+            self.name_prompt_in = val
+        if name == "gen_out":
+            self.name_gen_out = val
+        if name == "gen_new":
+            self.gen_new = int(val)
+        if name == "gen_temperature":
+            self.gen_temperature = float(val)
+        if name == "gen_topk":
+            self.gen_topk = int(val)
+        if name == "gen_seed":
+            self.gen_seed = int(val)
         if name == "output_format":
             self.output_format = 1 if val == "txt" else 0
         self.cfg.append((name, val))
@@ -243,10 +263,10 @@ class LearnTask:
                 continue
             if name == "iter" and val == "end":
                 assert flag != 0, "wrong configuration file"
-                if flag == 1 and self.task not in ("pred", "export"):
+                if flag == 1 and self.task not in ("pred", "export", "generate"):
                     assert self.itr_train is None, "can only have one data"
                     self.itr_train = create_iterator(itcfg)
-                if flag == 2 and self.task not in ("pred", "export"):
+                if flag == 2 and self.task not in ("pred", "export", "generate"):
                     self.itr_evals.append(create_iterator(itcfg))
                     self.eval_names.append(evname)
                 if flag == 3 and self.task in ("pred", "pred_raw", "extract"):
@@ -389,6 +409,44 @@ class LearnTask:
         with open(name_meta, "w") as fm:
             fm.write("%d,%d,%d,%d\n" % (nrow, dshape[0], dshape[1], dshape[2]))
         print("finished prediction, write into %s" % self.name_pred)
+
+    def task_generate(self) -> None:
+        """task = generate: KV-cached continuation of token-id prompts
+        (sequence nets; model_in required). ``prompt_in`` is a text file
+        of space-separated integer token ids, one prompt per line —
+        lines may have DIFFERENT lengths (ragged batch; per-row prompt
+        lengths feed Trainer.generate's prompt_lens). ``gen_new`` tokens
+        are appended per prompt with greedy decoding by default
+        (gen_temperature / gen_topk / gen_seed for sampling) and written
+        to ``gen_out``, one space-separated id line per prompt."""
+        rows = []
+        with open(self.name_prompt_in) as f:
+            for line in f:
+                line = line.split()
+                if line:
+                    rows.append([int(t) for t in line])
+        assert rows, "prompt_in %s has no prompts" % self.name_prompt_in
+        vocab = max((lay.vocab_size
+                     for lay in self.net_trainer.net.layers
+                     if getattr(lay, "type_name", "") == "embed"),
+                    default=0)
+        if vocab:
+            bad = [t for r in rows for t in r if not 0 <= t < vocab]
+            assert not bad, (
+                "prompt_in contains token ids outside the net's "
+                "vocab_size %d (e.g. %d) — wrong tokenizer? (jit would "
+                "silently clamp them)" % (vocab, bad[0]))
+        lens = [len(r) for r in rows]
+        max_p = max(lens)
+        prompts = [r + [0] * (max_p - len(r)) for r in rows]
+        out = self.net_trainer.generate(
+            prompts, self.gen_new, temperature=self.gen_temperature,
+            top_k=self.gen_topk, seed=self.gen_seed, prompt_lens=lens)
+        with open(self.name_gen_out, "w") as fo:
+            for row in out:
+                fo.write(" ".join(str(int(t)) for t in row) + "\n")
+        print("generated %d x %d tokens into %s"
+              % (out.shape[0], out.shape[1], self.name_gen_out))
 
     def task_export(self) -> None:
         """task = export: AOT-compile the inference forward (params baked

@@ -164,6 +164,41 @@ def test_export_decode_artifacts_match(tmp_path):
     np.testing.assert_array_equal(got, want)
 
 
+def test_cli_generate_task(tmp_path):
+    """task = generate through the CLI: train -> save -> generate ragged
+    prompt lines to a file; outputs match Trainer.generate."""
+    from cxxnet_tpu import learn_task
+    from cxxnet_tpu.utils import serializer
+    tr = _trained()
+    model = str(tmp_path / "0001.model")
+    with open(model, "wb") as f:
+        w = serializer.Writer(f)
+        w.write_int32(0)
+        tr.save_model(w)
+    rs = np.random.RandomState(5)
+    lines = [rs.randint(0, VOCAB, n).tolist() for n in (4, 7, 5, 7)]
+    pf = str(tmp_path / "prompts.txt")
+    with open(pf, "w") as f:
+        for row in lines:
+            f.write(" ".join(map(str, row)) + "\n")
+    gout = str(tmp_path / "gen.txt")
+    conf = LM % {"vocab": VOCAB, "seq": SEQ,
+                 "embed_extra": "pos_embed = 1", "attn_extra": ""}
+    cf = str(tmp_path / "gen.conf")
+    with open(cf, "w") as f:
+        f.write(conf + "task = generate\nmodel_in = %s\n"
+                "prompt_in = %s\ngen_out = %s\ngen_new = 5\n"
+                % (model, pf, gout))
+    assert learn_task.main([cf]) == 0
+    got = [list(map(int, line.split())) for line in open(gout)]
+    prompts = np.zeros((4, 7), np.int64)
+    lens = np.array([len(r) for r in lines])
+    for i, r in enumerate(lines):
+        prompts[i, :len(r)] = r
+    want = tr.generate(prompts, 5, prompt_lens=lens)
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
 def test_decode_bounds_checked():
     import pytest
     tr = _trained(steps=1)
