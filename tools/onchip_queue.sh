@@ -43,15 +43,17 @@ run() {
   note "$name DONE rc=$rc: $(tail -1 "onchip_logs/$name.log" | cut -c1-160)"
 }
 
-run kernels  900  python tools/check_tpu_kernels.py
+# priority order for SHORT tunnel windows: the headline bench first (the
+# driver's BENCH_r* number), then the queued A/Bs, then the long sweeps
 run bench    900  python bench.py
-run layout   2400 python tools/layout_ab.py default
+run kernels  900  python tools/check_tpu_kernels.py
 run poolab   1500 python tools/pool_ab.py
-run mfu      5400 python tools/mfu_experiments.py all
-run pipeline 1200 python bench.py pipeline
-run quality  3600 python tools/quality_run.py
-run profile  1200 python tools/profile_bench.py googlenet
+run layout   2400 python tools/layout_ab.py default
 run benchall 4200 python bench.py all
 run mfutable 600  python tools/roofline.py --bench onchip_logs/bench.log --bench onchip_logs/benchall.log
+run pipeline 1200 python bench.py pipeline
+run mfu      5400 python tools/mfu_experiments.py all
+run quality  3600 python tools/quality_run.py
+run profile  1200 python tools/profile_bench.py googlenet
 
 note "queue finished"
