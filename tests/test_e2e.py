@@ -223,6 +223,21 @@ def test_mnist_mlp_multidevice(tmp_path, mnist_data):
     assert err < 0.35, "multi-device eval error %f" % err
 
 
+def test_mnist_mlp_composed_parallelism(tmp_path, mnist_data):
+    """The full CLI pipeline (iterators, metrics, checkpoints) on a
+    composed mesh: pp x tp x dp + ZeRO-1 (fsdp=1) over the 8-device
+    virtual mesh — training must converge exactly like the plain run."""
+    conf = write_conf(tmp_path, MLP_CONF, mnist_data, num_round=4)
+    task = run_task(conf, "dev=tpu:0-7", "pipeline_parallel=2",
+                    "model_parallel=2", "fsdp=1")
+    mesh = task.net_trainer.mesh
+    assert (mesh.shape["data"], mesh.shape["pipe"],
+            mesh.shape["model"]) == (2, 2, 2)
+    err = task.net_trainer.metric.evals[0].get()
+    assert err < 0.35, "composed-mesh eval error %f" % err
+    assert os.path.exists(str(tmp_path / "models" / "0001.model"))
+
+
 def test_update_period_accumulation(tmp_path, mnist_data):
     conf = write_conf(tmp_path, MLP_CONF, mnist_data, num_round=6)
     task = run_task(conf, "update_period=2", "eta=0.4")
