@@ -184,6 +184,13 @@ class Net:
                                   top_k=top_k, seed=seed,
                                   prompt_lens=prompt_lens)
 
+    def beam_generate(self, prompts: np.ndarray, n_new: int,
+                      beam: int = 4) -> np.ndarray:
+        """Width-`beam` KV-cached beam search (best summed-log-prob
+        continuation per row — see Trainer.beam_generate)."""
+        assert self.net_ is not None, "model not initialized"
+        return self.net_.beam_generate(prompts, n_new, beam=beam)
+
     def export(self, fname: str, node_name: str = "",
                batch_size: int = 0) -> None:
         """Write the inference forward as a self-contained StableHLO

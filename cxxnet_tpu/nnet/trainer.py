@@ -1069,16 +1069,7 @@ class Trainer:
         if plen not in self._prefill_nets:
             self._prefill_nets[plen] = self._seq_net(b, plen)
         pre_net = self._prefill_nets[plen]
-        # gathered-canonical params live on device, re-fetched only when
-        # training produced a new params list (every serving call after
-        # that reuses them — no host round trip inside the timed path)
-        if self._decode_params is None \
-                or self._decode_params[0] is not self.params:
-            self._decode_params = (self.params, [
-                {k: jnp.asarray(np.asarray(parallel.fetch_global(v)))
-                 for k, v in p.items()}
-                for p in self.canonical_params()])
-        params = self._decode_params[1]
+        params = self._decode_params_current()
         _, cache_keys, cache_shapes = \
             self._decode_cache_specs(net2, b, l_max)
 
@@ -1163,6 +1154,18 @@ class Trainer:
         return np.stack([toks[r, lens[r]: lens[r] + n_new]
                          for r in range(b)])
 
+    def _decode_params_current(self):
+        """Gathered-canonical params on device for the decode paths,
+        re-fetched only when training produced a new params list — the
+        ONE staleness rule generate and beam_generate share."""
+        if getattr(self, "_decode_params", None) is None \
+                or self._decode_params[0] is not self.params:
+            self._decode_params = (self.params, [
+                {k: jnp.asarray(np.asarray(parallel.fetch_global(v)))
+                 for k, v in p.items()}
+                for p in self.canonical_params()])
+        return self._decode_params[1]
+
     def _seq_net(self, batch_size: int, seq_len: int) -> "NeuralNet":
         """A NeuralNet over the same config at a different sequence
         length (the decode/prefill nets — weights stay the trainer's)."""
@@ -1192,6 +1195,115 @@ class Trainer:
                 shapes.append((b, lay.nkvhead or lay.nhead, l_max,
                                d_in // lay.nhead))
         return att_idx, keys, shapes
+
+    def beam_generate(self, prompts, n_new: int,
+                      beam: int = 4) -> np.ndarray:
+        """KV-cached beam search: width-``beam`` exact search over summed
+        log-probabilities, returning each row's best continuation
+        (batch, n_new). Beams ride the decode batch dim (b*beam rows);
+        each step re-ranks beam x vocab candidates and REORDERS the k/v
+        caches to the surviving beams' parents (a batch-dim gather —
+        the cache machinery is shared with generate()). Fixed horizon
+        (no stop-token handling); uniform prompt lengths.
+        """
+        prompts = np.asarray(prompts)
+        check(prompts.ndim == 2,
+              "beam_generate: prompts must be (batch, len)")
+        b, plen = prompts.shape
+        B = int(beam)
+        check(B >= 1, "beam_generate: beam must be >= 1")
+        l_max = self.net_cfg.param.input_shape[2]
+        total = plen + n_new
+        check(total <= l_max,
+              "beam_generate: prompt_len %d + n_new %d exceeds the "
+              "net's sequence length %d" % (plen, n_new, l_max))
+        if n_new <= 0:
+            return np.zeros((b, 0), np.int32)
+        key = ("beam", b, B)
+        if getattr(self, "_beam_net", None) is None \
+                or self._beam_net[0] != key:
+            self._beam_net = (key, self._seq_net(b * B, 1))
+            self._beam_prefill = {}
+            self._beam_fns = {}
+        net2 = self._beam_net[1]
+        if plen not in self._beam_prefill:
+            self._beam_prefill[plen] = self._seq_net(b, plen)
+        pre_net = self._beam_prefill[plen]
+        params = self._decode_params_current()
+        _, cache_keys, pre_shapes = \
+            self._decode_cache_specs(pre_net, b, l_max)
+        last = net2.cfg.param.num_nodes - 1
+
+        fkey = (plen, total)
+        if fkey not in self._beam_fns:
+
+            def logp(probs):
+                return jnp.log(jnp.maximum(probs, 1e-30))
+
+            def run(params, toks):
+                # prefill on the raw batch, then expand row r -> r*B..:
+                # every beam of a row starts from the same prompt caches
+                caches = {k: jnp.zeros(sh, jnp.float32)
+                          for k, sh in zip(cache_keys, pre_shapes)}
+                values, _ = pre_net.forward(
+                    params,
+                    toks[:, :plen].reshape(b, 1, 1, plen)
+                    .astype(jnp.float32),
+                    train=False, decode_pos=0, kv_cache=caches)
+                caches = {k: jnp.repeat(v, B, axis=0)
+                          for k, v in
+                          pre_net._last_cache_updates.items()}
+                lp = logp(values[last].reshape(b, -1, plen)[:, :, -1])
+                V = lp.shape[1]
+                k0 = min(B, V)
+                scores, tok0 = jax.lax.top_k(lp, k0)       # (b, B)
+                if k0 < B:   # vocab smaller than beam: pad dead beams
+                    padd = B - k0
+                    scores = jnp.pad(scores, ((0, 0), (0, padd)),
+                                     constant_values=-jnp.inf)
+                    tok0 = jnp.pad(tok0, ((0, 0), (0, padd)))
+                hist = jnp.repeat(toks, B, axis=0)         # (b*B, l_max)
+                hist = jax.lax.dynamic_update_slice(
+                    hist, tok0.reshape(-1, 1).astype(hist.dtype),
+                    (0, plen))
+
+                def step(carry, t):
+                    hist, scores, caches = carry
+                    tok_t = jax.lax.dynamic_slice(
+                        hist, (0, t), (b * B, 1))
+                    values, _ = net2.forward(
+                        params,
+                        tok_t.reshape(b * B, 1, 1, 1).astype(jnp.float32),
+                        train=False, decode_pos=t, kv_cache=caches)
+                    caches = dict(net2._last_cache_updates)
+                    lp = logp(values[last].reshape(b * B, -1))
+                    cand = (scores.reshape(b, B, 1)
+                            + lp.reshape(b, B, -1)).reshape(b, -1)
+                    scores, idx = jax.lax.top_k(cand, B)   # (b, B)
+                    parent = idx // lp.shape[1]
+                    tok = (idx % lp.shape[1]).astype(hist.dtype)
+                    rows = (jnp.arange(b)[:, None] * B
+                            + parent).reshape(-1)
+                    caches = {k: jnp.take(v, rows, axis=0)
+                              for k, v in caches.items()}
+                    hist = jnp.take(hist, rows, axis=0)
+                    hist = jax.lax.dynamic_update_slice(
+                        hist, tok.reshape(-1, 1), (0, t + 1))
+                    return (hist, scores, caches), None
+
+                if total > plen + 1:
+                    (hist, scores, caches), _ = jax.lax.scan(
+                        step, (hist, scores, caches),
+                        jnp.arange(plen, total - 1))
+                best = jnp.argmax(scores, axis=1)          # (b,)
+                rows = jnp.arange(b) * B + best
+                return jnp.take(hist, rows, axis=0), scores
+
+            self._beam_fns[fkey] = jax.jit(run)
+        toks0 = np.zeros((b, l_max), np.int32)
+        toks0[:, :plen] = prompts
+        hist, _ = self._beam_fns[fkey](params, jnp.asarray(toks0))
+        return np.asarray(hist)[:, plen:total]
 
     def export_decode(self, batch_size: int, prompt_len: int,
                       compat: bool = True):

@@ -130,6 +130,46 @@ def test_decode_ragged_with_sampling():
     assert s1.shape == (8, 6) and s1.min() >= 0 and s1.max() < VOCAB
 
 
+def _seq_logprob(tr, prompts, cont):
+    """Sum of model log-probs of `cont` given `prompts` (full forward)."""
+    b, plen = prompts.shape
+    n = cont.shape[1]
+    toks = np.zeros((b, SEQ), np.int64)
+    toks[:, :plen] = prompts
+    toks[:, plen:plen + n] = cont
+    db = DataBatch()
+    db.data = toks.reshape(b, 1, 1, SEQ).astype(np.float32)
+    db.label = np.zeros((b, SEQ), np.float32)
+    db.batch_size = b
+    probs = tr.extract_feature(db, "top[-1]").reshape(b, VOCAB, SEQ)
+    lp = np.zeros(b)
+    for t in range(plen, plen + n):
+        lp += np.log(np.maximum(
+            probs[np.arange(b), toks[:, t], t - 1], 1e-30))
+    return lp
+
+
+def test_beam_search():
+    """beam=1 IS greedy (called FIRST — no prior generate() warms the
+    decode state); beam=4 is deterministic, in-vocab, and in practice
+    scores at least as well as greedy on this model (informative, not a
+    theorem — beam search may prune the greedy path; only logged)."""
+    tr = _trained(steps=12)   # partially trained: beams can disagree
+    rs = np.random.RandomState(21)
+    prompts = rs.randint(0, VOCAB, (8, 6))
+    b1 = tr.beam_generate(prompts, 8, beam=1)
+    greedy = tr.generate(prompts, 8)
+    np.testing.assert_array_equal(b1, greedy)
+    b4 = tr.beam_generate(prompts, 8, beam=4)
+    b4_again = tr.beam_generate(prompts, 8, beam=4)
+    np.testing.assert_array_equal(b4, b4_again)
+    assert b4.shape == (8, 8) and b4.min() >= 0 and b4.max() < VOCAB
+    lp_greedy = _seq_logprob(tr, prompts, greedy)
+    lp_beam = _seq_logprob(tr, prompts, b4)
+    print("beam4 vs greedy mean log-prob: %.3f vs %.3f"
+          % (lp_beam.mean(), lp_greedy.mean()))
+
+
 def test_decode_sampling():
     """temperature > 0 samples valid tokens reproducibly per seed; a tiny
     temperature concentrates the categorical on the argmax (= greedy)."""
