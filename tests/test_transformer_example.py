@@ -30,3 +30,10 @@ def test_lm_learns_grammar():
         tr.update(train_lm.make_batch(rs))
     after = train_lm.next_token_accuracy(tr, eval_b)
     assert after > 0.7, "LM failed to learn the grammar: %.3f" % after
+
+
+def test_lm_pipeline_conf_learns_grammar():
+    """lm_pipeline.conf: the composed pp x tp x dp + ZeRO-1 example
+    trains the same grammar through the example driver."""
+    acc = train_lm.main(steps=120, conf_name="lm_pipeline.conf")
+    assert acc > 0.7, "composed-mesh LM accuracy %.3f" % acc
