@@ -1070,7 +1070,7 @@ class Trainer:
             self._prefill_nets[plen] = self._seq_net(b, plen)
         pre_net = self._prefill_nets[plen]
         params = self._decode_params_current()
-        _, cache_keys, cache_shapes = \
+        _, cache_keys, cache_shapes, cache_dtype = \
             self._decode_cache_specs(net2, b, l_max)
 
         temperature, top_k = float(temperature), int(top_k)
@@ -1097,7 +1097,7 @@ class Trainer:
                 return jax.random.categorical(step_key, lg, axis=1)
 
             def run(params, toks, key, lens):
-                caches = {k: jnp.zeros(sh, jnp.float32)
+                caches = {k: jnp.zeros(sh, cache_dtype)
                           for k, sh in zip(cache_keys, cache_shapes)}
 
                 def place(toks, t, picked):
@@ -1168,18 +1168,24 @@ class Trainer:
 
     def _seq_net(self, batch_size: int, seq_len: int) -> "NeuralNet":
         """A NeuralNet over the same config at a different sequence
-        length (the decode/prefill nets — weights stay the trainer's)."""
+        length (the decode/prefill nets — weights stay the trainer's,
+        and so does the compute dtype: a bf16-trained model decodes in
+        bf16)."""
         import copy
         cfg2 = copy.deepcopy(self.net_cfg)
         cfg2.param.input_shape = (1, 1, seq_len)
-        return NeuralNet(cfg2, batch_size)
+        return NeuralNet(cfg2, batch_size,
+                         compute_dtype=self.compute_dtype)
 
     @staticmethod
     def _decode_cache_specs(net2, b: int, l_max: int):
-        """(att_idx, cache_keys, cache_shapes) for a decode net — THE
-        cache layout contract, shared by generate and export_decode so
-        live decoding and exported artifacts cannot drift apart. Also
-        enforces the decode preconditions (attention present, causal)."""
+        """(att_idx, cache_keys, cache_shapes, cache_dtype) for a decode
+        net — THE cache layout contract, shared by generate and
+        export_decode so live decoding and exported artifacts cannot
+        drift apart. Caches live in the net's compute dtype (a
+        bf16-trained model keeps bf16 activations end to end and halves
+        serving cache bytes). Also enforces the decode preconditions
+        (attention present, causal)."""
         att_idx = [i for i, lay in enumerate(net2.layers)
                    if getattr(lay, "type_name", "") == "attention"]
         check(bool(att_idx), "decode: the net has no attention layers")
@@ -1194,7 +1200,7 @@ class Trainer:
                 keys.append((i, nm))
                 shapes.append((b, lay.nkvhead or lay.nhead, l_max,
                                d_in // lay.nhead))
-        return att_idx, keys, shapes
+        return att_idx, keys, shapes, net2.compute_dtype or jnp.float32
 
     def beam_generate(self, prompts, n_new: int,
                       beam: int = 4) -> np.ndarray:
@@ -1230,7 +1236,7 @@ class Trainer:
             self._beam_prefill[plen] = self._seq_net(b, plen)
         pre_net = self._beam_prefill[plen]
         params = self._decode_params_current()
-        _, cache_keys, pre_shapes = \
+        _, cache_keys, pre_shapes, cache_dtype = \
             self._decode_cache_specs(pre_net, b, l_max)
         last = net2.cfg.param.num_nodes - 1
 
@@ -1243,7 +1249,7 @@ class Trainer:
             def run(params, toks):
                 # prefill on the raw batch, then expand row r -> r*B..:
                 # every beam of a row starts from the same prompt caches
-                caches = {k: jnp.zeros(sh, jnp.float32)
+                caches = {k: jnp.zeros(sh, cache_dtype)
                           for k, sh in zip(cache_keys, pre_shapes)}
                 values, _ = pre_net.forward(
                     params,
@@ -1332,12 +1338,12 @@ class Trainer:
         params = [{k: np.asarray(parallel.fetch_global(v))
                    for k, v in p.items()}
                   for p in self.canonical_params()]
-        _, cache_keys, cache_shapes = \
+        _, cache_keys, cache_shapes, cache_dtype = \
             self._decode_cache_specs(net2, b, l_max)
         last = net2.cfg.param.num_nodes - 1
 
         def prefill(toks):
-            caches = {k: jnp.zeros(sh, jnp.float32)
+            caches = {k: jnp.zeros(sh, cache_dtype)
                       for k, sh in zip(cache_keys, cache_shapes)}
             values, _ = pre_net.forward(
                 params, toks.reshape(b, 1, 1, plen).astype(jnp.float32),
@@ -1356,7 +1362,7 @@ class Trainer:
                     tuple(cu[k] for k in cache_keys))
 
         platforms = ("cpu", "tpu") if compat else None
-        cache_specs = tuple(jax.ShapeDtypeStruct(sh, jnp.float32)
+        cache_specs = tuple(jax.ShapeDtypeStruct(sh, cache_dtype)
                             for sh in cache_shapes)
         pre_exp = jexport.export(jax.jit(prefill), platforms=platforms)(
             jax.ShapeDtypeStruct((b, plen), jnp.int32))

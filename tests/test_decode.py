@@ -271,6 +271,30 @@ def test_export_decode_artifact_bounds(tmp_path):
     assert gen(np.zeros((2, 4), np.int64), 0).shape == (2, 0)
 
 
+def test_decode_bf16_compute():
+    """A bf16-trained model decodes in bf16 (the decode nets inherit
+    compute_dtype) and still matches ITS OWN bf16 full recompute."""
+    conf = (LM % {"vocab": VOCAB, "seq": SEQ,
+                  "embed_extra": "pos_embed = 1", "attn_extra": ""}
+            ) + "compute_dtype = bfloat16\n"
+    tr = Trainer()
+    for k, v in parse_config_string(conf):
+        tr.set_param(k, v)
+    tr.init_model()
+    rs = np.random.RandomState(0)
+    for _ in range(20):
+        phase = rs.randint(0, VOCAB, (8, 1))
+        t = np.arange(SEQ + 1)[None, :]
+        toks = (phase + t) % VOCAB
+        b = DataBatch()
+        b.data = toks[:, :SEQ].reshape(8, 1, 1, SEQ).astype(np.float32)
+        b.label = toks[:, 1:].astype(np.float32)
+        b.batch_size = 8
+        tr.update(b)
+    assert tr._seq_net(8, 1).compute_dtype is not None
+    _check(tr)
+
+
 def test_decode_with_remat_attention():
     """remat=1 attention (the long-context training config): decode skips
     the checkpoint wrapper (no backward at inference) and still matches
