@@ -1259,9 +1259,23 @@ class AttentionLayer(Layer):
                 cv, v.astype(cv.dtype), (0, 0, pos, 0))
             ctx.cache_updates[(li, "k")] = ck
             ctx.cache_updates[(li, "v")] = cv
-            out = attention_reference(
-                q, ck, cv, causal=True, scale=dh ** -0.5,
-                window=self.attn_window, q_offset=pos)
+            if isinstance(pos, int) and pos == 0 and L > 1:
+                # PREFILL (statically at position 0): attention over the
+                # chunk itself equals cache attention at offset 0 (slots
+                # past L are causally masked anyway) — and unlocks the
+                # O(L)-memory flash kernel for long prompts, instead of
+                # (L, l_max) dense scores against the cache
+                if ops.use_pallas() and ops.flash_supported(L, dh):
+                    out = ops.flash_attention(q, k, v, causal=True,
+                                              window=self.attn_window)
+                else:
+                    out = attention_reference(
+                        q, k, v, causal=True, scale=dh ** -0.5,
+                        window=self.attn_window)
+            else:
+                out = attention_reference(
+                    q, ck, cv, causal=True, scale=dh ** -0.5,
+                    window=self.attn_window, q_offset=pos)
         elif (sp_n := manual_axis_size(ctx, "sp")) > 1:
             # sequence parallelism inside a pipeline stage body (manual
             # shard_map): k/v are ALREADY replicated over sp (the pipeline
