@@ -467,6 +467,11 @@ class Trainer:
                 up = self.updaters[i].get(key)
                 if up is None:
                     continue
+                check(getattr(up, "elementwise", False),
+                      "pipeline_parallel: updater '%s' for layer %d key %s "
+                      "declares elementwise=False (per-tensor reductions); "
+                      "the packed stage update would be wrong for it" %
+                      (up.kind, i, key))
                 sig = _updater_signature(up)
                 if sig not in gid_of:
                     check(len(groups) < 127,
@@ -674,6 +679,7 @@ class Trainer:
                     self.params[j].update(
                         {k: jnp.asarray(v)
                          for k, v in old_params[i].items()})
+        self._decode_params = None   # per-dict update above is in place
         self._init_opt()
         self._pp_pack()
 
@@ -1156,15 +1162,50 @@ class Trainer:
 
     def _decode_params_current(self):
         """Gathered-canonical params on device for the decode paths,
-        re-fetched only when training produced a new params list — the
-        ONE staleness rule generate and beam_generate share."""
+        re-fetched only when the params changed — the ONE staleness rule
+        generate and beam_generate share. CONTRACT: the key is the params
+        LIST identity — training reassigns the list, so that path is
+        covered structurally; any mutator that edits the param dicts in
+        place (set_weight, copy_model_from today) must set
+        self._decode_params = None itself. (Leaf-id keys would be
+        unsound: id() values recycle after GC; holding leaf refs would
+        pin the previous params in device memory.)"""
         if getattr(self, "_decode_params", None) is None \
                 or self._decode_params[0] is not self.params:
-            self._decode_params = (self.params, [
+            canon = [
                 {k: jnp.asarray(np.asarray(parallel.fetch_global(v)))
                  for k, v in p.items()}
-                for p in self.canonical_params()])
+                for p in self.canonical_params()]
+            mesh = self._decode_mesh()
+            if mesh is not None:
+                # tensor-parallel serving: place the decode copy with the
+                # SAME Megatron shardings training uses (fullc/conv wmat
+                # split over the model axis, attention replicated —
+                # parallel/sharding.py:tp_spec); GSPMD partitions the
+                # decode matmuls and the argmax/sampling runs on gathered
+                # logits. A model whose FFN/head weights need tp to fit
+                # one chip's HBM is served the same way it was trained.
+                from ..parallel.sharding import param_shardings
+                shards = param_shardings(mesh, self.net.layers, canon)
+                canon = [
+                    {k: jax.device_put(v, shards[i][k])
+                     for k, v in p.items()}
+                    for i, p in enumerate(canon)]
+            self._decode_params = (self.params, canon)
         return self._decode_params[1]
+
+    def _decode_mesh(self):
+        """The serving mesh: ``model_parallel`` devices on one "model"
+        axis (the first tp group — serving needs no data axis; the batch
+        rides every device). None = single-device decode."""
+        if self.model_parallel <= 1 or self.mesh is None \
+                or "model" not in self.mesh.axis_names:
+            return None
+        if getattr(self, "_decode_mesh_cache", None) is None:
+            devs = np.asarray(self.mesh.devices).reshape(
+                -1, self.mesh.shape["model"])[0]
+            self._decode_mesh_cache = jax.sharding.Mesh(devs, ("model",))
+        return self._decode_mesh_cache
 
     def _seq_net(self, batch_size: int, seq_len: int) -> "NeuralNet":
         """A NeuralNet over the same config at a different sequence
@@ -1369,16 +1410,26 @@ class Trainer:
         step_exp = jexport.export(jax.jit(step), platforms=platforms)(
             jax.ShapeDtypeStruct((b,), jnp.int32),
             jax.ShapeDtypeStruct((), jnp.int32), cache_specs)
-        return pre_exp.serialize(), step_exp.serialize()
+        # versioned frame: loaders check format version + that the two
+        # artifacts share ONE cache-layout contract (utils/artifact.py;
+        # the reference's model-blob version guard, nnet_config.h:126-145)
+        from ..utils import artifact
+        meta = {"cache_fingerprint": artifact.cache_fingerprint(
+                    cache_keys, cache_shapes, cache_dtype),
+                "batch": b, "prompt_len": plen, "l_max": int(l_max)}
+        return (artifact.frame("decode_prefill", meta, pre_exp.serialize()),
+                artifact.frame("decode_step", meta, step_exp.serialize()))
 
     def export_forward(self, node_name: str = "", batch_size: int = 0,
                        compat: bool = True) -> bytes:
         """AOT-compile-and-serialize the inference forward as a portable
         StableHLO artifact (jax.export): trained params are baked in as
         constants, so the artifact is fully self-contained — loadable in
-        any process with `cxxnet_tpu.api.load_exported` (or plain
-        jax.export.deserialize) and runnable WITHOUT the framework, the
-        config file, or the model file. The TPU-native deployment story
+        any process with `cxxnet_tpu.api.load_exported` and runnable
+        WITHOUT the framework, the config file, or the model file (a
+        framework-free host strips the versioned 12-byte+JSON header —
+        magic "CXTF", two <II fields (version, header_len), header —
+        then jax.export.deserialize's the payload; utils/artifact.py). The TPU-native deployment story
         the reference covered with its C wrapper + model files
         (wrapper/cxxnet_wrapper.h:36-230): here the whole net is one
         compiler artifact.
@@ -1418,7 +1469,11 @@ class Trainer:
         platforms = ("cpu", "tpu") if compat else None
         exp = jexport.export(jax.jit(fwd),
                              platforms=platforms)(spec)
-        return exp.serialize()
+        from ..utils import artifact
+        return artifact.frame(
+            "forward", {"input_shape": [int(c), int(h), int(w)],
+                        "batch": (-1 if batch_size < 0 else int(bs))},
+            exp.serialize())
 
     def evaluate(self, iter_eval, data_name: str) -> str:
         """Run metrics over an eval iterator; padding rows dropped
@@ -1470,6 +1525,9 @@ class Trainer:
     def set_weight(self, value: np.ndarray, layer_name: str, tag: str) -> None:
         check(tag in ("wmat", "bias", "wo"),
               "SetWeight: weight tag can only be bias, wmat, or wo")
+        # params mutate in place below; the decode cache keys on list
+        # identity and would otherwise serve stale weights to generate()
+        self._decode_params = None
         if self._pp_entries is not None:
             self._pp_unpack()
             self.net.set_weight(self.params, value, layer_name, tag)

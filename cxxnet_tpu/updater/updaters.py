@@ -53,6 +53,14 @@ def _clip_nan(g, bound):
 
 class Updater:
     kind = "none"
+    # The packed pipeline update (trainer._pp_pack) applies one group
+    # member's apply() to the whole (k, F_p) stage array and selects per
+    # element by group id. That is only correct when apply() is purely
+    # elementwise (no per-tensor reductions). sgd/nag/adam/adamw are; an
+    # updater with a norm-based trust ratio or global clip must set this
+    # False, which makes _pp_pack refuse the pipeline_parallel config
+    # (a per-tensor fallback is not implemented).
+    elementwise = True
 
     def __init__(self, tag: str):
         self.param = UpdaterParam(tag)

@@ -891,3 +891,17 @@ momentum = 0.9
             tr.update(b)
             ref.update(b)
         _assert_params_match(tr, ref, rtol=5e-4, atol=5e-4)
+
+
+def test_pp_rejects_non_elementwise_updater():
+    """The packed-stage update applies one group member's apply() to the
+    whole (k, F_p) array — only sound for elementwise updaters. An updater
+    declaring elementwise=False must be refused at pack time (ADVICE r4)."""
+    tr = _trainer(ATT_CONF, "dev = cpu:0-7\npipeline_parallel = 2\n")
+    assert tr._pp_entries is not None
+    tr._pp_unpack()
+    for ups in tr.updaters:
+        for up in ups.values():
+            up.elementwise = False
+    with pytest.raises(ValueError, match="elementwise"):
+        tr._pp_pack()

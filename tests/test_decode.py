@@ -355,3 +355,56 @@ def test_decode_weight_tied_attention_has_separate_caches():
         b.batch_size = 8
         tr.update(b)
     _check(tr)
+
+
+def test_generate_sees_set_weight():
+    """The decode param cache must invalidate on SetWeight: net.set_weight
+    mutates the params list in place, so identity-keyed caching would
+    silently generate with stale weights (ADVICE r4)."""
+    tr = _trained(steps=10)
+    prompts = np.random.RandomState(3).randint(0, VOCAB, (4, 6))
+    before = tr.generate(prompts, 4)          # warm the decode cache
+    w, _ = tr.get_weight("head", "wmat")
+    tr.set_weight(np.zeros_like(w), "head", "wmat")
+    bias, _ = tr.get_weight("head", "bias")
+    tr.set_weight(np.zeros_like(bias), "head", "bias")
+    got = tr.generate(prompts, 4)
+    # zero head => uniform logits => greedy argmax picks token 0
+    np.testing.assert_array_equal(got, np.zeros_like(got))
+    assert not np.array_equal(before, np.zeros_like(before))
+
+
+def test_generate_tensor_parallel_token_exact():
+    """Serving under tensor parallelism (VERDICT r4 #3): generate() on a
+    model_parallel=2 trainer decodes with the FFN/head weights sharded
+    over the model axis (same Megatron specs as training) and must be
+    token-exact vs the single-device decode of the same weights —
+    column/output-channel splits introduce no reduction reordering."""
+    from cxxnet_tpu.utils import serializer
+    tr = _trained(steps=15)
+    w = serializer.Writer()
+    tr.save_model(w)
+
+    conf = LM % {"vocab": VOCAB, "seq": SEQ,
+                 "embed_extra": "pos_embed = 1", "attn_extra": ""}
+    tr_tp = Trainer()
+    for k, v in parse_config_string(conf):
+        tr_tp.set_param(k, v)
+    tr_tp.set_param("dev", "cpu:0-7")
+    tr_tp.set_param("model_parallel", "2")
+    tr_tp.init_model()
+    tr_tp.load_model(serializer.Reader(w.getvalue()))
+    assert tr_tp._decode_mesh() is not None
+
+    rs = np.random.RandomState(11)
+    prompts = rs.randint(0, VOCAB, (4, 6))
+    want = tr.generate(prompts, 8)
+    got = tr_tp.generate(prompts, 8)
+    np.testing.assert_array_equal(got, want)
+    # the sharded decode really holds the head weight split over the
+    # model axis (not gathered to one device)
+    params = tr_tp._decode_params_current()
+    idx = tr_tp.net_cfg.get_layer_index("head")
+    sh = params[idx]["wmat"].sharding
+    assert "model" in getattr(sh, "spec", ()) or any(
+        "model" in str(p) for p in sh.spec), sh.spec

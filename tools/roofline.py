@@ -32,6 +32,9 @@ import numpy as np
 # bf16 peak per chip. v5e ("v5 lite"): 197 TFLOP/s. Override for other
 # generations with CXXNET_PEAK_TFLOPS.
 PEAK_TFLOPS = {"v5e": 197.0, "v5lite": 197.0, "v4": 275.0, "v6e": 918.0}
+# HBM bandwidth per chip (GB/s) — the decode-side roof: autoregressive
+# decode is bound by bytes/token (params + KV cache), not FLOPs.
+HBM_GBS = {"v5e": 819.0, "v5lite": 819.0, "v4": 1228.0, "v6e": 1638.0}
 
 
 def peak_flops() -> float:
@@ -42,6 +45,14 @@ def peak_flops() -> float:
     return PEAK_TFLOPS.get(gen, 197.0) * 1e12
 
 
+def peak_hbm_bytes() -> float:
+    env = os.environ.get("CXXNET_PEAK_HBM_GBS")
+    if env:
+        return float(env) * 1e9
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e").lower()
+    return HBM_GBS.get(gen, 819.0) * 1e9
+
+
 def net_flops_per_sample(tr) -> float:
     """Forward matmul-class FLOPs for ONE sample of the trainer's net.
 
@@ -49,7 +60,7 @@ def net_flops_per_sample(tr) -> float:
     fullc:  2 * prod(wmat.shape)
     moe:    2 * E * din * dout (dense dispatch — every expert runs)
     attention: 4 * L * W * d_model score+AV FLOPs (W = attn_window or L)
-               + 2 * prod per projection weight
+               + 2 * L * prod per projection weight (applied per position)
     embed:  0 (gather).  Shared layers count once per APPLICATION.
     """
     net, cfg = tr.net, tr.net.cfg
@@ -80,6 +91,8 @@ def net_flops_per_sample(tr) -> float:
             win = getattr(lay, "attn_window", 0) or L
             causal = getattr(lay, "causal", 0)
             span = min(win, L)
+            # wqkv/wo projections apply per position, like conv's Ho*Wo
+            f *= L
             # scores + AV: 2 ops each over (L x span x d); causal halves
             f += (2.0 if causal else 4.0) * L * span * d
         total += f
@@ -124,7 +137,102 @@ def zoo(models=None):
     return out
 
 
+def decode_bound(tr, batch, prompt_len, gen_to, dtype_bytes=2):
+    """Analytic tokens/sec bound for KV-cached greedy decode.
+
+    Decode is HBM-bandwidth-bound, not FLOPs-bound: every step must read
+    the full parameter set once (shared by the batch) plus each stream's
+    KV cache up to the current position. bytes/step =
+      params*dtype + B * sum_layers 2*kv_dim*min(t, window)*dtype,
+    averaged over t in [prompt_len, gen_to). Bound = B * BW / avg_bytes.
+    Embedding tables are a GATHER at decode — B rows read per step, not
+    the whole table (mirroring the FLOPs model's "embed: 0" rule) — so
+    they are excluded from the params term and charged per-row instead.
+    Weight-shared attention applications each keep their own cache
+    (decode keys caches by connection), so shared layers count per
+    application here, unlike the params term."""
+    net = tr.net
+    params = tr.canonical_params() if hasattr(tr, "canonical_params") \
+        else tr.params
+    seen = set()
+    param_bytes = 0.0
+    embed_row_bytes = 0.0
+    for i, lay in enumerate(net.layers):
+        pidx = net.cfg.layers[i].primary_layer_index \
+            if net.is_shared[i] else i
+        if pidx in seen:
+            continue
+        seen.add(pidx)
+        for key, w in params[pidx].items():
+            sh = np.shape(w)
+            if getattr(lay, "type_name", "") == "embed":
+                # gather: one (d,)-row per stream per step
+                embed_row_bytes += float(sh[-1] if sh else 1) * dtype_bytes
+            else:
+                param_bytes += float(np.prod(sh)) * dtype_bytes
+    ts = np.arange(prompt_len, gen_to, dtype=np.float64)
+    kv_read = np.zeros_like(ts)
+    for i, lay in enumerate(net.layers):
+        if getattr(lay, "type_name", "") != "attention":
+            continue
+        b, d, _, L = net.node_shapes[net.cfg.layers[i].nindex_in[0]]
+        nkv = getattr(lay, "nkvhead", 0) or lay.nhead
+        kv_dim = nkv * (d // lay.nhead)
+        win = getattr(lay, "attn_window", 0) or gen_to
+        kv_read += 2.0 * kv_dim * np.minimum(ts, win) * dtype_bytes
+    avg_step_bytes = param_bytes + batch * (float(kv_read.mean())
+                                            + embed_row_bytes)
+    return batch * peak_hbm_bytes() / avg_step_bytes, param_bytes
+
+
+def decode_zoo():
+    """(name, builder, batch, prompt, gen_to) mirroring bench_lm_decode —
+    the serving configs whose measured tokens/sec the bound judges."""
+    from cxxnet_tpu import models as M
+
+    def lm(L, extra=""):
+        return lambda: M.transformer_lm_trainer(
+            vocab=8192, seq=L, batch_size=2, dim=512, nhead=8, nlayer=4,
+            dev="cpu", extra_cfg="eval_train = 0\n" + extra)
+
+    return [
+        ("lm_decode", lm(2048), 8, 64, 2048),
+        ("lm_decode_b1", lm(2048), 1, 64, 2048),
+        ("lm_decode_L8192_gqa_window",
+         lm(8192, "nkvhead = 2\nattn_window = 1024\nrope = 1\n"),
+         8, 64, 8192),
+    ]
+
+
+def decode_table(rates):
+    bw = peak_hbm_bytes()
+    print("| config | params MiB (bf16) | avg bytes/token | bound tok/s "
+          "| measured tok/s | % of bound |")
+    print("|---|---|---|---|---|---|")
+    for name, build, batch, plen, gen_to in decode_zoo():
+        try:
+            tr = build()
+        except Exception as e:
+            print("# %s: skipped (%s)" % (name, e), file=sys.stderr)
+            continue
+        bound, pbytes = decode_bound(tr, batch, plen, gen_to)
+        r = rates.get(name)
+        meas = ("%.0f" % r) if r else "queued"
+        pct = ("%.1f%%" % (100.0 * r / bound)) if r else "—"
+        print("| %s (b%d, %d->%d) | %.1f | %.2fM | %.0f | %s | %s |"
+              % (name, batch, plen, gen_to, pbytes / 2**20,
+                 bw / bound / 1e6, bound, meas, pct))
+    print("\n(bytes/token = bytes/step / batch; "
+          "bound = B * HBM_BW / (params + B*avg KV read) bytes/step; "
+          "HBM %.0f GB/s. MFU-style FLOPs are the wrong decode yardstick "
+          "— a batch-8 decode reads ~all params per token.)"
+          % (bw / 1e9))
+
+
 _RATE_KEYS = {
+    "lm_decode_tokens_per_sec": "lm_decode",
+    "lm_decode_b1_tokens_per_sec": "lm_decode_b1",
+    "lm_decode_L8192_tokens_per_sec": "lm_decode_L8192_gqa_window",
     "alexnet_imagenet_b1024": "alexnet",
     "alexnet_imagenet": "alexnet",
     "googlenet_imagenet": "googlenet",
@@ -165,6 +273,8 @@ def main():
                     help="bench JSON-lines file(s) to pull measured rates")
     ap.add_argument("--rate", action="append", default=[],
                     help="model=samples_per_sec override")
+    ap.add_argument("--decode", action="store_true",
+                    help="print the decode bandwidth-bound table instead")
     ap.add_argument("models", nargs="*")
     args = ap.parse_args()
     os.environ.setdefault("CXXNET_JAX_PLATFORM", "cpu")
@@ -173,6 +283,10 @@ def main():
     for spec in args.rate:
         k, v = spec.split("=")
         rates[k] = float(v)
+
+    if args.decode:
+        decode_table(rates)
+        return
 
     peak = peak_flops()
     print("| model | fwd GFLOPs/%s | train GFLOPs/%s | measured/s | MFU%% |"
