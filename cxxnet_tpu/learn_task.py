@@ -315,14 +315,39 @@ class LearnTask:
             sample_counter = 0
             self.net_trainer.start_round(self.start_counter)
             self.itr_train.before_first()
-            while self.itr_train.next():
+            # input-starvation probe: time spent blocked on the input
+            # pipeline (next+value) vs in the device step. The reference
+            # treats feed overlap as a design axis (thread_buffer.h:22);
+            # this is the number that says whether the loader keeps up.
+            t_input = t_step = 0.0
+            n_img = 0
+            while True:
+                t0 = time.perf_counter()
+                if not self.itr_train.next():
+                    break
+                batch = self.itr_train.value()
+                t1 = time.perf_counter()
+                t_input += t1 - t0
                 if self.test_io == 0:
-                    self.net_trainer.update(self.itr_train.value())
+                    self.net_trainer.update(batch)
+                    t_step += time.perf_counter() - t1
+                n_img += batch.batch_size - batch.num_batch_padd
                 sample_counter += 1
                 if sample_counter % self.print_step == 0 and not self.silent:
                     print("round %8d:[%8d] %.0f sec elapsed" %
                           (self.start_counter - 1, sample_counter,
                            time.time() - start))
+            wall = t_input + t_step
+            if self.test_io != 0:
+                print("round %d: io-only %.1f images/sec" %
+                      (self.start_counter - 1,
+                       n_img / t_input if t_input > 0 else 0.0))
+            elif not self.silent and wall > 0:
+                print("round %d: input-wait %.1f%% (io %.1f img/s when "
+                      "blocked, step %.1f img/s)" %
+                      (self.start_counter - 1, 100.0 * t_input / wall,
+                       n_img / t_input if t_input > 0 else float("inf"),
+                       n_img / t_step if t_step > 0 else float("inf")))
             if self.test_io == 0:
                 sys.stderr.write("[%d]" % self.start_counter)
                 if not self.itr_evals:

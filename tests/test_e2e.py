@@ -285,3 +285,20 @@ def test_test_on_server_consistency(tmp_path, mnist_data):
         arr.shape, NamedSharding(tr.mesh, P()), shards)
     with pytest.raises(ValueError, match="TestSync"):
         tr.check_replica_consistency()
+
+
+def test_train_loop_input_wait_probe(tmp_path, mnist_data, capsys):
+    """The train loop reports the input-starvation fraction per round
+    (reference design axis: device-feed overlap, thread_buffer.h:22) and
+    test_io=1 reports the io-only feed rate."""
+    conf = write_conf(tmp_path, MLP_CONF, mnist_data, num_round=1)
+    run_task(conf, "silent=0")
+    out = capsys.readouterr().out
+    m = re.search(r"input-wait +([0-9.]+)% \(io ([0-9.inf]+) img/s", out)
+    assert m, out
+    assert 0.0 <= float(m.group(1)) <= 100.0
+    run_task(conf, "test_io=1", "continue=0")
+    out = capsys.readouterr().out
+    m = re.search(r"io-only ([0-9.]+) images/sec", out)
+    assert m, out
+    assert float(m.group(1)) > 0
