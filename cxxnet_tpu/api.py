@@ -234,10 +234,21 @@ def load_decode(prefill_fname: str, step_fname: str):
     jax-only at serving time (a real deployment drives the two artifacts
     from its own loop: sampling, stop tokens, scheduling)."""
     from jax import export as jexport
+    from .utils import artifact
     with open(prefill_fname, "rb") as f:
-        pre = jexport.deserialize(f.read())
+        pre_meta, pre_bytes = artifact.unframe(f.read(), "decode_prefill")
     with open(step_fname, "rb") as f:
-        step = jexport.deserialize(f.read())
+        step_meta, step_bytes = artifact.unframe(f.read(), "decode_step")
+    if pre_meta.get("cache_fingerprint") != step_meta.get(
+            "cache_fingerprint"):
+        raise ValueError(
+            "load_decode: prefill and step artifacts disagree on the KV "
+            "cache layout (fingerprints %s vs %s) — they are from "
+            "different exports; regenerate the pair together"
+            % (pre_meta.get("cache_fingerprint"),
+               step_meta.get("cache_fingerprint")))
+    pre = jexport.deserialize(pre_bytes)
+    step = jexport.deserialize(step_bytes)
     (b, plen) = pre.in_avals[0].shape
     # cache avals are (b, nkv, l_max, dh): flattened step args are
     # (token, position, *cache leaves)
@@ -273,8 +284,10 @@ def load_exported(fname: str):
     Runs on whatever jax backend is active — the serving side needs
     jax only, none of this framework."""
     from jax import export as jexport
+    from .utils import artifact
     with open(fname, "rb") as f:
-        exp = jexport.deserialize(f.read())
+        _, payload = artifact.unframe(f.read(), "forward")
+    exp = jexport.deserialize(payload)
 
     def fn(data) -> np.ndarray:
         return np.asarray(exp.call(np.asarray(data, np.float32)))

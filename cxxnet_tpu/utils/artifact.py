@@ -1,0 +1,70 @@
+"""Versioned framing for exported serving artifacts.
+
+The reference versions its model blobs so a stale file fails with a
+message instead of undefined behavior (src/nnet/nnet_config.h:126-145 —
+net_type/reserved fields checked on load). Our serving artifacts
+(export_forward / export_decode StableHLO bytes) bake in a cache-layout
+contract (_decode_cache_specs) that can change across framework
+versions, so they get the same guard: a fixed magic, a format version,
+and a JSON header carrying the artifact kind plus a fingerprint of the
+layout contract. Loaders fail with a framework message on mismatch
+instead of whatever jax.export.deserialize does with alien bytes.
+
+Frame layout: b"CXTF" | uint32 version | uint32 header_len |
+header JSON (utf-8) | payload (raw jax.export serialization).
+"""
+
+import hashlib
+import json
+import struct
+
+MAGIC = b"CXTF"
+VERSION = 1
+
+
+def frame(kind: str, meta: dict, payload: bytes) -> bytes:
+    header = dict(meta)
+    header["kind"] = kind
+    hb = json.dumps(header, sort_keys=True).encode("utf-8")
+    return MAGIC + struct.pack("<II", VERSION, len(hb)) + hb + payload
+
+
+def unframe(data: bytes, expect_kind: str):
+    """-> (meta, payload); raises ValueError with a framework message on
+    any mismatch (wrong magic / future version / wrong artifact kind /
+    truncated frame)."""
+    if len(data) < 12 or data[:4] != MAGIC:
+        raise ValueError(
+            "not a cxxnet_tpu serving artifact (bad magic): this file is "
+            "either corrupt or a pre-versioning export — re-export it "
+            "with this framework version")
+    version, hlen = struct.unpack("<II", data[4:12])
+    if version > VERSION:
+        raise ValueError(
+            "serving artifact format v%d is newer than this framework "
+            "supports (v%d): upgrade the framework or re-export"
+            % (version, VERSION))
+    if len(data) < 12 + hlen:
+        raise ValueError("serving artifact truncated (header)")
+    try:
+        meta = json.loads(data[12:12 + hlen].decode("utf-8"))
+    except ValueError:
+        raise ValueError("serving artifact header is not valid JSON "
+                         "(corrupt file)")
+    kind = meta.get("kind")
+    if kind != expect_kind:
+        raise ValueError(
+            "serving artifact kind mismatch: file holds %r, loader "
+            "expects %r (did you swap the prefill/step files?)"
+            % (kind, expect_kind))
+    return meta, data[12 + hlen:]
+
+
+def cache_fingerprint(cache_keys, cache_shapes, cache_dtype) -> str:
+    """Stable digest of the decode cache-layout contract: the prefill and
+    step artifacts of one export share it, and a loader refuses to pair
+    artifacts whose layouts disagree."""
+    desc = repr((list(cache_keys),
+                 [tuple(int(d) for d in sh) for sh in cache_shapes],
+                 str(cache_dtype)))
+    return hashlib.sha1(desc.encode("utf-8")).hexdigest()

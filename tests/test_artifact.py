@@ -1,0 +1,69 @@
+"""Serving-artifact version guard (utils/artifact.py): stale, corrupt,
+swapped, or cross-export files must fail with a framework message, not
+with whatever jax.export.deserialize does to alien bytes (the
+reference's model-blob version check, nnet_config.h:126-145)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.utils import artifact
+
+
+def test_frame_roundtrip():
+    meta = {"cache_fingerprint": "abc", "batch": 4}
+    data = artifact.frame("decode_step", meta, b"PAYLOAD")
+    got_meta, payload = artifact.unframe(data, "decode_step")
+    assert payload == b"PAYLOAD"
+    assert got_meta["cache_fingerprint"] == "abc"
+    assert got_meta["batch"] == 4 and got_meta["kind"] == "decode_step"
+
+
+def test_stale_unversioned_artifact_rejected():
+    with pytest.raises(ValueError, match="pre-versioning|bad magic"):
+        artifact.unframe(b"MHLO...raw stablehlo bytes...", "forward")
+
+
+def test_future_version_rejected():
+    data = artifact.frame("forward", {}, b"x")
+    bumped = data[:4] + struct.pack("<I", artifact.VERSION + 1) + data[8:]
+    with pytest.raises(ValueError, match="newer than this framework"):
+        artifact.unframe(bumped, "forward")
+
+
+def test_kind_mismatch_rejected():
+    data = artifact.frame("decode_prefill", {}, b"x")
+    with pytest.raises(ValueError, match="kind mismatch"):
+        artifact.unframe(data, "decode_step")
+
+
+def test_truncated_header_rejected():
+    data = artifact.frame("forward", {"k": 1}, b"x")
+    with pytest.raises(ValueError, match="truncated"):
+        artifact.unframe(data[:14], "forward")
+
+
+def test_cache_fingerprint_sensitivity():
+    base = artifact.cache_fingerprint(
+        ["c0:k", "c0:v"], [(2, 4, 16, 8), (2, 4, 16, 8)], "bfloat16")
+    assert base == artifact.cache_fingerprint(
+        ["c0:k", "c0:v"], [(2, 4, 16, 8), (2, 4, 16, 8)], "bfloat16")
+    assert base != artifact.cache_fingerprint(
+        ["c0:k", "c0:v"], [(2, 4, 32, 8), (2, 4, 32, 8)], "bfloat16")
+    assert base != artifact.cache_fingerprint(
+        ["c0:k", "c0:v"], [(2, 4, 16, 8), (2, 4, 16, 8)], "float32")
+
+
+def test_load_decode_refuses_cross_export_pair(tmp_path):
+    """Integration: pairing the prefill of one export with the step of a
+    DIFFERENT cache layout fails with the fingerprint message."""
+    from cxxnet_tpu import api
+    p1 = tmp_path / "pre.hlo"
+    p2 = tmp_path / "step.hlo"
+    p1.write_bytes(artifact.frame(
+        "decode_prefill", {"cache_fingerprint": "aaa"}, b"x"))
+    p2.write_bytes(artifact.frame(
+        "decode_step", {"cache_fingerprint": "bbb"}, b"y"))
+    with pytest.raises(ValueError, match="different exports"):
+        api.load_decode(str(p1), str(p2))

@@ -113,19 +113,25 @@ def test_export_channels_last_artifact_is_nchw(tmp_path):
 
 def test_export_runs_without_framework(tmp_path):
     """The serving side needs jax only: a fresh interpreter that never
-    imports cxxnet_tpu runs the artifact."""
+    imports cxxnet_tpu runs the artifact. The 12-byte-header CXTF frame
+    (utils/artifact.py) is unwrapped with two struct reads — the
+    documented framework-free recipe from the export_forward docstring."""
     tr, b = _trained()
     path = str(tmp_path / "standalone.stablehlo")
     with open(path, "wb") as f:
         f.write(tr.export_forward())
     np.save(str(tmp_path / "x.npy"), b.data)
     code = (
-        "import jax, numpy as np\n"
+        "import jax, numpy as np, struct\n"
         "jax.config.update('jax_platforms', 'cpu')\n"
         "from jax import export\n"
         "import sys\n"
         "assert not any(m.startswith('cxxnet') for m in sys.modules)\n"
-        "exp = export.deserialize(open(%r, 'rb').read())\n"
+        "data = open(%r, 'rb').read()\n"
+        "assert data[:4] == b'CXTF', 'versioned artifact frame'\n"
+        "ver, hlen = struct.unpack('<II', data[4:12])\n"
+        "assert ver == 1\n"
+        "exp = export.deserialize(data[12 + hlen:])\n"
         "out = exp.call(np.load(%r))\n"
         "np.save(%r, np.asarray(out))\n"
         % (path, str(tmp_path / "x.npy"), str(tmp_path / "y.npy")))
