@@ -15,6 +15,16 @@ parallelism keys change the per-device footprint:
   python tools/memory_report.py mlp --zero     # ZeRO opt-state sharding
   python tools/memory_report.py mlp --pp 4     # stage-packed pipeline
   python tools/memory_report.py alexnet --tp 2 # Megatron fullc sharding
+  python tools/memory_report.py deep --pp 4 --remat  # PP + activation
+                                               # remat (the AD stash knob)
+
+The PP case: AD differentiates through the fill-drain scan
+(parallel/pipeline.py), stashing every tick's boundary activations plus
+stage internals — n_micro + n_stages - 1 ticks of them. That stash is
+XLA "temp" bytes here; ``--remat`` checkpoints every trunk layer so
+the backward recomputes stage internals instead of stashing them (the
+per-microbatch memory/compute trade: temp bytes down, ~1/3 more
+FLOPs). ``deep`` is a uniform 16-layer trunk built for pp4.
 
 This turns the ZeRO / pipeline memory claims (doc/multichip.md) into
 measured bytes; tests/test_compose.py asserts the shard-size ratios, this
@@ -56,6 +66,30 @@ def build(model, extra):
                                     dim=128, nhead=4, nlayer=2, dev=n,
                                     extra_cfg=extra)
         return tr, (8, 1, 1, 256), 512
+    if model == "deep":
+        # uniform 16-layer trunk: the natural pp4 customer; wide enough
+        # (512) that the per-tick AD stash dominates the report
+        conf = "netconfig = start\n"
+        for i in range(16):
+            conf += ("layer[+1] = fullc:d%d\n  nhidden = 512\n"
+                     "  init_sigma = 0.05\n" % i)
+            conf += "layer[+1] = relu\n"
+        conf += """layer[+1] = fullc:head
+  nhidden = 10
+  init_sigma = 0.05
+layer[+0] = softmax
+netconfig = end
+input_shape = 1,1,512
+batch_size = 64
+eta = 0.1
+momentum = 0.9
+dev = %s
+""" % n + extra
+        tr = Trainer()
+        for k, v in parse_config_string(conf):
+            tr.set_param(k, v)
+        tr.init_model()
+        return tr, (64, 1, 1, 512), 10
     conf = """
 netconfig = start
 layer[+1] = fullc:fc1
@@ -90,6 +124,7 @@ def main():
     extra = ""
     consumed = set()
     for flag, key in (("--pp", "pipeline_parallel"),
+                      ("--micro", "pipeline_micro"),
                       ("--tp", "model_parallel")):
         if flag in args:
             i = args.index(flag)
@@ -99,6 +134,8 @@ def main():
         extra += "update_on_server = 1\n"
     if "--fsdp" in args:
         extra += "fsdp = 1\n"
+    if "--remat" in args:
+        extra += "remat = 1\n"
     tail = [a for i, a in enumerate(args)
             if i > 0 and i not in consumed and a.isdigit()]
     ndev = int(tail[-1]) if tail else None
