@@ -74,6 +74,8 @@ class Trainer:
         self.input_scale = 1.0      # device-side input normalization
         self.input_mean = None
         self.fuse_sibling_convs = 1  # sibling-conv fusion pass (net.py)
+        self.fuse_cross_1x1 = 0      # cross-input 1x1 batching (opt-in
+                                     # until the on-chip A/B settles it)
         self.channels_last = -1     # NHWC conv-stack layout: -1 auto
         #                             (on for TPU backends), 0/1 force
         self.fsdp = 0               # ZeRO-3 param sharding over data
@@ -125,6 +127,8 @@ class Trainer:
             self.test_on_server = int(val)
         if name == "fuse_sibling_convs":
             self.fuse_sibling_convs = int(val)
+        if name == "fuse_cross_1x1":
+            self.fuse_cross_1x1 = int(val)
         if name == "channels_last":
             self.channels_last = int(val)
         if name == "fsdp":
@@ -308,6 +312,7 @@ class Trainer:
                              input_scale=self.input_scale,
                              input_mean=self.input_mean,
                              fuse_siblings=bool(self.fuse_sibling_convs),
+                             fuse_cross_1x1=bool(self.fuse_cross_1x1),
                              channels_last=self._resolve_channels_last())
         self._setup_mesh()
         # resolve eval nodes (metric[label,node] -> node id; default last)
@@ -639,6 +644,7 @@ class Trainer:
                              input_scale=self.input_scale,
                              input_mean=self.input_mean,
                              fuse_siblings=bool(self.fuse_sibling_convs),
+                             fuse_cross_1x1=bool(self.fuse_cross_1x1),
                              channels_last=self._resolve_channels_last())
         self._setup_mesh()
         self.eval_nodes = [self.net_cfg.param.num_nodes - 1 if nm is None
@@ -1367,6 +1373,13 @@ class Trainer:
         conditions, batching) and threads the opaque cache tuple between
         calls — `api.load_decode` ships a reference loop. Returns
         (prefill_bytes, step_bytes).
+
+        BOUND: exported artifacts are single-chip (params baked in as
+        one canonical copy) — a model whose weights need tensor
+        parallelism to fit one chip's HBM must be served in-process via
+        generate()/beam_generate() under ``model_parallel`` (the decode
+        params stay Megatron-sharded, _decode_params_current), not via
+        export.
         """
         from jax import export as jexport
         check(self.params is not None,

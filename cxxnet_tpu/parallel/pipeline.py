@@ -39,32 +39,27 @@ def _pipeline_local(params, x, *, axis_name: str, n_micro: int,
     idx = lax.axis_index(axis_name)
     # local slice of the stacked stage params: leading dim 1 -> this stage
     params = jax.tree.map(lambda p: p[0], params)
-    outbuf = jnp.zeros_like(x)
     cur = jnp.zeros_like(x[0])
     # forward hop: stage s -> s+1 (no wraparound; device 0 ingests fresh
     # microbatches, so its incoming edge is unused)
     perm = [(i, i + 1) for i in range(n - 1)]
 
-    def tick(carry, t):
-        cur, outbuf = carry
+    # finished microbatches leave as scan OUTPUTS, not an in-carry buffer
+    # (the carry is AD-stashed per tick — see _pipeline_local_switch)
+    def tick(cur, t):
         x_t = lax.dynamic_index_in_dim(x, jnp.clip(t, 0, n_micro - 1),
                                        axis=0, keepdims=False)
         inp = jnp.where(idx == 0, x_t, cur)
         y = stage_fn(params, inp)
-        done_t = t - (n - 1)
-        pos = jnp.clip(done_t, 0, n_micro - 1)
-        valid = (done_t >= 0) & (idx == n - 1)
-        slot = lax.dynamic_index_in_dim(outbuf, pos, axis=0, keepdims=False)
-        outbuf = lax.dynamic_update_index_in_dim(
-            outbuf, jnp.where(valid, y, slot), pos, axis=0)
+        done = (t - (n - 1) >= 0) & (idx == n - 1)
+        y_out = jnp.where(done, y, 0.0)
         cur = collectives.ppermute(y, axis_name, perm)
-        return (cur, outbuf), None
+        return cur, y_out
 
-    (_, outbuf), _ = lax.scan(tick, (cur, outbuf),
-                              jnp.arange(n_micro + n - 1))
-    # only the last stage wrote real outputs; psum broadcasts them (the other
-    # shards are zeros)
-    return collectives.psum(outbuf, axis_name)
+    _, ys = lax.scan(tick, cur, jnp.arange(n_micro + n - 1))
+    # ticks n-1 .. n-1+n_micro hold microbatches 0..n_micro in order on
+    # the last stage (zeros elsewhere); psum broadcasts them
+    return collectives.psum(ys[n - 1: n - 1 + n_micro], axis_name)
 
 
 def _pipeline_local_switch(params, x, state0=None, *, axis_name: str,
@@ -85,13 +80,16 @@ def _pipeline_local_switch(params, x, state0=None, *, axis_name: str,
     composed data axis to pmean per-shard statistics over."""
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
-    outbuf = jnp.zeros_like(x)
     cur = jnp.zeros_like(x[0])
     perm = [(i, i + 1) for i in range(n - 1)]
     with_state = state0 is not None
 
+    # Finished microbatches leave the scan as per-tick OUTPUTS (ys), not
+    # as an in-carry output buffer: anything riding the carry is stashed
+    # by AD at EVERY tick (an O(n_micro^2 * F) activation bill measured
+    # in TestPipelineMemoryProof); a scan output is written once.
     def tick(carry, t):
-        cur, outbuf, st = carry
+        cur, st = carry
         x_t = lax.dynamic_index_in_dim(x, jnp.clip(t, 0, n_micro - 1),
                                        axis=0, keepdims=False)
         inp = jnp.where(idx == 0, x_t, cur)
@@ -107,19 +105,17 @@ def _pipeline_local_switch(params, x, state0=None, *, axis_name: str,
             st = jnp.where(real, st_new, st)
         else:
             y = lax.switch(idx, stage_fns, params, inp, micro_id)
-        done_t = t - (n - 1)
-        pos = jnp.clip(done_t, 0, n_micro - 1)
-        valid = (done_t >= 0) & (idx == n - 1)
-        slot = lax.dynamic_index_in_dim(outbuf, pos, axis=0, keepdims=False)
-        outbuf = lax.dynamic_update_index_in_dim(
-            outbuf, jnp.where(valid, y, slot), pos, axis=0)
+        done = (t - (n - 1) >= 0) & (idx == n - 1)
+        y_out = jnp.where(done, y, 0.0)
         cur = collectives.ppermute(y, axis_name, perm)
-        return (cur, outbuf, st), None
+        return (cur, st), y_out
 
     st0 = state0 if with_state else jnp.zeros((0,), x.dtype)
-    (_, outbuf, st), _ = lax.scan(tick, (cur, outbuf, st0),
-                                  jnp.arange(n_micro + n - 1))
-    out = collectives.psum(outbuf, axis_name)
+    (_, st), ys = lax.scan(tick, (cur, st0),
+                           jnp.arange(n_micro + n - 1))
+    # ticks n-1 .. n-1+n_micro hold microbatches 0..n_micro in order on
+    # the last stage (zeros elsewhere); psum broadcasts them
+    out = collectives.psum(ys[n - 1: n - 1 + n_micro], axis_name)
     if not with_state:
         return out
     own = lax.dynamic_index_in_dim(state_masks, idx, axis=0,

@@ -408,3 +408,7 @@ def test_generate_tensor_parallel_token_exact():
     sh = params[idx]["wmat"].sharding
     assert "model" in getattr(sh, "spec", ()) or any(
         "model" in str(p) for p in sh.spec), sh.spec
+    # beam search rides the same sharded decode params
+    bw = tr.beam_generate(prompts, 6, beam=2)
+    bt = tr_tp.beam_generate(prompts, 6, beam=2)
+    np.testing.assert_array_equal(bt, bw)

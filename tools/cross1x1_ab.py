@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""Paired A/B: default inception module execution (sibling-fused 1x1
+trio + separate pool-projection conv) vs cross-input 1x1 batching
+(``fuse_cross_1x1 = 1``: the trio concat AND the pool-projection run as
+ONE batched matmul — net.py _cross_1x1_plan). Targets the GoogLeNet
+~23% MFU row (doc/performance.md): the per-module pool-proj matmul is
+individually too small to fill the MXU. Adjacent runs so shared-chip
+drift cancels; one JSON line per variant. Flip the trainer default only
+if this wins on-chip.
+
+Usage: python tools/cross1x1_ab.py [batch]
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from layout_ab import BF16, measure  # shared A/B measurement protocol
+
+
+def main():
+    from cxxnet_tpu.utils import enable_compile_cache
+    enable_compile_cache()
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    from cxxnet_tpu.models import googlenet_trainer
+    for knob in (0, 1):
+        tr = googlenet_trainer(
+            batch_size=batch, input_hw=224, dev="tpu",
+            extra_cfg=BF16 + "fuse_cross_1x1 = %d\n" % knob)
+        n_pairs = len(tr.net._cross_1x1_plan())
+        ips = measure(tr, (3, 224, 224), 1000, batch, steps=30)
+        print(json.dumps({"variant": "googlenet_b%d_cross1x1_%s"
+                          % (batch, "on" if knob else "off"),
+                          "batched_pairs": n_pairs,
+                          "img_per_sec": round(ips, 1)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -48,9 +48,11 @@ run() {
 run bench    900  python bench.py
 run kernels  900  python tools/check_tpu_kernels.py
 run poolab   1500 python tools/pool_ab.py
+run cross1x1 1500 python tools/cross1x1_ab.py
 run layout   2400 python tools/layout_ab.py default
 run benchall 4200 python bench.py all
 run mfutable 600  python tools/roofline.py --bench onchip_logs/bench.log --bench onchip_logs/benchall.log
+run decodetable 600 python tools/roofline.py --decode --bench onchip_logs/benchall.log
 run pipeline 1200 python bench.py pipeline
 run mfu      5400 python tools/mfu_experiments.py all
 run quality  3600 python tools/quality_run.py
