@@ -520,15 +520,22 @@ def bench_alexnet_pipeline(io_only=False):
             tr.update(b)
         t0 = time.perf_counter()
         n = 0
+        t_input = 0.0       # host blocked on the loader (the starvation
+                            # fraction the train loop also reports)
         for _ in range(2):  # two measured epochs
+            ti = time.perf_counter()
             for b in it:
+                t_input += time.perf_counter() - ti
                 tr.update(b)
                 n += b.batch_size - b.num_batch_padd
+                ti = time.perf_counter()
         float(jnp.sum(next(v for p in tr.params for v in p.values())))
-        ips = n / (time.perf_counter() - t0)
+        wall = time.perf_counter() - t0
+        ips = n / wall
         out.append({"metric": "alexnet_pipeline_fed_images_per_sec_per_chip",
                     "value": round(ips, 2), "unit": "images/sec/chip",
-                    "vs_baseline": round(ips / 2000.0, 4)})
+                    "vs_baseline": round(ips / 2000.0, 4),
+                    "input_wait_frac": round(t_input / wall, 4)})
         # stop the decode pool + prefetch thread so later benches in the
         # same process don't contend for host cores
         it.close()
