@@ -453,6 +453,7 @@ iter = imgbinx
   silent = 1
 %s
 iter = threadbuffer
+  silent = 1
 """ % (lst_path, bin_path, batch,
        "  decode_thread = %d" % decode_thread if decode_thread else "")
     pairs = [(k, v) for k, v in parse_config_string(cfg)]

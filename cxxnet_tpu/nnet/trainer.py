@@ -1442,7 +1442,8 @@ class Trainer:
         WITHOUT the framework, the config file, or the model file (a
         framework-free host strips the versioned 12-byte+JSON header —
         magic "CXTF", two <II fields (version, header_len), header —
-        then jax.export.deserialize's the payload; utils/artifact.py). The TPU-native deployment story
+        then jax.export.deserialize's the payload; utils/artifact.py).
+        The TPU-native deployment story
         the reference covered with its C wrapper + model files
         (wrapper/cxxnet_wrapper.h:36-230): here the whole net is one
         compiler artifact.

@@ -412,3 +412,39 @@ def test_generate_tensor_parallel_token_exact():
     bw = tr.beam_generate(prompts, 6, beam=2)
     bt = tr_tp.beam_generate(prompts, 6, beam=2)
     np.testing.assert_array_equal(bt, bw)
+
+
+def test_cli_generate_task_tensor_parallel(tmp_path):
+    """task = generate with model_parallel = 2 through the CLI: the
+    serving mesh decodes with sharded weights and the output matches the
+    single-device CLI run token for token."""
+    from cxxnet_tpu import learn_task
+    from cxxnet_tpu.utils import serializer
+    tr = _trained(steps=10)
+    model = str(tmp_path / "0001.model")
+    with open(model, "wb") as f:
+        w = serializer.Writer(f)
+        w.write_int32(0)
+        tr.save_model(w)
+    rs = np.random.RandomState(8)
+    prompts = rs.randint(0, VOCAB, (4, 6))
+    pf = str(tmp_path / "prompts.txt")
+    with open(pf, "w") as f:
+        for row in prompts:
+            f.write(" ".join(map(str, row)) + "\n")
+    conf = LM % {"vocab": VOCAB, "seq": SEQ,
+                 "embed_extra": "pos_embed = 1", "attn_extra": ""}
+    outs = {}
+    for name, extra in (("1dev", ""),
+                        ("tp2", "dev = cpu:0-7\nmodel_parallel = 2\n")):
+        gout = str(tmp_path / ("gen_%s.txt" % name))
+        cf = str(tmp_path / ("gen_%s.conf" % name))
+        with open(cf, "w") as f:
+            f.write(conf + extra +
+                    "task = generate\nmodel_in = %s\n"
+                    "prompt_in = %s\ngen_out = %s\ngen_new = 6\n"
+                    % (model, pf, gout))
+        assert learn_task.main([cf]) == 0
+        outs[name] = [list(map(int, line.split())) for line in open(gout)]
+    np.testing.assert_array_equal(np.asarray(outs["tp2"]),
+                                  np.asarray(outs["1dev"]))

@@ -37,4 +37,5 @@ def test_c_abi_end_to_end(wrapper_bin, tmp_path):
     sys.stderr.write(r.stderr)
     assert r.returncode == 0, r.stderr
     assert "C WRAPPER SMOKE TEST PASSED" in r.stderr
+    assert "C WRAPPER GENERATE LEG PASSED" in r.stderr
     assert "C WRAPPER ITERATOR LEG PASSED" in r.stderr

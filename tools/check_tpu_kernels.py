@@ -261,6 +261,67 @@ dev = tpu
         np.asarray(g_msk, np.float32), rtol=2e-2, atol=1e-2)
     print("fused max-pool backward kernel (ties, bf16): OK")
 
+    # --- cross-input 1x1 batching parity on-chip ------------------------
+    # the opt-in fuse_cross_1x1 path (batched-matmul inception module,
+    # net.py _apply_fused_cross) must match the default path through the
+    # REAL TPU compiler before tools/cross1x1_ab.py may flip the default
+    inc_conf = """
+netconfig = start
+layer[0->s] = conv:xs
+  kernel_size = 3
+  pad = 1
+  nchannel = 16
+  random_type = xavier
+layer[s->sa,sb,sc] = split
+layer[sa->a1] = conv:xa
+  kernel_size = 1
+  nchannel = 8
+layer[sb->b1] = conv:xb
+  kernel_size = 1
+  nchannel = 12
+layer[sc->c1] = max_pooling
+  kernel_size = 3
+  stride = 1
+  pad = 1
+layer[c1->c2] = conv:xp
+  kernel_size = 1
+  nchannel = 8
+layer[a1,b1,c2->cc] = ch_concat
+layer[cc->gp] = avg_pooling
+  kernel_size = 8
+  stride = 8
+layer[gp->fl] = flatten
+layer[fl->out] = fullc:xh
+  nhidden = 5
+  init_sigma = 0.05
+layer[+0] = softmax
+netconfig = end
+input_shape = 3,16,16
+batch_size = 8
+eta = 0.05
+eval_train = 0
+compute_dtype = bfloat16
+dev = tpu
+"""
+    db2 = DataBatch()
+    db2.data = rs.rand(8, 3, 16, 16).astype(np.float32)
+    db2.label = rs.randint(0, 5, (8, 1)).astype(np.float32)
+    db2.batch_size = 8
+    xw = []
+    for knob in (0, 1):
+        t3 = Trainer()
+        for k, v in parse_config_string(
+                inc_conf + "fuse_cross_1x1 = %d\n" % knob):
+            t3.set_param(k, v)
+        t3.init_model()
+        if knob:
+            assert len(t3.net._cross_1x1_plan()) == 1
+        t3.update(db2)
+        xw.append(np.asarray(
+            jax.device_get(t3.params[0]["wmat"]), np.float32))
+    np.testing.assert_allclose(xw[0], xw[1], rtol=2e-2, atol=2e-4)
+    print("cross-input 1x1 batching parity on-chip: OK")
+
     print("ALL TPU KERNEL CHECKS PASSED")
 
 

@@ -36,7 +36,8 @@ def main():
         out.extend(open(status).read().strip().splitlines())
         out.append("```")
 
-    for name in ("bench", "layout", "poolab", "pipeline", "benchall"):
+    for name in ("bench", "layout", "poolab", "cross1x1", "pipeline",
+                 "benchall"):
         rows = read_json_lines(os.path.join(d, "%s.log" % name))
         if rows:
             out.append("## %s" % name)
@@ -84,6 +85,13 @@ def main():
     if os.path.isfile(mfut):
         out.append("## MFU table (tools/roofline.py from this run's logs)")
         out.extend(l.rstrip() for l in open(mfut)
+                   if l.startswith("|") or l.startswith("#"))
+
+    dect = os.path.join(d, "decodetable.log")
+    if os.path.isfile(dect):
+        out.append("## Decode bound table (roofline --decode, measured "
+                   "vs HBM bound)")
+        out.extend(l.rstrip() for l in open(dect)
                    if l.startswith("|") or l.startswith("#"))
 
     print("\n".join(out))

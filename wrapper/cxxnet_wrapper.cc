@@ -441,6 +441,26 @@ extern "C" const cxn_real_t *CXNNetExtractIter(void *net_handle,
   return ExposeArray(net, arr, oshape, 2, nullptr);
 }
 
+extern "C" const cxn_real_t *CXNNetGenerate(void *handle,
+                                            const cxn_real_t *prompts,
+                                            const cxn_uint pshape[2],
+                                            cxn_uint n_new,
+                                            float temperature,
+                                            cxn_uint top_k, cxn_uint seed,
+                                            cxn_uint oshape[2]) {
+  GilGuard gil;
+  Handle *h = static_cast<Handle *>(handle);
+  PyObject *parr = MakeArray(prompts, pshape, 2);
+  if (!parr) return nullptr;
+  /* api.Net.generate(prompts, n_new, temperature, top_k, seed) — float
+   * ids in, float ids out (ExposeArray re-encodes the int result) */
+  PyObject *arr = Call(h->obj, "generate",
+                       Py_BuildValue("(NIfII)", parr, n_new,
+                                     (double)temperature, top_k, seed));
+  if (!arr) return nullptr;
+  return ExposeArray(h, arr, oshape, 2, nullptr);
+}
+
 extern "C" const char *CXNNetEvaluate(void *net_handle, void *io_handle,
                                       const char *data_name) {
   GilGuard gil;

@@ -70,6 +70,16 @@ const cxn_real_t *CXNNetExtractBatch(void *handle, const cxn_real_t *data,
 const cxn_real_t *CXNNetExtractIter(void *net_handle, void *io_handle,
                                     const char *node_name,
                                     cxn_uint oshape[2]);
+/*! KV-cached generation for sequence nets (beyond the reference ABI —
+ *  the serving loop of Trainer.generate): ``prompts`` is a row-major
+ *  (batch, prompt_len) matrix of token ids encoded as floats; returns a
+ *  borrowed (batch, n_new) matrix of generated ids (float-encoded,
+ *  exact for vocabularies < 2^24) and fills oshape. Greedy when
+ *  temperature == 0; temperature/top_k/seed select sampling. */
+const cxn_real_t *CXNNetGenerate(void *handle, const cxn_real_t *prompts,
+                                 const cxn_uint pshape[2], cxn_uint n_new,
+                                 float temperature, cxn_uint top_k,
+                                 cxn_uint seed, cxn_uint oshape[2]);
 /*! run metrics over an eval iterator; string lives until next call */
 const char *CXNNetEvaluate(void *net_handle, void *io_handle,
                            const char *data_name);
