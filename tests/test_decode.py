@@ -43,11 +43,14 @@ dev = cpu
 """
 
 
-def _trained(embed_extra="pos_embed = 1", attn_extra="", steps=30):
+def _trained(embed_extra="pos_embed = 1", attn_extra="", steps=30,
+             extra_params=()):
     conf = LM % {"vocab": VOCAB, "seq": SEQ, "embed_extra": embed_extra,
                  "attn_extra": attn_extra}
     tr = Trainer()
     for k, v in parse_config_string(conf):
+        tr.set_param(k, v)
+    for k, v in extra_params:
         tr.set_param(k, v)
     tr.init_model()
     rs = np.random.RandomState(0)
@@ -448,3 +451,15 @@ def test_cli_generate_task_tensor_parallel(tmp_path):
         outs[name] = [list(map(int, line.split())) for line in open(gout)]
     np.testing.assert_array_equal(np.asarray(outs["tp2"]),
                                   np.asarray(outs["1dev"]))
+
+
+def test_generate_after_pipeline_training():
+    """A model TRAINED under pipeline (+tensor) parallelism serves
+    through the same generate() surface: packed stage params gather
+    canonical, then decode (re-sharded by tp when model_parallel is
+    set). Token-exact vs the full-recompute reference."""
+    tr = _trained(steps=10, extra_params=(
+        ("dev", "cpu:0-7"), ("pipeline_parallel", "2"),
+        ("model_parallel", "2")))
+    assert tr._pp_entries is not None
+    _check(tr, n_new=6)
