@@ -97,6 +97,8 @@ class LearnTask:
             self.task_export()
         elif self.task == "generate":
             self.task_generate()
+        elif self.task == "serve":
+            self.task_serve()
         return 0
 
     def set_param(self, name: str, val: str) -> None:
@@ -472,6 +474,38 @@ class LearnTask:
                 fo.write(" ".join(str(int(t)) for t in row) + "\n")
         print("generated %d x %d tokens into %s"
               % (out.shape[0], out.shape[1], self.name_gen_out))
+
+    def task_serve(self) -> None:
+        """task = serve: interactive line-serving loop over stdin/stdout
+        (beyond the reference — the minimal online counterpart of
+        task = generate). Each input line is one prompt of
+        space-separated token ids; the continuation (gen_new ids, greedy
+        or gen_temperature/gen_topk-sampled) is written back as one line
+        and flushed immediately. The KV-cached decode program is
+        compiled per prompt-length signature and reused across requests
+        (bucket client-side prompt lengths to keep compilations few).
+        EOF ends the loop. Batch is 1 per request by design — the
+        latency-bound serving case; use task = generate for offline
+        batch throughput."""
+        vocab = max((lay.vocab_size
+                     for lay in self.net_trainer.net.layers
+                     if getattr(lay, "type_name", "") == "embed"),
+                    default=0)
+        served = 0
+        for line in sys.stdin:
+            toks = [int(t) for t in line.split()]
+            if not toks:
+                continue
+            if vocab and not all(0 <= t < vocab for t in toks):
+                print("ERR token id outside vocab_size %d" % vocab,
+                      flush=True)
+                continue
+            out = self.net_trainer.generate(
+                [toks], self.gen_new, temperature=self.gen_temperature,
+                top_k=self.gen_topk, seed=self.gen_seed + served)
+            print(" ".join(str(int(t)) for t in out[0]), flush=True)
+            served += 1
+        print("served %d prompts" % served, file=sys.stderr, flush=True)
 
     def task_export(self) -> None:
         """task = export: AOT-compile the inference forward (params baked

@@ -463,3 +463,43 @@ def test_generate_after_pipeline_training():
         ("model_parallel", "2")))
     assert tr._pp_entries is not None
     _check(tr, n_new=6)
+
+
+def test_cli_serve_task(tmp_path):
+    """task = serve: the interactive stdin/stdout loop answers each
+    prompt line with its continuation, matching Trainer.generate (seed
+    advances per request so sampling streams differ per line; greedy
+    here, so rows match generate exactly)."""
+    import os
+    import subprocess
+    import sys as _sys
+    from cxxnet_tpu.utils import serializer
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tr = _trained(steps=10)
+    model = str(tmp_path / "0001.model")
+    with open(model, "wb") as f:
+        w = serializer.Writer(f)
+        w.write_int32(0)
+        tr.save_model(w)
+    conf = LM % {"vocab": VOCAB, "seq": SEQ,
+                 "embed_extra": "pos_embed = 1", "attn_extra": ""}
+    cf = str(tmp_path / "serve.conf")
+    with open(cf, "w") as f:
+        f.write(conf + "task = serve\nmodel_in = %s\ngen_new = 5\n"
+                % model)
+    rs = np.random.RandomState(13)
+    lines = [rs.randint(0, VOCAB, n).tolist() for n in (4, 6, 4)]
+    stdin = "\n".join(" ".join(map(str, r)) for r in lines) + "\n"
+    env = dict(os.environ, CXXNET_JAX_PLATFORM="cpu")
+    p = subprocess.run(
+        [_sys.executable, os.path.join(REPO, "bin", "cxxnet"), cf],
+        input=stdin, capture_output=True, text=True, timeout=600,
+        env=env)
+    assert p.returncode == 0, (p.stdout[-1000:], p.stderr[-1000:])
+    out_lines = [l for l in p.stdout.splitlines() if l.strip()]
+    assert "served 3 prompts" in p.stderr
+    got = [list(map(int, l.split())) for l in out_lines[-3:]]
+    for i, r in enumerate(lines):
+        want = tr.generate(np.asarray([r]), 5)
+        np.testing.assert_array_equal(np.asarray([got[i]]), want,
+                                      err_msg="line %d" % i)
