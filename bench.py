@@ -146,6 +146,18 @@ def bench_resnet():
             "vs_baseline": None}
 
 
+def bench_mobilenet():
+    from cxxnet_tpu.models import mobilenet_trainer
+    batch = 256
+    tr = mobilenet_trainer(batch_size=batch, input_hw=224, dev="tpu",
+                           extra_cfg=BF16)
+    ips = _throughput(tr, (3, 224, 224), 1000, batch)
+    # no reference baseline: depthwise separability postdates the ref
+    return {"metric": "mobilenet_imagenet_images_per_sec_per_chip",
+            "value": round(ips, 2), "unit": "images/sec/chip",
+            "vs_baseline": None}
+
+
 def bench_vgg():
     from cxxnet_tpu.models import vgg_trainer
     batch = 64
@@ -582,7 +594,7 @@ def _bench_main():
     if len(sys.argv) > 1 and sys.argv[1] == "all":
         for fn in (bench_mnist_mlp, bench_mnist_conv, bench_bowl,
                    bench_googlenet, bench_googlenet_b256,
-                   bench_resnet, bench_vgg,
+                   bench_resnet, bench_vgg, bench_mobilenet,
                    bench_transformer_lm, bench_transformer_lm_long,
                    bench_vit, bench_alexnet_b1024, bench_alexnet_infer,
                    bench_alexnet_latency_b1, bench_lm_decode,

@@ -684,3 +684,99 @@ def vgg_trainer(batch_size: int = 64, input_hw: int = 224,
         tr.set_param(k, v)
     tr.init_model()
     return tr
+
+
+# MobileNet-V1-style depthwise-separable stack — the grouped-conv
+# extreme (ngroup = C: one input channel per group), exercising the
+# reference's in-layer model-splitting mechanism
+# (src/layer/convolution_layer-inl.hpp:92-96) at its limit while being
+# the canonical bandwidth-lean conv recipe for edge/serving. Beyond the
+# reference's zoo (its era predates depthwise separability going
+# mainstream); built entirely from the stock `conv` layer.
+
+MOBILENET_BLOCKS = ((64, 1), (128, 2), (128, 1), (256, 2),
+                    (256, 1), (512, 2), (512, 1))
+
+
+def _mobilenet_final_pool(blocks, input_hw: int) -> int:
+    """GAP kernel for the final feature map: input / (stem 2x * block
+    strides) — ONE definition so netconfig and trainer can't drift."""
+    downsample = 2
+    for _, s in blocks:
+        downsample *= s
+    return max(input_hw // downsample, 1)
+
+
+def mobilenet_netconfig(n_class: int = 1000, base_ch: int = 32,
+                        blocks=MOBILENET_BLOCKS,
+                        final_pool: int = 0) -> str:
+    """(out_channels, stride) per depthwise-separable block; shrink
+    ``blocks``/``base_ch`` for tests. final_pool 0 = global average
+    pool for a 224 input (derived from the block strides)."""
+    if not final_pool:
+        final_pool = _mobilenet_final_pool(blocks, 224)
+    txt = """netconfig = start
+layer[0->stem] = conv:stem
+  kernel_size = 3
+  pad = 1
+  stride = 2
+  nchannel = %d
+  random_type = kaiming
+  no_bias = 1
+layer[stem->stem_b] = batch_norm:stem_b
+layer[stem_b->stem_r] = relu
+""" % base_ch
+    node, c = "stem_r", base_ch
+    for i, (ch, stride) in enumerate(blocks):
+        txt += """layer[%s->dw%d] = conv:dw%d
+  kernel_size = 3
+  pad = 1
+  stride = %d
+  nchannel = %d
+  ngroup = %d
+  random_type = kaiming
+  no_bias = 1
+layer[dw%d->dwb%d] = batch_norm:dwb%d
+layer[dwb%d->dwr%d] = relu
+layer[dwr%d->pw%d] = conv:pw%d
+  kernel_size = 1
+  nchannel = %d
+  random_type = kaiming
+  no_bias = 1
+layer[pw%d->pwb%d] = batch_norm:pwb%d
+layer[pwb%d->pwr%d] = relu
+""" % (node, i, i, stride, c, c, i, i, i, i, i, i, i, i, ch,
+            i, i, i, i, i)
+        node, c = "pwr%d" % i, ch
+    txt += """layer[%s->gap] = avg_pooling
+  kernel_size = %d
+  stride = %d
+layer[gap->flat] = flatten
+layer[flat->fc] = fullc:fc
+  nhidden = %d
+  random_type = kaiming
+layer[fc->fc] = softmax
+netconfig = end
+""" % (node, final_pool, final_pool, n_class)
+    return txt
+
+
+def mobilenet_trainer(batch_size: int = 256, input_hw: int = 224,
+                      dev: str = "tpu", n_class: int = 1000,
+                      base_ch: int = 32,
+                      blocks=MOBILENET_BLOCKS,
+                      extra_cfg: str = "") -> Trainer:
+    """Depthwise-separable trainer (shrink blocks/base_ch/input_hw for
+    tests)."""
+    final_pool = _mobilenet_final_pool(blocks, input_hw)
+    conf = (mobilenet_netconfig(n_class, base_ch, blocks,
+                                final_pool=final_pool) +
+            "input_shape = 3,%d,%d\n" % (input_hw, input_hw) +
+            "batch_size = %d\n" % batch_size +
+            "eta = 0.1\nmomentum = 0.9\nwd = 0.0001\n" +
+            "dev = %s\n" % dev + extra_cfg)
+    tr = Trainer()
+    for k, v in parse_config_string(conf):
+        tr.set_param(k, v)
+    tr.init_model()
+    return tr

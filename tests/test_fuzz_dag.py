@@ -125,3 +125,38 @@ def test_random_dag_shapes_grads_checkpoint(seed):
     w2 = serializer.Writer()
     tr2.save_model(w2)
     assert w1.getvalue() == w2.getvalue(), conf
+
+
+# --- serving fuzz: decode == full recompute across the attention grid --
+
+ATT_GRID = [
+    # (embed_extra, attn_extra) random-ish corners beyond the
+    # hand-picked cases in test_decode.py
+    ("pos_embed = 1", "  nkvhead = 2\n"),
+    ("pos_embed = 0", "  rope = 1\n"),
+    ("pos_embed = 0", "  rope = 1\n  attn_window = 5\n"),
+    ("pos_embed = 1", "  nkvhead = 1\n  attn_window = 9\n"),
+    ("pos_embed = 0", "  rope = 1\n  nkvhead = 4\n"),
+    ("pos_embed = 1", "  attn_window = 16\n"),
+]
+
+
+@pytest.mark.parametrize("case", range(len(ATT_GRID)))
+def test_decode_grid_matches_recompute(case):
+    """KV-cached decode must be token-exact vs full-prefix recompute for
+    every (positions, rope, GQA-width, window) corner — including ragged
+    prompts — not just the hand-picked combinations."""
+    from tests.test_decode import _trained, _check
+    embed_extra, attn_extra = ATT_GRID[case]
+    tr = _trained(embed_extra=embed_extra, attn_extra=attn_extra,
+                  steps=8)
+    _check(tr, n_new=6)
+    # ragged variant on the same trainer
+    rs = np.random.RandomState(50 + case)
+    prompts = rs.randint(0, 12, (4, 8))
+    lens = np.array([4, 8, 6, 5])
+    got = tr.generate(prompts, 4, prompt_lens=lens)
+    for r in range(4):
+        want = tr.generate(prompts[r:r + 1, :lens[r]], 4)
+        np.testing.assert_array_equal(got[r:r + 1], want,
+                                      err_msg="row %d" % r)

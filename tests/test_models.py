@@ -81,3 +81,27 @@ def test_vit_memorizes():
     for _ in range(150):
         tr.update(b)
     assert (tr.predict(b) == b.label[:, 0]).mean() >= 0.9
+
+
+def test_mobilenet_memorizes():
+    """Depthwise-separable family: the grouped-conv extreme (ngroup = C,
+    one input channel per group) through BN + pointwise stacks trains to
+    memorization; depthwise weights keep the (g, 1, k*k) layout."""
+    import numpy as np
+    from cxxnet_tpu.models import mobilenet_trainer
+    from cxxnet_tpu.io.data import DataBatch
+
+    tr = mobilenet_trainer(batch_size=8, input_hw=16, dev="cpu",
+                           n_class=4, base_ch=8,
+                           blocks=((16, 1), (32, 2)),
+                           extra_cfg="eta = 0.05\n")
+    i = tr.net_cfg.get_layer_index("dw0")
+    assert np.shape(tr.params[i]["wmat"]) == (8, 1, 9)
+    rs = np.random.RandomState(3)
+    b = DataBatch()
+    b.data = rs.rand(8, 3, 16, 16).astype(np.float32)
+    b.label = rs.randint(0, 4, (8, 1)).astype(np.float32)
+    b.batch_size = 8
+    for _ in range(120):
+        tr.update(b)
+    assert (tr.predict(b) == b.label[:, 0]).mean() >= 0.9

@@ -114,6 +114,8 @@ def zoo(models=None):
         ("googlenet", lambda: M.googlenet_trainer(8, 224, dev="cpu"), "img"),
         ("resnet18", lambda: M.resnet_trainer(8, 224, dev="cpu"), "img"),
         ("vgg16", lambda: M.vgg_trainer(8, 224, dev="cpu"), "img"),
+        ("mobilenet", lambda: M.mobilenet_trainer(8, 224, dev="cpu"),
+         "img"),
         ("vit_s16", lambda: M.vit_trainer(
             n_class=1000, image_hw=224, patch=16, dim=384, nhead=6,
             nlayer=12, ffn_mult=4, batch_size=8, dev="cpu"), "img"),
@@ -238,6 +240,7 @@ _RATE_KEYS = {
     "googlenet_imagenet": "googlenet",
     "resnet18_imagenet": "resnet18",
     "vgg16_imagenet": "vgg16",
+    "mobilenet_imagenet": "mobilenet",
     "vit_s16": "vit_s16",
     "transformer_lm_L2048": "transformer_lm_L2048",
     "transformer_lm_L8192_gqa_window": "transformer_lm_L8192_gqa_window",
