@@ -638,7 +638,7 @@ def main():
     mode = sys.argv[1] if len(sys.argv) > 1 else ""
     limit = int(os.environ.get(
         "CXXNET_BENCH_TIMEOUT",
-        {"all": 3900, "pipeline": 1080}.get(mode, 780)))
+        {"all": 5100, "pipeline": 1080}.get(mode, 780)))
     limit = max(min(limit, 60), limit - int(time.perf_counter() - t0))
     env = dict(os.environ, _CXXNET_BENCH_CHILD="1")
     proc = subprocess.Popen([sys.executable] + sys.argv, env=env)

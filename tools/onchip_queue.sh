@@ -50,7 +50,7 @@ run kernels  900  python tools/check_tpu_kernels.py
 run poolab   1500 python tools/pool_ab.py
 run cross1x1 1500 python tools/cross1x1_ab.py
 run layout   2400 python tools/layout_ab.py default
-run benchall 4200 python bench.py all
+run benchall 5400 python bench.py all
 run mfutable 600  python tools/roofline.py --bench onchip_logs/bench.log --bench onchip_logs/benchall.log
 run decodetable 600 python tools/roofline.py --decode --bench onchip_logs/benchall.log
 run pipeline 1200 python bench.py pipeline
