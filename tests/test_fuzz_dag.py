@@ -160,3 +160,39 @@ def test_decode_grid_matches_recompute(case):
         want = tr.generate(prompts[r:r + 1, :lens[r]], 4)
         np.testing.assert_array_equal(got[r:r + 1], want,
                                       err_msg="row %d" % r)
+
+
+# --- parallelism fuzz: random DAG x (dp, dp x tp) exactness ------------
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_random_dag_parallel_matches_single_device(seed):
+    """Seeded random DAGs must train IDENTICALLY (tight tolerance)
+    under data parallelism and composed dp x tp vs the single-device
+    net — the generative version of test_compose's hand-built cases."""
+    rs = np.random.RandomState(300 + seed)
+    conf = _random_conf(rs)
+    # batch 8 so every data-parallel degree divides it
+    variants = {
+        "1dev": "dev = cpu\nbatch_size = 8\n",
+        "dp8": "dev = cpu:0-7\nbatch_size = 8\n",
+        "dp4xtp2": "dev = cpu:0-7\nbatch_size = 8\n"
+                   "model_parallel = 2\n",
+    }
+    from tests.test_compose import _trainer, _assert_params_match
+    trainers = {name: _trainer(conf, extra)
+                for name, extra in variants.items()}
+    xs = rs.rand(3, 8, 3, 16, 16).astype(np.float32)
+    ys = rs.randint(0, N_CLASS, (3, 8, 1)).astype(np.float32)
+    for x, y in zip(xs, ys):
+        for tr in trainers.values():
+            b = DataBatch()
+            b.data = x
+            b.label = y
+            b.batch_size = 8
+            tr.update(b)
+    ref = trainers["1dev"]
+    for name in ("dp8", "dp4xtp2"):
+        # same helper + 2e-4 tolerance every sibling dp/tp exactness
+        # comparison uses (all-reduce ordering drift allowance)
+        _assert_params_match(trainers[name], ref)
