@@ -196,3 +196,36 @@ def test_random_dag_parallel_matches_single_device(seed):
         # same helper + 2e-4 tolerance every sibling dp/tp exactness
         # comparison uses (all-reduce ordering drift allowance)
         _assert_params_match(trainers[name], ref)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_random_dag_pipeline_matches_single_device(seed):
+    """Random DAGs under pipeline parallelism track the single-device
+    net. Two documented semantic boundaries shape the comparison
+    (doc/multichip.md): batch_norm statistics are per-MICROBATCH under
+    GPipe (exact only at pipeline_micro = 1) and per-data-SHARD under a
+    composed dp axis (exact only at dp = 1) — so BN nets run pp2-only
+    with one microbatch, everything else runs pp2 x dp4 with the
+    default microbatch count."""
+    rs = np.random.RandomState(300 + seed)
+    conf = _random_conf(rs)
+    from tests.test_compose import _trainer, _assert_params_match
+    if "batch_norm" in conf:
+        extra = ("dev = cpu:0-1\nbatch_size = 8\n"
+                 "pipeline_parallel = 2\npipeline_micro = 1\n")
+    else:
+        extra = ("dev = cpu:0-7\nbatch_size = 8\n"
+                 "pipeline_parallel = 2\n")
+    tr = _trainer(conf, extra)
+    ref = _trainer(conf, "dev = cpu\nbatch_size = 8\n")
+    assert tr._pp_entries is not None
+    xs = rs.rand(2, 8, 3, 16, 16).astype(np.float32)
+    ys = rs.randint(0, N_CLASS, (2, 8, 1)).astype(np.float32)
+    for x, y in zip(xs, ys):
+        for t in (tr, ref):
+            b = DataBatch()
+            b.data = x
+            b.label = y
+            b.batch_size = 8
+            t.update(b)
+    _assert_params_match(tr, ref)
