@@ -229,3 +229,52 @@ def test_random_dag_pipeline_matches_single_device(seed):
             b.batch_size = 8
             t.update(b)
     _assert_params_match(tr, ref)
+
+
+SP_ATT_CONF = """
+netconfig = start
+layer[+1:att] = attention:att
+  nhead = 4
+  causal = 1
+  init_sigma = 0.1
+%s
+layer[+1] = flatten
+layer[+1:fc1] = fullc:fc1
+  nhidden = 8
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig = end
+input_shape = 8,1,16
+batch_size = 8
+eta = 0.1
+"""
+
+SP_GRID = [
+    "  nkvhead = 2\n",
+    "  rope = 1\n",
+    "  rope = 1\n  attn_window = 8\n",
+    "  attn_window = 16\n",
+    "  rope = 1\n  nkvhead = 4\n",
+]
+
+
+@pytest.mark.parametrize("case", range(len(SP_GRID)))
+def test_attention_grid_seq_parallel_matches(case):
+    """Ring attention under seq_parallel = 2 trains identically to the
+    single-device net across the (GQA-width, rope, window) grid — the
+    sp counterpart of the decode grid above (window tile-skipping and
+    GQA-sized ring hops are the risky corners)."""
+    from tests.test_compose import _trainer, _assert_params_match
+    conf = SP_ATT_CONF % SP_GRID[case]
+    tr = _trainer(conf, "dev = cpu:0-7\nseq_parallel = 2\n")
+    ref = _trainer(conf, "dev = cpu\n")
+    assert "sp" in tr.mesh.axis_names
+    rs = np.random.RandomState(case)
+    for _ in range(3):
+        b = DataBatch()
+        b.data = rs.rand(8, 8, 1, 16).astype(np.float32)
+        b.label = rs.randint(0, 8, (8, 1)).astype(np.float32)
+        b.batch_size = 8
+        tr.update(b)
+        ref.update(b)
+    _assert_params_match(tr, ref)
