@@ -278,3 +278,46 @@ def test_attention_grid_seq_parallel_matches(case):
         tr.update(b)
         ref.update(b)
     _assert_params_match(tr, ref)
+
+
+EP_CONF = """
+netconfig = start
+layer[+1:m1] = moe:m1
+  nexpert = %d
+  nhidden = 8
+%s
+  init_sigma = 0.1
+layer[+1] = relu
+layer[+1:fc] = fullc:fc
+  nhidden = 4
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig = end
+input_shape = 1,1,6
+batch_size = 8
+eta = 0.1
+"""
+
+EP_GRID = [(4, ""), (8, ""), (4, "  top_k = 2\n"), (8, "  top_k = 1\n")]
+
+
+@pytest.mark.parametrize("case", range(len(EP_GRID)))
+def test_moe_grid_expert_parallel_matches(case):
+    """Expert parallelism across the (nexpert, top_k) grid: sharded
+    experts + gate-weighted psum combine must train identically to the
+    single-device dense dispatch."""
+    from tests.test_compose import _trainer, _assert_params_match
+    nexpert, extra_keys = EP_GRID[case]
+    conf = EP_CONF % (nexpert, extra_keys)
+    tr = _trainer(conf, "dev = cpu:0-7\nexpert_parallel = 2\n")
+    ref = _trainer(conf, "dev = cpu\n")
+    assert "ep" in tr.mesh.axis_names
+    rs = np.random.RandomState(40 + case)
+    for _ in range(3):
+        b = DataBatch()
+        b.data = rs.rand(8, 1, 1, 6).astype(np.float32)
+        b.label = rs.randint(0, 4, (8, 1)).astype(np.float32)
+        b.batch_size = 8
+        tr.update(b)
+        ref.update(b)
+    _assert_params_match(tr, ref)
