@@ -321,3 +321,31 @@ def test_moe_grid_expert_parallel_matches(case):
         tr.update(b)
         ref.update(b)
     _assert_params_match(tr, ref)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_random_dag_zero_sharding_matches(seed):
+    """ZeRO tiers on random DAGs: update_on_server (opt-state sharding)
+    and fsdp (ZeRO-3 full param sharding) must not change numerics vs
+    plain data parallelism."""
+    rs = np.random.RandomState(500 + seed)
+    conf = _random_conf(rs)
+    from tests.test_compose import _trainer, _assert_params_match
+    variants = {
+        "1dev": "dev = cpu\nbatch_size = 8\n",
+        "zero1": "dev = cpu:0-7\nbatch_size = 8\nupdate_on_server = 1\n",
+        "fsdp": "dev = cpu:0-7\nbatch_size = 8\nfsdp = 1\n",
+    }
+    trainers = {name: _trainer(conf, extra)
+                for name, extra in variants.items()}
+    xs = rs.rand(3, 8, 3, 16, 16).astype(np.float32)
+    ys = rs.randint(0, N_CLASS, (3, 8, 1)).astype(np.float32)
+    for x, y in zip(xs, ys):
+        for tr in trainers.values():
+            b = DataBatch()
+            b.data = x
+            b.label = y
+            b.batch_size = 8
+            tr.update(b)
+    for name in ("zero1", "fsdp"):
+        _assert_params_match(trainers[name], trainers["1dev"])
