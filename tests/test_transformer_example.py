@@ -37,3 +37,23 @@ def test_lm_pipeline_conf_learns_grammar():
     trains the same grammar through the example driver."""
     acc = train_lm.main(steps=120, conf_name="lm_pipeline.conf")
     assert acc > 0.7, "composed-mesh LM accuracy %.3f" % acc
+
+
+def test_serve_lm_demo_agrees_across_surfaces():
+    """example/transformer/serve_lm.py: in-process generate, the
+    exported prefill/step artifact loop, and tensor-parallel serving
+    produce identical tokens (run short — agreement holds at any
+    training step)."""
+    import subprocess
+    env = dict(os.environ, CXXNET_JAX_PLATFORM="cpu")
+    p = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "example",
+                      "transformer", "serve_lm.py"), "25"],
+        capture_output=True, text=True, timeout=900, env=env,
+        cwd=os.path.join(os.path.dirname(__file__), "..", "example",
+                         "transformer"))
+    assert p.returncode == 0, (p.stdout[-1500:], p.stderr[-1500:])
+    assert "SERVING DEMO PASSED" in p.stdout
+    assert "artifact decode loop: MATCH" in p.stdout
+    assert "tensor-parallel serving (mp=2): MATCH" in p.stdout
