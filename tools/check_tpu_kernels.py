@@ -322,6 +322,24 @@ dev = tpu
     np.testing.assert_allclose(xw[0], xw[1], rtol=2e-2, atol=2e-4)
     print("cross-input 1x1 batching parity on-chip: OK")
 
+    # --- depthwise conv (feature_group_count = C) compiles + steps ------
+    # the mobilenet bench row's distinct XLA-TPU path: grouped conv at
+    # the one-channel-per-group extreme, under bf16 + channels_last
+    from cxxnet_tpu.models import mobilenet_trainer
+    mnt = mobilenet_trainer(batch_size=8, input_hw=32, dev="tpu",
+                            n_class=10, base_ch=8,
+                            blocks=((16, 1), (32, 2)),
+                            extra_cfg="eval_train = 0\n"
+                                      "compute_dtype = bfloat16\n")
+    db3 = DataBatch()
+    db3.data = rs.rand(8, 3, 32, 32).astype(np.float32)
+    db3.label = rs.randint(0, 10, (8, 1)).astype(np.float32)
+    db3.batch_size = 8
+    mnt.update(db3)
+    assert np.isfinite(np.asarray(
+        jax.device_get(mnt.params[0]["wmat"]), np.float32)).all()
+    print("depthwise (ngroup=C) conv train step on-chip: OK")
+
     print("ALL TPU KERNEL CHECKS PASSED")
 
 
