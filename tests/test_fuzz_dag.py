@@ -349,3 +349,43 @@ def test_random_dag_zero_sharding_matches(seed):
             tr.update(b)
     for name in ("zero1", "fsdp"):
         _assert_params_match(trainers[name], trainers["1dev"])
+
+
+@pytest.mark.parametrize("seed", range(15))
+def test_mutated_config_fails_controlled(seed):
+    """Corrupted configs must fail with a framework error (ValueError /
+    ConfigError / AssertionError with a message), never an uncontrolled
+    crash — the reference's utils::Check discipline (src/utils/utils.h)
+    applied generatively: take a valid random config and break it."""
+    rs = np.random.RandomState(700 + seed)
+    conf = _random_conf(rs)
+    lines = conf.splitlines()
+    mutation = rs.choice(["drop", "scramble_node", "bad_value", "dup"])
+    idx = [i for i, l in enumerate(lines) if l.startswith("layer[")]
+    i = int(rs.choice(idx))
+    if mutation == "drop":
+        del lines[i]                       # dangling node references
+    elif mutation == "scramble_node":
+        lines[i] = lines[i].replace("[", "[9", 1)   # undefined source
+    elif mutation == "bad_value":
+        lines.insert(i + 1, "  kernel_size = -3")
+    elif mutation == "dup":
+        lines.insert(i, lines[i])          # node written twice
+    broken = "\n".join(lines) + "\n"
+    tr = Trainer()
+    try:
+        for k, v in parse_config_string(broken):
+            tr.set_param(k, v)
+        tr.init_model()
+        # some mutations still yield a valid net (e.g. a dup split
+        # branch that type-checks) — then it must actually train
+        b = DataBatch()
+        b.data = rs.rand(4, 3, 16, 16).astype(np.float32)
+        b.label = rs.randint(0, N_CLASS, (4, 1)).astype(np.float32)
+        b.batch_size = 4
+        tr.update(b)
+    except (ValueError, AssertionError) as e:
+        # 40-seed census: every failure is a messaged ValueError
+        # (ConfigError subclasses it); KeyError/IndexError would be an
+        # uncontrolled-crash regression
+        assert str(e), "error must carry a message"
