@@ -151,6 +151,11 @@ def test_decode_grid_matches_recompute(case):
     tr = _trained(embed_extra=embed_extra, attn_extra=attn_extra,
                   steps=8)
     _check(tr, n_new=6)
+    # beam=1 IS greedy, for every attention-config corner
+    rsb = np.random.RandomState(90 + case)
+    bp = rsb.randint(0, 12, (4, 6))
+    np.testing.assert_array_equal(tr.beam_generate(bp, 5, beam=1),
+                                  tr.generate(bp, 5))
     # ragged variant on the same trainer
     rs = np.random.RandomState(50 + case)
     prompts = rs.randint(0, 12, (4, 8))
