@@ -214,8 +214,11 @@ def _maxpool_bwd_kernel(x_ref, y_ref, g_ref, dx_ref, *, kernel, stride,
     kh, kw = kernel
     s = stride
     (py, px), (ph, pw) = pad_lo, pad_hi
-    x = x_ref[0]
-    y = y_ref[0]
+    # ties are detected in f32: bf16->f32 is exact so equality is
+    # unchanged, and Mosaic on v5lite rejects sub-f32 vector compares
+    # ("Target does not support this comparison")
+    x = x_ref[0].astype(jnp.float32)
+    y = y_ref[0].astype(jnp.float32)
     g = g_ref[0].astype(jnp.float32)
     H, W, C = x.shape
     OH, OW, _ = y.shape
@@ -278,13 +281,14 @@ def maxpool_bwd_supported(shape_nhwc, kernel=(2, 2), stride=2,
     # the kernel materializes is h + 2*py + ph, not h + py + ph
     hp, wp = h + 2 * py + ph, w + 2 * px + pw
     plane = hp * wp * c
-    bytes_ = plane * (dtype_bytes      # padded input xp
+    bytes_ = plane * (dtype_bytes      # raw input block x
+                      + 4              # padded f32 input xp (ties compare in f32)
                       + 4              # f32 accumulator dxp
                       + dtype_bytes)   # output block dx
     if stride > 1:
-        bytes_ += 2 * plane * dtype_bytes   # dilated y and g lattices
+        bytes_ += 2 * plane * 4             # dilated f32 y and g lattices
     else:
         oh = (hp - kernel[0]) // stride + 1
         ow = (wp - kernel[1]) // stride + 1
-        bytes_ += 2 * oh * ow * c * dtype_bytes   # y and g blocks
+        bytes_ += 2 * oh * ow * c * 4       # f32 y and g blocks
     return bytes_ <= 12 * 1024 * 1024
