@@ -965,26 +965,31 @@ class Trainer:
             self.epoch_counter += 1
 
     # ------------------------------------------------------------------
+    def _eval_values(self, params, data, rng, node_ids):
+        """Eval-mode forward (traced inside jit) returning the requested
+        node values; shared by _forward_nodes and predict_device."""
+        if self.pipeline_parallel > 1:
+            values, _ = self.net.forward_pipelined(
+                params, data, train=False, rng=rng, mesh=self.mesh,
+                n_micro=self.pipeline_micro or None,
+                packed_entries=self._pp_entries,
+                stages=getattr(self, "_pp_stages", None))
+            for n in node_ids:
+                check(values[n] is not None,
+                      "node %d lives inside the pipelined prefix; "
+                      "with pipeline_parallel only loss-tail "
+                      "nodes are observable" % n)
+        else:
+            values, _ = self.net.forward(params, data, train=False,
+                                         rng=rng, mesh=self.mesh)
+        return [values[n] for n in node_ids]
+
     def _forward_nodes(self, batch, node_ids: Tuple[int, ...]):
         """Jitted eval forward returning the requested nodes."""
         k = ("fwd", node_ids)
         if k not in self._jit_cache:
             def fwd(params, data, rng):
-                if self.pipeline_parallel > 1:
-                    values, _ = self.net.forward_pipelined(
-                        params, data, train=False, rng=rng, mesh=self.mesh,
-                        n_micro=self.pipeline_micro or None,
-                        packed_entries=self._pp_entries,
-                        stages=getattr(self, "_pp_stages", None))
-                    for n in node_ids:
-                        check(values[n] is not None,
-                              "node %d lives inside the pipelined prefix; "
-                              "with pipeline_parallel only loss-tail "
-                              "nodes are observable" % n)
-                else:
-                    values, _ = self.net.forward(params, data, train=False,
-                                                 rng=rng, mesh=self.mesh)
-                return [values[n] for n in node_ids]
+                return self._eval_values(params, data, rng, node_ids)
             self._jit_cache[k] = jax.jit(fwd)
         data = self._shard_batch(batch.data)
         outs = self._jit_cache[k](self.params, data, self._next_rng())
@@ -998,14 +1003,35 @@ class Trainer:
                     for o in outs]
         return outs
 
+    def predict_device(self, batch):
+        """On-device prediction: the last node's per-row argmax (or its
+        scalar column) computed INSIDE the jitted program, returned as a
+        (batch,) jax.Array with no host fetch. predict() wraps this with
+        the fetch; serving loops call it directly so only (batch,)
+        floats ever cross the wire instead of the (batch, nclass) logit
+        matrix (reference Predict + TransformPred,
+        nnet_impl-inl.hpp:186-299 — the transform runs on device here)."""
+        node = self.net_cfg.param.num_nodes - 1
+        k = ("pred", node)
+        if k not in self._jit_cache:
+            def prog(params, data, rng):
+                out = self._eval_values(params, data, rng, (node,))[0]
+                out = out.reshape(out.shape[0], -1)
+                if out.shape[1] != 1:
+                    return jnp.argmax(out, axis=1).astype(jnp.float32)
+                return out[:, 0]
+            self._jit_cache[k] = jax.jit(prog)
+        data = self._shard_batch(batch.data)
+        return self._jit_cache[k](self.params, data, self._next_rng())
+
     def predict(self, batch) -> np.ndarray:
         """Argmax (or scalar) prediction per row of the last node
         (reference Predict + TransformPred, nnet_impl-inl.hpp:186-299)."""
-        out = self._forward_nodes(batch, (self.net_cfg.param.num_nodes - 1,))[0]
-        out = np.asarray(out).reshape(out.shape[0], -1)
-        if out.shape[1] != 1:
-            return np.argmax(out, axis=1).astype(np.float32)
-        return out[:, 0]
+        out = self.predict_device(batch)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            out = multihost_utils.process_allgather(out, tiled=True)
+        return np.asarray(out)
 
     def _resolve_node(self, node_name: str) -> int:
         """Node id from a name or a top[-k] offset (reference
