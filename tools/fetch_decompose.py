@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Decompose the serving-call round trip through the tunnel: which part
+of a predict()/generate() call costs what. The r5 benchall measured
+~10-11s PER predict call (any batch size) while a whole 1984-step
+generate scan round-tripped in ~1.3s — this pins down whether the cost
+is (a) jit cache misses / recompiles, (b) device_put resharding,
+(c) the np.asarray fetch path, or (d) eager-op dispatch, and therefore
+which number the infer/latency/decode bench rows actually measured.
+
+Usage: python tools/fetch_decompose.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+
+
+def t(label, f, n=3):
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        r = f()
+        best = min(best, time.perf_counter() - t0)
+    print("%-46s best-of-%d %8.3f s" % (label, n, best), flush=True)
+    return r
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from cxxnet_tpu.utils import enable_compile_cache
+    enable_compile_cache()
+    from cxxnet_tpu.models import alexnet_trainer
+    from cxxnet_tpu.io.data import DataBatch
+
+    batch = 256
+    tr = alexnet_trainer(batch_size=batch, input_hw=227, dev="tpu",
+                         extra_cfg="eval_train = 0\n"
+                                   "compute_dtype = bfloat16\n")
+    rs = np.random.RandomState(0)
+    b = DataBatch()
+    b.data = jax.device_put(rs.rand(batch, 3, 227, 227).astype(np.float32))
+    b.label = jax.device_put(np.zeros((batch, 1), np.float32))
+    b.batch_size = batch
+
+    print("== warmup (2 predict calls, includes compile) ==", flush=True)
+    t("predict warmup", lambda: tr.predict(b), n=2)
+
+    node = tr.net_cfg.param.num_nodes - 1
+    fn = tr._jit_cache[("pred", node)]
+    print("jit cache sizes: pred=%s" % (fn._cache_size(),), flush=True)
+
+    print("== decomposition ==", flush=True)
+    data = t("_shard_batch(batch.data)", lambda: tr._shard_batch(b.data))
+    rng = t("_next_rng()", lambda: tr._next_rng())
+    out = t("jitted pred dispatch (async)",
+            lambda: fn(tr.params, data, rng))
+    t("float(jnp.sum(out)) sync", lambda: float(jnp.sum(out)))
+    t("np.asarray(out) fetch (batch,)", lambda: np.asarray(out))
+    print("jit cache sizes after: pred=%s" % (fn._cache_size(),), flush=True)
+
+    print("== full predict calls (post-warm) ==", flush=True)
+    t("tr.predict(b)", lambda: tr.predict(b), n=3)
+
+    # fetch-size scaling: same jitted program, three result sizes
+    print("== fetch size scaling (jit identity -> asarray) ==", flush=True)
+    for shape in ((256,), (256, 1000), (256, 4096), (1, 1000)):
+        x = jax.jit(lambda a: a + 1.0)(jnp.zeros(shape, jnp.float32))
+        float(jnp.sum(x))   # ensure computed
+        t("np.asarray %s  (%.0f KB)"
+          % (shape, np.prod(shape) * 4 / 1024), lambda: np.asarray(x))
+
+    # eager op cost
+    print("== eager dispatch ==", flush=True)
+    t("eager fold_in", lambda: jax.random.fold_in(jax.random.PRNGKey(0), 3))
+    t("eager (x+1) on device",
+      lambda: jnp.add(jnp.float32(1.0), jnp.float32(2.0)))
+
+    # decode-loop round trip for the lm rows
+    print("== lm generate round trip ==", flush=True)
+    from cxxnet_tpu.models import transformer_lm_trainer
+    lt = transformer_lm_trainer(vocab=8192, seq=2048, batch_size=8,
+                                dim=512, nhead=8, nlayer=4, dev="tpu",
+                                extra_cfg="eval_train = 0\n"
+                                          "compute_dtype = bfloat16\n")
+    prompts = rs.randint(0, 8192, (8, 64))
+    t("generate warmup (compile)", lambda: lt.generate(prompts, 1984), n=1)
+    t("generate(b8, 1984 new)", lambda: lt.generate(prompts, 1984), n=3)
+    t("generate(b8, 64 new)", lambda: lt.generate(prompts, 64), n=3)
+
+
+if __name__ == "__main__":
+    main()
