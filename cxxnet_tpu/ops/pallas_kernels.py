@@ -226,11 +226,23 @@ def _maxpool_bwd_kernel(x_ref, y_ref, g_ref, dx_ref, *, kernel, stride,
     xp = jnp.pad(x, ((py, ph), (px, pw), (0, 0)), constant_values=neg)
     uh, uw = (OH - 1) * s + 1, (OW - 1) * s + 1
     if s > 1:
-        # dilate y/g onto the stride lattice; interior zeros never match
-        # (their g is zero, so a spurious equality contributes zero)
-        y = jax.lax.pad(y, neg, ((0, 0, s - 1), (0, 0, s - 1), (0, 0, 0)))
-        g = jax.lax.pad(g, jnp.asarray(0.0, g.dtype),
-                        ((0, 0, s - 1), (0, 0, s - 1), (0, 0, 0)))
+        # dilate y/g onto the stride lattice; interior fill never matches
+        # (-inf for y; g's fill is zero so a spurious equality contributes
+        # nothing). Expressed as concat+reshape over the leading dims —
+        # Mosaic does not lower lax.pad's interior padding.
+        def _dilate(z, fill):
+            oh_, ow_, c_ = z.shape
+            z = jnp.concatenate(
+                [z[:, None], jnp.full((oh_, s - 1, ow_, c_), fill,
+                                      z.dtype)],
+                axis=1).reshape(oh_ * s, ow_, c_)[:uh]
+            z = jnp.concatenate(
+                [z[:, :, None], jnp.full((uh, ow_, s - 1, c_), fill,
+                                         z.dtype)],
+                axis=2).reshape(uh, ow_ * s, c_)[:, :uw]
+            return z
+        y = _dilate(y, -jnp.inf)
+        g = _dilate(g, 0.0)
     hp, wp = H + py + ph, W + px + pw
     dxp = jnp.zeros((hp, wp, C), jnp.float32)
     for a in range(kh):
