@@ -131,54 +131,15 @@ def _max_pool_bwd(kernel, stride, padding, res, g):
 _max_pool.defvjp(_max_pool_fwd, _max_pool_bwd)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
-def _max_pool_pallas(x, kernel, stride, padding):
-    """NHWC max pool whose FORWARD is XLA reduce_window (already optimal)
-    and whose BACKWARD is the fused Pallas pass
-    (pallas_kernels.maxpool_bwd_nhwc) — reference unpool tie semantics at
-    one-VMEM-pass cost, replacing select-and-scatter. Opt-in via
-    CXXNET_POOL=pallas until the on-chip A/B settles the default."""
-    (py, ph_), (px, pw_) = padding
-    return lax.reduce_window(
-        x, -jnp.inf, lax.max,
-        (1, kernel[0], kernel[1], 1), (1, stride, stride, 1),
-        [(0, 0), (py, ph_), (px, pw_), (0, 0)])
-
-
-def _max_pool_pallas_fwd(x, kernel, stride, padding):
-    y = _max_pool_pallas(x, kernel, stride, padding)
-    return y, (x, y)
-
-
-def _max_pool_pallas_bwd(kernel, stride, padding, res, g):
-    from . import pallas_kernels
-    x, y = res
-    (py, ph_), (px, pw_) = padding
-    dx = pallas_kernels.maxpool_bwd_nhwc(
-        x, y, g, kernel, stride, (py, px), (ph_, pw_),
-        interpret=jax.default_backend() != "tpu")
-    return (dx,)
-
-
-_max_pool_pallas.defvjp(_max_pool_pallas_fwd, _max_pool_pallas_bwd)
-
-
-# CXXNET_POOL=pallas fall-back accounting: an A/B run must be able to tell
-# which kernel each pool layer actually executed (a silent fall-back to
-# select-and-scatter would be measured as if it were the Pallas kernel).
-# One warning per distinct (reason, shape); the counter is inspectable.
-pool_pallas_fallbacks: dict = {}
-
-
-def _note_pool_fallback(reason: str, shape) -> None:
-    key = (reason, tuple(shape))
-    first = key not in pool_pallas_fallbacks
-    pool_pallas_fallbacks[key] = pool_pallas_fallbacks.get(key, 0) + 1
-    if first:
-        import sys
-        print("cxxnet_tpu: CXXNET_POOL=pallas fell back to "
-              "select-and-scatter for pool input %s (%s)"
-              % (tuple(shape), reason), file=sys.stderr)
+# A fused Pallas max-pool BACKWARD (reference tie semantics in one VMEM
+# pass, replacing select-and-scatter) lived here through r4 and was
+# deleted after its on-chip A/B: GoogLeNet b128 bf16 measured 2,435
+# img/s vs 4,707 with select-and-scatter (onchip_logs/poolab.log, r5) —
+# and it needed three fixes against a moving Mosaic target just to
+# compile (f32-only vector compares, no interior-pad lowering, 16M
+# VMEM stack limits). XLA's select-and-scatter is the fast path on
+# v5lite; CXXNET_POOL=mask below keeps the reference-exact tie
+# semantics available in plain HLO.
 
 
 def pool2d(x: jnp.ndarray, mode: str, kernel: Tuple[int, int], stride: int,
@@ -215,17 +176,6 @@ def pool2d(x: jnp.ndarray, mode: str, kernel: Tuple[int, int], stride: int,
         padding = [(0, 0), (0, 0), (py, py + ph), (px, px + pw)]
     if mode == "max":
         pool_knob = os.environ.get("CXXNET_POOL")
-        if pool_knob == "pallas":
-            if layout == "NHWC":
-                from . import pallas_kernels
-                if pallas_kernels.maxpool_bwd_supported(
-                        x.shape, kernel, stride, (py, px, ph, pw),
-                        x.dtype.itemsize):
-                    return _max_pool_pallas(
-                        x, kernel, stride, ((py, py + ph), (px, px + pw)))
-                _note_pool_fallback("vmem_gate", x.shape)
-            else:
-                _note_pool_fallback("nchw_layout", x.shape)
         if pool_knob == "mask":
             # the mask VJP kernel is written for NCHW; wrap for NHWC
             # (opt-in knob — the transposes are acceptable there)

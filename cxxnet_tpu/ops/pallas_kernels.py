@@ -193,114 +193,6 @@ def rrelu(x, seed, lb: float, ub: float) -> Tuple[jnp.ndarray, jnp.ndarray]:
     autodiff gives the xelu gradient for free."""
     mask = rrelu_mask(seed, x.shape, lb, ub, x.dtype)
     return jnp.where(x > 0, x, x / mask), mask
-
-
-# ---------------------------------------------------------------------------
-# Max-pool backward: one fused VMEM pass instead of XLA select-and-scatter
-# ---------------------------------------------------------------------------
-def _maxpool_bwd_kernel(x_ref, y_ref, g_ref, dx_ref, *, kernel, stride,
-                        pad_lo, pad_hi):
-    """dx for max pooling on one (H, W, C) channels-last plane.
-
-    Gradient routes to every input equal to its window's max — the
-    reference's unpool tie semantics (mshadow unpool,
-    src/layer/pooling_layer-inl.hpp Backprop), which XLA's
-    select-and-scatter (single-winner) only approximates. The k*k
-    shifted compare/accumulate runs entirely in VMEM: expressed as HLO
-    (ops._max_pool_bwd) the nine input-sized passes each round-trip HBM
-    and measured 2x slower than select-and-scatter; fused here they are
-    nine VPU ops over resident tiles.
-    """
-    kh, kw = kernel
-    s = stride
-    (py, px), (ph, pw) = pad_lo, pad_hi
-    # ties are detected in f32: bf16->f32 is exact so equality is
-    # unchanged, and Mosaic on v5lite rejects sub-f32 vector compares
-    # ("Target does not support this comparison")
-    x = x_ref[0].astype(jnp.float32)
-    y = y_ref[0].astype(jnp.float32)
-    g = g_ref[0].astype(jnp.float32)
-    H, W, C = x.shape
-    OH, OW, _ = y.shape
-    neg = jnp.asarray(-jnp.inf, x.dtype)
-    xp = jnp.pad(x, ((py, ph), (px, pw), (0, 0)), constant_values=neg)
-    uh, uw = (OH - 1) * s + 1, (OW - 1) * s + 1
-    if s > 1:
-        # dilate y/g onto the stride lattice; interior fill never matches
-        # (-inf for y; g's fill is zero so a spurious equality contributes
-        # nothing). Expressed as concat+reshape over the leading dims —
-        # Mosaic does not lower lax.pad's interior padding.
-        def _dilate(z, fill):
-            oh_, ow_, c_ = z.shape
-            z = jnp.concatenate(
-                [z[:, None], jnp.full((oh_, s - 1, ow_, c_), fill,
-                                      z.dtype)],
-                axis=1).reshape(oh_ * s, ow_, c_)[:uh]
-            z = jnp.concatenate(
-                [z[:, :, None], jnp.full((uh, ow_, s - 1, c_), fill,
-                                         z.dtype)],
-                axis=2).reshape(uh, ow_ * s, c_)[:, :uw]
-            return z
-        y = _dilate(y, -jnp.inf)
-        g = _dilate(g, 0.0)
-    hp, wp = H + py + ph, W + px + pw
-    dxp = jnp.zeros((hp, wp, C), jnp.float32)
-    for a in range(kh):
-        for b in range(kw):
-            xs = jax.lax.slice(xp, (a, b, 0), (a + uh, b + uw, C))
-            contrib = jnp.where(xs == y, g, 0.0)
-            part = jnp.pad(contrib,
-                           ((a, hp - uh - a), (b, wp - uw - b), (0, 0)))
-            dxp = dxp + part
-    dx_ref[0] = jax.lax.slice(
-        dxp, (py, px, 0), (py + H, px + W, C)).astype(dx_ref.dtype)
-
-
-def maxpool_bwd_nhwc(x, y, g, kernel, stride, pad_lo, pad_hi,
-                     interpret: bool = False):
-    """Fused max-pool backward over (B, H, W, C) channels-last tensors.
-    x: pool input; y: pool output (forward result); g: output cotangent.
-    pad_lo/pad_hi: ((py, px), (ph, pw)) — the forward's asymmetric
-    ceil-mode padding. One grid step owns one sample's full plane."""
-    b = x.shape[0]
-    bh, bw, bc = x.shape[1:]
-    oh, ow = y.shape[1], y.shape[2]
-    return pl.pallas_call(
-        functools.partial(_maxpool_bwd_kernel, kernel=kernel,
-                          stride=stride, pad_lo=pad_lo, pad_hi=pad_hi),
-        grid=(b,),
-        in_specs=[pl.BlockSpec((1, bh, bw, bc), lambda i: (i, 0, 0, 0)),
-                  pl.BlockSpec((1, oh, ow, bc), lambda i: (i, 0, 0, 0)),
-                  pl.BlockSpec((1, oh, ow, bc), lambda i: (i, 0, 0, 0))],
-        out_specs=pl.BlockSpec((1, bh, bw, bc), lambda i: (i, 0, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
-        interpret=interpret,
-    )(x, y, g)
-
-
-def maxpool_bwd_supported(shape_nhwc, kernel=(2, 2), stride=2,
-                          pad=(0, 0, 0, 0), dtype_bytes=4) -> bool:
-    """Conservative VMEM gate sized from the PADDED plane the kernel
-    actually materializes (not the logical input): per grid step it holds
-    the padded input (input dtype), the padded f32 accumulator, the
-    dilated y/g planes when stride > 1 (approaching padded-plane size),
-    and the in/out blocks. Budget 12 MB of the 16 MB VMEM. Covers every
-    GoogLeNet inception pool tower and stage pool; the 112x112 stem pool
-    stays on XLA select-and-scatter."""
-    _, h, w, c = shape_nhwc
-    py, px, ph, pw = pad
-    # pool2d pads lo=py, hi=py+ph (symmetric ceil-mode extra): the plane
-    # the kernel materializes is h + 2*py + ph, not h + py + ph
-    hp, wp = h + 2 * py + ph, w + 2 * px + pw
-    plane = hp * wp * c
-    bytes_ = plane * (dtype_bytes      # raw input block x
-                      + 4              # padded f32 input xp (ties compare in f32)
-                      + 4              # f32 accumulator dxp
-                      + dtype_bytes)   # output block dx
-    if stride > 1:
-        bytes_ += 2 * plane * 4             # dilated f32 y and g lattices
-    else:
-        oh = (hp - kernel[0]) // stride + 1
-        ow = (wp - kernel[1]) // stride + 1
-        bytes_ += 2 * oh * ow * c * 4       # f32 y and g blocks
-    return bytes_ <= 12 * 1024 * 1024
+# (The fused max-pool backward kernel that lived here through r4 was
+# deleted after losing its on-chip A/B 2:1 to XLA select-and-scatter —
+# see ops.pool2d and onchip_logs/poolab.log.)

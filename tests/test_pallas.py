@@ -82,90 +82,7 @@ class TestLRNBf16:
             atol=5e-2)
 
 
-class TestMaxPoolBackwardKernel:
-    """Fused Pallas max-pool backward (interpret mode on CPU): gradients
-    must match the equality-mask VJP exactly — both implement the
-    reference's unpool tie semantics (every input equal to the window max
-    receives the full output gradient), where XLA select-and-scatter
-    picks a single winner."""
-
-    def _padding(self, h, w, k, s, p):
-        (_, _), (ph, pw) = ops._pool_padding(h + 2 * p, w + 2 * p,
-                                             (k, k), s)
-        return ((p, p + ph), (p, p + pw))
-
-    @pytest.mark.parametrize("h,w,c,k,s,p", [
-        (8, 8, 8, 2, 2, 0),     # even pool
-        (7, 7, 16, 3, 1, 1),    # the inception stride-1 tower shape
-        (9, 9, 4, 3, 2, 0),     # ceil-mode tail
-        (6, 6, 8, 3, 3, 0),     # stride > kernel-1
-    ])
-    def test_grad_matches_mask_vjp(self, h, w, c, k, s, p):
-        rs = np.random.RandomState(0)
-        # quantized values force ties — the semantics differentiator
-        x_nchw = jnp.asarray(np.round(rs.rand(2, c, h, w) * 4) / 4,
-                             jnp.float32)
-        pad = self._padding(h, w, k, s, p)
-
-        g_mask = jax.grad(lambda x: jnp.sum(jnp.square(
-            ops._max_pool(x, (k, k), s, pad))))(x_nchw)
-        g_pal = jax.grad(lambda x: jnp.sum(jnp.square(
-            ops._max_pool_pallas(x, (k, k), s, pad))))(
-                ops.to_nhwc(x_nchw))
-        np.testing.assert_allclose(np.asarray(ops.to_nchw(g_pal)),
-                                   np.asarray(g_mask),
-                                   rtol=1e-6, atol=1e-7)
-
-    def test_forward_is_reduce_window(self):
-        rs = np.random.RandomState(1)
-        x = jnp.asarray(rs.rand(2, 9, 9, 8), jnp.float32)
-        pad = self._padding(9, 9, 3, 2, 0)
-        y = ops._max_pool_pallas(x, (3, 3), 2, pad)
-        ref = ops.pool2d(ops.to_nchw(x), "max", (3, 3), 2)
-        np.testing.assert_array_equal(np.asarray(ops.to_nchw(y)),
-                                      np.asarray(ref))
-
-    def test_bf16(self):
-        rs = np.random.RandomState(2)
-        x = jnp.asarray(np.round(rs.rand(2, 7, 7, 8) * 4) / 4,
-                        jnp.bfloat16)
-        pad = self._padding(7, 7, 3, 1, 1)
-        g = jax.grad(lambda x: jnp.sum(jnp.square(
-            ops._max_pool_pallas(x, (3, 3), 1, pad)
-        ).astype(jnp.float32)))(x)
-        assert g.dtype == jnp.bfloat16
-        g_ref = jax.grad(lambda x: jnp.sum(jnp.square(
-            ops._max_pool(x, (3, 3), 1, pad)
-        ).astype(jnp.float32)))(ops.to_nchw(x))
-        np.testing.assert_allclose(
-            np.asarray(ops.to_nchw(g), np.float32),
-            np.asarray(g_ref, np.float32), rtol=2e-2, atol=1e-2)
-
-    def test_vmem_gate(self):
-        from cxxnet_tpu.ops import pallas_kernels as pk
-        assert pk.maxpool_bwd_supported((1, 28, 28, 480))
-        assert pk.maxpool_bwd_supported((1, 14, 14, 832))
-        assert not pk.maxpool_bwd_supported((1, 112, 112, 64))
-
-    def test_pool2d_dispatch(self, monkeypatch):
-        """CXXNET_POOL=pallas routes qualifying NHWC max pools through the
-        fused-backward path — proven through the GRADIENT, which is the
-        thing the dispatch changes: ties receive the full grad in every
-        matching window (select-and-scatter would pick one winner)."""
-        from cxxnet_tpu.ops import pallas_kernels as pk
-        x = jnp.full((1, 4, 4, 8), 1.0, jnp.float32)   # all tied
-        assert pk.maxpool_bwd_supported(x.shape)
-
-        def loss(x):
-            return jnp.sum(ops.pool2d(x, "max", (2, 2), 2, layout="NHWC"))
-
-        monkeypatch.setenv("CXXNET_POOL", "pallas")
-        g_pal = jax.grad(loss)(x)
-        monkeypatch.delenv("CXXNET_POOL")
-        g_def = jax.grad(loss)(x)
-        # pallas path: every element of each tied 2x2 window gets grad 1
-        np.testing.assert_array_equal(np.asarray(g_pal),
-                                      np.ones_like(np.asarray(g_pal)))
-        # the default select-and-scatter picks one winner per window —
-        # the two paths MUST differ here, proving the dispatch is live
-        assert not np.array_equal(np.asarray(g_pal), np.asarray(g_def))
+# (TestMaxPoolBackwardKernel was deleted with the fused Pallas max-pool
+# backward kernel: it lost its on-chip A/B 2:1 to select-and-scatter —
+# onchip_logs/poolab.log. The reference-exact tie semantics remain
+# covered by tests/test_layers.py::test_max_pool_mask_backward.)

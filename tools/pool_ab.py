@@ -1,8 +1,13 @@
 #!/usr/bin/env python
 """Paired A/B: XLA select-and-scatter max-pool backward (default) vs the
-fused Pallas backward (CXXNET_POOL=pallas) on GoogLeNet — the pool-heavy
-bench model (select-and-scatter measured ~20% of its NCHW step). Adjacent
-runs so shared-chip drift cancels; one JSON line per variant.
+equality-mask custom VJP (CXXNET_POOL=mask, reference-exact unpool tie
+semantics) on GoogLeNet — the pool-heavy bench model. Adjacent runs so
+shared-chip drift cancels; one JSON line per variant.
+
+History: a fused Pallas backward (CXXNET_POOL=pallas) also lived here
+through r4; its r5 on-chip A/B measured 2,435 img/s vs 4,707 for
+select-and-scatter (b128 bf16) and the kernel was deleted. The mask VJP
+remains the semantics reference (measured ~2x slower, r3).
 
 Usage: python tools/pool_ab.py [batch]
 """
@@ -22,7 +27,7 @@ def main():
     from cxxnet_tpu.utils import enable_compile_cache
     enable_compile_cache()
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
-    for knob in ("", "pallas"):
+    for knob in ("", "mask"):
         if knob:
             os.environ["CXXNET_POOL"] = knob
         else:
