@@ -167,6 +167,13 @@ class Net:
         assert self.net_ is not None, "model not initialized"
         return self.net_.predict(self._resolve_batch(data))
 
+    def predict_device(self, data):
+        """predict() without the host fetch: the (batch,) result stays a
+        jax.Array on device — the serving-loop building block (chain
+        calls, sync once; only the final fetch crosses the wire)."""
+        assert self.net_ is not None, "model not initialized"
+        return self.net_.predict_device(self._resolve_batch(data))
+
     def extract(self, data, name: str) -> np.ndarray:
         """Activations of the named node (or `top[-k]`) for the batch."""
         assert self.net_ is not None, "model not initialized"
