@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Micro-A/B of the KV-cached decode attention step's cache layout.
+
+The r5 decode trace (onchip_logs/decode_trace.log) shows ~80% of each
+step in the score/AV matvecs at ~33% of HBM bandwidth. Hypothesis: the
+(b, h, L, d) cache keeps d = 64 as the physical minor dim, so every
+(8, 128) vector tile is half padding. Candidates:
+
+  a) current    — K, V as (b, h, L, d);   scores 'bhqd,bhld->bhql'
+  b) flat-minor — K, V as (b, L, h*d);    per-head math via reshape
+  c) kT         — K as (b, h, d, L), V as (b, h, L, d)
+
+Each variant runs the same 4-layer-equivalent read volume (one layer
+here, x1983 steps in the scan is what generate does; we time 512
+chained single steps). Measured GB/s is the verdict.
+
+Usage: python tools/decode_layout_ab.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from cxxnet_tpu.utils import enable_compile_cache
+    enable_compile_cache()
+
+    b, h, L, d = 8, 8, 2048, 64
+    dt = jnp.bfloat16
+    rs = np.random.RandomState(0)
+    q = jax.device_put(rs.rand(b, h, 1, d).astype(np.float32)).astype(dt)
+    k_bhld = jax.device_put(rs.rand(b, h, L, d).astype(np.float32)).astype(dt)
+    v_bhld = jax.device_put(rs.rand(b, h, L, d).astype(np.float32)).astype(dt)
+    k_flat = k_bhld.transpose(0, 2, 1, 3).reshape(b, L, h * d)
+    v_flat = v_bhld.transpose(0, 2, 1, 3).reshape(b, L, h * d)
+    k_t = k_bhld.transpose(0, 1, 3, 2)   # (b, h, d, L)
+    scale = d ** -0.5
+    read_bytes = 2 * b * h * L * d * 2   # K + V, bf16
+
+    def step_a(q, k, v):
+        s = jnp.einsum("bhqd,bhld->bhql", q, k) * scale
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(dt)
+        return jnp.einsum("bhql,bhld->bhqd", p, v)
+
+    def step_b(q, kf, vf):
+        k = kf.reshape(b, L, h, d).transpose(0, 2, 1, 3)
+        v = vf.reshape(b, L, h, d).transpose(0, 2, 1, 3)
+        return step_a(q, k, v)
+
+    def step_c(q, kt, v):
+        s = jnp.einsum("bhqd,bhdl->bhql", q, kt) * scale
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(dt)
+        return jnp.einsum("bhql,bhld->bhqd", p, v)
+
+    def run(label, f, *args):
+        n = 512
+
+        def many(q0, *rest):
+            def body(c, _):
+                # carry-dependent q so XLA can't hoist the body out
+                o = f(q0 + (c * 0).astype(q0.dtype), *rest)
+                return c + jnp.sum(o.astype(jnp.float32)), None
+            acc, _ = jax.lax.scan(body, jnp.float32(0), None, length=n)
+            return acc
+        c = jax.jit(many).lower(*args).compile()
+        float(c(*args))
+        t0 = time.perf_counter()
+        r = c(*args)
+        float(r)
+        dt_s = (time.perf_counter() - t0) / n
+        print("%-12s %8.1f us/step  %6.1f GB/s (K+V read)"
+              % (label, dt_s * 1e6, read_bytes / dt_s / 1e9), flush=True)
+
+    run("a_bhld", step_a, q, k_bhld, v_bhld)
+    run("b_flat", step_b, q, k_flat, v_flat)
+    run("c_kT", step_c, q, k_t, v_bhld)
+
+
+if __name__ == "__main__":
+    main()
