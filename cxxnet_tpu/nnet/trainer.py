@@ -1211,9 +1211,15 @@ class Trainer:
         # prefill covers only the shared [0, min(lens)) prefix, and every
         # later column a step reads was either a real prompt token or
         # place()-written at the previous step)
-        toks_dev, new_dparams = self._decode_fns[fkey](
-            params, jnp.asarray(toks0), jax.random.PRNGKey(seed),
-            jnp.asarray(lens))
+        try:
+            toks_dev, new_dparams = self._decode_fns[fkey](
+                params, jnp.asarray(toks0), jax.random.PRNGKey(seed),
+                jnp.asarray(lens))
+        except Exception:
+            # the donated decode copy may be consumed even on failure —
+            # drop the cache so the next call regathers from self.params
+            self._decode_params = None
+            raise
         self._decode_params = (self._decode_params[0], new_dparams)
         toks = np.asarray(toks_dev)
         return np.stack([toks[r, lens[r]: lens[r] + n_new]
@@ -1409,8 +1415,13 @@ class Trainer:
             self._beam_fns[fkey] = jax.jit(run, donate_argnums=(0,))
         toks0 = np.zeros((b, l_max), np.int32)
         toks0[:, :plen] = prompts
-        hist, _, new_dparams = self._beam_fns[fkey](params,
-                                                    jnp.asarray(toks0))
+        try:
+            hist, _, new_dparams = self._beam_fns[fkey](params,
+                                                        jnp.asarray(toks0))
+        except Exception:
+            # donated decode copy may be consumed even on failure
+            self._decode_params = None
+            raise
         self._decode_params = (self._decode_params[0], new_dparams)
         return np.asarray(hist)[:, plen:total]
 
