@@ -410,6 +410,26 @@ def bench_lm_decode_long():
         extra="nkvhead = 2\nattn_window = 1024\nrope = 1\n")
 
 
+def bench_lm_decode_chunked():
+    """The flash-decode while-loop (decode_chunk): reads only the live
+    cache prefix per step instead of the full static length — the dense
+    path's known ~2x read overhead (doc/performance.md decode roofline).
+    Token-exactness is pinned in tests/test_decode.py; this row decides
+    whether the while-loop overhead beats the saved bandwidth on-chip."""
+    return _lm_decode("lm_decode_chunked_tokens_per_sec_per_chip",
+                      8, 2048, 64, extra="decode_chunk = 256\n")
+
+
+def bench_lm_decode_long_chunked():
+    """Chunked decode under the long-context recipe: with a 1024 window
+    the loop reads at most 5 x 256-row chunks per step regardless of
+    position, vs the dense path's masked 8192-row read."""
+    return _lm_decode(
+        "lm_decode_L8192_chunked_tokens_per_sec_per_chip", 8, 8192, 64,
+        extra="nkvhead = 2\nattn_window = 1024\nrope = 1\n"
+              "decode_chunk = 256\n")
+
+
 def bench_mnist_mlp():
     tr = _conf_trainer(MNIST_MLP, (1, 1, 784), 100, extra=BF16)
     ips = _throughput(tr, (1, 1, 784), 10, 100, steps=100)
@@ -605,7 +625,8 @@ def _bench_main():
                    bench_transformer_lm, bench_transformer_lm_long,
                    bench_vit, bench_alexnet_b1024, bench_alexnet_infer,
                    bench_alexnet_latency_b1, bench_lm_decode,
-                   bench_lm_decode_b1, bench_lm_decode_long):
+                   bench_lm_decode_b1, bench_lm_decode_long,
+                   bench_lm_decode_chunked, bench_lm_decode_long_chunked):
             print(json.dumps(fn()), flush=True)
     if len(sys.argv) > 1 and sys.argv[1] in ("all", "pipeline"):
         for line in bench_alexnet_pipeline():

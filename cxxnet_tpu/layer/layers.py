@@ -1130,6 +1130,12 @@ class AttentionLayer(Layer):
         # query sees only the last attn_window keys; flash kernels skip
         # out-of-window tiles wholesale
         self.attn_window = 0
+        # decode_chunk > 0: KV-cached decode steps read the cache via a
+        # chunked online-softmax while-loop (flash-decode) instead of
+        # scoring the full static-length cache — the dense path's L_max
+        # read per token is ~2x the useful traffic on average
+        # (doc/performance.md decode roofline). Opt-in until measured.
+        self.decode_chunk = 0
 
     def set_param(self, name, val):
         super().set_param(name, val)
@@ -1145,6 +1151,8 @@ class AttentionLayer(Layer):
             self.nkvhead = int(val)
         if name == "attn_window":
             self.attn_window = int(val)
+        if name == "decode_chunk":
+            self.decode_chunk = int(val)
         if name == "sp_mode":
             check(val in ("ring", "ulysses"),
                   "sp_mode must be ring or ulysses")
@@ -1272,6 +1280,14 @@ class AttentionLayer(Layer):
                     out = attention_reference(
                         q, k, v, causal=True, scale=dh ** -0.5,
                         window=self.attn_window)
+            elif self.decode_chunk > 0 and L == 1 \
+                    and ck.shape[2] % self.decode_chunk == 0:
+                # flash-decode: online-softmax while-loop over live cache
+                # chunks only (parallel/ring.py decode_attention_chunked)
+                from ..parallel.ring import decode_attention_chunked
+                out = decode_attention_chunked(
+                    q, ck, cv, pos=pos, scale=dh ** -0.5,
+                    window=self.attn_window, chunk=self.decode_chunk)
             else:
                 out = attention_reference(
                     q, ck, cv, causal=True, scale=dh ** -0.5,

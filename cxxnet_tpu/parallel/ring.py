@@ -67,6 +67,75 @@ def attention_reference(q, k, v, *, causal: bool = False,
     return jnp.einsum("bngqk,bnkd->bngqd", p, v).reshape(b, nh, sq, d)
 
 
+def decode_attention_chunked(q, k, v, *, pos, scale: Optional[float] = None,
+                             window: int = 0, chunk: int = 256):
+    """Single-position cache attention that reads only the LIVE prefix.
+
+    Equals ``attention_reference(q, k, v, causal=True, q_offset=pos)``
+    for a one-row query at global position ``pos`` (traced), but instead
+    of scoring against the full static-length cache it runs an online-
+    softmax ``lax.while_loop`` over ``chunk``-row cache blocks
+    [c_lo, pos // chunk] — a flash-decode step in plain XLA. The dense
+    path reads L_max rows per generated token regardless of position
+    (static shapes), which the r5 decode trace showed is ~2x the useful
+    traffic on average (doc/performance.md, decode roofline); here the
+    loop bound is data-dependent, which XLA's while supports. With
+    ``window > 0`` the loop also starts at the first chunk inside the
+    window (the dense path merely masks those reads). Accumulation is
+    float32 (better than the dense path's activation-dtype softmax).
+
+    q: (b, nh, 1, d); k/v: (b, nkv, L_max, d) caches, GQA-sized.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    b, nh, sq, d = q.shape
+    assert sq == 1, "decode_attention_chunked is a single-position step"
+    nkv, l_max = k.shape[1], k.shape[2]
+    assert nh % nkv == 0, "query heads must be a multiple of kv heads"
+    assert l_max % chunk == 0, \
+        "cache length %d must be divisible by decode_chunk %d" \
+        % (l_max, chunk)
+    g = nh // nkv
+    qg = q.reshape(b, nkv, g, d).astype(jnp.float32)
+    pos = jnp.asarray(pos, jnp.int32)
+    c_hi = pos // chunk                       # last live chunk, inclusive
+    if window > 0:
+        c_lo = jnp.maximum(0, (pos - (window - 1)) // chunk)
+    else:
+        c_lo = jnp.int32(0)
+
+    def body(carry):
+        c, m, l, acc = carry
+        kc = lax.dynamic_slice(k, (0, 0, c * chunk, 0),
+                               (b, nkv, chunk, d)).astype(jnp.float32)
+        vc = lax.dynamic_slice(v, (0, 0, c * chunk, 0),
+                               (b, nkv, chunk, d)).astype(jnp.float32)
+        s = jnp.einsum("bngd,bnkd->bngk", qg, kc) * scale
+        kpos = c * chunk + jnp.arange(chunk)[None, None, None, :]
+        keep = kpos <= pos
+        if window > 0:
+            keep = jnp.logical_and(keep, pos - kpos < window)
+        s = jnp.where(keep, s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        # exp(-inf - -inf) would be nan on the first all-masked chunk;
+        # m_new is finite whenever any key is live, and c_lo..c_hi always
+        # contains live keys, so guard only the carry rescale
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_new), 0.0)
+        p = jnp.exp(s - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha[..., None] \
+            + jnp.einsum("bngk,bnkd->bngd", p, vc)[:, :, :, None, :]
+        return c + 1, m_new, l_new, acc_new
+
+    m0 = jnp.full((b, nkv, g, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, nkv, g, 1), jnp.float32)
+    acc0 = jnp.zeros((b, nkv, g, 1, d), jnp.float32)
+    _, _, l, acc = lax.while_loop(
+        lambda carry: carry[0] <= c_hi, body, (c_lo, m0, l0, acc0))
+    out = acc[:, :, :, 0, :] / l
+    return out.reshape(b, nh, 1, d).astype(q.dtype)
+
+
 # per-step score tiles are capped at (RING_Q_CHUNK, skv): the local block
 # computation runs as a sequential lax.map over query chunks, so memory per
 # device stays O(chunk * skv) instead of O((L/n)^2) — the single-chip flash

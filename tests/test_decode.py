@@ -503,3 +503,39 @@ def test_cli_serve_task(tmp_path):
         want = tr.generate(np.asarray([r]), 5)
         np.testing.assert_array_equal(np.asarray([got[i]]), want,
                                       err_msg="line %d" % i)
+
+
+def test_decode_chunked_attention_unit():
+    """decode_attention_chunked == attention_reference for a one-row
+    query at every position class (first chunk, chunk boundary, interior,
+    last row), with and without GQA grouping and a sliding window."""
+    import jax.numpy as jnp
+    from cxxnet_tpu.parallel.ring import (attention_reference,
+                                          decode_attention_chunked)
+    rs = np.random.RandomState(3)
+    b, nh, nkv, L, d = 2, 4, 2, 32, 8
+    k = jnp.asarray(rs.randn(b, nkv, L, d).astype(np.float32))
+    v = jnp.asarray(rs.randn(b, nkv, L, d).astype(np.float32))
+    for window in (0, 5):
+        for pos in (0, 3, 7, 8, 15, 31):
+            q = jnp.asarray(rs.randn(b, nh, 1, d).astype(np.float32))
+            want = attention_reference(q, k, v, causal=True,
+                                       window=window, q_offset=pos)
+            got = decode_attention_chunked(q, k, v, pos=pos,
+                                           window=window, chunk=8)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=2e-5, atol=2e-5)
+
+
+def test_decode_chunked_token_exact():
+    """generate() with decode_chunk (flash-decode while-loop) reproduces
+    the full-recompute reference token for token."""
+    _check(_trained(attn_extra="  decode_chunk = 8\n"))
+
+
+def test_decode_chunked_rope_gqa_window_token_exact():
+    """The chunked path under the long-context serving recipe: RoPE +
+    GQA caches + sliding window."""
+    _check(_trained(embed_extra="pos_embed = 0",
+                    attn_extra="  rope = 1\n  nkvhead = 2\n"
+                               "  attn_window = 8\n  decode_chunk = 8\n"))
