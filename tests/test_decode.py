@@ -539,3 +539,21 @@ def test_decode_chunked_rope_gqa_window_token_exact():
     _check(_trained(embed_extra="pos_embed = 0",
                     attn_extra="  rope = 1\n  nkvhead = 2\n"
                                "  attn_window = 8\n  decode_chunk = 8\n"))
+
+
+def test_decode_chunked_export_artifacts_match(tmp_path):
+    """export_decode with decode_chunk: the while-loop step program
+    exports through jax.export and the artifact loop reproduces the
+    (chunk-enabled) generate token for token."""
+    from cxxnet_tpu import api
+    tr = _trained(attn_extra="  decode_chunk = 8\n")
+    rs = np.random.RandomState(9)
+    prompts = rs.randint(0, VOCAB, (4, 6))
+    pre_b, step_b = tr.export_decode(batch_size=4, prompt_len=6)
+    p1, p2 = str(tmp_path / "pre.hlo"), str(tmp_path / "step.hlo")
+    open(p1, "wb").write(pre_b)
+    open(p2, "wb").write(step_b)
+    gen = api.load_decode(p1, p2)
+    got = gen(prompts, 8)
+    want = tr.generate(prompts, 8)
+    np.testing.assert_array_equal(got, want)
