@@ -557,3 +557,13 @@ def test_decode_chunked_export_artifacts_match(tmp_path):
     got = gen(prompts, 8)
     want = tr.generate(prompts, 8)
     np.testing.assert_array_equal(got, want)
+
+
+def test_decode_chunked_beam1_equals_greedy():
+    """Beam search rides the same decode step: with decode_chunk on,
+    beam=1 stays pinned to greedy."""
+    tr = _trained(attn_extra="  decode_chunk = 8\n")
+    rs = np.random.RandomState(11)
+    prompts = rs.randint(0, VOCAB, (4, 6))
+    np.testing.assert_array_equal(tr.beam_generate(prompts, 6, beam=1),
+                                  tr.generate(prompts, 6))
