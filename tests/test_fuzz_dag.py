@@ -138,6 +138,14 @@ ATT_GRID = [
     ("pos_embed = 1", "  nkvhead = 1\n  attn_window = 9\n"),
     ("pos_embed = 0", "  rope = 1\n  nkvhead = 4\n"),
     ("pos_embed = 1", "  attn_window = 16\n"),
+    # flash-decode (decode_chunk while-loop) corners: chunk dividing and
+    # equal to the cache length, composed with GQA/rope/window
+    ("pos_embed = 1", "  decode_chunk = 8\n  nkvhead = 2\n"),
+    ("pos_embed = 0",
+     "  rope = 1\n  attn_window = 5\n  decode_chunk = 8\n"),
+    ("pos_embed = 1", "  decode_chunk = 24\n"),
+    ("pos_embed = 0",
+     "  rope = 1\n  nkvhead = 4\n  decode_chunk = 12\n"),
 ]
 
 
