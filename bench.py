@@ -430,6 +430,14 @@ def bench_lm_decode_long_chunked():
               "decode_chunk = 256\n")
 
 
+def bench_lm_decode_b1_chunked():
+    """Interactive single-stream decode with flash-decode: batch 1 is
+    where the dense full-cache read is the largest share of bytes/token
+    (decode roofline: b1 sits at 42% of bound with the dense read)."""
+    return _lm_decode("lm_decode_b1_chunked_tokens_per_sec_per_chip",
+                      1, 2048, 64, extra="decode_chunk = 256\n")
+
+
 def bench_mnist_mlp():
     tr = _conf_trainer(MNIST_MLP, (1, 1, 784), 100, extra=BF16)
     ips = _throughput(tr, (1, 1, 784), 10, 100, steps=100)
@@ -626,7 +634,8 @@ def _bench_main():
                    bench_vit, bench_alexnet_b1024, bench_alexnet_infer,
                    bench_alexnet_latency_b1, bench_lm_decode,
                    bench_lm_decode_b1, bench_lm_decode_long,
-                   bench_lm_decode_chunked, bench_lm_decode_long_chunked):
+                   bench_lm_decode_chunked, bench_lm_decode_long_chunked,
+                   bench_lm_decode_b1_chunked):
             print(json.dumps(fn()), flush=True)
     if len(sys.argv) > 1 and sys.argv[1] in ("all", "pipeline"):
         for line in bench_alexnet_pipeline():
