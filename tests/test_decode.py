@@ -567,3 +567,26 @@ def test_decode_chunked_beam1_equals_greedy():
     prompts = rs.randint(0, VOCAB, (4, 6))
     np.testing.assert_array_equal(tr.beam_generate(prompts, 6, beam=1),
                                   tr.generate(prompts, 6))
+
+
+def test_generate_stable_across_predict_calls():
+    """predict() swaps the params list identity (donate-and-return,
+    _swap_params); interleaved generate() calls must neither go stale
+    nor lose their decode-param cache to the identity change."""
+    tr = _trained()
+    rs = np.random.RandomState(13)
+    prompts = rs.randint(0, VOCAB, (4, 6))
+    first = tr.generate(prompts, 5)
+    db = DataBatch()
+    db.data = np.zeros((4, 1, 1, SEQ), np.float32)
+    db.label = np.zeros((4, SEQ), np.float32)
+    db.batch_size = 4
+    tr.predict(db)
+    # a regather would re-run canonical_params — count it
+    calls = []
+    orig = tr.canonical_params
+    tr.canonical_params = lambda: (calls.append(1), orig())[1]
+    again = tr.generate(prompts, 5)
+    tr.canonical_params = orig
+    np.testing.assert_array_equal(first, again)
+    assert not calls, "decode copy was regathered after predict()"
