@@ -35,4 +35,10 @@ bin/im2bin: tools/im2bin.cc $(CORE_SRC) $(CORE_HDR)
 clean:
 	rm -f lib/libcxxnet_tpu_core.so lib/libcxxnetwrapper.so bin/im2bin bin/test_wrapper_c
 
-.PHONY: all clean
+# tier-1 fast pass (what the driver's verify runs): the telemetry tests
+# ride here unmarked — only @pytest.mark.slow tests are excluded
+test-fast:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+		-p no:cacheprovider
+
+.PHONY: all clean test-fast

@@ -623,9 +623,23 @@ def _probe_backend(attempts=4, probe_timeout=45, sleep_s=30):
     return False
 
 
+def _attach_telemetry(result):
+    """Fold the per-phase telemetry breakdown (top spans, compile count/
+    seconds, counters since the last bench) into a bench line, so
+    BENCH_*.json carries the breakdown instead of one opaque number."""
+    from cxxnet_tpu.utils import telemetry
+    if telemetry.enabled():
+        result["telemetry"] = telemetry.brief_summary()
+        telemetry.reset()
+    return result
+
+
 def _bench_main():
-    from cxxnet_tpu.utils import enable_compile_cache
+    from cxxnet_tpu.utils import enable_compile_cache, telemetry
     enable_compile_cache()
+    # in-memory telemetry (no JSONL sink): each bench line gets the
+    # spans/compiles recorded during ITS run attached by _attach_telemetry
+    telemetry.enable()
     if len(sys.argv) > 1 and sys.argv[1] == "all":
         for fn in (bench_mnist_mlp, bench_mnist_conv, bench_bowl,
                    bench_googlenet, bench_googlenet_b256,
@@ -636,12 +650,15 @@ def _bench_main():
                    bench_lm_decode_b1, bench_lm_decode_long,
                    bench_lm_decode_chunked, bench_lm_decode_long_chunked,
                    bench_lm_decode_b1_chunked):
-            print(json.dumps(fn()), flush=True)
+            print(json.dumps(_attach_telemetry(fn())), flush=True)
     if len(sys.argv) > 1 and sys.argv[1] in ("all", "pipeline"):
-        for line in bench_alexnet_pipeline():
+        lines = bench_alexnet_pipeline()
+        if lines:
+            _attach_telemetry(lines[-1])
+        for line in lines:
             print(json.dumps(line), flush=True)
     # default (driver) mode: exactly ONE JSON line
-    print(json.dumps(bench_alexnet()), flush=True)
+    print(json.dumps(_attach_telemetry(bench_alexnet())), flush=True)
 
 
 def main():

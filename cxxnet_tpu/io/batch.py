@@ -20,6 +20,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..utils import telemetry
 from .data import DataBatch, DataInst, IIterator
 
 
@@ -166,8 +167,16 @@ class ThreadBufferIterator(IIterator):
             # queue nobody is draining
             try:
                 self.base.before_first()
-                while self.base.next():
-                    item = self.base.value().deep_copy()
+                while True:
+                    # producer-side cost of one batch (decode + augment +
+                    # pack + copy), on the prefetch thread — against the
+                    # consumer's io.wait span this says whether the
+                    # loader or the device is the bottleneck
+                    with telemetry.span("io.produce"):
+                        if not self.base.next():
+                            break
+                        item = self.base.value().deep_copy()
+                    telemetry.count("io.prefetch_batches")
                     while True:
                         if self._poll_stop():
                             return

@@ -28,6 +28,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..utils import telemetry
 from ..utils.binary_page import BinaryPage, KPAGE_INTS
 from .data import DataBatch, DataInst, IIterator
 from .batch import BatchAdaptIterator
@@ -37,17 +38,19 @@ def _decode_rgb_chw(buf: bytes) -> np.ndarray:
     # native path first: libjpeg decode + float CHW conversion in C++,
     # entirely off-GIL (src/core/jpeg_decode.cc) — this is what lets the
     # imgbinx decode thread pool scale
-    from ..utils import native
-    out = native.decode_jpeg_chw(buf)
-    if out is not None:
-        return out
-    import cv2
-    arr = np.frombuffer(buf, dtype=np.uint8)
-    bgr = cv2.imdecode(arr, cv2.IMREAD_COLOR)
-    assert bgr is not None, "decoding fail"
-    rgb = bgr[:, :, ::-1]
-    return np.ascontiguousarray(
-        rgb.transpose(2, 0, 1).astype(np.float32))
+    with telemetry.span("io.decode"):
+        telemetry.count("io.decode_bytes", len(buf))
+        from ..utils import native
+        out = native.decode_jpeg_chw(buf)
+        if out is not None:
+            return out
+        import cv2
+        arr = np.frombuffer(buf, dtype=np.uint8)
+        bgr = cv2.imdecode(arr, cv2.IMREAD_COLOR)
+        assert bgr is not None, "decoding fail"
+        rgb = bgr[:, :, ::-1]
+        return np.ascontiguousarray(
+            rgb.transpose(2, 0, 1).astype(np.float32))
 
 
 class _ListReader:

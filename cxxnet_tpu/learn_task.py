@@ -18,6 +18,7 @@ import numpy as np
 from .io import create_iterator
 from .nnet.trainer import Trainer, create_net
 from .utils import serializer
+from .utils import telemetry
 from .utils.config import ConfigIterator
 
 
@@ -39,6 +40,11 @@ class LearnTask:
         # Replaces the reference's wall-clock-only observability
         # (SURVEY.md §5 tracing/profiling).
         self.profile_dir = ""
+        # telemetry_log=<path>: structured JSONL run log (spans, counters,
+        # compile events; utils/telemetry.py). A Chrome-trace export is
+        # written next to it (<path>.trace.json) at end of run, and the
+        # end-of-run summary table prints unless silent.
+        self.telemetry_log = ""
         self.silent = 0
         self.start_counter = 0
         self.max_round = 1 << 31
@@ -82,23 +88,34 @@ class LearnTask:
                 num_processes=self.num_worker or None,
                 process_id=self.worker_rank if self.worker_rank >= 0
                 else None)
-        self.init()
-        if not self.silent:
-            print("initializing end, start working")
-        if self.task in ("train", "finetune"):
-            self.task_train()
-        elif self.task == "pred":
-            self.task_predict()
-        elif self.task == "pred_raw":
-            self.task_predict_raw()
-        elif self.task == "extract":
-            self.task_extract_feature()
-        elif self.task == "export":
-            self.task_export()
-        elif self.task == "generate":
-            self.task_generate()
-        elif self.task == "serve":
-            self.task_serve()
+        if self.telemetry_log:
+            telemetry.enable(self.telemetry_log)
+            telemetry.event({"ev": "run_meta", "task": self.task,
+                             "dev": self.device})
+        try:
+            with telemetry.span("init"):
+                self.init()
+            if not self.silent:
+                print("initializing end, start working")
+            if self.task in ("train", "finetune"):
+                self.task_train()
+            elif self.task == "pred":
+                self.task_predict()
+            elif self.task == "pred_raw":
+                self.task_predict_raw()
+            elif self.task == "extract":
+                self.task_extract_feature()
+            elif self.task == "export":
+                self.task_export()
+            elif self.task == "generate":
+                self.task_generate()
+            elif self.task == "serve":
+                self.task_serve()
+        finally:
+            if self.telemetry_log:
+                summary = telemetry.finish(close=True)
+                if summary and not self.silent:
+                    self._print_telemetry_summary(summary)
         return 0
 
     def set_param(self, name: str, val: str) -> None:
@@ -134,6 +151,8 @@ class LearnTask:
             self.test_io = int(val)
         if name == "profile_dir":
             self.profile_dir = val
+        if name == "telemetry_log":
+            self.telemetry_log = val
         if name == "coordinator":
             self.coordinator = val
         if name == "num_worker":
@@ -308,57 +327,38 @@ class LearnTask:
         profiling = False
         while self.start_counter <= self.num_round and cc > 0:
             cc -= 1
+            rnd = self.start_counter - 1
             if self.profile_dir and rounds_done == 1:
                 import jax
                 jax.profiler.start_trace(self.profile_dir)
                 profiling = True
             if not self.silent:
-                print("update round %d" % (self.start_counter - 1))
-            sample_counter = 0
-            self.net_trainer.start_round(self.start_counter)
-            self.itr_train.before_first()
-            # input-starvation probe: time spent blocked on the input
-            # pipeline (next+value) vs in the device step. The reference
-            # treats feed overlap as a design axis (thread_buffer.h:22);
-            # this is the number that says whether the loader keeps up.
-            t_input = t_step = 0.0
-            n_img = 0
-            while True:
-                t0 = time.perf_counter()
-                if not self.itr_train.next():
-                    break
-                batch = self.itr_train.value()
-                t1 = time.perf_counter()
-                t_input += t1 - t0
-                if self.test_io == 0:
-                    self.net_trainer.update(batch)
-                    t_step += time.perf_counter() - t1
-                n_img += batch.batch_size - batch.num_batch_padd
-                sample_counter += 1
-                if sample_counter % self.print_step == 0 and not self.silent:
-                    print("round %8d:[%8d] %.0f sec elapsed" %
-                          (self.start_counter - 1, sample_counter,
-                           time.time() - start))
+                print("update round %d" % rnd)
+            with telemetry.span("round", round=rnd):
+                stats = self._train_one_round(start)
+            t_input, t_step, t_eval, t_ckpt, n_img = stats
             wall = t_input + t_step
             if self.test_io != 0:
                 print("round %d: io-only %.1f images/sec" %
-                      (self.start_counter - 1,
-                       n_img / t_input if t_input > 0 else 0.0))
+                      (rnd, n_img / t_input if t_input > 0 else 0.0))
             elif not self.silent and wall > 0:
                 print("round %d: input-wait %.1f%% (io %.1f img/s when "
                       "blocked, step %.1f img/s)" %
-                      (self.start_counter - 1, 100.0 * t_input / wall,
+                      (rnd, 100.0 * t_input / wall,
                        n_img / t_input if t_input > 0 else float("inf"),
                        n_img / t_step if t_step > 0 else float("inf")))
-            if self.test_io == 0:
-                sys.stderr.write("[%d]" % self.start_counter)
-                if not self.itr_evals:
-                    sys.stderr.write(self.net_trainer.evaluate(None, "train"))
-                for itr, nm in zip(self.itr_evals, self.eval_names):
-                    sys.stderr.write(self.net_trainer.evaluate(itr, nm))
-                sys.stderr.write("\n")
-                sys.stderr.flush()
-            self._save_model()
+            if telemetry.enabled():
+                # the per-round breakdown as ONE structured event (the
+                # telemetry-backed form of the prints above; per-batch
+                # io.wait / train.step spans carry the fine grain)
+                telemetry.event({
+                    "ev": "round", "round": rnd, "images": n_img,
+                    "input_wait_s": round(t_input, 6),
+                    "step_s": round(t_step, 6),
+                    "eval_s": round(t_eval, 6),
+                    "checkpoint_s": round(t_ckpt, 6)})
+                telemetry.sample_device_memory()
+                telemetry.flush()
             rounds_done += 1
             if profiling:
                 import jax
@@ -368,6 +368,82 @@ class LearnTask:
                     print("profiler trace written to %s" % self.profile_dir)
         if not self.silent:
             print("updating end, %.0f sec in all" % (time.time() - start))
+
+    def _train_one_round(self, start: float):
+        """One pass over itr_train + eval + checkpoint. Returns the round
+        breakdown (input-wait, step, eval, checkpoint seconds, images) —
+        the input-starvation probe the reference treats as a design axis
+        (thread_buffer.h:22): time blocked on the input pipeline
+        (next+value) vs in the device step is the number that says
+        whether the loader keeps up."""
+        sample_counter = 0
+        self.net_trainer.start_round(self.start_counter)
+        self.itr_train.before_first()
+        t_input = t_step = t_eval = t_ckpt = 0.0
+        n_img = 0
+        while True:
+            t0 = time.perf_counter()
+            if not self.itr_train.next():
+                break
+            batch = self.itr_train.value()
+            t1 = time.perf_counter()
+            t_input += t1 - t0
+            # span recorded post hoc so the terminal (exhausted) next()
+            # never shows up as an io.wait — the span totals match the
+            # round event's input_wait_s exactly
+            telemetry.span_event("io.wait", t0, t1 - t0)
+            if self.test_io == 0:
+                self.net_trainer.update(batch)
+                t_step += time.perf_counter() - t1
+            n_img += batch.batch_size - batch.num_batch_padd
+            sample_counter += 1
+            if sample_counter % self.print_step == 0 and not self.silent:
+                print("round %8d:[%8d] %.0f sec elapsed" %
+                      (self.start_counter - 1, sample_counter,
+                       time.time() - start))
+        if self.test_io == 0:
+            t0 = time.perf_counter()
+            sys.stderr.write("[%d]" % self.start_counter)
+            if not self.itr_evals:
+                with telemetry.span("eval", dataset="train"):
+                    sys.stderr.write(self.net_trainer.evaluate(None, "train"))
+            for itr, nm in zip(self.itr_evals, self.eval_names):
+                with telemetry.span("eval", dataset=nm):
+                    sys.stderr.write(self.net_trainer.evaluate(itr, nm))
+            sys.stderr.write("\n")
+            sys.stderr.flush()
+            t_eval = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        with telemetry.span("checkpoint"):
+            self._save_model()
+        t_ckpt = time.perf_counter() - t0
+        return t_input, t_step, t_eval, t_ckpt, n_img
+
+    @staticmethod
+    def _print_telemetry_summary(summary: dict) -> None:
+        """End-of-run telemetry table: top spans by total time, compile
+        cost, counters — the at-a-glance per-phase breakdown."""
+        spans = summary.get("spans", {})
+        print("---- telemetry summary ----")
+        if spans:
+            print("%-18s %7s %10s %9s %9s %9s" %
+                  ("span", "count", "total_s", "p50_ms", "p99_ms",
+                   "max_ms"))
+            for name, a in sorted(spans.items(),
+                                  key=lambda kv: -kv[1]["total_s"])[:12]:
+                print("%-18s %7d %10.3f %9.2f %9.2f %9.2f" %
+                      (name, a["count"], a["total_s"], a["p50_ms"],
+                       a["p99_ms"], a["max_ms"]))
+        comp = summary.get("compiles", {})
+        if comp.get("count"):
+            print("compiles: %d (%.2fs) %s" %
+                  (comp["count"], comp["total_s"],
+                   " ".join("%s=%d" % kv
+                            for kv in sorted(comp["by_cause"].items()))))
+        for name, v in sorted(summary.get("counters", {}).items()):
+            print("counter %-24s %s" % (name, v))
+        for name, v in sorted(summary.get("gauges", {}).items()):
+            print("gauge   %-24s %s" % (name, v))
 
     def task_predict(self) -> None:
         assert self.itr_pred is not None, \

@@ -30,6 +30,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..layer.base import check
 from ..updater import create_updater
 from ..utils import serializer
+from ..utils import telemetry
 from ..utils.metric import MetricSet
 from .. import parallel
 from .config import NetConfig
@@ -93,6 +94,10 @@ class Trainer:
         self._metric_accum = None   # on-device (n_metrics, 2) stat sums
         self._rng_counter = 0
         self._jit_cache: Dict = {}
+        # telemetry: program keys ever built, surviving _jit_cache.clear()
+        # — a recompile of a PREVIOUSLY seen key is a rebuild (donation
+        # path / packing change cleared the cache), not a new signature
+        self._jit_seen_keys = set()
 
     # ------------------------------------------------------------------
     # configuration (reference SetParam, nnet_impl-inl.hpp:31-69)
@@ -328,7 +333,7 @@ class Trainer:
                       "metric: unknown node name %s" % nm)
                 self.eval_nodes.append(self.net_cfg.node_name_map[nm])
         self._build_updaters()
-        self._jit_cache.clear()
+        self._clear_jit_cache()
 
     def _build_updaters(self) -> None:
         """One Updater per (connection, weight tag), configured from global +
@@ -504,7 +509,7 @@ class Trainer:
                                 for (i, key, off, shape) in es}
         self._pp_stages = stages
         self.grad_accum = None   # tree structure changed
-        self._jit_cache.clear()
+        self._clear_jit_cache()
 
     def _pp_unpack(self) -> None:
         """Restore canonical per-layer params/opt state (host-side)."""
@@ -518,7 +523,7 @@ class Trainer:
         self._pp_groups = []
         self._pp_gid = None
         self.grad_accum = None   # tree structure changed
-        self._jit_cache.clear()
+        self._clear_jit_cache()
 
     def canonical_params(self):
         """Per-layer params list regardless of the PP packing (the form
@@ -650,7 +655,7 @@ class Trainer:
         self.eval_nodes = [self.net_cfg.param.num_nodes - 1 if nm is None
                            else self.net_cfg.node_name_map[nm]
                            for nm in self.eval_node_names]
-        self._jit_cache.clear()
+        self._clear_jit_cache()
         nbytes = r.read_uint64()
         self.params = self.net.load_model_blob(r.read_raw(nbytes))
         self.net._infer_shapes()
@@ -893,15 +898,41 @@ class Trainer:
         jitted = jax.jit(step, donate_argnums=(0, 1, 2, 3))
         return jitted
 
+    def _clear_jit_cache(self) -> None:
+        """Drop every cached program (packing/layout/model change). The
+        telemetry counter is what the report reads as rebuild pressure;
+        _jit_seen_keys survives so the recompile detector attributes the
+        recompiles to ``rebuild_after_clear``, not new signatures."""
+        if self._jit_cache:
+            telemetry.count("jit.cache_clear")
+        self._jit_cache.clear()
+
+    def _watched_jit(self, key, name: str, build):
+        """Build-or-fetch a jitted program in ``_jit_cache``, wrapped in
+        the telemetry recompile detector. The detector records one compile
+        event per genuinely new (signature, shape) key with its cause:
+        ``new_signature`` (first build of this program key),
+        ``rebuild_after_clear`` (the cache was cleared — packing change /
+        model reload — and a previously seen program recompiles), and
+        ``shape_change`` (same program, new input shapes/shardings)."""
+        if key not in self._jit_cache:
+            cause = ("rebuild_after_clear" if key in self._jit_seen_keys
+                     else "new_signature")
+            self._jit_seen_keys.add(key)
+            self._jit_cache[key] = telemetry.jit_watch(build(), name,
+                                                       cause=cause)
+        return self._jit_cache[key]
+
     def _get_step(self, do_update: bool, accumulate: bool,
                   with_accum: bool, with_stats: bool):
         k = ("train", do_update, accumulate, with_accum, with_stats)
-        if k not in self._jit_cache:
-            self._jit_cache[k] = self._make_train_step(
-                do_update, accumulate, with_accum, with_stats)
-        return self._jit_cache[k]
+        return self._watched_jit(
+            k, "jit.train_step",
+            lambda: self._make_train_step(do_update, accumulate,
+                                          with_accum, with_stats))
 
     def _shard_batch(self, arr):
+        telemetry.count("io.h2d_bytes", int(getattr(arr, "nbytes", 0) or 0))
         if self.mesh is None:
             return jnp.asarray(arr)
         sh = parallel.batch_sharding(self.mesh)
@@ -945,8 +976,9 @@ class Trainer:
         with_stats = self.eval_train != 0 and len(self.train_metric) > 0
         step = self._get_step(need_update, accumulate, with_accum,
                               with_stats)
-        data = self._shard_batch(batch.data)
-        label = self._shard_batch(batch.label)
+        with telemetry.span("train.h2d"):
+            data = self._shard_batch(batch.data)
+            label = self._shard_batch(batch.label)
         if with_accum and self.grad_accum is None:
             self.grad_accum = jax.tree.map(
                 lambda x: jnp.zeros_like(x),
@@ -954,11 +986,21 @@ class Trainer:
         if with_stats and self._metric_accum is None:
             self._metric_accum = jnp.zeros(
                 (len(self.train_metric), 2), jnp.float32)
-        self.params, self.opt_state, self.grad_accum, self._metric_accum = \
-            step(self.params, self.opt_state, self.grad_accum,
-                 self._metric_accum, data, label,
-                 jnp.asarray(self.epoch_counter, jnp.int32),
-                 self._next_rng())
+        # the span covers DISPATCH (plus any trace+compile, which the
+        # jit watch separates out) — execution is async; the input-wait
+        # fraction the train loop reports is what exposes device stalls
+        with telemetry.span("train.step"):
+            self.params, self.opt_state, self.grad_accum, \
+                self._metric_accum = \
+                step(self.params, self.opt_state, self.grad_accum,
+                     self._metric_accum, data, label,
+                     jnp.asarray(self.epoch_counter, jnp.int32),
+                     self._next_rng())
+        if telemetry.enabled():
+            telemetry.count("train.images",
+                            batch.batch_size - batch.num_batch_padd)
+            if need_update and with_accum:
+                telemetry.count("train.accum_flush")
         self.sample_counter += 1
         if self.sample_counter >= self.update_period:
             self.sample_counter = 0
@@ -1002,16 +1044,61 @@ class Trainer:
         if dp is not None and dp[0] is old:
             self._decode_params = (new_params, dp[1])
 
+    def _recover_donated_params(self) -> None:
+        """Failure path for programs that donate the AUTHORITATIVE
+        self.params (_forward_nodes / predict_device): if the jitted eval
+        died at execute time (OOM, runtime error) AFTER consuming the
+        donated buffers, the trainer would otherwise be left permanently
+        on deleted arrays. Mirror the decode paths' recovery: rebuild from
+        the decode cache's canonical copy when one is keyed to this exact
+        params list, else mark params unusable with a clear error (the
+        caller sees the original exception chained)."""
+        params = self.params
+        if params is None:
+            return
+        try:
+            deleted = any(
+                bool(getattr(v, "is_deleted", None) and v.is_deleted())
+                for p in params for v in p.values())
+        except Exception:
+            deleted = True
+        if not deleted:
+            return      # trace-time failure: donation never happened
+        telemetry.count("eval.params_donation_loss")
+        dp = getattr(self, "_decode_params", None)
+        if dp is not None and dp[0] is params and self._pp_entries is None:
+            # host round trip through the decode copy, then re-place with
+            # the training shardings
+            self._decode_params = None
+            self.params = [
+                {k: jnp.asarray(np.asarray(parallel.fetch_global(v)))
+                 for k, v in p.items()} for p in dp[1]]
+            self._place_params()
+            return
+        self.params = None
+        self._decode_params = None
+        raise RuntimeError(
+            "eval program failed after donating self.params; the device "
+            "buffers are consumed and no canonical copy exists — reload "
+            "the model (load_model) before continuing")
+
     def _forward_nodes(self, batch, node_ids: Tuple[int, ...]):
         """Jitted eval forward returning the requested nodes."""
         k = ("fwd", node_ids)
-        if k not in self._jit_cache:
+
+        def build():
             def fwd(params, data, rng):
                 return self._eval_values(params, data, rng, node_ids), params
-            self._jit_cache[k] = jax.jit(fwd, donate_argnums=(0,))
+            return jax.jit(fwd, donate_argnums=(0,))
+
+        prog = self._watched_jit(k, "jit.eval_fwd", build)
         data = self._shard_batch(batch.data)
-        outs, new_params = self._jit_cache[k](
-            self.params, data, self._next_rng())
+        try:
+            with telemetry.span("eval.forward"):
+                outs, new_params = prog(self.params, data, self._next_rng())
+        except Exception:
+            self._recover_donated_params()
+            raise
         self._swap_params(new_params)
         if jax.process_count() > 1:
             # outputs are sharded over the GLOBAL mesh: a plain np.asarray
@@ -1033,17 +1120,24 @@ class Trainer:
         nnet_impl-inl.hpp:186-299 — the transform runs on device here)."""
         node = self.net_cfg.param.num_nodes - 1
         k = ("pred", node)
-        if k not in self._jit_cache:
+
+        def build():
             def prog(params, data, rng):
                 out = self._eval_values(params, data, rng, (node,))[0]
                 out = out.reshape(out.shape[0], -1)
                 if out.shape[1] != 1:
                     return jnp.argmax(out, axis=1).astype(jnp.float32), params
                 return out[:, 0], params
-            self._jit_cache[k] = jax.jit(prog, donate_argnums=(0,))
+            return jax.jit(prog, donate_argnums=(0,))
+
+        fn = self._watched_jit(k, "jit.predict", build)
         data = self._shard_batch(batch.data)
-        pred, new_params = self._jit_cache[k](
-            self.params, data, self._next_rng())
+        try:
+            with telemetry.span("predict"):
+                pred, new_params = fn(self.params, data, self._next_rng())
+        except Exception:
+            self._recover_donated_params()
+            raise
         self._swap_params(new_params)
         return pred
 
@@ -1122,6 +1216,12 @@ class Trainer:
         key = ("decode", b)
         if getattr(self, "_decode_net", None) is None \
                 or self._decode_net[0] != key:
+            if getattr(self, "_decode_net", None) is not None:
+                # batch-signature change drops every decode program
+                telemetry.count("decode.cache_drop")
+                self._decode_cause = "decode_cache_drop"
+            else:
+                self._decode_cause = "new_signature"
             self._decode_net = (key, self._seq_net(b, 1))
             self._prefill_nets = {}
             self._decode_fns = {}
@@ -1204,7 +1304,9 @@ class Trainer:
                 # the decode copy runtime-resident across serving calls
                 return toks, params
 
-            self._decode_fns[fkey] = jax.jit(run, donate_argnums=(0,))
+            self._decode_fns[fkey] = telemetry.jit_watch(
+                jax.jit(run, donate_argnums=(0,)), "jit.decode",
+                cause=getattr(self, "_decode_cause", "new_signature"))
         toks0 = np.zeros((b, l_max), np.int32)
         toks0[:, :max_p] = prompts
         # (padding beyond a ragged row's real prompt is never read: the
@@ -1212,13 +1314,15 @@ class Trainer:
         # later column a step reads was either a real prompt token or
         # place()-written at the previous step)
         try:
-            toks_dev, new_dparams = self._decode_fns[fkey](
-                params, jnp.asarray(toks0), jax.random.PRNGKey(seed),
-                jnp.asarray(lens))
+            with telemetry.span("decode.generate", new_tokens=n_new):
+                toks_dev, new_dparams = self._decode_fns[fkey](
+                    params, jnp.asarray(toks0), jax.random.PRNGKey(seed),
+                    jnp.asarray(lens))
         except Exception:
             # the donated decode copy may be consumed even on failure —
             # drop the cache so the next call regathers from self.params
             self._decode_params = None
+            telemetry.count("decode.cache_drop")
             raise
         self._decode_params = (self._decode_params[0], new_dparams)
         toks = np.asarray(toks_dev)
@@ -1237,6 +1341,7 @@ class Trainer:
         pin the previous params in device memory.)"""
         if getattr(self, "_decode_params", None) is None \
                 or self._decode_params[0] is not self.params:
+            telemetry.count("decode.param_regather")
             canon = [
                 {k: jnp.asarray(np.asarray(parallel.fetch_global(v)))
                  for k, v in p.items()}
@@ -1412,15 +1517,18 @@ class Trainer:
                 # params donated-and-returned: see _swap_params
                 return jnp.take(hist, rows, axis=0), scores, params
 
-            self._beam_fns[fkey] = jax.jit(run, donate_argnums=(0,))
+            self._beam_fns[fkey] = telemetry.jit_watch(
+                jax.jit(run, donate_argnums=(0,)), "jit.beam_decode")
         toks0 = np.zeros((b, l_max), np.int32)
         toks0[:, :plen] = prompts
         try:
-            hist, _, new_dparams = self._beam_fns[fkey](params,
-                                                        jnp.asarray(toks0))
+            with telemetry.span("decode.beam", beam=B):
+                hist, _, new_dparams = self._beam_fns[fkey](
+                    params, jnp.asarray(toks0))
         except Exception:
             # donated decode copy may be consumed even on failure
             self._decode_params = None
+            telemetry.count("decode.cache_drop")
             raise
         self._decode_params = (self._decode_params[0], new_dparams)
         return np.asarray(hist)[:, plen:total]

@@ -62,3 +62,14 @@ def shard_map(f, mesh, in_specs, out_specs):
     kwargs = {_CHECK_KW: False} if _CHECK_KW else {}
     return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                       **kwargs)
+
+
+def axis_size(axis_name):
+    """Named-axis size inside a shard_map/pmap body. ``lax.axis_size``
+    only exists in newer jax; older versions use the psum-of-1 idiom,
+    which the tracer statically evaluates to a concrete python int (so
+    ring step counts / perm tables built from it stay static)."""
+    from jax import lax
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)

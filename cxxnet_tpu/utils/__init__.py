@@ -8,6 +8,7 @@ TPU-native counterpart of the reference's src/utils/ module
 from .config import ConfigIterator, parse_config_string, parse_config_file  # noqa: F401
 from .metric import MetricSet, create_metric  # noqa: F401
 from . import serializer  # noqa: F401
+from . import telemetry  # noqa: F401
 
 
 def enable_compile_cache(path=None):

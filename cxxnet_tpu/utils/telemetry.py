@@ -1,0 +1,558 @@
+"""Process-wide structured telemetry: spans, counters, recompile detection.
+
+The reference reported progress with bare printfs per round
+(src/cxxnet_main.cpp:330-360); production training systems stand on
+first-class runtime instrumentation (TF's system paper, arxiv 1605.08695)
+and per-region timing is what drives every subsequent optimization (TVM,
+arxiv 1802.04799). This module is that measurement substrate:
+
+* **span timers** — ``with telemetry.span("io.decode"):`` records wall time
+  per named region; spans nest (a per-thread stack tracks depth/parent) and
+  are safe to emit from worker threads (the decode pool, the prefetcher).
+* **counters / gauges** — ``telemetry.count("train.images", n)`` accumulates
+  monotonically; ``telemetry.gauge("device.bytes_in_use", v)`` records the
+  latest value of a level. ``sample_device_memory()`` snapshots the
+  accelerator's allocator stats where the backend exposes them.
+* **recompile detector** — ``jit_watch(fn, name, cause=...)`` wraps a jitted
+  callable and records a ``compile`` event (with its cause and compile
+  seconds) whenever the underlying jit cache grows: exactly once per
+  genuinely new (signature, shape) key, never on cache hits.
+
+Sinks:
+
+* a JSONL run log (one event per line; ``enable(path)``), flushed
+  incrementally so a crashed run still leaves its telemetry behind;
+* a Chrome-trace / Perfetto JSON export built from the span tree
+  (``write_chrome_trace`` or ``chrome_trace``), loadable in
+  chrome://tracing or https://ui.perfetto.dev;
+* an aggregate ``summary()`` dict (per-span totals, counters, compiles,
+  step-time percentiles) — printed by learn_task at end of run and
+  attached to bench.py's emitted JSON.
+
+Disabled (the default) the module is near-zero overhead: ``span()`` returns
+a shared no-op context manager (no allocation), counters are one branch,
+and no events are ever buffered. Everything is process-global by design —
+one training job per process (the Trainer model), one telemetry stream.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+__all__ = [
+    "enable", "disable", "enabled", "reset", "span", "count", "gauge",
+    "event", "record_compile", "jit_watch", "sample_device_memory",
+    "flush", "finish", "summary", "brief_summary", "events",
+    "span_event", "percentile", "count_by",
+    "chrome_trace", "events_to_chrome", "write_chrome_trace",
+]
+
+# per-span-name duration history kept for live percentiles (the JSONL log
+# keeps everything; this only bounds in-memory state on week-long runs)
+_DUR_CAP = 8192
+# in-memory event buffer bound when NO log sink drains it (bench/library
+# mode): oldest events drop past this; aggregates (summary) are unaffected
+_PENDING_CAP = 65536
+
+
+class _NullSpan:
+    """Shared no-op context manager returned by span() when disabled."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("reg", "name", "attrs", "t0", "depth")
+
+    def __init__(self, reg: "_Registry", name: str, attrs):
+        self.reg = reg
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        stack = self.reg._stack()
+        self.depth = len(stack)
+        stack.append(self.name)
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dur = time.perf_counter() - self.t0
+        stack = self.reg._stack()
+        if stack and stack[-1] is self.name:
+            stack.pop()
+        self.reg._record_span(self.name, self.t0, dur, self.depth,
+                              self.attrs)
+        return False
+
+
+class _Registry:
+    """The process-wide telemetry state. Use the module-level functions;
+    the class exists so tests can build isolated instances."""
+
+    def __init__(self):
+        self.enabled = False
+        self.log_path: Optional[str] = None
+        self._log_f: Optional[io.TextIOBase] = None
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self.reset()
+
+    # -- lifecycle -----------------------------------------------------
+    def reset(self) -> None:
+        with self._lock:
+            self._pending: List[dict] = []
+            self.counters: Dict[str, float] = {}
+            self.gauges: Dict[str, float] = {}
+            self.span_agg: Dict[str, list] = {}   # name -> [n, total, max]
+            self.span_durs: Dict[str, deque] = {}
+            self.compiles: List[dict] = []
+            self._flushed_counters: Dict[str, float] = {}
+            self.t0_perf = time.perf_counter()
+            self.t0_wall = time.time()
+
+    def enable(self, log_path: Optional[str] = None) -> None:
+        self.reset()
+        self.log_path = log_path or None
+        if self._log_f is not None:
+            self._log_f.close()
+            self._log_f = None
+        if self.log_path:
+            d = os.path.dirname(os.path.abspath(self.log_path))
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._log_f = open(self.log_path, "w")
+        self.enabled = True
+        self.record({"ev": "meta", "pid": os.getpid(),
+                     "t0_wall": self.t0_wall})
+
+    def disable(self) -> None:
+        self.enabled = False
+        if self._log_f is not None:
+            self._log_f.close()
+            self._log_f = None
+        self.log_path = None
+
+    # -- recording -----------------------------------------------------
+    def _stack(self) -> list:
+        s = getattr(self._tls, "stack", None)
+        if s is None:
+            s = self._tls.stack = []
+        return s
+
+    def _ts(self, t_perf: float) -> float:
+        return t_perf - self.t0_perf
+
+    def record(self, ev: dict) -> None:
+        """Append one raw event (already-shaped dict). No-op if disabled."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._append(ev)
+
+    def _append(self, ev: dict) -> None:
+        # lock held. Without a sink nothing drains _pending: bound it so
+        # an enabled-without-log run (bench mode) cannot leak per-step
+        self._pending.append(ev)
+        if self._log_f is None and len(self._pending) > _PENDING_CAP:
+            del self._pending[: _PENDING_CAP // 2]
+
+    def span(self, name: str, **attrs):
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, attrs or None)
+
+    def span_event(self, name: str, start_perf: float, dur: float,
+                   **attrs) -> None:
+        """Record a span from explicit perf_counter timings — for call
+        sites that must time regardless of telemetry (the train loop's
+        probes) or that only know post hoc whether the interval counts."""
+        if not self.enabled:
+            return
+        self._record_span(name, start_perf, dur, len(self._stack()),
+                          attrs or None)
+
+    def _record_span(self, name, t0, dur, depth, attrs) -> None:
+        if not self.enabled:     # disabled mid-span: drop silently
+            return
+        ev = {"ev": "span", "name": name, "ts": round(self._ts(t0), 6),
+              "dur": round(dur, 6), "depth": depth,
+              "tid": threading.get_ident()}
+        if attrs:
+            ev.update(attrs)
+        with self._lock:
+            self._append(ev)
+            agg = self.span_agg.get(name)
+            if agg is None:
+                agg = self.span_agg[name] = [0, 0.0, 0.0]
+                self.span_durs[name] = deque(maxlen=_DUR_CAP)
+            agg[0] += 1
+            agg[1] += dur
+            if dur > agg[2]:
+                agg[2] = dur
+            self.span_durs[name].append(dur)
+
+    def count(self, name: str, n=1) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def gauge(self, name: str, value) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self.gauges[name] = value
+            self._append(
+                {"ev": "gauge", "name": name, "value": value,
+                 "ts": round(self._ts(time.perf_counter()), 6)})
+
+    def record_compile(self, name: str, cause: str, seconds: float,
+                       key=None) -> None:
+        if not self.enabled:
+            return
+        ev = {"ev": "compile", "name": name, "cause": cause,
+              "dur": round(seconds, 6),
+              "ts": round(self._ts(time.perf_counter()) - seconds, 6),
+              "tid": threading.get_ident()}
+        if key is not None:
+            ev["key"] = str(key)
+        with self._lock:
+            self._append(ev)
+            self.compiles.append(ev)
+
+    # -- sinks ---------------------------------------------------------
+    def flush(self) -> None:
+        """Write pending events to the JSONL log (if one is attached),
+        plus a counters snapshot when any counter moved since the last
+        flush — so a crashed run keeps its counters too, not only its
+        spans. Without a log path events stay buffered in memory (the
+        bench / library mode — summary() and chrome_trace() read them
+        there)."""
+        if self._log_f is None:
+            return
+        with self._lock:
+            batch, self._pending = self._pending, []
+            counters = None
+            if self.counters != self._flushed_counters:
+                counters = dict(self.counters)
+                self._flushed_counters = dict(counters)
+        for ev in batch:
+            self._log_f.write(json.dumps(ev) + "\n")
+        if counters is not None:
+            self._log_f.write(json.dumps(
+                {"ev": "counters", "counters": counters,
+                 "ts": round(self._ts(time.perf_counter()), 6)}) + "\n")
+        self._log_f.flush()
+
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._pending)
+
+    def summary(self) -> dict:
+        """Aggregate view: per-span totals, counters, gauges, compiles,
+        and p50/p90/p99 duration percentiles per span name."""
+        with self._lock:
+            spans = {}
+            for name, (n, total, mx) in self.span_agg.items():
+                durs = sorted(self.span_durs[name])
+                spans[name] = {
+                    "count": n, "total_s": round(total, 6),
+                    "mean_ms": round(1e3 * total / n, 4),
+                    "max_ms": round(1e3 * mx, 4),
+                    "p50_ms": round(1e3 * percentile(durs, 50), 4),
+                    "p90_ms": round(1e3 * percentile(durs, 90), 4),
+                    "p99_ms": round(1e3 * percentile(durs, 99), 4),
+                }
+            return {
+                "spans": spans,
+                "counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+                "compiles": {
+                    "count": len(self.compiles),
+                    "total_s": round(sum(c["dur"] for c in self.compiles),
+                                     6),
+                    "by_cause": count_by(self.compiles, "cause"),
+                    "by_name": count_by(self.compiles, "name"),
+                },
+            }
+
+    def brief_summary(self, top: int = 8) -> dict:
+        """Compact per-phase breakdown for embedding in one-line JSON
+        (the bench.py contract): top spans by total time + compile cost."""
+        s = self.summary()
+        ranked = sorted(s["spans"].items(),
+                        key=lambda kv: -kv[1]["total_s"])[:top]
+        out = {"spans": {name: {"count": a["count"],
+                                "total_s": a["total_s"],
+                                "p50_ms": a["p50_ms"]}
+                         for name, a in ranked},
+               "compiles": s["compiles"]["count"],
+               "compile_s": s["compiles"]["total_s"]}
+        if s["counters"]:
+            out["counters"] = s["counters"]
+        return out
+
+    def finish(self, close: bool = False) -> Optional[dict]:
+        """Record the end-of-run summary event, flush the log, and (with a
+        log path) write the Chrome-trace export next to it. Returns the
+        summary dict (None if disabled)."""
+        if not self.enabled:
+            return None
+        s = self.summary()
+        if self.log_path:
+            self.flush()   # drain events + counters snapshot first, so
+            #                the summary below stays the log's last line
+        self.record({"ev": "summary", "summary": s,
+                     "ts": round(self._ts(time.perf_counter()), 6)})
+        if self.log_path:
+            self.flush()
+            try:
+                self.write_chrome_trace(self.log_path + ".trace.json")
+            except Exception:
+                pass
+        if close:
+            self.disable()
+        return s
+
+    # -- chrome trace ----------------------------------------------------
+    def _all_events(self) -> List[dict]:
+        """Everything recorded so far: the log file's lines (events already
+        flushed) plus the in-memory pending buffer."""
+        evs: List[dict] = []
+        if self.log_path and os.path.exists(self.log_path):
+            if self._log_f is not None:
+                self.flush()
+            with open(self.log_path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        evs.append(json.loads(line))
+            return evs
+        return self.events()
+
+    def chrome_trace(self) -> dict:
+        return events_to_chrome(self._all_events())
+
+    def write_chrome_trace(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+        return path
+
+
+def percentile(sorted_vals: list, p: float) -> float:
+    """Nearest-rank percentile of an ascending-sorted list (shared with
+    tools/telemetry_report.py so live and offline numbers agree)."""
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(round((p / 100.0)
+                                            * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+def count_by(evs: List[dict], key: str) -> Dict[str, int]:
+    """Histogram of ``ev[key]`` over a list of event dicts."""
+    out: Dict[str, int] = {}
+    for e in evs:
+        k = e.get(key, "?")
+        out[k] = out.get(k, 0) + 1
+    return out
+
+
+
+def events_to_chrome(evs: List[dict]) -> dict:
+    """Build a chrome://tracing / Perfetto 'traceEvents' JSON object from a
+    list of telemetry events (live or re-read from a JSONL log). Spans and
+    compiles become complete ('X') events; gauges become counter ('C')
+    tracks. Timestamps are microseconds relative to run start."""
+    trace = []
+    tids = {}
+
+    def tid_of(ev):
+        t = ev.get("tid", 0)
+        if t not in tids:
+            tids[t] = len(tids)
+            trace.append({"ph": "M", "name": "thread_name", "pid": 0,
+                          "tid": tids[t],
+                          "args": {"name": "thread-%d" % tids[t]}})
+        return tids[t]
+
+    for ev in evs:
+        kind = ev.get("ev")
+        if kind == "span":
+            trace.append({
+                "ph": "X", "name": ev["name"], "pid": 0,
+                "tid": tid_of(ev),
+                "ts": round(ev["ts"] * 1e6, 1),
+                "dur": round(ev["dur"] * 1e6, 1),
+            })
+        elif kind == "compile":
+            trace.append({
+                "ph": "X", "name": "compile:" + ev["name"], "pid": 0,
+                "tid": tid_of(ev),
+                "ts": round(max(ev.get("ts", 0.0), 0.0) * 1e6, 1),
+                "dur": round(ev["dur"] * 1e6, 1),
+                "args": {"cause": ev.get("cause", "?")},
+            })
+        elif kind == "gauge":
+            trace.append({
+                "ph": "C", "name": ev["name"], "pid": 0,
+                "ts": round(ev["ts"] * 1e6, 1),
+                "args": {"value": ev.get("value", 0)},
+            })
+    return {"traceEvents": trace, "displayTimeUnit": "ms"}
+
+
+class JitWatch:
+    """Recompile detector: wraps a jitted callable and records a compile
+    event whenever the wrapped jit cache grows — i.e. exactly when XLA
+    traced + compiled for a genuinely new (signature, shape) key, and
+    never on cache hits. The first detected compile is attributed to
+    ``cause`` (what the call site knows: new_signature, rebuild_after_clear,
+    decode_cache_drop); later growth on the same program means the inputs'
+    shapes/shardings changed ("shape_change")."""
+
+    __slots__ = ("_fn", "_name", "_cause_next", "_reg")
+
+    def __init__(self, fn, name: str, cause: str = "new_signature",
+                 registry: Optional[_Registry] = None):
+        self._fn = fn
+        self._name = name
+        self._cause_next = cause
+        self._reg = registry or _REG
+
+    def __call__(self, *args, **kwargs):
+        reg = self._reg
+        if not reg.enabled:
+            return self._fn(*args, **kwargs)
+        try:
+            before = self._fn._cache_size()
+        except Exception:
+            before = None
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        if before is not None:
+            try:
+                grew = self._fn._cache_size() > before
+            except Exception:
+                grew = False
+            if grew:
+                reg.record_compile(self._name, self._cause_next, dt)
+                self._cause_next = "shape_change"
+        return out
+
+    def __getattr__(self, name):
+        # forward lower()/trace()/cache introspection to the jitted fn
+        return getattr(self._fn, name)
+
+
+# ----------------------------------------------------------------------
+# module-level singleton surface
+_REG = _Registry()
+
+
+def enable(log_path: Optional[str] = None) -> None:
+    _REG.enable(log_path)
+
+
+def disable() -> None:
+    _REG.disable()
+
+
+def enabled() -> bool:
+    return _REG.enabled
+
+
+def reset() -> None:
+    _REG.reset()
+
+
+def span(name: str, **attrs):
+    return _REG.span(name, **attrs)
+
+
+def span_event(name: str, start_perf: float, dur: float, **attrs) -> None:
+    _REG.span_event(name, start_perf, dur, **attrs)
+
+
+def count(name: str, n=1) -> None:
+    _REG.count(name, n)
+
+
+def gauge(name: str, value) -> None:
+    _REG.gauge(name, value)
+
+
+def event(ev: dict) -> None:
+    _REG.record(ev)
+
+
+def record_compile(name: str, cause: str, seconds: float, key=None) -> None:
+    _REG.record_compile(name, cause, seconds, key)
+
+
+def jit_watch(fn, name: str, cause: str = "new_signature") -> JitWatch:
+    return JitWatch(fn, name, cause=cause)
+
+
+def flush() -> None:
+    _REG.flush()
+
+
+def finish(close: bool = False) -> Optional[dict]:
+    return _REG.finish(close=close)
+
+
+def summary() -> dict:
+    return _REG.summary()
+
+
+def brief_summary(top: int = 8) -> dict:
+    return _REG.brief_summary(top=top)
+
+
+def events() -> List[dict]:
+    return _REG.events()
+
+
+def chrome_trace() -> dict:
+    return _REG.chrome_trace()
+
+
+def write_chrome_trace(path: str) -> str:
+    return _REG.write_chrome_trace(path)
+
+
+def sample_device_memory() -> Optional[dict]:
+    """Record the first local device's allocator stats as gauges (device
+    memory high-water). Backends without memory_stats (CPU, some tunneled
+    runtimes) make this a silent no-op."""
+    if not _REG.enabled:
+        return None
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+        if k in stats:
+            gauge("device." + k, int(stats[k]))
+    return stats

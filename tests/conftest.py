@@ -25,3 +25,12 @@ jax.config.update("jax_default_matmul_precision", "highest")
 
 assert len(jax.devices()) == 8, (
     "tests require 8 virtual CPU devices, got %s" % jax.devices())
+
+
+def pytest_configure(config):
+    # register the tier split: tier-1 verify runs `-m 'not slow'` — fast
+    # tests (telemetry, units, small e2e) must stay unmarked so they ride
+    # in tier-1; long soak/sweep tests opt out with @pytest.mark.slow
+    config.addinivalue_line(
+        "markers", "slow: long-running test excluded from tier-1 verify "
+        "(-m 'not slow')")

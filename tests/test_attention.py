@@ -77,6 +77,7 @@ def test_seq_parallel_matches_single_device(sp_mode, causal):
     np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_seq_parallel_trains():
     # same data as the single-device memorize test: the sharded trainer must
     # reach the same fit (seed-2 data happens to be a hard draw at this eta
@@ -324,6 +325,7 @@ class TestGQAParallelPaths:
         np.testing.assert_allclose(out, self._expanded_ref(q, k, v, True),
                                    rtol=2e-5, atol=2e-6)
 
+    @pytest.mark.slow
     def test_ring_grouped_grads_match(self):
         import numpy as np
         import jax
@@ -366,6 +368,7 @@ class TestGQAParallelPaths:
         np.testing.assert_allclose(out, self._expanded_ref(q, k, v, True),
                                    rtol=2e-5, atol=2e-6)
 
+    @pytest.mark.slow
     def test_ring_flash_grouped_matches_reference(self):
         import os
         import numpy as np

@@ -306,6 +306,7 @@ class TestPipelineParamSharding:
                            n_class=10, fc_dim=64, dropout=0.0,
                            extra_cfg=extra)
 
+    @pytest.mark.slow
     def test_vgg_pp4_shard_bytes_and_step(self):
         import jax
         tr = self._vgg("pipeline_parallel = 4\n")
@@ -585,6 +586,7 @@ momentum = 0.9
             for v in p.values():
                 assert np.isfinite(np.asarray(v, np.float32)).all()
 
+    @pytest.mark.slow
     def test_pp_deep_resnet_trunk_bf16(self):
         """PP at depth on a REAL conv trunk: a 58-layer-deep resnet
         (depths=(7,7,7,7): 28 residual blocks, each 2 convs + BNs, plus
@@ -743,6 +745,7 @@ class TestTransformerPipeline:
                                       dim=16, nhead=2, nlayer=2, dev=dev,
                                       extra_cfg=extra)
 
+    @pytest.mark.slow
     def test_lm_pp_dp_tp_matches_single_device(self):
         tr = self._lm("cpu:0-3", "pipeline_parallel = 2\n")
         tr3 = self._lm("cpu:0-7", "pipeline_parallel = 2\n"
@@ -799,6 +802,7 @@ class TestViTCompose:
                            nhead=4, nlayer=2, ffn_mult=2, batch_size=16,
                            dev=dev, extra_cfg=extra)
 
+    @pytest.mark.slow
     def test_vit_tp_sp_matches_single_device(self):
         tr = self._vit("cpu:0-7",
                        "model_parallel = 2\nseq_parallel = 2\n")
@@ -949,6 +953,7 @@ eta = 0.1
             pytest.skip("backend exposes no memory_analysis")
         return m.temp_size_in_bytes
 
+    @pytest.mark.slow
     def test_pp_temp_bytes_bounded_and_flat_in_micro(self):
         base = self._temp_bytes(self._deep("dev = cpu\n"))
         pp4 = self._temp_bytes(

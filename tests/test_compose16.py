@@ -5,6 +5,7 @@ the 4-axis attention mesh with a REAL data axis — exercised on a
 mesh is pinned to 8)."""
 
 import os
+import pytest
 import subprocess
 import sys
 import textwrap
@@ -94,6 +95,7 @@ def test_pp4_tp2_dp2_matches_single_device():
     assert "OK pp4xtp2xdp2" in out
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_16():
     """The full dryrun at 16 devices: deep-pp tier (pp4 x tp2 x dp2 +
     ZeRO-1) and the 4-axis attention mesh with dp=2."""

@@ -5,6 +5,7 @@ integration tests" strategy (SURVEY.md §4.4): MNIST-format data, the MNIST
 MLP/conv configs, train/continue/pred/extract tasks.
 """
 
+import json
 import os
 import re
 import sys
@@ -285,6 +286,66 @@ def test_test_on_server_consistency(tmp_path, mnist_data):
         arr.shape, NamedSharding(tr.mesh, P()), shards)
     with pytest.raises(ValueError, match="TestSync"):
         tr.check_replica_consistency()
+
+
+def test_telemetry_logged_train_run(tmp_path, mnist_data, capsys):
+    """telemetry_log=<path>: a train run leaves a parseable JSONL log with
+    per-round io.wait/train.step/eval spans, >= 1 recorded compile event,
+    round breakdown events, a final summary event, and a valid
+    Chrome-trace export next to it; the report tool renders it."""
+    from cxxnet_tpu.utils import telemetry
+    log = str(tmp_path / "run.jsonl")
+    conf = write_conf(tmp_path, MLP_CONF, mnist_data, num_round=2)
+    try:
+        run_task(conf, "telemetry_log=%s" % log, "silent=0")
+    finally:
+        telemetry.disable()   # process-global: never leak into other tests
+    out = capsys.readouterr().out
+    assert "telemetry summary" in out       # end-of-run table printed
+    events = [json.loads(l) for l in open(log).read().splitlines()
+              if l.strip()]
+    span_names = {e["name"] for e in events if e["ev"] == "span"}
+    assert {"io.wait", "train.step", "train.h2d", "eval", "checkpoint",
+            "round", "init"} <= span_names
+    compiles = [e for e in events if e["ev"] == "compile"]
+    assert len(compiles) >= 1
+    assert any(e["name"] == "jit.train_step" for e in compiles)
+    rounds = [e for e in events if e["ev"] == "round"]
+    assert len(rounds) == 2
+    for r in rounds:
+        assert r["images"] == 600 and r["step_s"] >= 0
+    assert events[-1]["ev"] == "summary"
+    summ = events[-1]["summary"]
+    assert summ["spans"]["train.step"]["count"] == 12   # 2 rounds x 6
+    assert summ["counters"]["train.images"] == 1200
+    assert summ["counters"]["io.h2d_bytes"] > 0
+    # chrome trace loads as valid JSON with complete events
+    trace = json.load(open(log + ".trace.json"))
+    assert any(t.get("ph") == "X" and t["name"] == "train.step"
+               for t in trace["traceEvents"])
+    # the report tool renders the log
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import telemetry_report
+    assert telemetry_report.main([log]) == 0
+    rep = capsys.readouterr().out
+    assert "train.step" in rep and "rounds" in rep
+
+
+def test_telemetry_disabled_adds_no_events(tmp_path, mnist_data):
+    """Without telemetry_log the same run records nothing: no events are
+    buffered and span() returns the shared no-op (the zero-overhead-when-
+    disabled contract on the per-step hot path)."""
+    from cxxnet_tpu.utils import telemetry
+    telemetry.disable()
+    telemetry.reset()
+    conf = write_conf(tmp_path, MLP_CONF, mnist_data, num_round=1)
+    run_task(conf)
+    assert not telemetry.enabled()
+    assert telemetry.events() == []
+    s = telemetry.summary()
+    assert s["spans"] == {} and s["counters"] == {}
+    assert telemetry.span("x") is telemetry.span("y")
 
 
 def test_train_loop_input_wait_probe(tmp_path, mnist_data, capsys):

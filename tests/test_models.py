@@ -1,3 +1,5 @@
+import pytest
+
 
 
 class TestResNet:
@@ -45,6 +47,7 @@ class TestVGG:
         assert net.node_shapes[cfg.node_name_map["pool5"]] == (2, 512, 7, 7)
         assert net.node_shapes[cfg.node_name_map["out"]] == (2, 1, 1, 1000)
 
+    @pytest.mark.slow
     def test_memorizes_batch_with_remat(self):
         import numpy as np
         from cxxnet_tpu.models import vgg_trainer

@@ -3,6 +3,7 @@ deterministic grammar (exercises embed/attention/add/conv-FFN/seq-softmax
 end to end, incl. the softmax seq=1 loss)."""
 
 import os
+import pytest
 import sys
 
 import numpy as np
@@ -32,6 +33,7 @@ def test_lm_learns_grammar():
     assert after > 0.7, "LM failed to learn the grammar: %.3f" % after
 
 
+@pytest.mark.slow
 def test_lm_pipeline_conf_learns_grammar():
     """lm_pipeline.conf: the composed pp x tp x dp + ZeRO-1 example
     trains the same grammar through the example driver."""

@@ -152,6 +152,7 @@ def test_flash_window_with_skipped_tiles():
                                rtol=3e-4, atol=3e-4)
 
 
+@pytest.mark.slow
 def test_ring_flash_window_with_skipped_blocks():
     """8-device ring at L=1024, window=96: most ring steps hold blocks
     entirely out of window (skipped by the traced tile predicate) and the

@@ -58,8 +58,16 @@ def main():
     print("== decomposition ==", flush=True)
     data = t("_shard_batch(batch.data)", lambda: tr._shard_batch(b.data))
     rng = t("_next_rng()", lambda: tr._next_rng())
-    out = t("jitted pred dispatch (async)",
-            lambda: fn(tr.params, data, rng))
+
+    def run_pred():
+        # the cached program donates arg 0 and returns (pred, params):
+        # unpack and adopt the returned alias each iteration, else the
+        # 2nd call passes already-donated (deleted) buffers
+        pred, new_params = fn(tr.params, data, rng)
+        tr._swap_params(new_params)
+        return pred
+
+    out = t("jitted pred dispatch (async)", run_pred)
     t("float(jnp.sum(out)) sync", lambda: float(jnp.sum(out)))
     t("np.asarray(out) fetch (batch,)", lambda: np.asarray(out))
     print("jit cache sizes after: pred=%s" % (fn._cache_size(),), flush=True)

@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Summarize a telemetry JSONL run log (utils/telemetry.py).
+
+Usage:
+    python tools/telemetry_report.py run.jsonl [--top N] [--trace out.json]
+                                               [--json]
+
+Prints top spans by total time, recompile count/causes/seconds, per-round
+breakdowns, counters/gauges, and step-time percentiles. ``--trace``
+additionally exports a chrome://tracing / Perfetto JSON built from the
+span tree. ``--json`` emits the aggregate as one JSON object instead of
+the table (for scripting).
+
+Exit codes: 0 ok; 1 usage / unreadable file; 2 malformed log (a line
+that is not valid JSON, or no telemetry events at all) — CI gates on
+this so a broken emitter cannot silently pass.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from cxxnet_tpu.utils.telemetry import (  # noqa: E402
+    count_by, events_to_chrome, percentile)
+
+
+def load_events(path):
+    """Parse one-event-per-line JSONL; malformed lines are fatal (exit 2:
+    the log writer is append-only, so a bad line means a broken emitter
+    or a truncated copy — summarizing around it would lie)."""
+    events = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError as e:
+                print("%s:%d: malformed JSONL line: %s"
+                      % (path, lineno, e), file=sys.stderr)
+                sys.exit(2)
+            if not isinstance(ev, dict):
+                print("%s:%d: event is not a JSON object" % (path, lineno),
+                      file=sys.stderr)
+                sys.exit(2)
+            events.append(ev)
+    if not events:
+        print("%s: no telemetry events" % path, file=sys.stderr)
+        sys.exit(2)
+    return events
+
+
+def aggregate(events):
+    spans = {}
+    compiles = []
+    counters = {}
+    gauges = {}
+    rounds = []
+    for ev in events:
+        kind = ev.get("ev")
+        if kind == "span":
+            a = spans.setdefault(ev["name"], [])
+            a.append(float(ev.get("dur", 0.0)))
+        elif kind == "compile":
+            compiles.append(ev)
+        elif kind == "gauge":
+            gauges[ev["name"]] = ev.get("value")
+        elif kind == "round":
+            rounds.append(ev)
+        elif kind == "counters":
+            # periodic snapshot (per-round flush): monotonic, last wins —
+            # a crashed run keeps its counters up to the last flush
+            counters = ev.get("counters", {})
+        elif kind == "summary":
+            counters = ev.get("summary", {}).get("counters", counters)
+    out = {"spans": {}, "compiles": {}, "counters": counters,
+           "gauges": gauges, "rounds": rounds}
+    for name, durs in spans.items():
+        durs.sort()
+        out["spans"][name] = {
+            "count": len(durs),
+            "total_s": round(sum(durs), 6),
+            "p50_ms": round(1e3 * percentile(durs, 50), 4),
+            "p90_ms": round(1e3 * percentile(durs, 90), 4),
+            "p99_ms": round(1e3 * percentile(durs, 99), 4),
+            "max_ms": round(1e3 * (durs[-1] if durs else 0.0), 4),
+        }
+    out["compiles"] = {
+        "count": len(compiles),
+        "total_s": round(sum(float(c.get("dur", 0.0)) for c in compiles), 6),
+        "by_cause": count_by(compiles, "cause"),
+    }
+    return out
+
+
+def print_report(agg, top=15):
+    spans = agg["spans"]
+    print("== top spans by total time ==")
+    print("%-20s %8s %10s %9s %9s %9s %9s" %
+          ("span", "count", "total_s", "p50_ms", "p90_ms", "p99_ms",
+           "max_ms"))
+    for name, a in sorted(spans.items(),
+                          key=lambda kv: -kv[1]["total_s"])[:top]:
+        print("%-20s %8d %10.3f %9.2f %9.2f %9.2f %9.2f" %
+              (name, a["count"], a["total_s"], a["p50_ms"], a["p90_ms"],
+               a["p99_ms"], a["max_ms"]))
+    comp = agg["compiles"]
+    print("\n== recompiles ==")
+    print("count: %d   total: %.2fs" % (comp["count"], comp["total_s"]))
+    for cause, n in sorted(comp["by_cause"].items()):
+        print("  %-24s %d" % (cause, n))
+    step = spans.get("train.step")
+    if step:
+        print("\n== step-time percentiles (train.step dispatch) ==")
+        print("n=%d  p50=%.2fms  p90=%.2fms  p99=%.2fms  max=%.2fms" %
+              (step["count"], step["p50_ms"], step["p90_ms"],
+               step["p99_ms"], step["max_ms"]))
+    if agg["rounds"]:
+        print("\n== rounds ==")
+        print("%6s %9s %12s %9s %9s %9s" %
+              ("round", "images", "input_wait_s", "step_s", "eval_s",
+               "ckpt_s"))
+        for r in agg["rounds"]:
+            print("%6d %9d %12.3f %9.3f %9.3f %9.3f" %
+                  (r.get("round", -1), r.get("images", 0),
+                   r.get("input_wait_s", 0.0), r.get("step_s", 0.0),
+                   r.get("eval_s", 0.0), r.get("checkpoint_s", 0.0)))
+    if agg["counters"]:
+        print("\n== counters ==")
+        for name, v in sorted(agg["counters"].items()):
+            print("  %-28s %s" % (name, v))
+    if agg["gauges"]:
+        print("\n== gauges (last value) ==")
+        for name, v in sorted(agg["gauges"].items()):
+            print("  %-28s %s" % (name, v))
+
+
+def main(argv):
+    top = 15
+    trace_out = None
+    as_json = False
+    paths = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--top" and i + 1 < len(argv):
+            top = int(argv[i + 1])
+            i += 2
+        elif a == "--trace" and i + 1 < len(argv):
+            trace_out = argv[i + 1]
+            i += 2
+        elif a == "--json":
+            as_json = True
+            i += 1
+        elif a.startswith("--"):
+            print("unknown option %s" % a, file=sys.stderr)
+            return 1
+        else:
+            paths.append(a)
+            i += 1
+    if len(paths) != 1:
+        print(__doc__, file=sys.stderr)
+        return 1
+    path = paths[0]
+    if not os.path.exists(path):
+        print("no such log: %s" % path, file=sys.stderr)
+        return 1
+    events = load_events(path)
+    agg = aggregate(events)
+    if as_json:
+        print(json.dumps(agg, indent=1))
+    else:
+        print_report(agg, top=top)
+    if trace_out:
+        with open(trace_out, "w") as f:
+            json.dump(events_to_chrome(events), f)
+        print("\nchrome trace written to %s "
+              "(open in chrome://tracing or ui.perfetto.dev)" % trace_out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
