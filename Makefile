@@ -22,7 +22,7 @@ lib/libcxxnetwrapper.so: wrapper/cxxnet_wrapper.cc wrapper/cxxnet_wrapper.h
 
 bin/test_wrapper_c: wrapper/test_wrapper.c lib/libcxxnetwrapper.so
 	@mkdir -p bin
-	$(CC) -O2 -Wall -o $@ wrapper/test_wrapper.c -Llib -lcxxnetwrapper -Wl,-rpath,'$$ORIGIN/../lib'
+	$(CC) -O2 -Wall -pthread -o $@ wrapper/test_wrapper.c -Llib -lcxxnetwrapper -Wl,-rpath,'$$ORIGIN/../lib'
 
 lib/libcxxnet_tpu_core.so: $(CORE_SRC) $(CORE_HDR)
 	@mkdir -p lib
@@ -41,4 +41,11 @@ test-fast:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
 		-p no:cacheprovider
 
-.PHONY: all clean test-fast
+# fast regression gate (no pytest, no jax): every module byte-compiles and
+# the checkpoint verifier still detects every corruption class — a
+# checkpoint-format regression fails here in seconds
+check:
+	python -m compileall -q cxxnet_tpu tools tests
+	python tools/ckpt_fsck.py --selftest
+
+.PHONY: all clean test-fast check

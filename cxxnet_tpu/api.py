@@ -28,6 +28,7 @@ import numpy as np
 from . import io as io_mod
 from .io.data import DataBatch
 from .nnet import trainer as trainer_mod
+from .utils import checkpoint as ckpt
 from .utils import serializer
 from .utils.config import parse_config_string
 
@@ -127,18 +128,23 @@ class Net:
         self.net_.init_model()
 
     def load_model(self, fname: str) -> None:
-        with open(fname, "rb") as f:
-            r = serializer.Reader(f)
-            self.net_type = r.read_int32()
-            self.net_ = self._create_net()
-            self.net_.load_model(r)
+        # integrity-verified read: CRC-framed files are checked, legacy
+        # footer-less files pass through (checkpoint.read_verified)
+        payload, _ = ckpt.read_verified(fname)
+        r = serializer.Reader(payload)
+        self.net_type = r.read_int32()
+        self.net_ = self._create_net()
+        self.net_.load_model(r)
 
     def save_model(self, fname: str) -> None:
         assert self.net_ is not None, "model not initialized"
-        with open(fname, "wb") as f:
-            w = serializer.Writer(f)
-            w.write_int32(self.net_type)
-            self.net_.save_model(w)
+        w = serializer.Writer()
+        w.write_int32(self.net_type)
+        self.net_.save_model(w)
+        self.net_.save_training_state(w)
+        # durable atomic write with CRC framing: a kill mid-save leaves
+        # the previous file intact, never a torn one
+        ckpt.write_checkpoint(fname, w.f.getbuffer())
 
     def start_round(self, round_counter: int) -> None:
         assert self.net_ is not None, "model not initialized"

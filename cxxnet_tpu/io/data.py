@@ -112,6 +112,15 @@ class IIterator:
     """Iterator ABI (src/io/data.h:18-38): SetParam / Init / BeforeFirst /
     Next / Value."""
 
+    # True when before_first() replays the IDENTICAL batch sequence on a
+    # freshly-constructed iterator (fixed-seed one-shot shuffles, stream
+    # order). Iterators whose order depends on RNG state advanced across
+    # epochs (sliding-window shuffles) set this False in init(); mid-round
+    # checkpoint resume is then approximate — the fast-forward skips a
+    # DIFFERENT prefix — and the driver warns. Wrapper iterators inherit
+    # their chain's stability via the driver's walk over ``.base``.
+    stable_epoch_order = True
+
     def set_param(self, name: str, val: str) -> None:
         pass
 
@@ -130,6 +139,19 @@ class IIterator:
     def close(self) -> None:
         """Release host resources (threads, pools, files). Wrapper
         iterators delegate down the chain; safe to call twice."""
+
+    def skip(self, n: int) -> int:
+        """Fast-forward past ``n`` batches without touching their values —
+        the resume cursor for mid-epoch checkpoint recovery (learn_task
+        replays the round prefix after a preemption). Returns the number
+        actually skipped (< n when the epoch ends early). The default
+        consumes batches through next(), which is correct for every
+        chained/buffered iterator; base iterators with random access
+        override it with an O(1) seek."""
+        k = 0
+        while k < n and self.next():
+            k += 1
+        return k
 
     # python iteration sugar
     def __iter__(self):

@@ -195,6 +195,11 @@ class ImagePageIterator(IIterator):
         # kRandMagic = 121, mirroring the reference's sampler seed
         self._rnd = np.random.RandomState(self.seed_data + 121)
         self._part_order = list(range(len(self.path_imgbin)))
+        # the sliding-window shuffle draws from _rnd on every instance, so
+        # epoch k's order depends on all prior epochs' RNG state — a fresh
+        # process cannot replay it; mid-round checkpoint resume is then
+        # approximate (doc/robustness.md)
+        self.stable_epoch_order = not self.shuffle
         self.before_first()
 
     def _epoch_paths(self):

@@ -95,6 +95,14 @@ class MNISTIterator(IIterator):
     def before_first(self):
         self.loc = 0
 
+    def skip(self, n: int) -> int:
+        """O(1) resume fast-forward: the corpus is RAM-resident, so the
+        cursor just jumps n full batches ahead."""
+        avail = max(0, (self.img.shape[0] - self.loc) // self.batch_size)
+        k = min(int(n), avail)
+        self.loc += k * self.batch_size
+        return k
+
     def next(self) -> bool:
         if self.loc + self.batch_size <= self.img.shape[0]:
             self.out = DataBatch()

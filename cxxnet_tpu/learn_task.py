@@ -17,6 +17,7 @@ import numpy as np
 
 from .io import create_iterator
 from .nnet.trainer import Trainer, create_net
+from .utils import checkpoint as ckpt
 from .utils import serializer
 from .utils import telemetry
 from .utils.config import ConfigIterator
@@ -50,6 +51,27 @@ class LearnTask:
         self.max_round = 1 << 31
         self.continue_training = 0
         self.save_period = 1
+        # checkpoint robustness knobs (doc/robustness.md): retention
+        # (ckpt_keep_last=N keeps the newest N numbered checkpoints,
+        # ckpt_keep_every=K additionally keeps every K-th as a long-horizon
+        # anchor; 0 = keep all, the reference behavior), IO retries with
+        # exponential backoff for flaky NFS/GCS-fuse mounts, durable
+        # fsync (ckpt_fsync=0 trades durability for test speed), and the
+        # SIGTERM/SIGINT emergency-checkpoint handler (preempt_save=0
+        # restores the default die-on-signal behavior)
+        self.ckpt_keep_last = 0
+        self.ckpt_keep_every = 0
+        self.ckpt_retries = 2
+        self.ckpt_fsync = 1
+        self.preempt_save = 1
+        # resume cursor recovered from a checkpoint's training-state
+        # section: applied right before the train loop (after the
+        # continue-path eval, which must not consume the restored rng)
+        self._resume_state = None
+        self._resume_batches = 0
+        self._preempt: Optional[ckpt.PreemptionGuard] = None
+        self._preempt_noted = False
+        self._stop_training = False
         self.name_model_in = "NULL"
         self.name_pred = "pred.txt"
         self.print_step = 100
@@ -153,6 +175,16 @@ class LearnTask:
             self.profile_dir = val
         if name == "telemetry_log":
             self.telemetry_log = val
+        if name == "ckpt_keep_last":
+            self.ckpt_keep_last = int(val)
+        if name == "ckpt_keep_every":
+            self.ckpt_keep_every = int(val)
+        if name == "ckpt_retries":
+            self.ckpt_retries = int(val)
+        if name == "ckpt_fsync":
+            self.ckpt_fsync = int(val)
+        if name == "preempt_save":
+            self.preempt_save = int(val)
         if name == "coordinator":
             self.coordinator = val
         if name == "num_worker":
@@ -206,21 +238,95 @@ class LearnTask:
         return os.path.join(self.name_model_dir, "%04d.model" % counter)
 
     def _sync_latest_model(self) -> int:
-        """Scan model_dir for the newest %04d.model (reference :135-157)."""
-        s_counter = self.start_counter
-        last = None
-        while os.path.exists(self._model_path(s_counter)):
-            last = self._model_path(s_counter)
-            s_counter += 1
-        if last is None:
-            return 0
-        with open(last, "rb") as f:
-            r = serializer.Reader(f)
-            self.net_type = r.read_int32()
-            self.net_trainer = self._create_net()
-            self.net_trainer.load_model(r)
-        self.start_counter = s_counter
-        return 1
+        """Find and load the newest VALID checkpoint in model_dir.
+
+        Replaces the reference's stop-at-first-hole scan (:135-157), which
+        silently restarted from scratch whenever save_period > 1 left gaps
+        in the numbering. This scan lists every <counter>.model (gaps
+        fine), ranks an emergency (mid-round preemption) checkpoint by the
+        progress recorded in its training-state section, verifies CRC
+        framing and a full parse newest-first, quarantines anything
+        corrupt to <name>.corrupt, and falls back to the next-newest valid
+        file — a torn or bit-flipped checkpoint costs at most one save
+        interval, never the run."""
+        d = self.name_model_dir
+        # candidates: (progress = (resume_counter, batches_done), path,
+        # prefetched payload or None). A numbered checkpoint c resumes at
+        # (c + 1, 0); the emergency file carries its cursor inside.
+        cands = [((c + 1, 0), p, None, None)
+                 for c, p in ckpt.scan_checkpoints(d)
+                 if c >= self.start_counter]
+        epath = os.path.join(d, ckpt.EMERGENCY_NAME)
+        if os.path.exists(epath):
+            try:
+                payload, fmt = ckpt.read_verified(
+                    epath, retries=self.ckpt_retries)
+                st = ckpt.peek_state(payload) or {}
+                prog = (int(st.get("start_counter", 0)),
+                        int(st.get("batches_done", 0)))
+                if prog[0] > self.start_counter:
+                    cands.append((prog, epath, payload, fmt))
+            except ckpt.CheckpointCorruptError as e:
+                ckpt.quarantine(epath, reason=str(e))
+            except OSError as e:     # unreadable even after retries:
+                sys.stderr.write(    # skip, but never quarantine
+                    "WARNING: cannot read %s (%s); skipping\n" % (epath, e))
+        cands.sort(key=lambda t: t[0], reverse=True)
+        for prog, path, payload, fmt in cands:
+            try:
+                if payload is None:
+                    payload, fmt = ckpt.read_verified(
+                        path, retries=self.ckpt_retries)
+            except ckpt.CheckpointCorruptError as e:
+                ckpt.quarantine(path, reason=str(e))
+                continue
+            except OSError as e:
+                sys.stderr.write(
+                    "WARNING: cannot read %s (%s); skipping\n" % (path, e))
+                continue
+            try:
+                r = serializer.Reader(payload)
+                self.net_type = r.read_int32()
+                net = self._create_net()
+                net.load_model(r)
+                state = net.load_training_state(r)
+            except Exception as e:
+                if fmt == "v1":
+                    # the CRC verified, so the bytes are exactly what the
+                    # writer saved: this is a net/config mismatch, NOT
+                    # file corruption. Abort loudly instead of
+                    # destructively quarantining healthy checkpoints.
+                    raise RuntimeError(
+                        "checkpoint %s is intact (CRC verified) but "
+                        "failed to load: %s — likely a net/updater config "
+                        "mismatch with the current run; fix the config "
+                        "(or remove the file) and retry" % (path, e)) \
+                        from e
+                # legacy file without integrity framing: a parse failure
+                # here IS the corruption signal — quarantine and fall back
+                ckpt.quarantine(path, reason=str(e))
+                continue
+            self.net_trainer = net
+            self.start_counter = prog[0]
+            self._resume_state = state
+            self._resume_batches = prog[1] if state is not None else 0
+            telemetry.event({"ev": "ckpt_restore", "path": path,
+                             "counter": prog[0] - 1,
+                             "batches_done": self._resume_batches})
+            if not self.silent and self._resume_batches:
+                print("Init: resuming mid-round from %s (%d batches into "
+                      "round %d)" % (path, self._resume_batches,
+                                     prog[0] - 1))
+            return 1
+        return 0
+
+    def _read_model_file(self, path: str) -> serializer.Reader:
+        """Open a model file with integrity verification: framed files
+        (this writer) are CRC-checked, footer-less seed/legacy files pass
+        through untouched; a torn or bit-flipped file raises
+        CheckpointCorruptError instead of deserializing garbage."""
+        payload, _ = ckpt.read_verified(path, retries=self.ckpt_retries)
+        return serializer.Reader(payload)
 
     def _load_model(self) -> None:
         base = os.path.basename(self.name_model_in)
@@ -229,30 +335,114 @@ class LearnTask:
         except ValueError:
             print("WARNING: Cannot infer start_counter from model name. "
                   "Specify it in config if needed")
-        with open(self.name_model_in, "rb") as f:
-            r = serializer.Reader(f)
-            self.net_type = r.read_int32()
-            self.net_trainer = self._create_net()
-            self.net_trainer.load_model(r)
+        r = self._read_model_file(self.name_model_in)
+        self.net_type = r.read_int32()
+        self.net_trainer = self._create_net()
+        self.net_trainer.load_model(r)
         self.start_counter += 1
 
     def _copy_model(self) -> None:
-        with open(self.name_model_in, "rb") as f:
-            r = serializer.Reader(f)
-            self.net_type = r.read_int32()
-            self.net_trainer = self._create_net()
-            self.net_trainer.copy_model_from(r)
+        r = self._read_model_file(self.name_model_in)
+        self.net_type = r.read_int32()
+        self.net_trainer = self._create_net()
+        self.net_trainer.copy_model_from(r)
 
-    def _save_model(self) -> None:
-        name = self._model_path(self.start_counter)
-        self.start_counter += 1
-        if self.save_period == 0 or self.start_counter % self.save_period != 0:
+    def _is_writer(self) -> bool:
+        """Multi-process: every rank serializes (fetch_global is
+        collective) but exactly one touches the filesystem."""
+        import jax
+        return jax.process_count() <= 1 or jax.process_index() == 0
+
+    def _write_checkpoint(self, name: str, resume_counter: int,
+                          batches_done: int) -> None:
+        """Serialize net_type + model + optimizer + training state and
+        atomically write it with integrity framing (tmp + fsync + rename,
+        CRC32 footer) and retry-with-backoff on transient IO errors."""
+        t0 = time.perf_counter()
+        w = serializer.Writer()
+        w.write_int32(self.net_type)
+        self.net_trainer.save_model(w)
+        self.net_trainer.save_training_state(
+            w, extra={"start_counter": int(resume_counter),
+                      "batches_done": int(batches_done)})
+        if not self._is_writer():
             return
+        payload = w.f.getbuffer()   # zero-copy view of the BytesIO buffer
         os.makedirs(self.name_model_dir, exist_ok=True)
-        with open(name, "wb") as f:
-            w = serializer.Writer(f)
-            w.write_int32(self.net_type)
-            self.net_trainer.save_model(w)
+        ckpt.write_checkpoint(name, payload, fsync=bool(self.ckpt_fsync),
+                              retries=self.ckpt_retries)
+        telemetry.event({"ev": "ckpt_save", "path": name,
+                         "bytes": len(payload),
+                         "counter": int(resume_counter) - 1,
+                         "batches_done": int(batches_done),
+                         "seconds": round(time.perf_counter() - t0, 6)})
+
+    def _save_model(self, force: bool = False) -> bool:
+        """Round-boundary checkpoint; returns whether a file was written.
+
+        The counter is checked BEFORE the increment (the reference
+        incremented first, so save_period=k saved rounds k-1, 2k-1, ...
+        and never round 0); the session's final round — num_round reached
+        OR the max_round per-invocation cap exhausted — saves regardless
+        of save_period (``force``), so a clean exit never loses work."""
+        counter = self.start_counter
+        self.start_counter += 1
+        if self.save_period == 0:
+            return False
+        if counter % self.save_period != 0 and not force:
+            return False
+        self._write_checkpoint(self._model_path(counter),
+                               self.start_counter, 0)
+        if self._is_writer():
+            # a numbered checkpoint strictly supersedes any emergency
+            # file (its progress tuple is newer by construction)
+            epath = os.path.join(self.name_model_dir, ckpt.EMERGENCY_NAME)
+            try:
+                if os.path.exists(epath):
+                    os.remove(epath)
+            except OSError:
+                pass
+            ckpt.gc_stale_tmp(self.name_model_dir)
+            if self.ckpt_keep_last > 0:
+                ckpt.apply_retention(self.name_model_dir,
+                                     keep_last=self.ckpt_keep_last,
+                                     keep_every=self.ckpt_keep_every)
+        return True
+
+    def _save_emergency(self, batches_done: int) -> None:
+        """One mid-round emergency checkpoint at a step boundary (the
+        preemption path): full state including the iterator cursor, so
+        resume re-enters the SAME round and fast-forwards past the
+        already-trained batches."""
+        name = os.path.join(self.name_model_dir, ckpt.EMERGENCY_NAME)
+        with telemetry.span("checkpoint", kind="emergency"):
+            self._write_checkpoint(name, self.start_counter, batches_done)
+        if not self.silent:
+            print("preemption: emergency checkpoint -> %s (round %d, "
+                  "batch %d)" % (name, self.start_counter - 1,
+                                 batches_done))
+
+    def _preempt_requested(self) -> bool:
+        if self._preempt is None or not self._preempt.requested:
+            return False
+        if not self._preempt_noted:
+            # the signal handler only sets flags (async-signal safety:
+            # telemetry's lock may be held by this very thread when the
+            # signal lands) — the loop emits the event on first notice
+            self._preempt_noted = True
+            telemetry.event({"ev": "preempt_signal",
+                             "signum": self._preempt.signum})
+        return True
+
+    @staticmethod
+    def _iter_chain_stable(it) -> bool:
+        """Whether every iterator in the chain replays an identical epoch
+        order after restart (exact mid-round resume; see IIterator)."""
+        while it is not None:
+            if not getattr(it, "stable_epoch_order", True):
+                return False
+            it = getattr(it, "base", None)
+        return True
 
     def _create_net(self) -> Trainer:
         if self.reset_net_type != -1:
@@ -309,6 +499,27 @@ class LearnTask:
     # ------------------------------------------------------------------
     def task_train(self) -> None:
         start = time.time()
+        self._stop_training = False
+        self._preempt_noted = False
+        # cooperative preemption is single-process only: the stop flag is
+        # per-rank, so in a multi-process run ranks would observe the
+        # signal at different step boundaries and issue MISMATCHED
+        # collectives (one rank in the emergency save's fetch_global,
+        # another in the next train step) — a distributed hang. Multi-host
+        # fleets rely on the round-boundary checkpoints instead.
+        import jax
+        enabled = bool(self.preempt_save) and jax.process_count() <= 1
+        if self.preempt_save and not enabled and not self.silent:
+            print("preempt_save: disabled (multi-process run — emergency "
+                  "checkpoints require single-process training)")
+        with ckpt.PreemptionGuard(enabled=enabled) as guard:
+            self._preempt = guard
+            try:
+                self._task_train_loop(start)
+            finally:
+                self._preempt = None
+
+    def _task_train_loop(self, start: float) -> None:
         if self.continue_training == 0 and self.name_model_in == "NULL":
             self._save_model()
         else:
@@ -318,6 +529,13 @@ class LearnTask:
                 sys.stderr.write(self.net_trainer.evaluate(itr, nm))
             sys.stderr.write("\n")
             sys.stderr.flush()
+        # apply the checkpoint's training-state cursor HERE — after the
+        # continue-path eval above (which draws from the rng stream and
+        # would absorb a restored metric accumulator), right before the
+        # first update, so a preempted run resumes bit-for-bit
+        if self._resume_state is not None:
+            self.net_trainer.restore_training_state(self._resume_state)
+            self._resume_state = None
         if self.itr_train is None:
             return
         if self.test_io != 0:
@@ -334,8 +552,15 @@ class LearnTask:
                 profiling = True
             if not self.silent:
                 print("update round %d" % rnd)
+            # the session's last round — by the schedule (num_round) OR by
+            # the per-invocation cap (max_round) — always checkpoints, so
+            # a clean exit never loses finished rounds to save_period gaps
+            last_round = (cc == 0 or self.start_counter == self.num_round)
             with telemetry.span("round", round=rnd):
-                stats = self._train_one_round(start)
+                stats = self._train_one_round(
+                    start, skip_batches=self._resume_batches,
+                    final_round=last_round)
+            self._resume_batches = 0
             t_input, t_step, t_eval, t_ckpt, n_img = stats
             wall = t_input + t_step
             if self.test_io != 0:
@@ -366,10 +591,17 @@ class LearnTask:
                 profiling = False
                 if not self.silent:
                     print("profiler trace written to %s" % self.profile_dir)
+            if self._stop_training:
+                telemetry.event({"ev": "preempt_exit", "round": rnd})
+                if not self.silent:
+                    print("preemption: checkpointed, exiting cleanly "
+                          "(resume with continue=1)")
+                return
         if not self.silent:
             print("updating end, %.0f sec in all" % (time.time() - start))
 
-    def _train_one_round(self, start: float):
+    def _train_one_round(self, start: float, skip_batches: int = 0,
+                         final_round: bool = False):
         """One pass over itr_train + eval + checkpoint. Returns the round
         breakdown (input-wait, step, eval, checkpoint seconds, images) —
         the input-starvation probe the reference treats as a design axis
@@ -381,6 +613,21 @@ class LearnTask:
         self.itr_train.before_first()
         t_input = t_step = t_eval = t_ckpt = 0.0
         n_img = 0
+        batches_done = 0
+        if skip_batches:
+            # mid-round resume: replay the round's prefix without compute
+            # (base iterators seek O(1); buffered chains drain batches)
+            if not self._iter_chain_stable(self.itr_train):
+                print("WARNING: the training iterator's epoch order is "
+                      "not replay-stable (windowed shuffle); mid-round "
+                      "resume is approximate — some prefix batches may "
+                      "repeat or be skipped this round")
+            with telemetry.span("resume.skip", batches=skip_batches):
+                batches_done = self.itr_train.skip(skip_batches)
+            sample_counter = batches_done
+            if not self.silent:
+                print("resume: fast-forwarded %d batches into round %d"
+                      % (batches_done, self.start_counter - 1))
         while True:
             t0 = time.perf_counter()
             if not self.itr_train.next():
@@ -397,10 +644,20 @@ class LearnTask:
                 t_step += time.perf_counter() - t1
             n_img += batch.batch_size - batch.num_batch_padd
             sample_counter += 1
+            batches_done += 1
             if sample_counter % self.print_step == 0 and not self.silent:
                 print("round %8d:[%8d] %.0f sec elapsed" %
                       (self.start_counter - 1, sample_counter,
                        time.time() - start))
+            if self.test_io == 0 and self._preempt_requested():
+                # preemption at a step boundary: one emergency checkpoint
+                # with the iterator cursor, then a clean exit — the
+                # user-level checkpoint/restore recovery contract
+                t0 = time.perf_counter()
+                self._save_emergency(batches_done)
+                t_ckpt = time.perf_counter() - t0
+                self._stop_training = True
+                return t_input, t_step, t_eval, t_ckpt, n_img
         if self.test_io == 0:
             t0 = time.perf_counter()
             sys.stderr.write("[%d]" % self.start_counter)
@@ -415,8 +672,15 @@ class LearnTask:
             t_eval = time.perf_counter() - t0
         t0 = time.perf_counter()
         with telemetry.span("checkpoint"):
-            self._save_model()
+            saved = self._save_model(force=final_round)
         t_ckpt = time.perf_counter() - t0
+        if self._preempt_requested():
+            # signal arrived during eval/checkpoint: the round is complete;
+            # if save_period skipped the round checkpoint, write an
+            # emergency one so no finished work is lost
+            if not saved:
+                self._save_emergency(0)
+            self._stop_training = True
         return t_input, t_step, t_eval, t_ckpt, n_img
 
     @staticmethod

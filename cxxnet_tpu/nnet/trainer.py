@@ -18,6 +18,7 @@ INetTrainer ABI (src/nnet/nnet.h:18-92) TPU-first:
 
 from __future__ import annotations
 
+import json
 import re
 from functools import partial
 from typing import Dict, List, Optional, Tuple
@@ -633,6 +634,113 @@ class Trainer:
                           "optimizer state %r/%r shape mismatch" % (key, sk))
                     st[key][sk] = jnp.asarray(val)
         self._place_params()   # re-apply TP shardings to restored state
+
+    # training-state section (preemption-tolerant full-state resume): the
+    # host-side step state a weights+optimizer checkpoint does NOT cover —
+    # the rng stream position, the update_period phase, in-flight grad
+    # accumulation, and the on-device train-metric sums. With it a
+    # preempted run resumes bit-for-bit MID-schedule; without it (old
+    # files) resume still works, from round-start weights. Written by
+    # save_training_state AFTER save_model's sections, guarded by
+    # checkpoint.STATE_MAGIC so old readers (and load_model) ignore it.
+    def save_training_state(self, w: serializer.Writer,
+                            extra: Optional[dict] = None) -> None:
+        """Append the versioned training-state section. ``extra`` carries
+        the driver's cursor (round counter, iterator batch position).
+        Multi-process: collective (grad accum may be mesh-sharded) —
+        call on every process, write the stream on one."""
+        from ..utils import checkpoint as ckpt
+        sw = serializer.Writer()
+        meta = {"rng_counter": int(self._rng_counter),
+                "sample_counter": int(self.sample_counter)}
+        if extra:
+            meta.update(extra)
+        ga = self.grad_accum
+        ma = self._metric_accum
+        meta["has_grad_accum"] = ga is not None
+        meta["has_metric_accum"] = ma is not None
+        sw.write_string(json.dumps(meta, sort_keys=True))
+        if ma is not None:
+            sw.write_tensor(np.asarray(jax.device_get(ma), np.float32))
+        if ga is not None:
+            sw.write_uint64(len(ga))
+            for d in ga:
+                sw.write_uint64(len(d))
+                for key in sorted(d):
+                    sw.write_string(key)
+                    sw.write_tensor(np.asarray(
+                        parallel.fetch_global(d[key]), np.float32))
+        blob = sw.getvalue()
+        w.write_raw(ckpt.STATE_MAGIC)
+        w.write_uint64(len(blob))
+        w.write_raw(blob)
+
+    def load_training_state(self, r: serializer.Reader) -> Optional[dict]:
+        """Parse the optional training-state section into a dict (missing
+        section — old checkpoint — returns None). Application is separate
+        (restore_training_state): the driver's continue-path eval runs
+        between load and the train loop and must not consume the restored
+        rng/metric state."""
+        from ..utils import checkpoint as ckpt
+        magic = r.f.read(len(ckpt.STATE_MAGIC))
+        if magic != ckpt.STATE_MAGIC:
+            return None
+        nbytes = r.read_uint64()
+        sr = serializer.Reader(r.read_raw(nbytes))
+        meta = json.loads(sr.read_string())
+        state = dict(meta)
+        if meta.get("has_metric_accum"):
+            state["metric_accum"] = sr.read_tensor()
+        if meta.get("has_grad_accum"):
+            ga = []
+            for _ in range(sr.read_uint64()):
+                d = {}
+                for _ in range(sr.read_uint64()):
+                    key = sr.read_string()
+                    d[key] = sr.read_tensor()
+                ga.append(d)
+            state["grad_accum"] = ga
+        return state
+
+    def restore_training_state(self, state: Optional[dict]) -> None:
+        """Apply a loaded training-state dict. Counters always apply;
+        grad/metric accumulators apply only when their tree matches the
+        current net+parallelism config (a resume under a DIFFERENT mesh
+        layout drops them with a warning — correct at update boundaries,
+        just not bit-identical mid-accumulation)."""
+        if not state:
+            return
+        if "rng_counter" in state:
+            self._rng_counter = int(state["rng_counter"])
+        if "sample_counter" in state:
+            self.sample_counter = int(state["sample_counter"])
+        ma = state.get("metric_accum")
+        if ma is not None:
+            if np.shape(ma) == (len(self.train_metric), 2):
+                self._metric_accum = jnp.asarray(np.asarray(ma, np.float32))
+            elif not self.silent:
+                print("WARNING: checkpoint train-metric state does not "
+                      "match the current metric set; dropped")
+        ga = state.get("grad_accum")
+        if ga is not None:
+            ok = len(ga) == len(self.params) and all(
+                set(d) == set(p)
+                and all(tuple(np.shape(d[k])) == tuple(np.shape(p[k]))
+                        for k in d)
+                for d, p in zip(ga, self.params))
+            if ok:
+                self.grad_accum = [
+                    {k: jnp.asarray(
+                        np.asarray(v, np.float32),
+                        dtype=getattr(self.params[i][k], "dtype",
+                                      np.float32))
+                     for k, v in d.items()}
+                    for i, d in enumerate(ga)]
+            elif not self.silent:
+                print("WARNING: checkpoint gradient-accumulation state "
+                      "does not match the current net/parallelism config; "
+                      "dropped (resume is exact only at update "
+                      "boundaries)")
 
     def load_model(self, r: serializer.Reader) -> None:
         self.net_cfg.load_net(r)

@@ -1,0 +1,398 @@
+"""Preemption-tolerant checkpoint IO: atomic writes, integrity, recovery.
+
+The reference's restart story is "scan models/%04d.model and reload"
+(src/cxxnet_main.cpp:135-157) — written in place, no integrity check, no
+tolerance for a task kill mid-write. On a preemptible fleet the checkpoint
+path IS the fault-tolerance mechanism (TensorFlow makes user-level
+checkpoint/restore the sole recovery primitive for exactly this reason,
+arxiv 1605.08695 §4.2), so this module gives every model file:
+
+* **durable atomic writes** — payload goes to ``<name>.tmp``, is fsync'd,
+  and renamed over the final name; the directory entry is fsync'd too.
+  A kill at ANY point leaves either the old file or the new file, never
+  a torn one. Flaky-filesystem writes (NFS, GCS-fuse) retry with
+  exponential backoff (``retry_io``).
+* **integrity framing** — new files are ``CXCKHDR1 + payload + footer``
+  where the 20-byte footer is ``<IQ8s``: CRC32(payload), payload length,
+  magic ``CXCKPT01``. The header magic distinguishes a *truncated new
+  file* (header present, footer gone -> corrupt) from a *legacy seed
+  checkpoint* (no framing at all -> loaded trusted, flagged by fsck).
+  The first payload byte of a legacy file is a small int32 net_type, so
+  the 8-byte header can never be confused with legacy content.
+* **recovery helpers** — gap-tolerant directory scans, quarantine of
+  corrupt files to ``<name>.corrupt`` (telemetry event ``ckpt_corrupt``),
+  stale-tmp GC, and a ``keep_last``/``keep_every`` retention policy.
+* **preemption** — ``PreemptionGuard`` converts SIGTERM/SIGINT into a
+  "checkpoint at the next step boundary then exit cleanly" flag; a second
+  signal falls through to the default handler (hard kill still works).
+
+``tools/ckpt_fsck.py`` builds its offline verifier on these primitives and
+``tests/faultinject.py`` + ``tests/test_checkpoint_faults.py`` prove every
+failure mode (kill mid-write, truncation, bit flip, rename failure, disk
+full, stale tmp) either recovers or fails loudly — never loads garbage.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import re
+import signal
+import struct
+import sys
+import time
+import zlib
+from typing import List, Optional, Tuple
+
+from . import serializer
+from . import telemetry
+
+HEADER_MAGIC = b"CXCKHDR1"
+FOOTER_MAGIC = b"CXCKPT01"
+# magic of the versioned training-state section learn_task/trainer append
+# INSIDE the payload (rng counter, grad accum, iterator cursor); defined
+# here so peek_state and fsck can find it without importing the trainer
+STATE_MAGIC = b"CXTSTA01"
+
+_FOOTER_FMT = "<IQ8s"   # crc32(payload), payload length, FOOTER_MAGIC
+FOOTER_SIZE = struct.calcsize(_FOOTER_FMT)
+
+_NAME_RE = re.compile(r"^(\d+)\.model$")
+EMERGENCY_NAME = "emergency.model"
+
+
+class CheckpointError(Exception):
+    """Base class for checkpoint IO failures."""
+
+
+class CheckpointCorruptError(CheckpointError):
+    """The file's integrity framing does not validate (truncated / torn /
+    bit-flipped). Callers must NOT fall back to loading the raw bytes."""
+
+
+# ----------------------------------------------------------------------
+# integrity framing
+def crc32(payload: bytes) -> int:
+    return zlib.crc32(payload) & 0xFFFFFFFF
+
+
+def frame(payload: bytes) -> bytes:
+    """Wrap a serialized model payload in the v1 integrity framing."""
+    return (HEADER_MAGIC + payload
+            + struct.pack(_FOOTER_FMT, crc32(payload), len(payload),
+                          FOOTER_MAGIC))
+
+
+def split_footer(blob: bytes) -> Tuple[bytes, str]:
+    """Strip and verify the integrity framing.
+
+    Returns ``(payload, fmt)`` with fmt ``"v1"`` (framed, CRC verified) or
+    ``"legacy"`` (footer-less seed checkpoint, returned as-is). Raises
+    CheckpointCorruptError when the framing is present but inconsistent —
+    a framed file can never be silently demoted to legacy by truncation,
+    because the header magic survives at the front.
+    """
+    has_header = blob.startswith(HEADER_MAGIC)
+    body = blob[len(HEADER_MAGIC):] if has_header else blob
+    if len(body) >= FOOTER_SIZE and body.endswith(FOOTER_MAGIC):
+        crc, plen, _ = struct.unpack(_FOOTER_FMT, body[-FOOTER_SIZE:])
+        payload = body[:-FOOTER_SIZE]
+        if plen != len(payload):
+            raise CheckpointCorruptError(
+                "footer declares %d payload bytes but %d are present "
+                "(truncated or torn write)" % (plen, len(payload)))
+        actual = crc32(payload)
+        if actual != crc:
+            raise CheckpointCorruptError(
+                "CRC mismatch: footer %08x != payload %08x (bit "
+                "corruption)" % (crc, actual))
+        return payload, "v1"
+    if has_header:
+        raise CheckpointCorruptError(
+            "header magic present but footer missing or invalid "
+            "(truncated / torn write)")
+    return blob, "legacy"
+
+
+def verify_blob(blob: bytes):
+    """Classify checkpoint bytes without raising: returns
+    ``(status, reason, payload_or_None)`` with status ``ok`` (v1, CRC
+    verified), ``legacy`` (unverifiable seed format), or ``corrupt``."""
+    try:
+        payload, fmt = split_footer(blob)
+    except CheckpointCorruptError as e:
+        return "corrupt", str(e), None
+    return ("ok" if fmt == "v1" else "legacy"), "", payload
+
+
+def peek_state(payload: bytes) -> Optional[dict]:
+    """Read the training-state metadata dict (round counter, batch cursor,
+    rng counter, ...) out of a verified payload WITHOUT building the net.
+
+    The state section is the last section of the payload, so a valid hit
+    must end exactly at the payload end; earlier spurious occurrences of
+    the magic inside tensor data are rejected by that length check."""
+    import json
+    end = len(payload)
+    i = payload.rfind(STATE_MAGIC)
+    while i >= 0:
+        try:
+            r = serializer.Reader(payload[i + len(STATE_MAGIC):])
+            nbytes = r.read_uint64()
+            if i + len(STATE_MAGIC) + 8 + nbytes == end:
+                meta = json.loads(r.read_string())
+                if isinstance(meta, dict):
+                    return meta
+        except Exception:
+            pass
+        i = payload.rfind(STATE_MAGIC, 0, i)
+    return None
+
+
+# ----------------------------------------------------------------------
+# durable IO
+# OSErrors that no amount of retrying fixes: fail them immediately so a
+# mistyped path surfaces at once (and doesn't pollute the ckpt.io_retry
+# counter that exists to measure genuinely flaky mounts)
+_NON_TRANSIENT_ERRNO = frozenset(
+    e for e in (errno.ENOENT, errno.EISDIR, errno.ENOTDIR) if e is not None)
+
+
+def retry_io(fn, retries: int = 2, base_delay: float = 0.05,
+             retriable=(OSError,)):
+    """Run ``fn`` with exponential-backoff retries on transient IO errors
+    (flaky NFS / GCS-fuse mounts). ``retries`` is the number of RE-tries;
+    the last failure re-raises; permanent errors (missing path, not a
+    file) are never retried."""
+    delay = base_delay
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except retriable as e:
+            if getattr(e, "errno", None) in _NON_TRANSIENT_ERRNO \
+                    or attempt >= retries:
+                raise
+            telemetry.count("ckpt.io_retry")
+            time.sleep(delay)
+            delay *= 2
+
+
+def _fsync_dir(dirname: str) -> None:
+    """fsync the directory entry so the rename itself is durable; some
+    filesystems don't support opening a directory — best effort."""
+    try:
+        fd = os.open(dirname or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write(path: str, data, fsync: bool = True,
+                 retries: int = 2, base_delay: float = 0.05) -> None:
+    """Write ``data`` (bytes, or a sequence of byte-like chunks, written
+    in order) to ``path`` atomically: tmp file, fsync, rename.
+
+    A crash/kill at any instant leaves either the previous ``path``
+    contents or the complete new contents — never a partial file. The
+    tmp file is removed on failure; transient OSErrors retry with
+    backoff. Chunks are written sequentially so callers never have to
+    concatenate a multi-GB payload into one extra host-RAM copy."""
+    tmp = path + ".tmp"
+    chunks = data if isinstance(data, (list, tuple)) else (data,)
+
+    def _once():
+        try:
+            with open(tmp, "wb") as f:
+                for c in chunks:
+                    f.write(c)
+                if fsync:
+                    f.flush()
+                    os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                if os.path.exists(tmp):
+                    os.remove(tmp)
+            except OSError:
+                pass
+            raise
+        if fsync:
+            _fsync_dir(os.path.dirname(os.path.abspath(path)))
+
+    retry_io(_once, retries=retries, base_delay=base_delay)
+
+
+def write_checkpoint(path: str, payload, fsync: bool = True,
+                     retries: int = 2, base_delay: float = 0.05) -> None:
+    """Frame ``payload`` (bytes or memoryview; header + CRC footer) and
+    atomic-write it without building the framed blob in RAM."""
+    footer = struct.pack(_FOOTER_FMT, crc32(payload), len(payload),
+                         FOOTER_MAGIC)
+    atomic_write(path, (HEADER_MAGIC, payload, footer), fsync=fsync,
+                 retries=retries, base_delay=base_delay)
+
+
+def read_verified(path: str, retries: int = 0,
+                  base_delay: float = 0.05) -> Tuple[bytes, str]:
+    """Read a checkpoint file and verify/strip its framing. Returns
+    ``(payload, fmt)``; raises CheckpointCorruptError (with the path in
+    the message) when the framing does not validate."""
+    def _read():
+        with open(path, "rb") as f:
+            return f.read()
+
+    blob = retry_io(_read, retries=retries, base_delay=base_delay) \
+        if retries > 0 else _read()
+    try:
+        return split_footer(blob)
+    except CheckpointCorruptError as e:
+        raise CheckpointCorruptError("%s: %s" % (path, e)) from None
+
+
+# ----------------------------------------------------------------------
+# directory hygiene: scan / quarantine / GC / retention
+def scan_checkpoints(model_dir: str) -> List[Tuple[int, str]]:
+    """All ``<counter>.model`` files in ``model_dir``, sorted ascending by
+    counter. Tolerates gaps in the numbering (save_period > 1) — unlike
+    the reference's stop-at-first-hole scan."""
+    out: List[Tuple[int, str]] = []
+    try:
+        names = os.listdir(model_dir)
+    except OSError:
+        return out
+    for nm in names:
+        m = _NAME_RE.match(nm)
+        if m:
+            out.append((int(m.group(1)), os.path.join(model_dir, nm)))
+    out.sort()
+    return out
+
+
+def quarantine(path: str, reason: str = "") -> Optional[str]:
+    """Move a corrupt checkpoint aside to ``<path>.corrupt`` (never
+    deleted: the operator may want forensics) and emit the
+    ``ckpt_corrupt`` telemetry event. Returns the new path."""
+    dst = path + ".corrupt"
+    n = 0
+    while os.path.exists(dst):
+        n += 1
+        dst = "%s.corrupt.%d" % (path, n)
+    try:
+        os.replace(path, dst)
+    except OSError:
+        return None
+    telemetry.event({"ev": "ckpt_corrupt", "path": path,
+                     "reason": str(reason)[:300], "quarantined_to": dst})
+    sys.stderr.write("WARNING: corrupt checkpoint %s (%s) quarantined "
+                     "to %s\n" % (path, reason, dst))
+    return dst
+
+
+def gc_stale_tmp(model_dir: str) -> List[str]:
+    """Remove ``*.tmp`` leftovers from writes that died before their
+    rename. Call only from the single live writer of ``model_dir``."""
+    removed = []
+    try:
+        names = os.listdir(model_dir)
+    except OSError:
+        return removed
+    for nm in names:
+        if nm.endswith(".tmp"):
+            p = os.path.join(model_dir, nm)
+            try:
+                os.remove(p)
+                removed.append(p)
+            except OSError:
+                pass
+    if removed:
+        telemetry.event({"ev": "ckpt_gc_tmp", "removed": len(removed)})
+    return removed
+
+
+def apply_retention(model_dir: str, keep_last: int = 0,
+                    keep_every: int = 0, protect=()) -> List[str]:
+    """Delete old numbered checkpoints: keep the newest ``keep_last``,
+    plus every counter divisible by ``keep_every`` (long-horizon anchors),
+    plus anything in ``protect``. ``keep_last <= 0`` disables retention
+    entirely (keep everything — the reference behavior)."""
+    if keep_last <= 0:
+        return []
+    ckpts = scan_checkpoints(model_dir)
+    keep = {c for c, _ in ckpts[-keep_last:]}
+    if keep_every > 0:
+        keep |= {c for c, _ in ckpts if c % keep_every == 0}
+    keep |= set(protect)
+    removed = []
+    for c, p in ckpts:
+        if c in keep:
+            continue
+        try:
+            os.remove(p)
+            removed.append(p)
+        except OSError:
+            pass
+    if removed:
+        telemetry.event({"ev": "ckpt_retention", "removed": len(removed),
+                         "keep_last": keep_last, "keep_every": keep_every})
+    return removed
+
+
+# ----------------------------------------------------------------------
+# preemption handling
+class PreemptionGuard:
+    """Convert SIGTERM/SIGINT into a cooperative "checkpoint then exit"
+    request.
+
+    While installed, the FIRST signal sets ``requested`` (the train loop
+    checks it at step boundaries, takes one emergency checkpoint and
+    exits cleanly) and immediately restores the previous handlers, so a
+    second signal gets default handling — an operator can still hard-kill
+    a hung save. Installing outside the main thread is a silent no-op
+    (signal.signal is main-thread-only); ``enabled=False`` builds an
+    inert guard so call sites need no branching."""
+
+    def __init__(self, signals=None, enabled: bool = True):
+        self.signals = tuple(signals) if signals is not None else \
+            (signal.SIGTERM, signal.SIGINT)
+        self.enabled = enabled
+        self.requested = False
+        self.signum: Optional[int] = None
+        self._old = {}
+
+    def __enter__(self) -> "PreemptionGuard":
+        if not self.enabled:
+            return self
+        try:
+            for s in self.signals:
+                self._old[s] = signal.signal(s, self._handle)
+        except ValueError:        # not the main thread
+            self._restore()
+        return self
+
+    def _handle(self, signum, frame) -> None:
+        # async-signal-safe by construction: ONLY set flags. The handler
+        # runs on the main thread between bytecodes — calling into
+        # telemetry here could deadlock on its non-reentrant lock if the
+        # signal lands inside a span/counter critical section (the train
+        # loop holds it every batch). The train loop emits the telemetry
+        # event when it observes `requested`.
+        self.requested = True
+        self.signum = int(signum)
+        self._restore()
+
+    def _restore(self) -> None:
+        for s, h in self._old.items():
+            try:
+                signal.signal(s, h)
+            except (ValueError, OSError):
+                pass
+        self._old = {}
+
+    def __exit__(self, *exc) -> bool:
+        self._restore()
+        return False

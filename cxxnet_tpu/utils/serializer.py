@@ -64,6 +64,13 @@ class Writer:
         return self.f.getvalue()
 
 
+# sanity bounds for length fields: a corrupt (truncated / bit-flipped)
+# header must fail loudly here, not turn into a multi-GB allocation or a
+# silently garbage-shaped tensor downstream
+_MAX_BLOB = 1 << 40       # 1 TiB: no single field is ever this large
+_MAX_TENSOR_NDIM = 32
+
+
 class Reader:
     def __init__(self, data):
         if isinstance(data, (bytes, bytearray)):
@@ -72,9 +79,13 @@ class Reader:
             self.f = data
 
     def read_raw(self, size: int) -> bytes:
+        if size < 0:
+            raise ValueError("corrupt model file: negative field size %d"
+                             % size)
         b = self.f.read(size)
         if len(b) != size:
-            raise EOFError("unexpected end of model file")
+            raise EOFError("unexpected end of model file: wanted %d bytes, "
+                           "got %d (truncated checkpoint?)" % (size, len(b)))
         return b
 
     def read_int32(self) -> int:
@@ -91,18 +102,26 @@ class Reader:
 
     def read_string(self) -> str:
         n = self.read_uint64()
+        if n > _MAX_BLOB:
+            raise ValueError("corrupt model file: string length %d" % n)
         return self.read_raw(n).decode("utf-8")
 
     def read_int_vector(self) -> List[int]:
         n = self.read_uint64()
         if n == 0:
             return []
+        if 4 * n > _MAX_BLOB:
+            raise ValueError("corrupt model file: vector length %d" % n)
         return list(struct.unpack("<%di" % n, self.read_raw(4 * n)))
 
     def read_tensor(self) -> np.ndarray:
         ndim = self.read_int32()
+        if not 0 <= ndim <= _MAX_TENSOR_NDIM:
+            raise ValueError("corrupt model file: tensor ndim %d" % ndim)
         shape = tuple(self.read_uint32() for _ in range(ndim))
-        count = int(np.prod(shape)) if shape else 1
+        count = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        if 4 * count > _MAX_BLOB:
+            raise ValueError("corrupt model file: tensor shape %s" % (shape,))
         data = np.frombuffer(self.read_raw(4 * count), dtype="<f4").copy()
         return data.reshape(shape)
 

@@ -1,0 +1,118 @@
+"""Fault-injection primitives for the checkpoint robustness suite.
+
+Each helper reproduces one real failure mode of checkpoint IO on a
+preemptible fleet:
+
+* ``truncate``        — task killed mid-write on a filesystem without
+                        atomic rename (or a legacy in-place writer)
+* ``bit_flip``        — silent media/transfer corruption
+* ``tear_footer``     — partial final block: the payload survives, the
+                        integrity footer doesn't
+* ``make_stale_tmp``  — a writer died between tmp-write and rename
+* ``KillAfter``       — deterministic in-process "preemption": deliver
+                        SIGTERM after N train steps (at a step boundary,
+                        like a cluster scheduler's grace signal)
+* ``failing_once`` / ``always_failing`` — monkeypatch payloads for
+                        rename-failure and disk-full (ENOSPC) simulation
+
+These are plain file/process manipulations so they compose with any
+test runner; tests/test_checkpoint_faults.py drives them end-to-end.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import signal
+
+
+def truncate(path: str, keep_bytes: int = None, frac: float = 0.5) -> None:
+    """Chop the file to ``keep_bytes`` (default: ``frac`` of its size)."""
+    size = os.path.getsize(path)
+    keep = int(size * frac) if keep_bytes is None else keep_bytes
+    with open(path, "rb+") as f:
+        f.truncate(keep)
+
+
+def bit_flip(path: str, offset: int = None, mask: int = 0x10) -> None:
+    """XOR one byte (default: the middle of the file) — simulated media
+    corruption that leaves the length intact."""
+    with open(path, "rb+") as f:
+        data = bytearray(f.read())
+        i = len(data) // 2 if offset is None else offset
+        data[i] ^= mask
+        f.seek(0)
+        f.write(bytes(data))
+
+
+def tear_footer(path: str, nbytes: int = 1) -> None:
+    """Remove the last ``nbytes`` — a torn final block that destroys the
+    footer magic while keeping the payload readable."""
+    size = os.path.getsize(path)
+    with open(path, "rb+") as f:
+        f.truncate(max(0, size - nbytes))
+
+
+def strip_framing(path: str) -> None:
+    """Rewrite a framed (v1) checkpoint as a footer-less LEGACY file —
+    the backward-compat fixture for seed-era checkpoints."""
+    from cxxnet_tpu.utils import checkpoint as ckpt
+    payload, fmt = ckpt.read_verified(path)
+    assert fmt == "v1", "strip_framing expects a framed checkpoint"
+    with open(path, "wb") as f:
+        f.write(payload)
+
+
+def make_stale_tmp(model_dir: str, name: str = "9999.model.tmp",
+                   nbytes: int = 512) -> str:
+    """Leave a partial ``.tmp`` file behind, as a killed writer would."""
+    p = os.path.join(model_dir, name)
+    with open(p, "wb") as f:
+        f.write(b"\x7f" * nbytes)
+    return p
+
+
+def killing_method(orig, n: int, signum: int = signal.SIGTERM):
+    """Wrap an unbound method so the Nth call is followed by SIGTERM to
+    this process — a deterministic preemption at a step boundary (a
+    cluster scheduler's grace signal). Use with pytest's monkeypatch:
+
+        monkeypatch.setattr(Trainer, "update",
+                            killing_method(Trainer.update, n=9))
+    """
+    calls = {"n": 0}
+
+    def wrapper(self, *args, **kwargs):
+        out = orig(self, *args, **kwargs)
+        calls["n"] += 1
+        if calls["n"] == n:
+            os.kill(os.getpid(), signum)
+        return out
+
+    return wrapper
+
+
+def failing_once(fn, exc: BaseException = None):
+    """A stand-in for ``fn`` whose FIRST call raises (transient NFS blip);
+    later calls pass through — exercises the retry-with-backoff path."""
+    state = {"failed": False}
+    err = exc if exc is not None else OSError(errno.EIO, "injected IO error")
+
+    def wrapper(*args, **kwargs):
+        if not state["failed"]:
+            state["failed"] = True
+            raise err
+        return fn(*args, **kwargs)
+
+    return wrapper
+
+
+def always_failing(exc: BaseException = None):
+    """A stand-in that ALWAYS raises — disk-full / dead-mount simulation."""
+    err = exc if exc is not None else OSError(errno.ENOSPC,
+                                              "injected disk full")
+
+    def wrapper(*args, **kwargs):
+        raise err
+
+    return wrapper

@@ -186,10 +186,12 @@ def test_old_format_model_still_loads(tmp_path):
     net.update(x, np.zeros(25, np.float32))
     path = str(tmp_path / "m.model")
     net.save_model(path)
-    # strip the optimizer section to emulate a round-1 file
-    blob = open(path, "rb").read()
-    cut = blob.rindex(b"CXNOPT01")
-    open(path, "wb").write(blob[:cut])
+    # strip the integrity framing and the optimizer section to emulate a
+    # legacy (seed-era) file: no footer, nothing after the model blob
+    from cxxnet_tpu.utils import checkpoint as ckpt
+    payload, _ = ckpt.split_footer(open(path, "rb").read())
+    cut = payload.rindex(b"CXNOPT01")
+    open(path, "wb").write(payload[:cut])
     net2 = api.Net(dev="cpu", cfg="")
     net2.load_model(path)
     p1 = net.extract(x, "top[-1]")

@@ -1,0 +1,461 @@
+"""Fault-injection suite for the preemption-tolerant checkpoint subsystem.
+
+Proves the robustness contract end-to-end: for every injected failure —
+kill mid-write, truncation, bit flip, torn footer, rename failure, disk
+full, stale tmp — training either resumes from the newest VALID checkpoint
+or fails loudly with a clear error; no run ever loads garbage. A SIGTERM
+mid-round produces an emergency checkpoint from which resume reproduces
+the uninterrupted run bit-for-bit on the CPU backend.
+"""
+
+import json
+import os
+import shutil
+import signal
+import sys
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.learn_task import LearnTask
+from cxxnet_tpu.nnet.trainer import Trainer
+from cxxnet_tpu.utils import checkpoint as ckpt
+from cxxnet_tpu.utils import serializer
+
+from . import faultinject as fi
+from . import synth_mnist
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tools"))
+import ckpt_fsck  # noqa: E402
+
+
+CONF = """
+data = train
+iter = mnist
+    path_img = "{train_img}"
+    path_label = "{train_lab}"
+    shuffle = 1
+iter = end
+eval = test
+iter = mnist
+    path_img = "{test_img}"
+    path_label = "{test_lab}"
+iter = end
+
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 32
+  init_sigma = 0.01
+layer[+1:sg1] = sigmoid:se1
+layer[sg1->fc2] = fullc:fc2
+  nhidden = 10
+  init_sigma = 0.01
+layer[+0] = softmax
+netconfig=end
+
+input_shape = 1,1,784
+batch_size = 100
+
+dev = cpu
+save_model = 1
+model_dir = {model_dir}
+num_round = {num_round}
+max_round = {num_round}
+random_type = gaussian
+eta = 0.2
+momentum = 0.9
+wd  = 0.0
+metric = error
+eval_train = 1
+silent = 1
+ckpt_fsync = 0
+"""
+
+
+@pytest.fixture(scope="module")
+def mnist_data(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ckpt_mnist")
+    return synth_mnist.make_dataset(str(d))
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory, mnist_data):
+    """One 3-round training run shared by the corruption scenarios; each
+    test works on its own COPY of the models dir."""
+    d = tmp_path_factory.mktemp("ckpt_base")
+    conf = str(d / "train.conf")
+    with open(conf, "w") as f:
+        f.write(CONF.format(model_dir=str(d / "models"), num_round=3,
+                            **mnist_data))
+    task = LearnTask()
+    task.run([conf])
+    return {"dir": str(d), "conf": conf, "models": str(d / "models"),
+            "err": task.net_trainer.metric.evals[0].get()}
+
+
+def run_task(conf, *overrides):
+    task = LearnTask()
+    task.run([conf] + list(overrides))
+    return task
+
+
+def copy_models(trained, tmp_path):
+    dst = str(tmp_path / "models")
+    shutil.copytree(trained["models"], dst)
+    return dst
+
+
+def model(d, counter):
+    return os.path.join(d, "%04d.model" % counter)
+
+
+# ----------------------------------------------------------------------
+# framing / serializer units
+def test_footer_roundtrip_and_corruption_classes():
+    payload = b"\x00\x00\x00\x00" + b"payload-bytes" * 7
+    blob = ckpt.frame(payload)
+    out, fmt = ckpt.split_footer(blob)
+    assert out == payload and fmt == "v1"
+    # legacy (unframed) bytes pass through
+    out, fmt = ckpt.split_footer(payload)
+    assert out == payload and fmt == "legacy"
+    # truncation: header survives, footer gone -> corrupt, NOT legacy
+    with pytest.raises(ckpt.CheckpointCorruptError):
+        ckpt.split_footer(blob[: len(blob) // 2])
+    # torn final block
+    with pytest.raises(ckpt.CheckpointCorruptError):
+        ckpt.split_footer(blob[:-1])
+    # bit flip in the payload -> CRC mismatch
+    flipped = bytearray(blob)
+    flipped[len(ckpt.HEADER_MAGIC) + 3] ^= 0x01
+    with pytest.raises(ckpt.CheckpointCorruptError, match="CRC"):
+        ckpt.split_footer(bytes(flipped))
+    # bit flip in the header magic -> length mismatch, still corrupt
+    flipped = bytearray(blob)
+    flipped[0] ^= 0x01
+    with pytest.raises(ckpt.CheckpointCorruptError):
+        ckpt.split_footer(bytes(flipped))
+
+
+def test_serializer_rejects_short_and_corrupt_reads():
+    w = serializer.Writer()
+    w.write_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    blob = w.getvalue()
+    # truncated tensor payload raises EOFError, never returns short bytes
+    with pytest.raises(EOFError):
+        serializer.Reader(blob[:-5]).read_tensor()
+    with pytest.raises(EOFError):
+        serializer.Reader(b"\x01\x02").read_int32()
+    ok = serializer.Reader(blob).read_tensor()
+    assert ok.shape == (3, 4)
+    # corrupt ndim (negative / absurd) fails loudly
+    w2 = serializer.Writer()
+    w2.write_int32(-3)
+    with pytest.raises(ValueError, match="ndim"):
+        serializer.Reader(w2.getvalue()).read_tensor()
+    # absurd string length fails before allocating
+    w3 = serializer.Writer()
+    w3.write_uint64(1 << 60)
+    with pytest.raises(ValueError, match="string"):
+        serializer.Reader(w3.getvalue()).read_string()
+
+
+def test_missing_state_section_returns_none():
+    tr = Trainer()
+    r = serializer.Reader(b"")
+    assert tr.load_training_state(r) is None
+
+
+# ----------------------------------------------------------------------
+# atomic write: kill mid-write, rename failure, disk full
+def test_atomic_write_rename_failure_retries(tmp_path, monkeypatch):
+    path = str(tmp_path / "a.model")
+    ckpt.write_checkpoint(path, b"old-contents")
+    monkeypatch.setattr(ckpt.os, "replace",
+                        fi.failing_once(os.replace))
+    ckpt.write_checkpoint(path, b"new-contents", retries=2, base_delay=0.0)
+    assert ckpt.read_verified(path)[0] == b"new-contents"
+    assert not os.path.exists(path + ".tmp")
+
+
+def test_atomic_write_hard_failure_keeps_old_file(tmp_path, monkeypatch):
+    path = str(tmp_path / "a.model")
+    ckpt.write_checkpoint(path, b"old-contents")
+    monkeypatch.setattr(ckpt.os, "replace", fi.always_failing())
+    with pytest.raises(OSError):
+        ckpt.write_checkpoint(path, b"new-contents", retries=1,
+                              base_delay=0.0)
+    # the old file is intact and verified; no torn tmp left behind
+    assert ckpt.read_verified(path)[0] == b"old-contents"
+    assert not os.path.exists(path + ".tmp")
+
+
+def test_disk_full_leaves_no_partial_file(tmp_path, monkeypatch):
+    path = str(tmp_path / "a.model")
+    monkeypatch.setattr(ckpt.os, "fsync", fi.always_failing())
+    with pytest.raises(OSError):
+        ckpt.write_checkpoint(path, b"doomed", retries=1, base_delay=0.0)
+    assert not os.path.exists(path)
+    assert not os.path.exists(path + ".tmp")
+
+
+# ----------------------------------------------------------------------
+# recovery scans
+def test_resume_tolerates_numbering_gaps(tmp_path, trained):
+    models = copy_models(trained, tmp_path)
+    os.remove(model(models, 1))      # gap where the old scan stopped
+    os.remove(model(models, 3))
+    task = run_task(trained["conf"], "continue=1", "model_dir=%s" % models,
+                    "num_round=3")
+    assert task.start_counter == 4   # resumed from 0002, ran round 2
+    assert os.path.exists(model(models, 3))
+
+
+def test_resume_quarantines_truncated_newest(tmp_path, trained):
+    models = copy_models(trained, tmp_path)
+    fi.truncate(model(models, 3))
+    task = run_task(trained["conf"], "continue=1", "model_dir=%s" % models,
+                    "num_round=3")
+    # fell back to 0002, re-ran round 2, rewrote a valid 0003
+    assert os.path.exists(model(models, 3) + ".corrupt")
+    assert ckpt_fsck.inspect_file(model(models, 3))["status"] == "ok"
+    assert task.start_counter == 4
+
+
+def test_resume_quarantines_bit_flipped_newest(tmp_path, trained):
+    models = copy_models(trained, tmp_path)
+    fi.bit_flip(model(models, 3))
+    task = run_task(trained["conf"], "continue=1", "model_dir=%s" % models,
+                    "num_round=3")
+    assert os.path.exists(model(models, 3) + ".corrupt")
+    assert task.start_counter == 4
+    err = task.net_trainer.metric.evals[0].get()
+    assert err == trained["err"]     # identical to the uninterrupted run
+
+
+def test_resume_all_corrupt_fails_loudly(tmp_path, trained):
+    models = copy_models(trained, tmp_path)
+    for c in range(4):
+        fi.bit_flip(model(models, c))
+    with pytest.raises(RuntimeError, match="Cannot find models"):
+        run_task(trained["conf"], "continue=1", "model_dir=%s" % models,
+                 "num_round=3")
+    # every candidate was quarantined, none was loaded as garbage
+    for c in range(4):
+        assert not os.path.exists(model(models, c))
+        assert os.path.exists(model(models, c) + ".corrupt")
+
+
+def test_config_mismatch_aborts_without_quarantine(tmp_path, trained):
+    """A CRC-verified checkpoint that fails to parse is a config mismatch,
+    not corruption: resume must abort loudly and leave the file alone
+    (quarantining healthy checkpoints would destroy the run's history)."""
+    models = copy_models(trained, tmp_path)
+    conf2 = str(tmp_path / "bigger.conf")
+    text = open(trained["conf"]).read().replace(
+        "layer[+1:sg1] = sigmoid:se1",
+        "layer[+1:sg1] = sigmoid:se1\nlayer[+1:fcX] = fullc:fcX\n"
+        "  nhidden = 24\n  init_sigma = 0.01")
+    open(conf2, "w").write(text)
+    with pytest.raises(RuntimeError, match="CRC verified.*mismatch"):
+        run_task(conf2, "continue=1", "model_dir=%s" % models,
+                 "num_round=3")
+    for c in range(4):   # every checkpoint untouched, nothing quarantined
+        assert os.path.exists(model(models, c))
+        assert not os.path.exists(model(models, c) + ".corrupt")
+    # the original config still resumes fine
+    task = run_task(trained["conf"], "continue=1", "model_dir=%s" % models,
+                    "num_round=4")
+    assert task.start_counter == 5
+
+
+def test_stale_tmp_ignored_and_collected(tmp_path, trained):
+    models = copy_models(trained, tmp_path)
+    stale = fi.make_stale_tmp(models)
+    task = run_task(trained["conf"], "continue=1", "model_dir=%s" % models,
+                    "num_round=4")
+    assert task.start_counter == 5
+    assert not os.path.exists(stale)          # GC'd at the next save
+    assert os.path.exists(model(models, 4))
+
+
+def test_legacy_footerless_checkpoint_still_loads(tmp_path, trained):
+    models = copy_models(trained, tmp_path)
+    fi.strip_framing(model(models, 3))        # seed-format file, no footer
+    assert ckpt_fsck.inspect_file(model(models, 3))["status"] == "legacy"
+    task = run_task(trained["conf"], "continue=1", "model_dir=%s" % models,
+                    "num_round=4")
+    assert task.start_counter == 5
+    assert ckpt_fsck.inspect_file(model(models, 4))["status"] == "ok"
+
+
+# ----------------------------------------------------------------------
+# schedules, retention
+def test_save_period_saves_round_zero_and_final(tmp_path, mnist_data):
+    conf = str(tmp_path / "t.conf")
+    with open(conf, "w") as f:
+        f.write(CONF.format(model_dir=str(tmp_path / "models"), num_round=4,
+                            **mnist_data))
+    run_task(conf, "save_model=3")
+    models = str(tmp_path / "models")
+    have = sorted(c for c, _ in ckpt.scan_checkpoints(models))
+    # counter % 3 == 0 -> 0000, 0003; final round always saved -> 0004.
+    # (the reference's off-by-one saved rounds 2, 5, ... and never round 0)
+    assert have == [0, 3, 4]
+
+
+def test_max_round_capped_session_saves_final_round(tmp_path, mnist_data):
+    """A session ended by the max_round per-invocation cap (not num_round)
+    must still checkpoint its last round despite save_period gaps."""
+    conf = str(tmp_path / "t.conf")
+    with open(conf, "w") as f:
+        f.write(CONF.format(model_dir=str(tmp_path / "models"),
+                            num_round=50, **mnist_data))
+    run_task(conf, "save_model=5", "max_round=2")
+    have = sorted(c for c, _ in ckpt.scan_checkpoints(
+        str(tmp_path / "models")))
+    assert have == [0, 2]   # initial + the cap's final round (forced)
+
+
+def test_retry_io_skips_permanent_errors():
+    import errno
+    calls = {"n": 0}
+
+    def missing():
+        calls["n"] += 1
+        raise FileNotFoundError(errno.ENOENT, "no such file")
+
+    with pytest.raises(FileNotFoundError):
+        ckpt.retry_io(missing, retries=3, base_delay=0.0)
+    assert calls["n"] == 1          # permanent error: never retried
+
+    calls["n"] = 0
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError(errno.EIO, "injected transient error")
+        return "ok"
+
+    assert ckpt.retry_io(flaky, retries=3, base_delay=0.0) == "ok"
+    assert calls["n"] == 3          # transient error: retried
+
+
+def test_retention_policy(tmp_path, mnist_data):
+    conf = str(tmp_path / "t.conf")
+    with open(conf, "w") as f:
+        f.write(CONF.format(model_dir=str(tmp_path / "models"), num_round=6,
+                            **mnist_data))
+    run_task(conf, "ckpt_keep_last=2", "ckpt_keep_every=3")
+    have = sorted(c for c, _ in ckpt.scan_checkpoints(
+        str(tmp_path / "models")))
+    # newest 2 (0005, 0006) + every 3rd anchor (0000, 0003, 0006)
+    assert have == [0, 3, 5, 6]
+
+
+# ----------------------------------------------------------------------
+# preemption: SIGTERM mid-round -> emergency checkpoint -> exact resume
+def test_sigterm_emergency_checkpoint_exact_resume(tmp_path, mnist_data,
+                                                   monkeypatch):
+    da, db = tmp_path / "a", tmp_path / "b"
+    da.mkdir(), db.mkdir()
+    confs = {}
+    for name, d in (("a", da), ("b", db)):
+        confs[name] = str(d / "t.conf")
+        with open(confs[name], "w") as f:
+            f.write(CONF.format(model_dir=str(d / "models"), num_round=3,
+                                **mnist_data))
+    task_a = run_task(confs["a"])                      # uninterrupted
+    # interrupted: SIGTERM after 9 updates = 3 batches into round 1
+    monkeypatch.setattr(Trainer, "update",
+                        fi.killing_method(Trainer.update, n=9))
+    task_b = run_task(confs["b"])
+    monkeypatch.undo()
+    emergency = str(db / "models" / ckpt.EMERGENCY_NAME)
+    assert os.path.exists(emergency)
+    assert task_b.start_counter == 2                   # stopped mid round 1
+    st = ckpt.peek_state(ckpt.read_verified(emergency)[0])
+    assert (st["start_counter"], st["batches_done"]) == (2, 3)
+    # resume completes rounds 1-2 from the emergency cursor
+    task_c = run_task(confs["b"], "continue=1")
+    assert task_c.start_counter == 4
+    assert not os.path.exists(emergency)   # superseded by numbered save
+    # bit-for-bit: metrics, rng stream, and every weight match the
+    # uninterrupted run exactly (CPU backend)
+    assert (task_c.net_trainer.metric.evals[0].get()
+            == task_a.net_trainer.metric.evals[0].get())
+    assert task_c.net_trainer._rng_counter == task_a.net_trainer._rng_counter
+    pa = task_a.net_trainer.canonical_params()
+    pc = task_c.net_trainer.canonical_params()
+    for la, lc in zip(pa, pc):
+        assert set(la) == set(lc)
+        for k in la:
+            assert np.array_equal(np.asarray(la[k]), np.asarray(lc[k])), k
+
+
+def test_sigterm_mid_accumulation_restores_grad_accum(tmp_path, mnist_data,
+                                                      monkeypatch):
+    """update_period=2 killed after an ODD update: the in-flight gradient
+    accumulator must survive the checkpoint for exact resume."""
+    da, db = tmp_path / "a", tmp_path / "b"
+    da.mkdir(), db.mkdir()
+    confs = {}
+    for name, d in (("a", da), ("b", db)):
+        confs[name] = str(d / "t.conf")
+        with open(confs[name], "w") as f:
+            f.write(CONF.format(model_dir=str(d / "models"), num_round=2,
+                                **mnist_data))
+    task_a = run_task(confs["a"], "update_period=2")
+    monkeypatch.setattr(Trainer, "update",
+                        fi.killing_method(Trainer.update, n=9))
+    run_task(confs["b"], "update_period=2")
+    monkeypatch.undo()
+    task_c = run_task(confs["b"], "continue=1", "update_period=2")
+    assert task_c.net_trainer.epoch_counter == task_a.net_trainer.epoch_counter
+    pa = task_a.net_trainer.canonical_params()
+    pc = task_c.net_trainer.canonical_params()
+    for la, lc in zip(pa, pc):
+        for k in la:
+            assert np.array_equal(np.asarray(la[k]), np.asarray(lc[k])), k
+
+
+# ----------------------------------------------------------------------
+# telemetry + fsck integration
+def test_ckpt_telemetry_events(tmp_path, trained):
+    from cxxnet_tpu.utils import telemetry
+    models = copy_models(trained, tmp_path)
+    fi.truncate(model(models, 3))
+    log = str(tmp_path / "run.jsonl")
+    try:
+        run_task(trained["conf"], "continue=1", "model_dir=%s" % models,
+                 "num_round=3", "telemetry_log=%s" % log)
+    finally:
+        telemetry.disable()
+    events = [json.loads(l) for l in open(log) if l.strip()]
+    by_ev = {}
+    for e in events:
+        by_ev.setdefault(e["ev"], []).append(e)
+    assert any(e["path"].endswith("0003.model")
+               for e in by_ev["ckpt_corrupt"])
+    assert any(e["path"].endswith("0002.model")
+               for e in by_ev["ckpt_restore"])
+    assert any(e["path"].endswith("0003.model") and e["bytes"] > 0
+               for e in by_ev["ckpt_save"])
+
+
+def test_fsck_flags_every_injected_corruption(tmp_path, trained, capsys):
+    models = copy_models(trained, tmp_path)
+    fi.truncate(model(models, 1))
+    fi.bit_flip(model(models, 2))
+    fi.make_stale_tmp(models)
+    assert ckpt_fsck.main([models]) == 1
+    out = capsys.readouterr().out
+    assert out.count("CORRUPT") == 2 and "STALE" in out
+    rep = {r["path"]: r for r in
+           (ckpt_fsck.inspect_file(model(models, c)) for c in range(4))}
+    statuses = [rep[model(models, c)]["status"] for c in range(4)]
+    assert statuses == ["ok", "corrupt", "corrupt", "ok"]
+    # clean dir passes (exit 0) and reports the training cursor
+    assert ckpt_fsck.main([trained["models"]]) == 0
+    assert ckpt_fsck.selftest() == 0

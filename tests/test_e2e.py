@@ -160,6 +160,31 @@ def test_mnist_mlp_continue_resume(tmp_path, mnist_data):
     assert os.path.exists(str(tmp_path / "models" / "0003.model"))
 
 
+def test_resume_matches_uninterrupted_run(tmp_path, mnist_data):
+    """continue=1 end-to-end: train 2 rounds, stop, resume to 4 — the final
+    metrics AND every weight must match an uninterrupted 4-round run
+    bit-for-bit (the checkpoint carries optimizer state, rng-stream
+    position, and round counters; CPU backend is deterministic)."""
+    da, db = tmp_path / "a", tmp_path / "b"
+    da.mkdir(), db.mkdir()
+    conf_a = write_conf(da, MLP_CONF, mnist_data, num_round=4)
+    task_a = run_task(conf_a)
+    conf_b = write_conf(db, MLP_CONF, mnist_data, num_round=2)
+    run_task(conf_b)
+    task_b = run_task(conf_b, "continue=1", "num_round=4")
+    assert task_b.start_counter == task_a.start_counter == 5
+    assert (task_b.net_trainer.metric.evals[0].get()
+            == task_a.net_trainer.metric.evals[0].get())
+    assert task_b.net_trainer._rng_counter == task_a.net_trainer._rng_counter
+    assert task_b.net_trainer.epoch_counter == task_a.net_trainer.epoch_counter
+    pa = task_a.net_trainer.canonical_params()
+    pb = task_b.net_trainer.canonical_params()
+    for la, lb in zip(pa, pb):
+        assert set(la) == set(lb)
+        for k in la:
+            assert np.array_equal(np.asarray(la[k]), np.asarray(lb[k])), k
+
+
 def test_mnist_pred_task(tmp_path, mnist_data):
     conf = write_conf(tmp_path, MLP_CONF, mnist_data, num_round=2)
     run_task(conf)

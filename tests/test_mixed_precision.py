@@ -185,10 +185,11 @@ eta = 0.1
                               "  moving_average = 1")
     net2 = api.Net(dev="cpu", cfg=ft_cfg)
     net2.init_model()
-    with open(path, "rb") as f:
-        r = serializer.Reader(f)
-        r.read_int32()  # net_type
-        net2.net_.copy_model_from(r)
+    from cxxnet_tpu.utils import checkpoint as ckpt
+    payload, _ = ckpt.read_verified(path)   # strip the integrity framing
+    r = serializer.Reader(payload)
+    r.read_int32()  # net_type
+    net2.net_.copy_model_from(r)
     assert "running_mean" in net2.net_.params[1]
     x = np.random.RandomState(0).rand(8, 12).astype(np.float32)
     y = np.zeros(8, np.float32)
