@@ -41,11 +41,13 @@ test-fast:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
 		-p no:cacheprovider
 
-# fast regression gate (no pytest, no jax): every module byte-compiles and
-# the checkpoint verifier still detects every corruption class — a
-# checkpoint-format regression fails here in seconds
+# fast regression gate (no pytest, no jax): every module byte-compiles,
+# the checkpoint verifier still detects every corruption class, and the
+# training-health detect->rollback->skip state machine still recovers —
+# a checkpoint-format or recovery-policy regression fails here in seconds
 check:
 	python -m compileall -q cxxnet_tpu tools tests
 	python tools/ckpt_fsck.py --selftest
+	python -m cxxnet_tpu.utils.health --selftest
 
 .PHONY: all clean test-fast check

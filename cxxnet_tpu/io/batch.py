@@ -20,6 +20,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..utils import health
 from ..utils import telemetry
 from .data import DataBatch, DataInst, IIterator
 
@@ -177,6 +178,11 @@ class ThreadBufferIterator(IIterator):
                             break
                         item = self.base.value().deep_copy()
                     telemetry.count("io.prefetch_batches")
+                    # watchdog liveness: beaten per produced batch AND per
+                    # queue-full poll tick, so only a producer genuinely
+                    # wedged inside base.next() (hung read, dead decoder)
+                    # goes silent — a full queue never false-alarms
+                    health.beat("io.prefetch")
                     while True:
                         if self._poll_stop():
                             return
@@ -184,8 +190,11 @@ class ThreadBufferIterator(IIterator):
                             self.q.put(item, timeout=0.1)
                             break
                         except queue.Full:
-                            pass
+                            health.beat("io.prefetch")
                 self.q.put(None)  # end marker
+                # between passes the loader legitimately idles at
+                # _cmd.get(): disarm so the watchdog doesn't false-alarm
+                health.pause("io.prefetch")
             except Exception as exc:   # surface in the consumer's next()
                 self.q.put(_LoaderError(exc))
                 return
@@ -202,13 +211,31 @@ class ThreadBufferIterator(IIterator):
         self._dead = item.exc
         raise item.exc
 
+    def _get_item(self):
+        """q.get that cannot hang on a dead producer: a loader thread that
+        died WITHOUT posting its end marker or a _LoaderError (a
+        BaseException like KeyboardInterrupt, a runtime teardown) would
+        otherwise block the consumer forever — exactly the wedge the
+        health watchdog exists to catch; here we fail fast instead."""
+        while True:
+            try:
+                return self.q.get(timeout=1.0)
+            except queue.Empty:
+                if self.thread is None or not self.thread.is_alive():
+                    self._pass_started = False
+                    self._dead = RuntimeError(
+                        "ThreadBufferIterator: prefetch thread died "
+                        "without delivering a batch or an end marker")
+                    telemetry.count("io.prefetch_thread_deaths")
+                    raise self._dead
+
     def before_first(self):
         if self._dead is not None:
             raise self._dead
         # drain any in-flight pass
         if self._pass_started:
             while True:
-                item = self.q.get()
+                item = self._get_item()
                 if item is None:
                     break
                 if isinstance(item, _LoaderError):
@@ -221,7 +248,7 @@ class ThreadBufferIterator(IIterator):
             raise self._dead
         if not self._pass_started:
             self.before_first()
-        item = self.q.get()
+        item = self._get_item()
         if isinstance(item, _LoaderError):
             self._raise_dead(item)
         if item is None:

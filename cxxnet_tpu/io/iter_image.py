@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import math
 import os
+import sys
 from typing import List, Optional
 
 import numpy as np
@@ -32,6 +33,20 @@ from ..utils import telemetry
 from ..utils.binary_page import BinaryPage, KPAGE_INTS
 from .data import DataBatch, DataInst, IIterator
 from .batch import BatchAdaptIterator
+
+
+class RecordDecodeError(ValueError):
+    """A single record's bytes do not decode to an image (corrupt jpeg,
+    torn record, or a decode worker that had to be presumed dead). The
+    page iterator skips + quarantines such records (``skip_corrupt=1``)
+    instead of crashing the run."""
+
+
+class PackReadError(RuntimeError):
+    """The .bin pack ended or went unreadable before the .lst did —
+    a truncated or corrupt pack file. Record/label alignment past this
+    point is unrecoverable, so the epoch ends early (counted, warned,
+    never a crash) rather than serving mislabeled images."""
 
 
 def _decode_rgb_chw(buf: bytes) -> np.ndarray:
@@ -47,7 +62,9 @@ def _decode_rgb_chw(buf: bytes) -> np.ndarray:
         import cv2
         arr = np.frombuffer(buf, dtype=np.uint8)
         bgr = cv2.imdecode(arr, cv2.IMREAD_COLOR)
-        assert bgr is not None, "decoding fail"
+        if bgr is None:
+            raise RecordDecodeError(
+                "undecodable image record (%d bytes)" % len(buf))
         rgb = bgr[:, :, ::-1]
         return np.ascontiguousarray(
             rgb.transpose(2, 0, 1).astype(np.float32))
@@ -115,6 +132,18 @@ class ImagePageIterator(IIterator):
         self._pool = None
         self._pending = None
         self._lst_done = False
+        # data-pipeline fault tolerance (doc/robustness.md): with
+        # skip_corrupt=1 (default) a corrupt/truncated record is skipped,
+        # counted (io.corrupt_records) and quarantined by instance index —
+        # later epochs drop it before decode; a truncated pack ends the
+        # epoch early instead of crashing. decode_timeout>0 bounds one
+        # record's decode: a worker wedged past it is presumed dead, the
+        # pool is rebuilt (pending decodes resubmitted) and the record is
+        # quarantined.
+        self.skip_corrupt = 1
+        self.decode_timeout = 0.0
+        self._quarantined = set()
+        self._corrupt_seen = 0
         # shuffle=1 (reference iter_thread_imbin_x-inl.hpp:161-195,253-286):
         # part-file order is re-permuted every epoch, and instances are
         # shuffled within a seeded sliding window (the TPU-first analog of
@@ -160,6 +189,10 @@ class ImagePageIterator(IIterator):
             self.shuffle_window = int(val)
             assert self.shuffle_window >= 1, \
                 "shuffle_window must be >= 1 (1 = stream order)"
+        if name == "skip_corrupt":
+            self.skip_corrupt = int(val)
+        if name == "decode_timeout":
+            self.decode_timeout = float(val)
 
     def _parse_image_conf(self):
         """Multi-part list + distributed sharding
@@ -248,15 +281,22 @@ class ImagePageIterator(IIterator):
         # (src/core/binary_page.cc PageReader)
         if self.native_reader is not None:
             obj = self.native_reader.next_obj()
-            assert obj is not None, \
-                "binary pack exhausted before list file"
+            if obj is None:
+                raise PackReadError("binary pack exhausted before list "
+                                    "file (truncated pack?)")
             return obj
         while self.page is None or self.ptop >= self.page.size():
-            page = BinaryPage.load(self.fbin, self.page_ints)
+            try:
+                page = BinaryPage.load(self.fbin, self.page_ints)
+            except Exception as e:   # garbage page header/layout
+                raise PackReadError(
+                    "corrupt BinaryPage in %s: %s"
+                    % (self._epoch_bin_paths[self.bin_idx], e))
             if page is None:
                 self.bin_idx += 1
-                assert self.bin_idx < len(self._epoch_bin_paths), \
-                    "binary pack exhausted before list file"
+                if self.bin_idx >= len(self._epoch_bin_paths):
+                    raise PackReadError("binary pack exhausted before "
+                                        "list file (truncated pack?)")
                 self.fbin.close()
                 self.fbin = open(self._epoch_bin_paths[self.bin_idx], "rb")
                 continue
@@ -267,12 +307,43 @@ class ImagePageIterator(IIterator):
         return obj
 
     def _next_pair(self):
-        """Next (index, label, jpeg-bytes) in on-disk stream order."""
-        rec = self.lst.next_record()
-        if rec is None:
-            return None
-        index, label, _ = rec
-        return index, label, self._next_buffer()
+        """Next (index, label, jpeg-bytes) in on-disk stream order;
+        quarantined (previously-corrupt) indices are consumed and
+        dropped, and a truncated/corrupt pack ends the epoch early."""
+        while True:
+            rec = self.lst.next_record()
+            if rec is None:
+                return None
+            index, label, _ = rec
+            try:
+                buf = self._next_buffer()
+            except PackReadError as e:
+                if not self.skip_corrupt:
+                    raise
+                telemetry.count("io.truncated_pack")
+                telemetry.event({"ev": "data_corrupt", "source": "imgbin",
+                                 "index": int(index),
+                                 "reason": "pack: %s" % e})
+                sys.stderr.write("WARNING: %s; ending epoch early\n" % e)
+                return None
+            if int(index) in self._quarantined:
+                continue
+            return index, label, buf
+
+    def _note_corrupt(self, index, reason) -> None:
+        """Skip + count + quarantine a corrupt record by instance index:
+        later epochs drop it before decode, so one bad jpeg costs one
+        warning, never the run."""
+        self._quarantined.add(int(index))
+        self._corrupt_seen += 1
+        telemetry.count("io.corrupt_records")
+        telemetry.event({"ev": "data_corrupt", "source": "imgbin",
+                         "index": int(index),
+                         "reason": str(reason)[:200]})
+        if self.silent == 0 and self._corrupt_seen <= 10:
+            sys.stderr.write(
+                "WARNING: imgbin record %d undecodable (%s); skipped and "
+                "quarantined by index\n" % (int(index), reason))
 
     def _next_shuffled(self):
         """Instance-level shuffle: draw uniformly from a seeded window of
@@ -292,33 +363,93 @@ class ImagePageIterator(IIterator):
             self._window[-1], self._window[j]
         return self._window.pop()
 
+    def _new_pool(self):
+        from concurrent.futures import ThreadPoolExecutor
+        return ThreadPoolExecutor(max_workers=self.decode_thread,
+                                  thread_name_prefix="cxn-decode")
+
+    def _fill_pending(self) -> None:
+        if self._pool is None:
+            self._pool = self._new_pool()
+        while (len(self._pending) < self.buffer_size
+               and not self._lst_done):
+            p = self._next_shuffled()
+            if p is None:
+                self._lst_done = True
+                break
+            index, label, buf = p
+            # buf rides the tuple so a pool restart can resubmit it
+            self._pending.append(
+                (index, label, buf, self._pool.submit(_decode_rgb_chw,
+                                                      buf)))
+
+    def _restart_pool(self) -> None:
+        """Tear down a pool with a presumed-dead worker and resubmit the
+        still-pending decodes to a fresh one. The wedged worker thread
+        itself cannot be killed from Python — it is orphaned; nothing
+        waits on it anymore."""
+        try:
+            self._pool.shutdown(wait=False, cancel_futures=True)
+        except Exception:
+            pass
+        self._pool = self._new_pool()
+        from collections import deque
+        self._pending = deque(
+            (i, l, b, self._pool.submit(_decode_rgb_chw, b))
+            for (i, l, b, _f) in self._pending)
+        telemetry.count("io.decode_worker_restarts")
+
+    def _take_decoded(self, index, fut) -> np.ndarray:
+        if self.decode_timeout <= 0:
+            return fut.result()
+        from concurrent.futures import TimeoutError as _FutTimeout
+        try:
+            return fut.result(timeout=self.decode_timeout)
+        except _FutTimeout:
+            # dead/hung decode worker: telemetry first (the stall event
+            # the report surfaces), then restart the worker pool
+            telemetry.event({"ev": "watchdog_stall", "channel": "io.decode",
+                             "stalled_s": self.decode_timeout,
+                             "timeout_s": self.decode_timeout,
+                             "index": int(index),
+                             "action": "restart_pool"})
+            telemetry.flush()
+            self._restart_pool()
+            raise RecordDecodeError(
+                "decode of record %d exceeded decode_timeout=%.2fs "
+                "(worker presumed dead; pool restarted)"
+                % (int(index), self.decode_timeout))
+
     def next(self) -> bool:
         if self.decode_thread > 1:
-            if self._pool is None:
-                from concurrent.futures import ThreadPoolExecutor
-                self._pool = ThreadPoolExecutor(
-                    max_workers=self.decode_thread,
-                    thread_name_prefix="cxn-decode")
-            while (len(self._pending) < self.buffer_size
-                   and not self._lst_done):
-                p = self._next_shuffled()
-                if p is None:
-                    self._lst_done = True
-                    break
-                index, label, buf = p
-                self._pending.append(
-                    (index, label, self._pool.submit(_decode_rgb_chw, buf)))
-            if not self._pending:
+            while True:
+                self._fill_pending()
+                if not self._pending:
+                    return False
+                index, label, buf, fut = self._pending.popleft()
+                try:
+                    data = self._take_decoded(index, fut)
+                except RecordDecodeError as e:
+                    if not self.skip_corrupt:
+                        raise
+                    self._note_corrupt(index, e)
+                    continue
+                self.out = DataInst(data, label, index)
+                return True
+        while True:
+            p = self._next_shuffled()
+            if p is None:
                 return False
-            index, label, fut = self._pending.popleft()
-            self.out = DataInst(fut.result(), label, index)
+            index, label, buf = p
+            try:
+                data = _decode_rgb_chw(buf)
+            except RecordDecodeError as e:
+                if not self.skip_corrupt:
+                    raise
+                self._note_corrupt(index, e)
+                continue
+            self.out = DataInst(data, label, index)
             return True
-        p = self._next_shuffled()
-        if p is None:
-            return False
-        index, label, buf = p
-        self.out = DataInst(_decode_rgb_chw(buf), label, index)
-        return True
 
     def value(self) -> DataInst:
         return self.out
@@ -399,8 +530,7 @@ class ImageIterator(IIterator):
         return self.out
 
     def close(self) -> None:
-        if self.lst is not None:
-            self.lst.close()
+        pass   # records are (index, label, fname) tuples; no handles held
 
 
 class GeometricAugmenter:

@@ -18,6 +18,7 @@ import numpy as np
 from .io import create_iterator
 from .nnet.trainer import Trainer, create_net
 from .utils import checkpoint as ckpt
+from .utils import health
 from .utils import serializer
 from .utils import telemetry
 from .utils.config import ConfigIterator
@@ -64,6 +65,26 @@ class LearnTask:
         self.ckpt_retries = 2
         self.ckpt_fsync = 1
         self.preempt_save = 1
+        # training-health watchdog + automatic recovery (utils/health.py,
+        # doc/robustness.md): health_monitor=1 turns on per-step
+        # non-finite/loss-spike detection; on anomaly the policy rolls
+        # back to the newest valid checkpoint, replays with the offending
+        # batch window quarantined (nonfinite_action=rollback), suppresses
+        # the bad update on device (skip), or dies with a diagnostic dump
+        # (abort / retries exhausted). watchdog_timeout>0 starts a thread
+        # that dumps all-thread stacks when the step loop or the prefetch
+        # pipeline goes silent.
+        self.health_monitor = 0
+        self.nonfinite_action = "rollback"
+        self.loss_spike_factor = 0.0     # 0 = spike detection off
+        self.loss_spike_warmup = 20
+        self.rollback_backoff = 1.0      # LR scale per rollback (1 = off)
+        self.rollback_max_retries = 2
+        self.watchdog_timeout = 0.0      # seconds; 0 = watchdog off
+        self.watchdog_action = "warn"
+        self._health: Optional[health.HealthMonitor] = None
+        self._recovery: Optional[health.RecoveryPolicy] = None
+        self._start_counter_conf = False
         # resume cursor recovered from a checkpoint's training-state
         # section: applied right before the train loop (after the
         # continue-path eval, which must not consume the restored rng)
@@ -155,6 +176,7 @@ class LearnTask:
             self.save_period = int(val)
         if name == "start_counter":
             self.start_counter = int(val)
+            self._start_counter_conf = True
         if name == "model_in":
             self.name_model_in = val
         if name == "model_dir":
@@ -185,6 +207,22 @@ class LearnTask:
             self.ckpt_fsync = int(val)
         if name == "preempt_save":
             self.preempt_save = int(val)
+        if name == "health_monitor":
+            self.health_monitor = int(val)
+        if name == "nonfinite_action":
+            self.nonfinite_action = val
+        if name == "loss_spike_factor":
+            self.loss_spike_factor = float(val)
+        if name == "loss_spike_warmup":
+            self.loss_spike_warmup = int(val)
+        if name == "rollback_backoff":
+            self.rollback_backoff = float(val)
+        if name == "rollback_max_retries":
+            self.rollback_max_retries = int(val)
+        if name == "watchdog_timeout":
+            self.watchdog_timeout = float(val)
+        if name == "watchdog_action":
+            self.watchdog_action = val
         if name == "coordinator":
             self.coordinator = val
         if name == "num_worker":
@@ -333,8 +371,19 @@ class LearnTask:
         try:
             self.start_counter = int(base.split(".")[0])
         except ValueError:
-            print("WARNING: Cannot infer start_counter from model name. "
-                  "Specify it in config if needed")
+            # proceeding with a guessed counter silently mis-numbers every
+            # subsequent checkpoint (and the continue=1 scan keyed on it),
+            # so for TRAINING an un-inferable name is an error unless the
+            # config pins the counter explicitly. Inference tasks (pred /
+            # extract / export / generate / serve) never use the counter —
+            # arbitrary model names stay fine there.
+            if not self._start_counter_conf and self.task == "train":
+                raise ValueError(
+                    "Cannot infer start_counter from model name %r: "
+                    "expected '<counter>.model' (the save_model naming, "
+                    "e.g. 0042.model). Rename the file or set "
+                    "start_counter=<n> in the config." % self.name_model_in
+                ) from None
         r = self._read_model_file(self.name_model_in)
         self.net_type = r.read_int32()
         self.net_trainer = self._create_net()
@@ -512,12 +561,29 @@ class LearnTask:
         if self.preempt_save and not enabled and not self.silent:
             print("preempt_save: disabled (multi-process run — emergency "
                   "checkpoints require single-process training)")
+        if self.health_monitor:
+            self._health = health.HealthMonitor(
+                spike_factor=self.loss_spike_factor,
+                spike_warmup=self.loss_spike_warmup)
+            self._recovery = health.RecoveryPolicy(
+                action=self.nonfinite_action,
+                backoff=self.rollback_backoff,
+                max_retries=self.rollback_max_retries)
+        wd = None
+        if self.watchdog_timeout > 0:
+            # the step channel arms itself at the FIRST completed batch
+            # (pre-arming would false-alarm on a first-compile longer
+            # than the timeout) and is paused across eval/checkpoint
+            wd = health.Watchdog(self.watchdog_timeout,
+                                 action=self.watchdog_action).start()
         with ckpt.PreemptionGuard(enabled=enabled) as guard:
             self._preempt = guard
             try:
                 self._task_train_loop(start)
             finally:
                 self._preempt = None
+                if wd is not None:
+                    wd.stop()
 
     def _task_train_loop(self, start: float) -> None:
         if self.continue_training == 0 and self.name_model_in == "NULL":
@@ -556,10 +622,22 @@ class LearnTask:
             # the per-invocation cap (max_round) — always checkpoints, so
             # a clean exit never loses finished rounds to save_period gaps
             last_round = (cc == 0 or self.start_counter == self.num_round)
-            with telemetry.span("round", round=rnd):
-                stats = self._train_one_round(
-                    start, skip_batches=self._resume_batches,
-                    final_round=last_round)
+            try:
+                with telemetry.span("round", round=rnd):
+                    stats = self._train_one_round(
+                        start, skip_batches=self._resume_batches,
+                        final_round=last_round)
+            except health.TrainingAnomalyError as e:
+                # rollback: restore the newest valid checkpoint and
+                # re-enter the loop; the offending batch window is
+                # quarantined so the replay excludes it. (A rollback
+                # attempt consumes one unit of the max_round budget —
+                # irrelevant at the default cap, and it bounds a
+                # pathological rollback storm under a tight one.)
+                self._recover_from_anomaly(e.anomaly)
+                continue
+            if self._recovery is not None:
+                self._recovery.on_round_complete()
             self._resume_batches = 0
             t_input, t_step, t_eval, t_ckpt, n_img = stats
             wall = t_input + t_step
@@ -609,6 +687,8 @@ class LearnTask:
         (next+value) vs in the device step is the number that says
         whether the loader keeps up."""
         sample_counter = 0
+        hm = self._health
+        rnd = self.start_counter - 1
         self.net_trainer.start_round(self.start_counter)
         self.itr_train.before_first()
         t_input = t_step = t_eval = t_ckpt = 0.0
@@ -630,6 +710,19 @@ class LearnTask:
                       % (batches_done, self.start_counter - 1))
         while True:
             t0 = time.perf_counter()
+            if self._recovery is not None \
+                    and self._recovery.should_skip(rnd, batches_done):
+                # quarantined batch window (a prior anomaly): fast-forward
+                # the data cursor past it without training — the rollback
+                # replay's exclusion of the offending batch
+                if self.itr_train.skip(1) == 0:
+                    break
+                telemetry.event({"ev": "health_skip_batch", "round": rnd,
+                                 "batch": batches_done})
+                telemetry.count("health/batches_skipped")
+                sample_counter += 1
+                batches_done += 1
+                continue
             if not self.itr_train.next():
                 break
             batch = self.itr_train.value()
@@ -642,6 +735,14 @@ class LearnTask:
             if self.test_io == 0:
                 self.net_trainer.update(batch)
                 t_step += time.perf_counter() - t1
+                if hm is not None:
+                    # check the PREVIOUS step's health vector (pipelined:
+                    # its compute is done, the fetch cannot stall us)
+                    anomaly = hm.observe(rnd, batches_done,
+                                         self.net_trainer.last_health)
+                    if anomaly is not None:
+                        self._on_anomaly(anomaly)
+            health.beat("train.step")
             n_img += batch.batch_size - batch.num_batch_padd
             sample_counter += 1
             batches_done += 1
@@ -654,10 +755,27 @@ class LearnTask:
                 # with the iterator cursor, then a clean exit — the
                 # user-level checkpoint/restore recovery contract
                 t0 = time.perf_counter()
-                self._save_emergency(batches_done)
+                bad = hm.drain() if hm is not None else None
+                if bad is not None:
+                    # never persist post-anomaly state as a checkpoint:
+                    # resume restarts from the last numbered one instead
+                    telemetry.event({"ev": "health_anomaly_at_preempt",
+                                     "anomaly": bad.id})
+                else:
+                    self._save_emergency(batches_done)
                 t_ckpt = time.perf_counter() - t0
                 self._stop_training = True
                 return t_input, t_step, t_eval, t_ckpt, n_img
+        # eval + checkpoint are legitimately step-silent: disarm the step
+        # channel so the watchdog doesn't false-alarm (re-armed by the
+        # next round's first batch)
+        health.pause("train.step")
+        if hm is not None:
+            # settle the round's health BEFORE eval/checkpoint: a bad
+            # final step must roll back, never be saved as "good"
+            anomaly = hm.drain()
+            if anomaly is not None:
+                self._on_anomaly(anomaly)
         if self.test_io == 0:
             t0 = time.perf_counter()
             sys.stderr.write("[%d]" % self.start_counter)
@@ -682,6 +800,86 @@ class LearnTask:
                 self._save_emergency(0)
             self._stop_training = True
         return t_input, t_step, t_eval, t_ckpt, n_img
+
+    # ------------------------------------------------------------------
+    # training-health recovery (utils/health.py, doc/robustness.md)
+    def _on_anomaly(self, anomaly) -> None:
+        """Route a detected anomaly through the recovery policy: 'skip'
+        logs and continues (the device guard already suppressed the bad
+        update), 'rollback' unwinds the round via TrainingAnomalyError,
+        'abort' dumps diagnostics and dies."""
+        decision = self._recovery.decide(anomaly)
+        if decision == "skip":
+            # the on-device guard only suppresses NON-FINITE steps; a
+            # finite loss spike in skip mode was APPLIED to the weights
+            # and is logged, not suppressed — event + counter say which
+            suppressed = anomaly.kind == "nonfinite"
+            if not self.silent:
+                print("health: %s -> %s" % (
+                    anomaly.describe(),
+                    "skip (update suppressed on device)" if suppressed
+                    else "logged (skip mode does not suppress finite "
+                         "spikes)"))
+            telemetry.event({"ev": "health_skip", "anomaly": anomaly.id,
+                            "kind": anomaly.kind, "round": anomaly.round,
+                             "batch": anomaly.batch,
+                             "suppressed": suppressed})
+            telemetry.count("health/updates_suppressed" if suppressed
+                            else "health/spikes_logged")
+            return
+        if not self.silent:
+            print("health: %s -> %s" % (anomaly.describe(), decision))
+        if decision == "abort":
+            reason = ("nonfinite_action=abort" if self.nonfinite_action ==
+                      "abort" else "%d consecutive rollbacks exhausted "
+                      "rollback_max_retries=%d" % (self._recovery.retries,
+                                                   self.rollback_max_retries))
+            telemetry.event({"ev": "health_abort", "anomaly": anomaly.id,
+                             "reason": reason})
+            health.dump_diagnostics(reason, anomaly)
+            raise RuntimeError(
+                "health: training anomaly (%s); aborting: %s"
+                % (anomaly.describe(), reason))
+        raise health.TrainingAnomalyError(anomaly)
+
+    def _recover_from_anomaly(self, anomaly) -> None:
+        """Roll back to the newest valid checkpoint and let the train
+        loop re-enter the restored round; the offending batch window is
+        excluded on replay (RecoveryPolicy.should_skip) and the
+        accumulated LR backoff is re-applied to the fresh trainer."""
+        pol = self._recovery
+        telemetry.event({"ev": "health_rollback", "anomaly": anomaly.id,
+                         "retry": pol.retries, "round": anomaly.round,
+                         "batch": anomaly.batch, "lr_scale": pol.lr_scale,
+                         "skip": pol.skipped()})
+        telemetry.count("health/rollbacks")
+        health.pause("train.step")   # checkpoint reload is step-silent
+        self._health.reset_pending()
+        self._resume_state = None
+        self._resume_batches = 0
+        # any valid checkpoint qualifies: drop the scan floor before the
+        # rescan (it normally encodes "don't resume older than the run's
+        # own progress", which is exactly what a rollback must undo)
+        self.start_counter = 0
+        if self._sync_latest_model() == 0:
+            raise RuntimeError(
+                "health: anomaly at round %d batch %d requires a rollback "
+                "but no valid checkpoint exists in %s (save_model=0?); "
+                "cannot recover" % (anomaly.round, anomaly.batch,
+                                    self.name_model_dir))
+        if not self.silent:
+            print("health: rolled back to round %d (retry %d/%d, lr x%g)"
+                  % (self.start_counter - 1, pol.retries,
+                     self.rollback_max_retries, pol.lr_scale))
+        if self._resume_state is not None:
+            self.net_trainer.restore_training_state(self._resume_state)
+            self._resume_state = None
+        if not self._iter_chain_stable(self.itr_train):
+            print("WARNING: the training iterator's epoch order is not "
+                  "replay-stable (windowed shuffle); the rollback replay "
+                  "sees a different batch order and the quarantined "
+                  "window is positional — recovery is approximate")
+        self.net_trainer.scale_lr(pol.lr_scale)
 
     @staticmethod
     def _print_telemetry_summary(summary: dict) -> None:

@@ -83,6 +83,17 @@ class Trainer:
         self.fsdp = 0               # ZeRO-3 param sharding over data
         self.clip_global_norm = 0.0  # 0 -> off (per-tensor clip_gradient
         #                              remains the reference-parity knob)
+        # health_monitor=1: every train step additionally returns a tiny
+        # on-device health vector [loss, grad_norm_sq, nan_grad_elems, ok]
+        # computed INSIDE the jitted program — no extra device sync; the
+        # host-side monitor (utils/health.py, wired by learn_task) reads
+        # it one step late. nonfinite_action="skip" further guards the
+        # step on device: a non-finite loss/grad keeps the old
+        # params/opt/accumulators (jnp.where select), so one bad batch
+        # can never poison the weights even without a rollback.
+        self.health_monitor = 0
+        self.nonfinite_action = "rollback"
+        self.last_health = None     # device array of the LAST step's vector
         self.metric = MetricSet()
         self.train_metric = MetricSet()
         self.eval_node_names: List[Optional[str]] = []  # None -> last node
@@ -141,6 +152,12 @@ class Trainer:
             self.fsdp = int(val)
         if name == "clip_global_norm":
             self.clip_global_norm = float(val)
+        if name == "health_monitor":
+            self.health_monitor = int(val)
+        if name == "nonfinite_action":
+            check(val in ("rollback", "skip", "abort"),
+                  "nonfinite_action must be rollback, skip, or abort")
+            self.nonfinite_action = val
         if name == "compute_dtype":
             check(val in ("float32", "bfloat16", "bf16"),
                   "compute_dtype must be float32 or bfloat16")
@@ -958,12 +975,38 @@ class Trainer:
         return new_params, new_opt
 
     def _make_train_step(self, do_update: bool, accumulate: bool,
-                         with_accum: bool, with_stats: bool):
+                         with_accum: bool, with_stats: bool,
+                         with_health: bool = False):
+        # with_health: the step returns [loss, grad_norm_sq,
+        # nan_grad_elems, ok] as a 4-float device vector — computed in
+        # the compiled program over the FRESH (pre-accumulation) grads,
+        # so detection pins the offending batch, not the running sum.
+        # guard (nonfinite_action="skip"): additionally suppress the
+        # whole state transition on device when the step is non-finite.
+        guard = with_health and self.nonfinite_action == "skip"
+
         def step(params, opt_state, grad_accum, metric_accum,
                  data, label, epoch, rng):
-            grads, (stats, state_ups) = jax.grad(
+            (loss, (stats, state_ups)), grads = jax.value_and_grad(
                 self._loss_fn, has_aux=True)(params, data, label, rng,
                                              epoch, with_stats)
+            health = None
+            ok = None
+            if with_health:
+                leaves = jax.tree_util.tree_leaves(grads)
+                gn_sq = sum(jnp.vdot(g, g) for g in leaves) \
+                    .astype(jnp.float32)
+                # the elements updater._clip_nan would silently zero
+                # (telemetry counter health/nan_grads_zeroed, read by the
+                # host monitor)
+                nan_elems = sum(jnp.sum(jnp.isnan(g)) for g in leaves)
+                lossf = loss.astype(jnp.float32)
+                ok = jnp.isfinite(lossf) & jnp.isfinite(gn_sq)
+                health = jnp.stack([lossf, gn_sq,
+                                    nan_elems.astype(jnp.float32),
+                                    ok.astype(jnp.float32)])
+            if guard:
+                prev = (params, opt_state, grad_accum, metric_accum)
             if accumulate:
                 grads = jax.tree.map(jnp.add, grad_accum, grads)
             if do_update:
@@ -998,10 +1041,25 @@ class Trainer:
                                 jnp.ravel(val).astype(pk.dtype))
             if with_stats:
                 metric_accum = metric_accum + stats
+            if guard:
+                # non-finite step: keep EVERY piece of the old state
+                # (params, optimizer, grad accumulation, metric sums) —
+                # the bad batch contributes nothing, training continues.
+                # Referencing both the donated inputs and the updated
+                # values is fine: the program is functional; donation is
+                # a buffer-aliasing hint, not a consume.
+                def sel(n, o):
+                    return jnp.where(ok, n, o)
+                params = jax.tree.map(sel, params, prev[0])
+                opt_state = jax.tree.map(sel, opt_state, prev[1])
+                if with_accum:
+                    grads = jax.tree.map(sel, grads, prev[2])
+                if with_stats:
+                    metric_accum = sel(metric_accum, prev[3])
             # when update_period == 1 no grad-accumulator state is carried
             # at all (no params-sized zero tree in HBM, no donate/add)
             return (params, opt_state,
-                    grads if with_accum else None, metric_accum)
+                    grads if with_accum else None, metric_accum, health)
 
         jitted = jax.jit(step, donate_argnums=(0, 1, 2, 3))
         return jitted
@@ -1032,12 +1090,15 @@ class Trainer:
         return self._jit_cache[key]
 
     def _get_step(self, do_update: bool, accumulate: bool,
-                  with_accum: bool, with_stats: bool):
-        k = ("train", do_update, accumulate, with_accum, with_stats)
+                  with_accum: bool, with_stats: bool,
+                  with_health: bool = False):
+        k = ("train", do_update, accumulate, with_accum, with_stats,
+             with_health)
         return self._watched_jit(
             k, "jit.train_step",
             lambda: self._make_train_step(do_update, accumulate,
-                                          with_accum, with_stats))
+                                          with_accum, with_stats,
+                                          with_health))
 
     def _shard_batch(self, arr):
         telemetry.count("io.h2d_bytes", int(getattr(arr, "nbytes", 0) or 0))
@@ -1082,8 +1143,9 @@ class Trainer:
         accumulate = self.sample_counter % self.update_period != 0
         with_accum = self.update_period > 1
         with_stats = self.eval_train != 0 and len(self.train_metric) > 0
+        with_health = self.health_monitor != 0
         step = self._get_step(need_update, accumulate, with_accum,
-                              with_stats)
+                              with_stats, with_health)
         with telemetry.span("train.h2d"):
             data = self._shard_batch(batch.data)
             label = self._shard_batch(batch.label)
@@ -1098,8 +1160,8 @@ class Trainer:
         # jit watch separates out) — execution is async; the input-wait
         # fraction the train loop reports is what exposes device stalls
         with telemetry.span("train.step"):
-            self.params, self.opt_state, self.grad_accum, \
-                self._metric_accum = \
+            (self.params, self.opt_state, self.grad_accum,
+             self._metric_accum, self.last_health) = \
                 step(self.params, self.opt_state, self.grad_accum,
                      self._metric_accum, data, label,
                      jnp.asarray(self.epoch_counter, jnp.int32),
@@ -1113,6 +1175,21 @@ class Trainer:
         if self.sample_counter >= self.update_period:
             self.sample_counter = 0
             self.epoch_counter += 1
+
+    def scale_lr(self, factor: float) -> None:
+        """Multiply every updater's base learning rate by ``factor`` —
+        the health policy's rollback backoff (learn_task applies the
+        ACCUMULATED scale after each checkpoint restore, since a restore
+        rebuilds the updaters at their configured LR). base_lr is a
+        trace-time constant, so the jit cache is cleared and the next
+        step recompiles; backoffs are rare by construction."""
+        if factor == 1.0:
+            return
+        for ups in self.updaters:
+            for up in ups.values():
+                up.param.base_lr *= factor
+        telemetry.count("health.lr_backoff")
+        self._clear_jit_cache()
 
     # ------------------------------------------------------------------
     def _eval_values(self, params, data, rng, node_ids):

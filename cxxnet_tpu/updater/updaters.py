@@ -46,7 +46,15 @@ def decode_tag(key: int) -> str:
 
 
 def _clip_nan(g, bound):
-    """Gradient clip that also zeroes NaNs (reference struct clip)."""
+    """Gradient clip that also zeroes NaNs (reference struct clip).
+
+    The zeroing cannot count host-side from in here (it runs inside the
+    jitted step), so visibility comes from the trainer's health scalars:
+    with ``health_monitor=1`` the step counts NaN gradient elements on
+    device (nnet/trainer.py ``_make_train_step``) and the host monitor
+    accumulates them into the ``health/nan_grads_zeroed`` telemetry
+    counter (utils/health.py) — the corruption this clip used to mask
+    silently now shows up in the run log."""
     g = jnp.where(jnp.isnan(g), 0.0, g)
     return jnp.clip(g, -bound, bound)
 

@@ -115,19 +115,27 @@ class MetricLogloss(IMetric):
         if pred.shape[1] != 1:
             tgt = labels[:, 0].astype(np.int64)
             p = np.clip(pred[np.arange(n), tgt], 1e-15, 1.0 - 1e-15)
-            self.sum_metric += float(-np.sum(np.log(p)))
+            res = -np.log(p)
         else:
             p = np.clip(pred[:, 0], 1e-15, 1.0 - 1e-15)
             y = labels[:, 0]
             res = -(y * np.log(p) + (1.0 - y) * np.log(1.0 - p))
-            if np.any(np.isnan(res)):
-                raise FloatingPointError("NaN detected in logloss")
-            self.sum_metric += float(np.sum(res))
-        self.cnt_inst += n
+        bad = ~np.isfinite(res)
+        if bad.any():
+            # non-finite rows (NaN predictions/labels) are excluded from
+            # both sum and count, surfaced as a health event (warn +
+            # health/nonfinite_metric counter) — the jit path below can't
+            # raise, so the reference's host-only FloatingPointError was
+            # an inconsistent contract
+            from . import health
+            health.note_nonfinite("logloss", int(bad.sum()))
+            res = res[~bad]
+        self.sum_metric += float(np.sum(res))
+        self.cnt_inst += int(res.shape[0])
 
     def device_stats(self, pred, labels):
-        # no in-trace NaN raise (jit can't); NaNs surface in the printed
-        # value instead
+        # no in-trace NaN raise (jit can't); NaNs surface at absorb()
+        # time as the same health event the host path emits
         import jax.numpy as jnp
         n = pred.shape[0]
         if pred.shape[1] != 1:
@@ -233,10 +241,17 @@ class MetricSet:
 
     def absorb(self, stats) -> None:
         """Fold a fetched (n_metrics, 2) stats array (the on-device
-        accumulator) into the host counters."""
+        accumulator) into the host counters. A non-finite device sum is
+        kept (the printed value shows nan — visible) but routed through
+        the same health event the host path emits, so the jit path no
+        longer passes NaNs SILENTLY."""
         stats = np.asarray(stats)
         for i, e in enumerate(self.evals):
-            e.sum_metric += float(stats[i, 0])
+            s = float(stats[i, 0])
+            if not np.isfinite(s):
+                from . import health
+                health.note_nonfinite("train-metric:%s" % e.name)
+            e.sum_metric += s
             e.cnt_inst += int(round(float(stats[i, 1])))
 
     def print_str(self, evname: str) -> str:

@@ -14,9 +14,16 @@ preemptible fleet:
                         like a cluster scheduler's grace signal)
 * ``failing_once`` / ``always_failing`` — monkeypatch payloads for
                         rename-failure and disk-full (ENOSPC) simulation
+* ``poison_batch`` / ``spoof_health`` / ``recording_update``
+                      — Trainer.update wrappers for training-health
+                        fault injection: NaN batches, deterministic loss
+                        spikes, and the clean-run-minus-batch control
+* ``make_imgbin``     — .lst + .bin fixture from raw record bytes
+                        (including deliberately undecodable garbage)
 
 These are plain file/process manipulations so they compose with any
-test runner; tests/test_checkpoint_faults.py drives them end-to-end.
+test runner; tests/test_checkpoint_faults.py and
+tests/test_health_faults.py drive them end-to-end.
 """
 
 from __future__ import annotations
@@ -116,3 +123,99 @@ def always_failing(exc: BaseException = None):
         raise err
 
     return wrapper
+
+
+# ----------------------------------------------------------------------
+# training-health fault injection (tests/test_health_faults.py)
+def _batch_key_hit(trainer, batch, round_, first_index):
+    """Content-based batch key: (trainer round, first instance id).
+
+    Keyed on CONTENT rather than a call counter because a health
+    rollback REPLAYS the round with the offending batch skipped — call
+    counting would shift and poison an innocent neighbor on replay.
+    ``first_index=None`` matches every batch."""
+    if first_index is None:
+        return True
+    if batch.inst_index is None or not len(batch.inst_index):
+        return False
+    return (getattr(trainer, "round", None) == round_
+            and int(batch.inst_index[0]) == int(first_index))
+
+
+def poison_batch(orig, round_, first_index, mode="nan"):
+    """Wrap ``Trainer.update`` so the batch identified by
+    ``(round_, first_index)`` is tampered with:
+
+    * mode="nan"  — data replaced by NaNs (non-finite loss/gradients)
+    * mode="drop" — the update is silently skipped: the clean-run
+                    control for "same data with that batch excluded"
+    """
+    import numpy as np
+
+    def wrapper(self, batch):
+        if _batch_key_hit(self, batch, round_, first_index):
+            if mode == "drop":
+                return None
+            b2 = batch.shallow_copy()
+            b2.data = np.full(np.shape(batch.data), np.nan, np.float32)
+            return orig(self, b2)
+        return orig(self, batch)
+
+    return wrapper
+
+
+def spoof_health(orig, round_, first_index, vec):
+    """Wrap ``Trainer.update`` so the step for the batch identified by
+    ``(round_, first_index)`` REPORTS ``vec`` as its health scalars —
+    deterministic loss-spike injection with zero numeric flakiness (the
+    actual update runs untouched)."""
+    import numpy as np
+
+    def wrapper(self, batch):
+        hit = _batch_key_hit(self, batch, round_, first_index)
+        out = orig(self, batch)
+        if hit and self.last_health is not None:
+            self.last_health = np.asarray(vec, np.float32)
+        return out
+
+    return wrapper
+
+
+def recording_update(orig, record):
+    """Wrap ``Trainer.update`` to record (trainer.round, first instance
+    id) per call — how tests discover a stable content key to feed
+    ``poison_batch`` / ``spoof_health``."""
+
+    def wrapper(self, batch):
+        record.append((getattr(self, "round", 0),
+                       int(batch.inst_index[0])))
+        return orig(self, batch)
+
+    return wrapper
+
+
+def make_imgbin(dirname: str, bufs, page_ints: int = 1 << 12,
+                labels=None):
+    """Write an ``img.lst`` + ``img.bin`` pair from raw record bytes —
+    the fixture for data-pipeline fault injection (a record's bytes can
+    be anything, including deliberately undecodable garbage). Returns
+    (lst_path, bin_path)."""
+    from cxxnet_tpu.utils.binary_page import BinaryPage
+
+    os.makedirs(dirname, exist_ok=True)
+    lst = os.path.join(dirname, "img.lst")
+    binp = os.path.join(dirname, "img.bin")
+    with open(lst, "w") as f:
+        for i in range(len(bufs)):
+            lab = labels[i] if labels is not None else i % 2
+            f.write("%d %d rec_%03d.jpg\n" % (i, lab, i))
+    with open(binp, "wb") as f:
+        page = BinaryPage(page_ints)
+        for b in bufs:
+            if not page.push(b):
+                page.save(f)
+                page = BinaryPage(page_ints)
+                assert page.push(b), "record larger than a page"
+        if page.size():
+            page.save(f)
+    return lst, binp

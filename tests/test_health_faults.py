@@ -1,0 +1,535 @@
+"""Fault-injection suite for the training-health subsystem.
+
+Proves the recovery contract end-to-end on tiny synthetic data:
+
+* a run with ONE injected NaN batch completes via automatic
+  rollback+skip and its final weights match a clean run on the same
+  data with that batch excluded (bit-for-bit, CPU backend);
+* a loss spike triggers a rollback with LR backoff;
+* ``nonfinite_action=skip`` suppresses the bad update ON DEVICE and the
+  run matches the batch-excluded control without any rollback;
+* ``abort`` / exhausted retries die loudly with a diagnostic dump;
+* corrupt imgbin records are skipped, counted, and quarantined by
+  index; truncated packs end the epoch instead of crashing; a wedged
+  decode worker is detected via ``decode_timeout`` and its pool
+  restarted;
+* the watchdog detects a deliberately-stalled prefetch stub and dumps
+  all-thread stacks within the configured timeout;
+* non-finite metric values warn + count instead of the reference's
+  host-only FloatingPointError;
+* ``tools/telemetry_report.py`` exits 2 on unresolved health anomalies.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.io.batch import ThreadBufferIterator
+from cxxnet_tpu.io.data import DataBatch, IIterator
+from cxxnet_tpu.io.iter_image import ImagePageIterator
+from cxxnet_tpu.learn_task import LearnTask
+from cxxnet_tpu.nnet.trainer import Trainer
+from cxxnet_tpu.utils import health
+from cxxnet_tpu.utils import telemetry
+from cxxnet_tpu.utils.metric import MetricLogloss, MetricSet
+
+from . import faultinject as fi
+from . import synth_mnist
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tools"))
+import telemetry_report  # noqa: E402
+
+
+CONF = """
+data = train
+iter = mnist
+    path_img = "{train_img}"
+    path_label = "{train_lab}"
+    shuffle = 1
+iter = end
+eval = test
+iter = mnist
+    path_img = "{test_img}"
+    path_label = "{test_lab}"
+iter = end
+
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 16
+  init_sigma = 0.01
+layer[+1:sg1] = sigmoid:se1
+layer[sg1->fc2] = fullc:fc2
+  nhidden = 10
+  init_sigma = 0.01
+layer[+0] = softmax
+netconfig=end
+
+input_shape = 1,1,784
+batch_size = 100
+
+dev = cpu
+save_model = 1
+model_dir = {model_dir}
+num_round = 2
+max_round = 20
+random_type = gaussian
+eta = 0.2
+momentum = 0.9
+wd  = 0.0
+metric = error
+eval_train = 1
+silent = 1
+ckpt_fsync = 0
+"""
+
+# the batch the health tests tamper with: second batch of learn-task
+# round 1 (trainer.round == 2) — mid-run, after a good checkpoint exists
+TARGET_TRAINER_ROUND = 2
+TARGET_BATCH_POS = 1
+
+
+def run_task(conf, *overrides):
+    task = LearnTask()
+    task.run([conf] + list(overrides))
+    return task
+
+
+def write_conf(tmp_path, mnist_data, name="t.conf"):
+    conf = str(tmp_path / name)
+    with open(conf, "w") as f:
+        f.write(CONF.format(model_dir=str(tmp_path / "models"),
+                            **mnist_data))
+    return conf
+
+
+def canon_weights(task):
+    return task.net_trainer.canonical_params()
+
+
+def assert_same_weights(pa, pb):
+    for la, lb in zip(pa, pb):
+        assert set(la) == set(lb)
+        for k in la:
+            assert np.array_equal(np.asarray(la[k]), np.asarray(lb[k])), k
+
+
+def read_events(log):
+    evs = [json.loads(l) for l in open(log) if l.strip()]
+    by = {}
+    for e in evs:
+        by.setdefault(e.get("ev"), []).append(e)
+    return by
+
+
+@pytest.fixture(scope="module")
+def mnist_data(tmp_path_factory):
+    d = tmp_path_factory.mktemp("health_mnist")
+    return synth_mnist.make_dataset(str(d), n_train=400, n_test=100)
+
+
+@pytest.fixture(scope="module")
+def probe(tmp_path_factory, mnist_data):
+    """One clean run that records (trainer round, first instance id) per
+    update — the stable content key the poison wrappers need — plus the
+    batch-excluded CONTROL run ("same data with that batch dropped")."""
+    import unittest.mock as mock
+    d = tmp_path_factory.mktemp("health_probe")
+    records = []
+    conf = write_conf(d, mnist_data)
+    with mock.patch.object(Trainer, "update",
+                           fi.recording_update(Trainer.update, records)):
+        run_task(conf)
+    keys = [idx for r, idx in records if r == TARGET_TRAINER_ROUND]
+    target = int(keys[TARGET_BATCH_POS])
+
+    dc = tmp_path_factory.mktemp("health_control")
+    conf_c = write_conf(dc, mnist_data)
+    with mock.patch.object(
+            Trainer, "update",
+            fi.poison_batch(Trainer.update, TARGET_TRAINER_ROUND, target,
+                            mode="drop")):
+        control = run_task(conf_c)
+    return {"target": target, "control": control,
+            "models": str(d / "models")}
+
+
+# ----------------------------------------------------------------------
+# tentpole acceptance: NaN batch -> rollback + skip -> exact match with
+# the batch-excluded control run
+def test_nan_batch_rollback_and_skip_exact(tmp_path, mnist_data, probe,
+                                           monkeypatch):
+    conf = write_conf(tmp_path, mnist_data)
+    log = str(tmp_path / "run.jsonl")
+    monkeypatch.setattr(
+        Trainer, "update",
+        fi.poison_batch(Trainer.update, TARGET_TRAINER_ROUND,
+                        probe["target"], mode="nan"))
+    task = run_task(conf, "health_monitor=1", "telemetry_log=%s" % log)
+    monkeypatch.undo()
+    # the run completed every round despite the poisoned batch
+    assert task.start_counter == 3
+    assert task._recovery.total_rollbacks == 1
+    # weights identical to the clean run with that batch excluded
+    assert_same_weights(canon_weights(task),
+                        canon_weights(probe["control"]))
+    assert task.net_trainer._rng_counter == \
+        probe["control"].net_trainer._rng_counter
+    # telemetry: anomaly -> rollback -> quarantined replay, all resolved
+    by = read_events(log)
+    assert any(e["kind"] == "nonfinite" for e in by["health_anomaly"])
+    assert by["health_rollback"][0]["anomaly"] == \
+        [e for e in by["health_anomaly"] if e["kind"] == "nonfinite"][0]["id"]
+    assert by["health_skip_batch"][0]["round"] == TARGET_TRAINER_ROUND - 1
+    assert any(e["ev"] == "ckpt_restore" for e in by["ckpt_restore"])
+    # the report gate sees a RESOLVED anomaly -> exit 0, health section
+    assert telemetry_report.main([log]) == 0
+
+
+def test_loss_spike_triggers_lr_backoff(tmp_path, mnist_data, probe,
+                                        monkeypatch):
+    conf = write_conf(tmp_path, mnist_data)
+    log = str(tmp_path / "run.jsonl")
+    monkeypatch.setattr(
+        Trainer, "update",
+        fi.spoof_health(Trainer.update, TARGET_TRAINER_ROUND,
+                        probe["target"], [1e3, 1.0, 0.0, 1.0]))
+    task = run_task(conf, "health_monitor=1", "loss_spike_factor=3",
+                    "loss_spike_warmup=2", "rollback_backoff=0.5",
+                    "telemetry_log=%s" % log)
+    monkeypatch.undo()
+    assert task.start_counter == 3
+    by = read_events(log)
+    assert any(e["kind"] == "loss_spike" for e in by["health_anomaly"])
+    assert by["health_rollback"][0]["lr_scale"] == 0.5
+    # the backoff reached the (restored) trainer's updaters: eta 0.2 -> 0.1
+    up = next(u for d in task.net_trainer.updaters for u in d.values())
+    assert abs(up.param.base_lr - 0.1) < 1e-12
+
+
+def test_nonfinite_action_skip_suppresses_on_device(tmp_path, mnist_data,
+                                                    probe, monkeypatch):
+    conf = write_conf(tmp_path, mnist_data)
+    log = str(tmp_path / "run.jsonl")
+    monkeypatch.setattr(
+        Trainer, "update",
+        fi.poison_batch(Trainer.update, TARGET_TRAINER_ROUND,
+                        probe["target"], mode="nan"))
+    task = run_task(conf, "health_monitor=1", "nonfinite_action=skip",
+                    "telemetry_log=%s" % log)
+    monkeypatch.undo()
+    assert task.start_counter == 3
+    by = read_events(log)
+    assert "health_rollback" not in by          # no rollback needed
+    assert by["health_skip"][0]["kind"] == "nonfinite"
+    assert by["health_skip"][0]["suppressed"] is True
+    # the on-device jnp.where guard kept the params exactly as if the
+    # batch had been excluded (net has no rng-consuming layers, constant
+    # LR schedule — the only divergence would be a leaked NaN)
+    assert_same_weights(canon_weights(task),
+                        canon_weights(probe["control"]))
+    assert telemetry_report.main([log]) == 0
+
+
+def test_nonfinite_action_abort_dumps_diagnostics(tmp_path, mnist_data,
+                                                  probe, monkeypatch,
+                                                  capfd):
+    conf = write_conf(tmp_path, mnist_data)
+    log = str(tmp_path / "run.jsonl")
+    monkeypatch.setattr(
+        Trainer, "update",
+        fi.poison_batch(Trainer.update, TARGET_TRAINER_ROUND,
+                        probe["target"], mode="nan"))
+    with pytest.raises(RuntimeError, match="health: training anomaly"):
+        run_task(conf, "health_monitor=1", "nonfinite_action=abort",
+                 "telemetry_log=%s" % log)
+    monkeypatch.undo()
+    err = capfd.readouterr().err
+    assert "HEALTH ABORT" in err and "stack dump" in err
+    by = read_events(log)
+    assert by["health_abort"][0]["anomaly"] == by["health_anomaly"][0]["id"]
+
+
+def test_rollback_retries_exhausted_aborts(tmp_path, mnist_data,
+                                           monkeypatch):
+    conf = write_conf(tmp_path, mnist_data)
+    log = str(tmp_path / "run.jsonl")
+    # EVERY batch non-finite: rollback, replay, fail again -> abort
+    monkeypatch.setattr(
+        Trainer, "update",
+        fi.poison_batch(Trainer.update, None, None, mode="nan"))
+    with pytest.raises(RuntimeError, match="rollback_max_retries"):
+        run_task(conf, "health_monitor=1", "rollback_max_retries=1",
+                 "telemetry_log=%s" % log)
+    monkeypatch.undo()
+    by = read_events(log)
+    assert len(by["health_rollback"]) == 1      # one retry allowed
+    assert "health_abort" in by
+
+
+# ----------------------------------------------------------------------
+# data-pipeline fault tolerance
+def _jpeg(seed, hw=24):
+    import cv2
+    rs = np.random.RandomState(seed)
+    img = rs.randint(0, 255, (hw, hw, 3)).astype(np.uint8)
+    return cv2.imencode(".jpg", img)[1].tobytes()
+
+
+def _page_iter(lst, binp, page_ints=1 << 12, **params):
+    it = ImagePageIterator()
+    it.set_param("image_list", lst)
+    it.set_param("image_bin", binp)
+    it.set_param("page_size", str(page_ints))
+    it.set_param("silent", "1")
+    for k, v in params.items():
+        it.set_param(k, str(v))
+    it.init()
+    return it
+
+
+def test_corrupt_imgbin_record_skipped_and_quarantined(tmp_path):
+    pytest.importorskip("cv2")
+    bufs = [_jpeg(i) for i in range(6)]
+    bufs[2] = b"\x00garbage-not-a-jpeg\x7f" * 4     # corrupt record
+    lst, binp = fi.make_imgbin(str(tmp_path), bufs)
+    telemetry.enable(None)
+    try:
+        it = _page_iter(lst, binp)
+        seen = [it.value().index for _ in iter(it)]
+        assert seen == [0, 1, 3, 4, 5]              # skipped, not crashed
+        assert it._quarantined == {2}
+        assert telemetry.summary()["counters"]["io.corrupt_records"] == 1
+        assert any(e.get("ev") == "data_corrupt" and e["index"] == 2
+                   for e in telemetry.events())
+        # second epoch: the quarantined index is dropped BEFORE decode,
+        # no new corrupt-record count
+        it.before_first()
+        seen2 = sum(1 for _ in iter(it))
+        assert seen2 == 5
+        assert telemetry.summary()["counters"]["io.corrupt_records"] == 1
+        it.close()
+    finally:
+        telemetry.disable()
+
+
+def test_truncated_pack_ends_epoch_instead_of_crashing(tmp_path, capfd):
+    pytest.importorskip("cv2")
+    page_ints = 1 << 11          # 8 KiB pages -> several pages
+    bufs = [_jpeg(i, hw=48) for i in range(8)]
+    lst, binp = fi.make_imgbin(str(tmp_path), bufs, page_ints=page_ints)
+    assert os.path.getsize(binp) >= 2 * page_ints * 4
+    fi.truncate(binp, keep_bytes=page_ints * 4)     # keep only page 1
+    telemetry.enable(None)
+    try:
+        it = _page_iter(lst, binp, page_ints=page_ints)
+        seen = sum(1 for _ in iter(it))
+        assert 0 < seen < 8                          # early end, no crash
+        assert telemetry.summary()["counters"]["io.truncated_pack"] >= 1
+        it.close()
+    finally:
+        telemetry.disable()
+    assert "ending epoch early" in capfd.readouterr().err
+
+
+def test_decode_timeout_restarts_dead_worker(tmp_path, monkeypatch):
+    from cxxnet_tpu.io import iter_image as ii
+    bufs = [b"REC-A", b"REC-B", b"SLOW!", b"REC-C"]
+    lst, binp = fi.make_imgbin(str(tmp_path), bufs)
+
+    def fake_decode(buf):
+        if bytes(buf) == b"SLOW!":
+            time.sleep(0.8)                  # wedged decode worker
+        return np.zeros((3, 4, 4), np.float32)
+
+    monkeypatch.setattr(ii, "_decode_rgb_chw", fake_decode)
+    telemetry.enable(None)
+    try:
+        it = _page_iter(lst, binp, decode_thread=2, decode_timeout="0.2")
+        seen = [it.value().index for _ in iter(it)]
+        assert sorted(seen) == [0, 1, 3]             # SLOW! quarantined
+        assert it._quarantined == {2}
+        c = telemetry.summary()["counters"]
+        assert c["io.decode_worker_restarts"] == 1
+        assert any(e.get("ev") == "watchdog_stall"
+                   and e.get("channel") == "io.decode"
+                   for e in telemetry.events())
+        it.close()
+    finally:
+        telemetry.disable()
+
+
+# ----------------------------------------------------------------------
+# watchdog
+class _StallingBatches(IIterator):
+    """Prefetch stub: serves tiny batches, deliberately wedging inside
+    next() once — the hung-read simulation the watchdog must catch."""
+
+    def __init__(self, n=6, stall_at=3, stall_s=0.8):
+        self.n, self.stall_at, self.stall_s = n, stall_at, stall_s
+        self.i = 0
+
+    def before_first(self):
+        self.i = 0
+
+    def next(self):
+        if self.i >= self.n:
+            return False
+        if self.i == self.stall_at:
+            time.sleep(self.stall_s)
+        b = DataBatch()
+        b.data = np.zeros((2, 1, 1, 4), np.float32)
+        b.label = np.zeros((2, 1), np.float32)
+        b.batch_size = 2
+        self.out = b
+        self.i += 1
+        return True
+
+    def value(self):
+        return self.out
+
+
+def test_watchdog_fires_on_stalled_prefetch_stub(capfd):
+    telemetry.enable(None)
+    stalls = []
+    wd = health.Watchdog(timeout=0.2, action="warn", poll=0.05,
+                         on_stall=lambda ch, age: stalls.append((ch, age)))
+    tb = ThreadBufferIterator(_StallingBatches())
+    tb.set_param("silent", "1")
+    tb.set_param("buffer_size", "2")
+    try:
+        wd.start()
+        tb.init()
+        t0 = time.monotonic()
+        seen = sum(1 for _ in iter(tb))
+        assert seen == 6                     # the stall resolved; run on
+        # detected within the configured timeout (+ poll slack), stacks
+        # dumped, telemetry event emitted and flushed before acting
+        assert stalls and stalls[0][0] == "io.prefetch"
+        assert time.monotonic() - t0 < 5.0
+        evs = [e for e in telemetry.events()
+               if e.get("ev") == "watchdog_stall"]
+        assert evs and evs[0]["channel"] == "io.prefetch"
+        assert evs[0]["stalled_s"] >= 0.2
+    finally:
+        wd.stop()
+        tb.close()
+        telemetry.disable()
+    err = capfd.readouterr().err
+    assert "WATCHDOG" in err and "--- thread" in err
+
+
+def test_watchdog_pause_disarms_channel():
+    """Legitimately-silent phases (eval/checkpoint, between prefetch
+    passes) disarm their channel — no false stall, no spurious abort."""
+    telemetry.enable(None)
+    wd = health.Watchdog(timeout=0.15, action="warn", poll=0.05)
+    try:
+        wd.start()
+        health.beat("train.step")
+        health.pause("train.step")
+        time.sleep(0.4)
+        assert wd.stalls == 0            # paused channel never fires
+        health.beat("train.step")        # re-armed by the next beat
+        time.sleep(0.4)
+        assert wd.stalls == 1
+    finally:
+        wd.stop()
+        telemetry.disable()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_dead_prefetch_thread_raises_instead_of_hanging():
+    class _Dies(_StallingBatches):
+        calls = 0
+
+        def next(self):
+            self.calls += 1
+            if self.calls >= 2:
+                # BaseException: evades the loader's Exception handler ->
+                # the thread dies without posting an end marker or error
+                raise KeyboardInterrupt("thread killed")
+            return super(_Dies, self).next()
+
+    tb = ThreadBufferIterator(_Dies())
+    tb.set_param("silent", "1")
+    tb.init()
+    try:
+        with pytest.raises(RuntimeError, match="prefetch thread died"):
+            while tb.next():
+                pass
+    finally:
+        tb.close()
+
+
+# ----------------------------------------------------------------------
+# satellites: metric NaN routing, start_counter error, selftest, report
+def test_metric_nan_warns_and_counts_instead_of_raising(capfd):
+    telemetry.enable(None)
+    try:
+        m = MetricLogloss()
+        m.clear()
+        pred = np.array([[0.5], [np.nan], [0.9]], np.float32)
+        lab = np.array([[1.0], [0.0], [np.nan]], np.float32)
+        m.add_eval(pred, lab)                # no FloatingPointError
+        assert m.cnt_inst == 1               # the two bad rows excluded
+        assert np.isfinite(m.get())
+        ms = MetricSet()
+        ms.add_metric("logloss", "label")
+        ms.absorb(np.array([[np.nan, 100.0]], np.float32))  # jit path
+        c = telemetry.summary()["counters"]
+        assert c["health/nonfinite_metric"] == 3
+        evs = [e for e in telemetry.events()
+               if e.get("ev") == "health_anomaly"]
+        assert all(e["kind"] == "metric_nonfinite"
+                   and e["resolution"] == "warned" for e in evs)
+    finally:
+        telemetry.disable()
+    assert "non-finite value" in capfd.readouterr().err
+
+
+def test_load_model_bad_name_is_structured_error(tmp_path, probe):
+    task = LearnTask()
+    task.name_model_in = str(tmp_path / "final.model")
+    with pytest.raises(ValueError, match="start_counter"):
+        task._load_model()
+    # an explicit start_counter overrides the inference and loads fine
+    import shutil
+    from cxxnet_tpu.utils.config import ConfigIterator
+    src = os.path.join(probe["models"], "0001.model")
+    dst = str(tmp_path / "final.model")
+    shutil.copy(src, dst)
+    conf = os.path.join(os.path.dirname(probe["models"]), "t.conf")
+    task2 = LearnTask()
+    for name, val in ConfigIterator(conf, []):
+        task2.set_param(name, val)
+    task2.set_param("start_counter", "7")
+    task2.name_model_in = dst
+    task2._load_model()
+    assert task2.start_counter == 8          # configured 7, +1 post-load
+
+
+def test_health_policy_selftest():
+    assert health.selftest() == 0
+
+
+def test_telemetry_report_exits_2_on_unresolved_anomaly(tmp_path, capsys):
+    log = str(tmp_path / "bad.jsonl")
+    with open(log, "w") as f:
+        f.write(json.dumps({"ev": "health_anomaly", "id": 9,
+                            "kind": "nonfinite", "round": 1,
+                            "batch": 2}) + "\n")
+        f.write(json.dumps({"ev": "span", "name": "train.step",
+                            "ts": 0.0, "dur": 0.01}) + "\n")
+    assert telemetry_report.main([log]) == 2
+    assert "UNRESOLVED" in capsys.readouterr().out
+    # a matching rollback resolves it
+    with open(log, "a") as f:
+        f.write(json.dumps({"ev": "health_rollback", "anomaly": 9,
+                            "retry": 1}) + "\n")
+    assert telemetry_report.main([log]) == 0

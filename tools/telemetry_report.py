@@ -6,14 +6,18 @@ Usage:
                                                [--json]
 
 Prints top spans by total time, recompile count/causes/seconds, per-round
-breakdowns, counters/gauges, and step-time percentiles. ``--trace``
-additionally exports a chrome://tracing / Perfetto JSON built from the
-span tree. ``--json`` emits the aggregate as one JSON object instead of
-the table (for scripting).
+breakdowns, counters/gauges, step-time percentiles, and a training-health
+section (anomalies/rollbacks/watchdog stalls/corrupt records,
+utils/health.py). ``--trace`` additionally exports a chrome://tracing /
+Perfetto JSON built from the span tree. ``--json`` emits the aggregate as
+one JSON object instead of the table (for scripting).
 
 Exit codes: 0 ok; 1 usage / unreadable file; 2 malformed log (a line
-that is not valid JSON, or no telemetry events at all) — CI gates on
-this so a broken emitter cannot silently pass.
+that is not valid JSON, or no telemetry events at all) OR a log with
+``health_anomaly`` events that no resolution event (``health_rollback``
+/ ``health_skip`` / ``health_abort`` referencing the anomaly id, or an
+inline ``resolution`` field) ever answered — CI gates on this so neither
+a broken emitter nor an unrecovered training anomaly can silently pass.
 """
 
 import json
@@ -60,6 +64,8 @@ def aggregate(events):
     counters = {}
     gauges = {}
     rounds = []
+    health = {"anomalies": [], "resolutions": [], "stalls": [],
+              "data_corrupt": 0, "skipped_batches": 0}
     for ev in events:
         kind = ev.get("ev")
         if kind == "span":
@@ -77,8 +83,25 @@ def aggregate(events):
             counters = ev.get("counters", {})
         elif kind == "summary":
             counters = ev.get("summary", {}).get("counters", counters)
+        elif kind == "health_anomaly":
+            health["anomalies"].append(ev)
+        elif kind in ("health_rollback", "health_skip", "health_abort",
+                      "health_anomaly_at_preempt"):
+            health["resolutions"].append(ev)
+        elif kind == "watchdog_stall":
+            health["stalls"].append(ev)
+        elif kind == "data_corrupt":
+            health["data_corrupt"] += 1
+        elif kind == "health_skip_batch":
+            health["skipped_batches"] += 1
+    # an anomaly is resolved by an inline resolution field (warn-only
+    # metric events) or by any recovery event referencing its id
+    resolved = {r.get("anomaly") for r in health["resolutions"]}
+    health["unresolved"] = [
+        a for a in health["anomalies"]
+        if a.get("resolution") is None and a.get("id") not in resolved]
     out = {"spans": {}, "compiles": {}, "counters": counters,
-           "gauges": gauges, "rounds": rounds}
+           "gauges": gauges, "rounds": rounds, "health": health}
     for name, durs in spans.items():
         durs.sort()
         out["spans"][name] = {
@@ -137,6 +160,32 @@ def print_report(agg, top=15):
         print("\n== gauges (last value) ==")
         for name, v in sorted(agg["gauges"].items()):
             print("  %-28s %s" % (name, v))
+    h = agg.get("health", {})
+    if h and (h["anomalies"] or h["stalls"] or h["data_corrupt"]
+              or h["skipped_batches"]):
+        print("\n== health ==")
+        print("anomalies: %d  %s" %
+              (len(h["anomalies"]),
+               " ".join("%s=%d" % kv for kv in
+                        sorted(count_by(h["anomalies"], "kind").items()))))
+        if h["resolutions"]:
+            print("resolutions: %d  %s" %
+                  (len(h["resolutions"]),
+                   " ".join("%s=%d" % kv for kv in sorted(
+                       count_by(h["resolutions"], "ev").items()))))
+        if h["stalls"]:
+            print("watchdog stalls: %d  %s" %
+                  (len(h["stalls"]),
+                   " ".join("%s=%d" % kv for kv in sorted(
+                       count_by(h["stalls"], "channel").items()))))
+        if h["data_corrupt"]:
+            print("corrupt data records: %d" % h["data_corrupt"])
+        if h["skipped_batches"]:
+            print("quarantined batches skipped: %d" % h["skipped_batches"])
+        for a in h["unresolved"]:
+            print("UNRESOLVED anomaly id=%s kind=%s round=%s batch=%s" %
+                  (a.get("id"), a.get("kind"), a.get("round"),
+                   a.get("batch")))
 
 
 def main(argv):
@@ -180,6 +229,12 @@ def main(argv):
             json.dump(events_to_chrome(events), f)
         print("\nchrome trace written to %s "
               "(open in chrome://tracing or ui.perfetto.dev)" % trace_out)
+    unresolved = agg.get("health", {}).get("unresolved", [])
+    if unresolved:
+        print("%s: %d health_anomaly event(s) with no matching "
+              "health_rollback/resolution — the run detected trouble and "
+              "never recovered" % (path, len(unresolved)), file=sys.stderr)
+        return 2
     return 0
 
 
