@@ -42,12 +42,15 @@ test-fast:
 		-p no:cacheprovider
 
 # fast regression gate (no pytest, no jax): every module byte-compiles,
-# the checkpoint verifier still detects every corruption class, and the
-# training-health detect->rollback->skip state machine still recovers —
-# a checkpoint-format or recovery-policy regression fails here in seconds
+# the checkpoint verifier still detects every corruption class, the
+# training-health detect->rollback->skip state machine still recovers,
+# and the live introspection service serves/scrapes/shuts-down on a real
+# socket with valid Prometheus output — a checkpoint-format, recovery-
+# policy, or metrics-format regression fails here in seconds
 check:
 	python -m compileall -q cxxnet_tpu tools tests
 	python tools/ckpt_fsck.py --selftest
 	python -m cxxnet_tpu.utils.health --selftest
+	python -m cxxnet_tpu.utils.statusd --selftest
 
 .PHONY: all clean test-fast check

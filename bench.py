@@ -626,10 +626,20 @@ def _probe_backend(attempts=4, probe_timeout=45, sleep_s=30):
 def _attach_telemetry(result):
     """Fold the per-phase telemetry breakdown (top spans, compile count/
     seconds, counters since the last bench) into a bench line, so
-    BENCH_*.json carries the breakdown instead of one opaque number."""
+    BENCH_*.json carries the breakdown instead of one opaque number.
+    The step-time HISTOGRAM percentiles (fixed log-spaced buckets, the
+    same series /metrics scrapes live) ride along as "step_ms" — the
+    p50/p90/p99 tail a mean-throughput number hides."""
     from cxxnet_tpu.utils import telemetry
     if telemetry.enabled():
-        result["telemetry"] = telemetry.brief_summary()
+        # one summary() pass feeds both views (it sorts every span's
+        # duration history — don't do that twice per bench line)
+        s = telemetry.summary()
+        result["telemetry"] = telemetry.brief_summary(summary=s)
+        h = s.get("hists", {}).get("train.step")
+        if h and h["count"]:
+            result["step_ms"] = {"p50": h["p50_ms"], "p90": h["p90_ms"],
+                                 "p99": h["p99_ms"]}
         telemetry.reset()
     return result
 

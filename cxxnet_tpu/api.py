@@ -30,6 +30,7 @@ from .io.data import DataBatch
 from .nnet import trainer as trainer_mod
 from .utils import checkpoint as ckpt
 from .utils import serializer
+from .utils import telemetry
 from .utils.config import parse_config_string
 
 
@@ -171,14 +172,23 @@ class Net:
         """Per-row prediction (argmax over the output when it is a
         distribution — reference TransformPred)."""
         assert self.net_ is not None, "model not initialized"
-        return self.net_.predict(self._resolve_batch(data))
+        # request counter + latency histogram (the api.predict span feeds
+        # it): what an embedder's /metrics scrape sees per inference call
+        telemetry.count("api.predict.requests")
+        with telemetry.span("api.predict"):
+            return self.net_.predict(self._resolve_batch(data))
 
     def predict_device(self, data):
         """predict() without the host fetch: the (batch,) result stays a
         jax.Array on device — the serving-loop building block (chain
         calls, sync once; only the final fetch crosses the wire)."""
         assert self.net_ is not None, "model not initialized"
-        return self.net_.predict_device(self._resolve_batch(data))
+        # separate series from api.predict: this measures async DISPATCH
+        # (the result stays on device, no host sync) — folding it into
+        # the blocking-predict latency histogram would poison its tail
+        telemetry.count("api.predict_device.requests")
+        with telemetry.span("api.predict_device"):
+            return self.net_.predict_device(self._resolve_batch(data))
 
     def extract(self, data, name: str) -> np.ndarray:
         """Activations of the named node (or `top[-k]`) for the batch."""
@@ -193,9 +203,12 @@ class Net:
         scan; greedy by default, sampled with temperature/top_k; ragged
         batches via prompt_lens — see Trainer.generate)."""
         assert self.net_ is not None, "model not initialized"
-        return self.net_.generate(prompts, n_new, temperature=temperature,
-                                  top_k=top_k, seed=seed,
-                                  prompt_lens=prompt_lens)
+        telemetry.count("api.generate.requests")
+        with telemetry.span("api.generate", new_tokens=int(n_new)):
+            return self.net_.generate(prompts, n_new,
+                                      temperature=temperature,
+                                      top_k=top_k, seed=seed,
+                                      prompt_lens=prompt_lens)
 
     def beam_generate(self, prompts: np.ndarray, n_new: int,
                       beam: int = 4) -> np.ndarray:

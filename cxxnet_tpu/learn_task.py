@@ -20,6 +20,7 @@ from .nnet.trainer import Trainer, create_net
 from .utils import checkpoint as ckpt
 from .utils import health
 from .utils import serializer
+from .utils import statusd
 from .utils import telemetry
 from .utils.config import ConfigIterator
 
@@ -45,8 +46,20 @@ class LearnTask:
         # telemetry_log=<path>: structured JSONL run log (spans, counters,
         # compile events; utils/telemetry.py). A Chrome-trace export is
         # written next to it (<path>.trace.json) at end of run, and the
-        # end-of-run summary table prints unless silent.
+        # end-of-run summary table prints unless silent. Multihost runs
+        # put a %d rank placeholder in the path (one shard per process;
+        # merge with tools/telemetry_report.py --merge).
         self.telemetry_log = ""
+        # status_port=<p>: live introspection HTTP service
+        # (utils/statusd.py, doc/observability.md): /metrics (Prometheus),
+        # /healthz (200/503 off the watchdog + recovery state), /statusz
+        # (human page), /trace (Chrome-trace ring snapshot). Port 0 binds
+        # an ephemeral port (printed); -1 (default) = off. Binds loopback
+        # unless status_host widens it (0.0.0.0 lets a Prometheus server
+        # on another host scrape — the endpoints are unauthenticated).
+        self.status_port = -1
+        self.status_host = ""
+        self._status_telemetry = False
         self.silent = 0
         self.start_counter = 0
         self.max_round = 1 << 31
@@ -124,6 +137,7 @@ class LearnTask:
             return 0
         for name, val in ConfigIterator(argv[0], argv[1:]):
             self.set_param(name, val)
+        pidx = None
         if self.coordinator or self.num_worker > 1:
             from .parallel import init_distributed
             init_distributed(
@@ -131,10 +145,41 @@ class LearnTask:
                 num_processes=self.num_worker or None,
                 process_id=self.worker_rank if self.worker_rank >= 0
                 else None)
+            # distributed runtime is up: tag this process's telemetry
+            # shard / metric series with its rank
+            import jax
+            pidx = jax.process_index()
         if self.telemetry_log:
-            telemetry.enable(self.telemetry_log)
+            telemetry.enable(self.telemetry_log, process_index=pidx)
             telemetry.event({"ev": "run_meta", "task": self.task,
                              "dev": self.device})
+        if self.status_port >= 0:
+            if not telemetry.enabled():
+                # /metrics and /statusz read the telemetry registry: run
+                # it in-memory (no JSONL sink) when no log was configured
+                telemetry.enable(process_index=pidx)
+                self._status_telemetry = True
+            try:
+                srv = statusd.start(self.status_port,
+                                    host=self.status_host)
+            except (OSError, OverflowError) as e:
+                # a taken/privileged port — or an out-of-range one, which
+                # socket.bind raises as OverflowError — must not kill a
+                # training run over an observability feature: warn, run
+                # blind
+                sys.stderr.write(
+                    "WARNING: statusd: cannot bind port %d (%s); live "
+                    "introspection disabled for this run\n"
+                    % (self.status_port, e))
+                if self._status_telemetry:
+                    telemetry.disable()
+                    self._status_telemetry = False
+            else:
+                statusd.set_run_info(task=self.task, dev=self.device,
+                                     config=list(self.cfg))
+                if not self.silent:
+                    print("statusd: live introspection on port %d "
+                          "(/metrics /healthz /statusz /trace)" % srv.port)
         try:
             with telemetry.span("init"):
                 self.init()
@@ -155,10 +200,15 @@ class LearnTask:
             elif self.task == "serve":
                 self.task_serve()
         finally:
+            if self.status_port >= 0:
+                statusd.stop()
             if self.telemetry_log:
                 summary = telemetry.finish(close=True)
                 if summary and not self.silent:
                     self._print_telemetry_summary(summary)
+            elif self._status_telemetry:
+                telemetry.disable()
+                self._status_telemetry = False
         return 0
 
     def set_param(self, name: str, val: str) -> None:
@@ -197,6 +247,10 @@ class LearnTask:
             self.profile_dir = val
         if name == "telemetry_log":
             self.telemetry_log = val
+        if name == "status_port":
+            self.status_port = int(val)
+        if name == "status_host":
+            self.status_host = val
         if name == "ckpt_keep_last":
             self.ckpt_keep_last = int(val)
         if name == "ckpt_keep_every":
@@ -569,6 +623,9 @@ class LearnTask:
                 action=self.nonfinite_action,
                 backoff=self.rollback_backoff,
                 max_retries=self.rollback_max_retries)
+            # /healthz serves 503 while an anomaly is unresolved (the
+            # watchdog heartbeat channels are consulted unconditionally)
+            statusd.wire_health(self._recovery)
         wd = None
         if self.watchdog_timeout > 0:
             # the step channel arms itself at the FIRST completed batch
@@ -616,6 +673,7 @@ class LearnTask:
                 import jax
                 jax.profiler.start_trace(self.profile_dir)
                 profiling = True
+            statusd.update_progress(round=rnd, num_round=self.num_round)
             if not self.silent:
                 print("update round %d" % rnd)
             # the session's last round — by the schedule (num_round) OR by
@@ -746,6 +804,7 @@ class LearnTask:
             n_img += batch.batch_size - batch.num_batch_padd
             sample_counter += 1
             batches_done += 1
+            statusd.update_progress(batch=batches_done)
             if sample_counter % self.print_step == 0 and not self.silent:
                 print("round %8d:[%8d] %.0f sec elapsed" %
                       (self.start_counter - 1, sample_counter,
@@ -880,6 +939,9 @@ class LearnTask:
                   "sees a different batch order and the quarantined "
                   "window is positional — recovery is approximate")
         self.net_trainer.scale_lr(pol.lr_scale)
+        # recovery complete (checkpoint restored, replay armed): flip
+        # /healthz back to 200
+        pol.resolve()
 
     @staticmethod
     def _print_telemetry_summary(summary: dict) -> None:
@@ -1029,21 +1091,43 @@ class LearnTask:
                      for lay in self.net_trainer.net.layers
                      if getattr(lay, "type_name", "") == "embed"),
                     default=0)
-        served = 0
+        served = errors = 0
+        statusd.update_progress(served=0, errors=0)
+
+        def request_error(msg):
+            # a malformed request must not kill the serving loop: it is
+            # the CLIENT's error — answered, counted, surfaced
+            nonlocal errors
+            errors += 1
+            telemetry.count("serve.errors")
+            statusd.update_progress(errors=errors)
+            print("ERR " + msg, flush=True)
+
         for line in sys.stdin:
-            toks = [int(t) for t in line.split()]
+            try:
+                toks = [int(t) for t in line.split()]
+            except ValueError:
+                request_error("non-integer token in request")
+                continue
             if not toks:
                 continue
             if vocab and not all(0 <= t < vocab for t in toks):
-                print("ERR token id outside vocab_size %d" % vocab,
-                      flush=True)
+                request_error("token id outside vocab_size %d" % vocab)
                 continue
-            out = self.net_trainer.generate(
-                [toks], self.gen_new, temperature=self.gen_temperature,
-                top_k=self.gen_topk, seed=self.gen_seed + served)
+            # the span feeds the fixed-bucket serve.request latency
+            # histogram — what /metrics exposes as per-request p50/p99
+            with telemetry.span("serve.request", tokens=len(toks)):
+                out = self.net_trainer.generate(
+                    [toks], self.gen_new, temperature=self.gen_temperature,
+                    top_k=self.gen_topk, seed=self.gen_seed + served)
             print(" ".join(str(int(t)) for t in out[0]), flush=True)
             served += 1
-        print("served %d prompts" % served, file=sys.stderr, flush=True)
+            telemetry.count("serve.requests")
+            statusd.update_progress(served=served)
+        telemetry.event({"ev": "serve_done", "served": served,
+                         "errors": errors})
+        print("served %d prompts (%d request errors)" % (served, errors),
+              file=sys.stderr, flush=True)
 
     def task_export(self) -> None:
         """task = export: AOT-compile the inference forward (params baked

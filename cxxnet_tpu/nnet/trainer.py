@@ -822,6 +822,9 @@ class Trainer:
     # ------------------------------------------------------------------
     def start_round(self, round_: int) -> None:
         self.round = round_
+        # progress gauge for the live /metrics scrape (no-op when
+        # telemetry is off; one event per round when on)
+        telemetry.gauge("train.round", int(round_))
         if self.test_on_server:
             self.check_replica_consistency()
 

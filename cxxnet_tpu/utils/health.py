@@ -58,8 +58,8 @@ from . import telemetry
 
 __all__ = [
     "Anomaly", "TrainingAnomalyError", "HealthMonitor", "RecoveryPolicy",
-    "Watchdog", "beat", "pause", "dump_all_stacks", "dump_diagnostics",
-    "note_nonfinite", "selftest",
+    "Watchdog", "beat", "pause", "channel_status", "dump_all_stacks",
+    "dump_diagnostics", "note_nonfinite", "selftest",
 ]
 
 # health-vector slot layout, shared with nnet/trainer.py _make_train_step
@@ -227,15 +227,22 @@ class RecoveryPolicy:
         self.total_rollbacks = 0
         self.lr_scale = 1.0
         self._skip: Dict[int, set] = {}
+        # the anomaly currently being recovered from: set by a
+        # rollback/abort decision, cleared by resolve() once the driver's
+        # restore completes. statusd's /healthz serves 503 while set —
+        # the "don't route traffic / don't trust this run" window.
+        self.pending: Optional[Anomaly] = None
 
     def decide(self, anomaly: Anomaly) -> str:
         """'skip' | 'rollback' | 'abort'. A 'rollback' decision has
         already quarantined the offending batch and folded the backoff
         into ``lr_scale`` (apply via Trainer.scale_lr after restoring)."""
         if self.action == "abort":
+            self.pending = anomaly
             return "abort"
         if self.action == "skip":
-            return "skip"
+            return "skip"          # suppressed on device: nothing pending
+        self.pending = anomaly
         self.retries += 1
         if self.retries > self.max_retries:
             return "abort"
@@ -244,6 +251,13 @@ class RecoveryPolicy:
         if self.backoff != 1.0:
             self.lr_scale *= self.backoff
         return "rollback"
+
+    def resolve(self) -> None:
+        """The driver finished recovering (checkpoint restored, replay
+        armed): clear the unresolved-anomaly state so /healthz returns to
+        200. Aborts never resolve — the endpoint stays 503 for whatever
+        scrape catches the dying process."""
+        self.pending = None
 
     def should_skip(self, round_: int, batch: int) -> bool:
         s = self._skip.get(int(round_))
@@ -282,6 +296,20 @@ def pause(channel: str = "train.step") -> None:
     wd = _active_watchdog
     if wd is not None:
         wd._fired.pop(channel, None)
+
+
+def channel_status():
+    """Live heartbeat view for statusd: ``[(channel, age_s, timeout_s,
+    overdue), ...]`` over every ARMED channel (paused channels are
+    legitimately silent and excluded, same as the watchdog's own scan).
+    Empty when no watchdog is running — /healthz then has no heartbeat
+    opinion at all rather than a stale one."""
+    wd = _active_watchdog
+    if wd is None:
+        return []
+    now = time.monotonic()
+    return [(ch, now - t, wd.timeout, (now - t) > wd.timeout)
+            for ch, t in list(_beats.items())]
 
 
 def dump_all_stacks(out=None, header: str = "") -> str:

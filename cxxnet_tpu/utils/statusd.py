@@ -1,0 +1,510 @@
+"""Live introspection service: /metrics, /healthz, /statusz, /trace.
+
+Everything telemetry (PR 1) and the health watchdog (PR 3) record was
+post-mortem — JSONL logs and end-of-run summaries nobody can see while a
+multi-hour training job or a ``task = serve`` loop is actually running.
+Production systems treat pull-based live monitoring as first-class runtime
+instrumentation (TF's system paper, arxiv 1605.08695); this module is that
+surface: a stdlib-only ``http.server`` on a daemon thread, enabled by the
+conf key ``status_port=<p>`` (port 0 = ephemeral, printed at startup; the
+learn-task driver starts it for every task including serve).
+
+Endpoints:
+
+* ``/metrics`` — Prometheus text format (scrapable): every telemetry
+  counter as a ``_total`` series, gauges, and the fixed-bucket latency
+  histograms (``telemetry.HIST_BUCKETS``) as ``_seconds_bucket{le=...}``
+  series — step time, io wait, h2d, per-request serve latency. All series
+  carry a ``process`` label so a multihost scrape attributes shards.
+* ``/healthz`` — 200 while healthy, 503 while a heartbeat channel is
+  overdue (``health.channel_status``) or a registered probe fails — the
+  learn task wires the RecoveryPolicy's unresolved-anomaly state here, so
+  a rollback in flight (or an abort) flips the endpoint until recovery
+  completes. The k8s/liveness-probe contract.
+* ``/statusz`` — the human page: run config, round/batch progress,
+  step-time p50/p90/p99, recompile count and causes, checkpoint age,
+  device-memory gauges, counters, health detail.
+* ``/trace`` — a Chrome-trace JSON snapshot of the recent-event ring
+  buffer (load in chrome://tracing or ui.perfetto.dev) — the last ~4096
+  events of a LIVE run, no log file needed.
+
+The server binds in ``start()`` (so ``status_port=0`` resolves to a real
+port before the run begins), serves each request on its own thread
+(ThreadingHTTPServer), and reads only snapshot copies of telemetry state
+(``metrics_snapshot`` takes the registry lock once per scrape) — a scrape
+never blocks the train loop beyond one lock acquisition. Binds loopback
+by default (the endpoints expose run config and event detail,
+unauthenticated); set ``status_host=0.0.0.0`` to let a Prometheus server
+on another machine scrape.
+
+Deliberately jax-free (like health.py): ``python -m
+cxxnet_tpu.utils.statusd --selftest`` serves, scrapes, and validates on a
+box with no accelerator stack; ``make check`` gates on it.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import re
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+
+from . import health as health_mod
+from . import telemetry
+
+__all__ = [
+    "StatusServer", "start", "stop", "active", "set_run_info",
+    "update_progress", "register_probe", "wire_health",
+    "prometheus_metrics", "PROM_LINE_RE", "selftest",
+]
+
+_NAME_SAN = re.compile(r"[^a-zA-Z0-9_]")
+
+# one exposition line: metric name, optional {label="value",...}, value.
+# Shared with tests — the validity contract /metrics promises scrapers.
+PROM_LINE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\.)*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\.)*")*\})?'
+    r' (?:[-+]?[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|\+Inf|-Inf|NaN)$')
+
+
+def _mname(name: str) -> str:
+    """Telemetry name -> Prometheus metric name (``train.step`` ->
+    ``cxxnet_train_step``)."""
+    n = _NAME_SAN.sub("_", str(name))
+    if n and n[0].isdigit():
+        n = "_" + n
+    return "cxxnet_" + n
+
+
+def _lesc(value: str) -> str:
+    """Prometheus label-value escaping (backslash, quote, newline)."""
+    return str(value).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def prometheus_metrics(snapshot: dict, progress: Optional[dict] = None,
+                       health_failures: Optional[list] = None,
+                       channels: Optional[list] = None) -> str:
+    """Render a ``telemetry.metrics_snapshot()`` as Prometheus text
+    exposition format 0.0.4. Pure function of its inputs — the selftest
+    and tests validate its output without a socket. ``channels`` is the
+    heartbeat snapshot the caller derived ``health_failures`` from, so
+    one scrape can never contradict itself (healthy gauge vs overdue
+    heartbeat ages from two different instants)."""
+    p = str(snapshot.get("process", 0))
+    base = '{process="%s"}' % _lesc(p)
+    out: List[str] = []
+
+    def emit(name, mtype, value, labels=base, help_=None):
+        if help_:
+            out.append("# HELP %s %s" % (name, help_))
+        out.append("# TYPE %s %s" % (name, mtype))
+        out.append("%s%s %s" % (name, labels, _fmt(value)))
+
+    def _fmt(v):
+        if isinstance(v, float):
+            if v != v:
+                return "NaN"
+            if v == float("inf"):
+                return "+Inf"
+            if v == float("-inf"):
+                return "-Inf"
+            return repr(v)
+        return str(v)
+
+    emit("cxxnet_up", "gauge", 1,
+         help_="1 while the introspection service is serving")
+    emit("cxxnet_uptime_seconds", "gauge",
+         round(float(snapshot.get("uptime_s", 0.0)), 3))
+    emit("cxxnet_compiles_total", "counter", int(snapshot.get("compiles", 0)),
+         help_="jit recompiles detected since run start")
+    emit("cxxnet_compile_seconds_total", "counter",
+         float(snapshot.get("compile_s", 0.0)))
+    if health_failures is not None:
+        emit("cxxnet_healthy", "gauge", 0 if health_failures else 1,
+             help_="1 when /healthz returns 200")
+    if channels is None:
+        channels = health_mod.channel_status()
+    if channels:
+        # ONE TYPE line for the whole family (the exposition spec allows
+        # one per metric name; the channels are label values)
+        out.append("# TYPE cxxnet_heartbeat_age_seconds gauge")
+        for ch, age, timeout, overdue in channels:
+            out.append(
+                'cxxnet_heartbeat_age_seconds{process="%s",channel="%s"}'
+                ' %s' % (_lesc(p), _lesc(ch), _fmt(round(age, 3))))
+    for key in ("round", "num_round", "batch", "served", "errors"):
+        v = (progress or {}).get(key)
+        if _num(v):
+            emit("cxxnet_progress_" + key, "gauge", v)
+    for name, v in sorted(snapshot.get("counters", {}).items()):
+        if _num(v):
+            emit(_mname(name) + "_total", "counter", v)
+    for name, v in sorted(snapshot.get("gauges", {}).items()):
+        if _num(v):
+            emit(_mname(name), "gauge", v)
+    for name, h in sorted(snapshot.get("hists", {}).items()):
+        mname = _mname(name) + "_seconds"
+        out.append("# TYPE %s histogram" % mname)
+        counts = {int(i): int(c) for i, c in
+                  (h.get("buckets") or {}).items()}
+        cum = 0
+        for i, le in enumerate(telemetry.HIST_BUCKETS):
+            cum += counts.get(i, 0)
+            out.append('%s_bucket{process="%s",le="%g"} %d'
+                       % (mname, _lesc(p), le, cum))
+        total = int(h.get("count", 0))
+        out.append('%s_bucket{process="%s",le="+Inf"} %d'
+                   % (mname, _lesc(p), total))
+        out.append('%s_sum%s %s' % (mname, base,
+                                    _fmt(float(h.get("sum", 0.0)))))
+        out.append('%s_count%s %d' % (mname, base, total))
+    return "\n".join(out) + "\n"
+
+
+class _HTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+    statusd: "StatusServer"
+
+
+class _Endpoint(BaseHTTPRequestHandler):
+    server_version = "cxxnet-statusd/1"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):   # quiet: no per-scrape stderr spam
+        pass
+
+    def _reply(self, code: int, ctype: str, body: bytes) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):   # noqa: N802 (BaseHTTPRequestHandler contract)
+        srv = self.server.statusd
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                self._reply(200, "text/plain; version=0.0.4; charset=utf-8",
+                            srv.metrics_text().encode("utf-8"))
+            elif path == "/healthz":
+                fails = srv.health_failures()
+                if fails:
+                    body = "unhealthy\n" + "".join(
+                        "%s: %s\n" % (n, d) for n, d in fails)
+                    self._reply(503, "text/plain; charset=utf-8",
+                                body.encode("utf-8"))
+                else:
+                    self._reply(200, "text/plain; charset=utf-8", b"ok\n")
+            elif path in ("/", "/statusz"):
+                self._reply(200, "text/html; charset=utf-8",
+                            srv.statusz_html().encode("utf-8"))
+            elif path == "/trace":
+                trace = telemetry.events_to_chrome(
+                    srv.registry.recent_events())
+                self._reply(200, "application/json",
+                            json.dumps(trace).encode("utf-8"))
+            else:
+                self._reply(404, "text/plain; charset=utf-8",
+                            b"not found; endpoints: /metrics /healthz "
+                            b"/statusz /trace\n")
+        except Exception as e:    # a broken probe must not kill the server
+            try:
+                self._reply(500, "text/plain; charset=utf-8",
+                            ("internal error: %r\n" % e).encode("utf-8"))
+            except Exception:
+                pass
+
+
+class StatusServer:
+    """The live-introspection HTTP server. Construct + ``start()`` binds
+    a daemon thread; ``stop()`` shuts it down. One per process (the
+    module-level ``start``/``stop`` manage the singleton the learn task
+    uses); tests build isolated instances against private registries."""
+
+    def __init__(self, port: int = 0, host: str = "",
+                 registry=None):
+        self.registry = registry if registry is not None else telemetry._REG
+        self.run_info: Dict[str, object] = {}
+        self.progress: Dict[str, object] = {}
+        self.probes: List[Tuple[str, Callable[[], Tuple[bool, str]]]] = []
+        # loopback by default: /statusz exposes the full run config (data
+        # and model paths included), so wide exposure is OPT-IN —
+        # status_host=0.0.0.0 for a cross-host Prometheus scrape
+        self._httpd = _HTTPServer((host or "127.0.0.1", int(port)),
+                                  _Endpoint)
+        self._httpd.statusd = self
+        self.host = self._httpd.server_address[0]
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+        self.t0_wall = time.time()
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> "StatusServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="cxn-statusd",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "StatusServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False
+
+    # -- wiring --------------------------------------------------------
+    def register_probe(self, name: str,
+                       fn: Callable[[], Tuple[bool, str]]) -> None:
+        """``fn() -> (ok, detail)``; a False (or raising) probe flips
+        /healthz to 503 with the detail in the body."""
+        self.probes.append((name, fn))
+
+    def wire_health(self, recovery=None) -> None:
+        """Wire the standard health sources: the watchdog heartbeat
+        channels are always consulted (health.channel_status); a
+        RecoveryPolicy adds the unresolved-anomaly probe — 503 from the
+        moment an anomaly decides rollback/abort until the driver calls
+        ``recovery.resolve()`` after the restore."""
+        if recovery is not None:
+            def _probe():
+                a = recovery.pending
+                if a is None:
+                    return True, "no unresolved anomaly"
+                return False, "unresolved anomaly: " + a.describe()
+            self.register_probe("anomaly", _probe)
+
+    def health_failures(self, channels: Optional[list] = None) \
+            -> List[Tuple[str, str]]:
+        if channels is None:
+            channels = health_mod.channel_status()
+        fails: List[Tuple[str, str]] = []
+        for ch, age, timeout, overdue in channels:
+            if overdue:
+                fails.append(("watchdog:" + ch,
+                              "heartbeat silent %.2fs (timeout %.2fs)"
+                              % (age, timeout)))
+        for name, fn in list(self.probes):
+            try:
+                ok, detail = fn()
+            except Exception as e:
+                ok, detail = False, "probe raised: %r" % e
+            if not ok:
+                fails.append((name, detail))
+        return fails
+
+    # -- renderers -----------------------------------------------------
+    def metrics_text(self) -> str:
+        # ONE heartbeat snapshot per scrape: the healthy gauge and the
+        # per-channel age rows must agree within a single response
+        channels = health_mod.channel_status()
+        return prometheus_metrics(
+            self.registry.metrics_snapshot(),
+            progress=dict(self.progress),
+            health_failures=self.health_failures(channels),
+            channels=channels)
+
+    def statusz_html(self) -> str:
+        reg = self.registry
+        snap = reg.metrics_snapshot()
+        s = reg.summary()
+        esc = html.escape
+        parts = ["<html><head><title>cxxnet statusz</title></head>"
+                 "<body><h1>cxxnet_tpu statusz</h1>"]
+
+        def table(title, rows):
+            if not rows:
+                return
+            parts.append("<h2>%s</h2><pre>" % esc(title))
+            w = max(len(str(k)) for k, _ in rows)
+            for k, v in rows:
+                parts.append("%-*s  %s" % (w, esc(str(k)), esc(str(v))))
+            parts.append("</pre>")
+
+        info = [(k, v) for k, v in self.run_info.items() if k != "config"]
+        info.append(("uptime", "%.1fs" % snap["uptime_s"]))
+        info.append(("process", snap["process"]))
+        info.append(("started", time.strftime(
+            "%Y-%m-%d %H:%M:%S", time.localtime(self.t0_wall))))
+        table("run", info)
+        prog = sorted(self.progress.items())
+        table("progress", prog)
+
+        channels = health_mod.channel_status()
+        fails = self.health_failures(channels)
+        rows = [("healthz", "503 UNHEALTHY" if fails else "200 ok")]
+        rows += [("probe " + n, d) for n, d in fails]
+        for ch, age, timeout, overdue in channels:
+            rows.append(("heartbeat " + ch, "%.2fs ago (timeout %.1fs)%s"
+                         % (age, timeout, " OVERDUE" if overdue else "")))
+        table("health", rows)
+
+        ck = reg.last_event("ckpt_save")
+        if ck is not None and "ts" in ck:
+            table("checkpoint", [
+                ("last save", ck.get("path", "?")),
+                ("age", "%.1fs" % (snap["uptime_s"] - ck["ts"])),
+                ("bytes", ck.get("bytes", "?"))])
+
+        hist_rows = []
+        for name, a in sorted(s.get("hists", {}).items(),
+                              key=lambda kv: -kv[1]["sum_s"]):
+            hist_rows.append((name, "n=%d p50=%.2fms p90=%.2fms p99=%.2fms"
+                              % (a["count"], a["p50_ms"], a["p90_ms"],
+                                 a["p99_ms"])))
+        table("latency histograms", hist_rows)
+
+        comp = s.get("compiles", {})
+        if comp.get("count"):
+            table("recompiles", [("count", comp["count"]),
+                                 ("total_s", comp["total_s"])] +
+                  sorted(comp.get("by_cause", {}).items()))
+        table("counters", sorted(snap["counters"].items()))
+        table("gauges", sorted(snap["gauges"].items()))
+
+        cfg = self.run_info.get("config")
+        if cfg:
+            parts.append("<details><summary>config (%d keys)</summary><pre>"
+                         % len(cfg))
+            for k, v in cfg:
+                parts.append("%s = %s" % (esc(str(k)), esc(str(v))))
+            parts.append("</pre></details>")
+        parts.append("<p>endpoints: <a href='/metrics'>/metrics</a> "
+                     "<a href='/healthz'>/healthz</a> "
+                     "<a href='/trace'>/trace</a></p></body></html>")
+        return "\n".join(parts)
+
+
+# ----------------------------------------------------------------------
+# module-level singleton surface (the learn-task wiring); every function
+# is a cheap no-op while no server is running, so instrumented call
+# sites (per-batch progress updates) cost one attribute test by default
+_SERVER: Optional[StatusServer] = None
+
+
+def start(port: int = 0, host: str = "", registry=None) -> StatusServer:
+    global _SERVER
+    stop()
+    _SERVER = StatusServer(port, host=host, registry=registry).start()
+    return _SERVER
+
+
+def stop() -> None:
+    global _SERVER
+    if _SERVER is not None:
+        s, _SERVER = _SERVER, None
+        s.stop()
+
+
+def active() -> Optional[StatusServer]:
+    return _SERVER
+
+
+def set_run_info(**kv) -> None:
+    s = _SERVER
+    if s is not None:
+        s.run_info.update(kv)
+
+
+def update_progress(**kv) -> None:
+    s = _SERVER
+    if s is not None:
+        s.progress.update(kv)
+
+
+def register_probe(name: str, fn) -> None:
+    s = _SERVER
+    if s is not None:
+        s.register_probe(name, fn)
+
+
+def wire_health(recovery=None) -> None:
+    s = _SERVER
+    if s is not None:
+        s.wire_health(recovery)
+
+
+# ----------------------------------------------------------------------
+def selftest(verbose: bool = False) -> int:
+    """Serve on port 0, scrape every endpoint over a real socket,
+    validate the Prometheus text format, flip /healthz with a failing
+    probe, shut down. Jax-free; ``make check`` gates on it."""
+    from urllib.request import urlopen
+    from urllib.error import HTTPError
+
+    reg = telemetry._Registry()
+    reg.enable()                       # in-memory sink
+    with reg.span("selftest.step"):
+        time.sleep(0.001)
+    reg.count("selftest.requests", 3)
+    reg.gauge("selftest.level", 7)
+    reg.hist("selftest.latency", 0.012)
+
+    srv = StatusServer(0, host="127.0.0.1", registry=reg).start()
+    try:
+        base = "http://127.0.0.1:%d" % srv.port
+
+        metrics = urlopen(base + "/metrics", timeout=5).read().decode()
+        for line in metrics.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            assert PROM_LINE_RE.match(line), \
+                "invalid Prometheus line: %r" % line
+        assert "cxxnet_selftest_requests_total" in metrics
+        assert 'cxxnet_selftest_step_seconds_bucket' in metrics
+        assert 'le="+Inf"' in metrics
+
+        assert urlopen(base + "/healthz", timeout=5).status == 200
+        srv.register_probe("boom", lambda: (False, "injected failure"))
+        try:
+            urlopen(base + "/healthz", timeout=5)
+            raise AssertionError("healthz should be 503")
+        except HTTPError as e:
+            assert e.code == 503
+            assert "injected failure" in e.read().decode()
+        srv.probes.clear()
+
+        page = urlopen(base + "/statusz", timeout=5).read().decode()
+        assert "statusz" in page and "selftest.requests" in page
+        trace = json.loads(urlopen(base + "/trace", timeout=5).read())
+        assert any(t.get("ph") == "X" for t in trace["traceEvents"])
+
+        try:
+            urlopen(base + "/nope", timeout=5)
+            raise AssertionError("unknown path should 404")
+        except HTTPError as e:
+            assert e.code == 404
+    finally:
+        srv.stop()
+        reg.disable()
+    if verbose:
+        print("statusd selftest: /metrics /healthz /statusz /trace ok "
+              "(Prometheus format valid, healthz flip, 404)")
+    return 0
+
+
+if __name__ == "__main__":
+    if "--selftest" in sys.argv[1:]:
+        sys.exit(selftest(verbose=True))
+    print(__doc__)
+    sys.exit(1)

@@ -17,6 +17,13 @@ arxiv 1802.04799). This module is that measurement substrate:
   callable and records a ``compile`` event (with its cause and compile
   seconds) whenever the underlying jit cache grows: exactly once per
   genuinely new (signature, shape) key, never on cache hits.
+* **histograms** — ``telemetry.hist("serve.request", seconds)`` feeds a
+  fixed LOG-SPACED bucket histogram (``HIST_BUCKETS``: 4 buckets per
+  decade, 1µs..1000s, identical in every process), so merging shards from
+  a multihost run is exact bucket-count addition — never re-binning.
+  Every span duration additionally feeds the histogram of its span name,
+  which is what /metrics serves as Prometheus ``_bucket`` series
+  (utils/statusd.py) and what bench.py's p50/p90/p99 come from.
 
 Sinks:
 
@@ -33,13 +40,21 @@ Disabled (the default) the module is near-zero overhead: ``span()`` returns
 a shared no-op context manager (no allocation), counters are one branch,
 and no events are ever buffered. Everything is process-global by design —
 one training job per process (the Trainer model), one telemetry stream.
+
+Multihost runs get one stream PER PROCESS: ``enable(path, process_index=i)``
+substitutes a ``%d`` rank placeholder in the log path (so shards never
+clobber each other), tags every event with ``"p": i``, and
+``tools/telemetry_report.py --merge shard*.jsonl`` re-aligns the shards on
+the shared wall-clock epoch for one cross-host report.
 """
 
 from __future__ import annotations
 
+import bisect
 import io
 import json
 import os
+import sys
 import threading
 import time
 from collections import deque
@@ -47,10 +62,11 @@ from typing import Dict, List, Optional
 
 __all__ = [
     "enable", "disable", "enabled", "reset", "span", "count", "gauge",
-    "event", "record_compile", "jit_watch", "sample_device_memory",
+    "hist", "event", "record_compile", "jit_watch", "sample_device_memory",
     "flush", "finish", "summary", "brief_summary", "events",
-    "span_event", "percentile", "count_by",
+    "recent_events", "last_event", "span_event", "percentile", "count_by",
     "chrome_trace", "events_to_chrome", "write_chrome_trace",
+    "Histogram", "HIST_BUCKETS",
 ]
 
 # per-span-name duration history kept for live percentiles (the JSONL log
@@ -59,6 +75,91 @@ _DUR_CAP = 8192
 # in-memory event buffer bound when NO log sink drains it (bench/library
 # mode): oldest events drop past this; aggregates (summary) are unaffected
 _PENDING_CAP = 65536
+# recent-event ring kept even WITH a log sink — the /trace endpoint's
+# snapshot source (statusd serves a live Chrome trace from it)
+_RING_CAP = 4096
+
+# Fixed log-spaced histogram bucket upper bounds (seconds): 4 per decade,
+# 1µs .. 1000s. FIXED for every histogram in every process by design —
+# cross-process/shard merging is then exact bucket-count addition (the
+# property Prometheus `le` buckets and telemetry_report --merge rely on).
+HIST_BUCKETS = tuple(round(10.0 ** (e / 4.0), 10) for e in range(-24, 13))
+
+
+class Histogram:
+    """Fixed-bucket latency histogram (see HIST_BUCKETS). ``counts[i]``
+    holds observations with value <= HIST_BUCKETS[i] (and > the previous
+    bound); the final slot is the +Inf overflow. Mergeable exactly."""
+
+    __slots__ = ("counts", "sum", "n")
+
+    def __init__(self):
+        self.counts = [0] * (len(HIST_BUCKETS) + 1)
+        self.sum = 0.0
+        self.n = 0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        self.counts[bisect.bisect_left(HIST_BUCKETS, v)] += 1
+        self.sum += v
+        self.n += 1
+
+    def percentile(self, p: float) -> float:
+        """Estimated percentile: walk the cumulative counts to the target
+        rank, interpolate linearly inside the bucket. Error is bounded by
+        the bucket width (~78% per log-spaced step) — exact enough for
+        p50/p90/p99 dashboards, and identical no matter how many shards
+        were merged to produce the counts. Ranks landing in the +Inf
+        overflow slot are CLAMPED to the last bound (1000s): the result
+        must stay finite (strict-JSON logs, bench lines), so a tail past
+        1000s reads as exactly 1000s — the overflow bucket's count is
+        the tell."""
+        if self.n == 0:
+            return 0.0
+        rank = (p / 100.0) * self.n
+        cum = 0
+        for i, c in enumerate(self.counts):
+            if c == 0:
+                continue
+            prev = cum
+            cum += c
+            if cum >= rank:
+                lo = HIST_BUCKETS[i - 1] if i > 0 else 0.0
+                hi = HIST_BUCKETS[i] if i < len(HIST_BUCKETS) \
+                    else HIST_BUCKETS[-1]
+                frac = min(1.0, max(0.0, (rank - prev) / c))
+                return lo + (hi - lo) * frac
+        return HIST_BUCKETS[-1]
+
+    def to_dict(self) -> dict:
+        """JSON-friendly sparse snapshot (only nonzero buckets)."""
+        return {"buckets": {str(i): c for i, c in enumerate(self.counts)
+                            if c},
+                "sum": round(self.sum, 9), "count": self.n}
+
+    def merge_dict(self, d: dict) -> "Histogram":
+        """Fold a ``to_dict`` snapshot in — EXACT because every histogram
+        shares HIST_BUCKETS (shard merge = bucket-count addition). An
+        out-of-range bucket index means the snapshot came from a build
+        with DIFFERENT buckets (or a corrupted log): merging it would be
+        silently wrong, so it raises ValueError for the caller to report."""
+        for i, c in (d.get("buckets") or {}).items():
+            i = int(i)
+            if not 0 <= i < len(self.counts):
+                raise ValueError(
+                    "histogram bucket index %d out of range (%d buckets) "
+                    "— snapshot from a mismatched HIST_BUCKETS version or "
+                    "a corrupted log" % (i, len(self.counts)))
+            self.counts[i] += int(c)
+        self.sum += float(d.get("sum", 0.0))
+        self.n += int(d.get("count", 0))
+        return self
+
+    def stats(self) -> dict:
+        return {"count": self.n, "sum_s": round(self.sum, 6),
+                "p50_ms": round(1e3 * self.percentile(50), 4),
+                "p90_ms": round(1e3 * self.percentile(90), 4),
+                "p99_ms": round(1e3 * self.percentile(99), 4)}
 
 
 class _NullSpan:
@@ -111,6 +212,7 @@ class _Registry:
         self._log_f: Optional[io.TextIOBase] = None
         self._lock = threading.Lock()
         self._tls = threading.local()
+        self.process_index = 0
         self.reset()
 
     # -- lifecycle -----------------------------------------------------
@@ -121,14 +223,45 @@ class _Registry:
             self.gauges: Dict[str, float] = {}
             self.span_agg: Dict[str, list] = {}   # name -> [n, total, max]
             self.span_durs: Dict[str, deque] = {}
+            self.hists: Dict[str, Histogram] = {}
             self.compiles: List[dict] = []
             self._flushed_counters: Dict[str, float] = {}
+            self._flushed_hist_n: Dict[str, int] = {}
+            # recent-event ring (kept even with a log sink): the /trace
+            # endpoint's snapshot + last-event-by-kind for /statusz
+            self._recent: deque = deque(maxlen=_RING_CAP)
+            self.last_by_kind: Dict[str, dict] = {}
             self.t0_perf = time.perf_counter()
             self.t0_wall = time.time()
 
-    def enable(self, log_path: Optional[str] = None) -> None:
+    def enable(self, log_path: Optional[str] = None,
+               process_index: Optional[int] = None) -> None:
         self.reset()
-        self.log_path = log_path or None
+        if process_index is None:
+            # env fallback for library users under the multihost launcher.
+            # Deliberately NOT PS_RANK: that var also selects an io shard
+            # in single-process debugging (doc/io.md), where redirecting
+            # the telemetry log by rank would be wrong.
+            v = os.environ.get("CXXNET_WORKER_RANK")
+            if v is not None:
+                try:
+                    process_index = int(v)
+                except ValueError:
+                    pass
+        self.process_index = int(process_index or 0)
+        path = log_path or None
+        if path and "%d" in path:
+            # the multihost shard contract: each rank writes its own file
+            path = path.replace("%d", str(self.process_index))
+        elif path and self.process_index:
+            # no placeholder on a non-zero rank: suffix rather than
+            # silently clobber rank 0's shard
+            sys.stderr.write(
+                "WARNING: telemetry_log %r has no %%d rank placeholder in "
+                "a multi-process run; writing %s.%d instead so shard 0 is "
+                "not clobbered\n" % (path, path, self.process_index))
+            path = "%s.%d" % (path, self.process_index)
+        self.log_path = path
         if self._log_f is not None:
             self._log_f.close()
             self._log_f = None
@@ -162,13 +295,19 @@ class _Registry:
         """Append one raw event (already-shaped dict). No-op if disabled."""
         if not self.enabled:
             return
+        if "ts" not in ev:
+            ev["ts"] = round(self._ts(time.perf_counter()), 6)
         with self._lock:
             self._append(ev)
 
     def _append(self, ev: dict) -> None:
         # lock held. Without a sink nothing drains _pending: bound it so
         # an enabled-without-log run (bench mode) cannot leak per-step
+        if "p" not in ev:
+            ev["p"] = self.process_index
         self._pending.append(ev)
+        self._recent.append(ev)
+        self.last_by_kind[ev.get("ev", "?")] = ev
         if self._log_f is None and len(self._pending) > _PENDING_CAP:
             del self._pending[: _PENDING_CAP // 2]
 
@@ -206,12 +345,30 @@ class _Registry:
             if dur > agg[2]:
                 agg[2] = dur
             self.span_durs[name].append(dur)
+            # every span feeds the mergeable fixed-bucket histogram of its
+            # name — the /metrics latency series and the shard-merge feed
+            h = self.hists.get(name)
+            if h is None:
+                h = self.hists[name] = Histogram()
+            h.observe(dur)
 
     def count(self, name: str, n=1) -> None:
         if not self.enabled:
             return
         with self._lock:
             self.counters[name] = self.counters.get(name, 0) + n
+
+    def hist(self, name: str, value: float) -> None:
+        """Observe one value (seconds) into the named fixed-bucket
+        histogram — for latencies measured outside a span (or values that
+        are not span-shaped at all)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            h = self.hists.get(name)
+            if h is None:
+                h = self.hists[name] = Histogram()
+            h.observe(value)
 
     def gauge(self, name: str, value) -> None:
         if not self.enabled:
@@ -252,17 +409,57 @@ class _Registry:
             if self.counters != self._flushed_counters:
                 counters = dict(self.counters)
                 self._flushed_counters = dict(counters)
+            hists = None
+            hist_n = {k: h.n for k, h in self.hists.items()}
+            if hist_n != self._flushed_hist_n:
+                hists = {k: h.to_dict() for k, h in self.hists.items()}
+                self._flushed_hist_n = hist_n
+            ts = round(self._ts(time.perf_counter()), 6)
+            p = self.process_index
         for ev in batch:
             self._log_f.write(json.dumps(ev) + "\n")
         if counters is not None:
             self._log_f.write(json.dumps(
                 {"ev": "counters", "counters": counters,
-                 "ts": round(self._ts(time.perf_counter()), 6)}) + "\n")
+                 "ts": ts, "p": p}) + "\n")
+        if hists is not None:
+            # cumulative snapshot, last-wins on re-read — like counters,
+            # so a crashed run keeps its histograms to the last flush
+            self._log_f.write(json.dumps(
+                {"ev": "hists", "hists": hists, "ts": ts, "p": p}) + "\n")
         self._log_f.flush()
 
     def events(self) -> List[dict]:
         with self._lock:
             return list(self._pending)
+
+    def recent_events(self) -> List[dict]:
+        """The last ~_RING_CAP events regardless of sink — the /trace
+        endpoint's snapshot source."""
+        with self._lock:
+            return list(self._recent)
+
+    def last_event(self, kind: str) -> Optional[dict]:
+        """Most recent event of the given ``ev`` kind (e.g. "ckpt_save"
+        for /statusz's checkpoint-age line)."""
+        with self._lock:
+            return self.last_by_kind.get(kind)
+
+    def metrics_snapshot(self) -> dict:
+        """One consistent point-in-time copy of everything /metrics
+        serves: counters, gauges, raw histogram buckets, compile totals,
+        uptime — taken under the lock so a scrape mid-step never sees a
+        half-updated histogram."""
+        with self._lock:
+            return {
+                "counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+                "hists": {k: h.to_dict() for k, h in self.hists.items()},
+                "compiles": len(self.compiles),
+                "compile_s": round(sum(c["dur"] for c in self.compiles), 6),
+                "uptime_s": time.perf_counter() - self.t0_perf,
+                "process": self.process_index,
+            }
 
     def summary(self) -> dict:
         """Aggregate view: per-span totals, counters, gauges, compiles,
@@ -283,6 +480,8 @@ class _Registry:
                 "spans": spans,
                 "counters": dict(self.counters),
                 "gauges": dict(self.gauges),
+                "hists": {name: h.stats()
+                          for name, h in self.hists.items()},
                 "compiles": {
                     "count": len(self.compiles),
                     "total_s": round(sum(c["dur"] for c in self.compiles),
@@ -292,15 +491,20 @@ class _Registry:
                 },
             }
 
-    def brief_summary(self, top: int = 8) -> dict:
+    def brief_summary(self, top: int = 8,
+                      summary: Optional[dict] = None) -> dict:
         """Compact per-phase breakdown for embedding in one-line JSON
-        (the bench.py contract): top spans by total time + compile cost."""
-        s = self.summary()
+        (the bench.py contract): top spans by total time + compile cost.
+        Pass a precomputed ``summary()`` to avoid re-sorting every span's
+        duration history."""
+        s = summary if summary is not None else self.summary()
         ranked = sorted(s["spans"].items(),
                         key=lambda kv: -kv[1]["total_s"])[:top]
         out = {"spans": {name: {"count": a["count"],
                                 "total_s": a["total_s"],
-                                "p50_ms": a["p50_ms"]}
+                                "p50_ms": a["p50_ms"],
+                                "p90_ms": a["p90_ms"],
+                                "p99_ms": a["p99_ms"]}
                          for name, a in ranked},
                "compiles": s["compiles"]["count"],
                "compile_s": s["compiles"]["total_s"]}
@@ -467,8 +671,9 @@ class JitWatch:
 _REG = _Registry()
 
 
-def enable(log_path: Optional[str] = None) -> None:
-    _REG.enable(log_path)
+def enable(log_path: Optional[str] = None,
+           process_index: Optional[int] = None) -> None:
+    _REG.enable(log_path, process_index=process_index)
 
 
 def disable() -> None:
@@ -499,6 +704,10 @@ def gauge(name: str, value) -> None:
     _REG.gauge(name, value)
 
 
+def hist(name: str, value: float) -> None:
+    _REG.hist(name, value)
+
+
 def event(ev: dict) -> None:
     _REG.record(ev)
 
@@ -523,12 +732,20 @@ def summary() -> dict:
     return _REG.summary()
 
 
-def brief_summary(top: int = 8) -> dict:
-    return _REG.brief_summary(top=top)
+def brief_summary(top: int = 8, summary: Optional[dict] = None) -> dict:
+    return _REG.brief_summary(top=top, summary=summary)
 
 
 def events() -> List[dict]:
     return _REG.events()
+
+
+def recent_events() -> List[dict]:
+    return _REG.recent_events()
+
+
+def last_event(kind: str) -> Optional[dict]:
+    return _REG.last_event(kind)
 
 
 def chrome_trace() -> dict:

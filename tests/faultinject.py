@@ -126,6 +126,22 @@ def always_failing(exc: BaseException = None):
 
 
 # ----------------------------------------------------------------------
+# training-health fault injection (tests/test_health_faults.py,
+# tests/test_statusd.py)
+def health_vec(loss, nan_grads=0, grad_norm_sq=None):
+    """A trainer-shaped per-step health vector ``[loss, grad_norm_sq,
+    nan_grads, ok]`` (the _make_train_step layout, utils/health.py slot
+    constants) — inject anomalies straight into a HealthMonitor with no
+    trainer in the loop (how test_statusd flips /healthz to 503)."""
+    import numpy as np
+    finite = bool(np.isfinite(loss))
+    gn = float(grad_norm_sq) if grad_norm_sq is not None \
+        else (1.0 if finite else float("nan"))
+    return np.asarray([loss, gn, float(nan_grads),
+                       1.0 if finite else 0.0], np.float32)
+
+
+# ----------------------------------------------------------------------
 # training-health fault injection (tests/test_health_faults.py)
 def _batch_key_hit(trainer, batch, round_, first_index):
     """Content-based batch key: (trainer round, first instance id).

@@ -388,3 +388,87 @@ def test_train_loop_input_wait_probe(tmp_path, mnist_data, capsys):
     m = re.search(r"io-only ([0-9.]+) images/sec", out)
     assert m, out
     assert float(m.group(1)) > 0
+
+
+def test_live_statusd_scrape_during_training(tmp_path, mnist_data):
+    """The acceptance path for status_port: while a training run is LIVE,
+    /metrics answers with Prometheus text including the step-latency
+    histogram buckets, /healthz answers 200, /statusz shows round/batch
+    progress — and the service (plus its in-memory telemetry) shuts down
+    with the run."""
+    import threading
+    import time
+    import urllib.request
+    from cxxnet_tpu.utils import statusd, telemetry
+
+    # far more rounds than needed: the test stops the run right after
+    # the scrape (the cooperative _stop_training round-boundary exit)
+    conf = write_conf(tmp_path, MLP_CONF, mnist_data, num_round=500)
+    task = LearnTask()
+    done = threading.Event()
+    err = []
+
+    def run():
+        try:
+            task.run([conf, "status_port=0", "preempt_save=0",
+                      "save_model=0"])
+        except Exception as e:      # surfaced by the main thread
+            err.append(e)
+        finally:
+            done.set()
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    try:
+        deadline = time.time() + 90
+        srv = None
+        while time.time() < deadline and not done.is_set():
+            srv = statusd.active()
+            if srv is not None and srv.progress.get("batch"):
+                break
+            time.sleep(0.05)
+        assert srv is not None and srv.progress.get("batch"), \
+            "statusd never served a completed batch (err=%r)" % err
+        base = "http://127.0.0.1:%d" % srv.port
+        metrics = urllib.request.urlopen(
+            base + "/metrics", timeout=10).read().decode()
+        assert "cxxnet_train_step_seconds_bucket" in metrics
+        assert "cxxnet_io_wait_seconds_bucket" in metrics
+        assert "cxxnet_train_images_total" in metrics
+        assert 'le="+Inf"' in metrics
+        assert urllib.request.urlopen(
+            base + "/healthz", timeout=10).status == 200
+        page = urllib.request.urlopen(
+            base + "/statusz", timeout=10).read().decode()
+        assert "progress" in page and "train.step" in page
+    finally:
+        task._stop_training = True   # cooperative stop at the round edge
+        done.wait(timeout=120)
+    th.join(timeout=10)
+    assert not err, err
+    assert statusd.active() is None       # stopped with the run
+    assert not telemetry.enabled()        # in-memory registry released
+
+
+def test_statusd_bind_failure_does_not_kill_the_run(tmp_path, mnist_data,
+                                                    capsys):
+    """An unbindable status_port (taken by another process) must warn
+    and train blind — never crash a training job over observability —
+    and must not leak the in-memory telemetry registry it enabled."""
+    import socket
+    from cxxnet_tpu.utils import statusd, telemetry
+    blocker = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    blocker.bind(("0.0.0.0", 0))
+    blocker.listen(1)
+    port = blocker.getsockname()[1]
+    try:
+        conf = write_conf(tmp_path, MLP_CONF, mnist_data, num_round=1)
+        task = run_task(conf, "status_port=%d" % port, "preempt_save=0")
+        assert task.start_counter == 2          # the round still trained
+    finally:
+        blocker.close()
+    assert "cannot bind port %d" % port in capsys.readouterr().err
+    assert statusd.active() is None
+    assert not telemetry.enabled()
+    # (the out-of-range-port OverflowError variant of this contract is
+    # pinned jax-free in test_statusd.py — no second train run here)

@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""Gate on benchmark throughput regressions.
+
+Usage:
+    python tools/bench_compare.py [--threshold 0.10] [--dir REPO]
+                                  [--bench FILE] [--baseline FILE]
+
+Diffs the newest ``BENCH_*.json`` (the driver's per-round bench capture:
+``{"parsed": <last line>, "tail": "<all emitted lines>"}`` — raw
+``bench.py`` output files work too) against the committed numbers in
+``BASELINE.json``'s ``"published"`` map (metric name -> value). Exit
+codes:
+
+* 0 — no regression, or nothing comparable: a metric whose measured value
+  is ``null`` (e.g. the "backend unreachable" rows a down TPU tunnel
+  produces) or that has no published baseline is SKIPPED cleanly, never
+  failed — an unreachable backend is a structured non-result, not a
+  regression.
+* 1 — usage / unreadable input.
+* 2 — at least one metric regressed by more than ``--threshold``
+  (default 10%). "Regressed" respects the metric's direction: lower is
+  worse for throughput rows, HIGHER is worse for latency rows (unit
+  ``ms`` or a metric name containing ``latency``).
+
+To start gating a metric, copy a trusted run's value into
+``BASELINE.json``: ``"published": {"alexnet_imagenet_images_per_sec_per_chip":
+15047.0}``.
+"""
+
+import glob
+import json
+import os
+import re
+import sys
+
+
+def find_newest_bench(dirname):
+    """Newest BENCH_*.json by the rNN round number (mtime breaks ties —
+    and orders any non-rNN names)."""
+    cands = glob.glob(os.path.join(dirname, "BENCH_*.json"))
+    if not cands:
+        return None
+
+    def key(p):
+        m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(p))
+        return (int(m.group(1)) if m else -1, os.path.getmtime(p))
+
+    return max(cands, key=key)
+
+
+def extract_lines(doc, raw_text=""):
+    """Bench result lines from either capture shape: the driver wrapper
+    ({"parsed": ..., "tail": "..."}) or raw bench.py JSONL output."""
+    lines = []
+    if isinstance(doc, dict) and "metric" in doc:
+        lines.append(doc)
+    if isinstance(doc, dict):
+        for blob in (doc.get("tail") or "", raw_text):
+            for ln in blob.splitlines():
+                ln = ln.strip()
+                if not ln.startswith("{"):
+                    continue
+                try:
+                    d = json.loads(ln)
+                except ValueError:
+                    continue
+                if isinstance(d, dict) and "metric" in d:
+                    lines.append(d)
+        parsed = doc.get("parsed")
+        if isinstance(parsed, dict) and "metric" in parsed:
+            lines.append(parsed)
+    if isinstance(doc, list):
+        lines.extend(d for d in doc if isinstance(d, dict)
+                     and "metric" in d)
+    # last occurrence of each metric wins (the driver keeps the headline
+    # line last; tail may repeat it)
+    out = {}
+    for d in lines:
+        out[d["metric"]] = d
+    return list(out.values())
+
+
+def lower_is_better(line):
+    return (line.get("unit") == "ms"
+            or "latency" in str(line.get("metric", "")))
+
+
+def compare(lines, published, threshold):
+    """-> (regressions, compared, skipped) lists of printable rows."""
+    regressions, compared, skipped = [], [], []
+    for line in lines:
+        metric = line.get("metric")
+        value = line.get("value")
+        base = published.get(metric)
+        if value is None:
+            skipped.append((metric, "measured value is null (%s)"
+                            % line.get("error", "no error recorded")))
+            continue
+        if base is None:
+            skipped.append((metric, "no published baseline"))
+            continue
+        if not base:
+            skipped.append((metric, "baseline is zero/null"))
+            continue
+        try:
+            value, base = float(value), float(base)
+        except (TypeError, ValueError):
+            # placeholder strings ('TBD') etc.: not comparable, never
+            # a gate failure
+            skipped.append((metric, "non-numeric value/baseline "
+                            "(%r vs %r)" % (value, base)))
+            continue
+        if not base:
+            skipped.append((metric, "baseline is zero"))
+            continue
+        ratio = value / base
+        if lower_is_better(line):
+            bad = ratio > 1.0 + threshold
+            delta = ratio - 1.0
+        else:
+            bad = ratio < 1.0 - threshold
+            delta = ratio - 1.0
+        row = (metric, base, value, delta)
+        (regressions if bad else compared).append(row)
+    return regressions, compared, skipped
+
+
+def main(argv):
+    threshold = 0.10
+    dirname = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+    bench_path = None
+    baseline_path = None
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--threshold" and i + 1 < len(argv):
+            threshold = float(argv[i + 1])
+            i += 2
+        elif a == "--dir" and i + 1 < len(argv):
+            dirname = argv[i + 1]
+            i += 2
+        elif a == "--bench" and i + 1 < len(argv):
+            bench_path = argv[i + 1]
+            i += 2
+        elif a == "--baseline" and i + 1 < len(argv):
+            baseline_path = argv[i + 1]
+            i += 2
+        else:
+            print(__doc__, file=sys.stderr)
+            return 1
+    if bench_path is None:
+        bench_path = find_newest_bench(dirname)
+        if bench_path is None:
+            print("bench_compare: no BENCH_*.json in %s — nothing to "
+                  "compare (ok)" % dirname)
+            return 0
+    if baseline_path is None:
+        baseline_path = os.path.join(dirname, "BASELINE.json")
+    try:
+        with open(bench_path) as f:
+            raw = f.read()
+    except OSError as e:
+        print("bench_compare: cannot read %s: %s" % (bench_path, e),
+              file=sys.stderr)
+        return 1
+    try:
+        doc = json.loads(raw)
+    except ValueError:
+        # raw bench.py output is one JSON object PER LINE, not one
+        # document: extract_lines parses it line-by-line
+        doc = {}
+    published = {}
+    if os.path.exists(baseline_path):
+        try:
+            with open(baseline_path) as f:
+                published = json.load(f).get("published", {}) or {}
+        except (OSError, ValueError) as e:
+            print("bench_compare: cannot read %s: %s" % (baseline_path, e),
+                  file=sys.stderr)
+            return 1
+    lines = extract_lines(doc, raw)
+    if not lines:
+        print("bench_compare: no bench result lines in %s (ok: nothing "
+              "to gate)" % bench_path)
+        return 0
+    regressions, compared, skipped = compare(lines, published, threshold)
+    print("bench_compare: %s vs %s (threshold %.0f%%)"
+          % (os.path.basename(bench_path), os.path.basename(baseline_path),
+             100 * threshold))
+    for metric, base, value, delta in compared:
+        print("  ok    %-48s %12.2f -> %12.2f (%+.1f%%)"
+              % (metric, base, value, 100 * delta))
+    for metric, why in skipped:
+        print("  skip  %-48s %s" % (metric, why))
+    for metric, base, value, delta in regressions:
+        print("  REGRESSION %-43s %12.2f -> %12.2f (%+.1f%% > %.0f%%)"
+              % (metric, base, value, 100 * delta, 100 * threshold))
+    if regressions:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

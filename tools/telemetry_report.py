@@ -1,16 +1,27 @@
 #!/usr/bin/env python
-"""Summarize a telemetry JSONL run log (utils/telemetry.py).
+"""Summarize telemetry JSONL run logs (utils/telemetry.py).
 
 Usage:
     python tools/telemetry_report.py run.jsonl [--top N] [--trace out.json]
                                                [--json]
+    python tools/telemetry_report.py --merge shard0.jsonl shard1.jsonl ...
+                                               [--top N] [--json]
 
 Prints top spans by total time, recompile count/causes/seconds, per-round
-breakdowns, counters/gauges, step-time percentiles, and a training-health
-section (anomalies/rollbacks/watchdog stalls/corrupt records,
-utils/health.py). ``--trace`` additionally exports a chrome://tracing /
-Perfetto JSON built from the span tree. ``--json`` emits the aggregate as
-one JSON object instead of the table (for scripting).
+breakdowns, counters/gauges, fixed-bucket latency histograms (bucket table
++ p50/p90/p99), step-time percentiles, and a training-health section
+(anomalies/rollbacks/watchdog stalls/corrupt records, utils/health.py).
+``--trace`` additionally exports a chrome://tracing / Perfetto JSON built
+from the span tree. ``--json`` emits the aggregate as one JSON object
+instead of the table (for scripting).
+
+``--merge`` reads one shard per process of a multihost run (the
+``telemetry_log = run.%d.jsonl`` rank-placeholder layout): each shard's
+timestamps are re-aligned onto the shared wall-clock epoch (the earliest
+shard's ``t0_wall``), events keep their ``p`` process tag, histograms
+merge EXACTLY (shared fixed buckets: bucket-count addition), counters sum
+across processes, and the report adds a per-process breakdown — one
+coherent cross-host view instead of N clobbering logs.
 
 Exit codes: 0 ok; 1 usage / unreadable file; 2 malformed log (a line
 that is not valid JSON, or no telemetry events at all) OR a log with
@@ -28,7 +39,7 @@ sys.path.insert(0, os.path.join(
     os.path.dirname(os.path.abspath(__file__)), ".."))
 
 from cxxnet_tpu.utils.telemetry import (  # noqa: E402
-    count_by, events_to_chrome, percentile)
+    HIST_BUCKETS, Histogram, count_by, events_to_chrome, percentile)
 
 
 def load_events(path):
@@ -58,31 +69,118 @@ def load_events(path):
     return events
 
 
+def shard_identity(events, default_p):
+    """(t0_wall, process_index) of one shard: the meta event carries the
+    wall-clock epoch; the process tag rides on every event ("p").
+    t0_wall is None when no meta event exists (truncated copy)."""
+    t0 = None
+    p = None
+    for ev in events:
+        if t0 is None and ev.get("ev") == "meta":
+            t0 = float(ev.get("t0_wall", 0.0))
+        if p is None and "p" in ev:
+            p = int(ev["p"])
+        if t0 is not None and p is not None:
+            break
+    return t0, (p if p is not None else default_p)
+
+
+def merge_shards(shard_events):
+    """Merge per-process shards into ONE event stream on a shared clock.
+
+    Each shard's ``ts`` values are seconds since ITS OWN start; shards of
+    one run started at (slightly) different wall times. Re-base every
+    shard onto the earliest ``t0_wall`` so "the same moment" has the same
+    ts across processes, tag untagged events with the shard's process
+    index, and sort. Duplicate process indices (merging the same shard
+    twice) are rejected — the aggregate would double-count."""
+    metas = []
+    for i, events in enumerate(shard_events):
+        t0, p = shard_identity(events, i)
+        if t0 is None:
+            # no meta event = no epoch: re-basing the OTHER shards
+            # against a 0.0 epoch would shift them by ~50 years —
+            # refuse rather than emit a silently garbage timeline
+            print("--merge: shard %d has no 'meta' event (truncated "
+                  "copy?); cannot align it on the shared wall-clock "
+                  "epoch" % i, file=sys.stderr)
+            sys.exit(2)
+        metas.append((t0, p, events))
+    seen = {}
+    for i, (_, p, _) in enumerate(metas):
+        if p in seen:
+            print("--merge: shards %d and %d both claim process index %d "
+                  "— merging the same shard twice?" % (seen[p], i, p),
+                  file=sys.stderr)
+            sys.exit(1)
+        seen[p] = i
+    epoch = min(t0 for t0, _, _ in metas)
+    merged = []
+    for t0, p, events in metas:
+        off = t0 - epoch
+        for ev in events:
+            ev = dict(ev)
+            if "ts" in ev:
+                ev["ts"] = round(float(ev["ts"]) + off, 6)
+            ev.setdefault("p", p)
+            merged.append(ev)
+    merged.sort(key=lambda e: e.get("ts", 0.0))
+    return merged
+
+
 def aggregate(events):
     spans = {}
     compiles = []
-    counters = {}
+    counters_by_p = {}
+    hists_by_p = {}
     gauges = {}
+    gauges_by_p = {}
     rounds = []
+    procs = set()
+    by_proc = {}
     health = {"anomalies": [], "resolutions": [], "stalls": [],
               "data_corrupt": 0, "skipped_batches": 0}
+
+    def proc(ev):
+        p = int(ev.get("p", 0))
+        procs.add(p)
+        return p
+
     for ev in events:
         kind = ev.get("ev")
         if kind == "span":
             a = spans.setdefault(ev["name"], [])
             a.append(float(ev.get("dur", 0.0)))
+            pb = by_proc.setdefault(proc(ev), {"spans": {}, "images": 0,
+                                               "rounds": 0})
+            sp = pb["spans"].setdefault(ev["name"], [0, 0.0])
+            sp[0] += 1
+            sp[1] += float(ev.get("dur", 0.0))
         elif kind == "compile":
             compiles.append(ev)
+            proc(ev)
         elif kind == "gauge":
             gauges[ev["name"]] = ev.get("value")
+            gauges_by_p.setdefault(proc(ev), {})[ev["name"]] = \
+                ev.get("value")
         elif kind == "round":
             rounds.append(ev)
+            pb = by_proc.setdefault(proc(ev), {"spans": {}, "images": 0,
+                                               "rounds": 0})
+            pb["images"] += int(ev.get("images", 0))
+            pb["rounds"] += 1
         elif kind == "counters":
-            # periodic snapshot (per-round flush): monotonic, last wins —
-            # a crashed run keeps its counters up to the last flush
-            counters = ev.get("counters", {})
+            # periodic snapshot (per-round flush): monotonic, last wins
+            # PER PROCESS — a crashed shard keeps its counters to the
+            # last flush; cross-process totals are summed below
+            counters_by_p[proc(ev)] = ev.get("counters", {})
+        elif kind == "hists":
+            # cumulative like counters: last snapshot per process wins
+            hists_by_p[proc(ev)] = ev.get("hists", {})
         elif kind == "summary":
-            counters = ev.get("summary", {}).get("counters", counters)
+            p = proc(ev)
+            s = ev.get("summary", {})
+            counters_by_p[p] = s.get("counters", counters_by_p.get(p, {}))
         elif kind == "health_anomaly":
             health["anomalies"].append(ev)
         elif kind in ("health_rollback", "health_skip", "health_abort",
@@ -95,13 +193,38 @@ def aggregate(events):
         elif kind == "health_skip_batch":
             health["skipped_batches"] += 1
     # an anomaly is resolved by an inline resolution field (warn-only
-    # metric events) or by any recovery event referencing its id
-    resolved = {r.get("anomaly") for r in health["resolutions"]}
+    # metric events) or by any recovery event referencing its id —
+    # matched PER PROCESS: anomaly ids are per-process counters, so in a
+    # merged multihost report shard A's rollback of id=1 must not
+    # resolve shard B's unrelated (and possibly unrecovered) id=1
+    resolved = {(int(r.get("p", 0)), r.get("anomaly"))
+                for r in health["resolutions"]}
     health["unresolved"] = [
         a for a in health["anomalies"]
-        if a.get("resolution") is None and a.get("id") not in resolved]
+        if a.get("resolution") is None
+        and (int(a.get("p", 0)), a.get("id")) not in resolved]
+    counters = {}
+    for snap in counters_by_p.values():
+        for name, v in snap.items():
+            counters[name] = counters.get(name, 0) + v
+    # exact cross-shard histogram merge: every histogram shares the fixed
+    # log-spaced HIST_BUCKETS, so merging is bucket-count addition
+    merged_hists = {}
+    for p, snap in hists_by_p.items():
+        for name, d in snap.items():
+            try:
+                merged_hists.setdefault(name, Histogram()).merge_dict(d)
+            except (ValueError, TypeError) as e:
+                print("process %d histogram %r: %s" % (p, name, e),
+                      file=sys.stderr)
+                sys.exit(2)
     out = {"spans": {}, "compiles": {}, "counters": counters,
-           "gauges": gauges, "rounds": rounds, "health": health}
+           "gauges": gauges, "rounds": rounds, "health": health,
+           "hists": {}}
+    for name, h in sorted(merged_hists.items()):
+        st = h.stats()
+        st["buckets"] = h.to_dict()["buckets"]
+        out["hists"][name] = st
     for name, durs in spans.items():
         durs.sort()
         out["spans"][name] = {
@@ -117,7 +240,35 @@ def aggregate(events):
         "total_s": round(sum(float(c.get("dur", 0.0)) for c in compiles), 6),
         "by_cause": count_by(compiles, "cause"),
     }
+    if len(procs) > 1:
+        out["processes"] = {}
+        for p in sorted(procs):
+            pb = by_proc.get(p, {"spans": {}, "images": 0, "rounds": 0})
+            out["processes"][str(p)] = {
+                "images": pb["images"],
+                "rounds": pb["rounds"],
+                "spans": {name: {"count": n, "total_s": round(t, 6)}
+                          for name, (n, t) in sorted(pb["spans"].items())},
+                "counters": counters_by_p.get(p, {}),
+                # per-process gauge values: the merged top-level dict is
+                # last-event-wins across shards, which would hide e.g.
+                # the one near-OOM host's device.bytes_in_use
+                "gauges": gauges_by_p.get(p, {}),
+            }
     return out
+
+
+def _bucket_rows(buckets):
+    """(le, cumulative_count) rows of a sparse bucket dict — CUMULATIVE,
+    matching Prometheus ``le`` semantics (and /metrics output): the row
+    for bound B counts every sample <= B. One row per occupied bound."""
+    rows = []
+    cum = 0
+    for i, c in sorted(((int(i), c) for i, c in buckets.items())):
+        cum += c
+        le = "+Inf" if i >= len(HIST_BUCKETS) else "%g" % HIST_BUCKETS[i]
+        rows.append((le, cum))
+    return rows
 
 
 def print_report(agg, top=15):
@@ -142,24 +293,53 @@ def print_report(agg, top=15):
         print("n=%d  p50=%.2fms  p90=%.2fms  p99=%.2fms  max=%.2fms" %
               (step["count"], step["p50_ms"], step["p90_ms"],
                step["p99_ms"], step["max_ms"]))
+    if agg.get("hists"):
+        print("\n== latency histograms (fixed log-spaced buckets, "
+              "merge-exact) ==")
+        for name, h in sorted(agg["hists"].items(),
+                              key=lambda kv: -kv[1]["sum_s"]):
+            print("%-24s n=%-8d sum=%.3fs  p50=%.2fms  p90=%.2fms  "
+                  "p99=%.2fms" % (name, h["count"], h["sum_s"],
+                                  h["p50_ms"], h["p90_ms"], h["p99_ms"]))
+            for le, c in _bucket_rows(h.get("buckets", {})):
+                print("    le=%-12s %d" % (le, c))
     if agg["rounds"]:
         print("\n== rounds ==")
-        print("%6s %9s %12s %9s %9s %9s" %
+        multi = "processes" in agg
+        pre_hdr = "%6s " % "proc" if multi else ""
+        print(pre_hdr + "%6s %9s %12s %9s %9s %9s" %
               ("round", "images", "input_wait_s", "step_s", "eval_s",
                "ckpt_s"))
         for r in agg["rounds"]:
-            print("%6d %9d %12.3f %9.3f %9.3f %9.3f" %
+            pre = "%6d " % r.get("p", 0) if multi else ""
+            print(pre + "%6d %9d %12.3f %9.3f %9.3f %9.3f" %
                   (r.get("round", -1), r.get("images", 0),
                    r.get("input_wait_s", 0.0), r.get("step_s", 0.0),
                    r.get("eval_s", 0.0), r.get("checkpoint_s", 0.0)))
     if agg["counters"]:
-        print("\n== counters ==")
+        print("\n== counters%s ==" %
+              (" (summed across processes)" if "processes" in agg else ""))
         for name, v in sorted(agg["counters"].items()):
             print("  %-28s %s" % (name, v))
     if agg["gauges"]:
         print("\n== gauges (last value) ==")
         for name, v in sorted(agg["gauges"].items()):
             print("  %-28s %s" % (name, v))
+    if "processes" in agg:
+        print("\n== per-process breakdown ==")
+        for p, pb in sorted(agg["processes"].items(), key=lambda kv:
+                            int(kv[0])):
+            print("process %s: %d rounds, %d images" %
+                  (p, pb["rounds"], pb["images"]))
+            ranked = sorted(pb["spans"].items(),
+                            key=lambda kv: -kv[1]["total_s"])[:5]
+            for name, a in ranked:
+                print("    %-20s %8d calls %10.3fs" %
+                      (name, a["count"], a["total_s"]))
+            for name, v in sorted(pb.get("counters", {}).items()):
+                print("    counter %-20s %s" % (name, v))
+            for name, v in sorted(pb.get("gauges", {}).items()):
+                print("    gauge   %-20s %s" % (name, v))
     h = agg.get("health", {})
     if h and (h["anomalies"] or h["stalls"] or h["data_corrupt"]
               or h["skipped_batches"]):
@@ -192,6 +372,7 @@ def main(argv):
     top = 15
     trace_out = None
     as_json = False
+    merge = False
     paths = []
     i = 0
     while i < len(argv):
@@ -205,24 +386,34 @@ def main(argv):
         elif a == "--json":
             as_json = True
             i += 1
+        elif a == "--merge":
+            merge = True
+            i += 1
         elif a.startswith("--"):
             print("unknown option %s" % a, file=sys.stderr)
             return 1
         else:
             paths.append(a)
             i += 1
-    if len(paths) != 1:
+    if (len(paths) != 1 and not merge) or (merge and len(paths) < 1):
         print(__doc__, file=sys.stderr)
         return 1
-    path = paths[0]
-    if not os.path.exists(path):
-        print("no such log: %s" % path, file=sys.stderr)
-        return 1
-    events = load_events(path)
+    for path in paths:
+        if not os.path.exists(path):
+            print("no such log: %s" % path, file=sys.stderr)
+            return 1
+    if merge:
+        events = merge_shards([load_events(p) for p in paths])
+        label = "+".join(paths)
+    else:
+        events = load_events(paths[0])
+        label = paths[0]
     agg = aggregate(events)
     if as_json:
         print(json.dumps(agg, indent=1))
     else:
+        if merge:
+            print("merged %d shard(s): %s\n" % (len(paths), label))
         print_report(agg, top=top)
     if trace_out:
         with open(trace_out, "w") as f:
@@ -233,7 +424,7 @@ def main(argv):
     if unresolved:
         print("%s: %d health_anomaly event(s) with no matching "
               "health_rollback/resolution — the run detected trouble and "
-              "never recovered" % (path, len(unresolved)), file=sys.stderr)
+              "never recovered" % (label, len(unresolved)), file=sys.stderr)
         return 2
     return 0
 
