@@ -44,13 +44,16 @@ test-fast:
 # fast regression gate (no pytest, no jax): every module byte-compiles,
 # the checkpoint verifier still detects every corruption class, the
 # training-health detect->rollback->skip state machine still recovers,
-# and the live introspection service serves/scrapes/shuts-down on a real
-# socket with valid Prometheus output — a checkpoint-format, recovery-
-# policy, or metrics-format regression fails here in seconds
+# the live introspection service serves/scrapes/shuts-down on a real
+# socket with valid Prometheus output, and the serving frontend's
+# admission/deadline/breaker/drain machinery answers every request over
+# a real socket — a checkpoint-format, recovery-policy, metrics-format,
+# or serving-protocol regression fails here in seconds
 check:
 	python -m compileall -q cxxnet_tpu tools tests
 	python tools/ckpt_fsck.py --selftest
 	python -m cxxnet_tpu.utils.health --selftest
 	python -m cxxnet_tpu.utils.statusd --selftest
+	python -m cxxnet_tpu.utils.servd --selftest
 
 .PHONY: all clean test-fast check

@@ -438,6 +438,85 @@ def bench_lm_decode_b1_chunked():
                       1, 2048, 64, extra="decode_chunk = 256\n")
 
 
+def bench_serve_load():
+    """Serve-under-load: concurrent clients against the servd frontend
+    (utils/servd.py) on loopback — end-to-end per-request p50/p99
+    latency (socket + admission queue + KV-cached decode) and shed rate,
+    so tools/bench_compare.py gates serving-latency regressions
+    (unit ms = direction-aware, higher is worse) the way it already
+    gates throughput. One prompt-length signature: the decode program
+    compiles once and every request rides the cached fast path."""
+    import socket
+    import threading
+    from cxxnet_tpu.models import transformer_lm_trainer
+    from cxxnet_tpu.utils import servd
+    from cxxnet_tpu.utils.telemetry import percentile
+    vocab, L, plen, n_new = 8192, 256, 32, 16
+    tr = transformer_lm_trainer(vocab=vocab, seq=L, batch_size=8,
+                                dim=256, nhead=4, nlayer=2, dev="tpu",
+                                extra_cfg=BF16)
+
+    def backend(toks, seq):
+        return tr.generate(np.asarray([toks]), n_new)[0]
+
+    rs = np.random.RandomState(0)
+    prompt = rs.randint(0, vocab, plen).tolist()
+    backend(prompt, 0)              # compile the (1, plen) decode once
+    fe = servd.ServeFrontend(backend, queue_size=64)
+    fe.start()
+    port = fe.listen(0)
+    nclients, per = 4, 8
+    line = " ".join(map(str, prompt))
+    lats, nshed, nerr, nsent = [], [0], [0], [0]
+    lock = threading.Lock()
+
+    def client():
+        with socket.create_connection(("127.0.0.1", port),
+                                      timeout=300) as c:
+            f = c.makefile("r")
+            for _ in range(per):
+                t0 = time.perf_counter()
+                c.sendall((line + "\n").encode())
+                resp = f.readline()
+                dt = time.perf_counter() - t0
+                with lock:
+                    nsent[0] += 1
+                    if not resp:
+                        # connection torn down: an error, NOT a ~0ms
+                        # latency sample that would deflate the gated
+                        # p50/p99 of a degraded run
+                        nerr[0] += 1
+                    elif resp.startswith("ERR busy"):
+                        nshed[0] += 1       # shed = admission rejection
+                    elif resp.startswith("ERR"):
+                        nerr[0] += 1        # backend/deadline: not shed
+                    else:
+                        lats.append(dt)
+                if not resp:
+                    break
+
+    threads = [threading.Thread(target=client) for _ in range(nclients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    fe.drain()
+    lats.sort()
+    # rates over requests actually ISSUED: a client whose connection died
+    # stops early, and its unsent requests must not pad the denominator
+    # (a fully degraded run would otherwise understate its error rate)
+    total = max(1, nsent[0])
+    return {"metric": "serve_loopback_p99_latency_ms",
+            "value": round(1e3 * percentile(lats, 99), 3) if lats
+            else None,
+            "unit": "ms", "vs_baseline": None,
+            "p50_ms": round(1e3 * percentile(lats, 50), 3) if lats
+            else None,
+            "shed_rate": round(nshed[0] / float(total), 4),
+            "error_rate": round(nerr[0] / float(total), 4),
+            "requests": nsent[0]}
+
+
 def bench_mnist_mlp():
     tr = _conf_trainer(MNIST_MLP, (1, 1, 784), 100, extra=BF16)
     ips = _throughput(tr, (1, 1, 784), 10, 100, steps=100)
@@ -659,7 +738,7 @@ def _bench_main():
                    bench_alexnet_latency_b1, bench_lm_decode,
                    bench_lm_decode_b1, bench_lm_decode_long,
                    bench_lm_decode_chunked, bench_lm_decode_long_chunked,
-                   bench_lm_decode_b1_chunked):
+                   bench_lm_decode_b1_chunked, bench_serve_load):
             print(json.dumps(_attach_telemetry(fn())), flush=True)
     if len(sys.argv) > 1 and sys.argv[1] in ("all", "pipeline"):
         lines = bench_alexnet_pipeline()

@@ -210,6 +210,35 @@ class Net:
                                       top_k=top_k, seed=seed,
                                       prompt_lens=prompt_lens)
 
+    def serve(self, port: int = 0, host: str = "", n_new: int = 16,
+              temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+              **opts):
+        """Start the production serving frontend (utils/servd.py,
+        doc/serving.md) around this net's ``generate`` on a TCP line
+        protocol: bounded admission queue with ``ERR busy`` shedding,
+        per-request ``DEADLINE <ms>`` deadlines, backend supervision
+        with a circuit breaker, ``ADMIN reload`` hooks, and a graceful
+        ``drain()``. Returns the started, listening
+        ``servd.ServeFrontend`` (``.port`` is the bound port; port 0 =
+        ephemeral; loopback unless ``host`` widens it). ``opts`` pass
+        through to ServeFrontend (queue_size, deadline_ms, drain_ms,
+        breaker_fails, breaker_cooldown_ms, reload_fn, ...). The caller
+        owns shutdown: call ``.drain()`` — every accepted request is
+        answered before it returns."""
+        from .utils import servd
+        assert self.net_ is not None, "model not initialized"
+        vocab = servd.embed_vocab(self.net_.net)
+
+        def backend(toks, seq):
+            return self.net_.generate(
+                np.asarray([toks]), n_new, temperature=temperature,
+                top_k=top_k, seed=seed + seq)[0]
+
+        fe = servd.ServeFrontend(backend, vocab=vocab, **opts)
+        fe.start()
+        fe.listen(port, host=host)
+        return fe
+
     def beam_generate(self, prompts: np.ndarray, n_new: int,
                       beam: int = 4) -> np.ndarray:
         """Width-`beam` KV-cached beam search (best summed-log-prob

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import os
 import sys
+import threading
 import time
 from typing import List, Optional, Tuple
 
@@ -114,6 +115,20 @@ class LearnTask:
         self.export_batch = 0
         self.name_prompt_in = "prompts.txt"
         self.name_gen_out = "gen.txt"
+        # serving frontend (utils/servd.py, doc/serving.md): task = serve
+        # always runs through it (bounded admission queue + shedding,
+        # deadlines, backend supervision + circuit breaker, graceful
+        # drain, ADMIN reload / SIGHUP hot model reload); serve_port >= 0
+        # ADDITIONALLY serves the TCP line protocol (0 = ephemeral,
+        # printed; loopback unless serve_host widens it)
+        self.serve_port = -1
+        self.serve_host = ""
+        self.serve_queue = 64
+        self.serve_deadline_ms = 0.0     # 0 = no default deadline
+        self.serve_drain_ms = 5000.0
+        self.serve_breaker_fails = 5
+        self.serve_breaker_cooldown_ms = 1000.0
+        self.serve_stall_s = 120.0       # wedged-backend probe bound
         self.gen_new = 16
         self.gen_temperature = 0.0
         self.gen_topk = 0
@@ -178,13 +193,20 @@ class LearnTask:
                 statusd.set_run_info(task=self.task, dev=self.device,
                                      config=list(self.cfg))
                 if not self.silent:
+                    # stderr: operational chatter — task = serve's stdout
+                    # is a response stream (one line per request)
                     print("statusd: live introspection on port %d "
-                          "(/metrics /healthz /statusz /trace)" % srv.port)
+                          "(/metrics /healthz /livez /statusz /trace)"
+                          % srv.port, file=sys.stderr, flush=True)
         try:
             with telemetry.span("init"):
                 self.init()
             if not self.silent:
-                print("initializing end, start working")
+                # serve's stdout carries exactly one response line per
+                # request — startup chatter goes to stderr there
+                print("initializing end, start working",
+                      file=sys.stderr if self.task == "serve"
+                      else sys.stdout)
             if self.task in ("train", "finetune"):
                 self.task_train()
             elif self.task == "pred":
@@ -283,6 +305,22 @@ class LearnTask:
             self.num_worker = int(val)
         if name == "worker_rank":
             self.worker_rank = int(val)
+        if name == "serve_port":
+            self.serve_port = int(val)
+        if name == "serve_host":
+            self.serve_host = val
+        if name == "serve_queue":
+            self.serve_queue = int(val)
+        if name == "serve_deadline_ms":
+            self.serve_deadline_ms = float(val)
+        if name == "serve_drain_ms":
+            self.serve_drain_ms = float(val)
+        if name == "serve_breaker_fails":
+            self.serve_breaker_fails = int(val)
+        if name == "serve_breaker_cooldown_ms":
+            self.serve_breaker_cooldown_ms = float(val)
+        if name == "serve_stall_s":
+            self.serve_stall_s = float(val)
         if name == "extract_node_name":
             self.extract_node_name = val
         if name == "export_out":
@@ -406,9 +444,11 @@ class LearnTask:
                              "counter": prog[0] - 1,
                              "batches_done": self._resume_batches})
             if not self.silent and self._resume_batches:
+                # stderr: this scan also runs on a serve hot reload,
+                # where stdout is the response stream
                 print("Init: resuming mid-round from %s (%d batches into "
                       "round %d)" % (path, self._resume_batches,
-                                     prog[0] - 1))
+                                     prog[0] - 1), file=sys.stderr)
             return 1
         return 0
 
@@ -1053,10 +1093,8 @@ class LearnTask:
                 if line:
                     rows.append([int(t) for t in line])
         assert rows, "prompt_in %s has no prompts" % self.name_prompt_in
-        vocab = max((lay.vocab_size
-                     for lay in self.net_trainer.net.layers
-                     if getattr(lay, "type_name", "") == "embed"),
-                    default=0)
+        from .utils.servd import embed_vocab
+        vocab = embed_vocab(self.net_trainer.net)
         if vocab:
             bad = [t for r in rows for t in r if not 0 <= t < vocab]
             assert not bad, (
@@ -1076,58 +1114,194 @@ class LearnTask:
               % (out.shape[0], out.shape[1], self.name_gen_out))
 
     def task_serve(self) -> None:
-        """task = serve: interactive line-serving loop over stdin/stdout
-        (beyond the reference — the minimal online counterpart of
-        task = generate). Each input line is one prompt of
-        space-separated token ids; the continuation (gen_new ids, greedy
-        or gen_temperature/gen_topk-sampled) is written back as one line
-        and flushed immediately. The KV-cached decode program is
+        """task = serve: online serving through the production frontend
+        (utils/servd.py, doc/serving.md). The stdin/stdout line loop of
+        the reference-era task is still the default surface — each input
+        line is one prompt of space-separated token ids, answered with
+        one line (the gen_new-token continuation, or ``ERR <class>``) —
+        but every request now runs through the frontend engine: backend
+        supervision (an exception answers ``ERR backend`` and feeds the
+        circuit breaker instead of killing the loop), per-request
+        deadlines (``DEADLINE <ms>`` prefix / serve_deadline_ms),
+        admission control, hot model reload (``ADMIN reload`` / SIGHUP
+        picks up the newest valid checkpoint in model_dir between
+        requests), and graceful drain on SIGTERM/SIGINT (finish accepted
+        requests within serve_drain_ms, flush telemetry, exit 0).
+        serve_port >= 0 additionally serves concurrent TCP clients with
+        the same line protocol; after stdin EOF the process then keeps
+        serving until a drain signal. The KV-cached decode program is
         compiled per prompt-length signature and reused across requests
-        (bucket client-side prompt lengths to keep compilations few).
-        EOF ends the loop. Batch is 1 per request by design — the
-        latency-bound serving case; use task = generate for offline
-        batch throughput."""
-        vocab = max((lay.vocab_size
-                     for lay in self.net_trainer.net.layers
-                     if getattr(lay, "type_name", "") == "embed"),
-                    default=0)
-        served = errors = 0
+        (bucket client-side prompt lengths to keep compilations few);
+        batch is 1 per request by design — the latency-bound serving
+        case; use task = generate for offline batch throughput."""
+        import signal
+
+        from .utils import servd
+
+        vocab = servd.embed_vocab(self.net_trainer.net)
         statusd.update_progress(served=0, errors=0)
 
-        def request_error(msg):
-            # a malformed request must not kill the serving loop: it is
-            # the CLIENT's error — answered, counted, surfaced
-            nonlocal errors
-            errors += 1
-            telemetry.count("serve.errors")
-            statusd.update_progress(errors=errors)
-            print("ERR " + msg, flush=True)
+        def backend(toks, seq):
+            # reads net_trainer THROUGH self so a hot reload's swapped-in
+            # trainer serves the very next request
+            return self.net_trainer.generate(
+                [toks], self.gen_new, temperature=self.gen_temperature,
+                top_k=self.gen_topk, seed=self.gen_seed + seq)[0]
 
-        for line in sys.stdin:
+        def newest_ckpt_sig():
+            # identity of the newest checkpoint candidates (newest
+            # numbered + emergency file): any new or rewritten file
+            # changes the signature, so a matching one means a reload
+            # would re-load the very model being served
+            paths = []
+            cands = ckpt.scan_checkpoints(self.name_model_dir)
+            if cands:
+                paths.append(cands[-1][1])
+            epath = os.path.join(self.name_model_dir,
+                                 ckpt.EMERGENCY_NAME)
+            if os.path.exists(epath):
+                paths.append(epath)
+            sig = []
+            for p in paths:
+                try:
+                    fst = os.stat(p)
+                except OSError:
+                    continue
+                sig.append((os.path.realpath(p), fst.st_mtime_ns,
+                            fst.st_size))
+            return tuple(sig)
+
+        # seed the signature when the model being served IS the newest
+        # candidate, so an operator's blind SIGHUP loop starts out free
+        served_sig = [newest_ckpt_sig()]
+        if served_sig[0] and not (
+                len(served_sig[0]) == 1 and served_sig[0][0][0]
+                == os.path.realpath(self.name_model_in)):
+            served_sig[0] = None
+
+        def reload_fn():
+            # a reload that would re-load the checkpoint already being
+            # served must be FREE: rebuilding the trainer discards every
+            # compiled decode program — the recompile latency cliff —
+            # for a bit-identical model
+            sig = newest_ckpt_sig()
+            if sig and sig == served_sig[0]:
+                if not self.silent:
+                    print("serve: reload skipped — already serving the "
+                          "newest checkpoint", file=sys.stderr,
+                          flush=True)
+                return False
+            # newest valid checkpoint in model_dir (the continue=1 scan:
+            # CRC-verified newest-first, corrupt files quarantined);
+            # nothing valid = keep the current model and say so
+            prev_counter = self.start_counter
+            self.start_counter = 0
+            if self._sync_latest_model() == 0:
+                self.start_counter = prev_counter
+                sys.stderr.write(
+                    "WARNING: serve reload: no valid checkpoint in %s; "
+                    "keeping the current model\n" % self.name_model_dir)
+                return False
+            served_sig[0] = sig
+            if not self.silent:
+                # stderr: stdout is the response stream (one line per
+                # request — a banner there desyncs positional clients)
+                print("serve: reloaded model (round %d checkpoint)"
+                      % (self.start_counter - 1), file=sys.stderr,
+                      flush=True)
+            return True
+
+        fe = servd.ServeFrontend(
+            backend, queue_size=self.serve_queue,
+            deadline_ms=self.serve_deadline_ms,
+            drain_ms=self.serve_drain_ms,
+            breaker_fails=self.serve_breaker_fails,
+            breaker_cooldown_ms=self.serve_breaker_cooldown_ms,
+            stall_after_s=self.serve_stall_s,
+            vocab=vocab, reload_fn=reload_fn)
+        fe.start()
+        if self.serve_port >= 0:
             try:
-                toks = [int(t) for t in line.split()]
-            except ValueError:
-                request_error("non-integer token in request")
-                continue
-            if not toks:
-                continue
-            if vocab and not all(0 <= t < vocab for t in toks):
-                request_error("token id outside vocab_size %d" % vocab)
-                continue
-            # the span feeds the fixed-bucket serve.request latency
-            # histogram — what /metrics exposes as per-request p50/p99
-            with telemetry.span("serve.request", tokens=len(toks)):
-                out = self.net_trainer.generate(
-                    [toks], self.gen_new, temperature=self.gen_temperature,
-                    top_k=self.gen_topk, seed=self.gen_seed + served)
-            print(" ".join(str(int(t)) for t in out[0]), flush=True)
-            served += 1
-            telemetry.count("serve.requests")
-            statusd.update_progress(served=served)
-        telemetry.event({"ev": "serve_done", "served": served,
-                         "errors": errors})
-        print("served %d prompts (%d request errors)" % (served, errors),
+                port = fe.listen(self.serve_port, host=self.serve_host)
+            except (OSError, OverflowError) as e:
+                # like the statusd bind guard: a taken port must not kill
+                # serving — warn, fall back to the stdin surface
+                sys.stderr.write(
+                    "WARNING: servd: cannot bind port %d (%s); TCP "
+                    "serving disabled, stdin loop only\n"
+                    % (self.serve_port, e))
+            else:
+                if not self.silent:
+                    # stderr, not stdout: stdout carries exactly one
+                    # response line per stdin request
+                    print("servd: serving on port %d (line protocol; "
+                          "DEADLINE/ADMIN prefixes, ERR classes — "
+                          "doc/serving.md)" % port, file=sys.stderr,
+                          flush=True)
+        # /healthz flips 503 while draining or breaker-open (readiness);
+        # /livez only dies with the worker thread (liveness)
+        statusd.register_probe("serving", fe.health_probe)
+        statusd.register_probe("serving.worker", fe.liveness_probe,
+                               liveness=True)
+        wd = None
+        if self.watchdog_timeout > 0:
+            # the serve.accept / serve.worker channels beat from the
+            # frontend's threads (paused across idle periods)
+            wd = health.Watchdog(self.watchdog_timeout,
+                                 action=self.watchdog_action).start()
+        old_hup = None
+        try:
+            # SIGHUP = hot reload; the handler only sets a flag
+            # (async-signal safety, like PreemptionGuard)
+            old_hup = signal.signal(
+                signal.SIGHUP, lambda s, f: fe.request_reload())
+        except (AttributeError, ValueError, OSError):
+            pass                 # no SIGHUP (platform) / not main thread
+        stdin_done = threading.Event()
+
+        def pump():
+            reply = lambda text: print(text, flush=True)  # noqa: E731
+            for line in sys.stdin:
+                # wait=True keeps responses in request order — the stdin
+                # contract — while still running the full engine path
+                fe.submit(line.rstrip("\n"), reply, wait=True)
+            stdin_done.set()
+
+        threading.Thread(target=pump, name="cxn-serve-stdin",
+                         daemon=True).start()
+        try:
+            with ckpt.PreemptionGuard() as guard:
+                # serve until drain is requested; a stdin EOF ends a
+                # pipe-driven run unless TCP clients are being served
+                # (then only the signal does — sleep, don't spin)
+                while not guard.requested:
+                    if stdin_done.is_set() and not fe.listening:
+                        break
+                    time.sleep(0.1)
+                if guard.requested:
+                    telemetry.event({"ev": "preempt_signal",
+                                     "signum": guard.signum})
+                    if not self.silent:
+                        print("serve: drain requested (signal %s); "
+                              "finishing accepted requests"
+                              % guard.signum, file=sys.stderr, flush=True)
+        finally:
+            stats = fe.drain()
+            if wd is not None:
+                wd.stop()
+            if old_hup is not None:
+                try:
+                    signal.signal(signal.SIGHUP, old_hup)
+                except (ValueError, OSError):
+                    pass
+        telemetry.event(dict({"ev": "serve_done"}, **stats))
+        print("served %d prompts (%d request errors)"
+              % (stats["served"], stats["errors"]),
               file=sys.stderr, flush=True)
+        if stats["shed"] or stats["deadline"]:
+            print("  shed %d, deadline-expired %d (of %d accepted)"
+                  % (stats["shed"], stats["deadline"], stats["accepted"]),
+                  file=sys.stderr, flush=True)
 
     def task_export(self) -> None:
         """task = export: AOT-compile the inference forward (params baked

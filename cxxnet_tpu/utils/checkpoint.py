@@ -158,13 +158,21 @@ _NON_TRANSIENT_ERRNO = frozenset(
     e for e in (errno.ENOENT, errno.EISDIR, errno.ENOTDIR) if e is not None)
 
 
+def backoff_delay(attempt: int, base_delay: float = 0.05,
+                  cap: float = 30.0) -> float:
+    """The shared exponential-backoff schedule: ``base_delay`` doubled
+    per attempt, capped. Used by ``retry_io`` below and by the serving
+    frontend's circuit-breaker cooldown (utils/servd.py) — one curve for
+    every "try again later" in the stack."""
+    return min(cap, base_delay * (2.0 ** max(0, int(attempt))))
+
+
 def retry_io(fn, retries: int = 2, base_delay: float = 0.05,
              retriable=(OSError,)):
     """Run ``fn`` with exponential-backoff retries on transient IO errors
     (flaky NFS / GCS-fuse mounts). ``retries`` is the number of RE-tries;
     the last failure re-raises; permanent errors (missing path, not a
     file) are never retried."""
-    delay = base_delay
     for attempt in range(retries + 1):
         try:
             return fn()
@@ -173,8 +181,7 @@ def retry_io(fn, retries: int = 2, base_delay: float = 0.05,
                     or attempt >= retries:
                 raise
             telemetry.count("ckpt.io_retry")
-            time.sleep(delay)
-            delay *= 2
+            time.sleep(backoff_delay(attempt, base_delay))
 
 
 def _fsync_dir(dirname: str) -> None:

@@ -1,0 +1,977 @@
+"""Production serving frontend: admission control, deadlines, breaker, drain.
+
+The only online surface until now was ``task = serve`` — a single-threaded
+stdin loop where an unexpected backend exception killed the process, a slow
+decode stalled every queued client, and SIGTERM dropped in-flight requests
+on the floor. This module is the overload-robustness layer large-scale
+serving systems put in front of the model (the TensorFlow-Serving-era
+playbook, arxiv 1605.08695): a stdlib-only concurrent frontend that wraps
+the cached ``generate``/``predict`` programs behind a TCP line protocol
+and is also the engine behind the stdin ``task = serve`` loop, keeping
+every program on the compiled decode-cache fast path (recompiles are the
+latency cliff — cf. TVM, arxiv 1802.04799).
+
+What a request gets on the way to the backend:
+
+* **admission control** — a bounded queue (``serve_queue``); when it is
+  full the request is fast-rejected ``ERR busy`` from the reader thread
+  (never queued, never stalls the worker) and counted (``serve.shed``).
+  Load past capacity degrades into cheap rejections, not latency collapse.
+* **deadlines** — ``serve_deadline_ms`` default, or a per-request
+  ``DEADLINE <ms>`` prefix; a request whose deadline expired while queued
+  is answered ``ERR deadline`` BEFORE dispatch (the backend never burns
+  decode time on an answer nobody is waiting for) and counted.
+* **backend supervision** — any backend exception is caught, answered
+  ``ERR backend``, counted, and fed to a **circuit breaker**: after
+  ``serve_breaker_fails`` consecutive failures it opens and requests shed
+  instantly (no queue wait, no backend call); after an exponential-backoff
+  cooldown (the shared ``checkpoint.backoff_delay`` schedule) ONE request
+  goes through as a half-open probe — success closes the breaker, failure
+  reopens it with a doubled cooldown.
+* **graceful drain** — ``drain()`` (the driver calls it off the
+  ``PreemptionGuard`` SIGTERM/SIGINT flag) stops accepting, finishes every
+  accepted request within ``serve_drain_ms``, answers whatever is left
+  ``ERR draining``, flushes telemetry, and returns the final stats —
+  exactly one response line per accepted request, always.
+* **hot reload** — ``ADMIN reload`` (or SIGHUP in the driver) sets a flag
+  the worker honors BETWEEN requests: the reload callback swaps in the
+  newest valid checkpoint without dropping the queue.
+
+Wire protocol (one line per request, one line per response, utf-8):
+
+    <tok> <tok> ...                 -> <id> <id> ...        (continuation)
+    DEADLINE <ms> <tok> ...         -> same, with a per-request deadline
+    ADMIN reload                    -> OK reload scheduled
+    ADMIN stats                     -> OK accepted=.. served=.. ...
+    (anything else)                 -> ERR <class> <detail>
+
+Error classes: ``empty`` (blank request — visible instead of a silently
+missing response), ``parse`` (non-integer token, token outside vocab, bad
+DEADLINE), ``busy`` (queue full or breaker open: shed), ``deadline``,
+``backend``, ``draining``. Counters reconcile:
+``accepted == served + errors + shed + deadline``. A request arriving
+AFTER drain began is refused (``ERR draining``) without entering the
+accounting — it was never accepted, so drain's final stats stay final.
+Responses leave each connection in request order (the protocol pairs
+them positionally), even when a rejection is produced instantly while
+earlier requests are still queued.
+
+Observability: counters ``serve.accepted/requests/errors/shed/deadline/
+empty/client_gone/backend_errors/breaker_*/reloads``, gauges
+``serve.queue_depth``/``serve.in_flight``, the ``serve.request`` latency
+span/histogram and a ``serve.queue_wait`` histogram — all scrapable live
+via statusd ``/metrics``. ``health_probe`` (readiness: 503 while draining
+or breaker-open) and ``liveness_probe`` (worker thread death) plug into
+statusd ``/healthz`` / ``/livez``; the accept and worker threads beat the
+``serve.accept`` / ``serve.worker`` watchdog channels (paused across idle
+periods so an empty queue is not a hang).
+
+Deliberately jax-free (like health.py and statusd.py): the backend is an
+injected callable, so ``python -m cxxnet_tpu.utils.servd --selftest``
+proves the whole admission/deadline/breaker/drain machinery over a real
+socket on a box with no accelerator stack (``make check`` gates on it),
+and ``--stub`` runs a standalone echo server the chaos tests drive as a
+subprocess (SIGTERM drain, floods, exploding backends).
+"""
+
+from __future__ import annotations
+
+import socket
+import sys
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional, Tuple
+
+from . import checkpoint as ckpt
+from . import health
+from . import statusd
+from . import telemetry
+
+__all__ = ["CircuitBreaker", "ServeFrontend", "embed_vocab", "selftest"]
+
+
+def embed_vocab(net) -> int:
+    """The vocab bound for parse-time token validation: the largest
+    embed layer's vocab_size in a built net (0 = no embed layer, no
+    bound). Shared by the learn-task and api serving surfaces so the
+    check cannot drift between them. Pure attribute access — jax-free."""
+    return max((lay.vocab_size for lay in net.layers
+                if getattr(lay, "type_name", "") == "embed"), default=0)
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker with half-open probes.
+
+    States: ``closed`` (healthy) → ``open`` after ``fails`` consecutive
+    backend failures (every dispatch shed instantly) → ``half_open`` once
+    the cooldown elapses (exactly ONE request goes through as a probe) →
+    ``closed`` on probe success, or back to ``open`` with a doubled
+    cooldown on probe failure. The cooldown follows the shared
+    ``checkpoint.backoff_delay`` exponential schedule, so a backend that
+    stays broken is probed ever more rarely instead of hammered.
+
+    Thread-safe; every transition emits a ``serve_breaker`` telemetry
+    event and a ``serve.breaker_<state>`` counter (what
+    tools/telemetry_report.py's serving section and its unresolved-open
+    exit-2 gate read).
+    """
+
+    def __init__(self, fails: int = 5, cooldown: float = 1.0,
+                 max_cooldown: float = 30.0, clock=time.monotonic):
+        self.fails = max(1, int(fails))
+        self.cooldown = float(cooldown)
+        self.max_cooldown = float(max_cooldown)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.state = "closed"
+        self.consecutive = 0      # consecutive backend failures
+        self.opens = 0            # open transitions since last close
+        #                           (the backoff exponent)
+        self.transitions = 0
+        self.reopen_at = 0.0
+
+    def _transition(self, state: str, delay: Optional[float] = None):
+        # lock held by the caller
+        self.state = state
+        self.transitions += 1
+        telemetry.count("serve.breaker_%s" % state)
+        ev = {"ev": "serve_breaker", "state": state,
+              "consecutive_fails": self.consecutive}
+        if delay is not None:
+            ev["retry_in_s"] = round(delay, 3)
+        telemetry.event(ev)
+
+    def blocked(self) -> bool:
+        """Admission-time fast check: True while open and still cooling —
+        the caller sheds instantly without queueing."""
+        with self._lock:
+            return self.state == "open" and self._clock() < self.reopen_at
+
+    def allow(self) -> bool:
+        """Dispatch-time gate: True to call the backend. While open, the
+        first call after the cooldown becomes the half-open probe; until
+        that probe resolves every other dispatch is refused."""
+        with self._lock:
+            if self.state == "closed":
+                return True
+            if self.state == "open" and self._clock() >= self.reopen_at:
+                self._transition("half_open")
+                return True
+            return False
+
+    def success(self) -> None:
+        with self._lock:
+            self.consecutive = 0
+            if self.state != "closed":
+                self.opens = 0
+                self._transition("closed")
+
+    def failure(self) -> None:
+        with self._lock:
+            self.consecutive += 1
+            if self.state == "half_open" or (
+                    self.state == "closed"
+                    and self.consecutive >= self.fails):
+                delay = ckpt.backoff_delay(self.opens,
+                                           base_delay=self.cooldown,
+                                           cap=self.max_cooldown)
+                self.opens += 1
+                self.reopen_at = self._clock() + delay
+                self._transition("open", delay=delay)
+
+    def describe(self) -> str:
+        return ("%s (%d consecutive failures)"
+                % (self.state, self.consecutive))
+
+
+class _ConnState:
+    """Per-connection response state: slot-ordered reply buffer + the
+    count of filled-but-untransmitted responses (what drain waits on)."""
+
+    __slots__ = ("cond", "slots", "dead", "eof", "unsent")
+
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.slots: deque = deque()    # [text or None] per submitted line
+        self.dead = False              # send failed: connection torn down
+        self.eof = False               # reader saw client EOF
+        self.unsent = 0                # filled slots not yet transmitted
+
+
+class _Request:
+    __slots__ = ("toks", "deadline", "t_arrival", "reply", "done", "seq",
+                 "_alock", "answered")
+
+    def __init__(self, toks: List[int], deadline: Optional[float], reply):
+        self.toks = toks
+        self.t_arrival = time.monotonic()
+        # deadline arrives relative (seconds); stored absolute monotonic
+        self.deadline = None if deadline is None \
+            else self.t_arrival + deadline
+        self.reply = reply
+        self.done = threading.Event()
+        self.seq = -1
+        # exactly-once answer guard: drain can give up on a request
+        # whose backend wedged past the budget while the worker might
+        # still answer it later — only the first answer goes out
+        self._alock = threading.Lock()
+        self.answered = False
+
+
+# stat key -> telemetry counter (serve.requests keeps PR 4's name for the
+# successfully-served count so existing dashboards/reports keep working)
+_COUNTERS = {
+    "accepted": "serve.accepted",
+    "served": "serve.requests",
+    "errors": "serve.errors",
+    "shed": "serve.shed",
+    "deadline": "serve.deadline",
+    "empty": "serve.empty",
+    "admin": "serve.admin",
+    "reloads": "serve.reloads",
+    "client_gone": "serve.client_gone",
+}
+# the stats mirrored into statusd's progress gauges per bump
+_PROGRESS_KEYS = ("served", "errors", "shed", "deadline")
+
+
+class ServeFrontend:
+    """The concurrent serving frontend around one backend callable.
+
+    ``backend(toks, seq) -> sequence of ints`` runs on the single worker
+    thread (batch-1 decode is serial on the accelerator by design — the
+    latency-bound case; concurrency buys admission, shedding, and drain,
+    not parallel decode). ``seq`` is the dispatch ordinal (the driver
+    folds it into the sampling seed so streams differ per request).
+
+    ``reload_fn() -> bool`` (optional) is called between requests when a
+    reload was requested; returning False (or raising) keeps the current
+    model. ``vocab > 0`` rejects out-of-range token ids at parse time.
+
+    Lifecycle: ``start()`` (worker thread) → optional ``listen(port)``
+    (TCP accept thread) → ``submit()`` per request line (the connection
+    readers and the driver's stdin pump both land here) → ``drain()``.
+    """
+
+    def __init__(self, backend: Callable, queue_size: int = 64,
+                 deadline_ms: float = 0.0, drain_ms: float = 5000.0,
+                 breaker_fails: int = 5, breaker_cooldown_ms: float = 1000.0,
+                 breaker_max_cooldown_ms: float = 30000.0, vocab: int = 0,
+                 reload_fn: Optional[Callable] = None,
+                 client_timeout: float = 10.0,
+                 stall_after_s: float = 120.0):
+        self.backend = backend
+        self.queue_size = max(1, int(queue_size))
+        self.deadline_ms = float(deadline_ms)
+        self.drain_ms = float(drain_ms)
+        self.vocab = int(vocab)
+        self.reload_fn = reload_fn
+        self.client_timeout = float(client_timeout)
+        # a backend that BLOCKS (no exception) is invisible to the
+        # breaker and to deadlines (the single worker never dispatches
+        # again), and the worker heartbeat is deliberately paused across
+        # backend calls (compiles). This wall-clock bound on the current
+        # dispatch is the wedge detector: readiness fails past it,
+        # liveness past twice it. Size it above the worst legitimate
+        # call INCLUDING a first compile; 0 disables.
+        self.stall_after_s = float(stall_after_s)
+        self.breaker = CircuitBreaker(breaker_fails,
+                                      cooldown=breaker_cooldown_ms / 1e3,
+                                      max_cooldown=breaker_max_cooldown_ms
+                                      / 1e3)
+        self._q: deque = deque()
+        self._cond = threading.Condition()
+        self._slock = threading.Lock()
+        self._stats = {k: 0 for k in _COUNTERS}
+        self._draining = False
+        self._stop = False
+        self._reload_flag = False    # plain bool: settable from a signal
+        #                              handler without taking any lock
+        self._inflight = 0
+        self._inflight_req: Optional[_Request] = None
+        self._inflight_since: Optional[float] = None
+        self._seq = 0
+        self._worker_thread: Optional[threading.Thread] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._sock: Optional[socket.socket] = None
+        self.port: Optional[int] = None
+        # live per-connection writer states (_ConnState): drain waits for
+        # their queued responses to reach the kernel before returning —
+        # the writer threads are daemons, and a response still buffered
+        # at interpreter exit would be a silently dropped answer
+        self._conn_lock = threading.Lock()
+        self._conns: set = set()
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> "ServeFrontend":
+        telemetry.gauge("serve.queue_depth", 0)
+        telemetry.gauge("serve.in_flight", 0)
+        self._worker_thread = threading.Thread(
+            target=self._worker_run, name="cxn-servd-worker", daemon=True)
+        self._worker_thread.start()
+        return self
+
+    def listen(self, port: int = 0, host: str = "") -> int:
+        """Bind the TCP listener (port 0 = ephemeral; loopback unless
+        ``host`` widens it — the protocol is unauthenticated) and start
+        the accept thread. Returns the bound port."""
+        self._sock = socket.create_server((host or "127.0.0.1", int(port)))
+        self._sock.settimeout(0.25)
+        self.port = self._sock.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_run, name="cxn-servd-accept", daemon=True)
+        self._accept_thread.start()
+        telemetry.event({"ev": "serve_listen", "port": self.port})
+        return self.port
+
+    @property
+    def listening(self) -> bool:
+        return self._sock is not None
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def stats(self) -> dict:
+        with self._slock:
+            return dict(self._stats)
+
+    # -- health (statusd probes) ---------------------------------------
+    def _stalled_for(self) -> float:
+        """Seconds the CURRENT dispatch has been inside the backend
+        (0.0 when idle) — benign unlocked reads of GIL-atomic stores."""
+        t0 = self._inflight_since
+        if not self._inflight or t0 is None:
+            return 0.0
+        return time.monotonic() - t0
+
+    def health_probe(self) -> Tuple[bool, str]:
+        """Readiness: NOT ready while draining, while the circuit
+        breaker is anything but closed (open, or a half-open probe still
+        unresolved), or while the current dispatch has been stuck inside
+        the backend past ``stall_after_s`` — the "don't route traffic
+        here" signal."""
+        if self._draining:
+            return False, "draining: not accepting new requests"
+        st = self.breaker.state
+        if st != "closed":
+            return False, "circuit breaker %s" % self.breaker.describe()
+        stalled = self._stalled_for()
+        if self.stall_after_s > 0 and stalled > self.stall_after_s:
+            return False, ("backend stalled: request in flight for "
+                           "%.0fs (bound %.0fs)"
+                           % (stalled, self.stall_after_s))
+        return True, "serving (breaker closed)"
+
+    def liveness_probe(self) -> Tuple[bool, str]:
+        """Liveness: the process is still functional — a dead worker
+        thread (not a drained one), or a backend wedged past TWICE the
+        stall bound (first stop routing, then restart), means restart,
+        not just unroutable."""
+        t = self._worker_thread
+        if t is not None and not t.is_alive() and not self._stop:
+            return False, "serve worker thread died"
+        stalled = self._stalled_for()
+        if self.stall_after_s > 0 and stalled > 2 * self.stall_after_s:
+            return False, ("backend wedged: request in flight for %.0fs "
+                           "(2x the %.0fs stall bound)"
+                           % (stalled, self.stall_after_s))
+        return True, "alive"
+
+    # -- accounting ----------------------------------------------------
+    def _bump(self, *names: str) -> None:
+        """Bump one or more stat counters ATOMICALLY: a synchronously
+        rejected request's ``accepted`` and its outcome (errors/shed/
+        deadline) land in one critical section, so a concurrent
+        ``stats()`` snapshot — drain's final reconciliation — can never
+        observe a torn ``accepted > served+errors+shed+deadline``."""
+        with self._slock:
+            for name in names:
+                self._stats[name] += 1
+            if any(name in _PROGRESS_KEYS for name in names):
+                # applied under the lock: two racing bumps must publish
+                # their snapshots in order, or a stale one could make
+                # the progress gauges transiently regress
+                statusd.update_progress(
+                    **{k: self._stats[k] for k in _PROGRESS_KEYS})
+        for name in names:
+            telemetry.count(_COUNTERS[name])
+
+    def _send(self, reply, text: str) -> bool:
+        """Deliver one response line; a reply that raises (client hung up
+        mid-request) is counted, never propagated — the server outlives
+        every client."""
+        try:
+            reply(text)
+            return True
+        except Exception:
+            self._bump("client_gone")
+            return False
+
+    def _finish(self, req: _Request, text: str, *outcome: str) -> None:
+        """Answer a queued request EXACTLY ONCE, bumping its outcome
+        counters only on the winning side — drain can give up on a
+        request whose backend wedged past the budget while the worker
+        might still complete it later; whoever claims first accounts
+        and replies, the loser is a no-op."""
+        with req._alock:
+            if req.answered:
+                return
+            req.answered = True
+        if outcome:
+            self._bump(*outcome)
+        self._send(req.reply, text)
+        req.done.set()
+
+    # -- request intake ------------------------------------------------
+    def _parse(self, line: str):
+        """One request line -> ("req", toks, rel_deadline_s) |
+        ("admin", args) | ("err", cls, msg)."""
+        parts = line.split()
+        if not parts:
+            return ("err", "empty", "request line has no tokens")
+        if parts[0] == "ADMIN":
+            return ("admin", parts[1:])
+        deadline = (self.deadline_ms / 1e3) if self.deadline_ms > 0 \
+            else None
+        if parts[0] == "DEADLINE":
+            if len(parts) < 2:
+                return ("err", "parse", "DEADLINE needs a millisecond "
+                        "bound")
+            try:
+                deadline = float(parts[1]) / 1e3
+            except ValueError:
+                return ("err", "parse", "DEADLINE bound %r is not a "
+                        "number" % parts[1])
+            if not (0 <= deadline < float("inf")):
+                # float() accepts 'nan'/'inf'/negatives; a NaN deadline
+                # compares False everywhere and silently DISABLES the
+                # deadline — a client framing bug must get ERR parse,
+                # not an unbounded request (NaN fails both comparisons)
+                return ("err", "parse", "DEADLINE bound %r is not a "
+                        "finite non-negative number" % parts[1])
+            parts = parts[2:]
+            if not parts:
+                return ("err", "empty", "DEADLINE with no request tokens")
+        try:
+            toks = [int(t) for t in parts]
+        except ValueError:
+            return ("err", "parse", "non-integer token in request")
+        if self.vocab and not all(0 <= t < self.vocab for t in toks):
+            return ("err", "parse",
+                    "token id outside vocab_size %d" % self.vocab)
+        return ("req", toks, deadline)
+
+    def submit(self, line: str, reply, wait: bool = False):
+        """Admit one request line. ``reply`` is called EXACTLY ONCE with
+        the single response line — synchronously for rejections (shed /
+        parse / draining: the fast path that never touches the worker),
+        from the worker thread otherwise. ``wait=True`` blocks until the
+        request is answered (the stdin pump: serial by construction, so
+        responses stay in request order). Returns the request's done
+        Event, or None when the line was answered synchronously."""
+        parsed = self._parse(line)
+        if parsed[0] == "admin":
+            # the drain check and the scheduling are one critical
+            # section with drain()'s flag flip (like the request path):
+            # a drained frontend must not promise "OK reload scheduled"
+            # for a reload no worker will ever run
+            with self._cond:
+                if self._draining or self._stop:
+                    text = "ERR draining server is shutting down"
+                else:
+                    self._bump("admin")
+                    args = parsed[1]
+                    if args and args[0] == "reload":
+                        self.request_reload()
+                        text = "OK reload scheduled"
+                    elif args and args[0] == "stats":
+                        text = "OK " + " ".join(
+                            "%s=%d" % kv
+                            for kv in sorted(self.stats().items()))
+                    else:
+                        text = ("ERR parse unknown ADMIN command %r"
+                                % " ".join(args))
+            self._send(reply, text)
+            return None
+        req = None
+        # admission decision + accounting in ONE critical section with
+        # the drain flag: after drain() flips _draining (under this
+        # lock) no request can slip an accepted count past its final
+        # stats snapshot — a late arrival is refused WITHOUT entering
+        # the accounting (it was never accepted; it still gets its one
+        # response line). The socket write happens after release.
+        with self._cond:
+            if self._draining or self._stop:
+                text = "ERR draining server is shutting down"
+            elif parsed[0] == "err":
+                _, cls, msg = parsed
+                self._bump(*(("accepted", "empty", "errors")
+                             if cls == "empty"
+                             else ("accepted", "errors")))
+                text = "ERR %s %s" % (cls, msg)
+            elif self.breaker.blocked():
+                # breaker open: shed instantly — no queue, no backend
+                self._bump("accepted", "shed")
+                text = "ERR busy circuit breaker open"
+            elif len(self._q) >= self.queue_size:
+                self._bump("accepted", "shed")
+                text = "ERR busy admission queue full (%d)" \
+                    % self.queue_size
+            else:
+                _, toks, deadline = parsed
+                req = _Request(toks, deadline, reply)
+                self._bump("accepted")
+                self._q.append(req)
+                telemetry.gauge("serve.queue_depth", len(self._q))
+                self._cond.notify()
+                text = None
+        if req is None:
+            self._send(reply, text)
+            return None
+        if wait:
+            req.done.wait()
+            return None
+        return req.done
+
+    # -- hot reload ----------------------------------------------------
+    def request_reload(self) -> None:
+        """Schedule a model reload between requests. Only a plain
+        attribute store — safe to call from a SIGHUP handler (taking a
+        lock there could deadlock against the interrupted thread); the
+        worker notices within its 0.25s idle poll."""
+        self._reload_flag = True
+
+    def _do_reload(self) -> None:
+        self._reload_flag = False
+        if self.reload_fn is None:
+            return
+        try:
+            ok = self.reload_fn()
+        except Exception as e:
+            telemetry.count("serve.reload_errors")
+            telemetry.event({"ev": "serve_reload", "ok": False,
+                            "error": repr(e)[:200]})
+            sys.stderr.write("WARNING: servd: model reload failed (%s); "
+                             "keeping the current model\n" % (e,))
+            return
+        if ok is not False:
+            self._bump("reloads")
+            telemetry.event({"ev": "serve_reload", "ok": True})
+
+    # -- worker --------------------------------------------------------
+    def _worker_run(self) -> None:
+        while True:
+            req = None
+            with self._cond:
+                while not self._q and not self._stop \
+                        and not self._reload_flag:
+                    # idle is legitimate silence: disarm the watchdog
+                    # channel so an empty queue is not a hang
+                    health.pause("serve.worker")
+                    self._cond.wait(0.25)
+                if self._q:
+                    req = self._q.popleft()
+                    telemetry.gauge("serve.queue_depth", len(self._q))
+                    self._inflight = 1
+                    self._inflight_req = req
+                    self._inflight_since = time.monotonic()
+                elif self._stop:
+                    break
+            health.beat("serve.worker")
+            if self._reload_flag:
+                # a checkpoint reload is legitimately silent time, like
+                # a backend call: disarm the channel so a large-model
+                # reload can't false-alarm (or abort) the watchdog
+                health.pause("serve.worker")
+                self._do_reload()
+                health.beat("serve.worker")
+                if req is not None:
+                    with self._cond:
+                        # reload time is not backend time: restart the
+                        # stall clock for the dispatch that follows
+                        self._inflight_since = time.monotonic()
+            if req is None:
+                continue
+            try:
+                self._dispatch(req)
+            finally:
+                with self._cond:
+                    self._inflight = 0
+                    self._inflight_req = None
+                    self._inflight_since = None
+                    self._cond.notify_all()
+
+    def _dispatch(self, req: _Request) -> None:
+        now = time.monotonic()
+        telemetry.hist("serve.queue_wait", now - req.t_arrival)
+        if req.deadline is not None and now > req.deadline:
+            # expired while queued: answered BEFORE dispatch — the
+            # backend never decodes an answer nobody is waiting for
+            self._finish(req, "ERR deadline expired %.0fms ago"
+                         % (1e3 * (now - req.deadline)), "deadline")
+            return
+        if not self.breaker.allow():
+            self._finish(req, "ERR busy circuit breaker open", "shed")
+            return
+        req.seq, self._seq = self._seq, self._seq + 1
+        telemetry.gauge("serve.in_flight", 1)
+        # the backend call is legitimately silent time on the worker
+        # channel — a first-request decode-cache compile (or the
+        # recompile after a hot reload) can far outlast any sane
+        # watchdog_timeout, and PR 3's rule is that compiles never arm
+        # heartbeat channels. Slow backends are watched by deadlines and
+        # the breaker; a silently WEDGED one by the stall_after_s bound
+        # on this dispatch (health/liveness probes above); the heartbeat
+        # watches the worker loop itself.
+        health.pause("serve.worker")
+        try:
+            with telemetry.span("serve.request", tokens=len(req.toks)):
+                out = self.backend(req.toks, req.seq)
+            # the conversion is supervised too: a backend returning a
+            # non-iterable-of-ints is a backend failure, not a worker
+            # death sentence
+            text = " ".join(str(int(t)) for t in out)
+        except Exception as e:
+            health.beat("serve.worker")
+            telemetry.gauge("serve.in_flight", 0)
+            self.breaker.failure()
+            telemetry.count("serve.backend_errors")
+            telemetry.event({"ev": "serve_backend_error",
+                             "error": repr(e)[:200]})
+            # one line, whatever the exception said
+            self._finish(req, "ERR backend "
+                         + " ".join(repr(e).split())[:200], "errors")
+            return
+        health.beat("serve.worker")
+        telemetry.gauge("serve.in_flight", 0)
+        self.breaker.success()
+        self._finish(req, text, "served")
+
+    # -- TCP listener --------------------------------------------------
+    def _accept_run(self) -> None:
+        sock = self._sock       # local ref: drain() nulls the attribute
+        while True:
+            with self._cond:
+                if self._draining or self._stop:
+                    break
+            health.beat("serve.accept")
+            try:
+                conn, _addr = sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break               # listener closed (drain)
+            # sends run on the connection's own writer thread, so a
+            # stalled reader only wedges itself — and only for this
+            # long, then its connection is torn down and counted gone
+            conn.settimeout(self.client_timeout)
+            threading.Thread(target=self._client_run, args=(conn,),
+                             name="cxn-servd-client", daemon=True).start()
+        health.pause("serve.accept")
+
+    def _conn_writer(self, conn: socket.socket, st: _ConnState) -> None:
+        """Per-connection writer: transmits filled reply slots strictly
+        head-first. Sends happen HERE, never on the worker thread — a
+        client that stops reading (full TCP window) stalls only its own
+        connection for up to ``client_timeout``, not every client's
+        dispatch. Exits once the reader saw EOF and every slot is out."""
+        while True:
+            with st.cond:
+                while not ((st.slots and st.slots[0][0] is not None)
+                           or (st.eof and not st.slots)):
+                    st.cond.wait(0.5)
+                if st.eof and not st.slots:
+                    return
+                s = st.slots.popleft()
+            if st.dead:
+                # connection torn down: discard quietly, but keep
+                # draining slots so the reader's join terminates
+                with st.cond:
+                    st.unsent -= 1
+                    st.cond.notify_all()
+                continue
+            try:
+                conn.sendall((s[0] + "\n").encode("utf-8", "replace"))
+            except OSError:
+                # a failed/timed-out send may have written PART of a
+                # response: the positional stream is unrecoverable —
+                # tear the connection down rather than feed a resumed
+                # client desynced bytes (socket.timeout is an OSError)
+                st.dead = True
+                self._bump("client_gone")
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            finally:
+                with st.cond:
+                    st.unsent -= 1
+                    st.cond.notify_all()
+
+    def _client_run(self, conn: socket.socket) -> None:
+        # responses must leave the socket in REQUEST order — the line
+        # protocol pairs them positionally. A synchronous rejection
+        # (parse error, shed) is produced by this reader thread while
+        # earlier requests may still sit in the queue, so replies are
+        # buffered in per-line slots and transmitted strictly head-first
+        # by the connection's writer thread: shedding stays instant for
+        # the SERVER (no queue entry, no backend), the rejected client
+        # just reads its answer in order.
+        st = _ConnState()
+        with self._conn_lock:
+            self._conns.add(st)
+
+        def make_reply(slot):
+            def reply(text: str) -> None:
+                with st.cond:
+                    slot[0] = text
+                    st.unsent += 1
+                    st.cond.notify_all()
+            return reply
+
+        writer = threading.Thread(target=self._conn_writer,
+                                  args=(conn, st),
+                                  name="cxn-servd-send", daemon=True)
+        writer.start()
+        try:
+            buf = b""
+            while True:
+                # explicit recv loop (not makefile): a timeout here is
+                # an IDLE client — e.g. one waiting out a long queued
+                # decode — and must keep the connection, with no
+                # partial-line loss
+                try:
+                    chunk = conn.recv(65536)
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                eof = not chunk
+                if eof and buf:
+                    # client EOF with an unterminated final line: still
+                    # a request (stdin's `for line in sys.stdin` yields
+                    # such a line too — the two surfaces must agree, and
+                    # silence is exactly the framing-bug failure ERR
+                    # empty exists to prevent)
+                    buf += b"\n"
+                buf += chunk
+                while b"\n" in buf:
+                    raw, buf = buf.split(b"\n", 1)
+                    line = raw.decode("utf-8", "replace").rstrip("\r")
+                    slot = [None]
+                    with st.cond:
+                        st.slots.append(slot)
+                    self.submit(line, make_reply(slot))
+                if eof:
+                    break
+            # client EOF: the writer finishes delivering every answer,
+            # however long the requests take — each submitted line gets
+            # EXACTLY one reply (the worker's, or drain's ERR), so this
+            # join terminates; no budget that could drop a slow answer
+            with st.cond:
+                st.eof = True
+                st.cond.notify_all()
+            writer.join()
+        finally:
+            with self._conn_lock:
+                self._conns.discard(st)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- graceful drain ------------------------------------------------
+    def drain(self, timeout_ms: Optional[float] = None) -> dict:
+        """Stop accepting, finish every accepted request within the
+        budget (``drain_ms`` default), answer any leftovers ``ERR
+        draining``, flush telemetry, and return the final stats. Exactly
+        one response line per accepted request — a drained shutdown
+        loses zero accepted requests. Idempotent."""
+        budget = (self.drain_ms if timeout_ms is None
+                  else float(timeout_ms)) / 1e3
+        t0 = time.monotonic()
+        with self._cond:
+            self._draining = True
+            queued = len(self._q)
+            self._cond.notify_all()
+        telemetry.event({"ev": "serve_drain", "phase": "begin",
+                         "queued": queued})
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=2.0)
+            self._accept_thread = None
+        deadline = t0 + budget
+        with self._cond:
+            while (self._q or self._inflight) \
+                    and time.monotonic() < deadline:
+                self._cond.wait(0.05)
+            leftovers = list(self._q)
+            self._q.clear()
+            telemetry.gauge("serve.queue_depth", 0)
+            self._stop = True
+            self._cond.notify_all()
+        for req in leftovers:
+            # budget exhausted: still exactly one response per accepted
+            # request — an explicit ERR beats a silent dropped socket
+            self._finish(req, "ERR draining shutdown budget exhausted",
+                         "errors")
+        if self._worker_thread is not None:
+            self._worker_thread.join(
+                timeout=max(0.5, deadline - time.monotonic() + 1.0))
+            if self._worker_thread.is_alive():
+                # the backend outlived even the post-budget grace: the
+                # in-flight request is answered HERE, once — if the
+                # wedged backend ever returns, the worker's _finish
+                # loses the claim and is a no-op
+                with self._cond:
+                    req = self._inflight_req
+                if req is not None:
+                    self._finish(req, "ERR draining backend exceeded "
+                                 "the drain budget", "errors")
+        # every accepted request is answered by now, but TCP answers are
+        # transmitted by per-connection writer threads (daemons): wait
+        # for the buffered ones to reach the kernel, or a response could
+        # die with the interpreter — a silently dropped answer, exactly
+        # what drain exists to prevent. Bounded: a stalled reader's send
+        # times out at client_timeout and counts the client gone.
+        flush_by = time.monotonic() + self.client_timeout + 1.0
+        while time.monotonic() < flush_by:
+            with self._conn_lock:
+                conns = list(self._conns)
+            if all(c.unsent == 0 for c in conns):
+                break
+            time.sleep(0.02)
+        health.pause("serve.worker")
+        health.pause("serve.accept")
+        stats = self.stats()
+        telemetry.event(dict({"ev": "serve_drain", "phase": "end",
+                              "seconds": round(time.monotonic() - t0, 3)},
+                             **stats))
+        telemetry.flush()
+        return stats
+
+
+# ----------------------------------------------------------------------
+def _ask(port: int, line: str, timeout: float = 5.0) -> str:
+    """One-shot client (selftest + stub tooling): one request, one
+    response line."""
+    with socket.create_connection(("127.0.0.1", port),
+                                  timeout=timeout) as c:
+        c.sendall((line + "\n").encode("utf-8"))
+        resp = c.makefile("r", encoding="utf-8").readline()
+    return resp.rstrip("\n")
+
+
+def selftest(verbose: bool = False) -> int:
+    """Drive the full admission/deadline/breaker/reload/drain machinery
+    over a real loopback socket with an injected backend — jax-free;
+    ``make check`` gates on it."""
+    boom = {"on": False}
+    reloads = []
+
+    def backend(toks, seq):
+        if boom["on"]:
+            raise RuntimeError("injected backend failure")
+        return [t + 1 for t in toks]
+
+    fe = ServeFrontend(backend, queue_size=4, breaker_fails=2,
+                       breaker_cooldown_ms=300.0, drain_ms=2000.0,
+                       vocab=100,
+                       reload_fn=lambda: reloads.append(1) or True)
+    fe.start()
+    port = fe.listen(0)
+    try:
+        # happy path + parse/empty/vocab rejection
+        assert _ask(port, "1 2 3") == "2 3 4"
+        assert _ask(port, "").startswith("ERR empty")
+        assert _ask(port, "1 x 2").startswith("ERR parse")
+        assert _ask(port, "1 999").startswith("ERR parse")
+        assert _ask(port, "DEADLINE nope 1").startswith("ERR parse")
+        # a 0ms deadline has always expired by dispatch time
+        assert _ask(port, "DEADLINE 0 1 2").startswith("ERR deadline")
+        assert _ask(port, "DEADLINE 5000 7") == "8"
+        # backend supervision: failures answered, loop survives
+        boom["on"] = True
+        assert _ask(port, "5").startswith("ERR backend")
+        assert _ask(port, "5").startswith("ERR backend")
+        # 2 consecutive failures: breaker open, sheds instantly
+        assert fe.breaker.state == "open"
+        assert _ask(port, "5").startswith("ERR busy")
+        assert fe.health_probe()[0] is False
+        # cooldown elapses, backend healed: half-open probe closes it
+        boom["on"] = False
+        time.sleep(0.35)
+        assert _ask(port, "5") == "6"
+        assert fe.breaker.state == "closed" and fe.health_probe()[0]
+        # hot reload between requests
+        assert _ask(port, "ADMIN reload").startswith("OK")
+        assert _ask(port, "9") == "10"
+        assert reloads, "reload_fn never ran"
+        assert _ask(port, "ADMIN stats").startswith("OK accepted=")
+        assert _ask(port, "ADMIN bogus").startswith("ERR parse")
+    finally:
+        stats = fe.drain()
+    assert stats["accepted"] == (stats["served"] + stats["errors"]
+                                 + stats["shed"] + stats["deadline"]), \
+        "serve counters do not reconcile: %r" % (stats,)
+    assert stats["served"] == 4 and stats["shed"] == 1
+    assert stats["deadline"] == 1 and stats["empty"] == 1
+    assert fe.health_probe() == (False,
+                                 "draining: not accepting new requests")
+    assert fe.liveness_probe()[0]
+    if verbose:
+        print("servd selftest: admission/deadline/breaker/reload/drain ok "
+              "(%r)" % (stats,))
+    return 0
+
+
+def _stub_main(argv: List[str]) -> int:
+    """``--stub``: a standalone jax-free echo server for the chaos
+    harness — prints the bound port, serves until SIGTERM/SIGINT, drains,
+    prints the final stats as JSON, exits 0. Knobs: ``--port N``
+    ``--delay-ms D`` (slow backend) ``--explode-every N`` (every Nth
+    dispatch raises) ``--queue N`` ``--drain-ms D``."""
+    import json
+
+    def flag(name, default, cast=float):
+        if name in argv:
+            return cast(argv[argv.index(name) + 1])
+        return default
+
+    delay = flag("--delay-ms", 0.0) / 1e3
+    explode_every = int(flag("--explode-every", 0))
+
+    def backend(toks, seq):
+        if explode_every and (seq + 1) % explode_every == 0:
+            raise RuntimeError("injected stub explosion")
+        if delay:
+            time.sleep(delay)
+        return [t + 1 for t in toks]
+
+    fe = ServeFrontend(backend, queue_size=int(flag("--queue", 64)),
+                       drain_ms=flag("--drain-ms", 5000.0))
+    fe.start()
+    port = fe.listen(int(flag("--port", 0)))
+    print("servd-stub: listening on port %d" % port, flush=True)
+    with ckpt.PreemptionGuard(enabled=True) as guard:
+        while not guard.requested:
+            time.sleep(0.05)
+    stats = fe.drain()
+    print("servd-stub: drained " + json.dumps(stats), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    if "--selftest" in sys.argv[1:]:
+        sys.exit(selftest(verbose=True))
+    if "--stub" in sys.argv[1:]:
+        sys.exit(_stub_main(sys.argv[1:]))
+    print(__doc__)
+    sys.exit(1)

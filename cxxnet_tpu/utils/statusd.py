@@ -16,11 +16,19 @@ Endpoints:
   histograms (``telemetry.HIST_BUCKETS``) as ``_seconds_bucket{le=...}``
   series — step time, io wait, h2d, per-request serve latency. All series
   carry a ``process`` label so a multihost scrape attributes shards.
-* ``/healthz`` — 200 while healthy, 503 while a heartbeat channel is
-  overdue (``health.channel_status``) or a registered probe fails — the
-  learn task wires the RecoveryPolicy's unresolved-anomaly state here, so
-  a rollback in flight (or an abort) flips the endpoint until recovery
-  completes. The k8s/liveness-probe contract.
+* ``/healthz`` — READINESS: 200 while the process should receive traffic
+  / be trusted, 503 while a heartbeat channel is overdue
+  (``health.channel_status``) or ANY registered probe fails — the learn
+  task wires the RecoveryPolicy's unresolved-anomaly state here (a
+  rollback in flight flips it until recovery completes), and the serving
+  frontend (utils/servd.py) wires its draining / circuit-breaker-open
+  state. The k8s readiness-probe contract.
+* ``/livez`` — LIVENESS: 503 only when the process itself is broken — an
+  overdue heartbeat (hang) or a probe registered with ``liveness=True``
+  (e.g. a dead serve worker thread). A draining or breaker-open server
+  is NOT ready but IS alive: /healthz 503, /livez 200 — so a supervisor
+  stops routing without restarting a process that is shutting down
+  cleanly. The k8s liveness-probe contract.
 * ``/statusz`` — the human page: run config, round/batch progress,
   step-time p50/p90/p99, recompile count and causes, checkpoint age,
   device-memory gauges, counters, health detail.
@@ -94,7 +102,8 @@ def _num(v) -> bool:
 
 def prometheus_metrics(snapshot: dict, progress: Optional[dict] = None,
                        health_failures: Optional[list] = None,
-                       channels: Optional[list] = None) -> str:
+                       channels: Optional[list] = None,
+                       live_failures: Optional[list] = None) -> str:
     """Render a ``telemetry.metrics_snapshot()`` as Prometheus text
     exposition format 0.0.4. Pure function of its inputs — the selftest
     and tests validate its output without a socket. ``channels`` is the
@@ -132,7 +141,10 @@ def prometheus_metrics(snapshot: dict, progress: Optional[dict] = None,
          float(snapshot.get("compile_s", 0.0)))
     if health_failures is not None:
         emit("cxxnet_healthy", "gauge", 0 if health_failures else 1,
-             help_="1 when /healthz returns 200")
+             help_="1 when /healthz (readiness) returns 200")
+    if live_failures is not None:
+        emit("cxxnet_live", "gauge", 0 if live_failures else 1,
+             help_="1 when /livez (liveness) returns 200")
     if channels is None:
         channels = health_mod.channel_status()
     if channels:
@@ -143,7 +155,8 @@ def prometheus_metrics(snapshot: dict, progress: Optional[dict] = None,
             out.append(
                 'cxxnet_heartbeat_age_seconds{process="%s",channel="%s"}'
                 ' %s' % (_lesc(p), _lesc(ch), _fmt(round(age, 3))))
-    for key in ("round", "num_round", "batch", "served", "errors"):
+    for key in ("round", "num_round", "batch", "served", "errors",
+                "shed", "deadline"):
         v = (progress or {}).get(key)
         if _num(v):
             emit("cxxnet_progress_" + key, "gauge", v)
@@ -208,6 +221,16 @@ class _Endpoint(BaseHTTPRequestHandler):
                                 body.encode("utf-8"))
                 else:
                     self._reply(200, "text/plain; charset=utf-8", b"ok\n")
+            elif path == "/livez":
+                fails = srv.health_failures(liveness_only=True)
+                if fails:
+                    body = "dead\n" + "".join(
+                        "%s: %s\n" % (n, d) for n, d in fails)
+                    self._reply(503, "text/plain; charset=utf-8",
+                                body.encode("utf-8"))
+                else:
+                    self._reply(200, "text/plain; charset=utf-8",
+                                b"alive\n")
             elif path in ("/", "/statusz"):
                 self._reply(200, "text/html; charset=utf-8",
                             srv.statusz_html().encode("utf-8"))
@@ -219,7 +242,7 @@ class _Endpoint(BaseHTTPRequestHandler):
             else:
                 self._reply(404, "text/plain; charset=utf-8",
                             b"not found; endpoints: /metrics /healthz "
-                            b"/statusz /trace\n")
+                            b"/livez /statusz /trace\n")
         except Exception as e:    # a broken probe must not kill the server
             try:
                 self._reply(500, "text/plain; charset=utf-8",
@@ -239,7 +262,9 @@ class StatusServer:
         self.registry = registry if registry is not None else telemetry._REG
         self.run_info: Dict[str, object] = {}
         self.progress: Dict[str, object] = {}
-        self.probes: List[Tuple[str, Callable[[], Tuple[bool, str]]]] = []
+        # (name, probe_fn, liveness): see register_probe
+        self.probes: List[Tuple[str, Callable[[], Tuple[bool, str]],
+                                bool]] = []
         # loopback by default: /statusz exposes the full run config (data
         # and model paths included), so wide exposure is OPT-IN —
         # status_host=0.0.0.0 for a cross-host Prometheus scrape
@@ -275,10 +300,14 @@ class StatusServer:
 
     # -- wiring --------------------------------------------------------
     def register_probe(self, name: str,
-                       fn: Callable[[], Tuple[bool, str]]) -> None:
+                       fn: Callable[[], Tuple[bool, str]],
+                       liveness: bool = False) -> None:
         """``fn() -> (ok, detail)``; a False (or raising) probe flips
-        /healthz to 503 with the detail in the body."""
-        self.probes.append((name, fn))
+        /healthz (readiness) to 503 with the detail in the body.
+        ``liveness=True`` probes additionally flip /livez — reserve those
+        for "restart me" conditions (dead thread), not "don't route to
+        me" ones (draining, breaker open, rollback in flight)."""
+        self.probes.append((name, fn, bool(liveness)))
 
     def wire_health(self, recovery=None) -> None:
         """Wire the standard health sources: the watchdog heartbeat
@@ -294,35 +323,58 @@ class StatusServer:
                 return False, "unresolved anomaly: " + a.describe()
             self.register_probe("anomaly", _probe)
 
-    def health_failures(self, channels: Optional[list] = None) \
-            -> List[Tuple[str, str]]:
+    def all_failures(self, channels: Optional[list] = None) \
+            -> Tuple[List[Tuple[str, str]], List[Tuple[str, str]]]:
+        """ONE evaluation of every heartbeat channel and probe ->
+        ``(readiness_failures, liveness_failures)`` — so a scrape that
+        needs both views (the cxxnet_healthy and cxxnet_live gauges)
+        runs each probe once and the two lists can never disagree about
+        a single evaluation. An overdue heartbeat fails BOTH: a hung
+        process is neither routable nor worth keeping; probe failures
+        are readiness-only unless registered with ``liveness=True``."""
         if channels is None:
             channels = health_mod.channel_status()
-        fails: List[Tuple[str, str]] = []
+        ready: List[Tuple[str, str]] = []
+        live: List[Tuple[str, str]] = []
         for ch, age, timeout, overdue in channels:
             if overdue:
-                fails.append(("watchdog:" + ch,
-                              "heartbeat silent %.2fs (timeout %.2fs)"
-                              % (age, timeout)))
-        for name, fn in list(self.probes):
+                f = ("watchdog:" + ch,
+                     "heartbeat silent %.2fs (timeout %.2fs)"
+                     % (age, timeout))
+                ready.append(f)
+                live.append(f)
+        for name, fn, liveness in list(self.probes):
             try:
                 ok, detail = fn()
             except Exception as e:
                 ok, detail = False, "probe raised: %r" % e
             if not ok:
-                fails.append((name, detail))
-        return fails
+                ready.append((name, detail))
+                if liveness:
+                    live.append((name, detail))
+        return ready, live
+
+    def health_failures(self, channels: Optional[list] = None,
+                        liveness_only: bool = False) \
+            -> List[Tuple[str, str]]:
+        """Readiness failures by default; ``liveness_only=True`` gives
+        the /livez view (overdue heartbeats + liveness probes)."""
+        ready, live = self.all_failures(channels)
+        return live if liveness_only else ready
 
     # -- renderers -----------------------------------------------------
     def metrics_text(self) -> str:
-        # ONE heartbeat snapshot per scrape: the healthy gauge and the
-        # per-channel age rows must agree within a single response
+        # ONE heartbeat snapshot and ONE probe pass per scrape: the
+        # healthy/live gauges and the per-channel age rows must agree
+        # within a single response
         channels = health_mod.channel_status()
+        ready, live = self.all_failures(channels)
         return prometheus_metrics(
             self.registry.metrics_snapshot(),
             progress=dict(self.progress),
-            health_failures=self.health_failures(channels),
-            channels=channels)
+            health_failures=ready,
+            channels=channels,
+            live_failures=live)
 
     def statusz_html(self) -> str:
         reg = self.registry
@@ -351,8 +403,11 @@ class StatusServer:
         table("progress", prog)
 
         channels = health_mod.channel_status()
-        fails = self.health_failures(channels)
-        rows = [("healthz", "503 UNHEALTHY" if fails else "200 ok")]
+        fails, live_fails = self.all_failures(channels)
+        rows = [("healthz (ready)", "503 UNHEALTHY" if fails
+                 else "200 ok"),
+                ("livez (alive)", "503 DEAD" if live_fails
+                 else "200 alive")]
         rows += [("probe " + n, d) for n, d in fails]
         for ch, age, timeout, overdue in channels:
             rows.append(("heartbeat " + ch, "%.2fs ago (timeout %.1fs)%s"
@@ -432,10 +487,10 @@ def update_progress(**kv) -> None:
         s.progress.update(kv)
 
 
-def register_probe(name: str, fn) -> None:
+def register_probe(name: str, fn, liveness: bool = False) -> None:
     s = _SERVER
     if s is not None:
-        s.register_probe(name, fn)
+        s.register_probe(name, fn, liveness=liveness)
 
 
 def wire_health(recovery=None) -> None:
@@ -475,6 +530,7 @@ def selftest(verbose: bool = False) -> int:
         assert 'le="+Inf"' in metrics
 
         assert urlopen(base + "/healthz", timeout=5).status == 200
+        assert urlopen(base + "/livez", timeout=5).status == 200
         srv.register_probe("boom", lambda: (False, "injected failure"))
         try:
             urlopen(base + "/healthz", timeout=5)
@@ -482,6 +538,19 @@ def selftest(verbose: bool = False) -> int:
         except HTTPError as e:
             assert e.code == 503
             assert "injected failure" in e.read().decode()
+        # a readiness failure is NOT a liveness failure: /livez stays 200
+        assert urlopen(base + "/livez", timeout=5).status == 200
+        m = urlopen(base + "/metrics", timeout=5).read().decode()
+        assert 'cxxnet_healthy{process="0"} 0' in m
+        assert 'cxxnet_live{process="0"} 1' in m
+        srv.register_probe("dead", lambda: (False, "worker died"),
+                           liveness=True)
+        try:
+            urlopen(base + "/livez", timeout=5)
+            raise AssertionError("livez should be 503")
+        except HTTPError as e:
+            assert e.code == 503
+            assert "worker died" in e.read().decode()
         srv.probes.clear()
 
         page = urlopen(base + "/statusz", timeout=5).read().decode()
@@ -498,8 +567,9 @@ def selftest(verbose: bool = False) -> int:
         srv.stop()
         reg.disable()
     if verbose:
-        print("statusd selftest: /metrics /healthz /statusz /trace ok "
-              "(Prometheus format valid, healthz flip, 404)")
+        print("statusd selftest: /metrics /healthz /livez /statusz "
+              "/trace ok (Prometheus format valid, readiness vs liveness "
+              "flips, 404)")
     return 0
 
 

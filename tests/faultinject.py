@@ -20,6 +20,13 @@ preemptible fleet:
                         spikes, and the clean-run-minus-batch control
 * ``make_imgbin``     — .lst + .bin fixture from raw record bytes
                         (including deliberately undecodable garbage)
+* serving chaos (utils/servd.py, tests/test_servd.py):
+  ``slow_backend`` / ``exploding_backend`` / ``healing_backend``
+                      — backend wrappers for head-of-line stalls, crash
+                        supervision, and breaker open/half-open recovery
+  ``serve_request`` / ``serve_flood`` / ``disconnecting_client``
+                      — real-socket clients: one-shot, concurrent
+                        overload, and hang-up-mid-request
 
 These are plain file/process manipulations so they compose with any
 test runner; tests/test_checkpoint_faults.py and
@@ -208,6 +215,111 @@ def recording_update(orig, record):
         return orig(self, batch)
 
     return wrapper
+
+
+# ----------------------------------------------------------------------
+# serving chaos harness (tests/test_servd.py; utils/servd.ServeFrontend
+# takes the backend as a plain callable, so these compose jax-free)
+def slow_backend(base, delay_s: float):
+    """Backend wrapper that stalls ``delay_s`` before delegating — the
+    slow-decode head-of-line case that fills the admission queue and
+    expires queued deadlines."""
+    import time
+
+    def backend(toks, seq):
+        time.sleep(delay_s)
+        return base(toks, seq)
+
+    return backend
+
+
+def exploding_backend(base=None, every: int = 1, exc: Exception = None):
+    """Backend that raises on every ``every``-th call (every=1: always);
+    delegates to ``base`` otherwise — the supervision fixture (the
+    server must answer ``ERR backend`` and keep serving)."""
+    if base is None and every != 1:
+        raise ValueError("exploding_backend(every=%d) needs a `base` to "
+                         "delegate the non-exploding calls to" % every)
+    calls = {"n": 0}
+
+    def backend(toks, seq):
+        calls["n"] += 1
+        if every and calls["n"] % every == 0:
+            raise exc if exc is not None \
+                else RuntimeError("injected backend explosion")
+        return base(toks, seq)
+
+    backend.calls = calls
+    return backend
+
+
+def healing_backend(base, fail_first: int):
+    """Backend whose FIRST ``fail_first`` calls raise, then delegates —
+    drives the circuit breaker open and proves the half-open probe
+    closes it again. ``backend.calls["n"]`` counts actual dispatches
+    (shed requests never reach it)."""
+    calls = {"n": 0}
+
+    def backend(toks, seq):
+        calls["n"] += 1
+        if calls["n"] <= fail_first:
+            raise RuntimeError("injected failure %d/%d"
+                               % (calls["n"], fail_first))
+        return base(toks, seq)
+
+    backend.calls = calls
+    return backend
+
+
+def serve_request(port: int, line: str, timeout: float = 5.0):
+    """One-shot servd client: send one request line, return the response
+    line (None if the server closed the connection without answering —
+    the "accepted but unanswered" case the drain contract forbids).
+    Delegates to servd's own client helper so tests and the selftest
+    drive the protocol through one implementation."""
+    from cxxnet_tpu.utils import servd
+
+    resp = servd._ask(port, line, timeout=timeout)
+    return resp if resp else None
+
+
+def serve_flood(port: int, lines, timeout: float = 10.0):
+    """Concurrent one-request clients (one connection each) — the
+    request flood past ``serve_queue``. Returns responses aligned with
+    ``lines`` (None where a client got no response line)."""
+    import threading
+
+    out = [None] * len(lines)
+
+    def one(i):
+        try:
+            out[i] = serve_request(port, lines[i], timeout=timeout)
+        except OSError:
+            out[i] = None
+
+    ts = [threading.Thread(target=one, args=(i,))
+          for i in range(len(lines))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    return out
+
+
+def disconnecting_client(port: int, line: str, rst: bool = True) -> None:
+    """Send a request and hang up WITHOUT reading the answer — the
+    mid-request client disconnect. ``rst=True`` closes with SO_LINGER 0
+    (a TCP RST) so the server's reply write actually fails instead of
+    vanishing into a closed-but-buffered socket."""
+    import socket
+    import struct
+
+    c = socket.create_connection(("127.0.0.1", port), timeout=5.0)
+    c.sendall((line + "\n").encode("utf-8"))
+    if rst:
+        c.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                     struct.pack("ii", 1, 0))
+    c.close()
 
 
 def make_imgbin(dirname: str, bufs, page_ints: int = 1 << 12,

@@ -466,10 +466,15 @@ def test_generate_after_pipeline_training():
 
 
 def test_cli_serve_task(tmp_path):
-    """task = serve: the interactive stdin/stdout loop answers each
-    prompt line with its continuation, matching Trainer.generate (seed
-    advances per request so sampling streams differ per line; greedy
-    here, so rows match generate exactly)."""
+    """task = serve: the stdin/stdout loop (now the servd frontend
+    engine, utils/servd.py) answers each prompt line with its
+    continuation, matching Trainer.generate (seed advances per request
+    so sampling streams differ per line; greedy here, so rows match
+    generate exactly) — and SURVIVES request-level failures: an empty
+    line is answered ``ERR empty`` (not silently swallowed), a malformed
+    line ``ERR parse``, and a backend exception (a prompt too long for
+    the net's sequence length fails inside generate) is answered
+    ``ERR backend`` with the loop continuing to serve."""
     import os
     import subprocess
     import sys as _sys
@@ -489,7 +494,13 @@ def test_cli_serve_task(tmp_path):
                 % model)
     rs = np.random.RandomState(13)
     lines = [rs.randint(0, VOCAB, n).tolist() for n in (4, 6, 4)]
-    stdin = "\n".join(" ".join(map(str, r)) for r in lines) + "\n"
+    bad = ["",                                # -> ERR empty
+           "3 not-a-token 5",                 # -> ERR parse
+           " ".join(["1"] * (SEQ + 1))]       # in-vocab but longer than
+    #                                           the decode cache: the
+    #                                           backend raises mid-loop
+    stdin = "\n".join(bad
+                      + [" ".join(map(str, r)) for r in lines]) + "\n"
     env = dict(os.environ, CXXNET_JAX_PLATFORM="cpu")
     p = subprocess.run(
         [_sys.executable, os.path.join(REPO, "bin", "cxxnet"), cf],
@@ -497,7 +508,10 @@ def test_cli_serve_task(tmp_path):
         env=env)
     assert p.returncode == 0, (p.stdout[-1000:], p.stderr[-1000:])
     out_lines = [l for l in p.stdout.splitlines() if l.strip()]
-    assert "served 3 prompts" in p.stderr
+    assert "served 3 prompts (3 request errors)" in p.stderr
+    # one ERR line per failed request, in request order, loop alive after
+    errs = [l for l in out_lines if l.startswith("ERR")]
+    assert [e.split()[1] for e in errs] == ["empty", "parse", "backend"]
     got = [list(map(int, l.split())) for l in out_lines[-3:]]
     for i, r in enumerate(lines):
         want = tr.generate(np.asarray([r]), 5)

@@ -9,8 +9,11 @@ Usage:
 
 Prints top spans by total time, recompile count/causes/seconds, per-round
 breakdowns, counters/gauges, fixed-bucket latency histograms (bucket table
-+ p50/p90/p99), step-time percentiles, and a training-health section
-(anomalies/rollbacks/watchdog stalls/corrupt records, utils/health.py).
++ p50/p90/p99), step-time percentiles, a training-health section
+(anomalies/rollbacks/watchdog stalls/corrupt records, utils/health.py),
+and a serving section (shed rate, deadline-miss rate, circuit-breaker
+transitions, per-request p50/p99 from the ``serve.request`` histogram,
+utils/servd.py).
 ``--trace`` additionally exports a chrome://tracing / Perfetto JSON built
 from the span tree. ``--json`` emits the aggregate as one JSON object
 instead of the table (for scripting).
@@ -27,8 +30,11 @@ Exit codes: 0 ok; 1 usage / unreadable file; 2 malformed log (a line
 that is not valid JSON, or no telemetry events at all) OR a log with
 ``health_anomaly`` events that no resolution event (``health_rollback``
 / ``health_skip`` / ``health_abort`` referencing the anomaly id, or an
-inline ``resolution`` field) ever answered — CI gates on this so neither
-a broken emitter nor an unrecovered training anomaly can silently pass.
+inline ``resolution`` field) ever answered, OR a log whose LAST
+``serve_breaker`` event (per process) left the circuit breaker open —
+CI gates on this so neither a broken emitter, an unrecovered training
+anomaly, nor a serving run that ended with its backend shedding can
+silently pass.
 """
 
 import json
@@ -140,6 +146,7 @@ def aggregate(events):
     by_proc = {}
     health = {"anomalies": [], "resolutions": [], "stalls": [],
               "data_corrupt": 0, "skipped_batches": 0}
+    breaker_events = []
 
     def proc(ev):
         p = int(ev.get("p", 0))
@@ -192,6 +199,9 @@ def aggregate(events):
             health["data_corrupt"] += 1
         elif kind == "health_skip_batch":
             health["skipped_batches"] += 1
+        elif kind == "serve_breaker":
+            breaker_events.append(ev)
+            proc(ev)
     # an anomaly is resolved by an inline resolution field (warn-only
     # metric events) or by any recovery event referencing its id —
     # matched PER PROCESS: anomaly ids are per-process counters, so in a
@@ -218,9 +228,35 @@ def aggregate(events):
                 print("process %d histogram %r: %s" % (p, name, e),
                       file=sys.stderr)
                 sys.exit(2)
+    # serving summary: rates off the (summed) counters, breaker
+    # transition counts, and the FINAL breaker state per process — a log
+    # that ends breaker-open is an unresolved serving outage (exit 2)
+    serving = None
+    if breaker_events or any(k.startswith("serve.") for k in counters):
+        acc = counters.get("serve.accepted", 0)
+        serving = {
+            "accepted": acc,
+            "served": counters.get("serve.requests", 0),
+            "errors": counters.get("serve.errors", 0),
+            "shed": counters.get("serve.shed", 0),
+            "deadline": counters.get("serve.deadline", 0),
+            "shed_rate": round(counters.get("serve.shed", 0)
+                               / float(acc), 4) if acc else 0.0,
+            "deadline_miss_rate": round(counters.get("serve.deadline", 0)
+                                        / float(acc), 4) if acc else 0.0,
+            "reloads": counters.get("serve.reloads", 0),
+            "breaker_transitions": count_by(breaker_events, "state"),
+            "breaker_final": {},
+        }
+        for ev in breaker_events:       # events arrive time-sorted
+            serving["breaker_final"][str(int(ev.get("p", 0)))] = \
+                ev.get("state")
+        serving["breaker_open_unresolved"] = sorted(
+            p for p, st in serving["breaker_final"].items()
+            if st == "open")
     out = {"spans": {}, "compiles": {}, "counters": counters,
            "gauges": gauges, "rounds": rounds, "health": health,
-           "hists": {}}
+           "serving": serving, "hists": {}}
     for name, h in sorted(merged_hists.items()):
         st = h.stats()
         st["buckets"] = h.to_dict()["buckets"]
@@ -340,6 +376,28 @@ def print_report(agg, top=15):
                 print("    counter %-20s %s" % (name, v))
             for name, v in sorted(pb.get("gauges", {}).items()):
                 print("    gauge   %-20s %s" % (name, v))
+    sv = agg.get("serving")
+    if sv:
+        print("\n== serving ==")
+        print("accepted: %d  served: %d  errors: %d  shed: %d "
+              "(rate %.2f%%)  deadline-missed: %d (rate %.2f%%)"
+              % (sv["accepted"], sv["served"], sv["errors"], sv["shed"],
+                 100 * sv["shed_rate"], sv["deadline"],
+                 100 * sv["deadline_miss_rate"]))
+        req = agg.get("hists", {}).get("serve.request")
+        if req:
+            print("request latency: n=%d  p50=%.2fms  p90=%.2fms  "
+                  "p99=%.2fms" % (req["count"], req["p50_ms"],
+                                  req["p90_ms"], req["p99_ms"]))
+        if sv["reloads"]:
+            print("model reloads: %d" % sv["reloads"])
+        if sv["breaker_transitions"]:
+            print("breaker transitions: %s" %
+                  " ".join("%s=%d" % kv for kv in
+                           sorted(sv["breaker_transitions"].items())))
+            for p, st in sorted(sv["breaker_final"].items()):
+                print("  process %s final breaker state: %s%s"
+                      % (p, st, "  UNRESOLVED" if st == "open" else ""))
     h = agg.get("health", {})
     if h and (h["anomalies"] or h["stalls"] or h["data_corrupt"]
               or h["skipped_batches"]):
@@ -425,6 +483,13 @@ def main(argv):
         print("%s: %d health_anomaly event(s) with no matching "
               "health_rollback/resolution — the run detected trouble and "
               "never recovered" % (label, len(unresolved)), file=sys.stderr)
+        return 2
+    open_breakers = (agg.get("serving") or {}).get(
+        "breaker_open_unresolved", [])
+    if open_breakers:
+        print("%s: serving circuit breaker still OPEN at end of log "
+              "(process %s) — the run ended shedding every request"
+              % (label, ", ".join(open_breakers)), file=sys.stderr)
         return 2
     return 0
 
