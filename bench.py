@@ -502,6 +502,14 @@ def bench_serve_load():
         t.join()
     fe.drain()
     lats.sort()
+    # server-side phase attribution from the flight recorder: TTFT
+    # (accept -> first token, the trainer's prefill/decode split) and
+    # queue wait — the sub-fields the batching PR's before/after is
+    # graded on (bench_compare gates them via "<metric>.<field>" keys)
+    recs = [r for r in fe.flight.list() if r["outcome"] == "served"]
+    ttfts = sorted(r["ttft_s"] for r in recs
+                   if r.get("ttft_s") is not None)
+    qwaits = sorted(r["phases"]["queue_wait"] for r in recs)
     # rates over requests actually ISSUED: a client whose connection died
     # stops early, and its unsent requests must not pad the denominator
     # (a fully degraded run would otherwise understate its error rate)
@@ -512,6 +520,10 @@ def bench_serve_load():
             "unit": "ms", "vs_baseline": None,
             "p50_ms": round(1e3 * percentile(lats, 50), 3) if lats
             else None,
+            "ttft_p99_ms": round(1e3 * percentile(ttfts, 99), 3)
+            if ttfts else None,
+            "queue_wait_p99_ms": round(1e3 * percentile(qwaits, 99), 3)
+            if qwaits else None,
             "shed_rate": round(nshed[0] / float(total), 4),
             "error_rate": round(nerr[0] / float(total), 4),
             "requests": nsent[0]}

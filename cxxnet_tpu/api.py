@@ -222,10 +222,15 @@ class Net:
         ``servd.ServeFrontend`` (``.port`` is the bound port; port 0 =
         ephemeral; loopback unless ``host`` widens it). ``opts`` pass
         through to ServeFrontend (queue_size, deadline_ms, drain_ms,
-        breaker_fails, breaker_cooldown_ms, reload_fn, ...). The caller
-        owns shutdown: call ``.drain()`` — every accepted request is
-        answered before it returns."""
-        from .utils import servd
+        breaker_fails, breaker_cooldown_ms, reload_fn, slo, flight_cap,
+        ...). Every request gets a phase-attributed flight record in
+        ``fe.flight`` — TTFT split at the trainer's first-token
+        boundary (doc/observability.md "Request tracing & SLOs") — and
+        the recorder is registered with statusd when a status server is
+        live, so ``/trace?request=<id>`` answers for an embedder too.
+        The caller owns shutdown: call ``.drain()`` — every accepted
+        request is answered before it returns."""
+        from .utils import servd, statusd
         assert self.net_ is not None, "model not initialized"
         vocab = servd.embed_vocab(self.net_.net)
 
@@ -237,6 +242,11 @@ class Net:
         fe = servd.ServeFrontend(backend, vocab=vocab, **opts)
         fe.start()
         fe.listen(port, host=host)
+        statusd.set_flight_recorder(fe.flight)
+        # unconditional: slo=None must also CLEAR a tracker left behind
+        # by an earlier frontend, or /metrics keeps exporting a dead
+        # account the live frontend never feeds
+        statusd.set_slo(fe.slo)
         return fe
 
     def beam_generate(self, prompts: np.ndarray, n_new: int,

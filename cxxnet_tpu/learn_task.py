@@ -129,6 +129,18 @@ class LearnTask:
         self.serve_breaker_fails = 5
         self.serve_breaker_cooldown_ms = 1000.0
         self.serve_stall_s = 120.0       # wedged-backend probe bound
+        # serving SLOs + request tracing (doc/observability.md "Request
+        # tracing & SLOs"): every request gets a phase-attributed trace
+        # in a bounded flight recorder (statusd /trace?request=<id>,
+        # /requestz) and feeds a rolling error-budget account — a
+        # request that errors, or blows slo_ttft_ms / slo_p99_ms, burns
+        # budget; the cxxnet_slo_burn gauge flips at >= 1x burn rate.
+        # Latency objectives default 0 = availability-only SLO.
+        self.slo_ttft_ms = 0.0
+        self.slo_p99_ms = 0.0
+        self.slo_availability = 0.999
+        self.slo_window_s = 300.0
+        self.serve_flight_cap = 256
         self.gen_new = 16
         self.gen_temperature = 0.0
         self.gen_topk = 0
@@ -321,6 +333,16 @@ class LearnTask:
             self.serve_breaker_cooldown_ms = float(val)
         if name == "serve_stall_s":
             self.serve_stall_s = float(val)
+        if name == "slo_ttft_ms":
+            self.slo_ttft_ms = float(val)
+        if name == "slo_p99_ms":
+            self.slo_p99_ms = float(val)
+        if name == "slo_availability":
+            self.slo_availability = float(val)
+        if name == "slo_window_s":
+            self.slo_window_s = float(val)
+        if name == "serve_flight_cap":
+            self.serve_flight_cap = int(val)
         if name == "extract_node_name":
             self.extract_node_name = val
         if name == "export_out":
@@ -1211,6 +1233,13 @@ class LearnTask:
                       flush=True)
             return True
 
+        # SLO error-budget account: every completed request feeds it;
+        # the burn-rate gauges ride /metrics and the transition events
+        # ride the telemetry log (report exit-2 gate)
+        slo = statusd.SLOTracker(
+            ttft_ms=self.slo_ttft_ms, p99_ms=self.slo_p99_ms,
+            availability=self.slo_availability,
+            window_s=self.slo_window_s)
         fe = servd.ServeFrontend(
             backend, queue_size=self.serve_queue,
             deadline_ms=self.serve_deadline_ms,
@@ -1218,8 +1247,14 @@ class LearnTask:
             breaker_fails=self.serve_breaker_fails,
             breaker_cooldown_ms=self.serve_breaker_cooldown_ms,
             stall_after_s=self.serve_stall_s,
-            vocab=vocab, reload_fn=reload_fn)
+            vocab=vocab, reload_fn=reload_fn,
+            slo=slo, flight_cap=self.serve_flight_cap)
         fe.start()
+        # request introspection: /trace?request=<id> + /requestz serve
+        # the flight ring, /metrics + /statusz the SLO account (no-ops
+        # without status_port)
+        statusd.set_flight_recorder(fe.flight)
+        statusd.set_slo(slo)
         if self.serve_port >= 0:
             try:
                 port = fe.listen(self.serve_port, host=self.serve_host)

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import re
+import time
 from functools import partial
 from typing import Dict, List, Optional, Tuple
 
@@ -1425,7 +1426,10 @@ class Trainer:
         temperature, top_k = float(temperature), int(top_k)
         check(top_k >= 0, "generate: top_k must be >= 0")
         fkey = (plen, total, temperature, top_k)
-        if fkey not in self._decode_fns:
+        # a fresh entry means THIS call pays the decode-program compile:
+        # the TTFT stamp below must not charge it to prefill
+        fresh_fns = fkey not in self._decode_fns
+        if fresh_fns:
             last = net2.cfg.param.num_nodes - 1
 
             def pick(probs, step_key):
@@ -1445,19 +1449,24 @@ class Trainer:
                     lg = jnp.where(keep, lg, -jnp.inf)
                 return jax.random.categorical(step_key, lg, axis=1)
 
-            def run(params, toks, key, lens):
+            def place(toks, t, picked, lens):
+                """Column t+1: the row's own prompt token while t+1
+                is still inside its prompt, else the picked token."""
+                cur = jax.lax.dynamic_slice(
+                    toks, (0, t + 1), (b, 1))[:, 0]
+                new = jnp.where(t + 1 < lens, cur, picked)
+                return jax.lax.dynamic_update_slice(
+                    toks, new[:, None], (0, t + 1))
+
+            # The generation is TWO jitted programs split at the
+            # first-token boundary — the TTFT split the serving layer
+            # measures (doc/observability.md), and the same seam
+            # iteration-granularity batching will schedule at later.
+            # Same RNG folds, same cache contents as the old single
+            # program: token-exact.
+            def run_prefill(params, toks, key, lens):
                 caches = {k: jnp.zeros(sh, cache_dtype)
                           for k, sh in zip(cache_keys, cache_shapes)}
-
-                def place(toks, t, picked):
-                    """Column t+1: the row's own prompt token while t+1
-                    is still inside its prompt, else the picked token."""
-                    cur = jax.lax.dynamic_slice(
-                        toks, (0, t + 1), (b, 1))[:, 0]
-                    new = jnp.where(t + 1 < lens, cur, picked)
-                    return jax.lax.dynamic_update_slice(
-                        toks, new[:, None], (0, t + 1))
-
                 # chunked prefill: ONE forward covers the shared prefix
                 # [0, plen) and fills every cache; its last row yields the
                 # candidate token for position plen
@@ -1469,8 +1478,14 @@ class Trainer:
                 first = pick(values[last].reshape(b, -1, plen)[:, :, -1],
                              jax.random.fold_in(key, plen - 1)
                              ).astype(toks.dtype)
-                toks = place(toks, plen - 1, first)
+                toks = place(toks, plen - 1, first, lens)
+                # params donated-and-returned: see _swap_params — keeps
+                # the decode copy runtime-resident across serving calls.
+                # ``first`` is returned UNDONATED so the caller can block
+                # on the first token alone while the decode program runs.
+                return toks, caches, first, params
 
+            def run_decode(params, toks, caches, key, lens):
                 def step(carry, t):
                     toks, caches = carry
                     tok_t = jax.lax.dynamic_slice(toks, (0, t), (b, 1))
@@ -1481,20 +1496,25 @@ class Trainer:
                     nxt = pick(values[last].reshape(b, -1),
                                jax.random.fold_in(key, t)
                                ).astype(toks.dtype)
-                    toks = place(toks, t, nxt)
+                    toks = place(toks, t, nxt, lens)
                     return (toks, dict(net2._last_cache_updates)), None
 
-                if total > plen + 1:
-                    (toks, _), _ = jax.lax.scan(
-                        step, (toks, caches),
-                        jnp.arange(plen, total - 1))
-                # params donated-and-returned: see _swap_params — keeps
-                # the decode copy runtime-resident across serving calls
+                (toks, _), _ = jax.lax.scan(
+                    step, (toks, caches), jnp.arange(plen, total - 1))
                 return toks, params
 
-            self._decode_fns[fkey] = telemetry.jit_watch(
-                jax.jit(run, donate_argnums=(0,)), "jit.decode",
-                cause=getattr(self, "_decode_cause", "new_signature"))
+            cause = getattr(self, "_decode_cause", "new_signature")
+            self._decode_fns[fkey] = (
+                telemetry.jit_watch(
+                    jax.jit(run_prefill, donate_argnums=(0,)),
+                    "jit.decode_prefill", cause=cause),
+                telemetry.jit_watch(
+                    # toks flows prefill -> decode exactly once and is
+                    # returned: donate it so the scan updates in place
+                    # (caches are NOT donated — they have no matching
+                    # output to alias, so donation would only warn)
+                    jax.jit(run_decode, donate_argnums=(0, 1)),
+                    "jit.decode_step", cause=cause))
         toks0 = np.zeros((b, l_max), np.int32)
         toks0[:, :max_p] = prompts
         # (padding beyond a ragged row's real prompt is never read: the
@@ -1503,17 +1523,59 @@ class Trainer:
         # place()-written at the previous step)
         try:
             with telemetry.span("decode.generate", new_tokens=n_new):
-                toks_dev, new_dparams = self._decode_fns[fkey](
-                    params, jnp.asarray(toks0), jax.random.PRNGKey(seed),
-                    jnp.asarray(lens))
+                t0 = time.perf_counter()
+                pre_fn, dec_fn = self._decode_fns[fkey]
+                key_dev = jax.random.PRNGKey(seed)
+                lens_dev = jnp.asarray(lens)
+                toks_dev, caches, first_dev, new_dparams = pre_fn(
+                    params, jnp.asarray(toks0), key_dev, lens_dev)
+                run_decode = total > plen + 1
+                if run_decode and not fresh_fns:
+                    # compiled decode program: dispatch the scan BEFORE
+                    # blocking on the first token — async dispatch keeps
+                    # the chip busy while the host timestamps TTFT
+                    toks_dev, new_dparams = dec_fn(
+                        new_dparams, toks_dev, caches, key_dev, lens_dev)
+                jax.block_until_ready(first_dev)
+                t_first = time.perf_counter()
+                # the TTFT boundary: the serving worker's trace context
+                # picks this mark up (utils/servd._observe_request)
+                telemetry.mark("first_token")
+                telemetry.span_event("decode.prefill", t0, t_first - t0)
+                if run_decode and fresh_fns:
+                    # fresh decode program: jax.jit traces and compiles
+                    # synchronously inside this call, so dispatching it
+                    # before the block above would charge the whole
+                    # compile to prefill/TTFT — the device had the first
+                    # token long before. Stamp first, pay the compile
+                    # where it belongs: in the decode phase.
+                    toks_dev, new_dparams = dec_fn(
+                        new_dparams, toks_dev, caches, key_dev, lens_dev)
+                toks = np.asarray(toks_dev)        # blocks for the rest
+                if total > plen + 1:
+                    telemetry.span_event(
+                        "decode.decode", t_first,
+                        time.perf_counter() - t_first,
+                        tokens=int(b * (total - plen - 1)))
         except Exception:
             # the donated decode copy may be consumed even on failure —
             # drop the cache so the next call regathers from self.params
             self._decode_params = None
+            # a FIRST call that failed may have cached programs that
+            # never actually compiled: keeping them would make the
+            # retry look non-fresh and dispatch the decode program
+            # before the first-token block, charging its synchronous
+            # compile to prefill/TTFT — evict so the retry takes the
+            # fresh path. A warmed signature keeps its programs: they
+            # are known-compiled, and evicting would make every
+            # transient backend failure cost the retry a recompile
+            # cliff (misattributed to that innocent request)
+            if fresh_fns:
+                self._decode_fns.pop(fkey, None)
             telemetry.count("decode.cache_drop")
             raise
         self._decode_params = (self._decode_params[0], new_dparams)
-        toks = np.asarray(toks_dev)
+        telemetry.count("decode.tokens", int(b) * int(n_new))
         return np.stack([toks[r, lens[r]: lens[r] + n_new]
                          for r in range(b)])
 

@@ -56,11 +56,36 @@ Responses leave each connection in request order (the protocol pairs
 them positionally), even when a rejection is produced instantly while
 earlier requests are still queued.
 
-Observability: counters ``serve.accepted/requests/errors/shed/deadline/
-empty/client_gone/backend_errors/breaker_*/reloads``, gauges
-``serve.queue_depth``/``serve.in_flight``, the ``serve.request`` latency
-span/histogram and a ``serve.queue_wait`` histogram — all scrapable live
-via statusd ``/metrics``. ``health_probe`` (readiness: 503 while draining
+Observability — every accepted request gets a **request id** and its
+life is decomposed into phase-attributed telemetry (the measurement
+contract the batching/paging/prewarm throughput arc is graded against):
+
+* **phases** (they tile accept->answer wall-clock): ``queue_wait``
+  (accept -> worker pop), ``dispatch`` (pop -> backend call),
+  ``prefill`` (backend call -> first token — the worker runs the
+  backend under ``telemetry.trace_context(request_id)``, and the
+  trainer marks ``first_token`` at its prefill/decode split), and
+  ``decode`` (first token -> last token, i.e. per-token time).
+* **series**: counters ``serve.accepted/requests/errors/shed/deadline/
+  empty/client_gone/backend_errors/breaker_*/reloads/tokens``, gauges
+  ``serve.queue_depth`` / ``serve.in_flight`` /
+  ``serve.tokens_per_second`` / ``serve.batch_occupancy`` (sequences in
+  the most recent decode pass — reads 1 today, the headline once
+  batching lands), histograms ``serve.request`` (end-to-end),
+  ``serve.queue_wait``, ``serve.ttft`` (accept -> first token) and
+  ``serve.decode_per_token`` — declared at start() so /metrics exports
+  the bucket series from scrape one.
+* **flight recorder**: the last ``flight_cap`` dequeued requests keep
+  their full trace (phase split, tokens, outcome, the recompiles they
+  paid) in ``self.flight`` (telemetry.FlightRecorder) — statusd serves
+  one as a Chrome trace at ``/trace?request=<id>`` and lists the ring
+  at ``/requestz``; each also emits a ``serve_request_done`` event
+  (tools/telemetry_report.py's request-breakdown section).
+* **SLOs**: pass ``slo=statusd.SLOTracker(...)`` and every completed
+  request feeds the rolling error-budget account behind the
+  ``cxxnet_slo_burn`` alert gauge.
+
+``health_probe`` (readiness: 503 while draining
 or breaker-open) and ``liveness_probe`` (worker thread death) plug into
 statusd ``/healthz`` / ``/livez``; the accept and worker threads beat the
 ``serve.accept`` / ``serve.worker`` watchdog channels (paused across idle
@@ -200,12 +225,14 @@ class _ConnState:
 
 
 class _Request:
-    __slots__ = ("toks", "deadline", "t_arrival", "reply", "done", "seq",
-                 "_alock", "answered")
+    __slots__ = ("toks", "deadline", "t_arrival", "t_wall", "reply",
+                 "done", "seq", "id", "_alock", "answered")
 
     def __init__(self, toks: List[int], deadline: Optional[float], reply):
         self.toks = toks
         self.t_arrival = time.monotonic()
+        self.t_wall = time.time()    # flight-record arrival timestamp
+        self.id = "?"                # assigned under the admission lock
         # deadline arrives relative (seconds); stored absolute monotonic
         self.deadline = None if deadline is None \
             else self.t_arrival + deadline
@@ -260,8 +287,15 @@ class ServeFrontend:
                  breaker_max_cooldown_ms: float = 30000.0, vocab: int = 0,
                  reload_fn: Optional[Callable] = None,
                  client_timeout: float = 10.0,
-                 stall_after_s: float = 120.0):
+                 stall_after_s: float = 120.0,
+                 slo=None, flight_cap: int = 256):
         self.backend = backend
+        # per-request observability: the flight ring every dequeued
+        # request lands in, and the (optional) SLO error-budget account
+        # (statusd.SLOTracker) fed per completed request
+        self.flight = telemetry.FlightRecorder(flight_cap)
+        self.slo = slo
+        self._rid = 0                # request-id counter (admission lock)
         self.queue_size = max(1, int(queue_size))
         self.deadline_ms = float(deadline_ms)
         self.drain_ms = float(drain_ms)
@@ -307,6 +341,13 @@ class ServeFrontend:
     def start(self) -> "ServeFrontend":
         telemetry.gauge("serve.queue_depth", 0)
         telemetry.gauge("serve.in_flight", 0)
+        telemetry.gauge("serve.batch_occupancy", 0)
+        # declare the latency series up front: a dashboard (or the
+        # acceptance scrape) must see serve_ttft_seconds buckets exist
+        # BEFORE the first request, and /statusz shows them as "n/a"
+        for name in ("serve.request", "serve.queue_wait", "serve.ttft",
+                     "serve.decode_per_token"):
+            telemetry.declare_hist(name)
         self._worker_thread = threading.Thread(
             target=self._worker_run, name="cxn-servd-worker", daemon=True)
         self._worker_thread.start()
@@ -409,20 +450,50 @@ class ServeFrontend:
             self._bump("client_gone")
             return False
 
-    def _finish(self, req: _Request, text: str, *outcome: str) -> None:
+    def _claim(self, req: _Request) -> bool:
+        """Claim a request's exactly-once answer slot (see _finish)."""
+        with req._alock:
+            if req.answered:
+                return False
+            req.answered = True
+            return True
+
+    def _finish(self, req: _Request, text: str, *outcome: str) -> bool:
         """Answer a queued request EXACTLY ONCE, bumping its outcome
         counters only on the winning side — drain can give up on a
         request whose backend wedged past the budget while the worker
         might still complete it later; whoever claims first accounts
-        and replies, the loser is a no-op."""
-        with req._alock:
-            if req.answered:
-                return
-            req.answered = True
+        and replies, the loser is a no-op. Returns whether THIS call
+        won the answer slot (drain uses it to account the loss)."""
+        if not self._claim(req):
+            return False
         if outcome:
             self._bump(*outcome)
         self._send(req.reply, text)
         req.done.set()
+        return True
+
+    def _finish_observed(self, req: _Request, text: str, counter: str,
+                         outcome: str, tc, queue_wait: float,
+                         t_pop: float, t_back: float, t_end: float,
+                         wall: float, ntok: int) -> None:
+        """Terminal step for every dequeued request: claim the
+        exactly-once answer slot, publish the request's telemetry
+        (flight record, SLO account, TTFT series), and only THEN send
+        the response — a client synchronized on the response line can
+        immediately read /trace?request=<id>. A lost claim means drain
+        already answered this request (gave it up as wedged past the
+        budget): record outcome "abandoned" — the phases are real work
+        the backend did, but the client never received this answer —
+        instead of falsely logging a served/ok request."""
+        won = self._claim(req)
+        self._observe_request(req, tc, outcome if won else "abandoned",
+                              queue_wait, t_pop, t_back, t_end, wall,
+                              ntok)
+        if won:
+            self._bump(counter)
+            self._send(req.reply, text)
+            req.done.set()
 
     # -- request intake ------------------------------------------------
     def _parse(self, line: str):
@@ -496,6 +567,7 @@ class ServeFrontend:
             self._send(reply, text)
             return None
         req = None
+        shed = False
         # admission decision + accounting in ONE critical section with
         # the drain flag: after drain() flips _draining (under this
         # lock) no request can slip an accepted count past its final
@@ -514,20 +586,33 @@ class ServeFrontend:
             elif self.breaker.blocked():
                 # breaker open: shed instantly — no queue, no backend
                 self._bump("accepted", "shed")
+                shed = True
                 text = "ERR busy circuit breaker open"
             elif len(self._q) >= self.queue_size:
                 self._bump("accepted", "shed")
+                shed = True
                 text = "ERR busy admission queue full (%d)" \
                     % self.queue_size
             else:
                 _, toks, deadline = parsed
                 req = _Request(toks, deadline, reply)
+                # the request id that threads through the whole datapath
+                # (trace context, flight record, /trace?request=<id>)
+                self._rid += 1
+                req.id = str(self._rid)
                 self._bump("accepted")
                 self._q.append(req)
                 telemetry.gauge("serve.queue_depth", len(self._q))
                 self._cond.notify()
                 text = None
         if req is None:
+            if shed and self.slo is not None:
+                # an admission shed (queue full / breaker open at
+                # accept) is an availability failure the error budget
+                # must burn for, exactly like a dispatch-time breaker
+                # shed — otherwise a total-overload flood that sheds
+                # 99% of traffic at the door keeps cxxnet_slo_burn at 0
+                self.slo.observe(ok=False)
             self._send(reply, text)
             return None
         if wait:
@@ -605,18 +690,31 @@ class ServeFrontend:
 
     def _dispatch(self, req: _Request) -> None:
         now = time.monotonic()
-        telemetry.hist("serve.queue_wait", now - req.t_arrival)
+        t_pop = time.perf_counter()
+        queue_wait = now - req.t_arrival
+        telemetry.hist("serve.queue_wait", queue_wait)
         if req.deadline is not None and now > req.deadline:
             # expired while queued: answered BEFORE dispatch — the
             # backend never decodes an answer nobody is waiting for
-            self._finish(req, "ERR deadline expired %.0fms ago"
-                         % (1e3 * (now - req.deadline)), "deadline")
+            t_end = time.perf_counter()
+            wall = time.monotonic() - req.t_arrival
+            self._finish_observed(
+                req, "ERR deadline expired %.0fms ago"
+                % (1e3 * (now - req.deadline)), "deadline", "deadline",
+                None, queue_wait, t_pop, t_pop, t_end, wall, 0)
             return
         if not self.breaker.allow():
-            self._finish(req, "ERR busy circuit breaker open", "shed")
+            t_end = time.perf_counter()
+            wall = time.monotonic() - req.t_arrival
+            self._finish_observed(
+                req, "ERR busy circuit breaker open", "shed", "shed",
+                None, queue_wait, t_pop, t_pop, t_end, wall, 0)
             return
         req.seq, self._seq = self._seq, self._seq + 1
         telemetry.gauge("serve.in_flight", 1)
+        # occupancy of the decode pass being dispatched: 1 sequence per
+        # pass today — the series whose value IS the batching win later
+        telemetry.gauge("serve.batch_occupancy", 1)
         # the backend call is legitimately silent time on the worker
         # channel — a first-request decode-cache compile (or the
         # recompile after a hot reload) can far outlast any sane
@@ -626,28 +724,125 @@ class ServeFrontend:
         # on this dispatch (health/liveness probes above); the heartbeat
         # watches the worker loop itself.
         health.pause("serve.worker")
+        # the trace context tags every span/compile the backend records
+        # with this request's id and carries the trainer's first_token
+        # mark back out — the TTFT boundary
+        tc = telemetry.trace_context(req.id)
+        t_back = t_pop
         try:
-            with telemetry.span("serve.request", tokens=len(req.toks)):
-                out = self.backend(req.toks, req.seq)
-            # the conversion is supervised too: a backend returning a
-            # non-iterable-of-ints is a backend failure, not a worker
-            # death sentence
-            text = " ".join(str(int(t)) for t in out)
+            with tc:
+                t_back = time.perf_counter()
+                with telemetry.span("serve.request",
+                                    tokens=len(req.toks)):
+                    out = self.backend(req.toks, req.seq)
+                # the conversion is supervised too: a backend returning a
+                # non-iterable-of-ints is a backend failure, not a worker
+                # death sentence
+                outs = [int(t) for t in out]
+            text = " ".join(str(t) for t in outs)
         except Exception as e:
+            t_end = time.perf_counter()
+            wall = time.monotonic() - req.t_arrival
             health.beat("serve.worker")
             telemetry.gauge("serve.in_flight", 0)
             self.breaker.failure()
             telemetry.count("serve.backend_errors")
             telemetry.event({"ev": "serve_backend_error",
-                             "error": repr(e)[:200]})
+                             "error": repr(e)[:200], "req": req.id})
             # one line, whatever the exception said
-            self._finish(req, "ERR backend "
-                         + " ".join(repr(e).split())[:200], "errors")
+            self._finish_observed(
+                req, "ERR backend " + " ".join(repr(e).split())[:200],
+                "errors", "backend_error", tc, queue_wait, t_pop,
+                t_back, t_end, wall, 0)
             return
+        t_end = time.perf_counter()
+        wall = time.monotonic() - req.t_arrival
         health.beat("serve.worker")
         telemetry.gauge("serve.in_flight", 0)
         self.breaker.success()
-        self._finish(req, text, "served")
+        self._finish_observed(req, text, "served", "served", tc,
+                              queue_wait, t_pop, t_back, t_end, wall,
+                              len(outs))
+
+    def _observe_request(self, req: _Request, tc, outcome: str,
+                         queue_wait: float, t_pop: float, t_back: float,
+                         t_end: float, wall: float, ntok: int) -> None:
+        """Phase-attribute one dequeued request and publish everything
+        downstream reads: the TTFT / per-token histograms and
+        tokens-per-second gauge, the flight record, the
+        ``serve_request_done`` event, and the SLO account. Phases TILE
+        the request's accept->answer wall-clock — queue_wait, dispatch
+        (pop -> backend call), prefill (call -> first token), decode
+        (first -> last token) — so their sum IS the total; a request
+        that never reached the backend (deadline, breaker shed) carries
+        only queue_wait + dispatch."""
+        dispatch = max(0.0, t_back - t_pop)
+        prefill = decode = 0.0
+        ttft = None
+        dispatched = outcome in ("served", "backend_error", "abandoned")
+        if dispatched:
+            ft = tc.marks.get("first_token") if tc is not None else None
+            if ft is not None and t_back <= ft <= t_end:
+                prefill = ft - t_back
+                decode = t_end - ft
+            else:
+                # no first-token mark (simple backends, or a failure
+                # before one): the whole call is prefill — first token
+                # and last token arrive together
+                prefill = t_end - t_back
+            if outcome == "served":
+                ttft = queue_wait + dispatch + prefill
+        total = queue_wait + dispatch + prefill + decode
+        # ``wall`` is the independently measured accept->last-token
+        # wall-clock (one monotonic interval, stamped adjacent to t_end
+        # by the caller): the >=95% phase-coverage acceptance is checked
+        # against THIS, not against the phase sum itself — a regression
+        # that drops or mis-measures a phase moves total in lockstep
+        # but cannot move wall
+        if ttft is not None:
+            telemetry.hist("serve.ttft", ttft)
+        if decode > 0 and ntok > 1:
+            telemetry.hist("serve.decode_per_token", decode / (ntok - 1))
+        tps = None
+        gen = prefill + decode
+        if outcome == "served" and ntok and gen > 0:
+            tps = ntok / gen
+            telemetry.gauge("serve.tokens_per_second", round(tps, 3))
+            telemetry.count("serve.tokens", ntok)
+        rec = {"id": req.id, "outcome": outcome,
+               "tokens_in": len(req.toks), "tokens_out": ntok,
+               "t_wall": round(req.t_wall, 6),
+               "total_s": round(total, 6),
+               "wall_s": round(wall, 6),
+               "ttft_s": round(ttft, 6) if ttft is not None else None,
+               "tokens_per_s": round(tps, 3) if tps is not None else None,
+               "phases": {"queue_wait": round(queue_wait, 6),
+                          "dispatch": round(dispatch, 6),
+                          "prefill": round(prefill, 6),
+                          "decode": round(decode, 6)},
+               "recompiles": list(tc.compiles) if tc is not None else []}
+        if tc is not None and tc.counts:
+            rec["counts"] = dict(tc.counts)
+        self.flight.record(rec)
+        ev = {"ev": "serve_request_done", "req": req.id,
+              "outcome": outcome, "tokens": ntok,
+              "total_s": rec["total_s"],
+              "recompiles": len(rec["recompiles"])}
+        for ph, v in rec["phases"].items():
+            ev[ph + "_s"] = v
+        if not dispatched:
+            # the flight record's zeros are honest (phases tile the
+            # wall-clock), but the report's phase percentiles aggregate
+            # these events: a deadline/shed request never HAD a prefill
+            # or decode, and hard zeros would deflate the latency table
+            # exactly during the overload it triages — null, like ttft
+            ev["prefill_s"] = ev["decode_s"] = None
+        if ttft is not None:
+            ev["ttft_s"] = rec["ttft_s"]
+        telemetry.event(ev)
+        if self.slo is not None:
+            self.slo.observe(ok=(outcome == "served"), ttft_s=ttft,
+                             latency_s=total)
 
     # -- TCP listener --------------------------------------------------
     def _accept_run(self) -> None:
@@ -820,8 +1015,16 @@ class ServeFrontend:
         for req in leftovers:
             # budget exhausted: still exactly one response per accepted
             # request — an explicit ERR beats a silent dropped socket
-            self._finish(req, "ERR draining shutdown budget exhausted",
-                         "errors")
+            if self._finish(req, "ERR draining shutdown budget "
+                            "exhausted", "errors") \
+                    and self.slo is not None:
+                # an accepted request the client lost burns error
+                # budget like an admission shed — a preemption that
+                # drains a full queue as ERR draining must not leave
+                # cxxnet_slo_burn reading 0 in the final snapshot (the
+                # wedged in-flight case is covered by the worker's
+                # "abandoned" observation when the backend returns)
+                self.slo.observe(ok=False)
         if self._worker_thread is not None:
             self._worker_thread.join(
                 timeout=max(0.5, deadline - time.monotonic() + 1.0))
@@ -879,6 +1082,11 @@ def selftest(verbose: bool = False) -> int:
     def backend(toks, seq):
         if boom["on"]:
             raise RuntimeError("injected backend failure")
+        if toks and toks[0] == 42:
+            # a real (ms-scale) duration: the phase-coverage assertion
+            # compares against an independently stamped wall-clock, and
+            # on a µs echo request scheduler noise would dominate
+            time.sleep(0.025)
         return [t + 1 for t in toks]
 
     fe = ServeFrontend(backend, queue_size=4, breaker_fails=2,
@@ -916,19 +1124,69 @@ def selftest(verbose: bool = False) -> int:
         assert reloads, "reload_fn never ran"
         assert _ask(port, "ADMIN stats").startswith("OK accepted=")
         assert _ask(port, "ADMIN bogus").startswith("ERR parse")
+        # request tracing: every dequeued request left a flight record
+        # whose phases tile its wall-clock (the /trace?request= source);
+        # token 42 makes this one slow enough for robust coverage math
+        assert _ask(port, "42") == "43"
+        recs = fe.flight.list()
+        assert recs, "flight recorder empty after served requests"
+        rec = next(r for r in recs if r["outcome"] == "served")
+        assert set(rec["phases"]) == set(telemetry.REQUEST_PHASES)
+        # coverage is judged against the independently measured
+        # accept->observe wall-clock, NOT the phase sum (total_s is the
+        # sum by construction — checking against it proves nothing)
+        cover = sum(rec["phases"].values())
+        assert rec["wall_s"] > 0 and cover >= 0.95 * rec["wall_s"], \
+            "phases cover %.0f%% of the request wall-clock" \
+            % (100 * cover / rec["wall_s"])
+        assert rec["ttft_s"] is not None \
+            and rec["ttft_s"] <= rec["total_s"] + 1e-9
+        assert fe.flight.get(rec["id"])["id"] == rec["id"]
+        ct = telemetry.request_chrome_trace(rec)
+        assert any(t.get("name") == "prefill"
+                   for t in ct["traceEvents"])
+        # outcomes attributed: the exploded requests are in the ring too
+        assert any(r["outcome"] == "backend_error" for r in recs)
     finally:
         stats = fe.drain()
     assert stats["accepted"] == (stats["served"] + stats["errors"]
                                  + stats["shed"] + stats["deadline"]), \
         "serve counters do not reconcile: %r" % (stats,)
-    assert stats["served"] == 4 and stats["shed"] == 1
+    assert stats["served"] == 5 and stats["shed"] == 1
     assert stats["deadline"] == 1 and stats["empty"] == 1
     assert fe.health_probe() == (False,
                                  "draining: not accepting new requests")
     assert fe.liveness_probe()[0]
+
+    # SLO error budget: a healthy run keeps the burn gauge 0; a flood of
+    # objective-violating requests flips it
+    slo = statusd.SLOTracker(ttft_ms=30.0, availability=0.999,
+                             min_requests=4, window_s=60.0)
+    fe2 = ServeFrontend(lambda toks, seq: list(toks), slo=slo,
+                        drain_ms=2000.0)
+    fe2.start()
+    port2 = fe2.listen(0)
+    try:
+        for _ in range(4):
+            assert _ask(port2, "1 2") == "1 2"
+        assert slo.snapshot()["alert"] == 0, "healthy run burned budget"
+
+        def slow(toks, seq):
+            time.sleep(0.05)             # >> the 30ms TTFT objective
+            return list(toks)
+
+        fe2.backend = slow
+        for _ in range(4):
+            _ask(port2, "3")
+        snap = slo.snapshot()
+        assert snap["alert"] == 1 and snap["burn_rate"] >= 1.0, snap
+        assert snap["by_reason"].get("ttft", 0) >= 4, snap
+    finally:
+        fe2.drain()
     if verbose:
-        print("servd selftest: admission/deadline/breaker/reload/drain ok "
-              "(%r)" % (stats,))
+        print("servd selftest: admission/deadline/breaker/reload/drain + "
+              "request tracing (phases/TTFT/flight recorder) + SLO burn "
+              "flip ok (%r)" % (stats,))
     return 0
 
 

@@ -34,7 +34,24 @@ Endpoints:
   device-memory gauges, counters, health detail.
 * ``/trace`` — a Chrome-trace JSON snapshot of the recent-event ring
   buffer (load in chrome://tracing or ui.perfetto.dev) — the last ~4096
-  events of a LIVE run, no log file needed.
+  events of a LIVE run, no log file needed. With a flight recorder
+  registered (the serving frontend's per-request ring),
+  ``/trace?request=<id>`` instead returns ONE request's phase-attributed
+  Chrome trace (queue_wait / dispatch / prefill / decode + the
+  recompiles it paid) — open a single slow request in Perfetto.
+* ``/requestz`` — the flight recorder's ring as JSON, newest first:
+  request id, outcome, phase split, TTFT, tokens — the index you grab a
+  ``/trace?request=<id>`` id from.
+
+Serving SLOs: an ``SLOTracker`` (objectives ``slo_ttft_ms`` /
+``slo_p99_ms`` / ``slo_availability`` over a rolling window) turns each
+completed request into an error-budget account: a request that errored
+or blew a latency objective burns budget, and the burn RATE —
+bad_fraction / (1 - availability) — is exported as
+``cxxnet_slo_burn_rate`` with the alert gauge ``cxxnet_slo_burn``
+flipping to 1 while the budget burns faster than 1x sustainable
+(rendered on ``/statusz``, transition events in the telemetry log for
+tools/telemetry_report.py's exit-2 gate).
 
 The server binds in ``start()`` (so ``status_port=0`` resolves to a real
 port before the run begins), serves each request on its own thread
@@ -58,16 +75,19 @@ import re
 import sys
 import threading
 import time
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs
 
 from . import health as health_mod
 from . import telemetry
 
 __all__ = [
-    "StatusServer", "start", "stop", "active", "set_run_info",
-    "update_progress", "register_probe", "wire_health",
-    "prometheus_metrics", "PROM_LINE_RE", "selftest",
+    "StatusServer", "SLOTracker", "start", "stop", "active",
+    "set_run_info", "update_progress", "register_probe", "wire_health",
+    "set_flight_recorder", "set_slo", "prometheus_metrics",
+    "PROM_LINE_RE", "selftest",
 ]
 
 _NAME_SAN = re.compile(r"[^a-zA-Z0-9_]")
@@ -100,10 +120,147 @@ def _num(v) -> bool:
     return isinstance(v, (int, float)) and not isinstance(v, bool)
 
 
+# the shared empty-series-sentinel renderer (None -> "n/a")
+_ms = telemetry.fmt_ms
+
+
+class SLOTracker:
+    """Rolling-window serving SLO / error-budget tracker.
+
+    Objectives (0 disables a latency objective):
+
+    * ``ttft_ms`` — a request whose time-to-first-token (accept ->
+      first token) exceeds this is an SLO violation;
+    * ``p99_ms`` — same for end-to-end latency;
+    * ``availability`` — the SLO target fraction of GOOD requests
+      (default 0.999). Its complement is the **error budget**: the
+      fraction of requests allowed to be bad while still meeting SLO.
+
+    Every completed request is ``observe()``d: a request that errored
+    (``ok=False``) or blew any latency objective is *bad*. Over the
+    rolling ``window_s`` the tracker computes ``bad_fraction`` and the
+    **burn rate** = bad_fraction / (1 - availability) — the classic
+    error-budget form: 1x means bad requests arrive exactly as fast as
+    the budget allows; 10x means the month's budget is gone in 3 days.
+    The ``alert`` flag (exported as the ``cxxnet_slo_burn`` gauge, and
+    as ``slo_burn`` transition events in the telemetry stream) flips to
+    1 while burn_rate >= 1 with at least ``min_requests`` in the window
+    — the floor keeps one unlucky request over an empty window from
+    paging.
+
+    Thread-safe and jax-free; the serving frontend calls ``observe``
+    from its worker thread, /metrics and /statusz read ``snapshot()``.
+    """
+
+    def __init__(self, ttft_ms: float = 0.0, p99_ms: float = 0.0,
+                 availability: float = 0.999, window_s: float = 300.0,
+                 min_requests: int = 10, min_bad: int = 3,
+                 clock=time.monotonic):
+        self.ttft_ms = float(ttft_ms)
+        self.p99_ms = float(p99_ms)
+        self.availability = float(availability)
+        # availability=1 would make every bad request an instant page
+        # AND divide by zero: floor the budget at one-in-a-million
+        self.budget = max(1.0 - self.availability, 1e-6)
+        self.window_s = float(window_s)
+        self.min_requests = max(1, int(min_requests))
+        # with a tight budget (0.999 -> 0.1%) ONE error among 10
+        # requests already reads as 100x burn: require a minimum count
+        # of bad requests before paging, so a single recovered hiccup
+        # in a busy window can't flip the gauge (and fail the report's
+        # exit-2 gate) — the breaker analog needs 5 consecutive fails
+        self.min_bad = max(1, int(min_bad))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._win: deque = deque()     # (t, violation reason or None)
+        # incremental violation counts — observe()/scrape run on the
+        # serving accept/worker threads under the lock, so the window
+        # (QPS x window_s entries under sustained load) must never be
+        # rescanned per request: append/evict keep these current
+        self._by_reason: Dict[str, int] = {}
+        self.alert = 0
+        self.flips = 0
+
+    def observe(self, ok: bool = True, ttft_s: Optional[float] = None,
+                latency_s: Optional[float] = None) -> dict:
+        """Account one completed request; returns the fresh snapshot."""
+        reason = None
+        if not ok:
+            reason = "error"
+        elif (self.ttft_ms > 0 and ttft_s is not None
+                and ttft_s * 1e3 > self.ttft_ms):
+            reason = "ttft"
+        elif (self.p99_ms > 0 and latency_s is not None
+                and latency_s * 1e3 > self.p99_ms):
+            reason = "latency"
+        with self._lock:
+            self._win.append((self._clock(), reason))
+            if reason is not None:
+                self._by_reason[reason] = \
+                    self._by_reason.get(reason, 0) + 1
+        return self._update()
+
+    def snapshot(self) -> dict:
+        """The current window's accounting (evicts aged-out requests
+        first, so a scrape long after the last request reads the live
+        truth, not a stale burn)."""
+        return self._update()
+
+    def _update(self) -> dict:
+        now = self._clock()
+        with self._lock:
+            while self._win and self._win[0][0] < now - self.window_s:
+                _, evicted = self._win.popleft()
+                if evicted is not None:
+                    left = self._by_reason[evicted] - 1
+                    if left:
+                        self._by_reason[evicted] = left
+                    else:
+                        del self._by_reason[evicted]
+            n = len(self._win)
+            by_reason = dict(self._by_reason)
+            bad = sum(by_reason.values())
+            bad_fraction = bad / float(n) if n else 0.0
+            burn_rate = bad_fraction / self.budget
+            if n >= self.min_requests:
+                alert = 1 if (burn_rate >= 1.0
+                              and bad >= self.min_bad) else 0
+            else:
+                # too few requests in the window to judge either way:
+                # HOLD the previous state. Clearing here would let a
+                # zero-traffic scrape age the flood out of the window
+                # and log a state-0 transition with no recovery
+                # evidence — the report's end-of-log exit-2 gate would
+                # then depend on scrape timing (the breaker analog:
+                # open until a successful probe, not until silence)
+                alert = self.alert
+            flipped = alert != self.alert
+            self.alert = alert
+            if flipped:
+                self.flips += 1
+                # transition events, not per-request spam: the telemetry
+                # log's last slo_burn state is the report's exit-2 gate,
+                # so emit under the lock — two racing flips must land in
+                # the log in the order the state machine took them
+                telemetry.count("slo.burn_flips")
+                telemetry.event({"ev": "slo_burn", "state": alert,
+                                 "burn_rate": round(burn_rate, 4),
+                                 "bad": bad, "window": n})
+        return {"objectives": {"ttft_ms": self.ttft_ms,
+                               "p99_ms": self.p99_ms,
+                               "availability": self.availability},
+                "window_s": self.window_s, "requests": n, "bad": bad,
+                "by_reason": by_reason,
+                "bad_fraction": round(bad_fraction, 6),
+                "budget": round(self.budget, 6),
+                "burn_rate": round(burn_rate, 4), "alert": alert}
+
+
 def prometheus_metrics(snapshot: dict, progress: Optional[dict] = None,
                        health_failures: Optional[list] = None,
                        channels: Optional[list] = None,
-                       live_failures: Optional[list] = None) -> str:
+                       live_failures: Optional[list] = None,
+                       slo: Optional[dict] = None) -> str:
     """Render a ``telemetry.metrics_snapshot()`` as Prometheus text
     exposition format 0.0.4. Pure function of its inputs — the selftest
     and tests validate its output without a socket. ``channels`` is the
@@ -145,6 +302,20 @@ def prometheus_metrics(snapshot: dict, progress: Optional[dict] = None,
     if live_failures is not None:
         emit("cxxnet_live", "gauge", 0 if live_failures else 1,
              help_="1 when /livez (liveness) returns 200")
+    if slo is not None:
+        # the serving SLO account (SLOTracker.snapshot()): the alert
+        # gauge first — cxxnet_slo_burn is the series alert rules watch
+        emit("cxxnet_slo_burn", "gauge", int(slo.get("alert", 0)),
+             help_="1 while the rolling-window error-budget burn rate "
+                   "is >= 1x (SLO burning)")
+        emit("cxxnet_slo_burn_rate", "gauge",
+             float(slo.get("burn_rate", 0.0)),
+             help_="bad_fraction / (1 - slo_availability) over the "
+                   "rolling window")
+        emit("cxxnet_slo_bad_fraction", "gauge",
+             float(slo.get("bad_fraction", 0.0)))
+        emit("cxxnet_slo_window_requests", "gauge",
+             int(slo.get("requests", 0)))
     if channels is None:
         channels = health_mod.channel_status()
     if channels:
@@ -207,7 +378,7 @@ class _Endpoint(BaseHTTPRequestHandler):
 
     def do_GET(self):   # noqa: N802 (BaseHTTPRequestHandler contract)
         srv = self.server.statusd
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         try:
             if path == "/metrics":
                 self._reply(200, "text/plain; version=0.0.4; charset=utf-8",
@@ -235,14 +406,42 @@ class _Endpoint(BaseHTTPRequestHandler):
                 self._reply(200, "text/html; charset=utf-8",
                             srv.statusz_html().encode("utf-8"))
             elif path == "/trace":
-                trace = telemetry.events_to_chrome(
-                    srv.registry.recent_events())
+                # keep_blank_values: "?request=" with an empty id must
+                # 404 like any other unknown id, not silently fall
+                # through to the whole-ring event trace
+                rid = (parse_qs(query, keep_blank_values=True)
+                       .get("request") or [None])[0]
+                if rid is not None:
+                    # one request's flight record as a Chrome trace
+                    fr = srv.flight
+                    rec = fr.get(rid) if fr is not None else None
+                    if rec is None:
+                        detail = ("no flight record for request %r"
+                                  % rid) if fr is not None else \
+                            "no flight recorder registered (serving off?)"
+                        self._reply(404, "text/plain; charset=utf-8",
+                                    (detail + "; see /requestz\n")
+                                    .encode("utf-8"))
+                    else:
+                        self._reply(
+                            200, "application/json",
+                            json.dumps(telemetry.request_chrome_trace(
+                                rec)).encode("utf-8"))
+                else:
+                    trace = telemetry.events_to_chrome(
+                        srv.registry.recent_events())
+                    self._reply(200, "application/json",
+                                json.dumps(trace).encode("utf-8"))
+            elif path == "/requestz":
+                fr = srv.flight
+                body = {"requests": fr.list() if fr is not None else [],
+                        "capacity": fr.cap if fr is not None else 0}
                 self._reply(200, "application/json",
-                            json.dumps(trace).encode("utf-8"))
+                            json.dumps(body).encode("utf-8"))
             else:
                 self._reply(404, "text/plain; charset=utf-8",
                             b"not found; endpoints: /metrics /healthz "
-                            b"/livez /statusz /trace\n")
+                            b"/livez /statusz /trace /requestz\n")
         except Exception as e:    # a broken probe must not kill the server
             try:
                 self._reply(500, "text/plain; charset=utf-8",
@@ -262,6 +461,11 @@ class StatusServer:
         self.registry = registry if registry is not None else telemetry._REG
         self.run_info: Dict[str, object] = {}
         self.progress: Dict[str, object] = {}
+        # serving wiring (set_flight_recorder / set_slo): the per-request
+        # flight ring behind /trace?request= and /requestz, and the SLO
+        # tracker behind the cxxnet_slo_* gauges and the /statusz section
+        self.flight: Optional[telemetry.FlightRecorder] = None
+        self.slo: Optional[SLOTracker] = None
         # (name, probe_fn, liveness): see register_probe
         self.probes: List[Tuple[str, Callable[[], Tuple[bool, str]],
                                 bool]] = []
@@ -374,7 +578,8 @@ class StatusServer:
             progress=dict(self.progress),
             health_failures=ready,
             channels=channels,
-            live_failures=live)
+            live_failures=live,
+            slo=self.slo.snapshot() if self.slo is not None else None)
 
     def statusz_html(self) -> str:
         reg = self.registry
@@ -414,6 +619,37 @@ class StatusServer:
                          % (age, timeout, " OVERDUE" if overdue else "")))
         table("health", rows)
 
+        if self.slo is not None:
+            sn = self.slo.snapshot()
+            obj = sn["objectives"]
+            objs = []
+            if obj["ttft_ms"] > 0:
+                objs.append("ttft<=%gms" % obj["ttft_ms"])
+            if obj["p99_ms"] > 0:
+                objs.append("latency<=%gms" % obj["p99_ms"])
+            objs.append("availability>=%g" % obj["availability"])
+            reasons = " ".join("%s=%d" % kv
+                               for kv in sorted(sn["by_reason"].items()))
+            table("slo", [
+                ("objectives", "  ".join(objs)),
+                ("window", "%.0fs: %d requests, %d bad%s"
+                 % (sn["window_s"], sn["requests"], sn["bad"],
+                    ("  (" + reasons + ")") if reasons else "")),
+                ("error budget", "%.4f%% of requests may be bad"
+                 % (100 * sn["budget"])),
+                ("burn rate", "%.2fx%s" % (sn["burn_rate"],
+                                           "  BURNING" if sn["alert"]
+                                           else ""))])
+        if self.flight is not None and len(self.flight):
+            latest = self.flight.list()[0]
+            table("requests", [
+                ("flight recorder", "%d of last %d requests recorded"
+                 % (len(self.flight), self.flight.cap)),
+                ("latest", "id=%s outcome=%s total=%s"
+                 % (latest.get("id"), latest.get("outcome"),
+                    _ms(None if latest.get("total_s") is None
+                        else latest["total_s"] * 1e3)))])
+
         ck = reg.last_event("ckpt_save")
         if ck is not None and "ts" in ck:
             table("checkpoint", [
@@ -424,9 +660,11 @@ class StatusServer:
         hist_rows = []
         for name, a in sorted(s.get("hists", {}).items(),
                               key=lambda kv: -kv[1]["sum_s"]):
-            hist_rows.append((name, "n=%d p50=%.2fms p90=%.2fms p99=%.2fms"
-                              % (a["count"], a["p50_ms"], a["p90_ms"],
-                                 a["p99_ms"])))
+            # a declared-but-never-fired series (TTFT before the first
+            # request) renders "n/a", not a 0.00ms lie
+            hist_rows.append((name, "n=%d p50=%s p90=%s p99=%s"
+                              % (a["count"], _ms(a["p50_ms"]),
+                                 _ms(a["p90_ms"]), _ms(a["p99_ms"]))))
         table("latency histograms", hist_rows)
 
         comp = s.get("compiles", {})
@@ -446,7 +684,8 @@ class StatusServer:
             parts.append("</pre></details>")
         parts.append("<p>endpoints: <a href='/metrics'>/metrics</a> "
                      "<a href='/healthz'>/healthz</a> "
-                     "<a href='/trace'>/trace</a></p></body></html>")
+                     "<a href='/trace'>/trace</a> "
+                     "<a href='/requestz'>/requestz</a></p></body></html>")
         return "\n".join(parts)
 
 
@@ -499,6 +738,22 @@ def wire_health(recovery=None) -> None:
         s.wire_health(recovery)
 
 
+def set_flight_recorder(fr) -> None:
+    """Attach a telemetry.FlightRecorder — /trace?request=<id> and
+    /requestz serve from it. No-op without a running server."""
+    s = _SERVER
+    if s is not None:
+        s.flight = fr
+
+
+def set_slo(tracker: Optional[SLOTracker]) -> None:
+    """Attach an SLOTracker — /metrics exports its cxxnet_slo_* gauges
+    and /statusz renders the budget account. No-op without a server."""
+    s = _SERVER
+    if s is not None:
+        s.slo = tracker
+
+
 # ----------------------------------------------------------------------
 def selftest(verbose: bool = False) -> int:
     """Serve on port 0, scrape every endpoint over a real socket,
@@ -514,8 +769,17 @@ def selftest(verbose: bool = False) -> int:
     reg.count("selftest.requests", 3)
     reg.gauge("selftest.level", 7)
     reg.hist("selftest.latency", 0.012)
+    reg.declare_hist("selftest.never_fired")   # -> "n/a", empty buckets
 
     srv = StatusServer(0, host="127.0.0.1", registry=reg).start()
+    srv.slo = SLOTracker(ttft_ms=50.0, availability=0.999,
+                         min_requests=3, window_s=60.0)
+    srv.flight = telemetry.FlightRecorder(cap=8)
+    srv.flight.record({"id": "7", "outcome": "served", "tokens_in": 4,
+                       "tokens_out": 8, "total_s": 0.061, "ttft_s": 0.02,
+                       "phases": {"queue_wait": 0.001, "dispatch": 0.0005,
+                                  "prefill": 0.02, "decode": 0.04},
+                       "recompiles": []})
     try:
         base = "http://127.0.0.1:%d" % srv.port
 
@@ -528,6 +792,30 @@ def selftest(verbose: bool = False) -> int:
         assert "cxxnet_selftest_requests_total" in metrics
         assert 'cxxnet_selftest_step_seconds_bucket' in metrics
         assert 'le="+Inf"' in metrics
+        # a declared-but-empty series still exports (zeroed) buckets
+        assert "cxxnet_selftest_never_fired_seconds_bucket" in metrics
+        # the SLO account: healthy window -> burn gauge 0
+        assert 'cxxnet_slo_burn{process="0"} 0' in metrics
+        assert "cxxnet_slo_burn_rate" in metrics
+
+        # per-request flight recorder: listable + one request's trace
+        reqz = json.loads(urlopen(base + "/requestz", timeout=5).read())
+        assert reqz["requests"] and reqz["requests"][0]["id"] == "7"
+        rtrace = json.loads(urlopen(
+            base + "/trace?request=7", timeout=5).read())
+        names = [t["name"] for t in rtrace["traceEvents"]
+                 if t.get("ph") == "X"]
+        assert names == ["queue_wait", "dispatch", "prefill", "decode"]
+        try:
+            urlopen(base + "/trace?request=nope", timeout=5)
+            raise AssertionError("unknown request id should 404")
+        except HTTPError as e:
+            assert e.code == 404
+        # SLO burn flips under a flood of objective-violating requests
+        for _ in range(5):
+            srv.slo.observe(ok=True, ttft_s=0.5)     # >> 50ms objective
+        m2 = urlopen(base + "/metrics", timeout=5).read().decode()
+        assert 'cxxnet_slo_burn{process="0"} 1' in m2
 
         assert urlopen(base + "/healthz", timeout=5).status == 200
         assert urlopen(base + "/livez", timeout=5).status == 200
@@ -555,6 +843,9 @@ def selftest(verbose: bool = False) -> int:
 
         page = urlopen(base + "/statusz", timeout=5).read().decode()
         assert "statusz" in page and "selftest.requests" in page
+        # never-fired series renders n/a, not 0.00ms; SLO section shows
+        assert "selftest.never_fired" in page and "n/a" in page
+        assert "burn rate" in page and "BURNING" in page
         trace = json.loads(urlopen(base + "/trace", timeout=5).read())
         assert any(t.get("ph") == "X" for t in trace["traceEvents"])
 
@@ -568,8 +859,9 @@ def selftest(verbose: bool = False) -> int:
         reg.disable()
     if verbose:
         print("statusd selftest: /metrics /healthz /livez /statusz "
-              "/trace ok (Prometheus format valid, readiness vs liveness "
-              "flips, 404)")
+              "/trace /requestz ok (Prometheus format valid, readiness "
+              "vs liveness flips, per-request trace, SLO burn flip, "
+              "empty-series n/a, 404)")
     return 0
 
 

@@ -37,8 +37,10 @@ Sinks:
   attached to bench.py's emitted JSON.
 
 Disabled (the default) the module is near-zero overhead: ``span()`` returns
-a shared no-op context manager (no allocation), counters are one branch,
-and no events are ever buffered. Everything is process-global by design —
+a shared no-op context manager (no allocation), counters are one
+thread-local read plus a branch (the read keeps per-request attribution
+working inside a trace context even when disabled), and no events are
+ever buffered. Everything is process-global by design —
 one training job per process (the Trainer model), one telemetry stream.
 
 Multihost runs get one stream PER PROCESS: ``enable(path, process_index=i)``
@@ -46,6 +48,25 @@ substitutes a ``%d`` rank placeholder in the log path (so shards never
 clobber each other), tags every event with ``"p": i``, and
 ``tools/telemetry_report.py --merge shard*.jsonl`` re-aligns the shards on
 the shared wall-clock epoch for one cross-host report.
+
+Request attribution (the serving datapath's measurement contract):
+
+* **trace contexts** — ``with telemetry.trace_context(request_id) as tc:``
+  tags every span/event recorded on the same thread underneath it with
+  ``"req": request_id``, and accumulates per-request counter deltas and
+  recompile events on ``tc`` itself — so one served request's telemetry
+  can be pulled apart from everything around it. ``telemetry.mark(name)``
+  timestamps a named boundary on the active context (the trainer marks
+  ``first_token`` at the prefill/decode split — the TTFT boundary).
+  Contexts are thread-local and work even with telemetry DISABLED (the
+  marks/attribution still flow; only event emission is gated), because
+  the serving SLO layer needs TTFT regardless of whether a JSONL log was
+  configured.
+* **flight recorder** — ``FlightRecorder`` keeps a bounded ring of the
+  last N completed request traces (phase split, token counts, outcome,
+  recompiles); statusd serves one as a Chrome trace at
+  ``/trace?request=<id>`` (``request_chrome_trace``) and lists the ring
+  at ``/requestz``.
 """
 
 from __future__ import annotations
@@ -66,7 +87,9 @@ __all__ = [
     "flush", "finish", "summary", "brief_summary", "events",
     "recent_events", "last_event", "span_event", "percentile", "count_by",
     "chrome_trace", "events_to_chrome", "write_chrome_trace",
-    "Histogram", "HIST_BUCKETS",
+    "Histogram", "HIST_BUCKETS", "trace_context", "current_trace", "mark",
+    "declare_hist", "TraceContext", "FlightRecorder",
+    "request_chrome_trace", "REQUEST_PHASES",
 ]
 
 # per-span-name duration history kept for live percentiles (the JSONL log
@@ -84,6 +107,14 @@ _RING_CAP = 4096
 # cross-process/shard merging is then exact bucket-count addition (the
 # property Prometheus `le` buckets and telemetry_report --merge rely on).
 HIST_BUCKETS = tuple(round(10.0 ** (e / 4.0), 10) for e in range(-24, 13))
+
+
+def fmt_ms(v) -> str:
+    """Render a millisecond figure, turning the empty-series sentinel
+    (None — ``Histogram`` on zero observations) into "n/a". The ONE
+    renderer of the sentinel, shared by /statusz and the report tools
+    so the format cannot drift between them."""
+    return "n/a" if v is None else "%.2fms" % v
 
 
 class Histogram:
@@ -113,9 +144,15 @@ class Histogram:
         overflow slot are CLAMPED to the last bound (1000s): the result
         must stay finite (strict-JSON logs, bench lines), so a tail past
         1000s reads as exactly 1000s — the overflow bucket's count is
-        the tell."""
+        the tell.
+
+        An EMPTY histogram returns None (never NaN, never a fake 0.0):
+        a series that was declared but never fired — TTFT on a run that
+        served zero requests — has no percentiles, and 0.0ms would read
+        as an impossibly fast tail on /statusz and in bench lines. The
+        renderers turn None into "n/a"; JSON sinks carry it as null."""
         if self.n == 0:
-            return 0.0
+            return None
         rank = (p / 100.0) * self.n
         cum = 0
         for i, c in enumerate(self.counts):
@@ -156,6 +193,11 @@ class Histogram:
         return self
 
     def stats(self) -> dict:
+        """Summary dict; the percentile fields are None (rendered "n/a",
+        serialized null) when the histogram never observed anything."""
+        if self.n == 0:
+            return {"count": 0, "sum_s": 0.0,
+                    "p50_ms": None, "p90_ms": None, "p99_ms": None}
         return {"count": self.n, "sum_s": round(self.sum, 6),
                 "p50_ms": round(1e3 * self.percentile(50), 4),
                 "p90_ms": round(1e3 * self.percentile(90), 4),
@@ -200,6 +242,44 @@ class _Span:
         self.reg._record_span(self.name, self.t0, dur, self.depth,
                               self.attrs)
         return False
+
+
+class TraceContext:
+    """One request's attribution scope (``with trace_context(rid):``).
+
+    While active on a thread, every span/event that thread records is
+    tagged ``"req": request_id``, counter deltas are mirrored into
+    ``self.counts``, recompile events into ``self.compiles``, and
+    ``mark(name)`` timestamps named boundaries into ``self.marks``
+    (perf_counter stamps — the serving worker turns the trainer's
+    ``first_token`` mark into TTFT). Contexts nest (innermost wins) and
+    deliberately work with telemetry DISABLED: attribution costs a
+    thread-local read, and the SLO layer needs the marks whether or not
+    a JSONL sink exists."""
+
+    __slots__ = ("reg", "request_id", "marks", "counts", "compiles", "t0")
+
+    def __init__(self, reg: "_Registry", request_id):
+        self.reg = reg
+        self.request_id = str(request_id)
+        self.marks: Dict[str, float] = {}
+        self.counts: Dict[str, float] = {}
+        self.compiles: List[dict] = []
+        self.t0: Optional[float] = None
+
+    def __enter__(self) -> "TraceContext":
+        self.t0 = time.perf_counter()
+        self.reg._ctx_stack().append(self)
+        return self
+
+    def __exit__(self, *exc):
+        stack = self.reg._ctx_stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        return False
+
+    def mark(self, name: str) -> None:
+        self.marks[name] = time.perf_counter()
 
 
 class _Registry:
@@ -288,6 +368,29 @@ class _Registry:
             s = self._tls.stack = []
         return s
 
+    def _ctx_stack(self) -> list:
+        s = getattr(self._tls, "ctx", None)
+        if s is None:
+            s = self._tls.ctx = []
+        return s
+
+    def trace_context(self, request_id) -> TraceContext:
+        return TraceContext(self, request_id)
+
+    def current_trace(self) -> Optional[TraceContext]:
+        s = getattr(self._tls, "ctx", None)
+        return s[-1] if s else None
+
+    def mark(self, name: str) -> None:
+        """Timestamp a named boundary on this thread's active trace
+        context (no-op without one); with telemetry enabled the boundary
+        is also recorded as a ``mark`` event in the stream."""
+        tc = self.current_trace()
+        if tc is not None:
+            tc.mark(name)
+        if self.enabled:
+            self.record({"ev": "mark", "name": name})
+
     def _ts(self, t_perf: float) -> float:
         return t_perf - self.t0_perf
 
@@ -305,6 +408,13 @@ class _Registry:
         # an enabled-without-log run (bench mode) cannot leak per-step
         if "p" not in ev:
             ev["p"] = self.process_index
+        if "req" not in ev:
+            # request attribution: the recording thread's active trace
+            # context tags the event (thread-local read — safe under the
+            # registry lock, never contended)
+            tc = self.current_trace()
+            if tc is not None:
+                ev["req"] = tc.request_id
         self._pending.append(ev)
         self._recent.append(ev)
         self.last_by_kind[ev.get("ev", "?")] = ev
@@ -353,6 +463,11 @@ class _Registry:
             h.observe(dur)
 
     def count(self, name: str, n=1) -> None:
+        tc = self.current_trace()
+        if tc is not None:
+            # per-request attribution rides the thread-local context even
+            # with telemetry disabled (the flight recorder's counter view)
+            tc.counts[name] = tc.counts.get(name, 0) + n
         if not self.enabled:
             return
         with self._lock:
@@ -370,6 +485,17 @@ class _Registry:
                 h = self.hists[name] = Histogram()
             h.observe(value)
 
+    def declare_hist(self, name: str) -> None:
+        """Register a histogram series with zero observations, so
+        /metrics exports its (empty) bucket series from scrape one and
+        /statusz shows it as "n/a" — a dashboard watching serve_ttft
+        must see the series exist BEFORE the first request, not appear
+        mid-run."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self.hists.setdefault(name, Histogram())
+
     def gauge(self, name: str, value) -> None:
         if not self.enabled:
             return
@@ -381,6 +507,19 @@ class _Registry:
 
     def record_compile(self, name: str, cause: str, seconds: float,
                        key=None) -> None:
+        tc = self.current_trace()
+        if tc is not None:
+            # attribute the compile to the request that paid the cliff;
+            # "off" = compile start relative to the context entry (the
+            # backend call), so the trace export draws the bar inside
+            # the phase that actually paid it — a fresh decode-program
+            # compile runs in the decode phase, not prefill
+            entry = {"name": name, "cause": cause,
+                     "dur": round(seconds, 6)}
+            if tc.t0 is not None:
+                entry["off"] = round(
+                    time.perf_counter() - seconds - tc.t0, 6)
+            tc.compiles.append(entry)
         if not self.enabled:
             return
         ev = {"ev": "compile", "name": name, "cause": cause,
@@ -622,6 +761,117 @@ def events_to_chrome(evs: List[dict]) -> dict:
     return {"traceEvents": trace, "displayTimeUnit": "ms"}
 
 
+# the canonical request-phase order (doc/observability.md glossary):
+# queue_wait (accept -> worker pop), dispatch (pop -> backend call),
+# prefill (backend call -> first token: TTFT's server-side share),
+# decode (first token -> last token). The phases TILE the request's
+# wall-clock — their sum is the request's total by construction.
+REQUEST_PHASES = ("queue_wait", "dispatch", "prefill", "decode")
+
+
+class FlightRecorder:
+    """Bounded ring of the last N completed request traces — the
+    per-request black box the serving frontend fills and statusd serves
+    (``/trace?request=<id>`` as a Chrome trace, ``/requestz`` as a
+    list). A record is one plain dict::
+
+        {"id": "17", "outcome": "served", "tokens_in": 8, "tokens_out":
+         16, "t_wall": <arrival unix time>, "total_s": 0.213,
+         "ttft_s": 0.041, "tokens_per_s": 93.1,
+         "phases": {"queue_wait": .., "dispatch": .., "prefill": ..,
+                    "decode": ..},
+         "recompiles": [{"name": "jit.decode_prefill", "cause":
+                         "new_signature", "dur": 1.2}, ...],
+         "counts": {<per-request counter deltas>}}
+
+    Bounded and lock-guarded; eviction is oldest-first (deque maxlen).
+    Jax-free and registry-independent, so it works with telemetry
+    disabled — a flight record must survive a run that configured no
+    JSONL log."""
+
+    def __init__(self, cap: int = 256):
+        self.cap = max(1, int(cap))
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=self.cap)
+
+    def record(self, rec: dict) -> None:
+        with self._lock:
+            self._ring.append(rec)
+
+    def get(self, request_id) -> Optional[dict]:
+        rid = str(request_id)
+        with self._lock:
+            # newest-first: a repeated id (never in one frontend's
+            # lifetime, possible across restarts feeding one recorder)
+            # resolves to the most recent flight
+            for rec in reversed(self._ring):
+                if rec.get("id") == rid:
+                    return rec
+        return None
+
+    def list(self) -> List[dict]:
+        """Newest-first snapshot of the ring."""
+        with self._lock:
+            return list(reversed(self._ring))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+
+def request_chrome_trace(rec: dict) -> dict:
+    """One flight record -> a Chrome-trace / Perfetto JSON object: the
+    phases as back-to-back complete ('X') events on one lane (they tile
+    the request's wall-clock), recompiles on a second lane inside the
+    phase that paid them. Timestamps are µs relative to request accept,
+    so the trace opens in ui.perfetto.dev showing exactly where this
+    request's milliseconds went."""
+    rid = str(rec.get("id", "?"))
+    trace: List[dict] = [
+        {"ph": "M", "name": "process_name", "pid": 0,
+         "args": {"name": "cxxnet-request %s" % rid}},
+        {"ph": "M", "name": "thread_name", "pid": 0, "tid": 0,
+         "args": {"name": "phases"}},
+    ]
+    phases = rec.get("phases") or {}
+    t = 0.0
+    args = {"request": rid, "outcome": rec.get("outcome", "?"),
+            "tokens_in": rec.get("tokens_in", 0),
+            "tokens_out": rec.get("tokens_out", 0)}
+    for name in REQUEST_PHASES:
+        dur = float(phases.get(name, 0.0) or 0.0)
+        if dur <= 0.0:
+            continue
+        trace.append({"ph": "X", "name": name, "pid": 0, "tid": 0,
+                      "ts": round(t * 1e6, 1), "dur": round(dur * 1e6, 1),
+                      "args": args})
+        t += dur
+    comp_t0 = float(phases.get("queue_wait", 0.0) or 0.0) \
+        + float(phases.get("dispatch", 0.0) or 0.0)
+    if rec.get("recompiles"):
+        trace.append({"ph": "M", "name": "thread_name", "pid": 0,
+                      "tid": 1, "args": {"name": "recompiles"}})
+        ct = comp_t0
+        for c in rec["recompiles"]:
+            dur = float(c.get("dur", 0.0))
+            off = c.get("off")
+            # "off" places the bar where the compile actually ran
+            # (relative to the backend call = prefill start) — a fresh
+            # decode-program compile lands in the decode lane section,
+            # matching the phase accounting; records without it (older
+            # logs) fall back to stacking from prefill start
+            ts = comp_t0 + max(0.0, float(off)) if off is not None \
+                else ct
+            trace.append({"ph": "X", "name": "compile:%s"
+                          % c.get("name", "?"), "pid": 0, "tid": 1,
+                          "ts": round(ts * 1e6, 1),
+                          "dur": round(dur * 1e6, 1),
+                          "args": {"cause": c.get("cause", "?"),
+                                   "request": rid}})
+            ct = ts + dur
+    return {"traceEvents": trace, "displayTimeUnit": "ms"}
+
+
 class JitWatch:
     """Recompile detector: wraps a jitted callable and records a compile
     event whenever the wrapped jit cache grows — i.e. exactly when XLA
@@ -642,7 +892,9 @@ class JitWatch:
 
     def __call__(self, *args, **kwargs):
         reg = self._reg
-        if not reg.enabled:
+        if not reg.enabled and reg.current_trace() is None:
+            # an active trace context wants its recompiles attributed
+            # (the flight recorder works with telemetry disabled too)
             return self._fn(*args, **kwargs)
         try:
             before = self._fn._cache_size()
@@ -706,6 +958,22 @@ def gauge(name: str, value) -> None:
 
 def hist(name: str, value: float) -> None:
     _REG.hist(name, value)
+
+
+def declare_hist(name: str) -> None:
+    _REG.declare_hist(name)
+
+
+def trace_context(request_id) -> TraceContext:
+    return _REG.trace_context(request_id)
+
+
+def current_trace() -> Optional[TraceContext]:
+    return _REG.current_trace()
+
+
+def mark(name: str) -> None:
+    _REG.mark(name)
 
 
 def event(ev: dict) -> None:

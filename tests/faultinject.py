@@ -233,6 +233,27 @@ def slow_backend(base, delay_s: float):
     return backend
 
 
+def phased_backend(base, prefill_s: float, per_token_s: float):
+    """Backend that emulates the trainer's prefill/decode split without
+    jax: sleeps ``prefill_s``, marks ``first_token`` on the active trace
+    context (exactly what Trainer.generate does at its first-token
+    boundary), then sleeps ``per_token_s`` per remaining output token —
+    the TTFT-split fixture for the servd phase-attribution tests."""
+    import time
+
+    from cxxnet_tpu.utils import telemetry
+
+    def backend(toks, seq):
+        time.sleep(prefill_s)
+        telemetry.mark("first_token")
+        out = list(base(toks, seq))
+        for _ in range(max(0, len(out) - 1)):
+            time.sleep(per_token_s)
+        return out
+
+    return backend
+
+
 def exploding_backend(base=None, every: int = 1, exc: Exception = None):
     """Backend that raises on every ``every``-th call (every=1: always);
     delegates to ``base`` otherwise — the supervision fixture (the

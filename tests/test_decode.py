@@ -604,3 +604,48 @@ def test_generate_stable_across_predict_calls():
     tr.canonical_params = orig
     np.testing.assert_array_equal(first, again)
     assert not calls, "decode copy was regathered after predict()"
+
+
+def test_generate_failure_evicts_decode_programs():
+    """A generate() that fails after caching its decode programs must
+    evict them: the programs may never have compiled, and a retry that
+    believes they did would dispatch the decode scan before the
+    first-token block — charging its synchronous compile to
+    prefill/TTFT, the exact misattribution the two-program split
+    prevents (trainer except-path contract)."""
+    from cxxnet_tpu.utils import telemetry
+    tr = _trained(steps=0)
+    rs = np.random.RandomState(17)
+    prompts = rs.randint(0, VOCAB, (2, 4))
+    orig = telemetry.mark
+
+    def boom(name, **kw):
+        if name == "first_token":
+            raise RuntimeError("injected first-token failure")
+        return orig(name, **kw)
+
+    telemetry.mark = boom
+    try:
+        with np.testing.assert_raises(RuntimeError):
+            tr.generate(prompts, 5)
+    finally:
+        telemetry.mark = orig
+    assert not tr._decode_fns, "failed call left decode programs cached"
+    assert tr._decode_params is None
+    # the retry takes the fresh path end-to-end and still serves
+    out = tr.generate(prompts, 5)
+    assert out.shape == (2, 5)
+    # a WARMED signature keeps its programs through a transient
+    # failure: they are known-compiled, and evicting would charge the
+    # retry a recompile cliff for every backend hiccup
+    warmed = dict(tr._decode_fns)
+    assert warmed
+    telemetry.mark = boom
+    try:
+        with np.testing.assert_raises(RuntimeError):
+            tr.generate(prompts, 5)
+    finally:
+        telemetry.mark = orig
+    assert tr._decode_fns == warmed, "transient failure evicted warmed " \
+        "decode programs"
+    np.testing.assert_array_equal(tr.generate(prompts, 5), out)

@@ -418,5 +418,138 @@ def test_report_single_log_still_works(tmp_path, capsys):
 
 
 # ----------------------------------------------------------------------
+# empty-histogram sentinel: a declared-but-never-fired series (TTFT on a
+# run that served zero requests) renders "n/a", never NaN/0.0 garbage
+def test_empty_histogram_sentinel_and_na(registry, server):
+    h = Histogram()
+    assert h.percentile(50) is None and h.percentile(99) is None
+    st = h.stats()
+    assert st == {"count": 0, "sum_s": 0.0, "p50_ms": None,
+                  "p90_ms": None, "p99_ms": None}
+    registry.declare_hist("serve.ttft")
+    code, page = _get(server, "/statusz")
+    assert code == 200 and "serve.ttft" in page
+    assert "n=0 p50=n/a p90=n/a p99=n/a" in page
+    # /metrics still exports the (zeroed) bucket series, grammar-valid
+    code, metrics = _get(server, "/metrics")
+    assert code == 200
+    for line in metrics.splitlines():
+        if line and not line.startswith("#"):
+            assert statusd.PROM_LINE_RE.match(line), line
+    assert 'cxxnet_serve_ttft_seconds_bucket{process="0",le="+Inf"} 0' \
+        in metrics
+    assert 'cxxnet_serve_ttft_seconds_count{process="0"} 0' in metrics
+    # JSON sinks carry the sentinel as null, not NaN (strict JSON)
+    dumped = json.dumps(registry.summary()["hists"]["serve.ttft"])
+    assert "NaN" not in dumped and "null" in dumped
+
+
+def test_slo_tracker_rolling_window_and_reasons():
+    clock = [0.0]
+    slo = statusd.SLOTracker(ttft_ms=10.0, p99_ms=100.0,
+                             availability=0.99, window_s=30.0,
+                             min_requests=3, clock=lambda: clock[0])
+    for _ in range(3):
+        slo.observe(ok=True, ttft_s=0.005, latency_s=0.05)
+    assert slo.snapshot()["alert"] == 0
+    # one error + one ttft + one latency violation: 3/6 bad, budget 1%
+    slo.observe(ok=False)
+    slo.observe(ok=True, ttft_s=0.5)
+    slo.observe(ok=True, ttft_s=0.001, latency_s=0.5)
+    snap = slo.snapshot()
+    assert snap["alert"] == 1 and snap["burn_rate"] >= 1.0
+    assert snap["by_reason"] == {"error": 1, "ttft": 1, "latency": 1}
+    # the window forgets the entries, but with zero fresh evidence the
+    # alert HOLDS — a zero-traffic scrape must not clear a burn that no
+    # request ever recovered from (the gate would depend on scrape
+    # timing otherwise)
+    clock[0] = 31.0
+    snap = slo.snapshot()
+    assert snap["requests"] == 0 and snap["alert"] == 1
+    # recovery requires evidence: min_requests healthy observations
+    for _ in range(3):
+        slo.observe(ok=True, ttft_s=0.005, latency_s=0.05)
+    snap = slo.snapshot()
+    assert snap["alert"] == 0 and snap["burn_rate"] == 0.0
+
+
+def test_slo_burn_transition_events_only(registry):
+    """slo_burn events are emitted on TRANSITIONS, not per request —
+    the report's exit-2 gate reads the last state."""
+    import cxxnet_tpu.utils.telemetry as tmod
+    old = tmod._REG
+    tmod._REG = registry          # route module-level event() capture
+    try:
+        clock = [0.0]
+        slo = statusd.SLOTracker(ttft_ms=10.0, min_requests=2,
+                                 window_s=60.0, clock=lambda: clock[0])
+        for _ in range(4):
+            slo.observe(ok=True, ttft_s=0.5)     # flips to burning once
+        clock[0] = 61.0          # the bad requests age out of the window
+        for _ in range(4):
+            slo.observe(ok=True, ttft_s=0.001)   # flips back once
+    finally:
+        tmod._REG = old
+    burns = [e for e in registry.recent_events()
+             if e.get("ev") == "slo_burn"]
+    assert [e["state"] for e in burns] == [1, 0]
+
+
+# ----------------------------------------------------------------------
+# tools: bench_compare sub-field gating + summarize_trace request format
+import bench_compare  # noqa: E402  (tools/ is on sys.path above)
+import summarize_trace  # noqa: E402
+
+
+def test_bench_compare_gates_subfields(tmp_path, capsys):
+    bench = tmp_path / "BENCH_r09.json"
+    bench.write_text(json.dumps({"parsed": {
+        "metric": "serve_loopback_p99_latency_ms", "value": 50.0,
+        "unit": "ms", "ttft_p99_ms": 45.0, "queue_wait_p99_ms": None,
+        "shed_rate": 0.0}}))
+    baseline = tmp_path / "BASELINE.json"
+    baseline.write_text(json.dumps({"published": {
+        "serve_loopback_p99_latency_ms": 48.0,
+        "serve_loopback_p99_latency_ms.ttft_p99_ms": 20.0,
+        "serve_loopback_p99_latency_ms.queue_wait_p99_ms": 5.0}}))
+    rc = bench_compare.main(["--bench", str(bench),
+                             "--baseline", str(baseline)])
+    out = capsys.readouterr().out
+    # higher-is-worse for the _ms sub-field: 45 vs 20 published = gate
+    assert rc == 2
+    assert "REGRESSION serve_loopback_p99_latency_ms.ttft_p99_ms" in out
+    # null sub-field skipped cleanly, headline within threshold
+    assert "skip  serve_loopback_p99_latency_ms.queue_wait_p99_ms" in out
+    assert "ok    serve_loopback_p99_latency_ms " in out
+    # within-objective sub-field passes: no gate
+    baseline.write_text(json.dumps({"published": {
+        "serve_loopback_p99_latency_ms.ttft_p99_ms": 44.0}}))
+    assert bench_compare.main(["--bench", str(bench),
+                               "--baseline", str(baseline)]) == 0
+    capsys.readouterr()
+
+
+def test_summarize_trace_request_format(tmp_path, capsys):
+    rec = {"id": "12", "outcome": "served", "tokens_in": 3,
+           "tokens_out": 8, "total_s": 0.1,
+           "phases": {"queue_wait": 0.005, "dispatch": 0.001,
+                      "prefill": 0.034, "decode": 0.06},
+           "recompiles": [{"name": "jit.decode_prefill",
+                           "cause": "new_signature", "dur": 0.02}]}
+    p = tmp_path / "req.trace.json"
+    p.write_text(json.dumps(telemetry.request_chrome_trace(rec)))
+    sys.argv, old = ["summarize_trace.py", str(p)], sys.argv
+    try:
+        summarize_trace.main()
+    finally:
+        sys.argv = old
+    out = capsys.readouterr().out
+    assert "request 12 (served)" in out
+    assert "prefill" in out and "decode" in out
+    assert "jit.decode_prefill (new_signature)" in out
+    assert "phase coverage: 100.0%" in out
+
+
+# ----------------------------------------------------------------------
 def test_statusd_selftest():
     assert statusd.selftest() == 0

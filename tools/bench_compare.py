@@ -24,7 +24,11 @@ codes:
 
 To start gating a metric, copy a trusted run's value into
 ``BASELINE.json``: ``"published": {"alexnet_imagenet_images_per_sec_per_chip":
-15047.0}``.
+15047.0}``. Sub-fields of a row gate too, opt-in per field, when the
+baseline publishes ``"<metric>.<field>"`` — e.g.
+``"serve_loopback_p99_latency_ms.ttft_p99_ms": 40.0`` gates the serve
+row's TTFT tail (direction-aware: ``*_ms`` / ``*_rate`` sub-fields are
+worse when higher; null values skip cleanly like headline rows).
 """
 
 import glob
@@ -85,43 +89,73 @@ def lower_is_better(line):
             or "latency" in str(line.get("metric", "")))
 
 
+def sub_lower_is_better(key, line):
+    """Direction for a sub-field gated as ``<metric>.<key>``: latency
+    sub-fields (``*_ms``, ``*latency*``) and failure-rate sub-fields
+    (``*_rate``) are worse when HIGHER, whatever the parent row's unit —
+    ``ttft_p99_ms`` on a throughput row still gates as a latency."""
+    k = str(key)
+    if k.endswith("_ms") or "latency" in k or k.endswith("_rate"):
+        return True
+    return lower_is_better(line)
+
+
 def compare(lines, published, threshold):
     """-> (regressions, compared, skipped) lists of printable rows."""
     regressions, compared, skipped = [], [], []
-    for line in lines:
-        metric = line.get("metric")
-        value = line.get("value")
-        base = published.get(metric)
+
+    def gate(name, value, base, lower_better, null_detail):
+        """Classify one (measured, published) pair into exactly one of
+        the three row lists — shared by headline values and sub-fields
+        so null-safety and direction handling cannot drift."""
         if value is None:
-            skipped.append((metric, "measured value is null (%s)"
-                            % line.get("error", "no error recorded")))
-            continue
-        if base is None:
-            skipped.append((metric, "no published baseline"))
-            continue
+            skipped.append((name, "measured value is null (%s)"
+                            % null_detail))
+            return
         if not base:
-            skipped.append((metric, "baseline is zero/null"))
-            continue
+            skipped.append((name, "baseline is zero/null"))
+            return
         try:
             value, base = float(value), float(base)
         except (TypeError, ValueError):
             # placeholder strings ('TBD') etc.: not comparable, never
             # a gate failure
-            skipped.append((metric, "non-numeric value/baseline "
+            skipped.append((name, "non-numeric value/baseline "
                             "(%r vs %r)" % (value, base)))
-            continue
+            return
         if not base:
-            skipped.append((metric, "baseline is zero"))
-            continue
+            skipped.append((name, "baseline is zero"))
+            return
         ratio = value / base
-        if lower_is_better(line):
-            bad = ratio > 1.0 + threshold
-            delta = ratio - 1.0
-        else:
-            bad = ratio < 1.0 - threshold
-            delta = ratio - 1.0
-        row = (metric, base, value, delta)
+        bad = (ratio > 1.0 + threshold) if lower_better \
+            else (ratio < 1.0 - threshold)
+        row = (name, base, value, ratio - 1.0)
         (regressions if bad else compared).append(row)
+
+    for line in lines:
+        metric = line.get("metric")
+        base = published.get(metric)
+        if base is None:
+            skipped.append((metric, "no published baseline"))
+        else:
+            gate(metric, line.get("value"), base, lower_is_better(line),
+                 line.get("error", "no error recorded"))
+        # sub-fields (ttft_p99_ms, queue_wait_p99_ms, p50_ms, shed_rate,
+        # ...) gate when the baseline publishes "<metric>.<key>" —
+        # opt-in per sub-field, null-safe like the headline value.
+        # Driven by the PUBLISHED keys, not the line's: a bench refactor
+        # that renames or drops a gated sub-field must surface as a
+        # visible skip, not silently retire the gate
+        for name in sorted(k for k in published
+                           if k.startswith(metric + ".")):
+            key = name[len(metric) + 1:]
+            if key in line:
+                gate(name, line.get(key), published[name],
+                     sub_lower_is_better(key, line),
+                     "sub-field not measured")
+            else:
+                skipped.append((name, "sub-field absent from bench "
+                                "line (renamed or no longer emitted?)"))
     return regressions, compared, skipped
 
 

@@ -1,12 +1,21 @@
 #!/usr/bin/env python
-"""Summarize a jax profiler trace captured by tools/profile_bench.py.
+"""Summarize a Chrome-format trace: a jax profiler capture
+(tools/profile_bench.py) OR a per-request serving trace
+(statusd ``/trace?request=<id>``, utils/servd flight recorder).
 
-Usage: python tools/summarize_trace.py <trace-dir-or-trace.json.gz> [top_n]
+Usage: python tools/summarize_trace.py <trace-dir-or-trace.json[.gz]>
+                                       [top_n]
 
-Reads the Chrome-format trace (plugins/profile/*/**.trace.json.gz),
-aggregates complete events by name across the TensorCore lanes, and
-prints the top-N ops by total self duration — enough to rank hot
-HLO/fusion ops without TensorBoard. No TPU or network needed.
+Profiler traces (plugins/profile/*/**.trace.json.gz): aggregates
+complete events by name across the TensorCore lanes and prints the
+top-N ops by total self duration — enough to rank hot HLO/fusion ops
+without TensorBoard. No TPU or network needed.
+
+Per-request traces (detected by their phase lanes — queue_wait /
+dispatch / prefill / decode, doc/observability.md): prints the phase
+split with percentages of the request's wall-clock, the recompiles the
+request paid, and the phase coverage — the one-slow-request triage view
+without opening Perfetto.
 """
 
 import gzip
@@ -15,6 +24,10 @@ import json
 import os
 import sys
 from collections import defaultdict
+
+# the serving request-phase lanes (telemetry.REQUEST_PHASES — literal
+# here so the tool stays dependency-free and runs on a bare checkout)
+REQUEST_PHASES = ("queue_wait", "dispatch", "prefill", "decode")
 
 
 def find_trace(path: str) -> str:
@@ -29,12 +42,54 @@ def find_trace(path: str) -> str:
     return hits[-1]
 
 
+def load_trace(path: str) -> dict:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        return json.load(f)
+
+
+def summarize_request(events) -> None:
+    """Per-request trace: phase table + recompiles + coverage."""
+    xs = [e for e in events if e.get("ph") == "X" and "dur" in e]
+    phases = [e for e in xs if e["name"] in REQUEST_PHASES]
+    rid = outcome = "?"
+    for e in phases:
+        args = e.get("args") or {}
+        rid = args.get("request", rid)
+        outcome = args.get("outcome", outcome)
+    # the phases TILE the request's wall-clock (utils/servd) — the
+    # phase lane, not the recompile annotations, defines the total
+    t0 = min(e["ts"] for e in phases or xs)
+    t1 = max(e["ts"] + e["dur"] for e in phases or xs)
+    total = max(t1 - t0, 1e-9)
+    covered = sum(e["dur"] for e in phases)
+    print("request %s (%s): total %.2fms" % (rid, outcome, total / 1e3))
+    print("%-12s %10s %6s" % ("phase", "ms", "pct"))
+    by_name = {e["name"]: e for e in phases}
+    for name in REQUEST_PHASES:
+        e = by_name.get(name)
+        if e is not None:
+            print("%-12s %10.2f %5.1f%%"
+                  % (name, e["dur"] / 1e3, 100.0 * e["dur"] / total))
+    comps = [e for e in xs if e["name"].startswith("compile:")]
+    for e in comps:
+        print("%-12s %10.2f        %s (%s)"
+              % ("recompile", e["dur"] / 1e3, e["name"][len("compile:"):],
+                 (e.get("args") or {}).get("cause", "?")))
+    print("phase coverage: %.1f%% of request wall-clock"
+          % (100.0 * covered / total))
+
+
 def main():
     path = find_trace(sys.argv[1] if len(sys.argv) > 1 else "profile_out")
     top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 25
-    with gzip.open(path, "rt") as f:
-        trace = json.load(f)
+    trace = load_trace(path)
     events = trace.get("traceEvents", [])
+    if any(e.get("ph") == "X" and e.get("name") in REQUEST_PHASES
+           for e in events):
+        print("trace: %s" % path)
+        summarize_request(events)
+        return
     # name the process/thread lanes so we can keep device lanes only
     # (host-side Python/runtime lanes would double-count wall time)
     pids = {}

@@ -11,9 +11,12 @@ Prints top spans by total time, recompile count/causes/seconds, per-round
 breakdowns, counters/gauges, fixed-bucket latency histograms (bucket table
 + p50/p90/p99), step-time percentiles, a training-health section
 (anomalies/rollbacks/watchdog stalls/corrupt records, utils/health.py),
-and a serving section (shed rate, deadline-miss rate, circuit-breaker
+a serving section (shed rate, deadline-miss rate, circuit-breaker
 transitions, per-request p50/p99 from the ``serve.request`` histogram,
-utils/servd.py).
+utils/servd.py), and a request-breakdown section (phase-attributed
+p50/p99 over the ``serve_request_done`` events — queue_wait / dispatch /
+prefill / decode / TTFT — plus the top-5 slowest requests with their
+phase split and the requests that paid recompiles).
 ``--trace`` additionally exports a chrome://tracing / Perfetto JSON built
 from the span tree. ``--json`` emits the aggregate as one JSON object
 instead of the table (for scripting).
@@ -31,10 +34,12 @@ that is not valid JSON, or no telemetry events at all) OR a log with
 ``health_anomaly`` events that no resolution event (``health_rollback``
 / ``health_skip`` / ``health_abort`` referencing the anomaly id, or an
 inline ``resolution`` field) ever answered, OR a log whose LAST
-``serve_breaker`` event (per process) left the circuit breaker open —
-CI gates on this so neither a broken emitter, an unrecovered training
-anomaly, nor a serving run that ended with its backend shedding can
-silently pass.
+``serve_breaker`` event (per process) left the circuit breaker open,
+OR a log whose LAST ``slo_burn`` event (per process) left the SLO
+error budget burning (state 1) — CI gates on this so neither a broken
+emitter, an unrecovered training anomaly, a serving run that ended with
+its backend shedding, nor one that ended blowing its SLOs can silently
+pass.
 """
 
 import json
@@ -45,7 +50,8 @@ sys.path.insert(0, os.path.join(
     os.path.dirname(os.path.abspath(__file__)), ".."))
 
 from cxxnet_tpu.utils.telemetry import (  # noqa: E402
-    HIST_BUCKETS, Histogram, count_by, events_to_chrome, percentile)
+    HIST_BUCKETS, Histogram, count_by, events_to_chrome, fmt_ms,
+    percentile)
 
 
 def load_events(path):
@@ -147,6 +153,8 @@ def aggregate(events):
     health = {"anomalies": [], "resolutions": [], "stalls": [],
               "data_corrupt": 0, "skipped_batches": 0}
     breaker_events = []
+    requests = []
+    slo_events = []
 
     def proc(ev):
         p = int(ev.get("p", 0))
@@ -202,6 +210,12 @@ def aggregate(events):
         elif kind == "serve_breaker":
             breaker_events.append(ev)
             proc(ev)
+        elif kind == "serve_request_done":
+            requests.append(ev)
+            proc(ev)
+        elif kind == "slo_burn":
+            slo_events.append(ev)
+            proc(ev)
     # an anomaly is resolved by an inline resolution field (warn-only
     # metric events) or by any recovery event referencing its id —
     # matched PER PROCESS: anomaly ids are per-process counters, so in a
@@ -254,9 +268,64 @@ def aggregate(events):
         serving["breaker_open_unresolved"] = sorted(
             p for p, st in serving["breaker_final"].items()
             if st == "open")
+    # request breakdown: phase-attributed percentiles over the
+    # serve_request_done events, the slowest requests with their phase
+    # split, and recompile attribution (from the events' recompile count
+    # plus any compile events tagged with a request id)
+    req_agg = None
+    if requests:
+        phases = {}
+        for ph in ("queue_wait", "dispatch", "prefill", "decode",
+                   "ttft", "total"):
+            vals = sorted(float(r[ph + "_s"]) for r in requests
+                          if r.get(ph + "_s") is not None)
+            if vals:
+                phases[ph] = {
+                    "count": len(vals),
+                    "p50_ms": round(1e3 * percentile(vals, 50), 4),
+                    "p99_ms": round(1e3 * percentile(vals, 99), 4),
+                    "max_ms": round(1e3 * vals[-1], 4)}
+        slowest = sorted(requests,
+                         key=lambda r: -float(r.get("total_s", 0.0)))[:5]
+        recomp = {}
+        for r in requests:
+            if r.get("recompiles"):
+                recomp[str(r.get("req"))] = int(r["recompiles"])
+        for c in compiles:
+            if "req" in c:
+                recomp.setdefault(str(c["req"]), 0)
+                recomp[str(c["req"])] = max(recomp[str(c["req"])], 1)
+        req_agg = {
+            "count": len(requests),
+            "outcomes": count_by(requests, "outcome"),
+            "phases": phases,
+            "slowest": [{
+                "req": r.get("req"), "outcome": r.get("outcome"),
+                "total_s": r.get("total_s"),
+                "tokens": r.get("tokens", 0),
+                "phases": {ph: r.get(ph + "_s")
+                           for ph in ("queue_wait", "dispatch",
+                                      "prefill", "decode")}}
+                for r in slowest],
+            "recompile_requests": dict(sorted(recomp.items())),
+        }
+    # SLO burn account: transition events only — the LAST state per
+    # process is the gate (a log that ends burning exits 2)
+    slo = None
+    if slo_events:
+        final = {}
+        for ev in slo_events:           # events arrive time-sorted
+            final[str(int(ev.get("p", 0)))] = ev
+        slo = {"transitions": len(slo_events),
+               "final": {p: {"state": int(ev.get("state", 0)),
+                             "burn_rate": ev.get("burn_rate")}
+                         for p, ev in final.items()},
+               "burning": sorted(p for p, ev in final.items()
+                                 if int(ev.get("state", 0)))}
     out = {"spans": {}, "compiles": {}, "counters": counters,
            "gauges": gauges, "rounds": rounds, "health": health,
-           "serving": serving, "hists": {}}
+           "serving": serving, "requests": req_agg, "slo": slo,
+           "hists": {}}
     for name, h in sorted(merged_hists.items()):
         st = h.stats()
         st["buckets"] = h.to_dict()["buckets"]
@@ -292,6 +361,11 @@ def aggregate(events):
                 "gauges": gauges_by_p.get(p, {}),
             }
     return out
+
+
+# empty-histogram stats carry None percentiles (a series that never
+# fired); the shared renderer turns them into "n/a", never garbage zeros
+_fmt_ms = fmt_ms
 
 
 def _bucket_rows(buckets):
@@ -334,9 +408,9 @@ def print_report(agg, top=15):
               "merge-exact) ==")
         for name, h in sorted(agg["hists"].items(),
                               key=lambda kv: -kv[1]["sum_s"]):
-            print("%-24s n=%-8d sum=%.3fs  p50=%.2fms  p90=%.2fms  "
-                  "p99=%.2fms" % (name, h["count"], h["sum_s"],
-                                  h["p50_ms"], h["p90_ms"], h["p99_ms"]))
+            print("%-24s n=%-8d sum=%.3fs  p50=%s  p90=%s  p99=%s"
+                  % (name, h["count"], h["sum_s"], _fmt_ms(h["p50_ms"]),
+                     _fmt_ms(h["p90_ms"]), _fmt_ms(h["p99_ms"])))
             for le, c in _bucket_rows(h.get("buckets", {})):
                 print("    le=%-12s %d" % (le, c))
     if agg["rounds"]:
@@ -386,9 +460,9 @@ def print_report(agg, top=15):
                  100 * sv["deadline_miss_rate"]))
         req = agg.get("hists", {}).get("serve.request")
         if req:
-            print("request latency: n=%d  p50=%.2fms  p90=%.2fms  "
-                  "p99=%.2fms" % (req["count"], req["p50_ms"],
-                                  req["p90_ms"], req["p99_ms"]))
+            print("request latency: n=%d  p50=%s  p90=%s  p99=%s"
+                  % (req["count"], _fmt_ms(req["p50_ms"]),
+                     _fmt_ms(req["p90_ms"]), _fmt_ms(req["p99_ms"])))
         if sv["reloads"]:
             print("model reloads: %d" % sv["reloads"])
         if sv["breaker_transitions"]:
@@ -398,6 +472,44 @@ def print_report(agg, top=15):
             for p, st in sorted(sv["breaker_final"].items()):
                 print("  process %s final breaker state: %s%s"
                       % (p, st, "  UNRESOLVED" if st == "open" else ""))
+    rq = agg.get("requests")
+    if rq:
+        print("\n== request breakdown (phase-attributed) ==")
+        print("requests: %d  %s"
+              % (rq["count"],
+                 " ".join("%s=%d" % kv
+                          for kv in sorted(rq["outcomes"].items()))))
+        print("%-12s %8s %10s %10s %10s" %
+              ("phase", "count", "p50_ms", "p99_ms", "max_ms"))
+        for ph in ("queue_wait", "dispatch", "prefill", "decode",
+                   "ttft", "total"):
+            a = rq["phases"].get(ph)
+            if a:
+                print("%-12s %8d %10.2f %10.2f %10.2f" %
+                      (ph, a["count"], a["p50_ms"], a["p99_ms"],
+                       a["max_ms"]))
+        print("top-5 slowest requests:")
+        for r in rq["slowest"]:
+            ph = r["phases"]
+            print("  req=%-8s %-14s total=%8.2fms  queue=%.2f "
+                  "dispatch=%.2f prefill=%.2f decode=%.2f  tokens=%d"
+                  % (r["req"], r["outcome"],
+                     1e3 * float(r.get("total_s") or 0.0),
+                     *(1e3 * float(ph.get(k) or 0.0)
+                       for k in ("queue_wait", "dispatch", "prefill",
+                                 "decode")), r.get("tokens", 0)))
+        if rq["recompile_requests"]:
+            print("recompile-attributed requests: %s"
+                  % " ".join("req=%s(%d)" % kv for kv in
+                             rq["recompile_requests"].items()))
+    slo = agg.get("slo")
+    if slo:
+        print("\n== slo ==")
+        print("burn transitions: %d" % slo["transitions"])
+        for p, st in sorted(slo["final"].items()):
+            print("  process %s final: %s (burn rate %sx)"
+                  % (p, "BURNING" if st["state"] else "within budget",
+                     st.get("burn_rate")))
     h = agg.get("health", {})
     if h and (h["anomalies"] or h["stalls"] or h["data_corrupt"]
               or h["skipped_batches"]):
@@ -490,6 +602,12 @@ def main(argv):
         print("%s: serving circuit breaker still OPEN at end of log "
               "(process %s) — the run ended shedding every request"
               % (label, ", ".join(open_breakers)), file=sys.stderr)
+        return 2
+    burning = (agg.get("slo") or {}).get("burning", [])
+    if burning:
+        print("%s: SLO error-budget burn rate still exceeded at end of "
+              "log (process %s) — the run ended blowing its objectives"
+              % (label, ", ".join(burning)), file=sys.stderr)
         return 2
     return 0
 
