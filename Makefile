@@ -41,19 +41,33 @@ test-fast:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
 		-p no:cacheprovider
 
+# project-native static analysis (doc/static_analysis.md): lock-order /
+# blocking-under-lock rules, JAX hazards (donated reuse, traced
+# branches, wall-clock durations, dispatch-vs-compute spans), the
+# conf-key doc registry and the telemetry metric registry — ratcheted
+# against tools/cxxlint_baseline.json (counts may only shrink)
+lint:
+	python tools/cxxlint.py
+
 # fast regression gate (no pytest, no jax): every module byte-compiles,
 # the checkpoint verifier still detects every corruption class, the
 # training-health detect->rollback->skip state machine still recovers,
 # the live introspection service serves/scrapes/shuts-down on a real
-# socket with valid Prometheus output, and the serving frontend's
+# socket with valid Prometheus output, the serving frontend's
 # admission/deadline/breaker/drain machinery answers every request over
-# a real socket — a checkpoint-format, recovery-policy, metrics-format,
-# or serving-protocol regression fails here in seconds
+# a real socket (both with CXXNET_LOCKRANK=1 runtime lock-order
+# enforcement), and the static analyzer parses the whole package and
+# agrees the tree is clean — a checkpoint-format, recovery-policy,
+# metrics-format, serving-protocol, or lock-ordering regression fails
+# here in seconds
 check:
 	python -m compileall -q cxxnet_tpu tools tests
 	python tools/ckpt_fsck.py --selftest
 	python -m cxxnet_tpu.utils.health --selftest
 	python -m cxxnet_tpu.utils.statusd --selftest
 	python -m cxxnet_tpu.utils.servd --selftest
+	python -c "import sys; from cxxnet_tpu.utils import lockrank; \
+		sys.exit(lockrank.selftest(verbose=True))"
+	python tools/cxxlint.py --selftest
 
-.PHONY: all clean test-fast check
+.PHONY: all clean test-fast check lint

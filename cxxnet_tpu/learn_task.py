@@ -663,7 +663,7 @@ class LearnTask:
 
     # ------------------------------------------------------------------
     def task_train(self) -> None:
-        start = time.time()
+        start = time.monotonic()   # elapsed-time origin: never wall clock
         self._stop_training = False
         self._preempt_noted = False
         # cooperative preemption is single-process only: the stop flag is
@@ -796,7 +796,8 @@ class LearnTask:
                           "(resume with continue=1)")
                 return
         if not self.silent:
-            print("updating end, %.0f sec in all" % (time.time() - start))
+            print("updating end, %.0f sec in all"
+                  % (time.monotonic() - start))
 
     def _train_one_round(self, start: float, skip_batches: int = 0,
                          final_round: bool = False):
@@ -870,7 +871,7 @@ class LearnTask:
             if sample_counter % self.print_step == 0 and not self.silent:
                 print("round %8d:[%8d] %.0f sec elapsed" %
                       (self.start_counter - 1, sample_counter,
-                       time.time() - start))
+                       time.monotonic() - start))
             if self.test_io == 0 and self._preempt_requested():
                 # preemption at a step boundary: one emergency checkpoint
                 # with the iterator cursor, then a clean exit — the

@@ -1163,6 +1163,9 @@ class Trainer:
         # the span covers DISPATCH (plus any trace+compile, which the
         # jit watch separates out) — execution is async; the input-wait
         # fraction the train loop reports is what exposes device stalls
+        # cxxlint: disable=timed-dispatch — dispatch-only by design (the
+        # comment above): device time shows up as the round's io-wait
+        # complement, compiles via the jit watch
         with telemetry.span("train.step"):
             (self.params, self.opt_state, self.grad_accum,
              self._metric_accum, self.last_health) = \
@@ -1283,6 +1286,9 @@ class Trainer:
         prog = self._watched_jit(k, "jit.eval_fwd", build)
         data = self._shard_batch(batch.data)
         try:
+            # cxxlint: disable=timed-dispatch — the host fetch (asarray /
+            # allgather below) syncs right after; blocking inside the
+            # span would serialize eval against the input pipeline
             with telemetry.span("eval.forward"):
                 outs, new_params = prog(self.params, data, self._next_rng())
         except Exception:
@@ -1322,6 +1328,10 @@ class Trainer:
         fn = self._watched_jit(k, "jit.predict", build)
         data = self._shard_batch(batch.data)
         try:
+            # cxxlint: disable=timed-dispatch — async return IS the
+            # contract: serving loops consume the device array without a
+            # host fetch (api.predict_device); its own latency series
+            # exists precisely because blocking here would lie
             with telemetry.span("predict"):
                 pred, new_params = fn(self.params, data, self._next_rng())
         except Exception:

@@ -54,6 +54,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from . import lockrank
 from . import telemetry
 
 __all__ = [
@@ -65,7 +66,9 @@ __all__ = [
 # health-vector slot layout, shared with nnet/trainer.py _make_train_step
 H_LOSS, H_GNORM_SQ, H_NAN_GRADS, H_OK = 0, 1, 2, 3
 
-_id_lock = threading.Lock()
+# ranked (utils/lockrank.py): anomaly ids are allocated from
+# telemetry/watchdog callbacks, so the ordering discipline covers it
+_id_lock = lockrank.lock("health.ids")
 _next_anomaly_id = [0]
 
 

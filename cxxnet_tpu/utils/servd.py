@@ -110,6 +110,7 @@ from typing import Callable, List, Optional, Tuple
 
 from . import checkpoint as ckpt
 from . import health
+from . import lockrank
 from . import statusd
 from . import telemetry
 
@@ -148,7 +149,7 @@ class CircuitBreaker:
         self.cooldown = float(cooldown)
         self.max_cooldown = float(max_cooldown)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockrank.lock("servd.breaker")
         self.state = "closed"
         self.consecutive = 0      # consecutive backend failures
         self.opens = 0            # open transitions since last close
@@ -217,7 +218,7 @@ class _ConnState:
     __slots__ = ("cond", "slots", "dead", "eof", "unsent")
 
     def __init__(self):
-        self.cond = threading.Condition()
+        self.cond = lockrank.condition("servd.conn")
         self.slots: deque = deque()    # [text or None] per submitted line
         self.dead = False              # send failed: connection torn down
         self.eof = False               # reader saw client EOF
@@ -231,7 +232,9 @@ class _Request:
     def __init__(self, toks: List[int], deadline: Optional[float], reply):
         self.toks = toks
         self.t_arrival = time.monotonic()
-        self.t_wall = time.time()    # flight-record arrival timestamp
+        # cxxlint: disable=wallclock — flight-record arrival epoch, never
+        # subtracted: durations in this class all come from t_arrival
+        self.t_wall = time.time()
         self.id = "?"                # assigned under the admission lock
         # deadline arrives relative (seconds); stored absolute monotonic
         self.deadline = None if deadline is None \
@@ -242,7 +245,7 @@ class _Request:
         # exactly-once answer guard: drain can give up on a request
         # whose backend wedged past the budget while the worker might
         # still answer it later — only the first answer goes out
-        self._alock = threading.Lock()
+        self._alock = lockrank.lock("servd.request")
         self.answered = False
 
 
@@ -315,8 +318,10 @@ class ServeFrontend:
                                       max_cooldown=breaker_max_cooldown_ms
                                       / 1e3)
         self._q: deque = deque()
-        self._cond = threading.Condition()
-        self._slock = threading.Lock()
+        # ranked locks (utils/lockrank.py): with CXXNET_LOCKRANK=1 the
+        # chaos tests assert acquisition order matches the static graph
+        self._cond = lockrank.condition("servd.queue")
+        self._slock = lockrank.lock("servd.stats")
         self._stats = {k: 0 for k in _COUNTERS}
         self._draining = False
         self._stop = False
@@ -334,7 +339,7 @@ class ServeFrontend:
         # their queued responses to reach the kernel before returning —
         # the writer threads are daemons, and a response still buffered
         # at interpreter exit would be a silently dropped answer
-        self._conn_lock = threading.Lock()
+        self._conn_lock = lockrank.lock("servd.conns")
         self._conns: set = set()
 
     # -- lifecycle -----------------------------------------------------
@@ -1075,7 +1080,14 @@ def _ask(port: int, line: str, timeout: float = 5.0) -> str:
 def selftest(verbose: bool = False) -> int:
     """Drive the full admission/deadline/breaker/reload/drain machinery
     over a real loopback socket with an injected backend — jax-free;
-    ``make check`` gates on it."""
+    ``make check`` gates on it. Runs with runtime lock-order
+    enforcement on (utils/lockrank.py): an inversion anywhere in the
+    machinery raises a named LockOrderError instead of deadlocking."""
+    with lockrank.enforced():
+        return _selftest_body(verbose)
+
+
+def _selftest_body(verbose: bool = False) -> int:
     boom = {"on": False}
     reloads = []
 

@@ -81,6 +81,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs
 
 from . import health as health_mod
+from . import lockrank
 from . import telemetry
 
 __all__ = [
@@ -171,7 +172,9 @@ class SLOTracker:
         # exit-2 gate) — the breaker analog needs 5 consecutive fails
         self.min_bad = max(1, int(min_bad))
         self._clock = clock
-        self._lock = threading.Lock()
+        # ranked: _update emits telemetry under this lock (deliberate —
+        # transition ordering), so statusd.slo < telemetry.registry
+        self._lock = lockrank.lock("statusd.slo")
         self._win: deque = deque()     # (t, violation reason or None)
         # incremental violation counts — observe()/scrape run on the
         # serving accept/worker threads under the lock, so the window
@@ -478,6 +481,7 @@ class StatusServer:
         self.host = self._httpd.server_address[0]
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+        # cxxlint: disable=wallclock — rendered via localtime on /statusz
         self.t0_wall = time.time()
 
     # -- lifecycle -----------------------------------------------------
@@ -758,7 +762,14 @@ def set_slo(tracker: Optional[SLOTracker]) -> None:
 def selftest(verbose: bool = False) -> int:
     """Serve on port 0, scrape every endpoint over a real socket,
     validate the Prometheus text format, flip /healthz with a failing
-    probe, shut down. Jax-free; ``make check`` gates on it."""
+    probe, shut down. Jax-free; ``make check`` gates on it. Runs with
+    runtime lock-order enforcement on for the registry/SLO/flight
+    locks (utils/lockrank.py)."""
+    with lockrank.enforced():
+        return _selftest_body(verbose)
+
+
+def _selftest_body(verbose: bool = False) -> int:
     from urllib.request import urlopen
     from urllib.error import HTTPError
 

@@ -81,6 +81,8 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from . import lockrank
+
 __all__ = [
     "enable", "disable", "enabled", "reset", "span", "count", "gauge",
     "hist", "event", "record_compile", "jit_watch", "sample_device_memory",
@@ -290,7 +292,9 @@ class _Registry:
         self.enabled = False
         self.log_path: Optional[str] = None
         self._log_f: Optional[io.TextIOBase] = None
-        self._lock = threading.Lock()
+        # innermost rank by design: every subsystem records telemetry,
+        # so nothing may be acquired while this is held
+        self._lock = lockrank.lock("telemetry.registry")
         self._tls = threading.local()
         self.process_index = 0
         self.reset()
@@ -312,6 +316,8 @@ class _Registry:
             self._recent: deque = deque(maxlen=_RING_CAP)
             self.last_by_kind: Dict[str, dict] = {}
             self.t0_perf = time.perf_counter()
+            # cxxlint: disable=wallclock — the shard-merge epoch: --merge
+            # re-bases shards on the shared wall clock, never a duration
             self.t0_wall = time.time()
 
     def enable(self, log_path: Optional[str] = None,
@@ -791,7 +797,7 @@ class FlightRecorder:
 
     def __init__(self, cap: int = 256):
         self.cap = max(1, int(cap))
-        self._lock = threading.Lock()
+        self._lock = lockrank.lock("telemetry.flight")
         self._ring: deque = deque(maxlen=self.cap)
 
     def record(self, rec: dict) -> None:

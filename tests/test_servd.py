@@ -37,6 +37,17 @@ from . import faultinject
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.fixture(autouse=True)
+def _lockrank_on(monkeypatch):
+    """Runtime lock-order enforcement for every frontend/breaker/
+    tracker this suite constructs (and the stub subprocesses it
+    spawns): an inversion the static analyzer cannot see — callback-
+    driven, cross-thread — fails the chaos test as a LockOrderError
+    naming both locks and both sites instead of deadlocking in
+    production (doc/static_analysis.md)."""
+    monkeypatch.setenv("CXXNET_LOCKRANK", "1")
+
+
 def echo(toks, seq):
     return [t + 1 for t in toks]
 

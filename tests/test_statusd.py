@@ -29,6 +29,15 @@ sys.path.insert(0, os.path.join(os.path.dirname(
 import telemetry_report  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _lockrank_on(monkeypatch):
+    """Runtime lock-order enforcement for every registry/SLOTracker/
+    flight-recorder lock this suite constructs: a scrape-thread vs
+    worker-thread inversion fails as a named LockOrderError instead of
+    a deadlock (doc/static_analysis.md)."""
+    monkeypatch.setenv("CXXNET_LOCKRANK", "1")
+
+
 @pytest.fixture()
 def registry():
     """A private enabled registry — tests never touch the process-global

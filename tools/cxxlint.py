@@ -1,0 +1,1580 @@
+#!/usr/bin/env python3
+"""cxxlint: project-native static analysis for tpu-cxxnet.
+
+Generic linters cannot see this project's invariants: which attribute is
+a lock, which callable dispatches an async XLA program, which string is a
+telemetry series, which comparison reads a conf key. This tool walks the
+``cxxnet_tpu`` package's ASTs (stdlib-only, jax-free — it PARSES the
+code, never imports it) and enforces four rule families the review
+history shows humans keep re-finding by hand (doc/static_analysis.md has
+the full catalog with examples):
+
+concurrency
+    lock-cycle      cycles in the project lock-acquisition graph (with-
+                    statement nesting, including cross-method and cross-
+                    module edges through resolvable calls)
+    lock-rank       a static graph edge that contradicts the runtime
+                    rank table (cxxnet_tpu/utils/lockrank.py RANKS)
+    lock-blocking   blocking operations (socket/file IO, sleep,
+                    subprocess, Event.wait, queue get/put, jit dispatch)
+                    reachable while a lock is held
+    thread-unjoined non-daemon threads that are never joined
+
+jax hazards
+    donated-reuse   reading an argument after passing it to a
+                    donate_argnums call site (the buffer is gone)
+    traced-branch   Python truthiness/comparison branching on a traced
+                    parameter inside a jit-compiled function
+    wallclock       any time.time() call: durations must use
+                    time.monotonic()/perf_counter(); genuinely-wall-
+                    clock uses carry a suppression comment with a reason
+    timed-dispatch  a telemetry.span region that calls an async-
+                    dispatching jit program with no block_until_ready —
+                    the span times DISPATCH, not compute
+
+conf-key registry
+    conf-undocumented  a key the code reads (set_param comparisons,
+                       startswith prefixes) that no doc/*.md mentions
+    conf-dead          a key documented in a doc key table or config
+                       example that nothing in the package reads
+
+metric registry
+    metric-name      a telemetry series name with characters outside the
+                     project convention [A-Za-z0-9_./]
+    metric-type      one series name used as two different metric types
+                     (counter vs gauge vs histogram)
+    metric-suffix    unit-convention violations (statusd appends _total/
+                     _seconds — a raw name carrying them double-suffixes;
+                     a literal Prometheus counter must end in _total)
+    metric-collision two distinct series names that collide after
+                     Prometheus sanitization (both become cxxnet_a_b)
+
+Suppression (reason REQUIRED — an empty reason is itself a finding)::
+
+    t_wall = time.time()  # cxxlint: disable=wallclock — flight-record epoch
+
+Baseline / ratchet: ``tools/cxxlint_baseline.json`` grandfathers existing
+violations as fingerprint->count. The count may only SHRINK: a finding
+not covered by the baseline fails (new violation), and a baseline entry
+no longer matched fails (stale — delete it, the debt is paid). Update
+with ``--update-baseline`` only when deliberately accepting debt.
+
+Usage:
+    python tools/cxxlint.py                 # lint the package (make lint)
+    python tools/cxxlint.py --lock-graph    # print the acquisition graph
+    python tools/cxxlint.py --selftest      # parse-all + clean-tree gate
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import sys
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional, Set, Tuple
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = "cxxnet_tpu"
+BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "cxxlint_baseline.json")
+
+RULES = {
+    "lock-cycle": "cycle in the lock-acquisition graph",
+    "lock-rank": "static lock-graph edge contradicts lockrank.RANKS",
+    "lock-blocking": "blocking operation reachable while a lock is held",
+    "thread-unjoined": "non-daemon thread is never joined",
+    "donated-reuse": "argument read after a donate_argnums call consumed it",
+    "traced-branch": "Python branch on a traced value inside a jit function",
+    "wallclock": "time.time() call (durations need monotonic time)",
+    "timed-dispatch": "span times an async jit dispatch with no sync",
+    "conf-undocumented": "code reads a conf key no doc/*.md mentions",
+    "conf-dead": "doc registers a conf key nothing reads",
+    "metric-name": "telemetry series name outside [A-Za-z0-9_./]",
+    "metric-type": "one series name used as two metric types",
+    "metric-suffix": "metric unit-suffix convention violation",
+    "metric-collision": "two series names collide after sanitization",
+    "bad-suppression": "cxxlint disable comment without a reason",
+}
+
+HINTS = {
+    "lock-cycle": "break the cycle: release before calling, or reorder "
+                  "per lockrank.RANKS",
+    "lock-rank": "renumber lockrank.RANKS to a topological order of "
+                 "`cxxlint.py --lock-graph`",
+    "lock-blocking": "copy state under the lock, do the slow work after "
+                     "release (see telemetry.flush)",
+    "thread-unjoined": "pass daemon=True or join() it on shutdown",
+    "donated-reuse": "rebind the result or copy before the call; the "
+                     "donated buffer no longer exists",
+    "traced-branch": "use jnp.where/lax.cond, or branch on static "
+                     "Python config captured by the closure",
+    "wallclock": "time.monotonic() for durations; if wall-clock is the "
+                 "point, add `# cxxlint: disable=wallclock — <why>`",
+    "timed-dispatch": "jax.block_until_ready(out) inside the span, or "
+                      "suppress with a reason if dispatch-time is meant",
+    "conf-undocumented": "document the key in the owning doc/*.md page",
+    "conf-dead": "delete the doc row or wire the key back up",
+    "metric-name": "stick to letters, digits, '_', '.', '/'",
+    "metric-type": "pick one type per name; split the series otherwise",
+    "metric-suffix": "statusd appends _total/_seconds — drop the unit "
+                     "suffix from the raw name",
+    "metric-collision": "rename one series; both sanitize to the same "
+                        "Prometheus name",
+    "bad-suppression": "a suppression must say WHY: "
+                       "`# cxxlint: disable=<rule> — <reason>`",
+}
+
+SUPPRESS_RE = re.compile(
+    r"#\s*cxxlint:\s*disable=([A-Za-z,-]+)\s*(?:(?:—|--|-)\s*(.*))?")
+
+# blocking primitives by dotted-name suffix (resolution-free tier)
+BLOCKING_SUFFIX = {
+    "time.sleep": "time.sleep",
+    "os.system": "os.system",
+    "subprocess.run": "subprocess", "subprocess.call": "subprocess",
+    "subprocess.check_call": "subprocess",
+    "subprocess.check_output": "subprocess",
+    "subprocess.Popen": "subprocess",
+    "socket.create_connection": "socket connect",
+    "socket.create_server": "socket bind",
+    "select.select": "select.select",
+}
+# blocking method names on ANY receiver (socket-shaped verbs rare enough
+# elsewhere to be safe)
+BLOCKING_METHODS = {"accept": "socket accept", "recv": "socket recv",
+                    "recvfrom": "socket recv", "sendall": "socket send",
+                    "connect": "socket connect"}
+# result-sync markers only: jnp.asarray on an INPUT is not a sync, so
+# asarray deliberately does not count
+SYNC_MARKERS = {"block_until_ready", "device_get", "process_allgather"}
+METRIC_FUNCS = {"count": "counter", "gauge": "gauge", "hist": "histogram",
+                "declare_hist": "histogram", "span": "histogram",
+                "span_event": "histogram"}
+METRIC_NAME_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_./]*$")
+PROM_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+IDENT_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
+
+
+class Finding:
+    __slots__ = ("rule", "path", "line", "msg", "key")
+
+    def __init__(self, rule: str, path: str, line: int, msg: str,
+                 key: Optional[str] = None):
+        self.rule = rule
+        self.path = path
+        self.line = int(line)
+        self.msg = msg
+        self.key = key if key is not None else msg
+
+    def fingerprint(self, root: str) -> str:
+        rel = os.path.relpath(self.path, root)
+        return "%s|%s|%s" % (self.rule, rel.replace(os.sep, "/"), self.key)
+
+    def render(self, root: str) -> str:
+        rel = os.path.relpath(self.path, root)
+        return "%s:%d: [%s] %s\n    hint: %s" % (
+            rel, self.line, self.rule, self.msg, HINTS.get(self.rule, ""))
+
+
+def dotted(node) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return None if base is None else base + "." + node.attr
+    return None
+
+
+def const_str(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+# ----------------------------------------------------------------------
+# project model: what the ASTs tell us about classes, locks and types
+# ----------------------------------------------------------------------
+
+LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition"}
+KIND_CTORS = {"threading.Event": ("event",), "queue.Queue": ("queue",),
+              "threading.Thread": ("thread",), "open": ("file",)}
+
+
+class ClassInfo:
+    def __init__(self, modkey: str, name: str, node: ast.ClassDef):
+        self.modkey = modkey
+        self.name = name
+        self.node = node
+        self.methods: Dict[str, ast.FunctionDef] = {}
+        self.attr_kinds: Dict[str, tuple] = {}   # attr -> kind tuple
+
+
+class ModuleInfo:
+    def __init__(self, key: str, path: str, tree: ast.Module, src: str):
+        self.key = key
+        self.path = path
+        self.tree = tree
+        self.src = src
+        self.lines = src.splitlines()
+        self.nodes = list(ast.walk(tree))   # walked once, reused by
+        #                                     every whole-module rule
+        self.classes: Dict[str, ClassInfo] = {}
+        self.functions: Dict[str, ast.FunctionDef] = {}
+        self.mod_aliases: Dict[str, str] = {}     # alias -> module key
+        self.name_imports: Dict[str, Tuple[str, str]] = {}
+        self.var_kinds: Dict[str, tuple] = {}
+        self.suppress: Dict[int, Tuple[Set[str], str]] = {}
+
+
+class FuncEntry:
+    def __init__(self, modkey: str, qual: str, node, cls=None):
+        self.modkey = modkey
+        self.qual = qual
+        self.node = node
+        self.cls: Optional[ClassInfo] = cls
+        self.key = (modkey, qual)
+        self.calls: List[Tuple[tuple, int]] = []       # (callee key, line)
+        self.locks: List[Tuple[str, int]] = []         # direct acquisitions
+        self.blocking: List[Tuple[str, int]] = []      # context-filtered
+        self.lock_edges: List[Tuple[str, str, int]] = []
+        self.lock_calls: List[Tuple[str, tuple, int]] = []
+        self.lock_dispatch: List[Tuple[str, int]] = []  # jit under lock
+        self.local_defs: Dict[str, tuple] = {}
+        # (varname, resolved callee) for assignments that BECOME jit
+        # vars iff the callee turns out to be a jit source — the only
+        # part of the analysis the second pass can change
+        self.maybe_jit_assigns: List[Tuple[str, tuple]] = []
+        # own-scope nodes (nested def/class bodies excluded — they are
+        # their own FuncEntry), walked once at registration
+        self.own_nodes: List[ast.AST] = list(_walk_no_nested(node))
+
+
+class Project:
+    def __init__(self, root: str, pkg: str = PKG):
+        self.root = root
+        self.pkg_dir = os.path.join(root, pkg)
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.funcs: Dict[tuple, FuncEntry] = {}
+        self.attr_locks: Dict[str, Set[str]] = defaultdict(set)
+        self.jit_sources: Set[tuple] = set()
+        self.parse_errors: List[str] = []
+        self._load()
+        self._index()
+
+    # -- loading -------------------------------------------------------
+    def _load(self) -> None:
+        for dirpath, dirnames, filenames in os.walk(self.pkg_dir):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, self.pkg_dir)
+                key = rel[:-3].replace(os.sep, ".")
+                if key.endswith(".__init__"):
+                    key = key[:-len(".__init__")]
+                elif key == "__init__":
+                    key = ""
+                with open(path, "r", encoding="utf-8") as f:
+                    src = f.read()
+                try:
+                    tree = ast.parse(src, filename=path)
+                except SyntaxError as e:
+                    self.parse_errors.append("%s: %s" % (path, e))
+                    continue
+                self.modules[key] = ModuleInfo(key, path, tree, src)
+
+    # -- indexing ------------------------------------------------------
+    def _index(self) -> None:
+        for mod in self.modules.values():
+            self._index_imports(mod)
+            self._index_suppressions(mod)
+            for node in mod.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    ci = ClassInfo(mod.key, node.name, node)
+                    mod.classes[node.name] = ci
+                    for sub in node.body:
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                            ci.methods[sub.name] = sub
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    mod.functions[node.name] = node
+        # attr kinds need classes of ALL modules resolvable first
+        for mod in self.modules.values():
+            for ci in mod.classes.values():
+                for meth in ci.methods.values():
+                    self._collect_attr_kinds(mod, ci, meth)
+            for node in mod.tree.body:
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    kind = self.infer_kind(node.value, mod,
+                                           "%s.%s" % (mod.key,
+                                                      node.targets[0].id))
+                    if kind is not None:
+                        mod.var_kinds[node.targets[0].id] = kind
+        for mod in self.modules.values():
+            for ci in mod.classes.values():
+                for attr, kind in ci.attr_kinds.items():
+                    if kind[0] == "lock":
+                        self.attr_locks[attr].add(kind[1])
+        # function registry (nested defs included, qualified)
+        for mod in self.modules.values():
+            for name, node in mod.functions.items():
+                self._register_func(mod, name, node, None)
+            for ci in mod.classes.values():
+                for mname, meth in ci.methods.items():
+                    self._register_func(mod, "%s.%s" % (ci.name, mname),
+                                        meth, ci)
+
+    def _register_func(self, mod, qual, node, cls) -> None:
+        fe = FuncEntry(mod.key, qual, node, cls)
+        self.funcs[fe.key] = fe
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and sub is not node:
+                subqual = "%s.%s" % (qual, sub.name)
+                if (mod.key, subqual) not in self.funcs:
+                    sube = FuncEntry(mod.key, subqual, sub, cls)
+                    self.funcs[sube.key] = sube
+                fe.local_defs[sub.name] = (mod.key, subqual)
+
+    def _index_imports(self, mod: ModuleInfo) -> None:
+        parts = mod.key.split(".") if mod.key else []
+        for node in mod.nodes:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    name = a.name
+                    if name == PKG:
+                        continue
+                    if name.startswith(PKG + "."):
+                        key = name[len(PKG) + 1:]
+                        if key in self.modules:
+                            mod.mod_aliases[a.asname
+                                            or name.split(".")[-1]] = key
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = parts[:-node.level] if node.level <= len(parts) \
+                        else []
+                    tgt = base + (node.module.split(".")
+                                  if node.module else [])
+                else:
+                    if not node.module:
+                        continue
+                    if node.module == PKG:
+                        tgt = []
+                    elif node.module.startswith(PKG + "."):
+                        tgt = node.module[len(PKG) + 1:].split(".")
+                    else:
+                        continue
+                tkey = ".".join(tgt)
+                for a in node.names:
+                    sub = ".".join(tgt + [a.name])
+                    if sub in self.modules:
+                        mod.mod_aliases[a.asname or a.name] = sub
+                    elif tkey in self.modules:
+                        mod.name_imports[a.asname or a.name] = (tkey,
+                                                                a.name)
+
+    def _index_suppressions(self, mod: ModuleInfo) -> None:
+        """A suppression covers its own line; on a comment-only line the
+        reason may continue over following comment lines and the whole
+        block covers the first CODE line after it."""
+        for i, line in enumerate(mod.lines, 1):
+            m = SUPPRESS_RE.search(line)
+            if m is None:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",")
+                     if r.strip()}
+            reason = (m.group(2) or "").strip()
+            mod.suppress[i] = (rules, reason)
+            if not line.strip().startswith("#"):
+                continue
+            j = i
+            while j < len(mod.lines) \
+                    and mod.lines[j].strip().startswith("#"):
+                j += 1
+            if j < len(mod.lines) and mod.lines[j].strip() \
+                    and j + 1 not in mod.suppress:
+                mod.suppress[j + 1] = (rules, reason)
+
+    # -- kind inference ------------------------------------------------
+    def infer_kind(self, value, mod: ModuleInfo,
+                   autoname: str) -> Optional[tuple]:
+        """What does this r-value construct? -> ("lock", name) |
+        ("class", modkey, clsname) | ("event"|"queue"|"thread"|"file",)"""
+        if not isinstance(value, ast.Call):
+            return None
+        d = dotted(value.func)
+        if d is None:
+            return None
+        if d in LOCK_CTORS or d in ("Lock", "RLock", "Condition"):
+            return ("lock", autoname)
+        if d.endswith("lockrank.lock") or d.endswith("lockrank.condition") \
+                or (d in ("lock", "condition")
+                    and "lockrank" in mod.name_imports.get(d, ("",))[0]):
+            nm = const_str(value.args[0]) if value.args else None
+            return ("lock", nm or autoname)
+        if d in KIND_CTORS:
+            return KIND_CTORS[d]
+        if d in ("Event", "Queue", "Thread"):
+            return {"Event": ("event",), "Queue": ("queue",),
+                    "Thread": ("thread",)}[d]
+        cls = self.resolve_class_name(d, mod)
+        if cls is not None:
+            return ("class",) + cls
+        return None
+
+    def resolve_class_name(self, d: str, mod: ModuleInfo) \
+            -> Optional[Tuple[str, str]]:
+        if "." in d:
+            head, _, tail = d.partition(".")
+            tmod = mod.mod_aliases.get(head)
+            if tmod is not None and "." not in tail:
+                tm = self.modules.get(tmod)
+                if tm is not None and tail in tm.classes:
+                    return (tmod, tail)
+            return None
+        if d in mod.classes:
+            return (mod.key, d)
+        imp = mod.name_imports.get(d)
+        if imp is not None:
+            tm = self.modules.get(imp[0])
+            if tm is not None and imp[1] in tm.classes:
+                return imp
+        return None
+
+    def _collect_attr_kinds(self, mod, ci: ClassInfo, meth) -> None:
+        for node in ast.walk(meth):
+            tgt = None
+            value = None
+            ann = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt, value = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign):
+                tgt, value, ann = node.target, node.value, node.annotation
+            if not (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                continue
+            attr = tgt.attr
+            kind = None
+            if value is not None:
+                kind = self.infer_kind(
+                    value, mod, "%s.%s.%s" % (mod.key, ci.name, attr))
+            if kind is None and ann is not None:
+                kind = self.ann_kind(ann, mod)
+            if kind is not None and (attr not in ci.attr_kinds
+                                     or ci.attr_kinds[attr][0] != "lock"):
+                ci.attr_kinds[attr] = kind
+
+    def ann_kind(self, ann, mod: ModuleInfo) -> Optional[tuple]:
+        """Kind from a type annotation (Optional[X] unwrapped)."""
+        names = [dotted(n) or getattr(n, "id", "")
+                 for n in ast.walk(ann)
+                 if isinstance(n, (ast.Name, ast.Attribute))]
+        s = const_str(ann)
+        if s:
+            names.append(s)
+        for n in names:
+            if not n:
+                continue
+            tail = n.split(".")[-1].strip("'\"")
+            if tail == "Thread":
+                return ("thread",)
+            if tail == "Event":
+                return ("event",)
+            if tail == "Queue":
+                return ("queue",)
+            cls = self.resolve_class_name(tail, mod)
+            if cls is not None:
+                return ("class",) + cls
+        return None
+
+
+
+# ----------------------------------------------------------------------
+# per-function analysis: locks, blocking ops, calls, span blocks
+# ----------------------------------------------------------------------
+
+def _walk_no_nested(node):
+    """ast.walk that does not descend into nested function/class defs
+    (they are analyzed as their own FuncEntry)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            stack.append(child)
+
+
+class _FuncAnalysis:
+    def __init__(self, project: Project, fe: FuncEntry,
+                 jit_sources: Set[tuple]):
+        self.p = project
+        self.fe = fe
+        self.mod = project.modules[fe.modkey]
+        self.jit_sources = jit_sources
+        self.env: Dict[str, tuple] = {}
+        self.jit_vars: Set[str] = set()
+        fe.calls, fe.locks, fe.blocking = [], [], []
+        fe.lock_edges, fe.lock_calls, fe.lock_dispatch = [], [], []
+        fe.block_hits: List[Tuple[str, str, int]] = []
+        fe.span_blocks: List[Tuple[int, bool, bool]] = []
+        self._prepass()
+        self._visit_block(fe.node.body, [])
+
+    # -- environment ---------------------------------------------------
+    def _prepass(self) -> None:
+        a = self.fe.node.args
+        for arg in (list(getattr(a, "posonlyargs", [])) + list(a.args)
+                    + list(a.kwonlyargs)):
+            if arg.annotation is not None:
+                k = self.p.ann_kind(arg.annotation, self.mod)
+                if k is not None:
+                    self.env[arg.arg] = k
+        self.fe.maybe_jit_assigns = []
+        for node in self.fe.own_nodes:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                tname = node.targets[0].id
+                kind = self.p.infer_kind(
+                    node.value, self.mod,
+                    "%s.%s.%s" % (self.fe.modkey, self.fe.qual, tname))
+                if kind is not None:
+                    self.env[tname] = kind
+                if isinstance(node.value, ast.Call) \
+                        and self._is_jit_maker(node.value, tname):
+                    self.jit_vars.add(tname)
+
+    def _is_jit_maker(self, call: ast.Call, tname: str) -> bool:
+        d = dotted(call.func) or ""
+        if d.endswith("jax.jit") or "jit_watch" in d \
+                or "_watched_jit" in d:
+            return True
+        key = self._resolve_call(call.func)
+        if key is None:
+            return False
+        self.fe.maybe_jit_assigns.append((tname, key))
+        return key in self.jit_sources
+
+    # -- resolution ----------------------------------------------------
+    def _recv_kind(self, expr) -> Optional[tuple]:
+        """Kind of a receiver expression (Name / self.attr / var.attr)."""
+        if isinstance(expr, ast.Name):
+            k = self.env.get(expr.id) or self.mod.var_kinds.get(expr.id)
+            return k
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            ci = None
+            if isinstance(base, ast.Name) and base.id == "self" \
+                    and self.fe.cls is not None:
+                ci = self.fe.cls
+            else:
+                bk = self._recv_kind(base)
+                if bk is not None and bk[0] == "class":
+                    tm = self.p.modules.get(bk[1])
+                    ci = tm.classes.get(bk[2]) if tm else None
+            if ci is not None and expr.attr in ci.attr_kinds:
+                return ci.attr_kinds[expr.attr]
+        return None
+
+    def _resolve_lock(self, expr) -> Optional[str]:
+        k = self._recv_kind(expr)
+        if k is not None and k[0] == "lock":
+            return k[1]
+        # fallback: a lock attribute name unique across the project
+        if isinstance(expr, ast.Attribute):
+            names = self.p.attr_locks.get(expr.attr)
+            if names and len(names) == 1:
+                return next(iter(names))
+        return None
+
+    def _resolve_call(self, func) -> Optional[tuple]:
+        if isinstance(func, ast.Name):
+            n = func.id
+            if n in self.fe.local_defs:
+                return self.fe.local_defs[n]
+            if n in self.mod.functions:
+                return (self.mod.key, n)
+            imp = self.mod.name_imports.get(n)
+            if imp is not None:
+                tm = self.p.modules.get(imp[0])
+                if tm is not None:
+                    if imp[1] in tm.functions:
+                        return (imp[0], imp[1])
+                    if imp[1] in tm.classes \
+                            and "__init__" in tm.classes[imp[1]].methods:
+                        return (imp[0], imp[1] + ".__init__")
+            if n in self.mod.classes \
+                    and "__init__" in self.mod.classes[n].methods:
+                return (self.mod.key, n + ".__init__")
+            return None
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            if isinstance(base, ast.Name) and base.id == "self" \
+                    and self.fe.cls is not None \
+                    and func.attr in self.fe.cls.methods:
+                return (self.fe.modkey,
+                        "%s.%s" % (self.fe.cls.name, func.attr))
+            if isinstance(base, ast.Name) \
+                    and base.id in self.mod.mod_aliases:
+                tmk = self.mod.mod_aliases[base.id]
+                tm = self.p.modules.get(tmk)
+                if tm is not None:
+                    if func.attr in tm.functions:
+                        return (tmk, func.attr)
+                    if func.attr in tm.classes \
+                            and "__init__" in tm.classes[func.attr].methods:
+                        return (tmk, func.attr + ".__init__")
+                return None
+            bk = self._recv_kind(base)
+            if bk is not None and bk[0] == "class":
+                tm = self.p.modules.get(bk[1])
+                ci = tm.classes.get(bk[2]) if tm else None
+                if ci is not None and func.attr in ci.methods:
+                    return (bk[1], "%s.%s" % (bk[2], func.attr))
+        return None
+
+    # -- blocking classification ---------------------------------------
+    def _blocking_desc(self, call: ast.Call,
+                       held: List[Tuple[str, int]]) -> Optional[str]:
+        func = call.func
+        d = dotted(func) or ""
+        for suf, desc in BLOCKING_SUFFIX.items():
+            if d == suf or d.endswith("." + suf):
+                return desc
+        if d == "open" or d.endswith(".open"):
+            return "file open"
+        if isinstance(func, ast.Name) and func.id in self.jit_vars:
+            return "jit dispatch"
+        if isinstance(func, ast.Call) \
+                and (dotted(func.func) or "").endswith("jax.jit"):
+            return "jit dispatch"
+        if not isinstance(func, ast.Attribute):
+            return None
+        attr = func.attr
+        if attr in BLOCKING_METHODS:
+            return BLOCKING_METHODS[attr]
+        recv = func.value
+        if attr == "wait":
+            lock = self._resolve_lock(recv)
+            if lock is not None:
+                return None     # Condition.wait releases its own lock
+            k = self._recv_kind(recv)
+            if k is not None and k[0] == "event":
+                return "Event.wait"
+            return None
+        k = self._recv_kind(recv)
+        if k is None:
+            return None
+        if k[0] == "thread" and attr == "join":
+            return "Thread.join"
+        if k[0] == "queue" and attr in ("get", "put", "join"):
+            return "queue.%s" % attr
+        if k[0] == "file" and attr in ("read", "readline", "readlines",
+                                       "write", "writelines", "flush"):
+            return "file IO"
+        return None
+
+    # -- statement walk ------------------------------------------------
+    def _scan_calls(self, expr, held: List[Tuple[str, int]]) -> None:
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            line = getattr(node, "lineno", 0)
+            key = self._resolve_call(node.func)
+            if key is not None:
+                self.fe.calls.append((key, line))
+                if held:
+                    self.fe.lock_calls.append((held[-1][0], key, line))
+            desc = self._blocking_desc(node, held)
+            if desc is not None:
+                self.fe.blocking.append((desc, line))
+                if held:
+                    self.fe.block_hits.append((held[-1][0], desc, line))
+                if desc == "jit dispatch" and held:
+                    self.fe.lock_dispatch.append((held[-1][0], line))
+
+    def _is_span_call(self, expr) -> bool:
+        if not isinstance(expr, ast.Call):
+            return False
+        d = dotted(expr.func) or ""
+        return d == "span" or d.endswith(".span")
+
+    def _analyze_span(self, w: ast.With) -> None:
+        has_dispatch = has_sync = False
+        for st in w.body:
+            for node in ast.walk(st):
+                if isinstance(node, ast.Call):
+                    if isinstance(node.func, ast.Name) \
+                            and node.func.id in self.jit_vars:
+                        has_dispatch = True
+                    elif isinstance(node.func, ast.Call) and (dotted(
+                            node.func.func) or "").endswith("jax.jit"):
+                        has_dispatch = True
+                if isinstance(node, ast.Attribute) \
+                        and node.attr in SYNC_MARKERS:
+                    has_sync = True
+                if isinstance(node, ast.Name) and node.id in SYNC_MARKERS:
+                    has_sync = True
+        self.fe.span_blocks.append((w.lineno, has_dispatch, has_sync))
+
+    def _visit_block(self, stmts, held: List[Tuple[str, int]]) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            if isinstance(st, ast.With):
+                new_held = list(held)
+                is_span = False
+                for item in st.items:
+                    self._scan_calls(item.context_expr, held)
+                    if self._is_span_call(item.context_expr):
+                        is_span = True
+                        continue
+                    ln = self._resolve_lock(item.context_expr)
+                    if ln is not None:
+                        if new_held:
+                            self.fe.lock_edges.append(
+                                (new_held[-1][0], ln, st.lineno))
+                        self.fe.locks.append((ln, st.lineno))
+                        new_held.append((ln, st.lineno))
+                if is_span:
+                    self._analyze_span(st)
+                self._visit_block(st.body, new_held)
+                continue
+            # expressions of this statement (not sub-blocks)
+            for field in ("value", "test", "iter", "exc", "msg"):
+                sub = getattr(st, field, None)
+                if sub is not None and isinstance(sub, ast.AST):
+                    self._scan_calls(sub, held)
+            if isinstance(st, ast.Return) and st.value is not None:
+                pass  # covered by "value"
+            for blk in ("body", "orelse", "finalbody"):
+                sub = getattr(st, blk, None)
+                if isinstance(sub, list) and sub and \
+                        isinstance(sub[0], ast.stmt):
+                    self._visit_block(sub, held)
+            if isinstance(st, ast.Try):
+                for h in st.handlers:
+                    self._visit_block(h.body, held)
+
+
+def analyze_all(project: Project) -> None:
+    """Two passes: resolve the call graph first, derive the jit-source
+    set from it, then re-run with jit knowledge wired in."""
+    for fe in project.funcs.values():
+        _FuncAnalysis(project, fe, frozenset())
+    direct: Set[tuple] = set()
+    for fe in project.funcs.values():
+        for node in ast.walk(fe.node):
+            if isinstance(node, ast.Call):
+                d = dotted(node.func) or ""
+                if d.endswith("jax.jit") or "jit_watch" in d \
+                        or "_watched_jit" in d:
+                    direct.add(fe.key)
+                    break
+    srcs = set(direct)
+    changed = True
+    while changed:
+        changed = False
+        for fe in project.funcs.values():
+            if fe.key in srcs:
+                continue
+            if any(ck in srcs for ck, _ in fe.calls):
+                srcs.add(fe.key)
+                changed = True
+    project.jit_sources = srcs
+    # second pass only where jit knowledge can change the outcome: a
+    # function whose assignments resolve into the jit-source set gains
+    # jit vars; everything else keeps its (identical) first-pass result
+    for fe in project.funcs.values():
+        if any(k in srcs for _, k in fe.maybe_jit_assigns):
+            _FuncAnalysis(project, fe, srcs)
+
+
+def _closure(project: Project, direct_of) -> Dict[tuple, dict]:
+    """Fixpoint transitive closure over the resolved call graph.
+    ``direct_of(fe) -> {item: site}``; result maps func key ->
+    {item: representative site}."""
+    sets: Dict[tuple, dict] = {
+        fe.key: dict(direct_of(fe)) for fe in project.funcs.values()}
+    changed = True
+    while changed:
+        changed = False
+        for fe in project.funcs.values():
+            mine = sets[fe.key]
+            for ck, line in fe.calls:
+                other = sets.get(ck)
+                if not other:
+                    continue
+                for item, site in other.items():
+                    if item not in mine:
+                        mine[item] = site
+                        changed = True
+    return sets
+
+
+# ----------------------------------------------------------------------
+# rule drivers
+# ----------------------------------------------------------------------
+
+def lock_analysis(project: Project):
+    """-> (edges {(src,dst): (relpath,line)}, findings)."""
+    locks_of = _closure(
+        project, lambda fe: {ln: (fe.modkey, line)
+                             for ln, line in fe.locks})
+    blocking_of = _closure(
+        project, lambda fe: {desc: (fe.modkey, line)
+                             for desc, line in fe.blocking})
+    edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    findings: List[Finding] = []
+
+    def add_edge(src, dst, path, line):
+        if (src, dst) not in edges:
+            edges[(src, dst)] = (path, line)
+
+    for fe in project.funcs.values():
+        path = project.modules[fe.modkey].path
+        for src, dst, line in fe.lock_edges:
+            add_edge(src, dst, path, line)
+        for lockname, callee, line in fe.lock_calls:
+            for ln in locks_of.get(callee, {}):
+                add_edge(lockname, ln, path, line)
+        seen_block = set()
+        for lockname, desc, line in fe.block_hits:
+            key = (lockname, desc, line)
+            if key not in seen_block:
+                seen_block.add(key)
+                findings.append(Finding(
+                    "lock-blocking", path, line,
+                    "%s while holding %r" % (desc, lockname),
+                    key="%s|%s" % (lockname, desc)))
+        for lockname, callee, line in fe.lock_calls:
+            for desc, origin in blocking_of.get(callee, {}).items():
+                key = (lockname, desc, callee)
+                if key in seen_block:
+                    continue
+                seen_block.add(key)
+                findings.append(Finding(
+                    "lock-blocking", path, line,
+                    "call into %s.%s reaches %s (at %s:%d) while "
+                    "holding %r" % (callee[0], callee[1], desc,
+                                    origin[0], origin[1], lockname),
+                    key="%s|%s|%s.%s" % (lockname, desc, callee[0],
+                                         callee[1])))
+    # cycles (self-edges included: with L held, re-acquiring L deadlocks)
+    adj: Dict[str, List[str]] = defaultdict(list)
+    for (src, dst) in edges:
+        adj[src].append(dst)
+    color: Dict[str, int] = {}
+    stack: List[str] = []
+    cycles: List[List[str]] = []
+
+    def dfs(n):
+        color[n] = 1
+        stack.append(n)
+        for m in adj.get(n, ()):
+            if m == n:
+                cycles.append([n])
+            elif color.get(m, 0) == 0:
+                dfs(m)
+            elif color.get(m) == 1:
+                cyc = stack[stack.index(m):]
+                if sorted(cyc) not in [sorted(c) for c in cycles]:
+                    cycles.append(list(cyc))
+        stack.pop()
+        color[n] = 2
+
+    for n in sorted(adj):
+        if color.get(n, 0) == 0:
+            dfs(n)
+    for cyc in cycles:
+        segs = []
+        site = None
+        ring = cyc + [cyc[0]]
+        for a, b in zip(ring, ring[1:]):
+            e = edges.get((a, b))
+            if e is not None:
+                segs.append("%s->%s (%s:%d)"
+                            % (a, b, os.path.basename(e[0]), e[1]))
+                site = site or e
+        findings.append(Finding(
+            "lock-cycle", site[0] if site else project.pkg_dir,
+            site[1] if site else 0,
+            "lock-acquisition cycle: " + "  ".join(segs),
+            key="|".join(sorted(set(cyc)))))
+    # rank consistency with the runtime table
+    ranks = parse_ranks(project)
+    for (src, dst), (path, line) in sorted(edges.items()):
+        if src in ranks and dst in ranks and ranks[src] >= ranks[dst]:
+            findings.append(Finding(
+                "lock-rank", path, line,
+                "edge %s -> %s contradicts lockrank.RANKS "
+                "(%d >= %d): the runtime checker would raise here"
+                % (src, dst, ranks[src], ranks[dst]),
+                key="%s|%s" % (src, dst)))
+    return edges, findings
+
+
+def parse_ranks(project: Project) -> Dict[str, int]:
+    mod = project.modules.get("utils.lockrank")
+    if mod is None:
+        return {}
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "RANKS" \
+                and isinstance(node.value, ast.Dict):
+            out = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                ks, vi = const_str(k), getattr(v, "value", None)
+                if ks is not None and isinstance(vi, int):
+                    out[ks] = vi
+            return out
+    return {}
+
+
+def thread_findings(project: Project) -> List[Finding]:
+    out = []
+    for mod in project.modules.values():
+        if "Thread" not in mod.src:
+            continue
+        assigned = {}
+        # one walk: ast.walk yields a parent Assign before its value
+        # Call, so the name is always recorded by the time the Thread
+        # constructor comes up
+        for node in mod.nodes:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.value, ast.Call):
+                t = node.targets[0]
+                nm = t.id if isinstance(t, ast.Name) else (
+                    t.attr if isinstance(t, ast.Attribute) else None)
+                if nm:
+                    assigned[id(node.value)] = nm
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func) or ""
+            if not (d == "Thread" or d.endswith("threading.Thread")):
+                continue
+            daemon = None
+            for kw in node.keywords:
+                if kw.arg == "daemon":
+                    daemon = getattr(kw.value, "value", None)
+            if daemon is True:
+                continue
+            nm = assigned.get(id(node))
+            # left boundary required: client.join(",") must not count
+            # as joining a thread named t
+            if nm is not None and re.search(
+                    r"(?<![A-Za-z0-9_.])" + re.escape(nm)
+                    + r"\s*\.\s*join\s*\(", mod.src):
+                continue
+            out.append(Finding(
+                "thread-unjoined", mod.path, node.lineno,
+                "thread %s is not daemon=True and never joined"
+                % (repr(nm) if nm else "(unnamed)"),
+                key=nm or "anon:%d" % node.lineno))
+    return out
+
+
+def wallclock_findings(project: Project) -> List[Finding]:
+    out = []
+    for mod in project.modules.values():
+        for node in mod.nodes:
+            if isinstance(node, ast.Call) \
+                    and (dotted(node.func) or "") == "time.time":
+                line = mod.lines[node.lineno - 1].strip() \
+                    if node.lineno <= len(mod.lines) else ""
+                out.append(Finding(
+                    "wallclock", mod.path, node.lineno,
+                    "time.time() — wall clock; durations need "
+                    "time.monotonic()", key=line))
+    return out
+
+
+def _donate_idxs(call: ast.Call) -> Optional[Set[int]]:
+    if not (dotted(call.func) or "").endswith("jax.jit"):
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return {v.value}
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = set()
+            for e in v.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value,
+                                                              int):
+                    out.add(e.value)
+            return out
+    return None
+
+
+def donated_reuse_findings(project: Project) -> List[Finding]:
+    out = []
+    for fe in project.funcs.values():
+        mod = project.modules[fe.modkey]
+        if "donate_argnums" not in mod.src:
+            continue    # _donate_idxs needs the literal kwarg
+        donating: Dict[str, Set[int]] = {}
+        stores: Dict[str, List[int]] = defaultdict(list)
+        loads: Dict[str, List[int]] = defaultdict(list)
+        all_calls: List[ast.Call] = []
+        for node in fe.own_nodes:
+            if isinstance(node, ast.Name):
+                (stores if isinstance(node.ctx, ast.Store)
+                 else loads)[node.id].append(node.lineno)
+            elif isinstance(node, ast.Call):
+                all_calls.append(node)
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call):
+                cands = [node.value] + [a for a in node.value.args
+                                        if isinstance(a, ast.Call)]
+                for c in cands:
+                    idxs = _donate_idxs(c)
+                    if idxs:
+                        donating[node.targets[0].id] = idxs
+        if not donating and not any(isinstance(c.func, ast.Call)
+                                    for c in all_calls):
+            continue
+        calls: List[Tuple[int, List[str]]] = []
+        for node in all_calls:
+            idxs = None
+            if isinstance(node.func, ast.Name) \
+                    and node.func.id in donating:
+                idxs = donating[node.func.id]
+            elif isinstance(node.func, ast.Call):
+                idxs = _donate_idxs(node.func)
+            if not idxs:
+                continue
+            names = [a.id for i, a in enumerate(node.args)
+                     if i in idxs and isinstance(a, ast.Name)]
+            if names:
+                calls.append((node.lineno, names))
+        for callline, names in calls:
+            for nm in names:
+                later = [ln for ln in loads[nm] if ln > callline]
+                if not later:
+                    continue
+                use = min(later)
+                if any(callline <= s <= use for s in stores[nm]):
+                    continue
+                out.append(Finding(
+                    "donated-reuse", mod.path, use,
+                    "%r donated to the jit call at line %d is read "
+                    "again — the buffer was consumed" % (nm, callline),
+                    key="%s:%d" % (nm, callline)))
+    return out
+
+
+def _jit_roots(project: Project) -> Set[tuple]:
+    roots: Set[tuple] = set()
+    for fe in project.funcs.values():
+        for dec in getattr(fe.node, "decorator_list", []):
+            d = dotted(dec) or dotted(getattr(dec, "func", None)) or ""
+            if "jit" in d:
+                roots.add(fe.key)
+        for node in fe.own_nodes:
+            if isinstance(node, ast.Call) \
+                    and (dotted(node.func) or "").endswith("jax.jit") \
+                    and node.args \
+                    and isinstance(node.args[0], ast.Name) \
+                    and node.args[0].id in fe.local_defs:
+                roots.add(fe.local_defs[node.args[0].id])
+        if re.match(r"^_make_.*_step$", fe.qual.split(".")[-1]):
+            roots.update(fe.local_defs.values())
+    return roots
+
+
+def _traced_names(test, params: Set[str]) -> List[str]:
+    if isinstance(test, ast.Name):
+        return [test.id] if test.id in params else []
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _traced_names(test.operand, params)
+    if isinstance(test, ast.BoolOp):
+        out = []
+        for v in test.values:
+            out.extend(_traced_names(v, params))
+        return out
+    if isinstance(test, ast.Compare):
+        if all(isinstance(op, (ast.Eq, ast.NotEq, ast.Lt, ast.LtE,
+                               ast.Gt, ast.GtE)) for op in test.ops):
+            return [n.id for n in [test.left] + test.comparators
+                    if isinstance(n, ast.Name) and n.id in params]
+    return []
+
+
+def traced_branch_findings(project: Project) -> List[Finding]:
+    out = []
+    for key in sorted(_jit_roots(project)):
+        fe = project.funcs.get(key)
+        if fe is None:
+            continue
+        mod = project.modules[fe.modkey]
+        params = {a.arg for a in fe.node.args.args if a.arg != "self"}
+        for node in fe.own_nodes:
+            test = None
+            if isinstance(node, (ast.If, ast.While)):
+                test = node.test
+            elif isinstance(node, ast.Assert):
+                test = node.test
+            elif isinstance(node, ast.IfExp):
+                test = node.test
+            if test is None:
+                continue
+            names = _traced_names(test, params)
+            if names:
+                out.append(Finding(
+                    "traced-branch", mod.path, node.lineno,
+                    "jit function %r branches on traced %s — inside "
+                    "jit this is a Python-level bool() of a tracer"
+                    % (fe.qual, "/".join(sorted(set(names)))),
+                    key="%s:%s" % (fe.qual,
+                                   "/".join(sorted(set(names))))))
+    return out
+
+
+def timed_dispatch_findings(project: Project) -> List[Finding]:
+    out = []
+    for fe in project.funcs.values():
+        mod = project.modules[fe.modkey]
+        for line, has_dispatch, has_sync in fe.span_blocks:
+            if has_dispatch and not has_sync:
+                src = mod.lines[line - 1].strip() \
+                    if line <= len(mod.lines) else ""
+                out.append(Finding(
+                    "timed-dispatch", mod.path, line,
+                    "span times a jit dispatch with no "
+                    "block_until_ready — measures dispatch, not "
+                    "compute", key=src))
+    return out
+
+
+# ----------------------------------------------------------------------
+# conf-key registry
+# ----------------------------------------------------------------------
+
+def conf_code_keys(project: Project) -> Dict[str, Tuple[str, int]]:
+    keys: Dict[str, Tuple[str, int]] = {}
+
+    def record(k, path, line):
+        k = k.rstrip(":[-")
+        if IDENT_RE.match(k) and k not in keys:
+            keys[k] = (path, line)
+
+    def scan(scope, path):
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Compare) \
+                    and isinstance(node.left, ast.Name) \
+                    and node.left.id == "name" and len(node.ops) == 1:
+                cmpv = node.comparators[0]
+                if isinstance(node.ops[0], ast.Eq):
+                    s = const_str(cmpv)
+                    if s is not None:
+                        record(s, path, node.lineno)
+                elif isinstance(node.ops[0], ast.In) \
+                        and isinstance(cmpv, (ast.Tuple, ast.List)):
+                    for e in cmpv.elts:
+                        s = const_str(e)
+                        if s is not None:
+                            record(s, path, node.lineno)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "startswith" \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "name":
+                for a in node.args:
+                    s = const_str(a)
+                    if s is not None:
+                        record(s, path, node.lineno)
+                    elif isinstance(a, ast.Tuple):
+                        for e in a.elts:
+                            s = const_str(e)
+                            if s is not None:
+                                record(s, path, node.lineno)
+
+    for fe in project.funcs.values():
+        path = project.modules[fe.modkey].path
+        argnames = {a.arg for a in fe.node.args.args}
+        if "name" in argnames and ("val" in argnames
+                                   or "value" in argnames):
+            scan(fe.node, path)
+        else:
+            for node in fe.own_nodes:
+                if isinstance(node, ast.For) \
+                        and isinstance(node.target, ast.Tuple) \
+                        and node.target.elts \
+                        and isinstance(node.target.elts[0], ast.Name) \
+                        and node.target.elts[0].id == "name":
+                    scan(node, path)
+    return keys
+
+
+_FENCE_SKIP = {"python", "py", "bash", "sh", "json", "console", "text"}
+
+
+def doc_conf_keys(doc_dir: str):
+    """-> (texts {path: str}, registry {key: (path, line)}) — the
+    registry is the STRICT documented set (key-table first cells +
+    key = value lines in untagged fenced config examples)."""
+    texts: Dict[str, str] = {}
+    registry: Dict[str, Tuple[str, int]] = {}
+    if not os.path.isdir(doc_dir):
+        return texts, registry
+    for fn in sorted(os.listdir(doc_dir)):
+        if not fn.endswith(".md"):
+            continue
+        path = os.path.join(doc_dir, fn)
+        with open(path, "r", encoding="utf-8") as f:
+            text = f.read()
+        texts[path] = text
+        in_fence = False
+        fence_tag = ""
+        keycol = -1
+        for i, line in enumerate(text.splitlines(), 1):
+            ls = line.strip()
+            if ls.startswith("```"):
+                in_fence = not in_fence
+                fence_tag = ls[3:].strip().lower() if in_fence else ""
+                keycol = -1
+                continue
+            if in_fence:
+                if fence_tag in _FENCE_SKIP and fence_tag:
+                    continue
+                m = re.match(r"^\s*([A-Za-z_][A-Za-z0-9_]*)\s*=\s*(.*)$",
+                             line)
+                if m and "(" not in m.group(2):
+                    k = m.group(1)
+                    if IDENT_RE.match(k) and k not in registry:
+                        registry[k] = (path, i)
+                continue
+            if ls.startswith("|"):
+                cells = [c.strip() for c in ls.strip("|").split("|")]
+                lowered = [c.lower() for c in cells]
+                if "key" in lowered or "config key" in lowered:
+                    keycol = lowered.index("key") if "key" in lowered \
+                        else lowered.index("config key")
+                    continue
+                if keycol >= 0 and all(set(c) <= set("-: ")
+                                       for c in cells):
+                    continue   # header separator row
+                if keycol >= 0 and keycol < len(cells):
+                    for tok in re.findall(r"`([^`]+)`", cells[keycol]):
+                        tok = tok.split("[")[0].strip()
+                        if IDENT_RE.match(tok) and tok not in registry:
+                            registry[tok] = (path, i)
+            else:
+                keycol = -1
+    return texts, registry
+
+
+def conf_findings(project: Project, doc_dir: str) -> List[Finding]:
+    out: List[Finding] = []
+    texts, registry = doc_conf_keys(doc_dir)
+    if not texts:
+        return out
+    code = conf_code_keys(project)
+    for key in sorted(code):
+        pat = re.compile(r"\b%s\b" % re.escape(key))
+        if not any(pat.search(t) for t in texts.values()):
+            path, line = code[key]
+            out.append(Finding(
+                "conf-undocumented", path, line,
+                "conf key %r is read here but appears nowhere in "
+                "doc/*.md" % key, key=key))
+    for key in sorted(registry):
+        if key not in code:
+            path, line = registry[key]
+            out.append(Finding(
+                "conf-dead", path, line,
+                "doc registers conf key %r but nothing in the package "
+                "reads it" % key, key=key))
+    return out
+
+
+# ----------------------------------------------------------------------
+# metric registry
+# ----------------------------------------------------------------------
+
+def metric_findings(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    series: Dict[str, dict] = {}
+    for mod in project.modules.values():
+        for node in mod.nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in METRIC_FUNCS:
+                recv = dotted(f.value) or ""
+                if recv not in ("telemetry", "reg", "self.reg") \
+                        and not recv.endswith(".telemetry"):
+                    continue
+                name = const_str(node.args[0]) if node.args else None
+                if name is None:
+                    continue
+                ent = series.setdefault(
+                    name, {"types": set(), "site": (mod.path,
+                                                    node.lineno)})
+                ent["types"].add(METRIC_FUNCS[f.attr])
+            elif isinstance(f, ast.Name) and f.id == "emit" \
+                    and len(node.args) >= 2:
+                name = const_str(node.args[0])
+                mtype = const_str(node.args[1])
+                if name is None or mtype is None:
+                    continue
+                if not PROM_NAME_RE.match(name):
+                    out.append(Finding(
+                        "metric-name", mod.path, node.lineno,
+                        "invalid Prometheus metric name %r" % name,
+                        key=name))
+                if mtype == "counter" and not name.endswith("_total"):
+                    out.append(Finding(
+                        "metric-suffix", mod.path, node.lineno,
+                        "Prometheus counter %r must end in _total"
+                        % name, key=name))
+    for name in sorted(series):
+        ent = series[name]
+        path, line = ent["site"]
+        if not METRIC_NAME_RE.match(name):
+            out.append(Finding(
+                "metric-name", path, line,
+                "telemetry series name %r outside [A-Za-z0-9_./]"
+                % name, key=name))
+        if len(ent["types"]) > 1:
+            out.append(Finding(
+                "metric-type", path, line,
+                "series %r used as %s — one name, one type"
+                % (name, " AND ".join(sorted(ent["types"]))), key=name))
+        if "counter" in ent["types"] and name.endswith("_total"):
+            out.append(Finding(
+                "metric-suffix", path, line,
+                "counter %r already ends in _total; statusd appends it"
+                % name, key=name))
+        if "histogram" in ent["types"] and name.endswith("_seconds"):
+            out.append(Finding(
+                "metric-suffix", path, line,
+                "histogram %r already ends in _seconds; statusd "
+                "appends it" % name, key=name))
+    sanitized: Dict[str, Set[str]] = defaultdict(set)
+    for name in series:
+        sanitized[re.sub(r"[^A-Za-z0-9_]", "_", name)].add(name)
+    for snm, raws in sorted(sanitized.items()):
+        if len(raws) > 1:
+            first = sorted(raws)[0]
+            path, line = series[first]["site"]
+            out.append(Finding(
+                "metric-collision", path, line,
+                "series %s all sanitize to the same Prometheus name "
+                "cxxnet_%s" % (" / ".join(sorted(map(repr, raws))),
+                               snm),
+                key=snm))
+    return out
+
+
+# ----------------------------------------------------------------------
+# assembly: suppressions, baseline ratchet, CLI
+# ----------------------------------------------------------------------
+
+class LintResult:
+    def __init__(self, project, findings, edges, suppressed):
+        self.project = project
+        self.findings: List[Finding] = findings
+        self.edges = edges
+        self.suppressed: List[Finding] = suppressed
+
+
+def run_lint(root: str = ROOT, pkg: str = PKG,
+             doc_dir: Optional[str] = None) -> LintResult:
+    project = Project(root, pkg)
+    analyze_all(project)
+    findings: List[Finding] = []
+    for err in project.parse_errors:
+        findings.append(Finding("lock-cycle", err.split(":")[0], 0,
+                                "file failed to parse: " + err,
+                                key="parse-error"))
+    edges, lf = lock_analysis(project)
+    findings.extend(lf)
+    findings.extend(thread_findings(project))
+    findings.extend(wallclock_findings(project))
+    findings.extend(donated_reuse_findings(project))
+    findings.extend(traced_branch_findings(project))
+    findings.extend(timed_dispatch_findings(project))
+    findings.extend(conf_findings(
+        project, doc_dir or os.path.join(root, "doc")))
+    findings.extend(metric_findings(project))
+
+    by_path = {m.path: m for m in project.modules.values()}
+    kept: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in findings:
+        mod = by_path.get(f.path)
+        # a suppression covers its own line only — the comment-above
+        # style is handled by _index_suppressions propagating the entry
+        # to the first code line after the comment block; a blanket
+        # "line above" lookup would let an INLINE suppression silently
+        # cover the unrelated next statement too
+        sup = mod.suppress.get(f.line) if mod is not None else None
+        if sup is not None and (f.rule in sup[0] or "all" in sup[0]):
+            suppressed.append(f)
+        else:
+            kept.append(f)
+    for mod in project.modules.values():
+        for line, (rules, reason) in sorted(mod.suppress.items()):
+            if line <= len(mod.lines) \
+                    and not SUPPRESS_RE.search(mod.lines[line - 1]):
+                continue    # propagated block entry, not the comment
+            if not reason:
+                kept.append(Finding(
+                    "bad-suppression", mod.path, line,
+                    "suppression of %s carries no reason"
+                    % ",".join(sorted(rules)),
+                    key=",".join(sorted(rules))))
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    return LintResult(project, kept, edges, suppressed)
+
+
+def counts_of(findings: List[Finding], root: str) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for f in findings:
+        fp = f.fingerprint(root)
+        out[fp] = out.get(fp, 0) + 1
+    return out
+
+
+def ratchet(findings: List[Finding], root: str,
+            baseline: Dict[str, int]):
+    """-> (new, grandfathered, stale): new = findings past the baseline
+    allowance, grandfathered = findings the baseline covers, stale =
+    baseline fingerprints whose real count shrank below the recorded
+    one (the entry must shrink with the debt)."""
+    current = counts_of(findings, root)
+    allowance = dict(baseline)
+    new: List[Finding] = []
+    grandfathered: List[Finding] = []
+    for f in findings:
+        fp = f.fingerprint(root)
+        if allowance.get(fp, 0) > 0:
+            allowance[fp] -= 1
+            grandfathered.append(f)
+        else:
+            new.append(f)
+    stale = sorted(fp for fp, n in baseline.items()
+                   if current.get(fp, 0) < n)
+    return new, grandfathered, stale
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return {str(k): int(v) for k, v in data.items()}
+
+
+def topo_ranks(edges) -> List[str]:
+    nodes = sorted({n for e in edges for n in e})
+    indeg = {n: 0 for n in nodes}
+    for (a, b) in edges:
+        if a != b:
+            indeg[b] += 1
+    order: List[str] = []
+    ready = sorted(n for n in nodes if indeg[n] == 0)
+    adj = defaultdict(list)
+    for (a, b) in edges:
+        if a != b:
+            adj[a].append(b)
+    while ready:
+        n = ready.pop(0)
+        order.append(n)
+        for m in sorted(adj[n]):
+            indeg[m] -= 1
+            if indeg[m] == 0:
+                ready.append(m)
+                ready.sort()
+    return order
+
+
+def selftest(verbose: bool = False) -> int:
+    """The make-check gate: every package file parses, the full lint of
+    the clean tree agrees with the shipped baseline, and the whole run
+    stays fast enough to gate every PR (<5s)."""
+    t0 = time.monotonic()
+    res = run_lint()
+    n_mod = len(res.project.modules)
+    assert n_mod > 10, "package walk found only %d modules" % n_mod
+    assert not res.project.parse_errors, \
+        "analyzer failed to parse: %r" % res.project.parse_errors
+    new, _, stale = ratchet(res.findings, ROOT, load_baseline(BASELINE))
+    for f in new:
+        sys.stderr.write(f.render(ROOT) + "\n")
+    assert not new and not stale, (
+        "clean tree is not clean: %d new finding(s), %d stale baseline "
+        "entr(ies)" % (len(new), len(stale)))
+    dt = time.monotonic() - t0
+    assert dt < 5.0, "full-package lint took %.2fs (budget 5s)" % dt
+    assert res.edges, "lock graph came out empty — resolution broke"
+    if verbose:
+        print("cxxlint selftest: %d modules parsed, %d lock edges, "
+              "%d suppressed finding(s), clean in %.2fs"
+              % (n_mod, len(res.edges), len(res.suppressed), dt))
+    return 0
+
+
+def main(argv: List[str]) -> int:
+    if "--selftest" in argv:
+        return selftest(verbose=True)
+    verbose = "-v" in argv or "--verbose" in argv
+    res = run_lint()
+    if "--lock-graph" in argv:
+        for (a, b), (path, line) in sorted(res.edges.items()):
+            print("%s -> %s   (%s:%d)"
+                  % (a, b, os.path.relpath(path, ROOT), line))
+        return 0
+    if "--ranks" in argv:
+        for i, n in enumerate(topo_ranks(res.edges)):
+            print("%-28s %d" % (n, (i + 1) * 10))
+        return 0
+    if "--update-baseline" in argv:
+        counts = counts_of(res.findings, ROOT)
+        with open(BASELINE, "w", encoding="utf-8") as f:
+            json.dump(dict(sorted(counts.items())), f, indent=1,
+                      sort_keys=True)
+            f.write("\n")
+        print("cxxlint: baseline updated: %d fingerprint(s), %d "
+              "finding(s)" % (len(counts), sum(counts.values())))
+        return 0
+    baseline = load_baseline(BASELINE)
+    new, grandfathered, stale = ratchet(res.findings, ROOT, baseline)
+    for f in new:
+        print(f.render(ROOT))
+    if verbose:
+        for f in grandfathered:
+            print("baseline: " + f.render(ROOT).splitlines()[0])
+        for f in res.suppressed:
+            print("suppressed: " + f.render(ROOT).splitlines()[0])
+    for fp in stale:
+        print("stale baseline entry (fix landed — delete it from "
+              "tools/cxxlint_baseline.json): %s" % fp)
+    status = 1 if (new or stale) else 0
+    print("cxxlint: %d finding(s) (%d new, %d grandfathered, %d "
+          "suppressed), %d stale baseline entr%s -> %s"
+          % (len(res.findings), len(new), len(grandfathered),
+             len(res.suppressed), len(stale),
+             "y" if len(stale) == 1 else "ies",
+             "FAIL" if status else "ok"))
+    return status
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
