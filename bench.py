@@ -681,14 +681,86 @@ def bench_alexnet_pipeline(io_only=False):
     return out
 
 
-def _error_line(msg):
+def _error_line(msg, extra=None):
     """The one-JSON-line contract, structured-failure form: the driver
-    records a parseable line instead of a hang/timeout."""
-    return json.dumps({
+    records a parseable line instead of a hang/timeout. ``extra``
+    carries the analytic perf fields a CPU-side compile can still
+    produce with the tunnel down."""
+    row = {
         "metric": "alexnet_imagenet_images_per_sec_per_chip",
         "value": None, "unit": "images/sec/chip", "vs_baseline": None,
         "error": msg,
-    })
+    }
+    if extra:
+        row.update(extra)
+    return json.dumps(row)
+
+
+def _analytic_fields(model="alexnet"):
+    """The headline row's ANALYTIC perf fields, computed on CPU: lower
+    the same train step the bench would have run, read XLA
+    cost_analysis FLOPs + memory_analysis bytes, and predict the step
+    time against the TPU DeviceSpec (cxxnet_tpu/utils/perf.py — the
+    generation PALLAS_AXON_TPU_GEN names). The tunnel being down nulls
+    the MEASURED side only; these stay non-null so bench_compare and
+    roofline keep an analytic trajectory across unreachable rounds."""
+    import jax
+    from cxxnet_tpu.io.data import DataBatch
+    from cxxnet_tpu.models import alexnet_trainer
+    from cxxnet_tpu.utils import perf
+
+    batch = 256
+    tr = alexnet_trainer(batch_size=batch, input_hw=227, dev="cpu",
+                         extra_cfg=BF16)
+    rs = np.random.RandomState(0)
+    b = DataBatch()
+    b.data = rs.rand(batch, 3, 227, 227).astype(np.float32)
+    b.label = rs.randint(0, 1000, (batch, 1)).astype(np.float32)
+    b.batch_size = batch
+    lowered = tr.lower_update(b)
+    cost = lowered.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    m = lowered.compile().memory_analysis()
+    spec = perf.offline_spec()
+    flops = cost.get("flops")
+    # the ledger's own card math (ONE definition of the bound and the
+    # footprint — bench rows and /programz cannot drift apart)
+    pred = perf.predicted_seconds(flops, cost.get("bytes accessed"),
+                                  spec)
+    return {
+        "predicted_step_ms": round(1e3 * pred, 4) if pred is not None
+        else None,
+        "hbm_peak_bytes": perf.footprint_bytes(m),
+        "mfu_pct": None,            # needs a measured rate
+        "analytic": {"model": model, "batch": batch,
+                     "flops_per_step": flops, "spec": spec.name,
+                     "note": "CPU-lowered cost/memory analysis; "
+                             "predicted vs %s peaks" % spec.name},
+    }
+
+
+def _analytic_subprocess(timeout=240):
+    """Run the analytic compute in a bounded CPU child (the parent must
+    not import jax — a preloaded tunnel platform hangs); None on any
+    failure, never an exception."""
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               CXXNET_JAX_PLATFORM="cpu")
+    env.pop("_CXXNET_BENCH_CHILD", None)
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "analytic"],
+            capture_output=True, timeout=timeout, env=env)
+        if p.returncode != 0:
+            return None
+        for line in reversed(p.stdout.decode().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                return json.loads(line)
+    except Exception:
+        pass
+    return None
 
 
 def _probe_backend(attempts=4, probe_timeout=45, sleep_s=30):
@@ -714,6 +786,39 @@ def _probe_backend(attempts=4, probe_timeout=45, sleep_s=30):
     return False
 
 
+def _attach_perf(result):
+    """Fold the performance ledger's card for the row's main program
+    into the bench line: ``predicted_step_ms`` (roofline), ``mfu_pct``
+    (vs the measured step histogram), ``hbm_peak_bytes`` (XLA per-device
+    footprint). The ANALYTIC fields stay non-null wherever a program
+    compiled — including CPU runs with the TPU tunnel down — which is
+    what keeps the perf trajectory's denominator visible across null
+    rounds (tools/bench_compare.py gates the sub-fields opt-in)."""
+    from cxxnet_tpu.utils import perf
+    lg = perf.ledger()
+    if not lg.enabled:
+        return result
+    lg.drain(20.0)
+    snap = lg.snapshot()
+    card = None
+    # the row's main program: train rows compiled a train step; decode
+    # rows a decode scan; inference rows a predict program
+    for name in ("jit.train_step", "jit.decode_step", "jit.predict"):
+        ready = [c for c in snap["cards"]
+                 if c["name"] == name and c["status"] == "ready"]
+        if ready:
+            card = ready[-1]
+            break
+    if card is not None:
+        result["predicted_step_ms"] = (
+            round(card["predicted_s"] * 1e3, 4)
+            if card["predicted_s"] is not None else None)
+        result["hbm_peak_bytes"] = card["peak_bytes"]
+        result["mfu_pct"] = card["mfu_pct"]
+    lg.reset()
+    return result
+
+
 def _attach_telemetry(result):
     """Fold the per-phase telemetry breakdown (top spans, compile count/
     seconds, counters since the last bench) into a bench line, so
@@ -723,6 +828,9 @@ def _attach_telemetry(result):
     p50/p90/p99 tail a mean-throughput number hides."""
     from cxxnet_tpu.utils import telemetry
     if telemetry.enabled():
+        # the ledger joins the measured histograms, so it reads BEFORE
+        # the reset below wipes them
+        _attach_perf(result)
         # one summary() pass feeds both views (it sorts every span's
         # duration history — don't do that twice per bench line)
         s = telemetry.summary()
@@ -736,11 +844,14 @@ def _attach_telemetry(result):
 
 
 def _bench_main():
-    from cxxnet_tpu.utils import enable_compile_cache, telemetry
+    from cxxnet_tpu.utils import enable_compile_cache, perf, telemetry
     enable_compile_cache()
     # in-memory telemetry (no JSONL sink): each bench line gets the
     # spans/compiles recorded during ITS run attached by _attach_telemetry
     telemetry.enable()
+    # the program ledger: every bench row's compiled programs get
+    # cost/memory cards -> predicted_step_ms / mfu_pct / hbm_peak_bytes
+    perf.enable()
     if len(sys.argv) > 1 and sys.argv[1] == "all":
         for fn in (bench_mnist_mlp, bench_mnist_conv, bench_bowl,
                    bench_googlenet, bench_googlenet_b256,
@@ -779,11 +890,21 @@ def main():
         for line in bench_alexnet_pipeline(io_only=True):
             print(json.dumps(line), flush=True)
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "analytic":
+        # CPU-side analytic fields only (no device, no probe): the mode
+        # the unreachable path shells out to, also directly invocable
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(_analytic_fields()), flush=True)
+        return
     t0 = time.perf_counter()
     if not _probe_backend():
         print("backend unreachable; failing fast", file=sys.stderr,
               flush=True)
-        print(_error_line("backend unreachable (TPU tunnel down)"),
+        # the measured side is gone; the ANALYTIC side is not — a CPU
+        # child lowers the same step and predicts against the chip spec
+        print(_error_line("backend unreachable (TPU tunnel down)",
+                          extra=_analytic_subprocess()),
               flush=True)
         sys.exit(1)
     # watchdog budget scales with the mode and sits BELOW the outer

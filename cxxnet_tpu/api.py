@@ -247,6 +247,11 @@ class Net:
         # by an earlier frontend, or /metrics keeps exporting a dead
         # account the live frontend never feeds
         statusd.set_slo(fe.slo)
+        # /programz for embedders too: the module ledger cards this
+        # frontend's decode-program compiles once perf.enable() ran
+        # (learn_task wires it; library users call it themselves)
+        from .utils import perf
+        statusd.set_perf(perf.ledger())
         return fe
 
     def beam_generate(self, prompts: np.ndarray, n_new: int,

@@ -20,6 +20,7 @@ from .io import create_iterator
 from .nnet.trainer import Trainer, create_net
 from .utils import checkpoint as ckpt
 from .utils import health
+from .utils import perf
 from .utils import serializer
 from .utils import statusd
 from .utils import telemetry
@@ -61,6 +62,21 @@ class LearnTask:
         self.status_port = -1
         self.status_host = ""
         self._status_telemetry = False
+        # perf_ledger=1 (default): the live program performance ledger
+        # (utils/perf.py) — every compiled program gets a cost/memory
+        # card (XLA cost_analysis FLOPs, memory_analysis bytes, a
+        # roofline-predicted time vs the measured latency histogram),
+        # rendered at /programz, as cxxnet_program_*//cxxnet_hbm_*
+        # metrics, and as program_card JSONL events. Armed only when
+        # telemetry is on (telemetry_log or status_port); the memory
+        # tier pays one background re-compile per new program — set
+        # perf_ledger=0 to card nothing.
+        self.perf_ledger = 1
+        # profilez_dir=<dir>: where /profilez?secs=N on-demand profiler
+        # captures land (one numbered subdir per capture). Default:
+        # "profilez" next to the telemetry log (or ./profilez).
+        self.profilez_dir = ""
+        self._perf_enabled = False
         self.silent = 0
         self.start_counter = 0
         self.max_round = 1 << 31
@@ -210,6 +226,20 @@ class LearnTask:
                     print("statusd: live introspection on port %d "
                           "(/metrics /healthz /livez /statusz /trace)"
                           % srv.port, file=sys.stderr, flush=True)
+        if statusd.active() is not None:
+            # /profilez rides statusd alone — on-demand profiling has
+            # no dependency on (and must survive disabling) the ledger
+            pdir = self.profilez_dir or os.path.join(
+                os.path.dirname(self.telemetry_log) or ".", "profilez")
+            statusd.set_profiler(perf.ProfilerCapture(pdir))
+        if self.perf_ledger and telemetry.enabled():
+            # the program performance ledger rides the recompile
+            # detector: every program this run compiles gets a
+            # cost/memory card (/programz, cxxnet_program_* series,
+            # program_card JSONL events)
+            perf.enable()
+            self._perf_enabled = True
+            statusd.set_perf(perf.ledger())
         try:
             with telemetry.span("init"):
                 self.init()
@@ -234,6 +264,19 @@ class LearnTask:
             elif self.task == "serve":
                 self.task_serve()
         finally:
+            if self._perf_enabled:
+                # let queued card analyses land in the JSONL before the
+                # summary event seals the log
+                perf.drain(10.0)
+                perf.disable()
+                self._perf_enabled = False
+            srv = statusd.active()
+            if srv is not None and srv.profiler is not None:
+                # an in-flight /profilez capture must be stopped and
+                # JOINED before teardown — a daemon thread inside
+                # native profiler code at interpreter exit segfaults,
+                # turning a clean drain into rc -11
+                srv.profiler.shutdown()
             if self.status_port >= 0:
                 statusd.stop()
             if self.telemetry_log:
@@ -283,6 +326,10 @@ class LearnTask:
             self.telemetry_log = val
         if name == "status_port":
             self.status_port = int(val)
+        if name == "perf_ledger":
+            self.perf_ledger = int(val)
+        if name == "profilez_dir":
+            self.profilez_dir = val
         if name == "status_host":
             self.status_host = val
         if name == "ckpt_keep_last":

@@ -1089,8 +1089,12 @@ class Trainer:
             cause = ("rebuild_after_clear" if key in self._jit_seen_keys
                      else "new_signature")
             self._jit_seen_keys.add(key)
+            # the cache key rides the compile event and the perf
+            # ledger's ProgramCard (utils/perf.py) as the program's
+            # stable identity
             self._jit_cache[key] = telemetry.jit_watch(build(), name,
-                                                       cause=cause)
+                                                       cause=cause,
+                                                       key=key)
         return self._jit_cache[key]
 
     def _get_step(self, do_update: bool, accumulate: bool,
@@ -1517,14 +1521,16 @@ class Trainer:
             self._decode_fns[fkey] = (
                 telemetry.jit_watch(
                     jax.jit(run_prefill, donate_argnums=(0,)),
-                    "jit.decode_prefill", cause=cause),
+                    "jit.decode_prefill", cause=cause,
+                    key=("decode", b) + fkey),
                 telemetry.jit_watch(
                     # toks flows prefill -> decode exactly once and is
                     # returned: donate it so the scan updates in place
                     # (caches are NOT donated — they have no matching
                     # output to alias, so donation would only warn)
                     jax.jit(run_decode, donate_argnums=(0, 1)),
-                    "jit.decode_step", cause=cause))
+                    "jit.decode_step", cause=cause,
+                    key=("decode", b) + fkey))
         toks0 = np.zeros((b, l_max), np.int32)
         toks0[:, :max_p] = prompts
         # (padding beyond a ragged row's real prompt is never read: the
@@ -1778,7 +1784,8 @@ class Trainer:
                 return jnp.take(hist, rows, axis=0), scores, params
 
             self._beam_fns[fkey] = telemetry.jit_watch(
-                jax.jit(run, donate_argnums=(0,)), "jit.beam_decode")
+                jax.jit(run, donate_argnums=(0,)), "jit.beam_decode",
+                key=("beam", b, B) + fkey)
         toks0 = np.zeros((b, l_max), np.int32)
         toks0[:, :plen] = prompts
         try:

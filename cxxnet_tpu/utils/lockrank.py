@@ -71,7 +71,10 @@ RANKS = {
     "servd.breaker": 60,    # CircuitBreaker._lock
     "statusd.slo": 70,      # SLOTracker._lock — emits telemetry under it
     "health.ids": 80,       # health anomaly-id allocation
+    "perf.profilez": 85,    # ProfilerCapture._lock — capture guard
     "telemetry.flight": 90,   # FlightRecorder._ring
+    "perf.ledger": 95,      # Ledger._cond — emits program_card events
+    #                         and reads registry hists under it
     "telemetry.registry": 100,  # _Registry._lock — innermost by design:
     #                             every subsystem records telemetry, so
     #                             nothing may be acquired under it

@@ -1,0 +1,929 @@
+"""Live program performance ledger: per-compiled-program cost/memory
+cards, MFU & roofline-efficiency gauges, HBM headroom accounting, and an
+on-demand profiler capture guard.
+
+The offline tools already knew how to compute "is it fast / does it
+fit": ``tools/roofline.py`` counts analytic FLOPs against the chip peak,
+``tools/memory_report.py`` compiles a step and reads XLA's
+``memory_analysis()``, ``tools/profile_bench.py`` captures an xprof
+trace. None of that fed the *running* system — production ML infra
+treats cost models as first-class runtime objects (TF's system paper,
+arXiv:1605.08695) and compile-time cost metadata as the optimization
+currency (TVM, arXiv:1802.04799). This module is that runtime spine:
+
+* **DeviceSpec** — the shared peak-FLOP/s + HBM-bandwidth + HBM-capacity
+  table per platform (``DEVICE_SPECS``). The offline tools consume the
+  SAME table (``tools/roofline.py`` delegates ``peak_flops()`` /
+  ``peak_hbm_bytes()`` here), so live and offline numbers can never
+  disagree. A ``cpu`` entry (nominal, documented) makes every gauge
+  testable without the TPU tunnel; ``CXXNET_PEAK_TFLOPS`` /
+  ``CXXNET_PEAK_HBM_GBS`` / ``CXXNET_HBM_CAPACITY_GIB`` override any
+  entry, ``PALLAS_AXON_TPU_GEN`` picks the TPU generation.
+
+* **ProgramCard** — one card per (program name, input-shapes signature)
+  the trainer compiles. The recompile detector
+  (``telemetry.jit_watch``) already sees every compile; with the ledger
+  enabled it hands the compiled callable + its call arguments to
+  ``Ledger.on_compile``, which records the compile wall time
+  immediately and queues an analysis job. The **carder thread**
+  completes the card off the hot path: ``fn.lower(shapes)`` (the trace
+  is cached from the triggering call — milliseconds) yields XLA
+  ``cost_analysis()`` FLOPs + bytes accessed; ``lowered.compile()``
+  (a real second compile — the reason this runs on a background
+  thread, never inside a serving request) yields ``memory_analysis()``
+  argument/temp/output bytes per device. A roofline-predicted
+  execution time falls out: ``max(flops/peak_flops, bytes/hbm_bw)``.
+
+* **live gauges** — ``snapshot()`` joins each card against the
+  program's *measured* latency histogram (``MEASURED_SERIES``: the
+  telemetry series the trainer already feeds — ``train.step``,
+  ``decode.prefill``, ``decode.decode``, ...):
+  ``mfu_pct`` = flops / (measured p50 x peak), ``roofline_eff_pct`` =
+  predicted / measured p50 (under 100 = slower than the hardware
+  allows; over 100 usually means the measured series times DISPATCH,
+  not execution — flagged in doc/performance.md). Aggregates:
+  ``hbm_peak_bytes`` (max per-device peak over cards — the number the
+  paged-KV allocator will be sized against) and ``hbm_headroom_bytes``
+  vs the spec capacity. statusd renders all of it: ``/programz`` (the
+  per-program table), ``/metrics`` (``cxxnet_program_*`` /
+  ``cxxnet_hbm_*`` series), and each completed card lands in the
+  telemetry JSONL as a ``program_card`` event for
+  ``tools/telemetry_report.py``'s program-ledger section.
+
+* **ProfilerCapture** — the guard behind statusd's ``/profilez?secs=N``:
+  one jax.profiler trace capture at a time into a run-scoped directory
+  (conf key ``profilez_dir``), so a live slow replica can be xprof'd
+  without restarting it. Injectable trace function keeps it testable
+  (and the selftest) jax-free.
+
+Jax-free at import (like servd/statusd/health): jax is imported lazily
+inside the capture paths, which only run after a jitted call already
+proved jax present. ``python -m cxxnet_tpu.utils.perf --selftest``
+exercises card math, gauge rendering, /programz + /profilez over a real
+socket, and the capture guard with faked analyses; ``make check`` gates
+on it. Enabled via the conf key ``perf_ledger`` (learn_task wires it
+whenever telemetry is on); disabled, the only cost is the recompile
+detector's existing bookkeeping.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import zlib
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from . import lockrank
+from . import telemetry
+
+__all__ = [
+    "DeviceSpec", "DEVICE_SPECS", "device_spec", "offline_spec",
+    "current_device_spec", "MEASURED_SERIES", "Ledger", "ProfilerCapture",
+    "ledger", "enable", "disable", "enabled", "drain", "reset",
+    "decode_bound_tokens_per_s", "shapes_signature", "predicted_seconds",
+    "footprint_bytes", "selftest",
+]
+
+
+class DeviceSpec:
+    """One platform's roofline constants: peak matmul FLOP/s (bf16),
+    HBM bandwidth (bytes/s), and per-device HBM capacity (bytes). The
+    single source the live ledger AND the offline tools read."""
+
+    __slots__ = ("name", "peak_flops", "hbm_bw", "hbm_capacity")
+
+    def __init__(self, name: str, peak_flops: float, hbm_bw: float,
+                 hbm_capacity: float):
+        self.name = name
+        self.peak_flops = float(peak_flops)
+        self.hbm_bw = float(hbm_bw)
+        self.hbm_capacity = float(hbm_capacity)
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "peak_flops": self.peak_flops,
+                "hbm_bw": self.hbm_bw, "hbm_capacity": self.hbm_capacity}
+
+    def __repr__(self):
+        return ("DeviceSpec(%s, %.0f GFLOP/s, %.0f GB/s, %.1f GiB)"
+                % (self.name, self.peak_flops / 1e9, self.hbm_bw / 1e9,
+                   self.hbm_capacity / 2**30))
+
+
+# bf16 peak / HBM bandwidth / per-device HBM capacity per chip
+# generation (v5e = "v5 lite"). The ``cpu`` entry is NOMINAL — a
+# few-core container has no single honest peak — chosen so MFU%/headroom
+# stay meaningful (and overridable) in tunnel-down CPU runs; every field
+# yields to the CXXNET_PEAK_* env overrides below.
+DEVICE_SPECS: Dict[str, DeviceSpec] = {
+    "v5e": DeviceSpec("v5e", 197.0e12, 819.0e9, 16 * 2.0**30),
+    "v5lite": DeviceSpec("v5lite", 197.0e12, 819.0e9, 16 * 2.0**30),
+    "v4": DeviceSpec("v4", 275.0e12, 1228.0e9, 32 * 2.0**30),
+    "v6e": DeviceSpec("v6e", 918.0e12, 1638.0e9, 32 * 2.0**30),
+    "cpu": DeviceSpec("cpu", 0.2e12, 25.0e9, 16 * 2.0**30),
+}
+
+
+def device_spec(gen: Optional[str] = None) -> DeviceSpec:
+    """The spec for a generation name (default: the offline tools'
+    ``PALLAS_AXON_TPU_GEN`` convention, v5e when unset), with the env
+    overrides applied: ``CXXNET_PEAK_TFLOPS``, ``CXXNET_PEAK_HBM_GBS``,
+    ``CXXNET_HBM_CAPACITY_GIB``. Unknown generations fall back to v5e
+    (the fleet default), like tools/roofline.py always did."""
+    if gen is None:
+        gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e").lower()
+    base = DEVICE_SPECS.get(gen, DEVICE_SPECS["v5e"])
+    name, peak, bw, cap = base.name, base.peak_flops, base.hbm_bw, \
+        base.hbm_capacity
+    env = os.environ.get("CXXNET_PEAK_TFLOPS")
+    if env:
+        peak = float(env) * 1e12
+    env = os.environ.get("CXXNET_PEAK_HBM_GBS")
+    if env:
+        bw = float(env) * 1e9
+    env = os.environ.get("CXXNET_HBM_CAPACITY_GIB")
+    if env:
+        cap = float(env) * 2.0**30
+    if (peak, bw, cap) != (base.peak_flops, base.hbm_bw,
+                           base.hbm_capacity):
+        return DeviceSpec(name + "+env", peak, bw, cap)
+    return base
+
+
+def offline_spec() -> DeviceSpec:
+    """The chip the OFFLINE tools model (roofline.py, memory_report.py):
+    always a TPU generation — an analysis run on a CPU box is still
+    asking "how would this do on the chip"."""
+    return device_spec()
+
+
+def current_device_spec() -> DeviceSpec:
+    """The spec for the platform THIS process actually runs on: the cpu
+    entry under ``JAX_PLATFORMS=cpu`` (so live gauges are testable with
+    the tunnel down), the REAL chip generation (device_kind) on an
+    accelerator backend — ``PALLAS_AXON_TPU_GEN`` still overrides —
+    and the cpu fallback when jax is absent entirely (jax-free tests).
+
+    CONTRACT: call only after the backend is up (a jit ran, a device
+    was probed) — ``jax.default_backend()`` initializes the platform,
+    and doing that before the trainer's platform selection would
+    re-introduce the tunnel-down hang doc/performance.md warns about.
+    The ledger therefore resolves its spec LAZILY at first card
+    completion, never at enable() time."""
+    try:
+        import jax
+        backend = jax.default_backend()
+    except Exception:
+        backend = "cpu"
+    if backend == "cpu":
+        return device_spec("cpu")
+    if not os.environ.get("PALLAS_AXON_TPU_GEN"):
+        try:
+            kind = jax.devices()[0].device_kind.lower()
+        except Exception:
+            kind = ""
+        # "TPU v5 lite" / "TPU v4" / "TPU v6 lite" -> table keys
+        for token, gen in (("v6", "v6e"), ("v5", "v5e"), ("v4", "v4")):
+            if token in kind:
+                return device_spec(gen)
+    return offline_spec()
+
+
+# program name -> the telemetry histogram that MEASURES its executions
+# (the join key between a card's predicted time and reality). These are
+# the series the trainer already feeds; doc/observability.md notes
+# which ones time dispatch rather than execution.
+MEASURED_SERIES = {
+    "jit.train_step": "train.step",
+    "jit.eval_fwd": "eval.forward",
+    "jit.predict": "predict",
+    "jit.decode_prefill": "decode.prefill",
+    "jit.decode_step": "decode.decode",
+    "jit.beam_decode": "decode.beam",
+}
+
+_DTYPE_SHORT = {
+    "float32": "f32", "float64": "f64", "float16": "f16",
+    "bfloat16": "bf16", "int32": "i32", "int64": "i64", "int8": "i8",
+    "uint8": "u8", "uint32": "u32", "bool": "b1",
+}
+
+
+def _leaves(obj):
+    """Jax-free pytree leaf walk (list/tuple/dict containers — the only
+    shapes the trainer's call signatures use)."""
+    if isinstance(obj, (list, tuple)):
+        for v in obj:
+            yield from _leaves(v)
+    elif isinstance(obj, dict):
+        for k in sorted(obj, key=str):
+            yield from _leaves(obj[k])
+    else:
+        yield obj
+
+
+def shapes_signature(args, kwargs=None) -> Tuple[str, str]:
+    """(display, hash) signature of a call's input shapes/dtypes —
+    the card key's second half. Duck-typed (``.shape``/``.dtype``), so
+    fakes work jax-free; non-array leaves (None, python scalars) are
+    folded in by repr. The display form is truncated for tables; the
+    crc32 hash is the stable key."""
+    toks: List[str] = []
+    for leaf in _leaves((args, kwargs or {})):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            toks.append("%s[%s]" % (
+                _DTYPE_SHORT.get(str(dtype), str(dtype)),
+                ",".join(str(int(d)) for d in shape)))
+        elif leaf is None:
+            continue
+        else:
+            toks.append(repr(leaf)[:16])
+    full = ",".join(toks)
+    h = "%08x" % (zlib.crc32(full.encode("utf-8", "replace"))
+                  & 0xffffffff)
+    if len(full) > 56:
+        disp = "%s..(%d args)#%s" % (full[:40], len(toks), h)
+    else:
+        disp = full or "()"
+    return disp, h
+
+
+def _mem_field(mem, name):
+    """Read one memory_analysis field from either the XLA stats object
+    (attributes) or a faked dict (tests)."""
+    if mem is None:
+        return None
+    if isinstance(mem, dict):
+        v = mem.get(name)
+    else:
+        v = getattr(mem, name, None)
+    return int(v) if v is not None else None
+
+
+def predicted_seconds(flops, bytes_accessed,
+                      spec: DeviceSpec) -> Optional[float]:
+    """THE roofline execution-time bound: max(flops/peak, bytes/bw) —
+    one definition shared by the live ledger and bench's analytic rows
+    so the two can never disagree. None when neither term is known."""
+    bounds = []
+    if flops is not None and spec.peak_flops > 0:
+        bounds.append(float(flops) / spec.peak_flops)
+    if bytes_accessed is not None and spec.hbm_bw > 0:
+        bounds.append(float(bytes_accessed) / spec.hbm_bw)
+    return max(bounds) if bounds else None
+
+
+def footprint_bytes(mem) -> Optional[int]:
+    """THE per-device program footprint: XLA argument+temp+output bytes
+    (the total tools/memory_report.py prints) — shared definition, same
+    reason as ``predicted_seconds``. Accepts the XLA stats object or a
+    faked dict; None when no field is present."""
+    parts = [_mem_field(mem, k) for k in
+             ("argument_size_in_bytes", "temp_size_in_bytes",
+              "output_size_in_bytes")]
+    if all(v is None for v in parts):
+        return None
+    return sum(v or 0 for v in parts)
+
+
+class Ledger:
+    """The program performance ledger: cards keyed by (program name,
+    shapes hash), completed asynchronously by the carder thread, joined
+    against measured latency histograms at snapshot time. One per
+    process (the module singleton); tests build isolated instances
+    against private telemetry registries."""
+
+    def __init__(self, registry=None, spec: Optional[DeviceSpec] = None):
+        # ranked between telemetry.flight and telemetry.registry: card
+        # completion emits the program_card event under this lock (the
+        # SLOTracker precedent — completion order must match log order)
+        self._cond = lockrank.condition("perf.ledger")
+        self._registry = registry
+        self.spec = spec
+        self.enabled = False
+        self._cards: Dict[Tuple[str, str], dict] = {}
+        self._order: List[Tuple[str, str]] = []
+        self._jobs: deque = deque()
+        self._busy = 0
+        self._thread: Optional[threading.Thread] = None
+
+    def _reg(self):
+        return self._registry if self._registry is not None \
+            else telemetry._REG
+
+    # -- lifecycle -----------------------------------------------------
+    def enable(self, spec: Optional[DeviceSpec] = None) -> "Ledger":
+        """Arm the ledger and hook the recompile detector. The spec
+        stays UNRESOLVED unless given: enable() runs before the trainer
+        selects a platform, and probing jax here would initialize the
+        wrong backend (or hang on a down tunnel). It resolves lazily —
+        via ``current_device_spec()`` — at first card completion /
+        snapshot, when a jit provably already ran."""
+        with self._cond:
+            if spec is not None:
+                self.spec = spec
+            self.enabled = True
+        self._reg().compile_hook = self.on_compile
+        return self
+
+    def disable(self, join_timeout: float = 20.0) -> None:
+        """Unhook, drop queued jobs, and JOIN the carder thread
+        (bounded): a daemon thread still inside a native XLA compile at
+        interpreter teardown segfaults the process — the same crash
+        class ProfilerCapture.shutdown() guards against."""
+        reg = self._reg()
+        if reg.compile_hook == self.on_compile:
+            reg.compile_hook = None
+        with self._cond:
+            self.enabled = False
+            self._jobs.clear()
+            self._cond.notify_all()
+            t = self._thread
+        if t is not None and t.is_alive():
+            t.join(join_timeout)
+
+    def reset(self) -> None:
+        with self._cond:
+            self._cards.clear()
+            del self._order[:]
+            self._jobs.clear()
+
+    # -- capture -------------------------------------------------------
+    def on_compile(self, name: str, cause: str, seconds: float,
+                   fn=None, args=(), kwargs=None, key=None) -> None:
+        """The recompile detector's hook: called once per genuinely new
+        (program, signature) compile with the jitted callable and the
+        triggering call's arguments. Records compile wall time NOW;
+        queues the cost/memory analysis for the carder thread (the
+        memory tier pays a real second compile — never on this, the
+        hot, thread). Never raises: a ledger bug must not kill a train
+        step or a served request."""
+        try:
+            if not self.enabled:
+                return
+            disp, h = shapes_signature(args, kwargs)
+            with self._cond:
+                existing = self._cards.get((name, h))
+                need = fn is not None and (existing is None
+                                           or existing["status"] == "new")
+            # abstractify OUTSIDE the lock (the work-outside-the-lock
+            # rule the carder follows): shape/dtype/sharding metadata
+            # survives donation, the buffers may not, and a big params
+            # pytree walk must not block a /metrics scrape — and only
+            # for a card that still needs analysis (a reload's
+            # rebuild_after_clear re-compiles already-carded programs)
+            structs = self._abstractify(args, kwargs) if need else None
+            with self._cond:
+                card = self._cards.get((name, h))
+                if card is None:
+                    card = self._new_card(name, h, disp, cause, key)
+                    self._cards[(name, h)] = card
+                    self._order.append((name, h))
+                card["compiles"] += 1
+                card["compile_s"] = round(card["compile_s"]
+                                          + float(seconds), 6)
+                if card["status"] == "new" and fn is not None:
+                    if structs is not None:
+                        card["status"] = "pending"
+                        self._jobs.append((name, h, fn, structs[0],
+                                           structs[1]))
+                        self._cond.notify()
+                        self._ensure_thread()
+                    else:
+                        card["status"] = "error"
+                        card["error"] = "could not abstract call args"
+            reg = self._reg()
+            reg.count("perf.compile_hooks")
+        except Exception:
+            reg = self._reg()
+            reg.count("perf.capture_errors")
+
+    @staticmethod
+    def _new_card(name, h, disp, cause, key) -> dict:
+        return {"name": name, "shapes": disp, "sig": h,
+                "key": str(key) if key is not None else None,
+                "cause": cause, "compiles": 0, "compile_s": 0.0,
+                "flops": None, "bytes_accessed": None,
+                "arg_bytes": None, "temp_bytes": None, "out_bytes": None,
+                "gen_code_bytes": None, "peak_bytes": None,
+                "predicted_s": None, "status": "new", "error": None}
+
+    @staticmethod
+    def _abstractify(args, kwargs):
+        """jax.ShapeDtypeStruct pytrees mirroring the call's arguments
+        (shape + dtype + sharding — metadata that survives donated
+        buffers being consumed). None on any surprise."""
+        try:
+            import jax
+
+            def struct(a):
+                shape = getattr(a, "shape", None)
+                dtype = getattr(a, "dtype", None)
+                if shape is None or dtype is None:
+                    return a          # python scalar / None: pass through
+                sharding = getattr(a, "sharding", None)
+                try:
+                    return jax.ShapeDtypeStruct(shape, dtype,
+                                                sharding=sharding)
+                except Exception:
+                    return jax.ShapeDtypeStruct(shape, dtype)
+
+            def walk(o):
+                if isinstance(o, (list, tuple)):
+                    return type(o)(walk(v) for v in o)
+                if isinstance(o, dict):
+                    return {k: walk(v) for k, v in o.items()}
+                return struct(o)
+
+            return walk(list(args)), walk(dict(kwargs or {}))
+        except Exception:
+            return None
+
+    def _ensure_thread(self) -> None:
+        # under the lock
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._carder, name="cxn-perf-carder", daemon=True)
+            self._thread.start()
+
+    def _carder(self) -> None:
+        """Background card completion: one analysis job at a time, the
+        lower/compile work OUTSIDE the lock (a compile in here must
+        never block a scrape or the next on_compile)."""
+        while True:
+            with self._cond:
+                while not self._jobs and self.enabled:
+                    self._cond.wait(timeout=1.0)
+                if not self._jobs:
+                    if not self.enabled:
+                        return
+                    continue
+                name, h, fn, sargs, skwargs = self._jobs.popleft()
+                self._busy += 1
+            cost = mem = None
+            err = None
+            try:
+                cost, mem = self._capture(fn, sargs, skwargs)
+            except Exception as e:
+                err = "%s: %s" % (type(e).__name__, e)
+            try:
+                self.complete_card(name, h, cost=cost, mem=mem, error=err)
+            finally:
+                with self._cond:
+                    self._busy -= 1
+                    self._cond.notify_all()
+
+    @staticmethod
+    def _capture(fn, sargs, skwargs):
+        """(cost_analysis dict, memory stats) of the program, from a
+        re-lower (cheap: the trace cache is warm from the triggering
+        call) + a second compile (the expensive half — why this runs on
+        the carder thread)."""
+        lowered = fn.lower(*sargs, **skwargs)
+        cost = lowered.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        mem = lowered.compile().memory_analysis()
+        return (dict(cost) if cost else {}), mem
+
+    def complete_card(self, name: str, sig: str, cost=None, mem=None,
+                      error: Optional[str] = None) -> Optional[dict]:
+        """Fill a card's analysis fields (XLA dicts/objects or faked
+        test dicts), compute the roofline prediction, and publish the
+        ``program_card`` telemetry event. Public so jax-free tests (and
+        the selftest) can exercise the math with faked analyses."""
+        spec = self.spec or current_device_spec()
+        with self._cond:
+            card = self._cards.get((name, sig))
+            if card is None:
+                card = self._new_card(name, sig, sig, "unknown", None)
+                self._cards[(name, sig)] = card
+                self._order.append((name, sig))
+            if error is not None:
+                card["status"] = "error"
+                card["error"] = error[:200]
+            else:
+                card["status"] = "ready"
+                if cost:
+                    f = cost.get("flops")
+                    b = cost.get("bytes accessed")
+                    card["flops"] = float(f) if f is not None else None
+                    card["bytes_accessed"] = float(b) if b is not None \
+                        else None
+                card["arg_bytes"] = _mem_field(mem,
+                                               "argument_size_in_bytes")
+                card["temp_bytes"] = _mem_field(mem, "temp_size_in_bytes")
+                card["out_bytes"] = _mem_field(mem, "output_size_in_bytes")
+                card["gen_code_bytes"] = _mem_field(
+                    mem, "generated_code_size_in_bytes")
+                card["peak_bytes"] = footprint_bytes(mem)
+                card["predicted_s"] = predicted_seconds(
+                    card["flops"], card["bytes_accessed"], spec)
+            # the spec's peaks ride the event so the offline report can
+            # recompute MFU/eff joins without guessing the chip
+            ev = {"ev": "program_card", "spec": spec.name,
+                  "spec_peak_flops": spec.peak_flops,
+                  "spec_hbm_bw": spec.hbm_bw}
+            ev.update({k: card[k] for k in (
+                "name", "shapes", "sig", "key", "cause", "compiles",
+                "compile_s", "flops", "bytes_accessed", "arg_bytes",
+                "temp_bytes", "out_bytes", "peak_bytes", "predicted_s",
+                "status", "error")})
+            reg = self._reg()
+            reg.count("perf.cards")
+            reg.record(ev)
+            return dict(card)
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Wait for queued analysis jobs to finish (bench rows and the
+        end-of-run flush want complete cards). True when idle."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._jobs or self._busy:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cond.wait(timeout=min(0.2, left))
+        return True
+
+    # -- views ---------------------------------------------------------
+    def cards(self) -> List[dict]:
+        """Insertion-ordered card copies."""
+        with self._cond:
+            return [dict(self._cards[k]) for k in self._order]
+
+    def card(self, name: str) -> Optional[dict]:
+        """The most recent card for a program name (any signature)."""
+        with self._cond:
+            for k in reversed(self._order):
+                if k[0] == name:
+                    return dict(self._cards[k])
+        return None
+
+    def snapshot(self) -> dict:
+        """Everything the surfaces render: the spec, the cards joined
+        against their measured latency histograms (mfu_pct /
+        roofline_eff_pct / measured p50+p99), and the HBM account
+        (peak = max card footprint; headroom vs spec capacity)."""
+        spec = self.spec or current_device_spec()
+        cards = self.cards()
+        needed = {MEASURED_SERIES.get(c["name"]) for c in cards}
+        needed.discard(None)
+        reg = self._reg()
+        stats: Dict[str, dict] = {}
+        if needed:
+            with reg._lock:
+                for s in needed:
+                    hist = reg.hists.get(s)
+                    if hist is not None and hist.n:
+                        stats[s] = hist.stats()
+        by_name: Dict[str, int] = {}
+        for c in cards:
+            by_name[c["name"]] = by_name.get(c["name"], 0) + 1
+        peak = None
+        for c in cards:
+            series = MEASURED_SERIES.get(c["name"])
+            st = stats.get(series) if series else None
+            c["measured_series"] = series
+            # the measured histogram is per program NAME: with several
+            # live signatures (decode buckets, train-shape variants)
+            # each card's mfu/eff joins a p50 that AGGREGATES its
+            # siblings — flagged so /programz readers and the report
+            # interpret multi-signature joins accordingly
+            c["series_shared_by"] = by_name[c["name"]]
+            c["measured_n"] = st["count"] if st else 0
+            c["measured_p50_ms"] = st["p50_ms"] if st else None
+            c["measured_p99_ms"] = st["p99_ms"] if st else None
+            c["mfu_pct"] = c["roofline_eff_pct"] = None
+            if st and st["p50_ms"]:
+                p50_s = st["p50_ms"] / 1e3
+                if c["flops"] is not None and spec.peak_flops > 0:
+                    c["mfu_pct"] = round(
+                        100.0 * c["flops"] / (p50_s * spec.peak_flops), 2)
+                if c["predicted_s"] is not None:
+                    c["roofline_eff_pct"] = round(
+                        100.0 * c["predicted_s"] / p50_s, 2)
+            if c["peak_bytes"] is not None:
+                peak = max(peak or 0, c["peak_bytes"])
+        hbm = {"capacity_bytes": spec.hbm_capacity,
+               "peak_bytes": peak,
+               "headroom_bytes": (spec.hbm_capacity - peak)
+               if peak is not None else None}
+        return {"spec": spec.to_dict(), "enabled": self.enabled,
+                "cards": cards, "hbm": hbm}
+
+
+class ProfilerCapture:
+    """The /profilez guard: at most ONE jax.profiler trace capture at a
+    time, each into a fresh numbered subdirectory of the run-scoped
+    ``outdir`` (conf key ``profilez_dir``). ``start(secs)`` returns
+    (ok, detail) immediately — the capture itself runs on a daemon
+    thread so the HTTP handler never blocks for the capture window.
+    ``trace_fn(secs, path)`` is injectable for jax-free tests; the
+    default imports jax and brackets ``start_trace``/``stop_trace``."""
+
+    MAX_SECS = 120.0
+
+    def __init__(self, outdir: str, trace_fn=None):
+        self.outdir = outdir
+        self._trace_fn = trace_fn or self._jax_trace
+        self._lock = lockrank.lock("perf.profilez")
+        self._busy = False
+        # shutdown() sets _stop to cut an in-flight capture short (the
+        # default trace fn polls it between sleep slices) and LATCHES
+        # _shutdown so a racing /profilez request cannot start a fresh
+        # capture thread into interpreter teardown
+        self._stop = threading.Event()
+        self._shutdown = False
+        self._thread: Optional[threading.Thread] = None
+        self.captures = 0
+        self.last_path: Optional[str] = None
+        self.last_error: Optional[str] = None
+
+    def _jax_trace(self, secs: float, path: str) -> None:
+        import jax
+        jax.profiler.start_trace(path)
+        try:
+            # sliced sleep so shutdown() can end the capture early (a
+            # preemption must not wait out a 120s window)
+            deadline = time.monotonic() + secs
+            while time.monotonic() < deadline \
+                    and not self._stop.is_set():
+                time.sleep(min(0.2, max(0.0,
+                                        deadline - time.monotonic())))
+        finally:
+            jax.profiler.stop_trace()
+
+    def start(self, secs: float) -> Tuple[bool, str]:
+        try:
+            secs = float(secs)
+        except (TypeError, ValueError):
+            return False, "secs must be a number"
+        if not (0 < secs <= self.MAX_SECS):
+            return False, ("secs must be in (0, %g]" % self.MAX_SECS)
+        with self._lock:
+            if self._shutdown:
+                return False, "profiler shut down (process exiting)"
+            if self._busy:
+                return False, ("capture already in progress (into %s); "
+                               "one at a time" % (self.last_path or "?"))
+            self._busy = True
+            self.captures += 1
+            path = os.path.join(self.outdir,
+                                "capture_%03d" % self.captures)
+            self.last_path = path
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, args=(secs, path),
+                name="cxn-profilez", daemon=True)
+            # started under the lock: shutdown() can never observe
+            # _busy without also seeing a joinable thread
+            self._thread.start()
+        telemetry.count("perf.profilez_captures")
+        telemetry.event({"ev": "profilez", "secs": secs, "path": path})
+        return True, path
+
+    def _run(self, secs: float, path: str) -> None:
+        err = None
+        try:
+            os.makedirs(path, exist_ok=True)
+            self._trace_fn(secs, path)
+        except Exception as e:
+            err = "%s: %s" % (type(e).__name__, e)
+        with self._lock:
+            self._busy = False
+            self.last_error = err
+        if err:
+            # the HTTP 200 went out before the capture ran: make the
+            # failure visible — counted, logged, and echoed by the
+            # NEXT /profilez response (statusd reads last_error)
+            telemetry.count("perf.profilez_errors")
+            telemetry.event({"ev": "profilez_error", "path": path,
+                             "error": err[:200]})
+
+    def busy(self) -> bool:
+        with self._lock:
+            return self._busy
+
+    def shutdown(self, timeout: float = 20.0) -> bool:
+        """Cut short any in-flight capture and JOIN its thread. MUST run
+        before process teardown (learn_task's exit path does): a daemon
+        capture thread still inside native profiler code — or the
+        first capture's ~10s lazy profiler import — while the
+        interpreter exits SEGFAULTS the process (observed rc -11),
+        which would turn servd's clean SIGTERM drain into a crash.
+        True when the capture finished within the timeout. Latches: a
+        /profilez request racing the drain is refused from here on."""
+        with self._lock:
+            # latch AND set the stop flag under the lock: start() holds
+            # it across its _stop.clear() + thread launch, so a racing
+            # start either completes first (its thread then sees the
+            # flag) or observes the latch and refuses — it can never
+            # clear the flag after this set
+            self._shutdown = True
+            self._stop.set()
+            t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+        with self._lock:
+            return not self._busy
+
+    def wait(self, timeout: float = 30.0) -> bool:
+        """Poll until the in-flight capture (if any) finishes — tests
+        and the acceptance drive need a join point."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if not self.busy():
+                return True
+            time.sleep(0.02)
+        return not self.busy()
+
+
+# ----------------------------------------------------------------------
+# module-level singleton surface (the learn-task / bench wiring)
+_LEDGER = Ledger()
+
+
+def ledger() -> Ledger:
+    return _LEDGER
+
+
+def enable(spec: Optional[DeviceSpec] = None) -> Ledger:
+    return _LEDGER.enable(spec=spec)
+
+
+def disable() -> None:
+    _LEDGER.disable()
+
+
+def enabled() -> bool:
+    return _LEDGER.enabled
+
+
+def drain(timeout: float = 10.0) -> bool:
+    return _LEDGER.drain(timeout)
+
+
+def reset() -> None:
+    _LEDGER.reset()
+
+
+def decode_bound_tokens_per_s(ntok: int) -> Optional[float]:
+    """The decode-step roofline bound for a served request: the scan
+    program generates ntok-1 of the request's tokens (the first came
+    from prefill), so the hardware-allowed rate is (ntok-1) / the
+    program's predicted execution time. None until a decode-step card
+    is ready — callers (servd's flight recorder) stay null-safe."""
+    if ntok is None or ntok < 2 or not _LEDGER.enabled:
+        return None
+    card = _LEDGER.card("jit.decode_step")
+    if card is None or not card.get("predicted_s"):
+        return None
+    return round((ntok - 1) / card["predicted_s"], 3)
+
+
+# ----------------------------------------------------------------------
+def selftest(verbose: bool = False) -> int:
+    """Jax-free: card math from faked analyses, MFU/headroom joins
+    against a private telemetry registry, /programz + /profilez over a
+    real socket, the one-capture-at-a-time guard. ``make check`` gates
+    on it. Runs under runtime lock-rank enforcement."""
+    with lockrank.enforced():
+        return _selftest_body(verbose)
+
+
+def _selftest_body(verbose: bool = False) -> int:
+    import json
+    from urllib.request import urlopen
+    from urllib.error import HTTPError
+
+    reg = telemetry._Registry()
+    reg.enable()
+    spec = DeviceSpec("test", 100e12, 500e9, 8 * 2.0**30)
+    lg = Ledger(registry=reg, spec=spec).enable()
+    assert reg.compile_hook == lg.on_compile
+
+    # a faked train-step compile + analysis: flops-bound program
+    class _A:
+        def __init__(self, shape, dtype="float32"):
+            self.shape, self.dtype = shape, dtype
+    disp, sig = shapes_signature(([_A((8, 128)), {"w": _A((128, 64))}],),
+                                 None)
+    lg.on_compile("jit.train_step", "new_signature", 1.25, fn=None,
+                  args=([_A((8, 128)), {"w": _A((128, 64))}],), key="k1")
+    card = lg.complete_card(
+        "jit.train_step", sig,
+        cost={"flops": 2.0e12, "bytes accessed": 1.0e9},
+        mem={"argument_size_in_bytes": 3 * 2**30,
+             "temp_size_in_bytes": 2**30,
+             "output_size_in_bytes": 2**20})
+    # flops-bound: 2e12/100e12 = 20ms > 1e9/500e9 = 2ms
+    assert abs(card["predicted_s"] - 0.02) < 1e-9, card
+    assert card["peak_bytes"] == 3 * 2**30 + 2**30 + 2**20
+    assert card["status"] == "ready" and card["compile_s"] == 1.25
+    # the JSONL event landed
+    evs = [e for e in reg.events() if e.get("ev") == "program_card"]
+    assert evs and evs[-1]["flops"] == 2.0e12
+
+    # measured join: feed the train.step histogram at ~40ms -> MFU 50%
+    for _ in range(10):
+        reg.hist("train.step", 0.040)
+    snap = lg.snapshot()
+    c = [c for c in snap["cards"] if c["name"] == "jit.train_step"][0]
+    assert c["measured_n"] == 10
+    assert c["mfu_pct"] is not None and 35.0 < c["mfu_pct"] < 65.0, c
+    assert c["roofline_eff_pct"] is not None \
+        and 35.0 < c["roofline_eff_pct"] < 65.0
+    assert snap["hbm"]["peak_bytes"] == card["peak_bytes"]
+    assert snap["hbm"]["headroom_bytes"] == \
+        spec.hbm_capacity - card["peak_bytes"]
+
+    # an error completion keeps the card visible, fields null
+    lg.on_compile("jit.predict", "new_signature", 0.2, fn=None,
+                  args=(_A((4, 4)),))
+    _, sig2 = shapes_signature((_A((4, 4)),), None)
+    bad = lg.complete_card("jit.predict", sig2, error="boom")
+    assert bad["status"] == "error" and bad["flops"] is None
+
+    # decode bound: needs a ready decode-step card
+    assert decode_bound_tokens_per_s(16) is None     # module ledger off
+    _, sig3 = shapes_signature((_A((1, 8)),), None)
+    lg.on_compile("jit.decode_step", "new_signature", 0.5, fn=None,
+                  args=(_A((1, 8)),))
+    lg.complete_card("jit.decode_step", sig3,
+                     cost={"flops": 1.0e9, "bytes accessed": 5.0e8})
+    cardd = lg.card("jit.decode_step")
+    assert cardd["predicted_s"] == 5.0e8 / 500e9
+
+    # /programz + /metrics + /profilez over a real socket
+    from . import statusd
+    srv = statusd.StatusServer(0, host="127.0.0.1", registry=reg).start()
+    srv.perf = lg
+    started = []
+
+    def fake_trace(secs, path):
+        started.append(path)
+        time.sleep(secs)
+
+    import tempfile
+    prof = ProfilerCapture(tempfile.mkdtemp(prefix="cxn-perf-selftest-"),
+                           trace_fn=fake_trace)
+    srv.profiler = prof
+    try:
+        base = "http://127.0.0.1:%d" % srv.port
+        page = urlopen(base + "/programz", timeout=5).read().decode()
+        assert "jit.train_step" in page and "MFU" in page
+        doc = json.loads(urlopen(base + "/programz?json=1",
+                                 timeout=5).read())
+        assert doc["hbm"]["peak_bytes"] == card["peak_bytes"]
+        assert any(c["name"] == "jit.train_step" for c in doc["cards"])
+        m = urlopen(base + "/metrics", timeout=5).read().decode()
+        for line in m.splitlines():
+            if line and not line.startswith("#"):
+                assert statusd.PROM_LINE_RE.match(line), line
+        assert 'cxxnet_program_mfu_pct{process="0",program=' in m
+        assert "cxxnet_hbm_peak_bytes" in m
+        assert "cxxnet_hbm_headroom_bytes" in m
+        # profilez: capture starts, a concurrent second one is refused
+        r = urlopen(base + "/profilez?secs=0.5", timeout=5)
+        assert r.status == 200 and b"capture_001" in r.read()
+        try:
+            urlopen(base + "/profilez?secs=0.5", timeout=5)
+            raise AssertionError("concurrent capture should 409")
+        except HTTPError as e:
+            assert e.code == 409
+        prof.wait(5.0)
+        assert started and started[0].endswith("capture_001")
+        ok, detail = prof.start(0.01)      # guard released after finish
+        assert ok, detail
+        prof.wait(5.0)
+        try:
+            urlopen(base + "/profilez?secs=nope", timeout=5)
+            raise AssertionError("bad secs should 400")
+        except HTTPError as e:
+            assert e.code == 400
+        srv.profiler = None
+        try:
+            urlopen(base + "/profilez?secs=1", timeout=5)
+            raise AssertionError("no profiler registered should 404")
+        except HTTPError as e:
+            assert e.code == 404
+    finally:
+        srv.stop()
+        lg.disable()
+        reg.disable()
+    if verbose:
+        print("perf selftest: card math, MFU/headroom joins, /programz, "
+              "/metrics program series, /profilez guard ok")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    if "--selftest" in sys.argv[1:]:
+        sys.exit(selftest(verbose=True))
+    print(__doc__)
+    sys.exit(1)

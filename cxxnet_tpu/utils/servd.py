@@ -111,6 +111,7 @@ from typing import Callable, List, Optional, Tuple
 from . import checkpoint as ckpt
 from . import health
 from . import lockrank
+from . import perf
 from . import statusd
 from . import telemetry
 
@@ -826,6 +827,13 @@ class ServeFrontend:
                           "prefill": round(prefill, 6),
                           "decode": round(decode, 6)},
                "recompiles": list(tc.compiles) if tc is not None else []}
+        if tps is not None:
+            # the decode-step roofline bound for THIS token count (the
+            # performance ledger's card, null until one is ready):
+            # measured tokens/s far under it flags "slower than the
+            # hardware allows" per request, right in /requestz
+            rec["roofline_bound_tokens_per_s"] = \
+                perf.decode_bound_tokens_per_s(ntok)
         if tc is not None and tc.counts:
             rec["counts"] = dict(tc.counts)
         self.flight.record(rec)

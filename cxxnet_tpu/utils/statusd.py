@@ -42,6 +42,16 @@ Endpoints:
 * ``/requestz`` — the flight recorder's ring as JSON, newest first:
   request id, outcome, phase split, TTFT, tokens — the index you grab a
   ``/trace?request=<id>`` id from.
+* ``/programz`` — the program performance ledger (utils/perf.py): one
+  row per compiled program — shapes signature, XLA FLOPs, per-device
+  peak bytes, compile seconds, roofline-predicted vs measured p50/p99
+  time, MFU% — plus the HBM peak/headroom account. ``?json=1`` returns
+  the raw snapshot.
+* ``/profilez?secs=N`` — start an on-demand ``jax.profiler`` trace
+  capture of the next N seconds into the run-scoped ``profilez_dir``
+  (one capture at a time — a concurrent request gets 409), so a live
+  slow replica can be xprof'd without restarting it. Loopback-bound
+  like every other endpoint unless ``status_host`` widens the bind.
 
 Serving SLOs: an ``SLOTracker`` (objectives ``slo_ttft_ms`` /
 ``slo_p99_ms`` / ``slo_availability`` over a rolling window) turns each
@@ -87,8 +97,8 @@ from . import telemetry
 __all__ = [
     "StatusServer", "SLOTracker", "start", "stop", "active",
     "set_run_info", "update_progress", "register_probe", "wire_health",
-    "set_flight_recorder", "set_slo", "prometheus_metrics",
-    "PROM_LINE_RE", "selftest",
+    "set_flight_recorder", "set_slo", "set_perf", "set_profiler",
+    "prometheus_metrics", "programz_html", "PROM_LINE_RE", "selftest",
 ]
 
 _NAME_SAN = re.compile(r"[^a-zA-Z0-9_]")
@@ -263,7 +273,8 @@ def prometheus_metrics(snapshot: dict, progress: Optional[dict] = None,
                        health_failures: Optional[list] = None,
                        channels: Optional[list] = None,
                        live_failures: Optional[list] = None,
-                       slo: Optional[dict] = None) -> str:
+                       slo: Optional[dict] = None,
+                       perf: Optional[dict] = None) -> str:
     """Render a ``telemetry.metrics_snapshot()`` as Prometheus text
     exposition format 0.0.4. Pure function of its inputs — the selftest
     and tests validate its output without a socket. ``channels`` is the
@@ -319,6 +330,51 @@ def prometheus_metrics(snapshot: dict, progress: Optional[dict] = None,
              float(slo.get("bad_fraction", 0.0)))
         emit("cxxnet_slo_window_requests", "gauge",
              int(slo.get("requests", 0)))
+    if perf is not None:
+        # the program performance ledger (perf.Ledger.snapshot()):
+        # aggregates as plain gauges, per-program figures as labeled
+        # families (one TYPE line per family, one row per card — the
+        # heartbeat-channel pattern)
+        hbm = perf.get("hbm") or {}
+        if hbm.get("peak_bytes") is not None:
+            emit("cxxnet_hbm_peak_bytes", "gauge", int(hbm["peak_bytes"]),
+                 help_="largest per-device program footprint "
+                       "(arguments+temp+output) the ledger has carded")
+        if hbm.get("headroom_bytes") is not None:
+            emit("cxxnet_hbm_headroom_bytes", "gauge",
+                 int(hbm["headroom_bytes"]),
+                 help_="device HBM capacity minus cxxnet_hbm_peak_bytes")
+        if hbm.get("capacity_bytes") is not None:
+            emit("cxxnet_hbm_capacity_bytes", "gauge",
+                 int(hbm["capacity_bytes"]))
+        cards = perf.get("cards") or []
+        emit("cxxnet_program_cards", "gauge", len(cards),
+             help_="compiled programs the performance ledger has carded")
+        fams = (("cxxnet_program_flops", "flops",
+                 "XLA cost_analysis FLOPs per execution"),
+                ("cxxnet_program_bytes_accessed", "bytes_accessed", None),
+                ("cxxnet_program_peak_bytes", "peak_bytes",
+                 "per-device argument+temp+output bytes"),
+                ("cxxnet_program_predicted_seconds", "predicted_s",
+                 "roofline-predicted execution time"),
+                ("cxxnet_program_compile_seconds", "compile_s", None),
+                ("cxxnet_program_mfu_pct", "mfu_pct",
+                 "achieved FLOPs vs chip peak at the measured p50"),
+                ("cxxnet_program_roofline_eff_pct", "roofline_eff_pct",
+                 "predicted/measured p50 — low means slower than the "
+                 "hardware allows"))
+        for mname, field, help_ in fams:
+            rows = [c for c in cards if _num(c.get(field))]
+            if not rows:
+                continue
+            if help_:
+                out.append("# HELP %s %s" % (mname, help_))
+            out.append("# TYPE %s gauge" % mname)
+            for c in rows:
+                out.append(
+                    '%s{process="%s",program="%s",shapes="%s"} %s'
+                    % (mname, _lesc(p), _lesc(c.get("name", "?")),
+                       _lesc(c.get("sig", "?")), _fmt(c[field])))
     if channels is None:
         channels = health_mod.channel_status()
     if channels:
@@ -357,6 +413,71 @@ def prometheus_metrics(snapshot: dict, progress: Optional[dict] = None,
                                     _fmt(float(h.get("sum", 0.0)))))
         out.append('%s_count%s %d' % (mname, base, total))
     return "\n".join(out) + "\n"
+
+
+def _mib(v) -> str:
+    return "n/a" if v is None else "%.1f" % (v / float(1 << 20))
+
+
+def programz_html(snap: dict) -> str:
+    """Render a ``perf.Ledger.snapshot()`` as the /programz page: the
+    HBM account, then one row per carded program. Pure function of the
+    snapshot — the perf selftest and tests validate it socket-free."""
+    esc = html.escape
+    spec = snap.get("spec") or {}
+    hbm = snap.get("hbm") or {}
+    parts = ["<html><head><title>cxxnet programz</title></head>"
+             "<body><h1>program performance ledger</h1><pre>"]
+    parts.append("device spec: %s  peak %.1f TFLOP/s  HBM %.0f GB/s  "
+                 "capacity %.1f GiB"
+                 % (esc(str(spec.get("name", "?"))),
+                    (spec.get("peak_flops") or 0.0) / 1e12,
+                    (spec.get("hbm_bw") or 0.0) / 1e9,
+                    (spec.get("hbm_capacity") or 0.0) / 2.0**30))
+    peak = hbm.get("peak_bytes")
+    head = hbm.get("headroom_bytes")
+    parts.append("hbm: peak program footprint %s MiB   headroom %s MiB"
+                 % (_mib(peak), _mib(head)))
+    parts.append("</pre><h2>programs</h2><pre>")
+    cols = ("program", "shapes", "cause", "n", "compile_s", "GFLOPs",
+            "peak MiB", "pred ms", "p50 ms", "p99 ms", "MFU%", "eff%")
+    fmt = "%-18s %-28s %-18s %3s %9s %9s %9s %8s %8s %8s %6s %6s"
+    parts.append(fmt % cols)
+
+    def num(v, scale=1.0, form="%.2f"):
+        return "n/a" if v is None else form % (v * scale)
+
+    for c in snap.get("cards") or []:
+        if c.get("status") == "error":
+            parts.append(fmt % (
+                esc(c.get("name", "?")), esc(str(c.get("shapes", "?"))),
+                esc(str(c.get("cause", "?"))), c.get("compiles", 0),
+                num(c.get("compile_s")), "ERR", "ERR", "-", "-", "-",
+                "-", "-"))
+            parts.append("    analysis error: %s"
+                         % esc(str(c.get("error"))))
+            continue
+        shared = c.get("series_shared_by", 1) > 1
+        parts.append(fmt % (
+            esc(c.get("name", "?")), esc(str(c.get("shapes", "?"))),
+            esc(str(c.get("cause", "?"))), c.get("compiles", 0),
+            num(c.get("compile_s")), num(c.get("flops"), 1e-9),
+            _mib(c.get("peak_bytes")), num(c.get("predicted_s"), 1e3),
+            num(c.get("measured_p50_ms")) + ("*" if shared else ""),
+            num(c.get("measured_p99_ms")),
+            num(c.get("mfu_pct"), form="%.1f"),
+            num(c.get("roofline_eff_pct"), form="%.1f")))
+    if not snap.get("cards"):
+        parts.append("(no programs carded yet — nothing compiled since "
+                     "the ledger was enabled)")
+    parts.append("</pre><p>pred = max(flops/peak, bytes/bw) roofline; "
+                 "MFU% and eff% join the measured latency histogram "
+                 "(doc/performance.md \"Live program ledger\"); "
+                 "* = several signatures of this program share one "
+                 "measured series, so p50/MFU/eff aggregate them; "
+                 "<a href='/programz?json=1'>json</a> "
+                 "<a href='/statusz'>statusz</a></p></body></html>")
+    return "\n".join(parts)
 
 
 class _HTTPServer(ThreadingHTTPServer):
@@ -441,10 +562,60 @@ class _Endpoint(BaseHTTPRequestHandler):
                         "capacity": fr.cap if fr is not None else 0}
                 self._reply(200, "application/json",
                             json.dumps(body).encode("utf-8"))
+            elif path == "/programz":
+                lg = srv.perf
+                if lg is None:
+                    self._reply(404, "text/plain; charset=utf-8",
+                                b"no performance ledger registered "
+                                b"(perf_ledger=0?)\n")
+                else:
+                    snap = lg.snapshot()
+                    if parse_qs(query).get("json"):
+                        self._reply(200, "application/json",
+                                    json.dumps(snap).encode("utf-8"))
+                    else:
+                        self._reply(200, "text/html; charset=utf-8",
+                                    programz_html(snap).encode("utf-8"))
+            elif path == "/profilez":
+                prof = srv.profiler
+                if prof is None:
+                    self._reply(404, "text/plain; charset=utf-8",
+                                b"no profiler registered (learn_task "
+                                b"runs wire one whenever status_port "
+                                b"is set; embedders call "
+                                b"statusd.set_profiler)\n")
+                else:
+                    secs = (parse_qs(query).get("secs")
+                            or ["2"])[0]
+                    try:
+                        secs = float(secs)
+                    except ValueError:
+                        self._reply(400, "text/plain; charset=utf-8",
+                                    b"secs must be a number\n")
+                        return
+                    # a PREVIOUS capture's failure surfaces on the next
+                    # request (the 200 goes out before a capture runs)
+                    prev_err = getattr(prof, "last_error", None)
+                    ok, detail = prof.start(secs)
+                    if ok:
+                        body = ("profiling for %gs into %s\n(xprof/"
+                                "TensorBoard-profile format; summarize "
+                                "with tools/summarize_trace.py)\n"
+                                % (secs, detail))
+                        if prev_err:
+                            body += ("WARNING: previous capture FAILED: "
+                                     "%s\n" % prev_err)
+                        self._reply(200, "text/plain; charset=utf-8",
+                                    body.encode("utf-8"))
+                    else:
+                        code = 409 if "in progress" in detail else 400
+                        self._reply(code, "text/plain; charset=utf-8",
+                                    (detail + "\n").encode("utf-8"))
             else:
                 self._reply(404, "text/plain; charset=utf-8",
                             b"not found; endpoints: /metrics /healthz "
-                            b"/livez /statusz /trace /requestz\n")
+                            b"/livez /statusz /trace /requestz "
+                            b"/programz /profilez\n")
         except Exception as e:    # a broken probe must not kill the server
             try:
                 self._reply(500, "text/plain; charset=utf-8",
@@ -469,6 +640,11 @@ class StatusServer:
         # tracker behind the cxxnet_slo_* gauges and the /statusz section
         self.flight: Optional[telemetry.FlightRecorder] = None
         self.slo: Optional[SLOTracker] = None
+        # performance-ledger wiring (set_perf / set_profiler): the
+        # perf.Ledger behind /programz and the cxxnet_program_* series,
+        # and the perf.ProfilerCapture behind /profilez
+        self.perf = None
+        self.profiler = None
         # (name, probe_fn, liveness): see register_probe
         self.probes: List[Tuple[str, Callable[[], Tuple[bool, str]],
                                 bool]] = []
@@ -583,7 +759,8 @@ class StatusServer:
             health_failures=ready,
             channels=channels,
             live_failures=live,
-            slo=self.slo.snapshot() if self.slo is not None else None)
+            slo=self.slo.snapshot() if self.slo is not None else None,
+            perf=self.perf.snapshot() if self.perf is not None else None)
 
     def statusz_html(self) -> str:
         reg = self.registry
@@ -654,6 +831,16 @@ class StatusServer:
                     _ms(None if latest.get("total_s") is None
                         else latest["total_s"] * 1e3)))])
 
+        if self.perf is not None:
+            psnap = self.perf.snapshot()
+            hbm = psnap.get("hbm") or {}
+            table("program ledger", [
+                ("cards", "%d compiled programs (see /programz)"
+                 % len(psnap.get("cards") or [])),
+                ("hbm peak", "%s MiB (headroom %s MiB)"
+                 % (_mib(hbm.get("peak_bytes")),
+                    _mib(hbm.get("headroom_bytes"))))])
+
         ck = reg.last_event("ckpt_save")
         if ck is not None and "ts" in ck:
             table("checkpoint", [
@@ -689,7 +876,8 @@ class StatusServer:
         parts.append("<p>endpoints: <a href='/metrics'>/metrics</a> "
                      "<a href='/healthz'>/healthz</a> "
                      "<a href='/trace'>/trace</a> "
-                     "<a href='/requestz'>/requestz</a></p></body></html>")
+                     "<a href='/requestz'>/requestz</a> "
+                     "<a href='/programz'>/programz</a></p></body></html>")
         return "\n".join(parts)
 
 
@@ -756,6 +944,22 @@ def set_slo(tracker: Optional[SLOTracker]) -> None:
     s = _SERVER
     if s is not None:
         s.slo = tracker
+
+
+def set_perf(ledger) -> None:
+    """Attach a perf.Ledger — /programz and the cxxnet_program_* /
+    cxxnet_hbm_* series serve from it. No-op without a server."""
+    s = _SERVER
+    if s is not None:
+        s.perf = ledger
+
+
+def set_profiler(capture) -> None:
+    """Attach a perf.ProfilerCapture — /profilez?secs=N starts captures
+    through its one-at-a-time guard. No-op without a server."""
+    s = _SERVER
+    if s is not None:
+        s.profiler = capture
 
 
 # ----------------------------------------------------------------------

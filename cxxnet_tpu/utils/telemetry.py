@@ -85,7 +85,8 @@ from . import lockrank
 
 __all__ = [
     "enable", "disable", "enabled", "reset", "span", "count", "gauge",
-    "hist", "event", "record_compile", "jit_watch", "sample_device_memory",
+    "hist", "event", "record_compile", "jit_watch",
+    "sample_device_memory",
     "flush", "finish", "summary", "brief_summary", "events",
     "recent_events", "last_event", "span_event", "percentile", "count_by",
     "chrome_trace", "events_to_chrome", "write_chrome_trace",
@@ -297,6 +298,11 @@ class _Registry:
         self._lock = lockrank.lock("telemetry.registry")
         self._tls = threading.local()
         self.process_index = 0
+        # the performance ledger's compile hook (utils/perf.py):
+        # called by JitWatch with the compiled callable + call args on
+        # every detected compile. Survives reset()/enable() — bench
+        # resets telemetry between rows without re-wiring the ledger.
+        self.compile_hook = None
         self.reset()
 
     # -- lifecycle -----------------------------------------------------
@@ -887,20 +893,25 @@ class JitWatch:
     decode_cache_drop); later growth on the same program means the inputs'
     shapes/shardings changed ("shape_change")."""
 
-    __slots__ = ("_fn", "_name", "_cause_next", "_reg")
+    __slots__ = ("_fn", "_name", "_cause_next", "_reg", "_key")
 
     def __init__(self, fn, name: str, cause: str = "new_signature",
-                 registry: Optional[_Registry] = None):
+                 registry: Optional[_Registry] = None, key=None):
         self._fn = fn
         self._name = name
         self._cause_next = cause
         self._reg = registry or _REG
+        # the caller's program key (the trainer's jit-cache key): rides
+        # the compile event and the perf ledger's ProgramCard
+        self._key = key
 
     def __call__(self, *args, **kwargs):
         reg = self._reg
-        if not reg.enabled and reg.current_trace() is None:
+        if not reg.enabled and reg.current_trace() is None \
+                and reg.compile_hook is None:
             # an active trace context wants its recompiles attributed
-            # (the flight recorder works with telemetry disabled too)
+            # (the flight recorder works with telemetry disabled too),
+            # and the perf ledger wants its cards either way
             return self._fn(*args, **kwargs)
         try:
             before = self._fn._cache_size()
@@ -915,7 +926,20 @@ class JitWatch:
             except Exception:
                 grew = False
             if grew:
-                reg.record_compile(self._name, self._cause_next, dt)
+                reg.record_compile(self._name, self._cause_next, dt,
+                                   key=self._key)
+                hook = reg.compile_hook
+                if hook is not None:
+                    # the perf ledger (utils/perf.py): hand it the
+                    # compiled callable + the triggering args so it can
+                    # card the program. Supervised — a ledger bug must
+                    # not kill the train step that compiled
+                    try:
+                        hook(self._name, self._cause_next, dt,
+                             fn=self._fn, args=args, kwargs=kwargs,
+                             key=self._key)
+                    except Exception:
+                        pass
                 self._cause_next = "shape_change"
         return out
 
@@ -990,8 +1014,9 @@ def record_compile(name: str, cause: str, seconds: float, key=None) -> None:
     _REG.record_compile(name, cause, seconds, key)
 
 
-def jit_watch(fn, name: str, cause: str = "new_signature") -> JitWatch:
-    return JitWatch(fn, name, cause=cause)
+def jit_watch(fn, name: str, cause: str = "new_signature",
+              key=None) -> JitWatch:
+    return JitWatch(fn, name, cause=cause, key=key)
 
 
 def flush() -> None:

@@ -66,7 +66,10 @@ def test_example_conf_builds_and_steps(conf, shape, nclass):
     assert (0 <= out).all() and (out < nclass).all()
 
 
+@pytest.mark.slow
 def test_googlenet_conf_builds_and_steps():
+    # slow tier (tier-1 budget): the conf-parsing path rides tier-1 via
+    # the ImageNet/MNIST confs; the inception DAG compile via test_fusion
     """The GoogLeNet example (BASELINE config 4): builds the 9-module
     inception DAG and takes a step at reduced input size."""
     tr, cfg = build_from_conf(
@@ -85,7 +88,10 @@ def test_googlenet_conf_builds_and_steps():
     assert out.shape == (4,)
 
 
+@pytest.mark.slow
 def test_vgg_conf_builds_and_steps():
+    # slow tier (tier-1 budget): deep-plain-conv coverage rides tier-1
+    # via test_remat's vgg-shaped trunks
     """The VGG-16 example: parses (incl. the remat=1 netcfg default) and a
     reduced vgg11 takes a training step."""
     tr, cfg = build_from_conf(

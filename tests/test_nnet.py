@@ -336,9 +336,13 @@ def test_tag_scoped_params():
     assert up_b.param.wd == 0.25
 
 
+@pytest.mark.slow
 def test_inception_dag_memorizes():
     """GoogLeNet-flavored DAG (split -> parallel conv towers -> ch_concat)
-    built purely from the netconfig DSL trains to memorization."""
+    built purely from the netconfig DSL trains to memorization.
+    Slow tier: a ~50s convergence soak — the DAG build/step/fusion
+    coverage rides tier-1 via test_fusion and the example-config
+    smokes; this adds only the memorization endpoint."""
     import numpy as np
     from cxxnet_tpu.models import inception_trainer
     from cxxnet_tpu.io.data import DataBatch

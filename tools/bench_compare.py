@@ -136,7 +136,15 @@ def compare(lines, published, threshold):
         metric = line.get("metric")
         base = published.get(metric)
         if base is None:
-            skipped.append((metric, "no published baseline"))
+            if line.get("value") is None:
+                # count the null separately even unbaselined: the
+                # end-of-run summary tallies how many rows the backend
+                # never measured
+                skipped.append((metric, "no published baseline; "
+                                "measured value is null (%s)"
+                                % line.get("error", "no error recorded")))
+            else:
+                skipped.append((metric, "no published baseline"))
         else:
             gate(metric, line.get("value"), base, lower_is_better(line),
                  line.get("error", "no error recorded"))
@@ -229,6 +237,19 @@ def main(argv):
     for metric, base, value, delta in regressions:
         print("  REGRESSION %-43s %12.2f -> %12.2f (%+.1f%% > %.0f%%)"
               % (metric, base, value, 100 * delta, 100 * threshold))
+    # HEADLINE nulls only: a null sub-field of a row that DID measure
+    # (e.g. mfu_pct absent because the card analysis errored) is not a
+    # backend outage and must not be labeled one
+    nulls = [m for m, why in skipped
+             if "measured value is null" in why
+             and "sub-field not measured" not in why]
+    if nulls:
+        # the gate must SAY how much of the trajectory it is not
+        # checking: an all-null round (tunnel down) otherwise reads as
+        # a clean pass indistinguishable from a genuinely-gated one
+        print("bench_compare: %d row(s) skipped: backend unreachable "
+              "(measured value null) — %d row(s) actually gated"
+              % (len(nulls), len(compared) + len(regressions)))
     if regressions:
         return 2
     return 0

@@ -175,6 +175,15 @@ def main():
     total = (m.argument_size_in_bytes + m.temp_size_in_bytes
              + m.output_size_in_bytes)
     print("  total per device:", gb(total))
+    # headroom vs the shared DeviceSpec table (cxxnet_tpu/utils/perf.py
+    # — the same capacity the live ledger's cxxnet_hbm_headroom_bytes
+    # gauge reports, so offline sizing and runtime accounting agree)
+    from cxxnet_tpu.utils import perf
+    spec = perf.offline_spec()
+    print("  %s HBM capacity: %s  ->  headroom: %s (%.1f%% used)"
+          % (spec.name, gb(spec.hbm_capacity),
+             gb(spec.hbm_capacity - total),
+             100.0 * total / spec.hbm_capacity))
 
 
 if __name__ == "__main__":

@@ -29,28 +29,20 @@ sys.path.insert(0, os.path.join(
 
 import numpy as np
 
-# bf16 peak per chip. v5e ("v5 lite"): 197 TFLOP/s. Override for other
-# generations with CXXNET_PEAK_TFLOPS.
-PEAK_TFLOPS = {"v5e": 197.0, "v5lite": 197.0, "v4": 275.0, "v6e": 918.0}
-# HBM bandwidth per chip (GB/s) — the decode-side roof: autoregressive
-# decode is bound by bytes/token (params + KV cache), not FLOPs.
-HBM_GBS = {"v5e": 819.0, "v5lite": 819.0, "v4": 1228.0, "v6e": 1638.0}
+# The chip peak constants live in the SHARED DeviceSpec table
+# (cxxnet_tpu/utils/perf.py) the live program ledger also reads — the
+# offline MFU/decode-bound numbers and the runtime gauges can never
+# disagree. PALLAS_AXON_TPU_GEN picks the generation (default v5e);
+# CXXNET_PEAK_TFLOPS / CXXNET_PEAK_HBM_GBS override any entry.
+from cxxnet_tpu.utils import perf
 
 
 def peak_flops() -> float:
-    env = os.environ.get("CXXNET_PEAK_TFLOPS")
-    if env:
-        return float(env) * 1e12
-    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e").lower()
-    return PEAK_TFLOPS.get(gen, 197.0) * 1e12
+    return perf.offline_spec().peak_flops
 
 
 def peak_hbm_bytes() -> float:
-    env = os.environ.get("CXXNET_PEAK_HBM_GBS")
-    if env:
-        return float(env) * 1e9
-    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e").lower()
-    return HBM_GBS.get(gen, 819.0) * 1e9
+    return perf.offline_spec().hbm_bw
 
 
 def net_flops_per_sample(tr) -> float:
@@ -247,27 +239,71 @@ _RATE_KEYS = {
 }
 
 
-def rates_from_bench(paths):
-    """Parse {metric, value} JSON lines (BENCH_r*.json, onchip_logs/*.log);
-    keep the best rate per model."""
-    rates = {}
-    for path in paths:
-        for line in open(path):
+def _iter_bench_rows(raw):
+    """Every {metric, ...} row in a bench capture, BOTH shapes: the
+    driver wrapper ({"parsed": ..., "tail": "<JSONL>"}) the
+    BENCH_r*.json files use, and raw bench.py / onchip JSONL. No
+    dedup — repeated rounds of one metric in one log all come through
+    (the caller keeps the best rate per model)."""
+    try:
+        doc = json.loads(raw)
+    except ValueError:
+        doc = None
+    blobs = [raw]
+    if isinstance(doc, dict):
+        blobs = [doc.get("tail") or ""]
+        if "metric" in doc:
+            yield doc
+        parsed = doc.get("parsed")
+        if isinstance(parsed, dict) and "metric" in parsed:
+            yield parsed
+    elif isinstance(doc, list):
+        blobs = []
+        for d in doc:
+            if isinstance(d, dict) and "metric" in d:
+                yield d
+    for blob in blobs:
+        for line in blob.splitlines():
             line = line.strip()
             if not (line.startswith("{") and '"metric"' in line):
                 continue
             try:
-                row = json.loads(line)
+                d = json.loads(line)
             except ValueError:
                 continue
+            if isinstance(d, dict) and "metric" in d:
+                yield d
+
+
+def rates_from_bench(paths):
+    """Parse {metric, value} bench rows (BENCH_r*.json wrapper files or
+    raw JSONL like onchip_logs/*.log); keep the BEST rate per model
+    across every occurrence. Returns ``(rates, n_null)`` — n_null
+    counts the metrics whose every occurrence carried a null value (the
+    structured non-result a down TPU tunnel produces; a metric that
+    also measured somewhere is not "skipped"), and main() prints it:
+    the MFU table must say how much of the trajectory it is not seeing,
+    not silently render em-dashes."""
+    rates = {}
+    null_metrics = set()
+    measured = set()
+    for path in paths:
+        with open(path) as f:
+            raw = f.read()
+        for row in _iter_bench_rows(raw):
+            name = str(row.get("metric", ""))
             v = row.get("value")
+            if v is None:
+                null_metrics.add(name)
+                continue
             if not v:
                 continue
+            measured.add(name)
             for prefix, model in _RATE_KEYS.items():
-                if row.get("metric", "").startswith(prefix):
+                if name.startswith(prefix):
                     rates[model] = max(rates.get(model, 0.0), float(v))
                     break
-    return rates
+    return rates, len(null_metrics - measured)
 
 
 def main():
@@ -282,7 +318,11 @@ def main():
     args = ap.parse_args()
     os.environ.setdefault("CXXNET_JAX_PLATFORM", "cpu")
 
-    rates = rates_from_bench(args.bench)
+    rates, n_null = rates_from_bench(args.bench)
+    if n_null:
+        print("# %d bench row(s) skipped: value null (backend "
+              "unreachable) — measured/s and MFU%% columns cover only "
+              "the remaining rows" % n_null)
     for spec in args.rate:
         k, v = spec.split("=")
         rates[k] = float(v)

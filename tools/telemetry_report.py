@@ -13,7 +13,11 @@ breakdowns, counters/gauges, fixed-bucket latency histograms (bucket table
 (anomalies/rollbacks/watchdog stalls/corrupt records, utils/health.py),
 a serving section (shed rate, deadline-miss rate, circuit-breaker
 transitions, per-request p50/p99 from the ``serve.request`` histogram,
-utils/servd.py), and a request-breakdown section (phase-attributed
+utils/servd.py), a program-ledger section (the ``program_card`` events
+utils/perf.py emits — per-compiled-program FLOPs / peak bytes /
+compile time / roofline-predicted vs measured time, top programs by
+compile cost and by roofline gap), and a request-breakdown section
+(phase-attributed
 p50/p99 over the ``serve_request_done`` events — queue_wait / dispatch /
 prefill / decode / TTFT — plus the top-5 slowest requests with their
 phase split and the requests that paid recompiles).
@@ -49,6 +53,7 @@ import sys
 sys.path.insert(0, os.path.join(
     os.path.dirname(os.path.abspath(__file__)), ".."))
 
+from cxxnet_tpu.utils.perf import MEASURED_SERIES  # noqa: E402
 from cxxnet_tpu.utils.telemetry import (  # noqa: E402
     HIST_BUCKETS, Histogram, count_by, events_to_chrome, fmt_ms,
     percentile)
@@ -155,6 +160,7 @@ def aggregate(events):
     breaker_events = []
     requests = []
     slo_events = []
+    program_cards = {}
 
     def proc(ev):
         p = int(ev.get("p", 0))
@@ -216,6 +222,13 @@ def aggregate(events):
         elif kind == "slo_burn":
             slo_events.append(ev)
             proc(ev)
+        elif kind == "program_card":
+            # the performance ledger's per-compiled-program card
+            # (utils/perf.py): last event per (process, name, shapes
+            # signature) wins — re-completions carry cumulative
+            # compile counts
+            program_cards[(proc(ev), ev.get("name"),
+                           ev.get("sig"))] = ev
     # an anomaly is resolved by an inline resolution field (warn-only
     # metric events) or by any recovery event referencing its id —
     # matched PER PROCESS: anomaly ids are per-process counters, so in a
@@ -322,10 +335,58 @@ def aggregate(events):
                          for p, ev in final.items()},
                "burning": sorted(p for p, ev in final.items()
                                  if int(ev.get("state", 0)))}
+    # program ledger: one row per carded program (utils/perf.py),
+    # joined against the measured latency histograms like the live
+    # /programz table — MFU% and roofline efficiency from the log alone
+    programs = None
+    if program_cards:
+        rows = []
+        for (p, name, sig), ev in sorted(
+                program_cards.items(), key=lambda kv: str(kv[0])):
+            series = MEASURED_SERIES.get(name)
+            h = merged_hists.get(series) if series else None
+            st = h.stats() if h is not None and h.n else None
+            row = {"p": p, "name": name, "shapes": ev.get("shapes"),
+                   "spec": ev.get("spec"), "cause": ev.get("cause"),
+                   "compiles": int(ev.get("compiles") or 0),
+                   "compile_s": float(ev.get("compile_s") or 0.0),
+                   "flops": ev.get("flops"),
+                   "peak_bytes": ev.get("peak_bytes"),
+                   "predicted_s": ev.get("predicted_s"),
+                   "status": ev.get("status"), "error": ev.get("error"),
+                   "measured_p50_ms": st["p50_ms"] if st else None,
+                   "measured_p99_ms": st["p99_ms"] if st else None,
+                   "mfu_pct": None, "roofline_eff_pct": None}
+            if st and st["p50_ms"]:
+                p50_s = st["p50_ms"] / 1e3
+                peak = ev.get("spec_peak_flops")
+                if row["flops"] is not None and peak:
+                    row["mfu_pct"] = round(
+                        100.0 * row["flops"] / (p50_s * peak), 2)
+                if row["predicted_s"] is not None:
+                    row["roofline_eff_pct"] = round(
+                        100.0 * row["predicted_s"] / p50_s, 2)
+            rows.append(row)
+        gapped = [r for r in rows
+                  if r["roofline_eff_pct"] is not None]
+        programs = {
+            "count": len(rows),
+            "cards": rows,
+            "compile_s": round(sum(r["compile_s"] for r in rows), 6),
+            "hbm_peak_bytes": max(
+                (r["peak_bytes"] for r in rows
+                 if r["peak_bytes"] is not None), default=None),
+            "top_by_compile": [r["name"] for r in sorted(
+                rows, key=lambda r: -r["compile_s"])[:5]],
+            # the roofline GAP ranking: lowest efficiency = furthest
+            # from what the hardware allows
+            "top_by_gap": [r["name"] for r in sorted(
+                gapped, key=lambda r: r["roofline_eff_pct"])[:5]],
+        }
     out = {"spans": {}, "compiles": {}, "counters": counters,
            "gauges": gauges, "rounds": rounds, "health": health,
            "serving": serving, "requests": req_agg, "slo": slo,
-           "hists": {}}
+           "programs": programs, "hists": {}}
     for name, h in sorted(merged_hists.items()):
         st = h.stats()
         st["buckets"] = h.to_dict()["buckets"]
@@ -510,6 +571,38 @@ def print_report(agg, top=15):
             print("  process %s final: %s (burn rate %sx)"
                   % (p, "BURNING" if st["state"] else "within budget",
                      st.get("burn_rate")))
+    pg = agg.get("programs")
+    if pg:
+        print("\n== program ledger (per-compiled-program perf cards) ==")
+        hbm = pg.get("hbm_peak_bytes")
+        print("programs: %d   compile total: %.2fs   hbm peak: %s"
+              % (pg["count"], pg["compile_s"],
+                 "%.1f MiB" % (hbm / float(1 << 20))
+                 if hbm is not None else "n/a"))
+        print("%-18s %-26s %3s %9s %10s %9s %9s %9s %7s %7s" %
+              ("program", "shapes", "n", "compile_s", "GFLOPs",
+               "peak_MiB", "pred_ms", "p50_ms", "MFU%", "eff%"))
+
+        def _n(v, scale=1.0, form="%.2f"):
+            return "n/a" if v is None else form % (v * scale)
+
+        for r in pg["cards"]:
+            print("%-18s %-26s %3d %9.2f %10s %9s %9s %9s %7s %7s" %
+                  (r["name"], str(r.get("shapes"))[:26], r["compiles"],
+                   r["compile_s"], _n(r["flops"], 1e-9),
+                   _n(r["peak_bytes"], 1.0 / (1 << 20), "%.1f"),
+                   _n(r["predicted_s"], 1e3),
+                   _n(r["measured_p50_ms"]),
+                   _n(r["mfu_pct"], form="%.1f"),
+                   _n(r["roofline_eff_pct"], form="%.1f")))
+            if r.get("status") == "error":
+                print("    analysis error: %s" % r.get("error"))
+        if pg["top_by_compile"]:
+            print("top by compile time: %s"
+                  % "  ".join(pg["top_by_compile"]))
+        if pg["top_by_gap"]:
+            print("largest roofline gap (lowest eff%%): %s"
+                  % "  ".join(pg["top_by_gap"]))
     h = agg.get("health", {})
     if h and (h["anomalies"] or h["stalls"] or h["data_corrupt"]
               or h["skipped_batches"]):
