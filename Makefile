@@ -66,6 +66,7 @@ check:
 	python -m cxxnet_tpu.utils.health --selftest
 	python -m cxxnet_tpu.utils.statusd --selftest
 	python -m cxxnet_tpu.utils.servd --selftest
+	python -m cxxnet_tpu.utils.routerd --selftest
 	python -m cxxnet_tpu.utils.perf --selftest
 	python -c "import sys; from cxxnet_tpu.utils import lockrank; \
 		sys.exit(lockrank.selftest(verbose=True))"

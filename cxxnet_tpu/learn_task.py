@@ -157,6 +157,18 @@ class LearnTask:
         self.slo_availability = 0.999
         self.slo_window_s = 300.0
         self.serve_flight_cap = 256
+        # fleet router (utils/routerd.py, doc/serving.md "Replicated
+        # serving fleet"): task = route spreads client connections over
+        # the servd replicas listed in route_replicas (health-aware
+        # least-loaded dispatch, retry-on-shed, rolling ADMIN reload,
+        # SIGTERM fleet drain). No model is loaded — the router is a
+        # pure fleet-layer process.
+        self.route_port = 0              # 0 = ephemeral, printed
+        self.route_host = ""
+        self.route_replicas = ""         # host:port:status_port, comma-sep
+        self.route_probe_ms = 200.0
+        self.route_retries = 2
+        self.route_stall_s = 30.0        # per-attempt response bound
         self.gen_new = 16
         self.gen_temperature = 0.0
         self.gen_topk = 0
@@ -242,7 +254,10 @@ class LearnTask:
             statusd.set_perf(perf.ledger())
         try:
             with telemetry.span("init"):
-                self.init()
+                # the router is a pure fleet-layer process: no net, no
+                # iterators, no jax use — replicas own the models
+                if self.task != "route":
+                    self.init()
             if not self.silent:
                 # serve's stdout carries exactly one response line per
                 # request — startup chatter goes to stderr there
@@ -263,6 +278,8 @@ class LearnTask:
                 self.task_generate()
             elif self.task == "serve":
                 self.task_serve()
+            elif self.task == "route":
+                self.task_route()
         finally:
             if self._perf_enabled:
                 # let queued card analyses land in the JSONL before the
@@ -390,6 +407,18 @@ class LearnTask:
             self.slo_window_s = float(val)
         if name == "serve_flight_cap":
             self.serve_flight_cap = int(val)
+        if name == "route_port":
+            self.route_port = int(val)
+        if name == "route_host":
+            self.route_host = val
+        if name == "route_replicas":
+            self.route_replicas = val
+        if name == "route_probe_ms":
+            self.route_probe_ms = float(val)
+        if name == "route_retries":
+            self.route_retries = int(val)
+        if name == "route_stall_s":
+            self.route_stall_s = float(val)
         if name == "extract_node_name":
             self.extract_node_name = val
         if name == "export_out":
@@ -1385,6 +1414,97 @@ class LearnTask:
             print("  shed %d, deadline-expired %d (of %d accepted)"
                   % (stats["shed"], stats["deadline"], stats["accepted"]),
                   file=sys.stderr, flush=True)
+
+    def task_route(self) -> None:
+        """task = route: the replicated-fleet router (utils/routerd.py,
+        doc/serving.md "Replicated serving fleet"). Speaks the exact
+        servd line protocol on ``route_port`` and spreads client
+        connections over the ``task = serve`` replicas listed in
+        ``route_replicas`` (``host:serve_port:status_port``, comma
+        separated): health-aware dispatch fed by each replica's statusd
+        ``/healthz`` + load gauges, least-loaded power-of-two-choices,
+        transparent retry of never-dispatched sheds on another replica
+        within the client's remaining DEADLINE budget, dead-replica
+        ejection with exponential-backoff re-probe, and fleet-level
+        ``ADMIN reload`` (or SIGHUP) rolled across replicas one drain
+        window at a time — capacity never drops below N-1. SIGTERM/
+        SIGINT drains the router (in-flight routed requests finish,
+        counters reconcile, exit 0); replicas are their own processes
+        and drain on their own signals."""
+        import signal
+
+        from .utils import routerd
+
+        replicas = routerd.parse_replicas(self.route_replicas)
+        assert replicas, \
+            "task = route needs route_replicas = host:port:status_port[,...]"
+        router = routerd.Router(
+            replicas, probe_ms=self.route_probe_ms,
+            retries=self.route_retries, stall_s=self.route_stall_s,
+            drain_ms=self.serve_drain_ms)
+        router.start()
+        port = router.listen(self.route_port, host=self.route_host)
+        # one synchronous sweep so /fleetz and the first dispatches see
+        # probed state, not optimism (a dead replica listed in the conf
+        # is ejected before traffic arrives)
+        router.probe_now()
+        statusd.set_fleet(router)
+        statusd.register_probe("routing", router.health_probe)
+        statusd.register_probe("routing.prober", router.liveness_probe,
+                               liveness=True)
+        if not self.silent:
+            up = sum(1 for r in router._replicas
+                     if r.state == routerd.UP)
+            print("routerd: routing on port %d over %d replicas "
+                  "(%d up; servd line protocol — doc/serving.md)"
+                  % (port, len(replicas), up), file=sys.stderr,
+                  flush=True)
+        wd = None
+        if self.watchdog_timeout > 0:
+            wd = health.Watchdog(self.watchdog_timeout,
+                                 action=self.watchdog_action).start()
+        # SIGHUP = rolling fleet reload. The handler only sets a flag
+        # (request_rolling_reload takes locks — not async-signal-safe);
+        # the main loop converts it.
+        hup_flag = {"on": False}
+        old_hup = None
+        try:
+            old_hup = signal.signal(
+                signal.SIGHUP,
+                lambda s, f: hup_flag.update(on=True))
+        except (AttributeError, ValueError, OSError):
+            pass                 # no SIGHUP (platform) / not main thread
+        try:
+            with ckpt.PreemptionGuard() as guard:
+                while not guard.requested:
+                    if hup_flag["on"]:
+                        hup_flag["on"] = False
+                        if router.request_rolling_reload() \
+                                and not self.silent:
+                            print("route: rolling fleet reload "
+                                  "started (SIGHUP)", file=sys.stderr,
+                                  flush=True)
+                    time.sleep(0.1)
+                telemetry.event({"ev": "preempt_signal",
+                                 "signum": guard.signum})
+                if not self.silent:
+                    print("route: fleet drain requested (signal %s)"
+                          % guard.signum, file=sys.stderr, flush=True)
+        finally:
+            stats = router.drain()
+            if wd is not None:
+                wd.stop()
+            if old_hup is not None:
+                try:
+                    signal.signal(signal.SIGHUP, old_hup)
+                except (ValueError, OSError):
+                    pass
+        telemetry.event(dict({"ev": "route_done"}, **stats))
+        print("routed %d requests (%d served, %d errors, %d shed, "
+              "%d deadline, %d retries)"
+              % (stats["accepted"], stats["served"], stats["errors"],
+                 stats["shed"], stats["deadline"], stats["retries"]),
+              file=sys.stderr, flush=True)
 
     def task_export(self) -> None:
         """task = export: AOT-compile the inference forward (params baked

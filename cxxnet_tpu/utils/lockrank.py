@@ -63,6 +63,10 @@ __all__ = ["RANKS", "DEFAULT_RANK", "LockOrderError", "RankedLock",
 # with `python tools/cxxlint.py --lock-graph`; tests/test_cxxlint.py
 # fails if an edge of the real graph contradicts this table.
 RANKS = {
+    "routerd.fleet": 2,     # Router._lock — replica states/load/windows
+    #                         (outermost: held while recording telemetry,
+    #                         never under any servd/statusd lock)
+    "routerd.stats": 5,     # Router._slock — router counter snapshot
     "servd.queue": 10,      # ServeFrontend._cond — admission/worker/drain
     "servd.conns": 20,      # ServeFrontend._conn_lock — live writer set
     "servd.conn": 30,       # _ConnState.cond — per-connection reply slots

@@ -1,0 +1,1184 @@
+"""Replicated serving fleet: a health-aware TCP router over servd.
+
+One ``servd`` process is production-grade (PR 5-8) but it is not a
+fleet: a replica crash, wedge, or reload is a total outage. This module
+is the fleet layer — the TF-Serving-era topology (arxiv 1605.08695)
+where replicated model servers sit behind health-checked load balancing
+— as a stdlib-only TCP router in the servd/statusd design language. It
+speaks the EXACT servd line protocol (one request line in, one response
+line out, ``DEADLINE``/``ADMIN`` prefixes, ``ERR <class> <detail>``),
+so a client cannot tell the fleet from a single replica.
+
+Per-replica state machine, fed by two signal paths:
+
+* **probe path** — a prober thread polls each replica's statusd
+  ``/healthz`` (readiness) every ``probe_ms`` and classifies:
+  200 → ``up`` (and the replica's live ``queue_depth`` /
+  ``in_flight`` gauges — read via its ``ADMIN stats``, the same
+  values exported on ``/metrics`` — refresh the load estimate),
+  503 mentioning draining → ``draining``, any other 503 (breaker open,
+  stalled backend) → ``breaker_open``, unreachable → ``dead``.
+* **dispatch path** — outcomes observed while routing move the machine
+  without waiting a probe interval: connect-refused → ``dead``,
+  ``ERR busy breaker`` → ``breaker_open``, ``ERR draining`` →
+  ``draining``.
+
+A ``dead`` replica is EJECTED and re-probed on the shared exponential
+backoff schedule (``checkpoint.backoff_delay`` — the breaker/retry-IO
+curve): each consecutive failed re-probe doubles the wait, a successful
+probe re-admits it and resets the backoff.
+
+Dispatch is least-loaded with power-of-two-choices: two eligible
+replicas are sampled, the one with the lower load — probed queue_depth
++ in_flight plus the router's own live outstanding count — wins (ties
+go to the lower replica index, so behavior under zero load is
+deterministic). Only ``up`` replicas not held out by a rolling reload
+are eligible.
+
+**Retry-on-shed, exactly-once preserved.** The third token of a servd
+error line is a machine-readable detail token (utils/servd.py), and the
+router retries a request on a DIFFERENT replica only when that token
+proves the request never dispatched:
+
+    response                     dispatched?   router action
+    ------------------------     -----------   -----------------------
+    ERR busy queue ...           never         retry elsewhere
+    ERR busy breaker ...         never         eject + retry elsewhere
+    ERR draining server ...      never         mark draining + retry
+    ERR draining shutdown ...    never         mark draining + retry
+    ERR draining backend ...     MAYBE         relay (no retry)
+    ERR backend ...              yes           relay (no retry)
+    ERR parse / empty / deadline deterministic relay (no retry)
+    connect refused              never         mark dead + retry
+    sent, then no response       MAYBE         mark dead-suspect, relay
+                                               ERR backend (no retry)
+
+Retries respect the client's remaining ``DEADLINE`` budget: the router
+parses the bound once at accept, and every forward carries the budget
+REMAINING at that instant (so replica-side queue waits spend from the
+same clock); a budget that runs out between attempts is answered ``ERR
+deadline`` by the router itself. Requests without a deadline are
+bounded per attempt by ``stall_s`` — the accept-but-never-answer
+(partition) detector.
+
+**Fleet ADMIN.** ``ADMIN stats`` aggregates every reachable replica's
+counters (the per-replica counters each reconcile ``accepted == served
++ errors + shed + deadline``, so the fleet sums do too). ``ADMIN
+reload`` starts a ROLLING reload: one replica at a time is held out of
+rotation, its in-router outstanding requests drain to zero, ``ADMIN
+reload`` is forwarded, and the replica rejoins only after its reload
+counter moved and ``/healthz`` reads ready — so fleet capacity never
+drops below N-1 and a model update is client-invisible. Each hold is
+recorded as a (replica, t_out, t_back) drain window (the zero-downtime
+acceptance asserts the windows never overlap).
+
+Counters reconcile at the router too: ``accepted == served + errors +
+shed + deadline`` (``retries`` and ``admin`` ride outside). statusd
+surfaces: ``statusd.set_fleet(router)`` exports ``/fleetz`` and the
+``cxxnet_fleet_*`` series; ``health_probe``/``liveness_probe`` plug
+into ``/healthz``/``/livez`` like servd's.
+
+Deliberately jax-free (the replicas are other processes); ``python -m
+cxxnet_tpu.utils.routerd --selftest`` drives routing, retry, ejection,
+rolling reload and drain over real loopback sockets with in-process
+servd replicas — ``make check`` gates on it. The driver surface is
+``task = route`` (conf keys ``route_port`` / ``route_replicas`` /
+``route_probe_ms`` / ``route_retries`` / ``route_stall_s`` /
+``route_host`` — doc/serving.md "Replicated serving fleet").
+"""
+
+from __future__ import annotations
+
+import random
+import re
+import socket
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from . import checkpoint as ckpt
+from . import health
+from . import lockrank
+from . import telemetry
+
+__all__ = ["Replica", "Router", "parse_replicas", "retryable",
+           "UP", "DRAINING", "BREAKER_OPEN", "DEAD", "selftest"]
+
+UP = "up"
+DRAINING = "draining"
+BREAKER_OPEN = "breaker_open"
+DEAD = "dead"
+
+# stat key -> telemetry counter (reconciliation mirrors servd's:
+# accepted == served + errors + shed + deadline; retries/admin outside)
+_COUNTERS = {
+    "accepted": "route.accepted",
+    "served": "route.served",
+    "errors": "route.errors",
+    "shed": "route.shed",
+    "deadline": "route.deadline",
+    "admin": "route.admin",
+    "retries": "route.retries",
+    "client_gone": "route.client_gone",
+}
+
+
+
+def parse_replicas(spec) -> List[Tuple[str, int, int]]:
+    """``route_replicas`` conf value -> [(host, serve_port,
+    status_port)]. Items are comma/whitespace separated, each
+    ``host:serve_port:status_port`` (host defaults to 127.0.0.1 when
+    only two fields are given)."""
+    if not isinstance(spec, str):
+        return list(spec)
+    out: List[Tuple[str, int, int]] = []
+    for item in re.split(r"[,\s]+", spec.strip()):
+        if not item:
+            continue
+        bits = item.rsplit(":", 2)
+        if len(bits) == 2:
+            host, port, sport = "127.0.0.1", bits[0], bits[1]
+        elif len(bits) == 3:
+            host, port, sport = bits
+        else:
+            raise ValueError(
+                "route_replicas item %r is not host:port:status_port"
+                % item)
+        out.append((host or "127.0.0.1", int(port), int(sport)))
+    return out
+
+
+def retryable(resp: str) -> bool:
+    """The retryability half of the wire contract (module docstring):
+    True only when the response PROVES the request never dispatched to
+    a backend — a shed (``ERR busy``, any detail) or a drain refusal
+    that is not the drain-gave-up-on-in-flight case (``ERR draining
+    backend``). Everything else stays with the replica: exactly-once
+    beats availability."""
+    toks = resp.split()
+    if toks[:2] == ["ERR", "busy"]:
+        return True
+    if toks[:2] == ["ERR", "draining"]:
+        return toks[2:3] != ["backend"]
+    return False
+
+
+def _http_get(host: str, port: int, path: str,
+              timeout: float) -> Tuple[int, str]:
+    """Tiny GET helper -> (status, body); raises OSError when the
+    endpoint is unreachable (URLError is an OSError)."""
+    from urllib.error import HTTPError
+    from urllib.request import urlopen
+    try:
+        with urlopen("http://%s:%d%s" % (host, port, path),
+                     timeout=timeout) as resp:
+            return resp.status, resp.read().decode("utf-8", "replace")
+    except HTTPError as e:
+        return e.code, e.read().decode("utf-8", "replace")
+
+
+class Replica:
+    """One replica's routing state. All mutable fields are guarded by
+    the router's fleet lock; the object itself is a dumb record."""
+
+    __slots__ = ("name", "host", "port", "status_port", "state",
+                 "detail", "hold", "queue_depth", "in_flight",
+                 "outstanding", "probe_fails", "ejections",
+                 "next_probe_at", "last_probe")
+
+    def __init__(self, host: str, port: int, status_port: int):
+        self.host = host
+        self.port = int(port)
+        self.status_port = int(status_port)
+        self.name = "%s:%d" % (host, self.port)
+        # optimistic start: routable until a probe or a dispatch says
+        # otherwise — a router must not refuse traffic for probe_ms
+        # after startup when the fleet is healthy
+        self.state = UP
+        self.detail = "unprobed (optimistic)"
+        self.hold = False            # rolling reload: out of rotation
+        self.queue_depth = 0         # last probed gauges (load signal)
+        self.in_flight = 0
+        self.outstanding = 0         # router-side live request count
+        self.probe_fails = 0
+        self.ejections = 0           # backoff exponent while dead
+        self.next_probe_at = 0.0     # monotonic; dead replicas re-probe
+        #                              on the backoff schedule only
+        self.last_probe: Optional[float] = None
+
+    def snapshot(self, now: float) -> dict:
+        return {"name": self.name, "state": self.state,
+                "detail": self.detail, "hold": self.hold,
+                "queue_depth": self.queue_depth,
+                "in_flight": self.in_flight,
+                "outstanding": self.outstanding,
+                "ejections": self.ejections,
+                "probe_fails": self.probe_fails,
+                "last_probe_age_s": None if self.last_probe is None
+                else round(now - self.last_probe, 3)}
+
+
+class Router:
+    """The fleet router. ``replicas`` is a ``parse_replicas`` spec (or
+    its output). Lifecycle mirrors servd: ``start()`` (prober thread) →
+    ``listen(port)`` (accept thread) → ``drain()``.
+
+    Client connections are handled one request at a time per connection
+    (the positional line protocol pairs responses to requests, and the
+    forward is synchronous), so fleet concurrency comes from concurrent
+    connections — exactly the shape of the serving chaos harness."""
+
+    def __init__(self, replicas, probe_ms: float = 200.0,
+                 retries: int = 2, stall_s: float = 30.0,
+                 drain_ms: float = 5000.0,
+                 connect_timeout: float = 1.0,
+                 probe_timeout: float = 1.0,
+                 client_timeout: float = 10.0,
+                 probe_backoff_cap_s: float = 30.0,
+                 reload_timeout_s: float = 30.0):
+        specs = parse_replicas(replicas)
+        if not specs:
+            raise ValueError("router needs at least one replica")
+        self._replicas = [Replica(*s) for s in specs]
+        self.probe_s = max(0.01, float(probe_ms) / 1e3)
+        self.retries = max(0, int(retries))
+        self.stall_s = float(stall_s)
+        self.drain_ms = float(drain_ms)
+        self.connect_timeout = float(connect_timeout)
+        self.probe_timeout = float(probe_timeout)
+        self.client_timeout = float(client_timeout)
+        self.probe_backoff_cap_s = float(probe_backoff_cap_s)
+        self.reload_timeout_s = float(reload_timeout_s)
+        # ranked locks (utils/lockrank.py): fleet state outermost, then
+        # stats — both may record telemetry (registry is innermost)
+        self._lock = lockrank.lock("routerd.fleet")
+        self._slock = lockrank.lock("routerd.stats")
+        self._stats = {k: 0 for k in _COUNTERS}
+        self._draining = False
+        self._stop = False
+        self._active = 0             # requests currently being handled
+        self._reloading = False
+        self._windows: List[Tuple[str, float, float]] = []
+        self._wake = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._sock: Optional[socket.socket] = None
+        self.port: Optional[int] = None
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> "Router":
+        telemetry.declare_hist("route.request")
+        telemetry.gauge("route.replicas", len(self._replicas))
+        telemetry.gauge("route.replicas_up", len(self._replicas))
+        self._probe_thread = threading.Thread(
+            target=self._prober_run, name="cxn-routerd-probe",
+            daemon=True)
+        self._probe_thread.start()
+        return self
+
+    def listen(self, port: int = 0, host: str = "") -> int:
+        self._sock = socket.create_server((host or "127.0.0.1",
+                                           int(port)))
+        self._sock.settimeout(0.25)
+        self.port = self._sock.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_run, name="cxn-routerd-accept",
+            daemon=True)
+        self._accept_thread.start()
+        telemetry.event({"ev": "route_listen", "port": self.port,
+                         "replicas": [r.name for r in self._replicas]})
+        return self.port
+
+    def stats(self) -> dict:
+        with self._slock:
+            return dict(self._stats)
+
+    def _bump(self, *names: str) -> None:
+        with self._slock:
+            for name in names:
+                self._stats[name] += 1
+        for name in names:
+            telemetry.count(_COUNTERS[name])
+
+    # -- health (statusd probes) ---------------------------------------
+    def health_probe(self) -> Tuple[bool, str]:
+        """Readiness: the router can place a request somewhere."""
+        if self._draining:
+            return False, "draining: not accepting new requests"
+        with self._lock:
+            n = sum(1 for r in self._replicas
+                    if r.state == UP and not r.hold)
+            total = len(self._replicas)
+        if n == 0:
+            return False, ("no routable replica (0 of %d up)" % total)
+        return True, "routing to %d of %d replicas" % (n, total)
+
+    def liveness_probe(self) -> Tuple[bool, str]:
+        t = self._probe_thread
+        if t is not None and not t.is_alive() and not self._stop:
+            return False, "router prober thread died"
+        return True, "alive"
+
+    # -- fleet snapshot (statusd /fleetz + cxxnet_fleet_* series) ------
+    def fleet_snapshot(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            reps = [r.snapshot(now) for r in self._replicas]
+            eligible = sum(1 for r in self._replicas
+                           if r.state == UP and not r.hold)
+            windows = [{"replica": n, "out_s": round(a, 3),
+                        "back_s": round(b, 3)}
+                       for n, a, b in self._windows[-32:]]
+            body = {"replicas": reps, "eligible": eligible,
+                    "draining": self._draining,
+                    "reloading": self._reloading,
+                    "windows": windows}
+        body["stats"] = self.stats()
+        return body
+
+    # -- replica state machine (fleet lock) ----------------------------
+    def _mark(self, r: Replica, state: str, detail: str) -> None:
+        """Move one replica's state machine; emits a transition event
+        (never per-observation spam). Lock taken here — callers must
+        NOT hold the fleet lock (the event emission nests registry
+        under fleet, which the rank order allows, but the IO callers
+        around this must stay lock-free)."""
+        with self._lock:
+            prev = r.state
+            r.state = state
+            r.detail = detail
+            if state == DEAD:
+                # ejection: re-probe on the shared backoff curve; each
+                # consecutive failure doubles the wait
+                r.next_probe_at = time.monotonic() + ckpt.backoff_delay(
+                    r.ejections, base_delay=self.probe_s,
+                    cap=self.probe_backoff_cap_s)
+                r.ejections += 1
+                r.probe_fails += 1
+            elif state == UP:
+                r.ejections = 0
+                r.probe_fails = 0
+            up = sum(1 for x in self._replicas if x.state == UP)
+            changed = prev != state
+            if changed:
+                telemetry.count("route.transitions")
+                telemetry.event({"ev": "route_replica",
+                                 "replica": r.name, "state": state,
+                                 "prev": prev, "detail": detail[:120]})
+        if changed:
+            telemetry.gauge("route.replicas_up", up)
+
+    # -- prober --------------------------------------------------------
+    def probe_now(self) -> None:
+        """One synchronous probe sweep (tests, and the driver's initial
+        fleet check) — same classification as the prober thread."""
+        for r in list(self._replicas):
+            with self._lock:
+                if r.state == DEAD and \
+                        time.monotonic() < r.next_probe_at:
+                    continue             # still backing off
+                host, sport = r.host, r.status_port
+            self._probe_one(r, host, sport)
+
+    def _probe_one(self, r: Replica, host: str, sport: int) -> None:
+        # ALL IO lock-free; the classification lands via _mark
+        try:
+            code, body = _http_get(host, sport, "/healthz",
+                                   self.probe_timeout)
+        except OSError as e:
+            self._mark(r, DEAD, "statusd unreachable: %r" % (e,))
+            return
+        with self._lock:
+            r.last_probe = time.monotonic()
+        if code == 200:
+            # load refresh from the replica's own ADMIN stats (the
+            # live queue_depth/in_flight gauges, read under its
+            # admission lock): per-replica-exact even when replicas
+            # share one telemetry registry in-process, and far cheaper
+            # than a /metrics scrape (which runs the replica's whole
+            # probe pass + registry snapshot per poll). The same
+            # gauges ride /metrics for dashboards.
+            st = self._replica_stats(r)
+            if st is not None:
+                with self._lock:
+                    r.queue_depth = st.get("queue_depth",
+                                           r.queue_depth)
+                    r.in_flight = st.get("in_flight", r.in_flight)
+            self._mark(r, UP, "ready")
+        else:
+            lower = body.lower()
+            if "draining" in lower:
+                self._mark(r, DRAINING, body.strip()[:120])
+            else:
+                # breaker open, stalled backend, anomaly: unready for a
+                # cause other than drain — grouped as breaker_open (out
+                # of rotation until a ready probe; statusd reachable,
+                # so no backoff ejection)
+                self._mark(r, BREAKER_OPEN, body.strip()[:120])
+
+    def _prober_run(self) -> None:
+        # wait FIRST: replicas start optimistic (routable), so the
+        # sweep is refresh, not gate — and a driver that wants a
+        # verified fleet before serving calls probe_now() itself
+        while True:
+            health.beat("route.probe")
+            self._wake.wait(self.probe_s)
+            with self._lock:
+                if self._draining or self._stop:
+                    break
+            self.probe_now()
+        health.pause("route.probe")
+
+    # -- dispatch ------------------------------------------------------
+    def _load(self, r: Replica) -> float:
+        return r.queue_depth + r.in_flight + r.outstanding
+
+    def _pick(self, exclude) -> Optional[Replica]:
+        """Power-of-two-choices among eligible replicas (up, not held,
+        not yet tried for this request); the checked-out replica's
+        outstanding count is bumped under the same lock so concurrent
+        picks see each other's load."""
+        with self._lock:
+            elig = [r for r in self._replicas
+                    if r.state == UP and not r.hold
+                    and r.name not in exclude]
+            if not elig:
+                return None
+            if len(elig) == 1:
+                r = elig[0]
+            else:
+                a, b = random.sample(elig, 2)
+                la, lb = self._load(a), self._load(b)
+                if la == lb:
+                    # deterministic tie-break: the lower replica index
+                    # (selftest + zero-load behavior must not flap)
+                    r = a if self._replicas.index(a) \
+                        < self._replicas.index(b) else b
+                else:
+                    r = a if la < lb else b
+            r.outstanding += 1
+            return r
+
+    def _checkin(self, r: Replica) -> None:
+        with self._lock:
+            r.outstanding = max(0, r.outstanding - 1)
+
+    def _forward(self, r: Replica, line: str,
+                 timeout: float) -> Tuple[str, Optional[str]]:
+        """One attempt against one replica -> (status, response):
+        ``ok`` (a response line), ``noconnect`` (the request never
+        left: SAFE to retry), ``lost`` (sent, then EOF/timeout: the
+        request MAY have dispatched — never retried). A fresh
+        connection per attempt: a pooled socket into a replica that
+        died between requests would turn an innocent request into a
+        false 'lost'."""
+        try:
+            c = socket.create_connection((r.host, r.port),
+                                         timeout=self.connect_timeout)
+        except OSError:
+            return "noconnect", None
+        try:
+            c.settimeout(max(0.05, timeout))
+            try:
+                c.sendall((line + "\n").encode("utf-8", "replace"))
+                resp = c.makefile("r", encoding="utf-8").readline()
+            except OSError:
+                return "lost", None
+            if not resp:
+                return "lost", None
+            return "ok", resp.rstrip("\n")
+        finally:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def _handle(self, line: str) -> str:
+        """Route one request line; returns the one response line."""
+        parts = line.split()
+        if parts and parts[0] == "ADMIN":
+            return self._handle_admin(parts[1:])
+        t0 = time.monotonic()
+        # parse the deadline ONCE at accept: every retry spends from
+        # this clock. A malformed bound is forwarded untouched — the
+        # replica's parser answers ERR parse (one implementation).
+        deadline = None
+        rest: List[str] = []
+        if parts[:1] == ["DEADLINE"] and len(parts) >= 2:
+            try:
+                budget = float(parts[1]) / 1e3
+            except ValueError:
+                budget = None
+            if budget is not None and 0 <= budget < float("inf"):
+                deadline = t0 + budget
+                rest = parts[2:]
+        # admission + accounting in one critical section with drain()'s
+        # flag flip (the servd rule): a post-drain arrival is refused
+        # WITHOUT entering the accounting
+        with self._lock:
+            if self._draining or self._stop:
+                return "ERR draining router is shutting down"
+            self._active += 1
+        self._bump("accepted")
+        try:
+            text, outcome = self._route(line, rest, deadline, t0)
+            # outcome lands BEFORE the active slot is released: drain()
+            # snapshots final stats the moment _active hits 0, and an
+            # accepted-but-not-yet-outcomed request would read as
+            # non-reconciling books in the route_done event
+            self._bump(outcome)
+            telemetry.hist("route.request", time.monotonic() - t0)
+        finally:
+            with self._lock:
+                self._active -= 1
+        return text
+
+    def _route(self, line: str, rest: List[str],
+               deadline: Optional[float],
+               t0: float) -> Tuple[str, str]:
+        tried: set = set()
+        attempts = 0
+        last_shed: Optional[str] = None
+        while True:
+            now = time.monotonic()
+            if deadline is not None and now >= deadline:
+                return ("ERR deadline expired %.0fms past the budget "
+                        "(router)" % (1e3 * (now - deadline)),
+                        "deadline")
+            r = self._pick(tried)
+            if r is None:
+                if last_shed is not None:
+                    return last_shed, "shed"
+                return ("ERR busy fleet no routable replica (%s)"
+                        % self._states_brief(), "shed")
+            timeout = self.stall_s
+            sendline = line
+            if deadline is not None:
+                rem = deadline - now
+                timeout = min(timeout, rem)
+                # forward the budget REMAINING, not the original: the
+                # replica's own queue-expiry check spends the same clock
+                sendline = "DEADLINE %d %s" % (max(1, int(rem * 1e3)),
+                                               " ".join(rest))
+            try:
+                status, resp = self._forward(r, sendline, timeout)
+            finally:
+                self._checkin(r)
+            tried.add(r.name)
+            if status == "noconnect":
+                # never sent: safe. Eject now — waiting a probe
+                # interval would burn every retry on a dead replica.
+                self._mark(r, DEAD, "connect refused at dispatch")
+                if self._retry_allowed(attempts):
+                    attempts += 1
+                    self._bump("retries")
+                    continue
+                return ("ERR busy fleet replicas unreachable", "shed")
+            if status == "lost":
+                # sent, then silence/EOF: the request MAY have
+                # dispatched — exactly-once forbids a replay. The
+                # prober decides whether the replica is dead (SIGKILL)
+                # or merely slow (stall bound), so no hard eject here.
+                telemetry.count("route.lost_contact")
+                return ("ERR backend replica %s lost contact "
+                        "mid-request (not retried: may have dispatched)"
+                        % r.name, "errors")
+            # a response line: dispatch on the retryability contract
+            if retryable(resp):
+                last_shed = resp
+                toks = resp.split()
+                detail = toks[2] if len(toks) > 2 else ""
+                if toks[:2] == ["ERR", "busy"] and detail == "breaker":
+                    self._mark(r, BREAKER_OPEN, resp[:120])
+                elif toks[:1] == ["ERR"] and toks[1:2] == ["draining"]:
+                    self._mark(r, DRAINING, resp[:120])
+                if self._retry_allowed(attempts):
+                    attempts += 1
+                    self._bump("retries")
+                    continue
+                return resp, "shed"
+            if resp.startswith("ERR deadline"):
+                return resp, "deadline"
+            if resp.startswith("ERR"):
+                return resp, "errors"
+            return resp, "served"
+
+    def _retry_allowed(self, attempts: int) -> bool:
+        """Another attempt is allowed while the retry budget holds AND
+        the router is not draining — drain bounds its wait on 'every
+        in-flight request finishes within one attempt', so a request
+        mid-retry must stop chaining attempts once drain begins."""
+        if attempts >= self.retries:
+            return False
+        with self._lock:
+            return not self._draining
+
+    def _states_brief(self) -> str:
+        with self._lock:
+            by: Dict[str, int] = {}
+            for r in self._replicas:
+                key = "held" if (r.state == UP and r.hold) else r.state
+                by[key] = by.get(key, 0) + 1
+        return " ".join("%s=%d" % kv for kv in sorted(by.items()))
+
+    # -- fleet ADMIN ---------------------------------------------------
+    def _handle_admin(self, args: List[str]) -> str:
+        with self._lock:
+            if self._draining or self._stop:
+                return "ERR draining router is shutting down"
+        self._bump("admin")
+        if args and args[0] == "stats":
+            return self._fleet_stats_text()
+        if args and args[0] == "reload":
+            if self.request_rolling_reload():
+                return "OK fleet reload rolling (one replica at a time)"
+            return "ERR busy reload already rolling"
+        if args and args[0] == "fleet":
+            snap = self.fleet_snapshot()
+            return "OK " + " ".join(
+                "%s=%s:%d:%d" % (x["name"], x["state"],
+                                 x["queue_depth"] + x["in_flight"],
+                                 x["outstanding"])
+                for x in snap["replicas"])
+        return ("ERR parse unknown ADMIN command %r"
+                % " ".join(args))
+
+    def _replica_stats(self, r: Replica) -> Optional[Dict[str, int]]:
+        """One replica's ``ADMIN stats`` counters (None when
+        unreachable) — short probe timeout, never the stall bound."""
+        status, resp = self._forward(r, "ADMIN stats",
+                                     self.probe_timeout)
+        if status != "ok" or not resp.startswith("OK "):
+            return None
+        out: Dict[str, int] = {}
+        for kv in resp[3:].split():
+            k, _, v = kv.partition("=")
+            try:
+                out[k] = int(v)
+            except ValueError:
+                continue
+        return out
+
+    def _fleet_stats_text(self) -> str:
+        """Aggregate ``ADMIN stats`` over every reachable replica. Each
+        replica reconciles accepted == served + errors + shed +
+        deadline, so the sums reconcile too; ``replicas``/``reachable``
+        ride along so a partial aggregate is visible as partial."""
+        with self._lock:
+            reps = [(r, r.state) for r in self._replicas]
+        totals: Dict[str, int] = {}
+        reachable = 0
+        for r, state in reps:
+            if state == DEAD:
+                continue             # don't burn a timeout per scrape
+            st = self._replica_stats(r)
+            if st is None:
+                continue
+            reachable += 1
+            for k, v in st.items():
+                totals[k] = totals.get(k, 0) + v
+        totals["replicas"] = len(reps)
+        totals["reachable"] = reachable
+        return "OK " + " ".join("%s=%d" % kv
+                                for kv in sorted(totals.items()))
+
+    # -- rolling reload ------------------------------------------------
+    def request_rolling_reload(self) -> bool:
+        """Start the rolling fleet reload (one drain window at a time);
+        False when one is already running or the router is draining.
+        Safe from a SIGHUP handler? NO — this takes locks; the driver's
+        handler sets a flag and calls this from its main loop."""
+        with self._lock:
+            if self._reloading or self._draining or self._stop:
+                return False
+            self._reloading = True
+        t = threading.Thread(target=self._rolling_reload_run,
+                             name="cxn-routerd-reload", daemon=True)
+        t.start()
+        return True
+
+    def _rolling_reload_run(self) -> None:
+        try:
+            for r in list(self._replicas):
+                with self._lock:
+                    skip = r.state == DEAD
+                if skip:
+                    telemetry.event({"ev": "route_reload",
+                                     "replica": r.name,
+                                     "phase": "skipped_dead"})
+                    continue
+                self._reload_one(r)
+            telemetry.event({"ev": "route_reload", "phase": "complete"})
+        finally:
+            with self._lock:
+                self._reloading = False
+
+    def _reload_one(self, r: Replica) -> None:
+        with self._lock:
+            r.hold = True
+            t_out = time.monotonic()
+        telemetry.event({"ev": "route_reload", "replica": r.name,
+                         "phase": "drain"})
+        by = t_out + self.reload_timeout_s
+        ok = False
+        ready = False
+        try:
+            # 1. drain THIS router's outstanding requests off the
+            # replica (new picks already skip it)
+            while time.monotonic() < by:
+                with self._lock:
+                    n = r.outstanding
+                if n == 0:
+                    break
+                time.sleep(0.01)
+            # 2. reload; completion = the replica's reload_seen counter
+            # moved — it counts every PROCESSED reload request (real
+            # swap, no-op already-newest skip, and failed reload alike;
+            # the old model keeps serving on failure — still 'complete'
+            # for the roll). Waiting on `reloads` alone would burn the
+            # whole timeout out of rotation on a no-op roll.
+            base = self._replica_stats(r)
+            status, resp = self._forward(r, "ADMIN reload",
+                                         self.probe_timeout)
+            if status != "ok" or not resp.startswith("OK"):
+                self._mark(r, DEAD, "reload dispatch failed: %r"
+                           % (resp,))
+                return
+            while time.monotonic() < by:
+                st = self._replica_stats(r)
+                if base is None or (st is not None and
+                                    st.get("reload_seen", 0)
+                                    > base.get("reload_seen", 0)):
+                    ok = True
+                    break
+                time.sleep(0.05)
+            # 3. rejoin only once readiness confirms (a reload that
+            # tripped the breaker must not re-enter rotation)
+            while time.monotonic() < by:
+                try:
+                    code, _ = _http_get(r.host, r.status_port,
+                                        "/healthz", self.probe_timeout)
+                except OSError:
+                    code = None
+                if code == 200:
+                    ready = True
+                    break
+                time.sleep(0.05)
+        finally:
+            with self._lock:
+                r.hold = False
+                t_back = time.monotonic()
+                self._windows.append((r.name, t_out, t_back))
+                if len(self._windows) > 64:
+                    # bounded: /fleetz reads the last 32; a cron'd
+                    # SIGHUP refresh must not grow this for months
+                    del self._windows[:-64]
+                demote = not ready and r.state == UP
+            if demote:
+                # /healthz never read ready inside the window: the
+                # documented invariant is rejoin-only-when-ready, so
+                # the replica leaves rotation until a ready probe —
+                # NOT silently back into picks still unready
+                self._mark(r, BREAKER_OPEN,
+                           "not ready within %gs after reload"
+                           % self.reload_timeout_s)
+            telemetry.event({"ev": "route_reload", "replica": r.name,
+                             "phase": "done", "ok": ok,
+                             "ready": ready,
+                             "window_s": round(t_back - t_out, 3)})
+
+    # -- TCP front -----------------------------------------------------
+    def _accept_run(self) -> None:
+        sock = self._sock
+        while True:
+            with self._lock:
+                if self._draining or self._stop:
+                    break
+            health.beat("route.accept")
+            try:
+                conn, _addr = sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break               # listener closed (drain)
+            conn.settimeout(self.client_timeout)
+            threading.Thread(target=self._client_run, args=(conn,),
+                             name="cxn-routerd-client",
+                             daemon=True).start()
+        health.pause("route.accept")
+
+    def _client_run(self, conn: socket.socket) -> None:
+        # one request at a time per connection: the forward is
+        # synchronous, so responses leave in request order by
+        # construction (no reply-slot machinery needed)
+        try:
+            buf = b""
+            while True:
+                try:
+                    chunk = conn.recv(65536)
+                except socket.timeout:
+                    continue        # idle client: keep the connection
+                except OSError:
+                    break
+                eof = not chunk
+                if eof and buf:
+                    buf += b"\n"    # unterminated final line = request
+                buf += chunk
+                while b"\n" in buf:
+                    raw, buf = buf.split(b"\n", 1)
+                    line = raw.decode("utf-8", "replace").rstrip("\r")
+                    text = self._handle(line)
+                    try:
+                        conn.sendall((text + "\n")
+                                     .encode("utf-8", "replace"))
+                    except OSError:
+                        self._bump("client_gone")
+                        return
+                if eof:
+                    break
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- drain ---------------------------------------------------------
+    def drain(self, timeout_ms: Optional[float] = None) -> dict:
+        """Stop accepting, let in-flight routed requests finish, stop
+        the prober, flush telemetry, return the final stats.
+        Idempotent. Replicas are NOT told to drain — they are their own
+        processes with their own lifecycle; the fleet drain is the
+        router getting out of the traffic path cleanly.
+
+        Exactly-one-response holds through drain WITHOUT servd's
+        claim machinery because every in-flight request is bounded:
+        each forward times out within ``stall_s`` (or its remaining
+        deadline) and the drain flag stops further retry attempts — so
+        waiting ``max(budget, stall_s)`` + slack guarantees every
+        accepted request's handler returned and its response line
+        reached the client before this returns."""
+        budget = (self.drain_ms if timeout_ms is None
+                  else float(timeout_ms)) / 1e3
+        t0 = time.monotonic()
+        with self._lock:
+            self._draining = True
+        telemetry.event({"ev": "route_drain", "phase": "begin"})
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=2.0)
+            self._accept_thread = None
+        self._wake.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=2.0)
+            self._probe_thread = None
+        # the hard bound: one in-flight attempt per active request,
+        # each <= stall_s — past it something is wrong enough that
+        # leftover_active is reported instead of waited on forever
+        hard_by = t0 + max(budget, self.stall_s + 2.0)
+        while time.monotonic() < hard_by:
+            with self._lock:
+                if self._active == 0:
+                    break
+            time.sleep(0.02)
+        with self._lock:
+            self._stop = True
+            leftovers = self._active
+        health.pause("route.accept")
+        health.pause("route.probe")
+        stats = self.stats()
+        telemetry.event(dict({"ev": "route_drain", "phase": "end",
+                              "seconds": round(time.monotonic() - t0,
+                                               3),
+                              "leftover_active": leftovers}, **stats))
+        telemetry.flush()
+        return stats
+
+
+# ----------------------------------------------------------------------
+def _ask(port: int, line: str, timeout: float = 5.0) -> str:
+    from . import servd
+    return servd._ask(port, line, timeout=timeout)
+
+
+def selftest(verbose: bool = False) -> int:
+    """Drive routing, retry-on-shed, breaker ejection, dead-replica
+    ejection + re-admission, deadline-budget forwarding, fleet stats
+    aggregation, rolling reload, and drain over real loopback sockets
+    with in-process servd replicas — jax-free; ``make check`` gates on
+    it. Runs with runtime lock-order enforcement on."""
+    with lockrank.enforced():
+        return _selftest_body(verbose)
+
+
+def _selftest_body(verbose: bool = False) -> int:
+    from . import servd
+    from . import statusd
+
+    # two replicas with DISTINGUISHABLE models: +1 and +1000 — every
+    # assertion below can see which replica answered
+    wedge1 = threading.Event()
+    wedge1.set()
+    model1 = {"v": 1}
+    reload2 = []
+
+    def backend1(toks, seq):
+        wedge1.wait(10.0)
+        return [t + model1["v"] for t in toks]
+
+    def backend2(toks, seq):
+        return [t + 1000 for t in toks]
+
+    fe1 = servd.ServeFrontend(backend1, queue_size=1, breaker_fails=1,
+                              breaker_cooldown_ms=50.0, drain_ms=2000.0,
+                              reload_fn=lambda: model1.update(
+                                  v=model1["v"] + 1) or True)
+    fe2 = servd.ServeFrontend(backend2, drain_ms=2000.0,
+                              reload_fn=lambda: reload2.append(1)
+                              or True)
+    fe1.start()
+    fe2.start()
+    p1, p2 = fe1.listen(0), fe2.listen(0)
+    s1 = statusd.StatusServer(0, host="127.0.0.1").start()
+    s2 = statusd.StatusServer(0, host="127.0.0.1").start()
+    s1.register_probe("serving", fe1.health_probe)
+    s2.register_probe("serving", fe2.health_probe)
+
+    # probing OFF the clock (probe_ms huge): every state transition in
+    # this selftest is driven deterministically — by dispatch outcomes
+    # or explicit probe_now() sweeps
+    router = Router([("127.0.0.1", p1, s1.port),
+                     ("127.0.0.1", p2, s2.port)],
+                    probe_ms=3600e3, retries=2, stall_s=5.0,
+                    drain_ms=2000.0, probe_backoff_cap_s=0.2,
+                    reload_timeout_s=10.0)
+    router.start()
+    rport = router.listen(0)
+    r1, r2 = router._replicas
+    srv = statusd.StatusServer(0, host="127.0.0.1").start()
+    srv.fleet = router
+    try:
+        # zero load, index tie-break: replica 1 answers
+        assert _ask(rport, "1 2") == "2 3"
+        # retry-on-shed: wedge replica 1 and fill its 1-slot queue so
+        # any pick of it sheds `ERR busy queue`; the router must retry
+        # on replica 2 transparently
+        wedge1.clear()
+        fe1.submit("7", lambda t: None)      # occupies the worker
+        deadline = time.monotonic() + 5.0
+        while not fe1._inflight and time.monotonic() < deadline:
+            time.sleep(0.01)                 # wait for the worker pop
+        fe1.submit("8", lambda t: None)      # fills the 1-slot queue
+        # direct shed proves the detail token (the wire contract)
+        direct = _ask(p1, "9")
+        assert direct.startswith("ERR busy queue"), direct
+        assert retryable(direct)
+        assert _ask(rport, "5") == "1005"    # retried onto replica 2
+        st = router.stats()
+        assert st["retries"] >= 1 and st["served"] == 2, st
+        wedge1.set()                         # un-wedge; queue drains
+        deadline = time.monotonic() + 5.0
+        while fe1.stats()["served"] < 2 and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
+
+        # breaker ejection: one failure opens replica 1's breaker
+        # (breaker_fails=1). The failure itself is relayed (dispatched:
+        # never retried); the NEXT pick of replica 1 sheds `ERR busy
+        # breaker`, which both retries elsewhere AND ejects it.
+        fe1.backend = servd_explode
+        assert _ask(rport, "3").startswith("ERR backend")
+        st = router.stats()
+        assert st["errors"] == 1, st
+        assert fe1.breaker.state == "open"
+        assert _ask(rport, "4") == "1004"    # shed by 1, served by 2
+        assert r1.state == BREAKER_OPEN, r1.state
+        # ejected: routed straight to replica 2, no retry spent
+        pre = router.stats()["retries"]
+        assert _ask(rport, "6") == "1006"
+        assert router.stats()["retries"] == pre
+
+        # re-admission by probe: heal the backend, close the breaker
+        # with a direct half-open probe, then one probe sweep
+        fe1.backend = backend1
+        time.sleep(0.08)                     # past the 50ms cooldown
+        assert _ask(p1, "1") == "2"
+        assert fe1.breaker.state == "closed"
+        router.probe_now()
+        assert r1.state == UP, (r1.state, r1.detail)
+
+        # dead-replica ejection + backoff re-probe: a replica whose
+        # ports answer nothing is marked dead at dispatch (connect
+        # refused: never sent, SAFE retry) and re-probed on the
+        # backoff schedule
+        with socket.socket() as tmp:
+            tmp.bind(("127.0.0.1", 0))
+            dead_port = tmp.getsockname()[1]
+        router2 = Router([("127.0.0.1", dead_port, dead_port),
+                          ("127.0.0.1", p2, s2.port)],
+                         probe_ms=3600e3, retries=2, stall_s=5.0,
+                         drain_ms=1000.0, probe_backoff_cap_s=0.2)
+        router2.start()
+        rport2 = router2.listen(0)
+        try:
+            assert _ask(rport2, "11") == "1011"
+            d1 = router2._replicas[0]
+            assert d1.state == DEAD and d1.ejections == 1
+            assert router2.stats()["retries"] == 1
+            # backing off: a sweep before next_probe_at skips it
+            fails = d1.probe_fails
+            router2.probe_now()
+            assert d1.probe_fails == fails, "re-probed inside backoff"
+            time.sleep(0.25)                 # past the 0.2s cap
+            router2.probe_now()
+            assert d1.probe_fails == fails + 1, "backoff re-probe ran"
+        finally:
+            router2.drain(timeout_ms=500)
+
+        # deadline budget forwarding: a mirror replica echoes the line
+        # it was sent — the forwarded DEADLINE must carry the REMAINING
+        # budget, not the original
+        mirror = _MirrorReplica().start()
+        router3 = Router([("127.0.0.1", mirror.port, mirror.port)],
+                         probe_ms=3600e3, retries=0, stall_s=5.0,
+                         drain_ms=1000.0)
+        router3.start()
+        rport3 = router3.listen(0)
+        try:
+            resp = _ask(rport3, "DEADLINE 5000 1 2 3")
+            toks = resp.split()
+            assert toks[0] == "DEADLINE" and toks[2:] == ["1", "2", "3"]
+            assert 0 < int(toks[1]) <= 5000, resp
+            # an expired budget is answered by the ROUTER, not routed
+            assert _ask(rport3, "DEADLINE 0 9") \
+                .startswith("ERR deadline")
+            assert router3.stats()["deadline"] == 1
+        finally:
+            router3.drain(timeout_ms=500)
+            mirror.stop()
+
+        # fleet ADMIN stats aggregates and reconciles
+        resp = _ask(rport, "ADMIN stats")
+        assert resp.startswith("OK "), resp
+        agg = {k: int(v) for k, _, v in
+               (kv.partition("=") for kv in resp[3:].split())}
+        assert agg["reachable"] == 2 and agg["replicas"] == 2
+        assert agg["accepted"] == (agg["served"] + agg["errors"]
+                                   + agg["shed"] + agg["deadline"]), agg
+        assert _ask(rport, "ADMIN fleet").startswith("OK ")
+        assert _ask(rport, "ADMIN bogus").startswith("ERR parse")
+
+        # rolling reload: both replicas reload one at a time, the drain
+        # windows never overlap (capacity stays >= N-1), and the fleet
+        # keeps serving throughout
+        v_before = model1["v"]
+        assert _ask(rport, "ADMIN reload").startswith("OK fleet")
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            with router._lock:
+                done = len(router._windows) >= 2 \
+                    and not router._reloading
+            if done:
+                break
+            # the fleet keeps answering while the roll is in flight
+            assert not _ask(rport, "2").startswith("ERR")
+            time.sleep(0.02)
+        snap = router.fleet_snapshot()
+        assert len(snap["windows"]) == 2, snap["windows"]
+        w1, w2 = snap["windows"]
+        assert w1["back_s"] <= w2["out_s"] or \
+            w2["back_s"] <= w1["out_s"], "drain windows overlap"
+        assert model1["v"] == v_before + 1 and reload2, \
+            "rolling reload did not reach both replicas"
+
+        # /fleetz + cxxnet_fleet_* ride statusd
+        code, body = _http_status(srv.port, "/fleetz?json=1")
+        assert code == 200 and '"replicas"' in body
+        code, metrics = _http_status(srv.port, "/metrics")
+        assert "cxxnet_fleet_replicas" in metrics
+        assert 'cxxnet_fleet_replica_up{' in metrics
+
+        assert router.health_probe()[0] and router.liveness_probe()[0]
+    finally:
+        stats = router.drain()
+        srv.stop()
+        s1.stop()
+        s2.stop()
+        fe1.drain(timeout_ms=1000)
+        fe2.drain(timeout_ms=1000)
+    assert stats["accepted"] == (stats["served"] + stats["errors"]
+                                 + stats["shed"] + stats["deadline"]), \
+        "router counters do not reconcile: %r" % (stats,)
+    assert router.health_probe() == (
+        False, "draining: not accepting new requests")
+    if verbose:
+        print("routerd selftest: routing/retry-on-shed/breaker-eject/"
+              "dead-eject+backoff/deadline-budget/fleet-stats/"
+              "rolling-reload/drain ok (%r)" % (stats,))
+    return 0
+
+
+def servd_explode(toks, seq):
+    raise RuntimeError("injected replica failure")
+
+
+class _MirrorReplica:
+    """A fake replica that answers every request line with the line
+    itself — the fixture that makes the router's DEADLINE rewrite
+    observable (a real servd consumes the prefix)."""
+
+    def __init__(self):
+        self.port = None
+        self._sock = None
+        self._thread = None
+
+    def start(self) -> "_MirrorReplica":
+        self._sock = socket.create_server(("127.0.0.1", 0))
+        self._sock.settimeout(0.25)
+        self.port = self._sock.getsockname()[1]
+        self._thread = threading.Thread(target=self._run,
+                                        name="cxn-mirror", daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while self._sock is not None:
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            with conn:
+                try:
+                    line = conn.makefile("r").readline()
+                    conn.sendall(line.encode())
+                except OSError:
+                    pass
+
+    def stop(self) -> None:
+        s, self._sock = self._sock, None
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+def _http_status(port: int, path: str) -> Tuple[int, str]:
+    try:
+        return _http_get("127.0.0.1", port, path, 5.0)
+    except OSError as e:
+        return 0, repr(e)
+
+
+if __name__ == "__main__":
+    if "--selftest" in sys.argv[1:]:
+        sys.exit(selftest(verbose=True))
+    print(__doc__)
+    sys.exit(1)

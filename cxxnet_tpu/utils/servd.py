@@ -48,7 +48,17 @@ Wire protocol (one line per request, one line per response, utf-8):
 Error classes: ``empty`` (blank request — visible instead of a silently
 missing response), ``parse`` (non-integer token, token outside vocab, bad
 DEADLINE), ``busy`` (queue full or breaker open: shed), ``deadline``,
-``backend``, ``draining``. Counters reconcile:
+``backend``, ``draining``. The THIRD token of an error line is a
+machine-readable detail token — the retryability contract the fleet
+router (utils/routerd.py) dispatches on, so these are wire format, not
+prose: ``ERR busy queue ...`` (admission queue full — the request never
+dispatched, instantly retryable on another replica) vs ``ERR busy
+breaker ...`` (circuit breaker open — also never dispatched, retryable
+elsewhere, but the replica should leave rotation); ``ERR draining
+server ...`` (refused at the door) and ``ERR draining shutdown ...``
+(queued, never dispatched) are retryable, ``ERR draining backend ...``
+(the in-flight request drain gave up on) may have dispatched and is
+NOT. Counters reconcile:
 ``accepted == served + errors + shed + deadline``. A request arriving
 AFTER drain began is refused (``ERR draining``) without entering the
 accounting — it was never accepted, so drain's final stats stay final.
@@ -261,6 +271,7 @@ _COUNTERS = {
     "empty": "serve.empty",
     "admin": "serve.admin",
     "reloads": "serve.reloads",
+    "reload_seen": "serve.reload_seen",
     "client_gone": "serve.client_gone",
 }
 # the stats mirrored into statusd's progress gauges per bump
@@ -564,9 +575,15 @@ class ServeFrontend:
                         self.request_reload()
                         text = "OK reload scheduled"
                     elif args and args[0] == "stats":
+                        # counters plus the LIVE load gauges (the fleet
+                        # router's load signal rides here too, not just
+                        # /metrics) — read under this lock, so the
+                        # snapshot is consistent with the queue
+                        live = dict(self.stats(),
+                                    queue_depth=len(self._q),
+                                    in_flight=self._inflight)
                         text = "OK " + " ".join(
-                            "%s=%d" % kv
-                            for kv in sorted(self.stats().items()))
+                            "%s=%d" % kv for kv in sorted(live.items()))
                     else:
                         text = ("ERR parse unknown ADMIN command %r"
                                 % " ".join(args))
@@ -590,15 +607,18 @@ class ServeFrontend:
                              else ("accepted", "errors")))
                 text = "ERR %s %s" % (cls, msg)
             elif self.breaker.blocked():
-                # breaker open: shed instantly — no queue, no backend
+                # breaker open: shed instantly — no queue, no backend.
+                # Third token "breaker" is wire format (module docstring):
+                # retryable elsewhere AND "eject me from rotation"
                 self._bump("accepted", "shed")
                 shed = True
-                text = "ERR busy circuit breaker open"
+                text = "ERR busy breaker open (circuit)"
             elif len(self._q) >= self.queue_size:
+                # third token "queue": never dispatched, instantly
+                # retryable on another replica
                 self._bump("accepted", "shed")
                 shed = True
-                text = "ERR busy admission queue full (%d)" \
-                    % self.queue_size
+                text = "ERR busy queue full (%d)" % self.queue_size
             else:
                 _, toks, deadline = parsed
                 req = _Request(toks, deadline, reply)
@@ -636,6 +656,13 @@ class ServeFrontend:
 
     def _do_reload(self) -> None:
         self._reload_flag = False
+        # EVERY processed reload request counts here — success, no-op
+        # skip (reload_fn False: already serving the newest checkpoint)
+        # and failure alike — while `reloads` counts only real swaps.
+        # The fleet router's rolling reload waits on THIS delta, so a
+        # no-op roll completes in milliseconds instead of burning its
+        # whole per-replica timeout out of rotation.
+        self._bump("reload_seen")
         if self.reload_fn is None:
             return
         try:
@@ -713,7 +740,7 @@ class ServeFrontend:
             t_end = time.perf_counter()
             wall = time.monotonic() - req.t_arrival
             self._finish_observed(
-                req, "ERR busy circuit breaker open", "shed", "shed",
+                req, "ERR busy breaker open (circuit)", "shed", "shed",
                 None, queue_wait, t_pop, t_pop, t_end, wall, 0)
             return
         req.seq, self._seq = self._seq, self._seq + 1
@@ -1211,12 +1238,25 @@ def _selftest_body(verbose: bool = False) -> int:
 
 
 def _stub_main(argv: List[str]) -> int:
-    """``--stub``: a standalone jax-free echo server for the chaos
-    harness — prints the bound port, serves until SIGTERM/SIGINT, drains,
-    prints the final stats as JSON, exits 0. Knobs: ``--port N``
-    ``--delay-ms D`` (slow backend) ``--explode-every N`` (every Nth
-    dispatch raises) ``--queue N`` ``--drain-ms D``."""
+    """``--stub``: a standalone jax-free replica for the chaos harness —
+    prints the bound port(s), serves until SIGTERM/SIGINT, drains, prints
+    the final stats as JSON, exits 0. Knobs: ``--port N`` ``--delay-ms D``
+    (slow backend) ``--explode-every N`` (every Nth dispatch raises)
+    ``--queue N`` ``--drain-ms D`` ``--breaker-fails N`` ``--stall-s S``
+    (wedged-backend probe bound).
+
+    Fleet knobs (tests/faultinject.py's fleet helpers, the routerd chaos
+    suite): ``--status-port N`` starts a statusd sidecar wired to the
+    frontend's readiness/liveness probes (what the router polls) and
+    prints its port on a second line; the backend answers ``tok +
+    version`` where ``version`` starts at 1 and each ``ADMIN reload``
+    bumps it (after sleeping ``--reload-ms`` — a stand-in for the decode
+    recompile a real reload pays), so a rolling-reload test can SEE which
+    model answered; SIGUSR1 wedges the backend (it blocks, heartbeats
+    silent — the accept-but-never-answer failure mode from inside) until
+    SIGUSR2 unwedges it."""
     import json
+    import signal
 
     def flag(name, default, cast=float):
         if name in argv:
@@ -1225,23 +1265,55 @@ def _stub_main(argv: List[str]) -> int:
 
     delay = flag("--delay-ms", 0.0) / 1e3
     explode_every = int(flag("--explode-every", 0))
+    reload_s = flag("--reload-ms", 0.0) / 1e3
+    model = {"version": 1}
+    wedge = {"on": False}
 
     def backend(toks, seq):
+        while wedge["on"]:          # SIGUSR1: block until SIGUSR2
+            time.sleep(0.05)
         if explode_every and (seq + 1) % explode_every == 0:
             raise RuntimeError("injected stub explosion")
         if delay:
             time.sleep(delay)
-        return [t + 1 for t in toks]
+        return [t + model["version"] for t in toks]
+
+    def reload_fn():
+        if reload_s:
+            time.sleep(reload_s)    # the recompile stand-in
+        model["version"] += 1
+        return True
 
     fe = ServeFrontend(backend, queue_size=int(flag("--queue", 64)),
-                       drain_ms=flag("--drain-ms", 5000.0))
+                       drain_ms=flag("--drain-ms", 5000.0),
+                       breaker_fails=int(flag("--breaker-fails", 5)),
+                       stall_after_s=flag("--stall-s", 120.0),
+                       reload_fn=reload_fn)
     fe.start()
     port = fe.listen(int(flag("--port", 0)))
     print("servd-stub: listening on port %d" % port, flush=True)
+    status_port = int(flag("--status-port", -1))
+    if status_port >= 0:
+        # the statusd sidecar a real `task = serve` replica runs: the
+        # router's probe surface (/healthz readiness + /metrics gauges)
+        telemetry.enable()          # in-memory: /metrics needs the reg
+        srv = statusd.start(status_port)
+        statusd.register_probe("serving", fe.health_probe)
+        statusd.register_probe("serving.worker", fe.liveness_probe,
+                               liveness=True)
+        statusd.set_flight_recorder(fe.flight)
+        print("servd-stub: status on port %d" % srv.port, flush=True)
+    for signum, on in ((getattr(signal, "SIGUSR1", None), True),
+                       (getattr(signal, "SIGUSR2", None), False)):
+        if signum is not None:
+            signal.signal(signum,
+                          lambda s, f, _on=on: wedge.update(on=_on))
     with ckpt.PreemptionGuard(enabled=True) as guard:
         while not guard.requested:
             time.sleep(0.05)
     stats = fe.drain()
+    if status_port >= 0:
+        statusd.stop()
     print("servd-stub: drained " + json.dumps(stats), flush=True)
     return 0
 

@@ -52,6 +52,12 @@ Endpoints:
   (one capture at a time — a concurrent request gets 409), so a live
   slow replica can be xprof'd without restarting it. Loopback-bound
   like every other endpoint unless ``status_host`` widens the bind.
+* ``/fleetz`` — the serving fleet's routing table (utils/routerd.py,
+  registered by ``task = route``): one row per replica — state machine
+  (up / draining / breaker_open / dead), load gauges, ejection backoff
+  — plus the router's counters and the rolling-reload drain windows.
+  ``?json=1`` returns the raw snapshot; /metrics exports the same
+  account as the ``cxxnet_fleet_*`` series.
 
 Serving SLOs: an ``SLOTracker`` (objectives ``slo_ttft_ms`` /
 ``slo_p99_ms`` / ``slo_availability`` over a rolling window) turns each
@@ -98,7 +104,8 @@ __all__ = [
     "StatusServer", "SLOTracker", "start", "stop", "active",
     "set_run_info", "update_progress", "register_probe", "wire_health",
     "set_flight_recorder", "set_slo", "set_perf", "set_profiler",
-    "prometheus_metrics", "programz_html", "PROM_LINE_RE", "selftest",
+    "set_fleet", "prometheus_metrics", "programz_html", "fleetz_html",
+    "PROM_LINE_RE", "selftest",
 ]
 
 _NAME_SAN = re.compile(r"[^a-zA-Z0-9_]")
@@ -274,7 +281,8 @@ def prometheus_metrics(snapshot: dict, progress: Optional[dict] = None,
                        channels: Optional[list] = None,
                        live_failures: Optional[list] = None,
                        slo: Optional[dict] = None,
-                       perf: Optional[dict] = None) -> str:
+                       perf: Optional[dict] = None,
+                       fleet: Optional[dict] = None) -> str:
     """Render a ``telemetry.metrics_snapshot()`` as Prometheus text
     exposition format 0.0.4. Pure function of its inputs — the selftest
     and tests validate its output without a socket. ``channels`` is the
@@ -375,6 +383,47 @@ def prometheus_metrics(snapshot: dict, progress: Optional[dict] = None,
                     '%s{process="%s",program="%s",shapes="%s"} %s'
                     % (mname, _lesc(p), _lesc(c.get("name", "?")),
                        _lesc(c.get("sig", "?")), _fmt(c[field])))
+    if fleet is not None:
+        # the routing fleet (routerd.Router.fleet_snapshot()): per-state
+        # counts as one labeled family, per-replica load/liveness rows
+        # keyed by replica address (the heartbeat-channel pattern)
+        reps = fleet.get("replicas") or []
+        emit("cxxnet_fleet_replicas", "gauge", len(reps),
+             help_="replicas configured behind the router")
+        emit("cxxnet_fleet_replicas_eligible", "gauge",
+             int(fleet.get("eligible", 0)),
+             help_="replicas up and in rotation (not held by a "
+                   "rolling reload)")
+        by_state: Dict[str, int] = {}
+        for r in reps:
+            by_state[r.get("state", "?")] = \
+                by_state.get(r.get("state", "?"), 0) + 1
+        if by_state:
+            out.append("# TYPE cxxnet_fleet_state gauge")
+            for st in sorted(by_state):
+                out.append('cxxnet_fleet_state{process="%s",state="%s"}'
+                           ' %d' % (_lesc(p), _lesc(st), by_state[st]))
+        fams = (("cxxnet_fleet_replica_up",
+                 lambda r: 1 if r.get("state") == "up" else 0,
+                 "1 while the replica is routable"),
+                ("cxxnet_fleet_replica_queue_depth",
+                 lambda r: r.get("queue_depth", 0), None),
+                ("cxxnet_fleet_replica_in_flight",
+                 lambda r: r.get("in_flight", 0), None),
+                ("cxxnet_fleet_replica_outstanding",
+                 lambda r: r.get("outstanding", 0),
+                 "requests this router currently has on the replica"))
+        for mname, get, help_ in fams:
+            if not reps:
+                continue
+            if help_:
+                out.append("# HELP %s %s" % (mname, help_))
+            out.append("# TYPE %s gauge" % mname)
+            for r in reps:
+                out.append('%s{process="%s",replica="%s"} %s'
+                           % (mname, _lesc(p),
+                              _lesc(r.get("name", "?")),
+                              _fmt(get(r))))
     if channels is None:
         channels = health_mod.channel_status()
     if channels:
@@ -480,6 +529,50 @@ def programz_html(snap: dict) -> str:
     return "\n".join(parts)
 
 
+def fleetz_html(snap: dict) -> str:
+    """Render a ``routerd.Router.fleet_snapshot()`` as the /fleetz
+    page: one row per replica (state machine + load + ejection
+    backoff), the router's counters, and the recent rolling-reload
+    drain windows. Pure function of the snapshot — the routerd
+    selftest and tests validate it socket-free."""
+    esc = html.escape
+    parts = ["<html><head><title>cxxnet fleetz</title></head>"
+             "<body><h1>serving fleet</h1><pre>"]
+    reps = snap.get("replicas") or []
+    parts.append("replicas: %d configured, %d eligible%s%s"
+                 % (len(reps), snap.get("eligible", 0),
+                    "  DRAINING" if snap.get("draining") else "",
+                    "  ROLLING-RELOAD" if snap.get("reloading")
+                    else ""))
+    parts.append("</pre><h2>replicas</h2><pre>")
+    cols = ("replica", "state", "hold", "queue", "in_flight",
+            "outstanding", "ejections", "probed", "detail")
+    fmt = "%-21s %-12s %-4s %5s %9s %11s %9s %8s  %s"
+    parts.append(fmt % cols)
+    for r in reps:
+        age = r.get("last_probe_age_s")
+        parts.append(fmt % (
+            esc(r.get("name", "?")), esc(r.get("state", "?")),
+            "yes" if r.get("hold") else "-", r.get("queue_depth", 0),
+            r.get("in_flight", 0), r.get("outstanding", 0),
+            r.get("ejections", 0),
+            "never" if age is None else "%.1fs" % age,
+            esc(str(r.get("detail", "")))))
+    parts.append("</pre><h2>router</h2><pre>")
+    parts.append(" ".join("%s=%s" % kv for kv in
+                          sorted((snap.get("stats") or {}).items())))
+    wins = snap.get("windows") or []
+    if wins:
+        parts.append("</pre><h2>rolling-reload drain windows</h2><pre>")
+        for w in wins:
+            parts.append("%-21s out %.3f -> back %.3f (%.3fs)"
+                         % (esc(w.get("replica", "?")), w["out_s"],
+                            w["back_s"], w["back_s"] - w["out_s"]))
+    parts.append("</pre><p><a href='/fleetz?json=1'>json</a> "
+                 "<a href='/statusz'>statusz</a></p></body></html>")
+    return "\n".join(parts)
+
+
 class _HTTPServer(ThreadingHTTPServer):
     daemon_threads = True
     allow_reuse_address = True
@@ -576,6 +669,21 @@ class _Endpoint(BaseHTTPRequestHandler):
                     else:
                         self._reply(200, "text/html; charset=utf-8",
                                     programz_html(snap).encode("utf-8"))
+            elif path == "/fleetz":
+                fl = srv.fleet
+                if fl is None:
+                    self._reply(404, "text/plain; charset=utf-8",
+                                b"no fleet registered (this process is "
+                                b"not a router; task = route wires "
+                                b"one)\n")
+                else:
+                    snap = fl.fleet_snapshot()
+                    if parse_qs(query).get("json"):
+                        self._reply(200, "application/json",
+                                    json.dumps(snap).encode("utf-8"))
+                    else:
+                        self._reply(200, "text/html; charset=utf-8",
+                                    fleetz_html(snap).encode("utf-8"))
             elif path == "/profilez":
                 prof = srv.profiler
                 if prof is None:
@@ -615,7 +723,7 @@ class _Endpoint(BaseHTTPRequestHandler):
                 self._reply(404, "text/plain; charset=utf-8",
                             b"not found; endpoints: /metrics /healthz "
                             b"/livez /statusz /trace /requestz "
-                            b"/programz /profilez\n")
+                            b"/programz /profilez /fleetz\n")
         except Exception as e:    # a broken probe must not kill the server
             try:
                 self._reply(500, "text/plain; charset=utf-8",
@@ -645,6 +753,9 @@ class StatusServer:
         # and the perf.ProfilerCapture behind /profilez
         self.perf = None
         self.profiler = None
+        # fleet wiring (set_fleet): the routerd.Router behind /fleetz
+        # and the cxxnet_fleet_* series (task = route registers it)
+        self.fleet = None
         # (name, probe_fn, liveness): see register_probe
         self.probes: List[Tuple[str, Callable[[], Tuple[bool, str]],
                                 bool]] = []
@@ -760,7 +871,9 @@ class StatusServer:
             channels=channels,
             live_failures=live,
             slo=self.slo.snapshot() if self.slo is not None else None,
-            perf=self.perf.snapshot() if self.perf is not None else None)
+            perf=self.perf.snapshot() if self.perf is not None else None,
+            fleet=self.fleet.fleet_snapshot()
+            if self.fleet is not None else None)
 
     def statusz_html(self) -> str:
         reg = self.registry
@@ -821,6 +934,24 @@ class StatusServer:
                 ("burn rate", "%.2fx%s" % (sn["burn_rate"],
                                            "  BURNING" if sn["alert"]
                                            else ""))])
+        if self.fleet is not None:
+            fsnap = self.fleet.fleet_snapshot()
+            by: Dict[str, int] = {}
+            for r in fsnap.get("replicas") or []:
+                by[r.get("state", "?")] = by.get(r.get("state", "?"),
+                                                 0) + 1
+            table("fleet", [
+                ("replicas", "%d configured, %d eligible (%s) — see "
+                 "/fleetz" % (len(fsnap.get("replicas") or []),
+                              fsnap.get("eligible", 0),
+                              " ".join("%s=%d" % kv
+                                       for kv in sorted(by.items()))
+                              or "none")),
+                ("router", " ".join(
+                    "%s=%s" % kv
+                    for kv in sorted((fsnap.get("stats")
+                                      or {}).items())))])
+
         if self.flight is not None and len(self.flight):
             latest = self.flight.list()[0]
             table("requests", [
@@ -962,6 +1093,14 @@ def set_profiler(capture) -> None:
         s.profiler = capture
 
 
+def set_fleet(router) -> None:
+    """Attach a routerd.Router — /fleetz and the cxxnet_fleet_* series
+    serve from its fleet_snapshot(). No-op without a server."""
+    s = _SERVER
+    if s is not None:
+        s.fleet = router
+
+
 # ----------------------------------------------------------------------
 def selftest(verbose: bool = False) -> int:
     """Serve on port 0, scrape every endpoint over a real socket,
@@ -1056,8 +1195,62 @@ def _selftest_body(verbose: bool = False) -> int:
             assert "worker died" in e.read().decode()
         srv.probes.clear()
 
+        # fleet surfaces: 404 before a router registers, then the
+        # /fleetz page + cxxnet_fleet_* series from a snapshot-shaped
+        # fake (the real Router drives these in the routerd selftest)
+        try:
+            urlopen(base + "/fleetz", timeout=5)
+            raise AssertionError("fleetz without a fleet should 404")
+        except HTTPError as e:
+            assert e.code == 404
+
+        class _FakeFleet:
+            def fleet_snapshot(self):
+                return {"replicas": [
+                    {"name": "127.0.0.1:7001", "state": "up",
+                     "hold": False, "queue_depth": 2, "in_flight": 1,
+                     "outstanding": 1, "ejections": 0,
+                     "probe_fails": 0, "last_probe_age_s": 0.1,
+                     "detail": "ready"},
+                    {"name": "127.0.0.1:7002", "state": "dead",
+                     "hold": False, "queue_depth": 0, "in_flight": 0,
+                     "outstanding": 0, "ejections": 3,
+                     "probe_fails": 3, "last_probe_age_s": None,
+                     "detail": "statusd unreachable"}],
+                    "eligible": 1, "draining": False,
+                    "reloading": False,
+                    "windows": [{"replica": "127.0.0.1:7001",
+                                 "out_s": 1.0, "back_s": 1.5}],
+                    "stats": {"accepted": 5, "served": 4, "shed": 1,
+                              "errors": 0, "deadline": 0,
+                              "retries": 1, "admin": 0,
+                              "client_gone": 0}}
+
+        srv.fleet = _FakeFleet()
+        fz = urlopen(base + "/fleetz", timeout=5).read().decode()
+        assert "127.0.0.1:7001" in fz and "dead" in fz
+        assert "drain windows" in fz
+        fj = json.loads(urlopen(base + "/fleetz?json=1",
+                                timeout=5).read())
+        assert fj["eligible"] == 1 and len(fj["replicas"]) == 2
+        mf = urlopen(base + "/metrics", timeout=5).read().decode()
+        for line in mf.splitlines():
+            if line and not line.startswith("#"):
+                assert PROM_LINE_RE.match(line), \
+                    "invalid Prometheus line: %r" % line
+        assert 'cxxnet_fleet_replicas{process="0"} 2' in mf
+        assert 'cxxnet_fleet_replicas_eligible{process="0"} 1' in mf
+        assert ('cxxnet_fleet_state{process="0",state="dead"} 1'
+                in mf)
+        assert ('cxxnet_fleet_replica_up{process="0",'
+                'replica="127.0.0.1:7002"} 0' in mf)
+        assert ('cxxnet_fleet_replica_queue_depth{process="0",'
+                'replica="127.0.0.1:7001"} 2' in mf)
+
         page = urlopen(base + "/statusz", timeout=5).read().decode()
         assert "statusz" in page and "selftest.requests" in page
+        assert "fleet" in page and "eligible" in page
+        srv.fleet = None
         # never-fired series renders n/a, not 0.00ms; SLO section shows
         assert "selftest.never_fired" in page and "n/a" in page
         assert "burn rate" in page and "BURNING" in page

@@ -27,6 +27,22 @@ preemptible fleet:
   ``serve_request`` / ``serve_flood`` / ``disconnecting_client``
                       — real-socket clients: one-shot, concurrent
                         overload, and hang-up-mid-request
+* fleet chaos (utils/routerd.py, tests/test_routerd.py):
+  ``spawn_replica`` / ``spawn_fleet`` — N REAL ``servd --stub``
+                        subprocesses on ephemeral ports (each with a
+                        statusd sidecar — the router's probe surface)
+  ``kill_replica``    — SIGKILL: the replica vanishes mid-flood
+  ``partition_replica`` / ``heal_replica``
+                      — SIGSTOP/SIGCONT: the kernel keeps ACCEPTING
+                        TCP (listen backlog) but nothing ever answers
+                        — the accept-but-never-respond network
+                        partition, reversible for re-admission tests
+  ``wedge_replica`` / ``unwedge_replica``
+                      — SIGUSR1/SIGUSR2: the backend blocks past
+                        ``serve_stall_s`` (readiness fails, the
+                        router ejects) without the process dying
+  ``restart_replica`` — respawn a killed replica on the SAME ports
+                        (recovery for backoff re-admission tests)
 
 These are plain file/process manipulations so they compose with any
 test runner; tests/test_checkpoint_faults.py and
@@ -341,6 +357,168 @@ def disconnecting_client(port: int, line: str, rst: bool = True) -> None:
         c.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
                      struct.pack("ii", 1, 0))
     c.close()
+
+
+# ----------------------------------------------------------------------
+# fleet chaos harness (utils/routerd.py, tests/test_routerd.py): real
+# servd subprocesses — the router's failure modes are PROCESS failure
+# modes (SIGKILL, SIGSTOP partitions), so in-process fakes cannot
+# exercise them
+class FleetReplica:
+    """One spawned ``servd --stub`` replica: the Popen handle plus its
+    serve/status ports and the argv used (so ``restart_replica`` can
+    respawn it on the SAME ports after a kill)."""
+
+    def __init__(self, proc, port, status_port, args):
+        self.proc = proc
+        self.port = port
+        self.status_port = status_port
+        self.args = args
+
+    @property
+    def spec(self):
+        """The (host, serve_port, status_port) tuple routerd routes by."""
+        return ("127.0.0.1", self.port, self.status_port)
+
+
+def _start_stub(port=0, status_port=0, delay_ms=0.0, queue=64,
+                drain_ms=5000.0, stall_s=120.0, breaker_fails=5,
+                explode_every=0, reload_ms=0.0):
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    args = [sys.executable, "-m", "cxxnet_tpu.utils.servd", "--stub",
+            "--port", str(port), "--status-port", str(status_port),
+            "--delay-ms", str(delay_ms), "--queue", str(queue),
+            "--drain-ms", str(drain_ms), "--stall-s", str(stall_s),
+            "--breaker-fails", str(breaker_fails),
+            "--explode-every", str(explode_every),
+            "--reload-ms", str(reload_ms)]
+    return subprocess.Popen(args, stdout=subprocess.PIPE, text=True,
+                            cwd=repo), args
+
+
+def _await_ports(proc, timeout=20.0):
+    import time
+
+    ports = {}
+    t0 = time.monotonic()
+    while len(ports) < 2 and time.monotonic() - t0 < timeout:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        if line.startswith("servd-stub: listening on port "):
+            ports["serve"] = int(line.split()[-1])
+        elif line.startswith("servd-stub: status on port "):
+            ports["status"] = int(line.split()[-1])
+    assert len(ports) == 2, \
+        "stub replica did not report its ports (rc=%r)" % proc.poll()
+    return ports["serve"], ports["status"]
+
+
+def spawn_replica(timeout=20.0, **kw):
+    """Spawn one real ``python -m cxxnet_tpu.utils.servd --stub``
+    subprocess with a statusd sidecar, block until both ports are
+    printed, return a FleetReplica. The stub's backend answers
+    ``tok + version`` (version starts at 1, each ADMIN reload bumps it
+    after sleeping ``reload_ms``) so tests can SEE which model served."""
+    proc, args = _start_stub(**kw)
+    port, status_port = _await_ports(proc, timeout=timeout)
+    r = FleetReplica(proc, port, status_port, args)
+    # re-pin the ports so a restart lands on the same addresses
+    r.args[r.args.index("--port") + 1] = str(r.port)
+    r.args[r.args.index("--status-port") + 1] = str(r.status_port)
+    return r
+
+
+def spawn_fleet(n, timeout=20.0, **kw):
+    """N replicas (see spawn_replica), spawned CONCURRENTLY — the
+    interpreter startup dominates, so N sequential spawns would tax
+    every chaos test N-fold. kill/partition/wedge compose."""
+    procs = [_start_stub(**kw) for _ in range(n)]
+    out = []
+    for proc, args in procs:
+        port, status_port = _await_ports(proc, timeout=timeout)
+        r = FleetReplica(proc, port, status_port, args)
+        r.args[r.args.index("--port") + 1] = str(r.port)
+        r.args[r.args.index("--status-port") + 1] = str(r.status_port)
+        out.append(r)
+    return out
+
+
+def stop_fleet(replicas, timeout=15.0):
+    """SIGTERM (graceful drain) every still-running replica; SIGKILL
+    whatever ignores it. Safe on already-dead/killed replicas."""
+    for r in replicas:
+        if r.proc.poll() is None:
+            try:
+                r.proc.send_signal(signal.SIGCONT)   # un-freeze first
+                r.proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+    for r in replicas:
+        try:
+            r.proc.wait(timeout=timeout)
+        except Exception:
+            r.proc.kill()
+            r.proc.wait()
+        if r.proc.stdout is not None:
+            r.proc.stdout.close()
+
+
+def kill_replica(r):
+    """SIGKILL — no drain, no goodbye: connections die with EOF/RST,
+    accepted requests vanish. The router must answer its own clients
+    anyway and never replay a request that may have dispatched."""
+    r.proc.kill()
+    r.proc.wait()
+
+
+def partition_replica(r):
+    """SIGSTOP — the network partition from the replica's side: the
+    kernel still completes TCP handshakes (listen backlog) and ACKs
+    bytes, but no response ever comes. Reversible (heal_replica)."""
+    os.kill(r.proc.pid, signal.SIGSTOP)
+
+
+def heal_replica(r):
+    """SIGCONT — the partition heals; frozen requests resume."""
+    os.kill(r.proc.pid, signal.SIGCONT)
+
+
+def wedge_replica(r):
+    """SIGUSR1 — the stub's backend blocks (stays blocked until
+    unwedge_replica): past ``stall_s`` the replica's own /healthz
+    fails and the router takes it out of rotation."""
+    os.kill(r.proc.pid, signal.SIGUSR1)
+
+
+def unwedge_replica(r):
+    """SIGUSR2 — the wedged backend resumes."""
+    os.kill(r.proc.pid, signal.SIGUSR2)
+
+
+def restart_replica(r, timeout=20.0):
+    """Respawn a killed replica on the SAME serve/status ports — the
+    'operator replaced the dead task' recovery the router's backoff
+    re-probe must notice and re-admit."""
+    import subprocess
+
+    assert r.proc.poll() is not None, "restart_replica on a live replica"
+    if r.proc.stdout is not None:
+        r.proc.stdout.close()
+    proc = subprocess.Popen(r.args, stdout=subprocess.PIPE, text=True,
+                            cwd=os.path.dirname(os.path.dirname(
+                                os.path.abspath(__file__))))
+    seen = 0
+    while seen < 2:
+        line = proc.stdout.readline()
+        assert line, "restarted replica died (rc=%r)" % proc.poll()
+        if line.startswith("servd-stub:"):
+            seen += 1
+    r.proc = proc
+    return r
 
 
 def make_imgbin(dirname: str, bufs, page_ints: int = 1 << 12,

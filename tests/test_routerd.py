@@ -1,0 +1,535 @@
+"""Replicated-fleet chaos suite (utils/routerd.py): health-aware
+routing over REAL servd replica subprocesses, retry-on-shed, provably
+exactly-once forwarding, replica SIGKILL / SIGSTOP-partition / wedge
+mid-flood, backoff re-admission, rolling zero-downtime reload, and the
+task = route driver's SIGTERM fleet drain.
+
+Everything here is jax-free and real-socket (the replicas are
+``servd --stub`` subprocesses from faultinject's fleet helpers; the
+stub's backend answers ``tok + model_version`` so tests can SEE which
+model served). The fleet invariants under fault injection:
+
+* every request the ROUTER accepts gets exactly one response line;
+* a request that MAY have dispatched to a replica is never replayed on
+  another one (exactly-once beats availability);
+* router counters reconcile: accepted == served + errors + shed +
+  deadline — and so does the fleet-wide ``ADMIN stats`` aggregate over
+  the surviving replicas;
+* a rolling ``ADMIN reload`` under sustained load is client-invisible
+  and holds at most ONE replica out of rotation at a time.
+"""
+
+import json
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from cxxnet_tpu.utils import routerd, servd, statusd, telemetry
+
+from . import faultinject
+
+REPO = __import__("os").path.dirname(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _lockrank_on(monkeypatch):
+    """Runtime lock-order enforcement for every router/frontend this
+    suite constructs (the stub subprocesses inherit the env too): an
+    inversion the static analyzer cannot see fails the chaos test as a
+    named LockOrderError instead of deadlocking (doc/static_analysis.md
+    — the test_servd/test_statusd autouse pattern)."""
+    monkeypatch.setenv("CXXNET_LOCKRANK", "1")
+
+
+def reconciles(stats):
+    return stats["accepted"] == (stats["served"] + stats["errors"]
+                                 + stats["shed"] + stats["deadline"])
+
+
+def replica_stats(r):
+    """One replica's ADMIN stats as a dict (direct, not via router)."""
+    resp = faultinject.serve_request(r.port, "ADMIN stats")
+    assert resp and resp.startswith("OK "), resp
+    return {k: int(v) for k, _, v in
+            (kv.partition("=") for kv in resp[3:].split())}
+
+
+@pytest.fixture()
+def make_router():
+    """Factory for started+listening routers over FleetReplica lists
+    (or raw specs); everything made here drains at teardown."""
+    made = []
+
+    def make(replicas, **kw):
+        specs = [r.spec if isinstance(r, faultinject.FleetReplica)
+                 else r for r in replicas]
+        kw.setdefault("drain_ms", 2000.0)
+        kw.setdefault("probe_timeout", 0.5)
+        router = routerd.Router(specs, **kw)
+        router.start()
+        router.listen(0)
+        made.append(router)
+        return router
+
+    yield make
+    for router in made:
+        router.drain(timeout_ms=2000)
+
+
+def wait_until(cond, timeout=8.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError("timed out waiting for " + msg)
+
+
+def spawn_two(kw_a, kw_b=None):
+    """Two replicas with DIFFERENT configs, spawned concurrently (the
+    homogeneous case is faultinject.spawn_fleet)."""
+    procs = [faultinject._start_stub(**kw_a),
+             faultinject._start_stub(**(kw_b or {}))]
+    out = []
+    for proc, args in procs:
+        port, sp = faultinject._await_ports(proc)
+        r = faultinject.FleetReplica(proc, port, sp, args)
+        r.args[r.args.index("--port") + 1] = str(r.port)
+        r.args[r.args.index("--status-port") + 1] = str(r.status_port)
+        out.append(r)
+    return out
+
+
+# ----------------------------------------------------------------------
+# the wire-format retryability contract (what keeps exactly-once safe)
+def test_retryability_contract():
+    assert routerd.retryable("ERR busy queue full (64)")
+    assert routerd.retryable("ERR busy breaker open (circuit)")
+    assert routerd.retryable("ERR draining server is shutting down")
+    assert routerd.retryable("ERR draining shutdown budget exhausted")
+    # the drain-gave-up-on-in-flight case MAY have dispatched
+    assert not routerd.retryable(
+        "ERR draining backend exceeded the drain budget")
+    assert not routerd.retryable("ERR backend RuntimeError('boom')")
+    assert not routerd.retryable("ERR parse non-integer token")
+    assert not routerd.retryable("ERR deadline expired 5ms ago")
+    assert not routerd.retryable("ERR empty request line has no tokens")
+    assert not routerd.retryable("2 3 4")
+
+
+def test_parse_replicas():
+    specs = routerd.parse_replicas(
+        "7001:7101, 10.0.0.2:7002:7102\nlocalhost:7003:7103")
+    assert specs == [("127.0.0.1", 7001, 7101),
+                     ("10.0.0.2", 7002, 7102),
+                     ("localhost", 7003, 7103)]
+    with pytest.raises(ValueError):
+        routerd.parse_replicas("7001")
+
+
+# ----------------------------------------------------------------------
+# routing basics over real replicas: sequential + concurrent traffic,
+# least-loaded spread, fleet ADMIN stats aggregation
+def test_routes_spreads_and_fleet_stats_reconcile(make_router):
+    fleet = faultinject.spawn_fleet(2, delay_ms=40)
+    try:
+        router = make_router(fleet, probe_ms=50.0)
+        for i in range(4):
+            assert faultinject.serve_request(
+                router.port, "%d" % i) == "%d" % (i + 1)
+        responses = faultinject.serve_flood(router.port, ["5"] * 8)
+        assert all(r == "6" for r in responses), responses
+        st = router.stats()
+        assert st["served"] == 12 and reconciles(st)
+        # least-loaded dispatch: with 8 concurrent 40ms requests both
+        # replicas must have taken real work
+        counts = [replica_stats(r)["accepted"] for r in fleet]
+        assert all(c >= 1 for c in counts), counts
+        assert sum(counts) == 12
+        # fleet ADMIN stats aggregates the per-replica counters and the
+        # sums reconcile (each replica reconciles, so the fleet does)
+        resp = faultinject.serve_request(router.port, "ADMIN stats")
+        agg = {k: int(v) for k, _, v in
+               (kv.partition("=") for kv in resp[3:].split())}
+        assert agg["reachable"] == 2 and agg["replicas"] == 2
+        assert agg["accepted"] == 12 and reconciles(agg)
+    finally:
+        faultinject.stop_fleet(fleet)
+
+
+# ----------------------------------------------------------------------
+# retry-on-shed: ERR busy queue is retried elsewhere, ERR busy breaker
+# additionally ejects, ERR backend is never retried
+def test_queue_shed_retried_on_other_replica(make_router):
+    a, b = spawn_two({"queue": 1})
+    socks = []
+    try:
+        # wedge A and fill its 1-slot queue with fire-and-forget
+        # requests so any pick of A sheds `ERR busy queue`
+        faultinject.wedge_replica(a)
+        for _ in range(2):
+            s = socket.create_connection(("127.0.0.1", a.port),
+                                         timeout=5)
+            s.sendall(b"9\n")
+            socks.append(s)
+        wait_until(lambda: replica_stats(a)["queue_depth"] == 1
+                   and replica_stats(a)["in_flight"] == 1,
+                   msg="replica A full")
+        # probing off the clock: picks are deterministic (zero load,
+        # index tie-break -> A first), so the shed+retry is guaranteed
+        router = make_router([a, b], probe_ms=3600e3, retries=2)
+        assert faultinject.serve_request(router.port, "5") == "6"
+        st = router.stats()
+        assert st["served"] == 1 and st["retries"] == 1, st
+        assert replica_stats(b)["served"] == 1
+        # the shed is in A's books, the request is not
+        assert replica_stats(a)["shed"] == 1
+    finally:
+        for s in socks:
+            s.close()
+        faultinject.unwedge_replica(a)
+        faultinject.stop_fleet([a, b])
+
+
+def test_breaker_shed_ejects_replica(make_router):
+    a, b = spawn_two({"explode_every": 1, "breaker_fails": 1})
+    try:
+        router = make_router([a, b], probe_ms=3600e3, retries=2)
+        # dispatched failure: relayed verbatim, NEVER retried
+        assert faultinject.serve_request(
+            router.port, "1").startswith("ERR backend")
+        st = router.stats()
+        assert st["errors"] == 1 and st["retries"] == 0, st
+        # next pick of A sheds `ERR busy breaker`: retried on B AND A
+        # leaves rotation
+        assert faultinject.serve_request(router.port, "2") == "3"
+        snap = router.fleet_snapshot()
+        assert snap["replicas"][0]["state"] == routerd.BREAKER_OPEN
+        assert router.stats()["retries"] == 1
+        # ejected: the next request goes straight to B, no retry spent
+        assert faultinject.serve_request(router.port, "4") == "5"
+        assert router.stats()["retries"] == 1
+        assert replica_stats(b)["served"] == 2
+    finally:
+        faultinject.stop_fleet([a, b])
+
+
+# ----------------------------------------------------------------------
+# exactly-once: a replica that dies AFTER accepting is never replayed
+def test_no_replay_when_replica_dies_after_accepting(make_router):
+    a, b = spawn_two({"delay_ms": 500})
+    try:
+        router = make_router([a, b], probe_ms=3600e3, retries=2,
+                             stall_s=5.0)
+        out = {}
+
+        def client():
+            out["resp"] = faultinject.serve_request(router.port, "7",
+                                                    timeout=15)
+
+        t = threading.Thread(target=client)
+        t.start()
+        # zero load, index tie-break: the request is on A (800ms
+        # backend); kill A while it is in flight
+        wait_until(lambda: replica_stats(a)["in_flight"] == 1,
+                   msg="request in flight on A")
+        faultinject.kill_replica(a)
+        t.join(timeout=15)
+        assert not t.is_alive()
+        # the client got an honest ERR, and the request was NOT
+        # replayed: replica B never saw a request
+        assert out["resp"].startswith("ERR backend"), out
+        assert "not retried" in out["resp"]
+        st = router.stats()
+        assert st["errors"] == 1 and st["retries"] == 0, st
+        assert replica_stats(b)["accepted"] == 0
+    finally:
+        faultinject.stop_fleet([a, b])
+
+
+# ----------------------------------------------------------------------
+# deadline budget: the router forwards the REMAINING budget and answers
+# expired budgets itself
+def test_deadline_budget_forwarded_and_enforced(make_router):
+    mirror = routerd._MirrorReplica().start()
+    try:
+        router = make_router([("127.0.0.1", mirror.port, mirror.port)],
+                             probe_ms=3600e3, retries=0)
+        resp = faultinject.serve_request(router.port,
+                                         "DEADLINE 400 1 2 3")
+        toks = resp.split()
+        assert toks[0] == "DEADLINE" and toks[2:] == ["1", "2", "3"]
+        assert 0 < int(toks[1]) <= 400, resp
+        assert faultinject.serve_request(
+            router.port, "DEADLINE 0 9").startswith("ERR deadline")
+        st = router.stats()
+        assert st["deadline"] == 1 and reconciles(st)
+    finally:
+        mirror.stop()
+
+
+# ----------------------------------------------------------------------
+# THE HEADLINE CHAOS GUARANTEE: SIGKILL one replica and partition
+# another mid-flood — every request the fleet accepted is answered,
+# counters reconcile fleet-wide, and both replicas are ejected then
+# re-admitted after recovery via backoff re-probe
+def test_kill_and_partition_mid_flood_zero_loss(make_router):
+    fleet = faultinject.spawn_fleet(3, delay_ms=40)
+    try:
+        router = make_router(fleet, probe_ms=100.0, retries=2,
+                             stall_s=1.5, probe_backoff_cap_s=0.5)
+        n = 24
+        responses = [None] * n
+        started = threading.Event()
+
+        def client(i):
+            started.set()
+            try:
+                responses[i] = faultinject.serve_request(
+                    router.port, "5", timeout=25)
+            except OSError:
+                responses[i] = None
+
+        ts = [threading.Thread(target=client, args=(i,))
+              for i in range(n)]
+        for t in ts:
+            t.start()
+        started.wait(5.0)
+        time.sleep(0.15)          # flood in progress
+        faultinject.kill_replica(fleet[0])
+        faultinject.partition_replica(fleet[1])
+        for t in ts:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in ts)
+        # zero silent losses: every accepted request was answered
+        # (served, or an honest ERR — never a missing line)
+        assert all(r is not None for r in responses), responses
+        ok = [r for r in responses if r == "6"]
+        errs = [r for r in responses if r.startswith("ERR")]
+        assert len(ok) + len(errs) == n, responses
+        assert ok, "no request survived the chaos"
+        st = router.stats()
+        assert st["accepted"] == n and reconciles(st), st
+        # both failed replicas are ejected
+        wait_until(lambda: router.fleet_snapshot()["replicas"][0]
+                   ["state"] == routerd.DEAD, msg="killed ejected")
+        wait_until(lambda: router.fleet_snapshot()["replicas"][1]
+                   ["state"] == routerd.DEAD,
+                   msg="partitioned ejected")
+        # fleet-wide reconciliation over the survivors (the healed
+        # partition finishes its frozen requests into dead sockets —
+        # still counted, still reconciled)
+        faultinject.heal_replica(fleet[1])
+        wait_until(lambda: reconciles(replica_stats(fleet[1])),
+                   msg="healed replica settles")
+        assert reconciles(replica_stats(fleet[2]))
+        # recovery: the healed partition AND an operator-restarted
+        # replacement for the killed replica are re-admitted by the
+        # backoff re-probe (no router restart, no operator action on
+        # the router)
+        faultinject.restart_replica(fleet[0])
+        wait_until(lambda: all(
+            r["state"] == routerd.UP
+            for r in router.fleet_snapshot()["replicas"]),
+            timeout=10.0, msg="fleet re-admitted")
+        for i in range(3):
+            assert faultinject.serve_request(router.port, "5") == "6"
+        assert reconciles(router.stats())
+    finally:
+        faultinject.stop_fleet(fleet)
+
+
+# ----------------------------------------------------------------------
+# rolling zero-downtime reload: under sustained load, zero
+# client-visible errors, every replica reloads, capacity >= N-1
+def test_rolling_reload_zero_downtime(make_router):
+    fleet = faultinject.spawn_fleet(3, delay_ms=5, reload_ms=100)
+    try:
+        router = make_router(fleet, probe_ms=100.0, retries=2,
+                             reload_timeout_s=15.0)
+        stop = threading.Event()
+        responses = []
+        lock = threading.Lock()
+
+        def load():
+            while not stop.is_set():
+                r = faultinject.serve_request(router.port, "5",
+                                              timeout=15)
+                with lock:
+                    responses.append(r)
+
+        ts = [threading.Thread(target=load) for _ in range(3)]
+        for t in ts:
+            t.start()
+        time.sleep(0.2)           # sustained load established
+        resp = faultinject.serve_request(router.port, "ADMIN reload")
+        assert resp.startswith("OK fleet"), resp
+        wait_until(lambda: len(router.fleet_snapshot()["windows"]) >= 3
+                   and not router.fleet_snapshot()["reloading"],
+                   timeout=20.0, msg="rolling reload completes")
+        stop.set()
+        for t in ts:
+            t.join(timeout=15)
+        # zero client-visible errors: every response during the roll is
+        # an answer from model v1 (6) or v2 (7) — never an ERR, never
+        # a dropped line
+        assert responses and all(r in ("6", "7") for r in responses), \
+            [r for r in responses if r not in ("6", "7")][:5]
+        assert "7" in responses, "no request saw the reloaded model"
+        # every replica reloaded exactly once
+        for r in fleet:
+            assert replica_stats(r)["reloads"] == 1
+        # capacity never below N-1: the drain windows are per-replica
+        # and pairwise NON-overlapping (one replica held at a time)
+        wins = sorted(router.fleet_snapshot()["windows"],
+                      key=lambda w: w["out_s"])
+        assert len(wins) == 3
+        assert len({w["replica"] for w in wins}) == 3
+        for w1, w2 in zip(wins, wins[1:]):
+            assert w1["back_s"] <= w2["out_s"], (w1, w2)
+        # and the fleet answers the new model afterwards
+        assert faultinject.serve_request(router.port, "5") == "7"
+    finally:
+        faultinject.stop_fleet(fleet)
+
+
+# ----------------------------------------------------------------------
+# wedged replica (accepts, then stalls past serve_stall_s): the probe
+# sees its readiness fail and routes around it; unwedge re-admits
+def test_wedged_replica_routed_around(make_router):
+    a, b = spawn_two({"stall_s": 0.2})
+    sock = None
+    try:
+        router = make_router([a, b], probe_ms=100.0, retries=2,
+                             stall_s=2.0)
+        faultinject.wedge_replica(a)
+        sock = socket.create_connection(("127.0.0.1", a.port),
+                                        timeout=5)
+        sock.sendall(b"9\n")      # wedges A's worker
+        # past stall_s the replica's own /healthz fails; the router's
+        # probe takes it out of rotation (grouped with breaker_open)
+        wait_until(lambda: router.fleet_snapshot()["replicas"][0]
+                   ["state"] != routerd.UP, msg="wedged ejected")
+        for _ in range(3):
+            assert faultinject.serve_request(router.port, "5") == "6"
+        assert replica_stats(b)["served"] >= 3
+        faultinject.unwedge_replica(a)
+        wait_until(lambda: router.fleet_snapshot()["replicas"][0]
+                   ["state"] == routerd.UP, msg="unwedged re-admitted")
+    finally:
+        if sock is not None:
+            sock.close()
+        faultinject.stop_fleet([a, b])
+
+
+# ----------------------------------------------------------------------
+# statusd fleet surfaces over a REAL router (in-process replicas keep
+# this cheap; the snapshot-shape fake lives in the statusd selftest)
+def test_fleetz_and_metrics_surfaces():
+    telemetry.enable()
+    fe = srv = router = None
+    try:
+        fe = servd.ServeFrontend(lambda toks, seq: [t + 1 for t in toks],
+                                 drain_ms=2000.0)
+        fe.start()
+        fe.listen(0)
+        rs = statusd.StatusServer(0, host="127.0.0.1").start()
+        rs.register_probe("serving", fe.health_probe)
+        router = routerd.Router([("127.0.0.1", fe.port, rs.port)],
+                                probe_ms=3600e3, drain_ms=1000.0)
+        router.start()
+        router.listen(0)
+        router.probe_now()
+        srv = statusd.StatusServer(0, host="127.0.0.1").start()
+        srv.fleet = router
+        srv.register_probe("routing", router.health_probe)
+        assert faultinject.serve_request(router.port, "1") == "2"
+        from urllib.request import urlopen
+        base = "http://127.0.0.1:%d" % srv.port
+        fj = json.loads(urlopen(base + "/fleetz?json=1",
+                                timeout=5).read())
+        assert fj["eligible"] == 1
+        assert fj["replicas"][0]["state"] == routerd.UP
+        assert fj["stats"]["served"] == 1
+        page = urlopen(base + "/fleetz", timeout=5).read().decode()
+        assert "serving fleet" in page and fe.port is not None
+        metrics = urlopen(base + "/metrics", timeout=5).read().decode()
+        for line in metrics.splitlines():
+            if line and not line.startswith("#"):
+                assert statusd.PROM_LINE_RE.match(line), line
+        assert "cxxnet_fleet_replicas" in metrics
+        assert "cxxnet_fleet_replica_up" in metrics
+        assert 'state="up"' in metrics
+        assert urlopen(base + "/healthz", timeout=5).status == 200
+        rs.stop()
+    finally:
+        if router is not None:
+            router.drain(timeout_ms=1000)
+        if srv is not None:
+            srv.stop()
+        if fe is not None:
+            fe.drain(timeout_ms=1000)
+        telemetry.disable()
+
+
+# ----------------------------------------------------------------------
+# the task = route driver: SIGTERM fleet drain through the real CLI
+def test_cli_route_task_sigterm_drain():
+    fleet = faultinject.spawn_fleet(2)
+    p = None
+    try:
+        import os
+        import tempfile
+        conf = tempfile.NamedTemporaryFile(
+            "w", suffix=".conf", delete=False)
+        conf.write("task = route\n"
+                   "route_replicas = %s\n"
+                   "route_port = 0\n"
+                   "route_probe_ms = 100\n"
+                   % ",".join("127.0.0.1:%d:%d" % (r.port,
+                                                   r.status_port)
+                              for r in fleet))
+        conf.close()
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   CXXNET_JAX_PLATFORM="cpu", CXXNET_LOCKRANK="1")
+        p = subprocess.Popen(
+            [sys.executable, "bin/cxxnet", conf.name],
+            stderr=subprocess.PIPE, stdout=subprocess.DEVNULL,
+            text=True, cwd=REPO, env=env)
+        port = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            line = p.stderr.readline()
+            assert line, "driver died before routing (rc=%r)" % p.poll()
+            if line.startswith("routerd: routing on port "):
+                port = int(line.split()[4])
+                break
+        assert port is not None
+        for i in range(4):
+            assert faultinject.serve_request(
+                port, "%d" % i, timeout=15) == "%d" % (i + 1)
+        p.send_signal(signal.SIGTERM)
+        rc = p.wait(timeout=30)
+        tail = p.stderr.read()
+        assert rc == 0, tail
+        assert "routed 4 requests (4 served" in tail, tail
+        # the replicas served on: 2 each or 3/1 — the fleet took all 4
+        counts = [replica_stats(r)["served"] for r in fleet]
+        assert sum(counts) == 4, counts
+        os.unlink(conf.name)
+    finally:
+        if p is not None and p.poll() is None:
+            p.kill()
+            p.wait()
+        faultinject.stop_fleet(fleet)
+
+
+# ----------------------------------------------------------------------
+def test_routerd_selftest():
+    assert routerd.selftest() == 0

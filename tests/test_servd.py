@@ -251,6 +251,63 @@ def test_flood_sheds_and_every_request_answered(make_frontend):
 
 
 # ----------------------------------------------------------------------
+# the ERR busy detail-token split (wire format: the fleet router's
+# retryability contract — utils/routerd.py dispatches on token 3)
+def test_err_busy_detail_tokens_queue_vs_breaker(make_frontend):
+    """Queue-full and breaker-open sheds share the ``busy`` class (the
+    2-token parse contract stands) but MUST be distinguishable by the
+    third token: ``queue`` is instantly-retryable-elsewhere, ``breaker``
+    additionally means "eject this replica from rotation"."""
+    release = threading.Event()
+
+    def wedged(toks, seq):
+        release.wait(10.0)
+        return echo(toks, seq)
+
+    fe = make_frontend(backend=wedged, queue_size=1)
+    try:
+        fe.submit("1", lambda t: None)       # occupies the worker
+        time.sleep(0.1)
+        fe.submit("2", lambda t: None)       # fills the 1-slot queue
+        resp = faultinject.serve_request(fe.port, "3")
+        assert resp.split()[:3] == ["ERR", "busy", "queue"], resp
+    finally:
+        release.set()
+    # breaker-open shed carries the breaker token (admission path)
+    fe2 = make_frontend(backend=faultinject.exploding_backend(every=1),
+                        breaker_fails=1, breaker_cooldown_ms=60000.0)
+    assert faultinject.serve_request(
+        fe2.port, "1").startswith("ERR backend")
+    resp = faultinject.serve_request(fe2.port, "2")
+    assert resp.split()[:3] == ["ERR", "busy", "breaker"], resp
+
+
+def test_admin_stats_reports_live_load_gauges(make_frontend):
+    """ADMIN stats carries the LIVE queue_depth / in_flight gauges (the
+    router's load signal) alongside the counters — consistent with the
+    admission queue at snapshot time."""
+    release = threading.Event()
+
+    def wedged(toks, seq):
+        release.wait(10.0)
+        return echo(toks, seq)
+
+    fe = make_frontend(backend=wedged, queue_size=4)
+    try:
+        stats = faultinject.serve_request(fe.port, "ADMIN stats")
+        assert "queue_depth=0" in stats and "in_flight=0" in stats
+        fe.submit("1", lambda t: None)       # occupies the worker
+        time.sleep(0.1)
+        fe.submit("2", lambda t: None)       # queued
+        fe.submit("3", lambda t: None)       # queued
+        stats = faultinject.serve_request(fe.port, "ADMIN stats")
+        assert "queue_depth=2" in stats and "in_flight=1" in stats, \
+            stats
+    finally:
+        release.set()
+
+
+# ----------------------------------------------------------------------
 # backend supervision + circuit breaker
 def test_backend_exception_answered_and_survived(make_frontend):
     fe = make_frontend(backend=faultinject.exploding_backend(echo,
