@@ -616,6 +616,16 @@ def bench_serve_fleet():
         fe.drain()
     for ss in status:
         ss.stop()
+    # fleet TTFT through the trace join: the router minted ONE id per
+    # request and stamped it on every forward, so the replica flight
+    # record that carries the honest device-level ttft_s is found by
+    # id — the same join `telemetry_report.py --fleet` does offline
+    routed = {rec["id"] for rec in router.flight.list()
+              if rec.get("outcome") == "served"}
+    ttfts = sorted(rec["ttft_s"] for fe in replicas
+                   for rec in fe.flight.list()
+                   if rec.get("id") in routed
+                   and rec.get("ttft_s") is not None)
     lats.sort()
     total = max(1, nsent[0])
     return {"metric": "serve_fleet_p99_latency_ms",
@@ -624,6 +634,8 @@ def bench_serve_fleet():
             "unit": "ms", "vs_baseline": None,
             "p50_ms": round(1e3 * percentile(lats, 50), 3) if lats
             else None,
+            "ttft_p99_ms": round(1e3 * percentile(ttfts, 99), 3)
+            if ttfts else None,
             "shed_rate": round(nshed[0] / float(total), 4),
             "retry_rate": round(rstats.get("retries", 0)
                                 / float(total), 4),

@@ -169,6 +169,17 @@ class LearnTask:
         self.route_probe_ms = 200.0
         self.route_retries = 2
         self.route_stall_s = 30.0        # per-attempt response bound
+        # fleet observability plane (doc/observability.md "Fleet
+        # observability"): the router's per-request flight ring (every
+        # routed request's candidates/attempts/retries — /requestz,
+        # stitched /trace?request=<id>), the federation cadence (pull +
+        # exactly merge every replica's serve histograms/SLO window
+        # into cxxnet_fleet_* series; 0 = off), and the per-replica
+        # outlier detector thresholds (p99 vs fleet median).
+        self.route_flight_cap = 256
+        self.fleet_federate_ms = 1000.0
+        self.fleet_outlier_ratio = 3.0
+        self.fleet_outlier_min_n = 20
         self.gen_new = 16
         self.gen_temperature = 0.0
         self.gen_topk = 0
@@ -419,6 +430,14 @@ class LearnTask:
             self.route_retries = int(val)
         if name == "route_stall_s":
             self.route_stall_s = float(val)
+        if name == "route_flight_cap":
+            self.route_flight_cap = int(val)
+        if name == "fleet_federate_ms":
+            self.fleet_federate_ms = float(val)
+        if name == "fleet_outlier_ratio":
+            self.fleet_outlier_ratio = float(val)
+        if name == "fleet_outlier_min_n":
+            self.fleet_outlier_min_n = int(val)
         if name == "extract_node_name":
             self.extract_node_name = val
         if name == "export_out":
@@ -1441,7 +1460,11 @@ class LearnTask:
         router = routerd.Router(
             replicas, probe_ms=self.route_probe_ms,
             retries=self.route_retries, stall_s=self.route_stall_s,
-            drain_ms=self.serve_drain_ms)
+            drain_ms=self.serve_drain_ms,
+            flight_cap=self.route_flight_cap,
+            federate_ms=self.fleet_federate_ms,
+            outlier_ratio=self.fleet_outlier_ratio,
+            outlier_min_n=self.fleet_outlier_min_n)
         router.start()
         port = router.listen(self.route_port, host=self.route_host)
         # one synchronous sweep so /fleetz and the first dispatches see
@@ -1449,6 +1472,11 @@ class LearnTask:
         # is ejected before traffic arrives)
         router.probe_now()
         statusd.set_fleet(router)
+        # the routing flight ring: /requestz lists every routed
+        # request's attempts, /trace?request=<id> stitches the
+        # cross-process trace (set_fleet makes /trace prefer the
+        # stitched view on this process)
+        statusd.set_flight_recorder(router.flight)
         statusd.register_probe("routing", router.health_probe)
         statusd.register_probe("routing.prober", router.liveness_probe,
                                liveness=True)

@@ -67,6 +67,10 @@ RANKS = {
     #                         (outermost: held while recording telemetry,
     #                         never under any servd/statusd lock)
     "routerd.stats": 5,     # Router._slock — router counter snapshot
+    "routerd.fed": 7,       # Router._fed_lock — federated replica
+    #                         metric snapshots + outlier verdicts
+    #                         (never nested with fleet/stats; IO stays
+    #                         outside it)
     "servd.queue": 10,      # ServeFrontend._cond — admission/worker/drain
     "servd.conns": 20,      # ServeFrontend._conn_lock — live writer set
     "servd.conn": 30,       # _ConnState.cond — per-connection reply slots

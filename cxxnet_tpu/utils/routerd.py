@@ -78,6 +78,47 @@ surfaces: ``statusd.set_fleet(router)`` exports ``/fleetz`` and the
 ``cxxnet_fleet_*`` series; ``health_probe``/``liveness_probe`` plug
 into ``/healthz``/``/livez`` like servd's.
 
+**Fleet observability plane** (the cross-process half of
+doc/observability.md "Request tracing & SLOs"):
+
+* **Trace propagation** — the router mints ONE fleet-wide request id
+  per client request (or adopts a valid client-sent ``TRACE <id>``)
+  and stamps ``TRACE <id>`` on every forward attempt; each servd
+  replica adopts it as its own request id, so the id names the request
+  on every process that touched it (the Dapper idea). Pre-TRACE
+  replicas degrade gracefully: a TRACE-prefixed attempt answered ``ERR
+  parse`` is resent once WITHOUT the prefix (a parse rejection proves
+  the request never dispatched, so the resend is exactly-once safe);
+  if the bare resend succeeds the replica is latched ``no_trace`` and
+  future forwards skip the prefix (cleared when the replica is
+  re-admitted from DEAD — a restart may have upgraded it).
+* **Router flight recorder** — every routed request's full routing
+  life lands in a bounded ring (``route_flight_cap``): the
+  power-of-two candidates and their load signals at pick time, each
+  attempt's replica/outcome/latency, retry reasons, and the deadline
+  budget spend. Router ``/requestz`` lists it; ``/trace?request=<id>``
+  returns the STITCHED cross-process Chrome trace: the router's
+  attempt lane plus each touched replica's phase lanes, fetched live
+  over the replicas' statusd (``/requestz?request=<id>``) and aligned
+  on the shared wall-clock epoch — a retried request shows both
+  attempts under one id. Each request also emits a
+  ``route_request_done`` event (the ``--fleet`` report join key).
+* **Live federation** — every ``fleet_federate_ms`` the prober
+  additionally pulls each reachable replica's RAW metrics snapshot
+  (statusd ``/metrics?json=1``) and merges the serve histograms and
+  counters EXACTLY (shared fixed buckets: merge is bucket-count
+  addition, never re-binning) into ``cxxnet_fleet_*`` series on the
+  router's own ``/metrics``: fleet TTFT/latency percentiles, a
+  fleet-wide SLO burn account over the merged windows (each replica
+  just under its own alert floor can still be fleet-over), and a
+  per-replica **outlier detector** — a replica whose serve p99
+  diverges from the median of the OTHER replicas (leave-one-out, so a
+  2-replica fleet can still flag its slow half) by
+  ``fleet_outlier_ratio`` x (with at least ``fleet_outlier_min_n``
+  requests in its histogram) flips the
+  ``cxxnet_fleet_outlier{replica=...}`` gauge, emits a transition-only
+  ``fleet_outlier`` event, and is flagged on ``/fleetz``.
+
 Deliberately jax-free (the replicas are other processes); ``python -m
 cxxnet_tpu.utils.routerd --selftest`` drives routing, retry, ejection,
 rolling reload and drain over real loopback sockets with in-process
@@ -89,9 +130,11 @@ servd replicas — ``make check`` gates on it. The driver surface is
 
 from __future__ import annotations
 
+import json
 import random
 import re
 import socket
+import statistics
 import sys
 import threading
 import time
@@ -100,9 +143,11 @@ from typing import Dict, List, Optional, Tuple
 from . import checkpoint as ckpt
 from . import health
 from . import lockrank
+from . import servd
 from . import telemetry
 
 __all__ = ["Replica", "Router", "parse_replicas", "retryable",
+           "route_chrome_trace", "stitched_chrome_trace",
            "UP", "DRAINING", "BREAKER_OPEN", "DEAD", "selftest"]
 
 UP = "up"
@@ -185,7 +230,7 @@ class Replica:
     __slots__ = ("name", "host", "port", "status_port", "state",
                  "detail", "hold", "queue_depth", "in_flight",
                  "outstanding", "probe_fails", "ejections",
-                 "next_probe_at", "last_probe")
+                 "next_probe_at", "last_probe", "no_trace", "trace_ok")
 
     def __init__(self, host: str, port: int, status_port: int):
         self.host = host
@@ -206,6 +251,17 @@ class Replica:
         self.next_probe_at = 0.0     # monotonic; dead replicas re-probe
         #                              on the backoff schedule only
         self.last_probe: Optional[float] = None
+        # pre-TRACE replica latch (module docstring): once a TRACE
+        # prefix was proven unsupported (ERR parse on the traced line,
+        # success on the bare resend) forwards skip the prefix; cleared
+        # on re-admission from DEAD (a restart may have upgraded it).
+        # trace_ok is the POSITIVE latch: one traced exchange answered
+        # by anything but ERR parse proves the replica parsed the
+        # prefix, so later ERR parse answers are genuine client body
+        # errors and never pay the downgrade resend (also cleared on
+        # re-admission — a rollback may have downgraded the binary)
+        self.no_trace = False
+        self.trace_ok = False
 
     def snapshot(self, now: float) -> dict:
         return {"name": self.name, "state": self.state,
@@ -236,7 +292,11 @@ class Router:
                  probe_timeout: float = 1.0,
                  client_timeout: float = 10.0,
                  probe_backoff_cap_s: float = 30.0,
-                 reload_timeout_s: float = 30.0):
+                 reload_timeout_s: float = 30.0,
+                 flight_cap: int = 256,
+                 federate_ms: float = 1000.0,
+                 outlier_ratio: float = 3.0,
+                 outlier_min_n: int = 20):
         specs = parse_replicas(replicas)
         if not specs:
             raise ValueError("router needs at least one replica")
@@ -250,10 +310,33 @@ class Router:
         self.client_timeout = float(client_timeout)
         self.probe_backoff_cap_s = float(probe_backoff_cap_s)
         self.reload_timeout_s = float(reload_timeout_s)
+        # federation cadence (0 disables) + outlier thresholds: a
+        # replica whose serve p99 exceeds outlier_ratio x the median
+        # of the OTHER replicas (with >= outlier_min_n observations
+        # behind it) is flagged — conf keys fleet_federate_ms /
+        # fleet_outlier_*
+        self.federate_s = max(0.0, float(federate_ms) / 1e3)
+        self.outlier_ratio = float(outlier_ratio)
+        self.outlier_min_n = max(1, int(outlier_min_n))
         # ranked locks (utils/lockrank.py): fleet state outermost, then
         # stats — both may record telemetry (registry is innermost)
         self._lock = lockrank.lock("routerd.fleet")
         self._slock = lockrank.lock("routerd.stats")
+        # federated per-replica metric snapshots + outlier verdicts
+        # (written by the prober's federation sweep, read per scrape)
+        self._fed_lock = lockrank.lock("routerd.fed")
+        self._fed: Dict[str, dict] = {}
+        self._fed_outlier: Dict[str, dict] = {}
+        self._fed_at = 0.0
+        # the routing flight recorder: one record per routed request —
+        # candidates at pick time, per-attempt replica/outcome/latency,
+        # deadline spend (statusd /requestz + the /trace stitch source)
+        self.flight = telemetry.FlightRecorder(flight_cap)
+        # fleet-wide trace-id minting: a short random prefix makes ids
+        # from a restarted (or second) router distinguishable without
+        # coordination; the counter rides the stats lock
+        self._trace_prefix = "r%05x" % random.randrange(16 ** 5)
+        self._trace_n = 0
         self._stats = {k: 0 for k in _COUNTERS}
         self._draining = False
         self._stop = False
@@ -335,6 +418,14 @@ class Router:
                     "reloading": self._reloading,
                     "windows": windows}
         body["stats"] = self.stats()
+        fed = self.federation_snapshot()
+        if fed is not None:
+            body["federation"] = fed
+            for rsnap in reps:
+                v = fed["outliers"].get(rsnap["name"])
+                if v is not None:
+                    rsnap["outlier"] = v["outlier"]
+                    rsnap["p99_ms"] = v["p99_ms"]
         return body
 
     # -- replica state machine (fleet lock) ----------------------------
@@ -348,6 +439,12 @@ class Router:
             prev = r.state
             r.state = state
             r.detail = detail
+            if state == UP and prev == DEAD:
+                # re-admission after death: the process may have been
+                # restarted on a newer (or OLDER) build — re-learn its
+                # TRACE capability from scratch
+                r.no_trace = False
+                r.trace_ok = False
             if state == DEAD:
                 # ejection: re-probe on the shared backoff curve; each
                 # consecutive failure doubles the wait
@@ -428,27 +525,37 @@ class Router:
                 if self._draining or self._stop:
                     break
             self.probe_now()
+            if self.federate_s > 0:
+                with self._fed_lock:
+                    due = (time.monotonic() - self._fed_at
+                           >= self.federate_s)
+                if due:
+                    self.federate_now()
         health.pause("route.probe")
 
     # -- dispatch ------------------------------------------------------
     def _load(self, r: Replica) -> float:
         return r.queue_depth + r.in_flight + r.outstanding
 
-    def _pick(self, exclude) -> Optional[Replica]:
+    def _pick(self, exclude) -> Tuple[Optional[Replica], List[dict]]:
         """Power-of-two-choices among eligible replicas (up, not held,
         not yet tried for this request); the checked-out replica's
         outstanding count is bumped under the same lock so concurrent
-        picks see each other's load."""
+        picks see each other's load. Also returns the sampled
+        candidates' load signals AT PICK TIME — the flight record keeps
+        them, so a routing decision stays explainable after the fact."""
         with self._lock:
             elig = [r for r in self._replicas
                     if r.state == UP and not r.hold
                     and r.name not in exclude]
             if not elig:
-                return None
+                return None, []
             if len(elig) == 1:
                 r = elig[0]
+                sample = [r]
             else:
                 a, b = random.sample(elig, 2)
+                sample = [a, b]
                 la, lb = self._load(a), self._load(b)
                 if la == lb:
                     # deterministic tie-break: the lower replica index
@@ -457,8 +564,12 @@ class Router:
                         < self._replicas.index(b) else b
                 else:
                     r = a if la < lb else b
+            cands = [{"replica": x.name, "load": self._load(x),
+                      "queue_depth": x.queue_depth,
+                      "in_flight": x.in_flight,
+                      "outstanding": x.outstanding} for x in sample]
             r.outstanding += 1
-            return r
+            return r, cands
 
     def _checkin(self, r: Replica) -> None:
         with self._lock:
@@ -494,17 +605,33 @@ class Router:
             except OSError:
                 pass
 
+    def _mint_trace_id(self) -> str:
+        """One fleet-wide request id (router prefix + counter): valid
+        per the shared servd contract, unique per router lifetime."""
+        with self._slock:
+            self._trace_n += 1
+            return "%s-%d" % (self._trace_prefix, self._trace_n)
+
     def _handle(self, line: str) -> str:
         """Route one request line; returns the one response line."""
         parts = line.split()
-        if parts and parts[0] == "ADMIN":
+        # trace propagation: adopt a valid client-sent TRACE id (a
+        # request already named upstream keeps its name through this
+        # hop — router-of-routers composes), refuse a malformed one
+        # with the same ERR proto a replica would (ONE shared checker:
+        # servd.parse_trace_prefix), mint otherwise
+        tid, proto_detail, parts = servd.parse_trace_prefix(parts)
+        proto_err = None if proto_detail is None \
+            else "ERR proto " + proto_detail
+        if proto_err is None and parts and parts[0] == "ADMIN":
             return self._handle_admin(parts[1:])
         t0 = time.monotonic()
         # parse the deadline ONCE at accept: every retry spends from
         # this clock. A malformed bound is forwarded untouched — the
         # replica's parser answers ERR parse (one implementation).
         deadline = None
-        rest: List[str] = []
+        deadline_ms: Optional[float] = None
+        rest = parts
         if parts[:1] == ["DEADLINE"] and len(parts) >= 2:
             try:
                 budget = float(parts[1]) / 1e3
@@ -512,6 +639,7 @@ class Router:
                 budget = None
             if budget is not None and 0 <= budget < float("inf"):
                 deadline = t0 + budget
+                deadline_ms = budget * 1e3
                 rest = parts[2:]
         # admission + accounting in one critical section with drain()'s
         # flag flip (the servd rule): a post-drain arrival is refused
@@ -521,58 +649,127 @@ class Router:
                 return "ERR draining router is shutting down"
             self._active += 1
         self._bump("accepted")
+        if tid is None:
+            tid = self._mint_trace_id()
         try:
-            text, outcome = self._route(line, rest, deadline, t0)
+            attempts: List[dict] = []
+            if proto_err is not None:
+                text, outcome = proto_err, "errors"
+            else:
+                text, outcome = self._route(tid, rest, deadline, t0,
+                                            attempts)
+            total = time.monotonic() - t0
+            # the flight record + route_request_done event land BEFORE
+            # the response goes out (the servd rule): a client that
+            # just read its answer can immediately /trace?request=<id>
+            self._record_request(tid, outcome, text, attempts, total,
+                                 deadline_ms)
             # outcome lands BEFORE the active slot is released: drain()
             # snapshots final stats the moment _active hits 0, and an
             # accepted-but-not-yet-outcomed request would read as
             # non-reconciling books in the route_done event
             self._bump(outcome)
-            telemetry.hist("route.request", time.monotonic() - t0)
+            telemetry.hist("route.request", total)
         finally:
             with self._lock:
                 self._active -= 1
         return text
 
-    def _route(self, line: str, rest: List[str],
-               deadline: Optional[float],
-               t0: float) -> Tuple[str, str]:
+    def _record_request(self, tid: str, outcome: str, text: str,
+                        attempts: List[dict], total: float,
+                        deadline_ms: Optional[float]) -> None:
+        rec = {"id": tid, "outcome": outcome,
+               "resp": " ".join(text.split()[:3])
+               if text.startswith("ERR") else "served",
+               # cxxlint: disable=wallclock — flight-record accept
+               # epoch: the cross-process stitch aligns the router and
+               # replica lanes on this shared wall clock, never a
+               # duration
+               "t_wall": round(time.time() - total, 6),
+               "total_s": round(total, 6),
+               "deadline_ms": deadline_ms,
+               "retries": max(0, len(attempts) - 1),
+               "attempts": attempts}
+        self.flight.record(rec)
+        telemetry.event({"ev": "route_request_done", "req": tid,
+                         "outcome": outcome,
+                         "attempts": len(attempts),
+                         "replicas": [a["replica"] for a in attempts],
+                         "retries": rec["retries"],
+                         "total_s": rec["total_s"]})
+
+    def _route(self, tid: str, rest: List[str],
+               deadline: Optional[float], t0: float,
+               attempts_out: List[dict]) -> Tuple[str, str]:
         tried: set = set()
         attempts = 0
         last_shed: Optional[str] = None
+        body = " ".join(rest)
         while True:
             now = time.monotonic()
             if deadline is not None and now >= deadline:
                 return ("ERR deadline expired %.0fms past the budget "
                         "(router)" % (1e3 * (now - deadline)),
                         "deadline")
-            r = self._pick(tried)
+            r, cands = self._pick(tried)
             if r is None:
                 if last_shed is not None:
                     return last_shed, "shed"
                 return ("ERR busy fleet no routable replica (%s)"
                         % self._states_brief(), "shed")
             timeout = self.stall_s
-            sendline = line
+            sendbody = body
             if deadline is not None:
                 rem = deadline - now
                 timeout = min(timeout, rem)
                 # forward the budget REMAINING, not the original: the
                 # replica's own queue-expiry check spends the same clock
-                sendline = "DEADLINE %d %s" % (max(1, int(rem * 1e3)),
-                                               " ".join(rest))
+                sendbody = "DEADLINE %d %s" % (max(1, int(rem * 1e3)),
+                                               body)
+            with self._lock:
+                traced = not r.no_trace
+            sendline = ("TRACE %s %s" % (tid, sendbody)) if traced \
+                else sendbody
+            t_att = time.monotonic()
+            att = {"replica": r.name,
+                   "t_off_s": round(t_att - t0, 6),
+                   "candidates": cands}
             try:
                 status, resp = self._forward(r, sendline, timeout)
+                if traced and status == "ok":
+                    if not resp.startswith("ERR parse"):
+                        # ANY other answer to a traced line proves the
+                        # prefix was parsed: latch trace_ok so later
+                        # genuine client parse errors never pay the
+                        # downgrade resend (one write, then steady)
+                        if not r.trace_ok:
+                            with self._lock:
+                                r.trace_ok = True
+                    elif not r.trace_ok:
+                        # maybe a pre-TRACE replica rejecting the
+                        # prefix itself: a parse rejection proves the
+                        # request never dispatched, so ONE bare resend
+                        # is exactly-once safe. A genuine client parse
+                        # error comes back identical and is relayed; a
+                        # different answer proves the replica is old —
+                        # latch no_trace.
+                        status, resp = self._trace_downgrade(
+                            r, sendbody, timeout, att, resp)
             finally:
                 self._checkin(r)
+            att["latency_s"] = round(time.monotonic() - t_att, 6)
+            att["status"] = status
             tried.add(r.name)
             if status == "noconnect":
                 # never sent: safe. Eject now — waiting a probe
                 # interval would burn every retry on a dead replica.
+                att["outcome"] = "noconnect"
+                attempts_out.append(att)
                 self._mark(r, DEAD, "connect refused at dispatch")
                 if self._retry_allowed(attempts):
                     attempts += 1
                     self._bump("retries")
+                    att["retried"] = True
                     continue
                 return ("ERR busy fleet replicas unreachable", "shed")
             if status == "lost":
@@ -580,10 +777,15 @@ class Router:
                 # dispatched — exactly-once forbids a replay. The
                 # prober decides whether the replica is dead (SIGKILL)
                 # or merely slow (stall bound), so no hard eject here.
+                att["outcome"] = "lost"
+                attempts_out.append(att)
                 telemetry.count("route.lost_contact")
                 return ("ERR backend replica %s lost contact "
                         "mid-request (not retried: may have dispatched)"
                         % r.name, "errors")
+            att["outcome"] = " ".join(resp.split()[:3]) \
+                if resp.startswith("ERR") else "served"
+            attempts_out.append(att)
             # a response line: dispatch on the retryability contract
             if retryable(resp):
                 last_shed = resp
@@ -596,6 +798,7 @@ class Router:
                 if self._retry_allowed(attempts):
                     attempts += 1
                     self._bump("retries")
+                    att["retried"] = True
                     continue
                 return resp, "shed"
             if resp.startswith("ERR deadline"):
@@ -603,6 +806,24 @@ class Router:
             if resp.startswith("ERR"):
                 return resp, "errors"
             return resp, "served"
+
+    def _trace_downgrade(self, r: Replica, sendbody: str,
+                         timeout: float, att: dict,
+                         first_resp: str) -> Tuple[str, Optional[str]]:
+        """The pre-TRACE compat path (module docstring): resend the
+        bare line once; whatever comes back (including noconnect/lost)
+        is THE attempt's result — the traced try provably never
+        dispatched. A changed answer proves the replica does not speak
+        TRACE: latch it so future forwards skip the prefix."""
+        status, resp = self._forward(r, sendbody, timeout)
+        if status == "ok" and not resp.startswith("ERR parse"):
+            with self._lock:
+                r.no_trace = True
+            att["trace_downgraded"] = True
+            telemetry.count("route.trace_downgrades")
+            telemetry.event({"ev": "route_trace_downgrade",
+                             "replica": r.name})
+        return status, resp
 
     def _retry_allowed(self, attempts: int) -> bool:
         """Another attempt is allowed while the retry budget holds AND
@@ -682,6 +903,220 @@ class Router:
         totals["reachable"] = reachable
         return "OK " + " ".join("%s=%d" % kv
                                 for kv in sorted(totals.items()))
+
+    # -- live fleet federation (metrics + SLO + outliers) --------------
+    def federate_now(self) -> int:
+        """One federation sweep: pull each non-dead replica's RAW
+        metrics snapshot (statusd ``/metrics?json=1`` — exact bucket
+        counts, no text-format round trip) plus its SLO window, store
+        them, and recompute the outlier verdicts. Returns the number of
+        replicas federated. All IO lock-free; the prober thread calls
+        this every ``federate_s`` (tests and the selftest call it
+        directly for determinism)."""
+        with self._lock:
+            reps = [(r.name, r.state, r.host, r.status_port)
+                    for r in self._replicas]
+        snaps: Dict[str, dict] = {}
+        for name, state, host, sport in reps:
+            if state == DEAD:
+                continue             # don't burn a timeout per sweep
+            try:
+                code, body = _http_get(host, sport, "/metrics?json=1",
+                                       self.probe_timeout)
+                if code != 200:
+                    continue
+                snap = json.loads(body)
+            except (OSError, ValueError):
+                continue
+            if isinstance(snap, dict) and "metrics" in snap:
+                snaps[name] = snap
+        now = time.monotonic()
+        with self._fed_lock:
+            # a replica that missed THIS sweep (one slow scrape, a GC
+            # pause) keeps its last-known snapshot: dropping it would
+            # make every cxxnet_fleet_* counter/bucket series dip and
+            # recover, which Prometheus reads as a process reset and
+            # re-counts the replica's lifetime totals as new traffic.
+            # Only DEAD replicas leave the merge (a real reset).
+            prev = self._fed
+            live = {name for name, state, _, _ in reps
+                    if state != DEAD}
+            merged = {}
+            for name, snap in snaps.items():
+                merged[name] = {"snap": snap, "t": now}
+            for name, entry in prev.items():
+                if name not in merged and name in live:
+                    merged[name] = entry
+            self._fed = merged
+            self._fed_at = now
+            det = {name: e["snap"] for name, e in merged.items()}
+        self._detect_outliers(det)
+        return len(snaps)
+
+    def _detect_outliers(self, snaps: Dict[str, dict]) -> None:
+        """Per-replica serve p99 vs the median of the OTHER replicas
+        (leave-one-out — against a median that includes itself, a
+        2-replica fleet could NEVER flag its slow half: the median of
+        two values is their mean, so p99 > ratio*median is impossible
+        for ratio >= 2): a replica diverging by ``outlier_ratio`` x
+        (with >= ``outlier_min_n`` requests behind its histogram) is an
+        outlier. Verdicts are stored for /fleetz + the
+        cxxnet_fleet_outlier gauges; transitions emit ONE
+        ``fleet_outlier`` event each (never per-sweep spam)."""
+        p99s: Dict[str, float] = {}
+        for name, snap in snaps.items():
+            d = (snap.get("metrics") or {}).get("hists", {}) \
+                .get("serve.request")
+            if not d:
+                continue
+            h = telemetry.Histogram()
+            try:
+                h.merge_dict(d)
+            except (ValueError, TypeError):
+                continue
+            if h.n >= self.outlier_min_n:
+                p99s[name] = h.percentile(99)
+        flips = []
+        with self._fed_lock:
+            prev = self._fed_outlier
+            verdicts: Dict[str, dict] = {}
+            for name, p99 in sorted(p99s.items()):
+                others = [v for n, v in p99s.items() if n != name]
+                med = statistics.median(others) if others else None
+                out = bool(med and med > 0
+                           and p99 > self.outlier_ratio * med)
+                verdicts[name] = {"outlier": out,
+                                  "p99_ms": round(1e3 * p99, 3),
+                                  "fleet_p99_ms":
+                                  round(1e3 * med, 3)
+                                  if med is not None else None}
+                was = prev.get(name, {}).get("outlier", False)
+                if out != was:
+                    flips.append((name, verdicts[name]))
+            # a FLAGGED replica that left the verdict set (died, or its
+            # fresh histogram fell under min_n after a restart) must
+            # emit its clearing transition — an event consumer watching
+            # outlier=1 with no outlier=0 would page on it forever
+            for name, was in prev.items():
+                if name not in verdicts and was.get("outlier"):
+                    flips.append((name, {"outlier": False,
+                                         "p99_ms": None,
+                                         "fleet_p99_ms": None}))
+            self._fed_outlier = verdicts
+        for name, v in flips:
+            telemetry.count("route.outlier_flips")
+            telemetry.event({"ev": "fleet_outlier", "replica": name,
+                             "outlier": int(v["outlier"]),
+                             "p99_ms": v["p99_ms"],
+                             "fleet_p99_ms": v["fleet_p99_ms"]})
+
+    def federation_snapshot(self) -> Optional[dict]:
+        """The merged fleet view (None before the first sweep): serve
+        histograms merged EXACTLY (shared fixed buckets: bucket-count
+        addition), serve counters summed, the fleet-wide SLO account
+        over the replicas' merged windows, per-replica p99 + outlier
+        verdicts. Rides ``fleet_snapshot()`` onto /fleetz and the
+        router's /metrics (``cxxnet_fleet_*`` series)."""
+        with self._fed_lock:
+            if not self._fed:
+                return None
+            fed = {name: d["snap"] for name, d in self._fed.items()}
+            age = time.monotonic() - self._fed_at
+            outliers = {name: dict(v)
+                        for name, v in self._fed_outlier.items()}
+        hists: Dict[str, telemetry.Histogram] = {}
+        counters: Dict[str, float] = {}
+        slo_req = slo_bad = 0
+        slo_budget = None
+        slo_floor_req = slo_floor_bad = 1
+        slo_seen = False
+        for name, snap in sorted(fed.items()):
+            m = snap.get("metrics") or {}
+            for hname, d in (m.get("hists") or {}).items():
+                if not hname.startswith("serve."):
+                    continue
+                try:
+                    hists.setdefault(
+                        hname, telemetry.Histogram()).merge_dict(d)
+                except (ValueError, TypeError):
+                    continue
+            for cname, v in (m.get("counters") or {}).items():
+                if cname.startswith("serve."):
+                    counters[cname] = counters.get(cname, 0) + v
+            slo = snap.get("slo")
+            if slo:
+                # the merged-window account: each replica's rolling
+                # window contributes its request/bad counts. The alert
+                # floors are fleet-wide — N replicas each one bad
+                # request under their own min_bad can still page here
+                # (the fleet-over case no single replica triggers)
+                slo_seen = True
+                slo_req += int(slo.get("requests", 0))
+                slo_bad += int(slo.get("bad", 0))
+                if slo.get("budget") is not None:
+                    b = float(slo["budget"])
+                    slo_budget = b if slo_budget is None \
+                        else min(slo_budget, b)
+                slo_floor_req = max(slo_floor_req,
+                                    int(slo.get("min_requests", 1)))
+                slo_floor_bad = max(slo_floor_bad,
+                                    int(slo.get("min_bad", 1)))
+        out = {"replicas": len(fed), "age_s": round(age, 3),
+               "series": {name: dict(h.stats(),
+                                     buckets=h.to_dict()["buckets"])
+                          for name, h in sorted(hists.items())},
+               "counters": counters,
+               "outliers": outliers,
+               "slo": None}
+        if slo_seen and slo_budget is not None:
+            bad_fraction = slo_bad / float(slo_req) if slo_req else 0.0
+            burn = bad_fraction / slo_budget
+            out["slo"] = {
+                "requests": slo_req, "bad": slo_bad,
+                "budget": round(slo_budget, 6),
+                "bad_fraction": round(bad_fraction, 6),
+                "burn_rate": round(burn, 4),
+                "alert": 1 if (slo_req >= slo_floor_req
+                               and slo_bad >= slo_floor_bad
+                               and burn >= 1.0) else 0}
+        return out
+
+    # -- stitched cross-process traces ---------------------------------
+    def stitched_trace(self, request_id) -> Optional[dict]:
+        """ONE Chrome trace for one routed request: the router's
+        attempt lane plus the phase lane of every replica that touched
+        it, fetched live over each replica's statusd
+        (``/requestz?request=<id>``) and aligned on the shared
+        wall-clock epoch. None when the router never saw the id. A
+        replica that is gone (or has evicted the record) simply
+        contributes no lane — the router lane still names it."""
+        rid = str(request_id)
+        rec = self.flight.get(rid)
+        if rec is None:
+            return None
+        with self._lock:
+            by_name = {r.name: (r.host, r.status_port)
+                       for r in self._replicas}
+        hops: List[Tuple[str, dict]] = []
+        seen = set()
+        for att in rec.get("attempts") or []:
+            name = att.get("replica")
+            if name in seen or name not in by_name:
+                continue
+            seen.add(name)
+            host, sport = by_name[name]
+            try:
+                code, body = _http_get(
+                    host, sport, "/requestz?request=%s" % rid,
+                    self.probe_timeout)
+                if code != 200:
+                    continue
+                rrec = json.loads(body)
+            except (OSError, ValueError):
+                continue
+            if isinstance(rrec, dict) and rrec.get("id") == rid:
+                hops.append((name, rrec))
+        return stitched_chrome_trace(rec, hops)
 
     # -- rolling reload ------------------------------------------------
     def request_rolling_reload(self) -> bool:
@@ -878,9 +1313,10 @@ class Router:
             self._probe_thread.join(timeout=2.0)
             self._probe_thread = None
         # the hard bound: one in-flight attempt per active request,
-        # each <= stall_s — past it something is wrong enough that
+        # each <= stall_s (2x for the one-shot pre-TRACE downgrade
+        # resend) — past it something is wrong enough that
         # leftover_active is reported instead of waited on forever
-        hard_by = t0 + max(budget, self.stall_s + 2.0)
+        hard_by = t0 + max(budget, 2.0 * self.stall_s + 2.0)
         while time.monotonic() < hard_by:
             with self._lock:
                 if self._active == 0:
@@ -901,8 +1337,74 @@ class Router:
 
 
 # ----------------------------------------------------------------------
+def stitched_chrome_trace(router_rec: dict, hops) -> dict:
+    """ONE cross-process Chrome trace from a router flight record plus
+    ``hops`` = [(replica_name, replica_flight_record), ...]. Pure
+    function — ``Router.stitched_trace`` feeds it live HTTP fetches,
+    the tests feed it dicts. Lanes: pid 0 is the router (a request row
+    plus an attempts row), pid 1..N one per replica hop (the replica's
+    phase/recompile lanes, via ``telemetry.request_chrome_trace``).
+    Every lane is placed on the SHARED wall-clock epoch (the earliest
+    ``t_wall`` across the records): each flight record stamps its
+    accept wall time, so cross-process alignment is a subtraction, and
+    a retried request renders both attempts — shed lane and served
+    lane — in true time order under one id."""
+    rid = str(router_rec.get("id", "?"))
+    walls = [router_rec.get("t_wall")] \
+        + [r.get("t_wall") for _, r in hops]
+    walls = [t for t in walls if isinstance(t, (int, float))]
+    epoch = min(walls) if walls else 0.0
+    r_off = float(router_rec.get("t_wall") or epoch) - epoch
+    trace: List[dict] = [
+        {"ph": "M", "name": "process_name", "pid": 0,
+         "args": {"name": "router request %s" % rid}},
+        {"ph": "M", "name": "thread_name", "pid": 0, "tid": 0,
+         "args": {"name": "request"}},
+        {"ph": "M", "name": "thread_name", "pid": 0, "tid": 1,
+         "args": {"name": "attempts"}},
+    ]
+    total = float(router_rec.get("total_s") or 0.0)
+    trace.append({
+        "ph": "X", "name": "route:%s" % router_rec.get("outcome", "?"),
+        "pid": 0, "tid": 0, "ts": round(r_off * 1e6, 1),
+        "dur": round(total * 1e6, 1),
+        "args": {"request": rid,
+                 "outcome": router_rec.get("outcome", "?"),
+                 "retries": router_rec.get("retries", 0),
+                 "deadline_ms": router_rec.get("deadline_ms")}})
+    for i, att in enumerate(router_rec.get("attempts") or []):
+        ts = r_off + float(att.get("t_off_s") or 0.0)
+        trace.append({
+            "ph": "X",
+            "name": "forward:%s" % att.get("replica", "?"),
+            "pid": 0, "tid": 1, "ts": round(ts * 1e6, 1),
+            "dur": round(float(att.get("latency_s") or 0.0) * 1e6, 1),
+            "args": {"request": rid, "attempt": i + 1,
+                     "outcome": att.get("outcome", "?"),
+                     "candidates": att.get("candidates")}})
+    for i, (name, rrec) in enumerate(hops):
+        sub = telemetry.request_chrome_trace(rrec)
+        off_us = (float(rrec.get("t_wall") or epoch) - epoch) * 1e6
+        for ev in sub["traceEvents"]:
+            ev = dict(ev)
+            ev["pid"] = i + 1
+            if ev.get("ph") == "M":
+                if ev.get("name") == "process_name":
+                    ev["args"] = {"name": "replica %s" % name}
+            else:
+                ev["ts"] = round(float(ev.get("ts", 0.0)) + off_us, 1)
+            trace.append(ev)
+    return {"traceEvents": trace, "displayTimeUnit": "ms"}
+
+
+def route_chrome_trace(rec: dict) -> dict:
+    """Router-lane-only Chrome trace of one routing flight record (the
+    stitch with zero replica hops)."""
+    return stitched_chrome_trace(rec, [])
+
+
+# ----------------------------------------------------------------------
 def _ask(port: int, line: str, timeout: float = 5.0) -> str:
-    from . import servd
     return servd._ask(port, line, timeout=timeout)
 
 
@@ -917,8 +1419,14 @@ def selftest(verbose: bool = False) -> int:
 
 
 def _selftest_body(verbose: bool = False) -> int:
-    from . import servd
     from . import statusd
+
+    # in-memory telemetry so the replicas' statusd serve real metric
+    # snapshots — the federation half of this selftest needs exact
+    # histogram buckets to merge (restored at the end)
+    owns_telemetry = not telemetry.enabled()
+    if owns_telemetry:
+        telemetry.enable()
 
     # two replicas with DISTINGUISHABLE models: +1 and +1000 — every
     # assertion below can see which replica answered
@@ -948,20 +1456,27 @@ def _selftest_body(verbose: bool = False) -> int:
     s2 = statusd.StatusServer(0, host="127.0.0.1").start()
     s1.register_probe("serving", fe1.health_probe)
     s2.register_probe("serving", fe2.health_probe)
+    # each replica's flight ring on its statusd: the stitched-trace
+    # fetch reads /requestz?request=<id> per hop
+    s1.flight = fe1.flight
+    s2.flight = fe2.flight
 
     # probing OFF the clock (probe_ms huge): every state transition in
     # this selftest is driven deterministically — by dispatch outcomes
-    # or explicit probe_now() sweeps
+    # or explicit probe_now() sweeps (federation likewise: off the
+    # clock, federate_now() drives it)
     router = Router([("127.0.0.1", p1, s1.port),
                      ("127.0.0.1", p2, s2.port)],
                     probe_ms=3600e3, retries=2, stall_s=5.0,
                     drain_ms=2000.0, probe_backoff_cap_s=0.2,
-                    reload_timeout_s=10.0)
+                    reload_timeout_s=10.0, federate_ms=3600e3,
+                    outlier_min_n=1)
     router.start()
     rport = router.listen(0)
     r1, r2 = router._replicas
     srv = statusd.StatusServer(0, host="127.0.0.1").start()
     srv.fleet = router
+    srv.flight = router.flight
     try:
         # zero load, index tie-break: replica 1 answers
         assert _ask(rport, "1 2") == "2 3"
@@ -1041,8 +1556,8 @@ def _selftest_body(verbose: bool = False) -> int:
             router2.drain(timeout_ms=500)
 
         # deadline budget forwarding: a mirror replica echoes the line
-        # it was sent — the forwarded DEADLINE must carry the REMAINING
-        # budget, not the original
+        # it was sent — the forwarded line must carry the minted TRACE
+        # id and the REMAINING deadline budget, not the original
         mirror = _MirrorReplica().start()
         router3 = Router([("127.0.0.1", mirror.port, mirror.port)],
                          probe_ms=3600e3, retries=0, stall_s=5.0,
@@ -1052,8 +1567,13 @@ def _selftest_body(verbose: bool = False) -> int:
         try:
             resp = _ask(rport3, "DEADLINE 5000 1 2 3")
             toks = resp.split()
-            assert toks[0] == "DEADLINE" and toks[2:] == ["1", "2", "3"]
-            assert 0 < int(toks[1]) <= 5000, resp
+            assert toks[0] == "TRACE" and servd.valid_trace_id(toks[1])
+            assert toks[2] == "DEADLINE" and toks[4:] == ["1", "2", "3"]
+            assert 0 < int(toks[3]) <= 5000, resp
+            # a client-sent TRACE id is adopted, not re-minted
+            resp = _ask(rport3, "TRACE client-1 9 9")
+            assert resp.split()[:2] == ["TRACE", "client-1"], resp
+            assert router3.flight.get("client-1") is not None
             # an expired budget is answered by the ROUTER, not routed
             assert _ask(rport3, "DEADLINE 0 9") \
                 .startswith("ERR deadline")
@@ -1103,6 +1623,66 @@ def _selftest_body(verbose: bool = False) -> int:
         assert "cxxnet_fleet_replicas" in metrics
         assert 'cxxnet_fleet_replica_up{' in metrics
 
+        # -- fleet observability plane ---------------------------------
+        # ONE trace id names the request on the router AND on the
+        # replica that served it (TRACE propagation end to end)
+        assert not _ask(rport, "TRACE obs-1 2").startswith("ERR")
+        rrec = router.flight.get("obs-1")
+        assert rrec is not None and rrec["outcome"] == "served", rrec
+        served_by = rrec["attempts"][-1]["replica"]
+        hop_fe = fe1 if served_by.endswith(":%d" % p1) else fe2
+        hop = hop_fe.flight.get("obs-1")
+        assert hop is not None and hop["outcome"] == "served", hop
+        # the stitched cross-process trace off the router's statusd:
+        # router attempt lane (pid 0) + the replica's phase lane
+        code, body = _http_status(srv.port, "/trace?request=obs-1")
+        assert code == 200, body
+        stitched = json.loads(body)
+        xs = [t for t in stitched["traceEvents"] if t.get("ph") == "X"]
+        assert any(t["name"].startswith("forward:") for t in xs)
+        assert any(t["name"] == "prefill" and t["pid"] >= 1
+                   for t in xs), xs
+        assert all(t.get("args", {}).get("request") == "obs-1"
+                   for t in xs)
+        code, body = _http_status(srv.port, "/trace?request=missing")
+        assert code == 404
+        # router /requestz: bounded listing of the routing flights
+        code, body = _http_status(srv.port, "/requestz?json=1&n=2")
+        assert code == 200
+        lst = json.loads(body)
+        assert lst["shown"] <= 2 and lst["total"] >= 2
+
+        # live federation: EXACT histogram merge — for every merged
+        # series the fleet bucket counts equal the sum of the
+        # per-replica snapshot buckets (the acceptance criterion)
+        code, b1 = _http_status(s1.port, "/metrics?json=1")
+        code2, b2 = _http_status(s2.port, "/metrics?json=1")
+        assert code == 200 and code2 == 200
+        shards = [json.loads(b1)["metrics"]["hists"],
+                  json.loads(b2)["metrics"]["hists"]]
+        assert router.federate_now() == 2
+        fed = router.federation_snapshot()
+        assert fed is not None and fed["replicas"] == 2
+        assert "serve.request" in fed["series"], fed["series"].keys()
+        for name, h in fed["series"].items():
+            expect: Dict[str, int] = {}
+            for shard in shards:
+                for i, c in (shard.get(name, {}).get("buckets")
+                             or {}).items():
+                    expect[i] = expect.get(i, 0) + c
+            assert h["buckets"] == expect, (name, h["buckets"], expect)
+        # no outlier between two identically-loaded replicas; the
+        # verdicts (and the federated series) ride /fleetz + /metrics
+        assert fed["outliers"] and not any(
+            v["outlier"] for v in fed["outliers"].values())
+        code, metrics = _http_status(srv.port, "/metrics")
+        assert "cxxnet_fleet_serve_request_seconds_bucket" in metrics
+        assert "cxxnet_fleet_federated_replicas" in metrics
+        assert "cxxnet_fleet_outlier{" in metrics
+        for line in metrics.splitlines():
+            if line and not line.startswith("#"):
+                assert statusd.PROM_LINE_RE.match(line), line
+
         assert router.health_probe()[0] and router.liveness_probe()[0]
     finally:
         stats = router.drain()
@@ -1111,6 +1691,8 @@ def _selftest_body(verbose: bool = False) -> int:
         s2.stop()
         fe1.drain(timeout_ms=1000)
         fe2.drain(timeout_ms=1000)
+        if owns_telemetry:
+            telemetry.disable()
     assert stats["accepted"] == (stats["served"] + stats["errors"]
                                  + stats["shed"] + stats["deadline"]), \
         "router counters do not reconcile: %r" % (stats,)
@@ -1119,7 +1701,8 @@ def _selftest_body(verbose: bool = False) -> int:
     if verbose:
         print("routerd selftest: routing/retry-on-shed/breaker-eject/"
               "dead-eject+backoff/deadline-budget/fleet-stats/"
-              "rolling-reload/drain ok (%r)" % (stats,))
+              "rolling-reload/drain + trace-propagation/stitched-trace/"
+              "exact-federation/outliers ok (%r)" % (stats,))
     return 0
 
 

@@ -41,17 +41,35 @@ Wire protocol (one line per request, one line per response, utf-8):
 
     <tok> <tok> ...                 -> <id> <id> ...        (continuation)
     DEADLINE <ms> <tok> ...         -> same, with a per-request deadline
+    TRACE <id> [DEADLINE <ms>] ...  -> same, request adopts the caller's
+                                       fleet-wide trace id (see below)
     ADMIN reload                    -> OK reload scheduled
     ADMIN stats                     -> OK accepted=.. served=.. ...
     (anything else)                 -> ERR <class> <detail>
 
+``TRACE <id>`` is the cross-process trace-propagation prefix (the
+Dapper idea: ONE id names a request on every process that touched it).
+The fleet router (utils/routerd.py) mints an id per client request and
+stamps it on every forward attempt; this frontend adopts it as the
+request id its ``telemetry.trace_context`` / flight record / ``/trace
+?request=<id>`` surface uses — so a request retried across replicas is
+findable on each of them under the same id. The prefix composes with
+``DEADLINE`` (TRACE first), is optional (a TRACE-less client gets a
+locally minted id, exactly as before), and is validated: the id must
+be 1..``TRACE_ID_MAX`` chars of ``[A-Za-z0-9._:-]``; anything else is
+answered ``ERR proto trace ...`` (class ``proto``: a protocol-level
+violation, deterministic, never dispatched).
+
 Error classes: ``empty`` (blank request — visible instead of a silently
 missing response), ``parse`` (non-integer token, token outside vocab, bad
-DEADLINE), ``busy`` (queue full or breaker open: shed), ``deadline``,
+DEADLINE), ``proto`` (malformed TRACE prefix), ``busy`` (queue full or
+breaker open: shed), ``deadline``,
 ``backend``, ``draining``. The THIRD token of an error line is a
 machine-readable detail token — the retryability contract the fleet
 router (utils/routerd.py) dispatches on, so these are wire format, not
-prose: ``ERR busy queue ...`` (admission queue full — the request never
+prose (the full vocabulary is ONE table in doc/serving.md "Error
+vocabulary"): ``ERR busy queue ...`` (admission queue full — the request
+never
 dispatched, instantly retryable on another replica) vs ``ERR busy
 breaker ...`` (circuit breaker open — also never dispatched, retryable
 elsewhere, but the replica should leave rotation); ``ERR draining
@@ -111,6 +129,7 @@ subprocess (SIGTERM drain, floods, exploding backends).
 
 from __future__ import annotations
 
+import re
 import socket
 import sys
 import threading
@@ -125,7 +144,40 @@ from . import perf
 from . import statusd
 from . import telemetry
 
-__all__ = ["CircuitBreaker", "ServeFrontend", "embed_vocab", "selftest"]
+__all__ = ["CircuitBreaker", "ServeFrontend", "embed_vocab",
+           "TRACE_ID_MAX", "valid_trace_id", "selftest"]
+
+# the TRACE prefix's id bound: long enough for any reasonable minting
+# scheme (router prefix + counter, uuid hex), short enough that a
+# garbage line cannot smuggle kilobytes into every flight record and
+# JSONL event the id is stamped on
+TRACE_ID_MAX = 64
+_TRACE_ID_RE = re.compile(r"[A-Za-z0-9._:-]{1,%d}$" % TRACE_ID_MAX)
+
+
+def valid_trace_id(tid: str) -> bool:
+    """The TRACE id charset/length contract, shared with the router (it
+    validates before forwarding, and mints ids that pass): 1..64 chars
+    of ``[A-Za-z0-9._:-]`` — safe in URLs (``/trace?request=<id>``),
+    label values, and log lines without escaping."""
+    return bool(_TRACE_ID_RE.match(tid))
+
+
+def parse_trace_prefix(parts: List[str]):
+    """Strip a leading ``TRACE <id>`` from a token list ->
+    ``(trace_id, proto_detail, rest)``. ``trace_id`` is None when no
+    prefix was present; ``proto_detail`` (None when valid) is the
+    detail text of the ``ERR proto`` line — ONE implementation of the
+    wire-format check, shared by servd's parser and the router's (the
+    two must never desynchronize on what a malformed prefix is)."""
+    if parts[:1] != ["TRACE"]:
+        return None, None, parts
+    if len(parts) < 2:
+        return None, "trace prefix needs an id", parts
+    if not valid_trace_id(parts[1]):
+        return None, ("trace id must be 1..%d chars of "
+                      "[A-Za-z0-9._:-]" % TRACE_ID_MAX), parts
+    return parts[1], None, parts[2:]
 
 
 def embed_vocab(net) -> int:
@@ -514,11 +566,23 @@ class ServeFrontend:
 
     # -- request intake ------------------------------------------------
     def _parse(self, line: str):
-        """One request line -> ("req", toks, rel_deadline_s) |
-        ("admin", args) | ("err", cls, msg)."""
+        """One request line -> ("req", toks, rel_deadline_s, trace_id) |
+        ("admin", args) | ("err", cls, msg). ``trace_id`` is None unless
+        the line carried a valid ``TRACE <id>`` prefix."""
         parts = line.split()
         if not parts:
             return ("err", "empty", "request line has no tokens")
+        # the cross-process trace id (module docstring): validated by
+        # the shared checker, adopted as the request id below.
+        # Malformed ids are a protocol violation — deterministic, never
+        # dispatched, and distinct from "parse" so an OLD server's
+        # rejection of the prefix itself (ERR parse: TRACE is not an
+        # integer token) stays distinguishable on the wire
+        trace_id, proto_detail, parts = parse_trace_prefix(parts)
+        if proto_detail is not None:
+            return ("err", "proto", proto_detail)
+        if trace_id is not None and not parts:
+            return ("err", "empty", "TRACE with no request line")
         if parts[0] == "ADMIN":
             return ("admin", parts[1:])
         deadline = (self.deadline_ms / 1e3) if self.deadline_ms > 0 \
@@ -549,7 +613,7 @@ class ServeFrontend:
         if self.vocab and not all(0 <= t < self.vocab for t in toks):
             return ("err", "parse",
                     "token id outside vocab_size %d" % self.vocab)
-        return ("req", toks, deadline)
+        return ("req", toks, deadline, trace_id)
 
     def submit(self, line: str, reply, wait: bool = False):
         """Admit one request line. ``reply`` is called EXACTLY ONCE with
@@ -591,6 +655,7 @@ class ServeFrontend:
             return None
         req = None
         shed = False
+        shed_rec = None
         # admission decision + accounting in ONE critical section with
         # the drain flag: after drain() flips _draining (under this
         # lock) no request can slip an accepted count past its final
@@ -612,25 +677,52 @@ class ServeFrontend:
                 # retryable elsewhere AND "eject me from rotation"
                 self._bump("accepted", "shed")
                 shed = True
+                shed_rec = self._shed_record(parsed, "breaker")
                 text = "ERR busy breaker open (circuit)"
             elif len(self._q) >= self.queue_size:
                 # third token "queue": never dispatched, instantly
                 # retryable on another replica
                 self._bump("accepted", "shed")
                 shed = True
+                shed_rec = self._shed_record(parsed, "queue")
                 text = "ERR busy queue full (%d)" % self.queue_size
             else:
-                _, toks, deadline = parsed
+                _, toks, deadline, tid = parsed
                 req = _Request(toks, deadline, reply)
                 # the request id that threads through the whole datapath
-                # (trace context, flight record, /trace?request=<id>)
+                # (trace context, flight record, /trace?request=<id>):
+                # a TRACE-propagated id wins — the router minted ONE id
+                # for this request fleet-wide, and every replica that
+                # touches it must file its flight record under it. The
+                # local counter still advances so TRACE-less requests
+                # keep their dense local ids either way.
                 self._rid += 1
-                req.id = str(self._rid)
+                req.id = tid if tid is not None else str(self._rid)
                 self._bump("accepted")
                 self._q.append(req)
                 telemetry.gauge("serve.queue_depth", len(self._q))
                 self._cond.notify()
                 text = None
+        if shed_rec is not None:
+            # admission sheds land in the flight ring too: a request the
+            # fleet router retried elsewhere leaves a record — under its
+            # ONE trace id — on EVERY replica that touched it, so the
+            # stitched cross-process trace can show the shed attempt
+            # next to the served one (phases are honest zeros: nothing
+            # was dequeued, nothing dispatched)
+            self.flight.record(shed_rec)
+            # ... and a serve_request_done event, so the OFFLINE join
+            # (telemetry_report --fleet, keyed on the trace id) shows
+            # the shed hop too, not just the live stitch. Phases are
+            # null like every never-dispatched event — the report's
+            # percentile table must not deflate during the overload
+            # these events describe
+            telemetry.event({
+                "ev": "serve_request_done", "req": shed_rec["id"],
+                "outcome": "shed", "shed_at": shed_rec["shed_at"],
+                "tokens": 0, "total_s": 0.0, "queue_wait_s": None,
+                "dispatch_s": None, "prefill_s": None,
+                "decode_s": None, "recompiles": 0})
         if req is None:
             if shed and self.slo is not None:
                 # an admission shed (queue full / breaker open at
@@ -645,6 +737,27 @@ class ServeFrontend:
             req.done.wait()
             return None
         return req.done
+
+    def _shed_record(self, parsed, where: str) -> dict:
+        """Flight record for a request shed AT ADMISSION (queue full /
+        breaker open). Called under the admission lock — it mints from
+        the same id counter accepted requests use, so ids stay unique
+        per frontend; a TRACE-propagated id wins like everywhere else.
+        Phases are honest zeros (nothing was dequeued or dispatched);
+        the record exists so the ONE fleet-wide id names this request
+        on every replica that touched it, shed attempts included."""
+        _, toks, deadline, tid = parsed
+        self._rid += 1
+        return {"id": tid if tid is not None else str(self._rid),
+                "outcome": "shed", "shed_at": where,
+                "tokens_in": len(toks), "tokens_out": 0,
+                # cxxlint: disable=wallclock — flight-record arrival
+                # epoch (the cross-process stitch key), never subtracted
+                "t_wall": round(time.time(), 6),
+                "total_s": 0.0, "wall_s": 0.0, "ttft_s": None,
+                "tokens_per_s": None,
+                "phases": {ph: 0.0 for ph in telemetry.REQUEST_PHASES},
+                "recompiles": []}
 
     # -- hot reload ----------------------------------------------------
     def request_reload(self) -> None:
@@ -1152,6 +1265,16 @@ def _selftest_body(verbose: bool = False) -> int:
         # a 0ms deadline has always expired by dispatch time
         assert _ask(port, "DEADLINE 0 1 2").startswith("ERR deadline")
         assert _ask(port, "DEADLINE 5000 7") == "8"
+        # TRACE propagation: the caller's fleet-wide id becomes the
+        # request id (the flight-record / trace-surface key); malformed
+        # ids are a protocol violation, composable with DEADLINE
+        assert _ask(port, "TRACE req-a 1 2") == "2 3"
+        assert fe.flight.get("req-a")["outcome"] == "served"
+        assert _ask(port, "TRACE req-b DEADLINE 5000 3") == "4"
+        assert fe.flight.get("req-b") is not None
+        assert _ask(port, "TRACE %s 1"
+                    % ("x" * (TRACE_ID_MAX + 1))).startswith("ERR proto")
+        assert _ask(port, "TRACE bad/id 1").startswith("ERR proto trace")
         # backend supervision: failures answered, loop survives
         boom["on"] = True
         assert _ask(port, "5").startswith("ERR backend")
@@ -1199,7 +1322,7 @@ def _selftest_body(verbose: bool = False) -> int:
     assert stats["accepted"] == (stats["served"] + stats["errors"]
                                  + stats["shed"] + stats["deadline"]), \
         "serve counters do not reconcile: %r" % (stats,)
-    assert stats["served"] == 5 and stats["shed"] == 1
+    assert stats["served"] == 7 and stats["shed"] == 1
     assert stats["deadline"] == 1 and stats["empty"] == 1
     assert fe.health_probe() == (False,
                                  "draining: not accepting new requests")

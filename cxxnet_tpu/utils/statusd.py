@@ -16,6 +16,10 @@ Endpoints:
   histograms (``telemetry.HIST_BUCKETS``) as ``_seconds_bucket{le=...}``
   series — step time, io wait, h2d, per-request serve latency. All series
   carry a ``process`` label so a multihost scrape attributes shards.
+  ``?json=1`` returns the RAW registry snapshot plus the SLO window —
+  exact bucket counts, the fleet router's federation feed
+  (utils/routerd.py ``federate_now``: the merge stays bucket-count
+  addition with no text-format round trip).
 * ``/healthz`` — READINESS: 200 while the process should receive traffic
   / be trusted, 503 while a heartbeat channel is overdue
   (``health.channel_status``) or ANY registered probe fails — the learn
@@ -38,10 +42,18 @@ Endpoints:
   registered (the serving frontend's per-request ring),
   ``/trace?request=<id>`` instead returns ONE request's phase-attributed
   Chrome trace (queue_wait / dispatch / prefill / decode + the
-  recompiles it paid) — open a single slow request in Perfetto.
-* ``/requestz`` — the flight recorder's ring as JSON, newest first:
-  request id, outcome, phase split, TTFT, tokens — the index you grab a
-  ``/trace?request=<id>`` id from.
+  recompiles it paid) — open a single slow request in Perfetto. On a
+  ROUTER process (``set_fleet``) the same query returns the STITCHED
+  cross-process trace: the router's attempt lane plus every touched
+  replica's phase lanes, fetched live and aligned on the shared wall
+  epoch (utils/routerd.py ``stitched_trace``).
+* ``/requestz`` — the flight recorder's ring, newest first: request
+  id, outcome, phase split (or a router's attempt list), TTFT, tokens
+  — the index you grab a ``/trace?request=<id>`` id from. HTML by
+  default with ``?json=1`` for the raw snapshot (the /fleetz and
+  /programz contract), ``?n=<k>`` bounds the listing, and
+  ``?request=<id>`` returns ONE raw record — the feed the fleet
+  router's cross-process trace stitch reads from each replica.
 * ``/programz`` — the program performance ledger (utils/perf.py): one
   row per compiled program — shapes signature, XLA FLOPs, per-device
   peak bytes, compile seconds, roofline-predicted vs measured p50/p99
@@ -105,7 +117,7 @@ __all__ = [
     "set_run_info", "update_progress", "register_probe", "wire_health",
     "set_flight_recorder", "set_slo", "set_perf", "set_profiler",
     "set_fleet", "prometheus_metrics", "programz_html", "fleetz_html",
-    "PROM_LINE_RE", "selftest",
+    "requestz_html", "PROM_LINE_RE", "selftest",
 ]
 
 _NAME_SAN = re.compile(r"[^a-zA-Z0-9_]")
@@ -273,6 +285,12 @@ class SLOTracker:
                 "by_reason": by_reason,
                 "bad_fraction": round(bad_fraction, 6),
                 "budget": round(self.budget, 6),
+                # the alert floors ride the snapshot so the fleet
+                # federation (routerd) can apply them FLEET-wide to
+                # the merged window — the each-replica-just-under
+                # case is exactly what the fleet account exists for
+                "min_requests": self.min_requests,
+                "min_bad": self.min_bad,
                 "burn_rate": round(burn_rate, 4), "alert": alert}
 
 
@@ -309,6 +327,25 @@ def prometheus_metrics(snapshot: dict, progress: Optional[dict] = None,
                 return "-Inf"
             return repr(v)
         return str(v)
+
+    def emit_hist(mname, h):
+        """One fixed-bucket histogram family (cumulative ``le`` rows)
+        from a sparse ``Histogram.to_dict`` snapshot — shared by the
+        registry's own series and the fleet-federated ones."""
+        out.append("# TYPE %s histogram" % mname)
+        counts = {int(i): int(c) for i, c in
+                  (h.get("buckets") or {}).items()}
+        cum = 0
+        for i, le in enumerate(telemetry.HIST_BUCKETS):
+            cum += counts.get(i, 0)
+            out.append('%s_bucket{process="%s",le="%g"} %d'
+                       % (mname, _lesc(p), le, cum))
+        total = int(h.get("count", 0))
+        out.append('%s_bucket{process="%s",le="+Inf"} %d'
+                   % (mname, _lesc(p), total))
+        out.append('%s_sum%s %s' % (mname, base,
+                                    _fmt(float(h.get("sum", 0.0)))))
+        out.append('%s_count%s %d' % (mname, base, total))
 
     emit("cxxnet_up", "gauge", 1,
          help_="1 while the introspection service is serving")
@@ -424,6 +461,66 @@ def prometheus_metrics(snapshot: dict, progress: Optional[dict] = None,
                            % (mname, _lesc(p),
                               _lesc(r.get("name", "?")),
                               _fmt(get(r))))
+        fed = fleet.get("federation")
+        if fed:
+            # the federated fleet account (routerd.federation_snapshot)
+            # — per-replica serve histograms merged EXACTLY (shared
+            # fixed buckets: bucket-count addition) into fleet series,
+            # counters summed, SLO over the merged windows, and the
+            # per-replica outlier verdicts
+            emit("cxxnet_fleet_federated_replicas", "gauge",
+                 int(fed.get("replicas", 0)),
+                 help_="replicas whose metrics the last federation "
+                       "sweep reached")
+            emit("cxxnet_fleet_federation_age_seconds", "gauge",
+                 round(float(fed.get("age_s", 0.0)), 3))
+            for name, h in sorted((fed.get("series") or {}).items()):
+                emit_hist("cxxnet_fleet_"
+                          + _NAME_SAN.sub("_", str(name)) + "_seconds",
+                          {"buckets": h.get("buckets"),
+                           "count": h.get("count", 0),
+                           "sum": h.get("sum_s", 0.0)})
+            for cname, v in sorted((fed.get("counters") or {}).items()):
+                if _num(v):
+                    emit("cxxnet_fleet_"
+                         + _NAME_SAN.sub("_", str(cname)) + "_total",
+                         "counter", v)
+            fslo = fed.get("slo")
+            if fslo is not None:
+                emit("cxxnet_fleet_slo_burn", "gauge",
+                     int(fslo.get("alert", 0)),
+                     help_="1 while the FLEET-wide merged-window error "
+                           "budget burns >= 1x — fires even when no "
+                           "single replica's own alert floor trips")
+                emit("cxxnet_fleet_slo_burn_rate", "gauge",
+                     float(fslo.get("burn_rate", 0.0)))
+                emit("cxxnet_fleet_slo_bad_fraction", "gauge",
+                     float(fslo.get("bad_fraction", 0.0)))
+                emit("cxxnet_fleet_slo_window_requests", "gauge",
+                     int(fslo.get("requests", 0)))
+            verdicts = fed.get("outliers") or {}
+            if verdicts:
+                out.append("# HELP cxxnet_fleet_outlier 1 while the "
+                           "replica's serve p99 diverges from the "
+                           "fleet median past fleet_outlier_ratio")
+                out.append("# TYPE cxxnet_fleet_outlier gauge")
+                for name in sorted(verdicts):
+                    out.append(
+                        'cxxnet_fleet_outlier{process="%s",'
+                        'replica="%s"} %d'
+                        % (_lesc(p), _lesc(name),
+                           1 if verdicts[name].get("outlier") else 0))
+                out.append("# TYPE cxxnet_fleet_replica_p99_seconds "
+                           "gauge")
+                for name in sorted(verdicts):
+                    p99 = verdicts[name].get("p99_ms")
+                    if p99 is None:
+                        continue
+                    out.append(
+                        'cxxnet_fleet_replica_p99_seconds'
+                        '{process="%s",replica="%s"} %s'
+                        % (_lesc(p), _lesc(name),
+                           _fmt(round(p99 / 1e3, 6))))
     if channels is None:
         channels = health_mod.channel_status()
     if channels:
@@ -446,21 +543,7 @@ def prometheus_metrics(snapshot: dict, progress: Optional[dict] = None,
         if _num(v):
             emit(_mname(name), "gauge", v)
     for name, h in sorted(snapshot.get("hists", {}).items()):
-        mname = _mname(name) + "_seconds"
-        out.append("# TYPE %s histogram" % mname)
-        counts = {int(i): int(c) for i, c in
-                  (h.get("buckets") or {}).items()}
-        cum = 0
-        for i, le in enumerate(telemetry.HIST_BUCKETS):
-            cum += counts.get(i, 0)
-            out.append('%s_bucket{process="%s",le="%g"} %d'
-                       % (mname, _lesc(p), le, cum))
-        total = int(h.get("count", 0))
-        out.append('%s_bucket{process="%s",le="+Inf"} %d'
-                   % (mname, _lesc(p), total))
-        out.append('%s_sum%s %s' % (mname, base,
-                                    _fmt(float(h.get("sum", 0.0)))))
-        out.append('%s_count%s %d' % (mname, base, total))
+        emit_hist(_mname(name) + "_seconds", h)
     return "\n".join(out) + "\n"
 
 
@@ -551,16 +634,40 @@ def fleetz_html(snap: dict) -> str:
     parts.append(fmt % cols)
     for r in reps:
         age = r.get("last_probe_age_s")
+        detail = str(r.get("detail", ""))
+        if r.get("outlier"):
+            # the federation sweep's verdict: this replica's serve p99
+            # diverges from the fleet median — the flagged row the
+            # cxxnet_fleet_outlier gauge and fleet_outlier event name
+            detail = ("OUTLIER (p99 %.1fms vs fleet) " % r["p99_ms"]
+                      if r.get("p99_ms") is not None
+                      else "OUTLIER ") + detail
         parts.append(fmt % (
             esc(r.get("name", "?")), esc(r.get("state", "?")),
             "yes" if r.get("hold") else "-", r.get("queue_depth", 0),
             r.get("in_flight", 0), r.get("outstanding", 0),
             r.get("ejections", 0),
             "never" if age is None else "%.1fs" % age,
-            esc(str(r.get("detail", "")))))
+            esc(detail)))
     parts.append("</pre><h2>router</h2><pre>")
     parts.append(" ".join("%s=%s" % kv for kv in
                           sorted((snap.get("stats") or {}).items())))
+    fed = snap.get("federation")
+    if fed:
+        parts.append("</pre><h2>federated fleet metrics</h2><pre>")
+        parts.append("%d replica(s) federated, %.1fs ago"
+                     % (fed.get("replicas", 0), fed.get("age_s", 0.0)))
+        for name, h in sorted((fed.get("series") or {}).items()):
+            parts.append("%-28s n=%-8d p50=%s p99=%s"
+                         % (esc(name), h.get("count", 0),
+                            _ms(h.get("p50_ms")), _ms(h.get("p99_ms"))))
+        fslo = fed.get("slo")
+        if fslo is not None:
+            parts.append("fleet slo: %d requests, %d bad, burn %.2fx%s"
+                         % (fslo.get("requests", 0),
+                            fslo.get("bad", 0),
+                            fslo.get("burn_rate", 0.0),
+                            "  BURNING" if fslo.get("alert") else ""))
     wins = snap.get("windows") or []
     if wins:
         parts.append("</pre><h2>rolling-reload drain windows</h2><pre>")
@@ -569,6 +676,59 @@ def fleetz_html(snap: dict) -> str:
                          % (esc(w.get("replica", "?")), w["out_s"],
                             w["back_s"], w["back_s"] - w["out_s"]))
     parts.append("</pre><p><a href='/fleetz?json=1'>json</a> "
+                 "<a href='/statusz'>statusz</a></p></body></html>")
+    return "\n".join(parts)
+
+
+def requestz_html(recs: List[dict], total: int, cap: int,
+                  limit: int) -> str:
+    """Render a flight-recorder listing as the /requestz page — one
+    row per request, newest first. Handles BOTH record shapes: a servd
+    replica's phase-attributed records and a router's attempt records
+    (utils/routerd.py), so the same page works on every process.
+    Pure function of its inputs — validated socket-free in tests."""
+    esc = html.escape
+    parts = ["<html><head><title>cxxnet requestz</title></head>"
+             "<body><h1>request flight recorder</h1><pre>"]
+    parts.append("%d of last %d requests recorded%s"
+                 % (total, cap,
+                    "  (showing newest %d — ?n=<k> to change)"
+                    % len(recs) if limit > 0 and total > len(recs)
+                    else ""))
+    parts.append("</pre><pre>")
+    cols = ("request", "outcome", "total", "ttft", "tok", "detail")
+    fmt = "%-24s %-14s %9s %9s %5s  %s"
+    parts.append(fmt % cols)
+    for r in recs:
+        total_s = r.get("total_s")
+        ttft_s = r.get("ttft_s")
+        if r.get("attempts") is not None:
+            # router shape: the routing life in one cell
+            detail = " -> ".join(
+                "%s:%s%s" % (a.get("replica", "?"),
+                             a.get("outcome", "?"),
+                             " (retried)" if a.get("retried") else "")
+                for a in r["attempts"]) or "(no attempt)"
+        else:
+            ph = r.get("phases") or {}
+            detail = " ".join(
+                "%s=%s" % (k, _ms(None if ph.get(k) is None
+                                  else ph[k] * 1e3))
+                for k in telemetry.REQUEST_PHASES if k in ph)
+            if r.get("shed_at"):
+                detail = "shed at admission (%s)" % r["shed_at"]
+        parts.append(fmt % (
+            esc(str(r.get("id", "?"))), esc(str(r.get("outcome", "?"))),
+            _ms(None if total_s is None else total_s * 1e3),
+            _ms(None if ttft_s is None else ttft_s * 1e3),
+            r.get("tokens_out", r.get("retries", 0)),
+            esc(detail)))
+    if not recs:
+        parts.append("(no requests recorded yet)")
+    parts.append("</pre><p>one request's Chrome trace: "
+                 "<code>/trace?request=&lt;id&gt;</code> "
+                 "(on a router: the stitched cross-process trace); "
+                 "<a href='/requestz?json=1'>json</a> "
                  "<a href='/statusz'>statusz</a></p></body></html>")
     return "\n".join(parts)
 
@@ -598,8 +758,23 @@ class _Endpoint(BaseHTTPRequestHandler):
         path, _, query = self.path.partition("?")
         try:
             if path == "/metrics":
-                self._reply(200, "text/plain; version=0.0.4; charset=utf-8",
-                            srv.metrics_text().encode("utf-8"))
+                if parse_qs(query).get("json"):
+                    # the RAW registry snapshot (+ SLO window): the
+                    # fleet router's federation feed — exact bucket
+                    # counts, so the fleet merge is bucket addition
+                    # with no text-format round trip (routerd
+                    # federate_now; doc/observability.md "Fleet
+                    # observability")
+                    body = {"metrics": srv.registry.metrics_snapshot(),
+                            "slo": srv.slo.snapshot()
+                            if srv.slo is not None else None}
+                    self._reply(200, "application/json",
+                                json.dumps(body).encode("utf-8"))
+                else:
+                    self._reply(
+                        200,
+                        "text/plain; version=0.0.4; charset=utf-8",
+                        srv.metrics_text().encode("utf-8"))
             elif path == "/healthz":
                 fails = srv.health_failures()
                 if fails:
@@ -629,6 +804,25 @@ class _Endpoint(BaseHTTPRequestHandler):
                 rid = (parse_qs(query, keep_blank_values=True)
                        .get("request") or [None])[0]
                 if rid is not None:
+                    if srv.fleet is not None and hasattr(
+                            srv.fleet, "stitched_trace"):
+                        # router process: ONE cross-process trace —
+                        # the router's attempt lane plus each touched
+                        # replica's phase lanes, fetched live over
+                        # their statusd and clock-aligned on the
+                        # shared wall epoch (routerd.stitched_trace)
+                        trace = srv.fleet.stitched_trace(rid)
+                        if trace is None:
+                            self._reply(
+                                404, "text/plain; charset=utf-8",
+                                ("no routed request %r in the router "
+                                 "flight ring; see /requestz\n" % rid)
+                                .encode("utf-8"))
+                        else:
+                            self._reply(200, "application/json",
+                                        json.dumps(trace)
+                                        .encode("utf-8"))
+                        return
                     # one request's flight record as a Chrome trace
                     fr = srv.flight
                     rec = fr.get(rid) if fr is not None else None
@@ -650,11 +844,48 @@ class _Endpoint(BaseHTTPRequestHandler):
                     self._reply(200, "application/json",
                                 json.dumps(trace).encode("utf-8"))
             elif path == "/requestz":
+                q = parse_qs(query, keep_blank_values=True)
                 fr = srv.flight
-                body = {"requests": fr.list() if fr is not None else [],
-                        "capacity": fr.cap if fr is not None else 0}
-                self._reply(200, "application/json",
-                            json.dumps(body).encode("utf-8"))
+                rid = (q.get("request") or [None])[0]
+                if rid is not None:
+                    # ONE raw flight record by id — the cross-process
+                    # stitch fetches a replica's half of a routed
+                    # request through this (routerd.stitched_trace)
+                    rec = fr.get(rid) if fr is not None else None
+                    if rec is None:
+                        self._reply(404, "text/plain; charset=utf-8",
+                                    ("no flight record for request %r\n"
+                                     % rid).encode("utf-8"))
+                    else:
+                        self._reply(200, "application/json",
+                                    json.dumps(rec).encode("utf-8"))
+                    return
+                try:
+                    # ?n=<k>: the ring default (256 records) is an
+                    # unreadable wall in a browser — bound the listing
+                    n = int((q.get("n") or ["0"])[0])
+                except ValueError:
+                    self._reply(400, "text/plain; charset=utf-8",
+                                b"n must be an integer\n")
+                    return
+                recs = fr.list() if fr is not None else []
+                total = len(recs)
+                if n > 0:
+                    recs = recs[:n]
+                if q.get("json"):
+                    body = {"requests": recs,
+                            "capacity": fr.cap if fr is not None else 0,
+                            "total": total, "shown": len(recs)}
+                    self._reply(200, "application/json",
+                                json.dumps(body).encode("utf-8"))
+                else:
+                    # HTML by default, ?json=1 for the raw snapshot —
+                    # the same contract as /fleetz and /programz
+                    self._reply(200, "text/html; charset=utf-8",
+                                requestz_html(
+                                    recs, total,
+                                    fr.cap if fr is not None else 0,
+                                    n).encode("utf-8"))
             elif path == "/programz":
                 lg = srv.perf
                 if lg is None:
@@ -1152,9 +1383,41 @@ def _selftest_body(verbose: bool = False) -> int:
         assert 'cxxnet_slo_burn{process="0"} 0' in metrics
         assert "cxxnet_slo_burn_rate" in metrics
 
-        # per-request flight recorder: listable + one request's trace
-        reqz = json.loads(urlopen(base + "/requestz", timeout=5).read())
+        # per-request flight recorder: HTML by default (the ?json=1
+        # contract /fleetz and /programz follow), listable as JSON,
+        # ?n=<k> bounded, one raw record by ?request=<id> (the
+        # cross-process stitch feed)
+        rpage = urlopen(base + "/requestz", timeout=5).read().decode()
+        assert "flight recorder" in rpage and ">7<" not in rpage
+        reqz = json.loads(urlopen(base + "/requestz?json=1",
+                                  timeout=5).read())
         assert reqz["requests"] and reqz["requests"][0]["id"] == "7"
+        srv.flight.record({"id": "8", "outcome": "shed",
+                           "shed_at": "queue", "total_s": 0.0,
+                           "phases": {}, "recompiles": []})
+        lim = json.loads(urlopen(base + "/requestz?json=1&n=1",
+                                 timeout=5).read())
+        assert lim["shown"] == 1 and lim["total"] == 2 \
+            and lim["requests"][0]["id"] == "8"
+        one = json.loads(urlopen(base + "/requestz?request=7",
+                                 timeout=5).read())
+        assert one["id"] == "7" and one["outcome"] == "served"
+        try:
+            urlopen(base + "/requestz?request=nope", timeout=5)
+            raise AssertionError("unknown request id should 404")
+        except HTTPError as e:
+            assert e.code == 404
+        try:
+            urlopen(base + "/requestz?n=x", timeout=5)
+            raise AssertionError("non-integer n should 400")
+        except HTTPError as e:
+            assert e.code == 400
+        # the federation feed: raw registry snapshot + SLO window
+        mj = json.loads(urlopen(base + "/metrics?json=1",
+                                timeout=5).read())
+        assert mj["metrics"]["counters"]["selftest.requests"] == 3
+        assert "selftest.latency" in mj["metrics"]["hists"]
+        assert mj["slo"]["min_requests"] == 3
         rtrace = json.loads(urlopen(
             base + "/trace?request=7", timeout=5).read())
         names = [t["name"] for t in rtrace["traceEvents"]

@@ -813,9 +813,13 @@ class FlightRecorder:
     def get(self, request_id) -> Optional[dict]:
         rid = str(request_id)
         with self._lock:
-            # newest-first: a repeated id (never in one frontend's
-            # lifetime, possible across restarts feeding one recorder)
-            # resolves to the most recent flight
+            # newest-first: a repeated id resolves to the most recent
+            # flight. Repeats happen across frontend restarts feeding
+            # one recorder, and with client-chosen TRACE ids — a
+            # client that reuses an id (or picks one colliding with a
+            # local dense id) shadows the older record here; that is
+            # the documented contract (doc/serving.md: choose unique
+            # trace ids), not a lookup guarantee
             for rec in reversed(self._ring):
                 if rec.get("id") == rid:
                     return rec
@@ -858,6 +862,17 @@ def request_chrome_trace(rec: dict) -> dict:
                       "ts": round(t * 1e6, 1), "dur": round(dur * 1e6, 1),
                       "args": args})
         t += dur
+    if t == 0.0:
+        # no positive phase at all — an admission shed (honest zero
+        # phases: nothing was dequeued or dispatched). The lane must
+        # still be VISIBLE in a stitched cross-process trace (the
+        # retried-request case renders the shed hop next to the served
+        # one), so draw a 1µs marker named for the outcome.
+        name = str(rec.get("outcome", "?"))
+        if rec.get("shed_at"):
+            name += "(%s)" % rec["shed_at"]
+        trace.append({"ph": "X", "name": name, "pid": 0, "tid": 0,
+                      "ts": 0.0, "dur": 1.0, "args": args})
     comp_t0 = float(phases.get("queue_wait", 0.0) or 0.0) \
         + float(phases.get("dispatch", 0.0) or 0.0)
     if rec.get("recompiles"):

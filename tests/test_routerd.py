@@ -264,8 +264,11 @@ def test_deadline_budget_forwarded_and_enforced(make_router):
         resp = faultinject.serve_request(router.port,
                                          "DEADLINE 400 1 2 3")
         toks = resp.split()
-        assert toks[0] == "DEADLINE" and toks[2:] == ["1", "2", "3"]
-        assert 0 < int(toks[1]) <= 400, resp
+        # the forward carries the minted TRACE id and the REMAINING
+        # budget (the mirror echoes the line it was sent)
+        assert toks[0] == "TRACE" and servd.valid_trace_id(toks[1])
+        assert toks[2] == "DEADLINE" and toks[4:] == ["1", "2", "3"]
+        assert 0 < int(toks[3]) <= 400, resp
         assert faultinject.serve_request(
             router.port, "DEADLINE 0 9").startswith("ERR deadline")
         st = router.stats()

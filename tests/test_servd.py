@@ -841,10 +841,16 @@ def test_request_tracing_end_to_end():
         assert total_us >= 0.95 * rec["wall_s"] * 1e6
         code, _ = _get(srv, "/trace?request=99999")
         assert code == 404
-        code, body = _get(srv, "/requestz")
+        code, body = _get(srv, "/requestz?json=1")
         assert code == 200
         assert rec["id"] in [r["id"]
                              for r in json.loads(body)["requests"]]
+        # HTML by default (the /fleetz//programz ?json=1 contract) and
+        # the single-record fetch the cross-process stitch uses
+        code, body = _get(srv, "/requestz")
+        assert code == 200 and "flight recorder" in body
+        code, body = _get(srv, "/requestz?request=" + rec["id"])
+        assert code == 200 and json.loads(body)["id"] == rec["id"]
         # /metrics: valid serve_ttft_seconds buckets with the request in
         code, metrics = _get(srv, "/metrics")
         assert code == 200

@@ -27,7 +27,11 @@ To start gating a metric, copy a trusted run's value into
 15047.0}``. Sub-fields of a row gate too, opt-in per field, when the
 baseline publishes ``"<metric>.<field>"`` — e.g.
 ``"serve_loopback_p99_latency_ms.ttft_p99_ms": 40.0`` gates the serve
-row's TTFT tail (direction-aware: ``*_ms`` / ``*_rate`` sub-fields are
+row's TTFT tail, and
+``"serve_fleet_p99_latency_ms.ttft_p99_ms"`` /
+``".retry_rate"`` gate the routed-fleet row's tail and retry pressure
+(the fleet TTFT comes from the router↔replica trace-id join)
+(direction-aware: ``*_ms`` / ``*_rate`` sub-fields are
 worse when higher; null values skip cleanly like headline rows).
 """
 

@@ -6,6 +6,8 @@ Usage:
                                                [--json]
     python tools/telemetry_report.py --merge shard0.jsonl shard1.jsonl ...
                                                [--top N] [--json]
+    python tools/telemetry_report.py --fleet router.jsonl replica0.jsonl ...
+                                               [--top N] [--json]
 
 Prints top spans by total time, recompile count/causes/seconds, per-round
 breakdowns, counters/gauges, fixed-bucket latency histograms (bucket table
@@ -32,6 +34,18 @@ shard's ``t0_wall``), events keep their ``p`` process tag, histograms
 merge EXACTLY (shared fixed buckets: bucket-count addition), counters sum
 across processes, and the report adds a per-process breakdown — one
 coherent cross-host view instead of N clobbering logs.
+
+``--fleet`` merges a serving FLEET's logs — the router's
+(``task = route``) plus its replicas' (``task = serve``) — which are
+separate single-process runs that may all claim process index 0, so the
+shards are relabeled by argument position (shard i -> process i) before
+the same wall-clock re-basing. The report then JOINS the router's
+``route_request_done`` events against the replicas'
+``serve_request_done`` events on the shared trace id (the ``TRACE``
+propagation of utils/routerd.py) and prints a per-hop breakdown: each
+routed request's attempts/retries next to the phase split of every
+replica that touched it, the router-overhead percentiles (router total
+minus the slowest hop), and any ``fleet_outlier`` transitions.
 
 Exit codes: 0 ok; 1 usage / unreadable file; 2 malformed log (a line
 that is not valid JSON, or no telemetry events at all) OR a log with
@@ -145,6 +159,19 @@ def merge_shards(shard_events):
     return merged
 
 
+def merge_fleet_shards(shard_events):
+    """--fleet: the router log + N replica logs are DIFFERENT processes
+    that may each carry process index 0 (every one is its own
+    single-process run), so --merge's duplicate-index guard would
+    reject them. Relabel shard i as process i — argument order is the
+    identity (put the router first by convention) — then re-base on
+    the shared wall-clock epoch exactly like --merge."""
+    relabeled = []
+    for i, events in enumerate(shard_events):
+        relabeled.append([dict(ev, p=i) for ev in events])
+    return merge_shards(relabeled)
+
+
 def aggregate(events):
     spans = {}
     compiles = []
@@ -159,6 +186,8 @@ def aggregate(events):
               "data_corrupt": 0, "skipped_batches": 0}
     breaker_events = []
     requests = []
+    route_requests = []
+    outlier_events = []
     slo_events = []
     program_cards = {}
 
@@ -218,6 +247,12 @@ def aggregate(events):
             proc(ev)
         elif kind == "serve_request_done":
             requests.append(ev)
+            proc(ev)
+        elif kind == "route_request_done":
+            route_requests.append(ev)
+            proc(ev)
+        elif kind == "fleet_outlier":
+            outlier_events.append(ev)
             proc(ev)
         elif kind == "slo_burn":
             slo_events.append(ev)
@@ -322,6 +357,64 @@ def aggregate(events):
                 for r in slowest],
             "recompile_requests": dict(sorted(recomp.items())),
         }
+    # fleet view: the router's route_request_done events joined against
+    # the replicas' serve_request_done events on the shared trace id —
+    # one id names a request on every process that touched it (--fleet)
+    fleet = None
+    if route_requests:
+        by_req = {}
+        for r in requests:
+            by_req.setdefault(str(r.get("req")), []).append(r)
+        joined = []
+        overheads = []
+        for ev in route_requests:
+            rid = str(ev.get("req"))
+            hops = [{"p": int(h.get("p", 0)),
+                     "outcome": h.get("outcome"),
+                     "total_s": h.get("total_s"),
+                     "ttft_s": h.get("ttft_s"),
+                     "queue_wait_s": h.get("queue_wait_s"),
+                     "prefill_s": h.get("prefill_s"),
+                     "decode_s": h.get("decode_s")}
+                    for h in by_req.get(rid, [])]
+            row = {"req": rid, "outcome": ev.get("outcome"),
+                   "total_s": ev.get("total_s"),
+                   "attempts": int(ev.get("attempts", 0)),
+                   "retries": int(ev.get("retries", 0)),
+                   "replicas": ev.get("replicas") or [],
+                   "hops": hops}
+            if ev.get("total_s") is not None and hops:
+                hop_tot = max(float(h.get("total_s") or 0.0)
+                              for h in hops)
+                # router total minus the slowest hop's total = queueing
+                # + connect + rewrite + relay overhead the router added
+                overheads.append(max(0.0, float(ev["total_s"])
+                                     - hop_tot))
+            joined.append(row)
+        overheads.sort()
+        fleet = {
+            "requests": len(route_requests),
+            "outcomes": count_by(route_requests, "outcome"),
+            "retried": sum(1 for ev in route_requests
+                           if int(ev.get("retries", 0)) > 0),
+            "matched": sum(1 for j in joined if j["hops"]),
+            "unmatched": sum(1 for j in joined if not j["hops"]),
+            "router_overhead_p50_ms":
+                round(1e3 * percentile(overheads, 50), 4)
+                if overheads else None,
+            "router_overhead_p99_ms":
+                round(1e3 * percentile(overheads, 99), 4)
+                if overheads else None,
+            "slowest": sorted(joined,
+                              key=lambda j: -float(j.get("total_s")
+                                                   or 0.0))[:5],
+            "outlier_transitions": [
+                {"replica": ev.get("replica"),
+                 "outlier": int(ev.get("outlier", 0)),
+                 "p99_ms": ev.get("p99_ms"),
+                 "fleet_p99_ms": ev.get("fleet_p99_ms")}
+                for ev in outlier_events],
+        }
     # SLO burn account: transition events only — the LAST state per
     # process is the gate (a log that ends burning exits 2)
     slo = None
@@ -385,8 +478,8 @@ def aggregate(events):
         }
     out = {"spans": {}, "compiles": {}, "counters": counters,
            "gauges": gauges, "rounds": rounds, "health": health,
-           "serving": serving, "requests": req_agg, "slo": slo,
-           "programs": programs, "hists": {}}
+           "serving": serving, "requests": req_agg, "fleet": fleet,
+           "slo": slo, "programs": programs, "hists": {}}
     for name, h in sorted(merged_hists.items()):
         st = h.stats()
         st["buckets"] = h.to_dict()["buckets"]
@@ -563,6 +656,48 @@ def print_report(agg, top=15):
             print("recompile-attributed requests: %s"
                   % " ".join("req=%s(%d)" % kv for kv in
                              rq["recompile_requests"].items()))
+    fl = agg.get("fleet")
+    if fl:
+        print("\n== fleet requests (router <-> replica join on "
+              "trace id) ==")
+        print("routed: %d  %s  retried: %d  hop-matched: %d"
+              "  unmatched: %d"
+              % (fl["requests"],
+                 " ".join("%s=%d" % kv
+                          for kv in sorted(fl["outcomes"].items())),
+                 fl["retried"], fl["matched"], fl["unmatched"]))
+        if fl["router_overhead_p50_ms"] is not None:
+            print("router overhead (total - slowest hop): p50=%s  "
+                  "p99=%s"
+                  % (_fmt_ms(fl["router_overhead_p50_ms"]),
+                     _fmt_ms(fl["router_overhead_p99_ms"])))
+        print("top-5 slowest routed requests (per-hop breakdown):")
+        for j in fl["slowest"]:
+            print("  req=%-18s %-10s total=%8.2fms  attempts=%d"
+                  "%s  via %s"
+                  % (j["req"], j["outcome"],
+                     1e3 * float(j.get("total_s") or 0.0),
+                     j["attempts"],
+                     " retries=%d" % j["retries"] if j["retries"]
+                     else "",
+                     ",".join(j["replicas"]) or "-"))
+            for h in j["hops"]:
+                print("    hop p=%-3d %-12s total=%s ttft=%s "
+                      "queue=%s prefill=%s decode=%s"
+                      % (h["p"], h.get("outcome"),
+                         *(_fmt_ms(None if h.get(k) is None
+                                   else 1e3 * float(h[k]))
+                           for k in ("total_s", "ttft_s",
+                                     "queue_wait_s", "prefill_s",
+                                     "decode_s"))))
+        if fl["outlier_transitions"]:
+            print("outlier transitions:")
+            for t in fl["outlier_transitions"]:
+                print("  %-21s -> %s (p99 %s vs fleet %s)"
+                      % (t["replica"],
+                         "OUTLIER" if t["outlier"] else "ok",
+                         _fmt_ms(t.get("p99_ms")),
+                         _fmt_ms(t.get("fleet_p99_ms"))))
     slo = agg.get("slo")
     if slo:
         print("\n== slo ==")
@@ -636,6 +771,7 @@ def main(argv):
     trace_out = None
     as_json = False
     merge = False
+    fleet = False
     paths = []
     i = 0
     while i < len(argv):
@@ -652,20 +788,29 @@ def main(argv):
         elif a == "--merge":
             merge = True
             i += 1
+        elif a == "--fleet":
+            fleet = True
+            i += 1
         elif a.startswith("--"):
             print("unknown option %s" % a, file=sys.stderr)
             return 1
         else:
             paths.append(a)
             i += 1
-    if (len(paths) != 1 and not merge) or (merge and len(paths) < 1):
+    many = merge or fleet
+    if (len(paths) != 1 and not many) or (many and len(paths) < 1):
         print(__doc__, file=sys.stderr)
         return 1
     for path in paths:
         if not os.path.exists(path):
             print("no such log: %s" % path, file=sys.stderr)
             return 1
-    if merge:
+    if fleet:
+        # router + replica logs: separate processes, relabeled by
+        # argument position, joined on the shared trace ids
+        events = merge_fleet_shards([load_events(p) for p in paths])
+        label = "+".join(paths)
+    elif merge:
         events = merge_shards([load_events(p) for p in paths])
         label = "+".join(paths)
     else:
@@ -675,7 +820,10 @@ def main(argv):
     if as_json:
         print(json.dumps(agg, indent=1))
     else:
-        if merge:
+        if fleet:
+            print("fleet-merged %d log(s) (shard i = process i): %s\n"
+                  % (len(paths), label))
+        elif merge:
             print("merged %d shard(s): %s\n" % (len(paths), label))
         print_report(agg, top=top)
     if trace_out:
