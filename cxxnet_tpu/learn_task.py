@@ -27,6 +27,67 @@ from .utils import telemetry
 from .utils.config import ConfigIterator
 
 
+class _SeededSession:
+    """Maps the dispatcher's per-request dispatch ordinal onto the conf
+    sampling seed — ``seed = gen_seed + seq``, exactly what the solo
+    backend passes to ``generate``; the per-slot RNG therefore keys on
+    the request's dispatch ordinal, never on batch composition, and
+    batched streams are token-exact vs solo dispatch."""
+
+    def __init__(self, inner, seed0: int):
+        self._inner = inner
+        self._seed0 = int(seed0)
+        self.nslots = inner.nslots
+
+    @property
+    def closed(self):
+        return self._inner.closed
+
+    def prefill(self, slot, toks, seq):
+        return self._inner.prefill(slot, toks, self._seed0 + int(seq))
+
+    def step(self):
+        return self._inner.step()
+
+    def retire(self, slot):
+        self._inner.retire(slot)
+
+    def free_slots(self):
+        return self._inner.free_slots()
+
+    def close(self):
+        self._inner.close()
+
+
+class _SlotBackendAdapter:
+    """Continuous-batching slot backend over ``Trainer.decode_session``
+    — what servd's batching dispatcher drives when ``serve_buckets`` is
+    set (doc/serving.md "Continuous batching"). Reads the trainer
+    THROUGH the task so a hot reload's swapped-in trainer serves the
+    next session (the dispatcher closes every session before a
+    reload — slot caches hold the old model's K/V)."""
+
+    def __init__(self, task, buckets):
+        self.task = task
+        self.buckets = list(buckets)
+
+    def admits(self, toks):
+        t = self.task
+        l_max = t.net_trainer.net_cfg.param.input_shape[2]
+        if len(toks) + t.gen_new > l_max:
+            return ("prompt len %d + gen_new %d exceeds the net's "
+                    "sequence length %d" % (len(toks), t.gen_new, l_max))
+        return None
+
+    def session(self, bucket):
+        t = self.task
+        return _SeededSession(
+            t.net_trainer.decode_session(
+                bucket, t.gen_new, temperature=t.gen_temperature,
+                top_k=t.gen_topk),
+            t.gen_seed)
+
+
 class LearnTask:
     def __init__(self):
         self.task = "train"
@@ -145,6 +206,17 @@ class LearnTask:
         self.serve_breaker_fails = 5
         self.serve_breaker_cooldown_ms = 1000.0
         self.serve_stall_s = 120.0       # wedged-backend probe bound
+        # continuous batching (doc/serving.md "Continuous batching"):
+        # serve_buckets = "1,2,4,8" arms the iteration-granularity
+        # batching dispatcher over Trainer.decode_session — queued
+        # compatible requests coalesce (up to serve_batch_max within a
+        # serve_batch_window_ms gather window) into the smallest bucket
+        # that fits, and a finished sequence frees its slot to the next
+        # queued request MID-DECODE. Empty = one request per decode
+        # pass (the pre-batching solo dispatch).
+        self.serve_buckets = ""
+        self.serve_batch_max = 8
+        self.serve_batch_window_ms = 2.0
         # serving SLOs + request tracing (doc/observability.md "Request
         # tracing & SLOs"): every request gets a phase-attributed trace
         # in a bounded flight recorder (statusd /trace?request=<id>,
@@ -408,6 +480,12 @@ class LearnTask:
             self.serve_breaker_cooldown_ms = float(val)
         if name == "serve_stall_s":
             self.serve_stall_s = float(val)
+        if name == "serve_buckets":
+            self.serve_buckets = val
+        if name == "serve_batch_max":
+            self.serve_batch_max = int(val)
+        if name == "serve_batch_window_ms":
+            self.serve_batch_window_ms = float(val)
         if name == "slo_ttft_ms":
             self.slo_ttft_ms = float(val)
         if name == "slo_p99_ms":
@@ -1336,6 +1414,22 @@ class LearnTask:
             ttft_ms=self.slo_ttft_ms, p99_ms=self.slo_p99_ms,
             availability=self.slo_availability,
             window_s=self.slo_window_s)
+        # continuous batching: serve_buckets = "1,2,4,8" swaps the
+        # one-request-per-pass worker for the iteration-granularity
+        # batching dispatcher over Trainer.decode_session (the slot
+        # counts are the compile-once bucket grid — keep it short, each
+        # bucket is one decode-step program)
+        slot_backend = None
+        bucket_list = [int(x) for x in
+                       str(self.serve_buckets).replace(",", " ").split()]
+        if bucket_list:
+            slot_backend = _SlotBackendAdapter(self, bucket_list)
+            if not self.silent:
+                print("serve: continuous batching on (buckets %s, "
+                      "batch_max %d, window %.1fms)"
+                      % (sorted(set(bucket_list)), self.serve_batch_max,
+                         self.serve_batch_window_ms),
+                      file=sys.stderr, flush=True)
         fe = servd.ServeFrontend(
             backend, queue_size=self.serve_queue,
             deadline_ms=self.serve_deadline_ms,
@@ -1344,7 +1438,10 @@ class LearnTask:
             breaker_cooldown_ms=self.serve_breaker_cooldown_ms,
             stall_after_s=self.serve_stall_s,
             vocab=vocab, reload_fn=reload_fn,
-            slo=slo, flight_cap=self.serve_flight_cap)
+            slo=slo, flight_cap=self.serve_flight_cap,
+            slot_backend=slot_backend,
+            batch_max=self.serve_batch_max,
+            batch_window_ms=self.serve_batch_window_ms)
         fe.start()
         # request introspection: /trace?request=<id> + /requestz serve
         # the flight ring, /metrics + /statusz the SLO account (no-ops

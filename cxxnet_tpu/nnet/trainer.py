@@ -39,6 +39,34 @@ from .config import NetConfig
 from .net import NeuralNet
 
 
+def _sample_pick(temperature: float, top_k: int):
+    """Next-token chooser over a (b, vocab) softmax row: greedy argmax at
+    temperature 0, else sampling from log-probs / temperature (optionally
+    truncated to the ``top_k`` most likely tokens). ONE implementation
+    shared by ``Trainer.generate`` (solo dispatch) and ``DecodeSession``
+    (batched dispatch) — the token-exactness contract between the two
+    keys on the sampling math never drifting."""
+    temperature, top_k = float(temperature), int(top_k)
+    check(top_k >= 0, "generate: top_k must be >= 0")
+
+    def pick(probs, step_key):
+        if temperature <= 0.0:
+            return jnp.argmax(probs, axis=1)
+        lg = jnp.log(jnp.maximum(probs, 1e-30)) / temperature
+        if top_k and top_k < lg.shape[1]:
+            # exact-k mask from top_k indices (same pattern as the
+            # moe gate, layers.py — a >=kth-value threshold would
+            # keep every tied token)
+            _, idx = jax.lax.top_k(lg, top_k)
+            keep = jnp.sum(jax.nn.one_hot(idx, lg.shape[1],
+                                          dtype=jnp.float32),
+                           axis=1) > 0
+            lg = jnp.where(keep, lg, -jnp.inf)
+        return jax.random.categorical(step_key, lg, axis=1)
+
+    return pick
+
+
 def _updater_signature(up):
     """Hashable hyper-parameter signature for grouping packed-stage tensors
     whose updates are identical elementwise programs (same kind, same
@@ -1438,30 +1466,13 @@ class Trainer:
             self._decode_cache_specs(net2, b, l_max)
 
         temperature, top_k = float(temperature), int(top_k)
-        check(top_k >= 0, "generate: top_k must be >= 0")
         fkey = (plen, total, temperature, top_k)
         # a fresh entry means THIS call pays the decode-program compile:
         # the TTFT stamp below must not charge it to prefill
         fresh_fns = fkey not in self._decode_fns
         if fresh_fns:
             last = net2.cfg.param.num_nodes - 1
-
-            def pick(probs, step_key):
-                """Next token from the softmax row: greedy, or sampled
-                from log-probs / temperature (top_k-truncated)."""
-                if temperature <= 0.0:
-                    return jnp.argmax(probs, axis=1)
-                lg = jnp.log(jnp.maximum(probs, 1e-30)) / temperature
-                if top_k and top_k < lg.shape[1]:
-                    # exact-k mask from top_k indices (same pattern as the
-                    # moe gate, layers.py — a >=kth-value threshold would
-                    # keep every tied token)
-                    _, idx = jax.lax.top_k(lg, top_k)
-                    keep = jnp.sum(jax.nn.one_hot(idx, lg.shape[1],
-                                                  dtype=jnp.float32),
-                                   axis=1) > 0
-                    lg = jnp.where(keep, lg, -jnp.inf)
-                return jax.random.categorical(step_key, lg, axis=1)
+            pick = _sample_pick(temperature, top_k)
 
             def place(toks, t, picked, lens):
                 """Column t+1: the row's own prompt token while t+1
@@ -1800,6 +1811,23 @@ class Trainer:
         self._decode_params = (self._decode_params[0], new_dparams)
         return np.asarray(hist)[:, plen:total]
 
+    def decode_session(self, nslots: int, n_new: int,
+                       temperature: float = 0.0,
+                       top_k: int = 0) -> "DecodeSession":
+        """A batched decode session over ``nslots`` independent KV-cache
+        slots — the iteration-granularity serving datapath
+        (doc/serving.md "Continuous batching"). ``prefill`` admits one
+        request into a free slot, ``step`` advances every active slot
+        one token, ``retire`` frees a finished slot so the next queued
+        request joins MID-DECODE instead of waiting out the stragglers.
+        Per-request output is token-exact vs a solo ``generate`` of the
+        same request (per-slot RNG keyed on the request's own seed).
+        Programs are cached per (bucket, sampling) signature in the
+        trainer's jit cache: a request joining a warm bucket never
+        recompiles (the arXiv:1802.04799 latency cliff)."""
+        return DecodeSession(self, nslots, n_new,
+                             temperature=temperature, top_k=top_k)
+
     def export_decode(self, batch_size: int, prompt_len: int,
                       compat: bool = True):
         """AOT-export the KV-cached decode loop as TWO self-contained
@@ -1995,6 +2023,298 @@ class Trainer:
         check(tag in ("wmat", "bias", "wo"),
               "GetWeight: weight tag can only be bias, wmat, or wo")
         return self.net.get_weight(self.canonical_params(), layer_name, tag)
+
+
+class DecodeSession:
+    """Iteration-granularity batched decode over a fixed slot batch.
+
+    The continuous-batching serving datapath (doc/serving.md): where
+    ``generate`` runs one monolithic jitted scan per call — a finished
+    sequence holds its slot until the longest one ends, and a new
+    request cannot join mid-flight — a session owns ``nslots``
+    independent decode slots with per-slot KV cache rows, per-slot
+    positions, and per-slot RNG keys, scheduled one TOKEN at a time:
+
+    * ``prefill(slot, toks, seed)`` admits one request into a free slot
+      (the same b=1 per-prompt-length prefill program solo dispatch
+      compiles, then a jitted scatter inserts its cache/token rows into
+      the slot-major batch state) and returns its first token;
+    * ``step()`` advances ALL active slots one token — ONE jitted
+      program per bucket size: the b=1 decode step ``jax.vmap``-ed over
+      the slot axis, so every slot runs exactly the solo per-row math
+      (per-slot ``decode_pos``, per-slot cache row, per-slot
+      ``fold_in(PRNGKey(seed), pos)``) and batch composition never
+      enters a request's tokens — token-exact vs solo dispatch;
+    * ``retire(slot)`` frees a finished slot, so the NEXT queued request
+      joins mid-decode instead of waiting out the stragglers.
+
+    Programs cache in the trainer's jit cache per (bucket, sampling)
+    signature — ``("sess_step", nslots, temperature, top_k)`` extends
+    the ``_decode_fns`` keying — so a request joining a WARM bucket
+    never triggers a recompile (the compile-is-the-latency-cliff
+    constraint, arXiv:1802.04799); only a new bucket size, a new prompt
+    length, or a new sampling signature compiles. A retired slot's
+    stale cache tail is never read: attention masks to [0, pos] and a
+    new occupant's prefill overwrites [0, plen) before any step reads.
+
+    Single-consumer by design (the servd worker thread); NOT
+    thread-safe. The session serves the params the trainer had at
+    creation — after a model reload (``trainer.params`` reassigned)
+    every call raises, because the slot caches hold OLD-weight K/V;
+    the dispatcher closes sessions before reloading.
+    """
+
+    def __init__(self, trainer: Trainer, nslots: int, n_new: int,
+                 temperature: float = 0.0, top_k: int = 0):
+        check(nslots >= 1, "decode_session: nslots must be >= 1")
+        check(n_new >= 1, "decode_session: n_new must be >= 1")
+        self.tr = trainer
+        self.nslots = int(nslots)
+        self.n_new = int(n_new)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self._params_key = trainer.params   # staleness guard (identity)
+        self.l_max = trainer.net_cfg.param.input_shape[2]
+        # the b=1 decode net: ONE row's step; step() vmaps it over slots
+        self._net1 = trainer._seq_net(1, 1)
+        (_, self._cache_keys, self._cache_shapes1, self._cache_dtype) = \
+            trainer._decode_cache_specs(self._net1, 1, self.l_max)
+        self._last = self._net1.cfg.param.num_nodes - 1
+        self._pick = _sample_pick(self.temperature, self.top_k)
+        # slot-major device state. Caches keep the b=1 dim — (nslots, 1,
+        # nkvhead, l_max, dh) — so the vmapped per-row forward sees
+        # exactly the solo (1, nkvhead, l_max, dh) cache shape.
+        self._toks = jnp.zeros((self.nslots, self.l_max), jnp.int32)
+        self._caches = {k: jnp.zeros((self.nslots,) + sh,
+                                     self._cache_dtype)
+                        for k, sh in zip(self._cache_keys,
+                                         self._cache_shapes1)}
+        # per-slot RNG keys and positions live ON DEVICE: the admit
+        # program seeds a slot's row, the step program returns pos+1 —
+        # zero per-iteration H2D on the serving hot path (a retired
+        # slot's device pos keeps advancing harmlessly; admission
+        # resets it). The host mirrors only what scheduling needs.
+        k0 = np.asarray(jax.random.PRNGKey(0))
+        self._keys_dev = jnp.zeros((self.nslots,) + k0.shape, k0.dtype)
+        self._pos_dev = jnp.zeros(self.nslots, jnp.int32)
+        self._active = [False] * self.nslots
+        self._remaining = [0] * self.nslots
+        self.closed = False
+
+    # -- bookkeeping ---------------------------------------------------
+    @property
+    def active_count(self) -> int:
+        return sum(self._active)
+
+    def free_slots(self) -> List[int]:
+        return [s for s in range(self.nslots) if not self._active[s]]
+
+    def _check_live(self) -> None:
+        check(not self.closed, "decode_session: session is closed")
+        if self.tr.params is not self._params_key:
+            # staleness IS the never-serve-again condition the closed
+            # flag encodes: latch it BEFORE raising, so the dispatcher
+            # (which keys session eviction on `closed`) drops this
+            # session from its warm pool instead of re-offering it —
+            # and counts the fault against the backend, not the request
+            self.closed = True
+            check(False,
+                  "decode_session: stale session — the trainer's "
+                  "params changed (model reload); close it and open a "
+                  "new one (the slot caches hold old-weight K/V)")
+
+    # -- programs (trainer jit cache: recompile-watched, keyed) --------
+    def _prefill_fn(self, plen: int):
+        cache_keys, shapes1 = self._cache_keys, self._cache_shapes1
+        cache_dtype, last, pick = self._cache_dtype, self._last, self._pick
+        tr = self.tr
+
+        def build():
+            pre_net = tr._seq_net(1, plen)
+
+            def run(params, toks, key):
+                caches = {k: jnp.zeros((1,) + sh[1:], cache_dtype)
+                          for k, sh in zip(cache_keys, shapes1)}
+                pre = jax.lax.dynamic_slice(toks, (0, 0), (1, plen))
+                values, _ = pre_net.forward(
+                    params,
+                    pre.reshape(1, 1, 1, plen).astype(jnp.float32),
+                    train=False, decode_pos=0, kv_cache=caches)
+                caches = dict(pre_net._last_cache_updates)
+                first = pick(values[last].reshape(1, -1, plen)[:, :, -1],
+                             jax.random.fold_in(key, plen - 1)
+                             ).astype(toks.dtype)
+                toks = jax.lax.dynamic_update_slice(
+                    toks, first[:, None], (0, plen))
+                # params donated-and-returned (see _swap_params): the
+                # decode copy stays runtime-resident across requests
+                return toks, caches, first, params
+            return jax.jit(run, donate_argnums=(0,))
+
+        return tr._watched_jit(
+            ("sess_prefill", plen, self.temperature, self.top_k),
+            "jit.decode_prefill", build)
+
+    def _admit_fn(self):
+        def build():
+            def run(btoks, bcaches, bkeys, bpos, toks1, caches1, key1,
+                    pos1, slot):
+                btoks = jax.lax.dynamic_update_slice(
+                    btoks, toks1, (slot, 0))
+                bc = {k: jax.lax.dynamic_update_slice(
+                    bcaches[k], caches1[k][None].astype(bcaches[k].dtype),
+                    (slot, 0, 0, 0, 0)) for k in bcaches}
+                bkeys = jax.lax.dynamic_update_slice(
+                    bkeys, key1[None].astype(bkeys.dtype), (slot, 0))
+                bpos = jax.lax.dynamic_update_slice(
+                    bpos, pos1[None].astype(bpos.dtype), (slot,))
+                return btoks, bc, bkeys, bpos
+            return jax.jit(run, donate_argnums=(0, 1, 2, 3))
+
+        return self.tr._watched_jit(("sess_admit", self.nslots),
+                                    "jit.decode_admit", build)
+
+    def _step_fn(self):
+        net1, last, pick = self._net1, self._last, self._pick
+
+        def build():
+            def one(params, toks_r, caches_r, key_r, pos_r):
+                # EXACTLY the solo decode step at b=1, with this row's
+                # own position/cache/key — vmapped below over slots
+                tok = jax.lax.dynamic_slice(toks_r, (pos_r,), (1,))
+                data = tok.reshape(1, 1, 1, 1).astype(jnp.float32)
+                values, _ = net1.forward(params, data, train=False,
+                                         decode_pos=pos_r,
+                                         kv_cache=caches_r)
+                caches2 = dict(net1._last_cache_updates)
+                nxt = pick(values[last].reshape(1, -1),
+                           jax.random.fold_in(key_r, pos_r)
+                           )[0].astype(toks_r.dtype)
+                toks2 = jax.lax.dynamic_update_slice(
+                    toks_r, nxt[None], (pos_r + 1,))
+                return toks2, caches2, nxt
+
+            def run(params, toks, caches, keys, pos):
+                # inactive slots are stepped too (fixed shapes — that is
+                # what bucketing is for): their writes land past a DEAD
+                # slot's parked position where nobody reads, and
+                # admission overwrites the row. Every row's pos advances
+                # on device (returned +1) — active rows match the host's
+                # bookkeeping; a dead row's runaway pos is irrelevant
+                # and reset at its next admission.
+                toks2, caches2, nxt = jax.vmap(
+                    one, in_axes=(None, 0, 0, 0, 0))(
+                        params, toks, caches, keys, pos)
+                return toks2, caches2, nxt, pos + 1, params
+            return jax.jit(run, donate_argnums=(0, 1, 2, 4))
+
+        return self.tr._watched_jit(
+            ("sess_step", self.nslots, self.temperature, self.top_k),
+            "jit.decode_step", build)
+
+    # -- scheduling surface -------------------------------------------
+    def prefill(self, slot: int, toks, seed: int) -> Tuple[int, bool]:
+        """Admit one request into free ``slot``: run its b=1 prefill,
+        scatter the KV/token rows into the batch state, block on and
+        return ``(first_token, done)`` — ``done`` when ``n_new == 1``
+        finished the request at admission. Marks ``first_token`` on the
+        active trace context (the serving TTFT boundary, exactly like
+        solo ``generate``)."""
+        self._check_live()
+        check(0 <= slot < self.nslots and not self._active[slot],
+              "decode_session: slot %r is not free" % (slot,))
+        toks = [int(t) for t in toks]
+        plen = len(toks)
+        check(plen >= 1, "decode_session: empty prompt")
+        check(plen + self.n_new <= self.l_max,
+              "decode_session: prompt len %d + n_new %d exceeds the "
+              "net's sequence length %d" % (plen, self.n_new, self.l_max))
+        pre_fn, admit_fn = self._prefill_fn(plen), self._admit_fn()
+        params = self.tr._decode_params_current()
+        t1 = np.zeros((1, self.l_max), np.int32)
+        t1[0, :plen] = toks
+        key = np.asarray(jax.random.PRNGKey(int(seed)))
+        try:
+            t0 = time.perf_counter()
+            toks1, caches1, first, new_params = pre_fn(
+                params, jnp.asarray(t1), jnp.asarray(key))
+            (self._toks, self._caches, self._keys_dev,
+             self._pos_dev) = admit_fn(
+                self._toks, self._caches, self._keys_dev,
+                self._pos_dev, toks1, caches1, jnp.asarray(key),
+                jnp.asarray(plen, jnp.int32),
+                jnp.asarray(slot, jnp.int32))
+            first = int(np.asarray(first)[0])   # blocks: the first token
+        except Exception:
+            # the donated decode copy may be consumed even on failure —
+            # and the admit scatter DONATES the batch toks/caches, so
+            # the session's device state integrity is unknown too:
+            # close it (the dispatcher answers the batch and opens a
+            # fresh session; a broken one must never serve again)
+            self.tr._decode_params = None
+            self.closed = True
+            raise
+        t_first = time.perf_counter()
+        # the TTFT boundary mark the serving worker's trace context
+        # picks up (utils/servd) — same contract as solo generate
+        telemetry.mark("first_token")
+        telemetry.span_event("decode.prefill", t0, t_first - t0)
+        self.tr._decode_params = (self.tr._decode_params[0], new_params)
+        self._active[slot] = True
+        self._remaining[slot] = self.n_new - 1
+        telemetry.count("decode.tokens")
+        return first, self._remaining[slot] == 0
+
+    def step(self) -> List[Tuple[int, int, bool]]:
+        """Advance every active slot one token (one jitted pass over the
+        whole bucket); blocks on the token vector — iteration
+        granularity is the scheduling seam. Returns ``[(slot, token,
+        done), ...]`` for slots that still owed tokens."""
+        self._check_live()
+        if self.active_count == 0:
+            return []
+        step_fn = self._step_fn()
+        params = self.tr._decode_params_current()
+        try:
+            t0 = time.perf_counter()
+            (self._toks, self._caches, nxt, self._pos_dev,
+             new_params) = step_fn(
+                params, self._toks, self._caches, self._keys_dev,
+                self._pos_dev)
+            nxt = np.asarray(nxt)               # blocks: this iteration
+        except Exception:
+            self.tr._decode_params = None
+            self.closed = True      # batch state integrity unknown
+            raise
+        telemetry.span_event("decode.step", t0,
+                             time.perf_counter() - t0,
+                             slots=self.active_count)
+        self.tr._decode_params = (self.tr._decode_params[0], new_params)
+        out = []
+        for s in range(self.nslots):
+            if not self._active[s] or self._remaining[s] <= 0:
+                continue
+            self._remaining[s] -= 1
+            out.append((s, int(nxt[s]), self._remaining[s] == 0))
+        telemetry.count("decode.tokens", len(out))
+        return out
+
+    def retire(self, slot: int) -> None:
+        """Free a finished (or abandoned) slot — the next queued request
+        joins mid-decode here. Device state is left in place: a dead
+        slot's rows are never read, and admission overwrites them."""
+        if 0 <= slot < self.nslots:
+            self._active[slot] = False
+            self._remaining[slot] = 0
+
+    def close(self) -> None:
+        """Release the device state (the per-slot caches are the
+        session's HBM footprint). Idempotent."""
+        self.closed = True
+        self._toks = None
+        self._caches = None
+        self._keys_dev = None
+        self._pos_dev = None
 
 
 def create_net(net_type: int = 0) -> Trainer:

@@ -30,10 +30,15 @@ probe re-admits it and resets the backoff.
 
 Dispatch is least-loaded with power-of-two-choices: two eligible
 replicas are sampled, the one with the lower load — probed queue_depth
-+ in_flight plus the router's own live outstanding count — wins (ties
-go to the lower replica index, so behavior under zero load is
-deterministic). Only ``up`` replicas not held out by a rolling reload
-are eligible.
++ in_flight plus the router's own live outstanding count, MINUS the
+replica's probed free decode slots — wins (ties go to the lower replica
+index, so behavior under zero load is deterministic). ``free_slots`` is
+the continuous-batching capacity signal a batching replica reports in
+``ADMIN stats`` (bucket capacity − active sequences): a replica that
+can batch the request into a running decode pass beats one that would
+queue it. Old replicas simply omit the field (treated as 0) — the
+pre-batching ordering is unchanged, backward compatible by absence.
+Only ``up`` replicas not held out by a rolling reload are eligible.
 
 **Retry-on-shed, exactly-once preserved.** The third token of a servd
 error line is a machine-readable detail token (utils/servd.py), and the
@@ -229,7 +234,7 @@ class Replica:
 
     __slots__ = ("name", "host", "port", "status_port", "state",
                  "detail", "hold", "queue_depth", "in_flight",
-                 "outstanding", "probe_fails", "ejections",
+                 "free_slots", "outstanding", "probe_fails", "ejections",
                  "next_probe_at", "last_probe", "no_trace", "trace_ok")
 
     def __init__(self, host: str, port: int, status_port: int):
@@ -245,6 +250,10 @@ class Replica:
         self.hold = False            # rolling reload: out of rotation
         self.queue_depth = 0         # last probed gauges (load signal)
         self.in_flight = 0
+        self.free_slots = 0          # continuous-batching capacity: a
+        #                              batching replica reports free
+        #                              decode slots; old replicas omit
+        #                              the field (0 = no bonus)
         self.outstanding = 0         # router-side live request count
         self.probe_fails = 0
         self.ejections = 0           # backoff exponent while dead
@@ -268,6 +277,7 @@ class Replica:
                 "detail": self.detail, "hold": self.hold,
                 "queue_depth": self.queue_depth,
                 "in_flight": self.in_flight,
+                "free_slots": self.free_slots,
                 "outstanding": self.outstanding,
                 "ejections": self.ejections,
                 "probe_fails": self.probe_fails,
@@ -502,6 +512,9 @@ class Router:
                     r.queue_depth = st.get("queue_depth",
                                            r.queue_depth)
                     r.in_flight = st.get("in_flight", r.in_flight)
+                    # absent on pre-batching replicas: reset to 0, not
+                    # last-known — the field IS the capability signal
+                    r.free_slots = st.get("free_slots", 0)
             self._mark(r, UP, "ready")
         else:
             lower = body.lower()
@@ -535,7 +548,12 @@ class Router:
 
     # -- dispatch ------------------------------------------------------
     def _load(self, r: Replica) -> float:
-        return r.queue_depth + r.in_flight + r.outstanding
+        # free decode slots SUBTRACT: a request a replica can batch
+        # into its running decode pass costs no queueing there — the
+        # power-of-two pick prefers the replica that can batch it in
+        # (may go negative: idle batching capacity beats idle solo)
+        return (r.queue_depth + r.in_flight + r.outstanding
+                - r.free_slots)
 
     def _pick(self, exclude) -> Tuple[Optional[Replica], List[dict]]:
         """Power-of-two-choices among eligible replicas (up, not held,
@@ -567,6 +585,7 @@ class Router:
             cands = [{"replica": x.name, "load": self._load(x),
                       "queue_depth": x.queue_depth,
                       "in_flight": x.in_flight,
+                      "free_slots": x.free_slots,
                       "outstanding": x.outstanding} for x in sample]
             r.outstanding += 1
             return r, cands

@@ -119,6 +119,47 @@ statusd ``/healthz`` / ``/livez``; the accept and worker threads beat the
 ``serve.accept`` / ``serve.worker`` watchdog channels (paused across idle
 periods so an empty queue is not a hang).
 
+**Continuous batching** (doc/serving.md "Continuous batching"): pass a
+``slot_backend`` and the worker becomes an iteration-granularity
+batching dispatcher instead of the one-request-per-pass loop. The slot
+backend owns bucketed decode sessions (``buckets`` = slot counts, e.g.
+``1,2,4,8``; ``session(bucket)`` opens one; a session exposes
+``prefill(slot, toks, seq) -> (first_token, done)``, ``step() ->
+[(slot, token, done), ...]``, ``retire(slot)`` and optionally
+``close()`` / the backend ``admits(toks) -> error-detail-or-None``
+compatibility check) — ``Trainer.decode_session`` is the real one, the
+chaos tests inject jax-free fakes (tests/faultinject.py). Scheduling:
+
+* **coalesce** — up to ``batch_max`` queued requests are drained within
+  a ``batch_window_ms`` gather window and admitted into the smallest
+  bucket that fits; the window applies only when STARTING a batch —
+  requests already decoding never stall on it.
+* **iteration granularity** — each loop turn advances every active slot
+  one token; a finished sequence retires its slot and the next queued
+  request joins MID-DECODE (its ``queue_wait`` ends at slot admission).
+* **per-iteration deadlines** — an expired sequence retires with ``ERR
+  deadline`` between iterations; the others keep decoding.
+* **contracts kept** — exactly-once ``_finish`` per request (drain
+  mid-batch answers every in-flight slot), breaker semantics (a
+  prefill/step failure that CLOSED the session — the device-fault
+  signal of the session contract — counts ONE breaker failure however
+  many requests die of it, and a step failure fails the whole batch
+  ``ERR backend``; a prefill that raised with the session left OPEN
+  never touched device state — pre-dispatch validation — and is a
+  deterministic request defect the breaker ignores), honest
+  per-request phases (prefill
+  is the request's own admission prefill; decode is ITS first->last
+  token wall; ``occupancy_at_dispatch`` rides the flight record), and
+  hot reload deferred until the in-flight batch finishes (the slot
+  caches hold the old model's K/V; sessions are closed, then reloaded).
+* **occupancy is measured, not asserted** — every iteration feeds
+  ``serve.batch_occupancy`` (gauge: last iteration) plus the honest
+  weighted-mean pair ``serve.batch_iterations`` /
+  ``serve.batch_slot_iterations`` (mean occupancy = slots/iterations —
+  a last-write gauge scraped between batches lies), and ``ADMIN stats``
+  reports ``free_slots`` (bucket capacity − active) so the fleet router
+  can prefer the replica that can batch a request in.
+
 Deliberately jax-free (like health.py and statusd.py): the backend is an
 injected callable, so ``python -m cxxnet_tpu.utils.servd --selftest``
 proves the whole admission/deadline/breaker/drain machinery over a real
@@ -312,6 +353,25 @@ class _Request:
         self.answered = False
 
 
+class _SlotState:
+    """Per-slot request state on the batching dispatcher: the admitted
+    request, its trace context (first_token mark, recompiles), its
+    phase timestamps (queue_wait ended at slot admission), the tokens
+    produced so far, and the batch occupancy at its admission."""
+
+    __slots__ = ("req", "tc", "queue_wait", "t_pop", "t_back", "toks",
+                 "occ")
+
+    def __init__(self, req, tc, queue_wait, t_pop, t_back, toks, occ):
+        self.req = req
+        self.tc = tc
+        self.queue_wait = queue_wait
+        self.t_pop = t_pop
+        self.t_back = t_back
+        self.toks = toks
+        self.occ = occ
+
+
 # stat key -> telemetry counter (serve.requests keeps PR 4's name for the
 # successfully-served count so existing dashboards/reports keep working)
 _COUNTERS = {
@@ -355,8 +415,23 @@ class ServeFrontend:
                  reload_fn: Optional[Callable] = None,
                  client_timeout: float = 10.0,
                  stall_after_s: float = 120.0,
-                 slo=None, flight_cap: int = 256):
+                 slo=None, flight_cap: int = 256,
+                 slot_backend=None, batch_max: int = 0,
+                 batch_window_ms: float = 0.0):
         self.backend = backend
+        # continuous batching (module docstring): a slot backend makes
+        # the worker an iteration-granularity batching dispatcher;
+        # batch_max bounds the coalesced batch (0 = the largest bucket),
+        # batch_window_ms is the gather window for a FRESH batch
+        self.slot_backend = slot_backend
+        self.batch_max = int(batch_max)
+        self.batch_window_s = float(batch_window_ms) / 1e3
+        self._buckets = []
+        if slot_backend is not None:
+            self._buckets = sorted(
+                {max(1, int(b))
+                 for b in (getattr(slot_backend, "buckets", None)
+                           or (1,))})
         # per-request observability: the flight ring every dequeued
         # request lands in, and the (optional) SLO error-budget account
         # (statusd.SLOTracker) fed per completed request
@@ -393,7 +468,24 @@ class ServeFrontend:
         #                              handler without taking any lock
         self._inflight = 0
         self._inflight_req: Optional[_Request] = None
+        # batched path: every popped-but-unanswered request (drain's
+        # give-up list; _inflight counts these). Mutated by the worker
+        # and read by drain/stats under _cond
+        self._inflight_reqs: List[_Request] = []
         self._inflight_since: Optional[float] = None
+        # batching load/occupancy account: free decode slots (ADMIN
+        # stats -> the router's load signal) and the weighted-mean
+        # occupancy pair (slot-iterations / iterations). Capacity is
+        # known at construction so a stats probe racing worker startup
+        # still reads the true idle capacity.
+        self._batch_capacity = 0
+        if self._buckets:
+            self._batch_capacity = (min(self._buckets[-1], self.batch_max)
+                                    if self.batch_max > 0
+                                    else self._buckets[-1])
+        self._batch_free = self._batch_capacity
+        self._occ_iters = 0
+        self._occ_slots = 0
         self._seq = 0
         self._worker_thread: Optional[threading.Thread] = None
         self._accept_thread: Optional[threading.Thread] = None
@@ -417,8 +509,10 @@ class ServeFrontend:
         for name in ("serve.request", "serve.queue_wait", "serve.ttft",
                      "serve.decode_per_token"):
             telemetry.declare_hist(name)
+        target = (self._worker_run_batched if self.slot_backend is not None
+                  else self._worker_run)
         self._worker_thread = threading.Thread(
-            target=self._worker_run, name="cxn-servd-worker", daemon=True)
+            target=target, name="cxn-servd-worker", daemon=True)
         self._worker_thread.start()
         return self
 
@@ -446,6 +540,15 @@ class ServeFrontend:
     def stats(self) -> dict:
         with self._slock:
             return dict(self._stats)
+
+    def mean_occupancy(self) -> Optional[float]:
+        """Weighted-mean batch occupancy over decode iterations (None
+        before the first) — the honest form of ``serve.batch_occupancy``
+        (a last-write gauge scraped between batches lies). Solo dispatch
+        counts each request as one iteration at occupancy 1."""
+        if not self._occ_iters:
+            return None
+        return self._occ_slots / float(self._occ_iters)
 
     # -- health (statusd probes) ---------------------------------------
     def _stalled_for(self) -> float:
@@ -545,7 +648,8 @@ class ServeFrontend:
     def _finish_observed(self, req: _Request, text: str, counter: str,
                          outcome: str, tc, queue_wait: float,
                          t_pop: float, t_back: float, t_end: float,
-                         wall: float, ntok: int) -> None:
+                         wall: float, ntok: int,
+                         occupancy: Optional[int] = None) -> None:
         """Terminal step for every dequeued request: claim the
         exactly-once answer slot, publish the request's telemetry
         (flight record, SLO account, TTFT series), and only THEN send
@@ -558,7 +662,7 @@ class ServeFrontend:
         won = self._claim(req)
         self._observe_request(req, tc, outcome if won else "abandoned",
                               queue_wait, t_pop, t_back, t_end, wall,
-                              ntok)
+                              ntok, occupancy=occupancy)
         if won:
             self._bump(counter)
             self._send(req.reply, text)
@@ -646,6 +750,13 @@ class ServeFrontend:
                         live = dict(self.stats(),
                                     queue_depth=len(self._q),
                                     in_flight=self._inflight)
+                        if self.slot_backend is not None:
+                            # free decode slots (bucket capacity −
+                            # active): the router's prefer-the-replica-
+                            # that-can-batch-it-in signal. Old replicas
+                            # simply omit the field — backward
+                            # compatible by absence.
+                            live["free_slots"] = self._batch_free
                         text = "OK " + " ".join(
                             "%s=%d" % kv for kv in sorted(live.items()))
                     else:
@@ -858,9 +969,11 @@ class ServeFrontend:
             return
         req.seq, self._seq = self._seq, self._seq + 1
         telemetry.gauge("serve.in_flight", 1)
-        # occupancy of the decode pass being dispatched: 1 sequence per
-        # pass today — the series whose value IS the batching win later
-        telemetry.gauge("serve.batch_occupancy", 1)
+        # occupancy accounting: solo dispatch is one whole-request pass
+        # at occupancy 1 — the honest weighted-mean pair (iterations /
+        # slot-iterations) reads 1.0 here; the batched dispatcher feeds
+        # the same series per decode ITERATION
+        self._observe_occupancy(1)
         # the backend call is legitimately silent time on the worker
         # channel — a first-request decode-cache compile (or the
         # recompile after a hot reload) can far outlast any sane
@@ -910,9 +1023,386 @@ class ServeFrontend:
                               queue_wait, t_pop, t_back, t_end, wall,
                               len(outs))
 
+    # -- batching dispatcher (slot_backend path) -----------------------
+    def _observe_occupancy(self, n: int) -> None:
+        """One decode pass/iteration with ``n`` sequences aboard: the
+        last-write gauge (a glance value) plus the honest weighted-mean
+        counter pair — mean occupancy = slot_iterations / iterations,
+        exact however the scrape interleaves with batches."""
+        self._occ_iters += 1
+        self._occ_slots += n
+        telemetry.gauge("serve.batch_occupancy", n)
+        telemetry.count("serve.batch_iterations")
+        telemetry.count("serve.batch_slot_iterations", n)
+
+    def _publish_batch_state(self, sess, active) -> None:
+        """Refresh the load signals after any slot change: the live
+        in-flight gauge and the free-slot count ``ADMIN stats`` reports
+        (idle = full capacity; an active session = its free slots)."""
+        cap = self._batch_capacity
+        free = cap if not active else \
+            max(0, min(cap, sess.nslots) - len(active))
+        with self._cond:
+            self._batch_free = free
+        telemetry.gauge("serve.in_flight", len(active))
+
+    def _drop_inflight(self, req: _Request) -> None:
+        """A popped request got its final answer: leave drain's
+        give-up list (the popped-but-unanswered account)."""
+        with self._cond:
+            try:
+                self._inflight_reqs.remove(req)
+            except ValueError:
+                pass
+            self._inflight = len(self._inflight_reqs)
+
+    def _gather(self, limit: int, fresh: bool) -> List[_Request]:
+        """Pop up to ``limit`` queued requests for admission. A FRESH
+        batch (no active slots) waits up to the gather window for more
+        to coalesce; mid-decode joins take only what is already queued
+        — sequences mid-flight must never stall on the window. Popped
+        requests enter ``_inflight_reqs`` under the SAME lock as the
+        pop, so drain's accounting never sees a request in neither the
+        queue nor the in-flight set."""
+        out: List[_Request] = []
+        if limit <= 0:
+            return out
+        deadline = None
+        with self._cond:
+            while True:
+                while self._q and len(out) < limit:
+                    req = self._q.popleft()
+                    out.append(req)
+                    self._inflight_reqs.append(req)
+                if out:
+                    self._inflight = len(self._inflight_reqs)
+                    telemetry.gauge("serve.queue_depth", len(self._q))
+                if len(out) >= limit or not fresh or not out \
+                        or self.batch_window_s <= 0 \
+                        or self._draining or self._stop:
+                    break
+                if deadline is None:
+                    deadline = time.monotonic() + self.batch_window_s
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    break
+                self._cond.wait(min(rem, 0.05))
+        return out
+
+    def _finish_popped(self, req: _Request, text: str, counter: str,
+                       outcome: str, tc, queue_wait: float, t_pop: float,
+                       t_back: float, ntok: int,
+                       occupancy: Optional[int] = None) -> None:
+        """Terminal answer for a popped request on the batched path —
+        the observed finish plus the in-flight bookkeeping drop."""
+        t_end = time.perf_counter()
+        wall = time.monotonic() - req.t_arrival
+        self._finish_observed(req, text, counter, outcome, tc,
+                              queue_wait, t_pop, t_back, t_end, wall,
+                              ntok, occupancy=occupancy)
+        self._drop_inflight(req)
+
+    def _fail_unadmitted(self, reqs, msg: str) -> None:
+        """Answer popped-but-never-admitted requests ``ERR backend``
+        (they never reached a slot: no phases, no dispatch) — the
+        session-creation-failure and closed-session-leftover paths."""
+        t_pop = time.perf_counter()
+        now = time.monotonic()
+        for req in reqs:
+            self._finish_popped(req, msg, "errors", "backend_error",
+                                None, now - req.t_arrival, t_pop,
+                                t_pop, 0)
+
+    def _admit_one(self, sb, sess, active, req: _Request):
+        """Admit one popped request into a free slot of ``sess`` (its
+        ``queue_wait`` ends HERE — slot admission, not queue pop): the
+        solo dispatch-time gates first (expired deadline, breaker,
+        backend compatibility), then the request's own b=1 prefill runs
+        under its trace context — the per-request prefill phase and the
+        first_token TTFT mark are per-slot, never per-batch. Returns
+        the slot the request now occupies, or None (rejected, failed,
+        or already complete — an ``n_new == 1`` request finishes at
+        prefill and records its admission-order occupancy: it never
+        shares a decode pass, so the batch-wide stamp does not apply)."""
+        t_pop = time.perf_counter()
+        now = time.monotonic()
+        queue_wait = now - req.t_arrival
+        telemetry.hist("serve.queue_wait", queue_wait)
+        if req.deadline is not None and now > req.deadline:
+            self._finish_popped(
+                req, "ERR deadline expired %.0fms ago"
+                % (1e3 * (now - req.deadline)), "deadline", "deadline",
+                None, queue_wait, t_pop, t_pop, 0)
+            return
+        if not self.breaker.allow():
+            self._finish_popped(
+                req, "ERR busy breaker open (circuit)", "shed", "shed",
+                None, queue_wait, t_pop, t_pop, 0)
+            return
+        admits = getattr(sb, "admits", None)
+        detail = admits(req.toks) if admits is not None else None
+        if detail:
+            # a deterministic request defect (e.g. prompt too long for
+            # the model): answered as a backend-class error (relayed by
+            # the router, never retried) but NOT fed to the breaker —
+            # the backend is healthy, the request is not
+            self._finish_popped(
+                req, "ERR backend " + " ".join(str(detail).split())[:200],
+                "errors", "backend_error", None, queue_wait, t_pop,
+                t_pop, 0)
+            return
+        slot = sess.free_slots()[0]
+        req.seq, self._seq = self._seq, self._seq + 1
+        tc = telemetry.trace_context(req.id)
+        self._inflight_since = time.monotonic()
+        health.pause("serve.worker")     # prefill may compile
+        t_back = t_pop
+        try:
+            with tc:
+                t_back = time.perf_counter()
+                first, done = sess.prefill(slot, req.toks, req.seq)
+        except Exception as e:
+            health.beat("serve.worker")
+            self._inflight_since = None
+            # classify by the session's own verdict: a DEVICE-section
+            # failure CLOSES the session (the DecodeSession contract) —
+            # that is a backend fault and feeds the breaker; a prefill
+            # that raised WITHOUT closing never touched device state
+            # (pre-dispatch validation, e.g. a prompt too long for a
+            # backend with no admits() hook) — a deterministic request
+            # defect that must not poison the breaker, exactly like the
+            # admits() rejection above
+            if getattr(sess, "closed", False):
+                self.breaker.failure()
+                telemetry.count("serve.backend_errors")
+                telemetry.event({"ev": "serve_backend_error",
+                                 "error": repr(e)[:200], "req": req.id})
+            self._finish_popped(
+                req, "ERR backend " + " ".join(repr(e).split())[:200],
+                "errors", "backend_error", tc, queue_wait, t_pop,
+                t_back, 0)
+            return None
+        health.beat("serve.worker")
+        self._inflight_since = None
+        st = _SlotState(req, tc, queue_wait, t_pop, t_back,
+                        [int(first)], len(active) + 1)
+        active[slot] = st
+        if done:
+            self._complete_slot(sess, active, slot)
+            return None
+        return slot
+
+    def _complete_slot(self, sess, active, slot) -> None:
+        """A sequence produced its last token: answer, retire the slot
+        (the next queued request joins here mid-decode), account."""
+        st = active.pop(slot)
+        sess.retire(slot)
+        t_end = time.perf_counter()
+        # the request's backend time (prefill -> its own last token)
+        # feeds the serve.request histogram like the solo span does
+        telemetry.hist("serve.request", max(0.0, t_end - st.t_back))
+        self.breaker.success()
+        text = " ".join(str(t) for t in st.toks)
+        self._finish_popped(st.req, text, "served", "served", st.tc,
+                            st.queue_wait, st.t_pop, st.t_back,
+                            len(st.toks), occupancy=st.occ)
+
+    def _retire_expired(self, sess, active) -> None:
+        """Per-ITERATION deadline enforcement: an expired sequence
+        retires with ``ERR deadline`` between iterations — the others
+        keep decoding. Its real prefill/decode phases are recorded
+        (the backend did burn that time)."""
+        now = time.monotonic()
+        for slot, st in list(active.items()):
+            req = st.req
+            if req.deadline is not None and now > req.deadline:
+                del active[slot]
+                sess.retire(slot)
+                self._finish_popped(
+                    req, "ERR deadline expired %.0fms ago (mid-decode)"
+                    % (1e3 * (now - req.deadline)), "deadline",
+                    "deadline", st.tc, st.queue_wait, st.t_pop,
+                    st.t_back, len(st.toks), occupancy=st.occ)
+
+    def _fail_batch(self, sess, active, exc: Exception,
+                    count_failure: bool = True) -> None:
+        """A decode STEP failed: the whole batch is lost — every active
+        sequence is answered ``ERR backend`` (exactly once), the
+        breaker counts ONE backend failure, the session is dropped.
+        ``count_failure=False`` when the underlying fault was already
+        counted (a failed PREFILL closed the session: _admit_one's
+        except path counted it — the batch dies of that same fault,
+        and one fault must cost the breaker AND the backend-error
+        series exactly one count; the event still fires, naming the
+        requests the fault took down)."""
+        if count_failure:
+            self.breaker.failure()
+            telemetry.count("serve.backend_errors")
+        telemetry.event({"ev": "serve_backend_error",
+                         "error": repr(exc)[:200],
+                         "reqs": [st.req.id for st in active.values()]})
+        msg = "ERR backend " + " ".join(repr(exc).split())[:200]
+        for slot, st in list(active.items()):
+            sess.retire(slot)
+            self._finish_popped(st.req, msg, "errors", "backend_error",
+                                st.tc, st.queue_wait, st.t_pop,
+                                st.t_back, len(st.toks),
+                                occupancy=st.occ)
+        active.clear()
+
+    def _worker_run_batched(self) -> None:
+        """The iteration-granularity scheduling loop (module docstring
+        "Continuous batching"): coalesce -> admit into slots ->
+        per-iteration deadlines -> step every active slot one token ->
+        retire finished sequences -> repeat, admitting queued requests
+        into freed slots MID-DECODE. Sessions are pooled per bucket and
+        stay warm (their programs cache per bucket signature — a
+        request joining a warm bucket never recompiles); a model reload
+        waits for the in-flight batch, then closes every session."""
+        sb = self.slot_backend
+        buckets = self._buckets
+        cap = self._batch_capacity
+        sessions = {}                  # bucket -> warm session
+        sess = None                    # current session
+        active = {}                    # slot -> _SlotState
+
+        def close_all():
+            for s in sessions.values():
+                try:
+                    close = getattr(s, "close", None)
+                    if close is not None:
+                        close()
+                except Exception:
+                    pass
+            sessions.clear()
+
+        while True:
+            with self._cond:
+                while not self._q and not active and not self._stop \
+                        and not self._reload_flag:
+                    health.pause("serve.worker")
+                    self._cond.wait(0.25)
+                if self._stop and not active:
+                    break
+            health.beat("serve.worker")
+            if self._reload_flag and not active:
+                # reload only BETWEEN batches: the slot caches hold the
+                # old model's K/V — close the warm sessions (their
+                # programs die with the old trainer), swap, resume
+                health.pause("serve.worker")
+                close_all()
+                sess = None
+                self._do_reload()
+                health.beat("serve.worker")
+                continue
+            # --- admit: coalesce queued requests into free slots ---
+            if not self._reload_flag:
+                if not active:
+                    batch = self._gather(cap, fresh=True)
+                    if batch:
+                        b = next((x for x in buckets
+                                  if x >= len(batch)), buckets[-1])
+                        sess = sessions.get(b)
+                        if sess is None:
+                            try:
+                                sess = sessions[b] = sb.session(b)
+                            except Exception as e:
+                                # the batch never reached a slot: every
+                                # drained request is answered, the
+                                # breaker counts one failure
+                                self.breaker.failure()
+                                telemetry.count("serve.backend_errors")
+                                telemetry.event(
+                                    {"ev": "serve_backend_error",
+                                     "error": repr(e)[:200]})
+                                self._fail_unadmitted(
+                                    batch, "ERR backend "
+                                    + " ".join(repr(e).split())[:200])
+                                batch = []
+                                sess = None
+                else:
+                    free = min(len(sess.free_slots()),
+                               cap - len(active))
+                    batch = self._gather(free, fresh=False) \
+                        if free > 0 else []
+                leftovers = []
+                new_slots = []
+                for i, req in enumerate(batch):
+                    slot = self._admit_one(sb, sess, active, req)
+                    if slot is not None:
+                        new_slots.append(slot)
+                    if getattr(sess, "closed", False):
+                        # a failed prefill closed the session: stop
+                        # admitting — every further prefill would raise
+                        # "closed" and spuriously count ANOTHER breaker
+                        # failure for the same single fault (one fault,
+                        # one count: _admit_one's except path had it)
+                        leftovers = batch[i + 1:]
+                        break
+                # every request admitted THIS turn shares its first
+                # decode pass with the whole turn's admissions: stamp
+                # the final occupancy on all of them — the sequential
+                # per-admit stamp would record 1, 2, 3, 4 for a fully
+                # coalesced 4-request batch and /requestz would read
+                # "not coalesced" for its first member
+                for s in new_slots:
+                    if s in active:
+                        active[s].occ = len(active)
+                if sess is not None and getattr(sess, "closed", False):
+                    # the session's device state integrity is unknown:
+                    # answer everything that died of the one prefill
+                    # fault (no further breaker counts) and evict it
+                    # from the warm pool — a broken session left pooled
+                    # would poison every later batch
+                    self._fail_unadmitted(
+                        leftovers, "ERR backend decode session closed "
+                        "by a failed prefill")
+                    if active:
+                        self._fail_batch(
+                            sess, active, RuntimeError(
+                                "decode session closed by a failed "
+                                "prefill"), count_failure=False)
+                    sessions = {b: s for b, s in sessions.items()
+                                if s is not sess}
+                    sess = None
+            # --- per-iteration deadline retirement ---
+            if active:
+                self._retire_expired(sess, active)
+            self._publish_batch_state(sess, active)
+            if not active:
+                continue
+            # --- one decode iteration: every active slot, one token ---
+            self._observe_occupancy(len(active))
+            self._inflight_since = time.monotonic()
+            health.pause("serve.worker")   # a fresh bucket may compile
+            try:
+                res = sess.step()
+            except Exception as e:
+                health.beat("serve.worker")
+                self._inflight_since = None
+                self._fail_batch(sess, active, e)
+                # the session's state is suspect: drop it from the pool
+                sessions = {b: s for b, s in sessions.items()
+                            if s is not sess}
+                sess = None
+                self._publish_batch_state(sess, active)
+                continue
+            health.beat("serve.worker")
+            self._inflight_since = None
+            for slot, tok, done in res:
+                st = active.get(slot)
+                if st is None:
+                    continue           # retired this iteration
+                st.toks.append(int(tok))
+                if done:
+                    self._complete_slot(sess, active, slot)
+            self._publish_batch_state(sess, active)
+        close_all()
+
     def _observe_request(self, req: _Request, tc, outcome: str,
                          queue_wait: float, t_pop: float, t_back: float,
-                         t_end: float, wall: float, ntok: int) -> None:
+                         t_end: float, wall: float, ntok: int,
+                         occupancy: Optional[int] = None) -> None:
         """Phase-attribute one dequeued request and publish everything
         downstream reads: the TTFT / per-token histograms and
         tokens-per-second gauge, the flight record, the
@@ -926,8 +1416,14 @@ class ServeFrontend:
         prefill = decode = 0.0
         ttft = None
         dispatched = outcome in ("served", "backend_error", "abandoned")
+        ft = tc.marks.get("first_token") if tc is not None else None
+        if outcome == "deadline" and ft is not None:
+            # batched path: a sequence retired MID-DECODE by its
+            # deadline really did prefill and decode — record the
+            # phases (the never-dispatched deadline keeps tc=None, so
+            # the solo expired-in-queue case is unchanged)
+            dispatched = True
         if dispatched:
-            ft = tc.marks.get("first_token") if tc is not None else None
             if ft is not None and t_back <= ft <= t_end:
                 prefill = ft - t_back
                 decode = t_end - ft
@@ -967,6 +1463,11 @@ class ServeFrontend:
                           "prefill": round(prefill, 6),
                           "decode": round(decode, 6)},
                "recompiles": list(tc.compiles) if tc is not None else []}
+        if occupancy is not None:
+            # sequences sharing the decode pass when this request was
+            # admitted to its slot (itself included): /trace and
+            # /requestz show the coalescing, request by request
+            rec["occupancy_at_dispatch"] = int(occupancy)
         if tps is not None:
             # the decode-step roofline bound for THIS token count (the
             # performance ledger's card, null until one is ready):
@@ -1182,13 +1683,16 @@ class ServeFrontend:
             self._worker_thread.join(
                 timeout=max(0.5, deadline - time.monotonic() + 1.0))
             if self._worker_thread.is_alive():
-                # the backend outlived even the post-budget grace: the
-                # in-flight request is answered HERE, once — if the
-                # wedged backend ever returns, the worker's _finish
-                # loses the claim and is a no-op
+                # the backend outlived even the post-budget grace: every
+                # in-flight request (ONE on the solo path, the whole
+                # popped batch on the batching path) is answered HERE,
+                # once — if the wedged backend ever returns, the
+                # worker's _finish loses the claim and is a no-op
                 with self._cond:
-                    req = self._inflight_req
-                if req is not None:
+                    reqs = list(self._inflight_reqs)
+                    if self._inflight_req is not None:
+                        reqs.append(self._inflight_req)
+                for req in reqs:
                     self._finish(req, "ERR draining backend exceeded "
                                  "the drain budget", "errors")
         # every accepted request is answered by now, but TCP answers are
@@ -1412,6 +1916,16 @@ def _stub_main(argv: List[str]) -> int:
                        breaker_fails=int(flag("--breaker-fails", 5)),
                        stall_after_s=flag("--stall-s", 120.0),
                        reload_fn=reload_fn)
+    # the wedge handlers install BEFORE the port banner: the banner is
+    # the chaos harness's spawn synchronization point, and a SIGUSR1
+    # sent right after it must wedge the backend — not kill the process
+    # via the default action (a real race on fast machines: the fleet
+    # wedge tests flaked exactly there)
+    for signum, on in ((getattr(signal, "SIGUSR1", None), True),
+                       (getattr(signal, "SIGUSR2", None), False)):
+        if signum is not None:
+            signal.signal(signum,
+                          lambda s, f, _on=on: wedge.update(on=_on))
     fe.start()
     port = fe.listen(int(flag("--port", 0)))
     print("servd-stub: listening on port %d" % port, flush=True)
@@ -1426,11 +1940,6 @@ def _stub_main(argv: List[str]) -> int:
                                liveness=True)
         statusd.set_flight_recorder(fe.flight)
         print("servd-stub: status on port %d" % srv.port, flush=True)
-    for signum, on in ((getattr(signal, "SIGUSR1", None), True),
-                       (getattr(signal, "SIGUSR2", None), False)):
-        if signum is not None:
-            signal.signal(signum,
-                          lambda s, f, _on=on: wedge.update(on=_on))
     with ckpt.PreemptionGuard(enabled=True) as guard:
         while not guard.requested:
             time.sleep(0.05)

@@ -1193,6 +1193,20 @@ class StatusServer:
                     _ms(None if latest.get("total_s") is None
                         else latest["total_s"] * 1e3)))])
 
+        # continuous-batching occupancy: the honest weighted mean over
+        # decode iterations (serve.batch_slot_iterations /
+        # serve.batch_iterations — a last-write gauge scraped between
+        # batches lies); 1.00 means every pass served one sequence
+        iters = snap["counters"].get("serve.batch_iterations", 0)
+        if iters:
+            slots = snap["counters"].get("serve.batch_slot_iterations",
+                                         0)
+            table("batching", [
+                ("mean occupancy", "%.2f sequences/pass over %d decode "
+                 "iterations" % (slots / float(iters), iters)),
+                ("last pass", snap["gauges"].get(
+                    "serve.batch_occupancy", "n/a"))])
+
         if self.perf is not None:
             psnap = self.perf.snapshot()
             hbm = psnap.get("hbm") or {}

@@ -270,6 +270,139 @@ def phased_backend(base, prefill_s: float, per_token_s: float):
     return backend
 
 
+def slot_backend(buckets=(1, 2, 4), n_new: int = 4,
+                 prefill_s: float = 0.0, per_token_s: float = 0.0,
+                 long_for=None, long_n_new: int = 0,
+                 step_delays=None, explode_on_iterations=(),
+                 explode_prefill_for=(), reject_for=(),
+                 max_prompt: int = 0):
+    """Jax-free slot backend for servd's batching dispatcher — the fake
+    twin of ``Trainer.decode_session`` (same duck interface: ``buckets``,
+    ``session(bucket)``; a session has ``prefill``/``step``/``retire``/
+    ``free_slots``/``close``). Deterministic token math so tests verify
+    responses exactly: a request whose first token is ``t`` answers
+    ``t+1, t+2, ..., t+n`` (``n = n_new``, or ``long_n_new`` when ``t``
+    is in ``long_for`` — the STRAGGLER knob: wedge ONE sequence in a
+    batch with a long tail and prove the others retire on time and new
+    requests join mid-decode).
+
+    Phase emulation (the TTFT split, like ``phased_backend``): prefill
+    sleeps ``prefill_s`` then marks ``first_token``; each iteration
+    sleeps ``per_token_s`` plus any active slot's ``step_delays`` entry
+    (keyed by first token — the per-slot token-delay chaos knob).
+    ``explode_on_iterations`` makes those (1-based, per-session)
+    iterations raise — the whole-batch backend-failure case — and
+    ``explode_prefill_for`` (first tokens) makes a request's PREFILL
+    raise and CLOSE the session, mirroring the DecodeSession contract
+    (a failed prefill's device state integrity is unknown), while
+    ``reject_for`` raises WITHOUT closing — the pre-dispatch
+    validation failure the breaker must ignore.
+    ``max_prompt > 0`` arms the ``admits`` compatibility check.
+
+    Every session appends to the shared ``backend.journal``:
+    ``("admit", slot, iteration, seq)`` / ``("retire", slot,
+    iteration)`` — the mid-decode-join assertions read it.
+    """
+    import time
+
+    from cxxnet_tpu.utils import telemetry
+
+    class _Session:
+        def __init__(self, owner, nslots):
+            self.owner = owner
+            self.nslots = int(nslots)
+            self.iteration = 0
+            self.closed = False  # the DecodeSession contract: a failed
+            #                      prefill/step closes the session (its
+            #                      device state integrity is unknown)
+            self._live = {}     # slot -> {"next", "remaining", "first"}
+
+        def free_slots(self):
+            return [s for s in range(self.nslots) if s not in self._live]
+
+        def prefill(self, slot, toks, seq):
+            ow = self.owner
+            if self.closed:
+                raise RuntimeError("slot session is closed")
+            t0 = int(toks[0])
+            if t0 in ow.reject_for:
+                # pre-dispatch validation failure: raises WITHOUT
+                # closing — a request defect, not a device fault
+                raise ValueError("injected prefill rejection (%d)" % t0)
+            if t0 in ow.explode_prefill_for:
+                self.closed = True
+                raise RuntimeError("injected prefill explosion (%d)"
+                                   % t0)
+            if ow.prefill_s:
+                time.sleep(ow.prefill_s)
+            telemetry.mark("first_token")
+            n = ow.long_n_new if t0 in ow.long_for else ow.n_new
+            self._live[slot] = {"next": t0 + 2, "remaining": n - 1,
+                                "first": t0}
+            ow.journal.append(("admit", slot, self.iteration, seq))
+            return t0 + 1, n == 1
+
+        def step(self):
+            ow = self.owner
+            if self.closed:
+                raise RuntimeError("slot session is closed")
+            self.iteration += 1
+            if self.iteration in ow.explode_on:
+                raise RuntimeError("injected step explosion (iteration "
+                                   "%d)" % self.iteration)
+            delay = ow.per_token_s + sum(
+                ow.step_delays.get(st["first"], 0.0)
+                for st in self._live.values())
+            if delay:
+                time.sleep(delay)
+            out = []
+            for slot, st in sorted(self._live.items()):
+                if st["remaining"] <= 0:
+                    continue
+                tok = st["next"]
+                st["next"] += 1
+                st["remaining"] -= 1
+                out.append((slot, tok, st["remaining"] <= 0))
+            return out
+
+        def retire(self, slot):
+            self._live.pop(slot, None)
+            self.owner.journal.append(("retire", slot, self.iteration))
+
+        def close(self):
+            self._live.clear()
+            self.owner.closed += 1
+
+    class _Backend:
+        def __init__(self):
+            self.buckets = list(buckets)
+            self.n_new = int(n_new)
+            self.prefill_s = float(prefill_s)
+            self.per_token_s = float(per_token_s)
+            self.long_for = set(long_for or ())
+            self.long_n_new = int(long_n_new or n_new)
+            self.step_delays = dict(step_delays or {})
+            self.explode_on = set(explode_on_iterations or ())
+            self.explode_prefill_for = set(explode_prefill_for or ())
+            self.reject_for = set(reject_for or ())
+            self.journal = []
+            self.sessions = []
+            self.closed = 0
+
+        def session(self, bucket):
+            s = _Session(self, bucket)
+            self.sessions.append(s)
+            return s
+
+        def admits(self, toks):
+            if max_prompt and len(toks) > max_prompt:
+                return ("prompt len %d exceeds the %d-token bound"
+                        % (len(toks), max_prompt))
+            return None
+
+    return _Backend()
+
+
 def exploding_backend(base=None, every: int = 1, exc: Exception = None):
     """Backend that raises on every ``every``-th call (every=1: always);
     delegates to ``base`` otherwise — the supervision fixture (the

@@ -5,6 +5,7 @@ RoPE offsets, GQA caches, and sliding-window masking.
 """
 
 import numpy as np
+import pytest
 
 from cxxnet_tpu.io.data import DataBatch
 from cxxnet_tpu.nnet.trainer import Trainer
@@ -649,3 +650,173 @@ def test_generate_failure_evicts_decode_programs():
     assert tr._decode_fns == warmed, "transient failure evicted warmed " \
         "decode programs"
     np.testing.assert_array_equal(tr.generate(prompts, 5), out)
+
+
+# ----------------------------------------------------------------------
+# continuous batching: DecodeSession (iteration-granularity bucketed
+# decode — doc/serving.md "Continuous batching") must be token-exact vs
+# solo dispatch of every request, with zero recompiles on a warm bucket.
+
+
+def _solo_continuations(tr, prompts, n_new, temp, top_k, seed0):
+    return [list(tr.generate(np.asarray([p]), n_new, temperature=temp,
+                             top_k=top_k, seed=seed0 + i)[0])
+            for i, p in enumerate(prompts)]
+
+
+def _drive_session(sess, prompts, seed0, stagger=True):
+    """Schedule `prompts` through the session like the servd dispatcher:
+    admit into free slots, step, retire on done. ``stagger`` admits at
+    most one request per iteration, so later requests join while
+    earlier ones are MID-DECODE — the composition the token-exactness
+    claim is about."""
+    got, live, nxt = {}, {}, 0
+    while nxt < len(prompts) or live:
+        free = sess.free_slots()
+        admit_n = min(len(free), len(prompts) - nxt)
+        if stagger:
+            admit_n = min(admit_n, 1)
+        for s in free[:admit_n]:
+            i, nxt = nxt, nxt + 1
+            tok, done = sess.prefill(s, prompts[i], seed0 + i)
+            live[s] = (i, [tok])
+            if done:
+                got[i] = live.pop(s)[1]
+                sess.retire(s)
+        for s, tok, done in sess.step():
+            live[s][1].append(tok)
+            if done:
+                i, toks = live.pop(s)
+                got[i] = toks
+                sess.retire(s)
+    return [got[i] for i in range(len(prompts))]
+
+
+def test_decode_session_token_exact_and_warm_bucket_no_recompile():
+    """Batched == solo, token for token, greedy AND sampled, with
+    staggered admissions (every later request joins mid-decode); then
+    a request re-served through the WARM bucket records ZERO compiles
+    on the recompile detector — the arXiv:1802.04799 cliff pin."""
+    from cxxnet_tpu.utils import telemetry
+    tr = _trained()
+    rs = np.random.RandomState(5)
+    # two prompt lengths only (tier-1 compile budget; the full ragged
+    # grid is the slow test below)
+    prompts = [rs.randint(0, VOCAB, (4, 6)[i % 2]).tolist()
+               for i in range(5)]
+    n_new = 5
+    for temp, top_k in ((0.0, 0), (0.8, 3)):
+        solo = _solo_continuations(tr, prompts, n_new, temp, top_k, 50)
+        sess = tr.decode_session(3, n_new, temperature=temp, top_k=top_k)
+        got = _drive_session(sess, prompts, 50)
+        assert got == solo, "batched != solo at temp=%s top_k=%s" \
+            % (temp, top_k)
+        # warm-bucket join: the recompile detector (trace-context
+        # compile attribution — works with telemetry disabled) must
+        # record NOTHING for a request joining the warm bucket
+        tc = telemetry.trace_context("warm-join")
+        with tc:
+            got2 = _drive_session(sess, prompts[:1], 50)
+        assert got2[0] == solo[0]
+        assert tc.compiles == [], tc.compiles
+        sess.close()
+
+
+def test_decode_session_stale_after_params_change():
+    """A session serves the params it was created under: swapping the
+    trainer's params (model reload) makes every call raise AND latches
+    ``closed`` — the slot caches hold old-weight K/V, and the
+    dispatcher keys warm-pool eviction (and breaker accounting) on the
+    closed flag, so a stale session must never be re-offered."""
+    tr = _trained(steps=2)
+    sess = tr.decode_session(2, 3)
+    sess.prefill(0, [1, 2, 3], 7)
+    tr.params = list(tr.params)        # the reload signature: new list
+    with pytest.raises(ValueError):
+        sess.step()
+    assert sess.closed
+    with pytest.raises(ValueError):
+        sess.prefill(1, [1, 2], 7)
+
+
+def test_serve_frontend_continuous_batching_token_exact():
+    """The real datapath end-to-end: servd's batching dispatcher over
+    Trainer.decode_session serves a concurrent flood with responses
+    IDENTICAL to solo generate, coalesces (occupancy > 1), and a
+    request admitted into the warm bucket carries zero recompiles in
+    its flight record."""
+    import threading
+
+    from cxxnet_tpu.utils import servd
+    tr = _trained(steps=5)
+    n_new = 4
+
+    class _SlotBackend:
+        buckets = [2]
+
+        def session(self, nslots):
+            # the dispatcher's seq ordinal is the seed (greedy: unused)
+            return tr.decode_session(nslots, n_new)
+
+    fe = servd.ServeFrontend(None, slot_backend=_SlotBackend(),
+                             batch_max=2, batch_window_ms=60.0,
+                             drain_ms=8000.0)
+    fe.start()
+    port = fe.listen(0)
+    try:
+        prompts = [[1, 2, 3, 4], [5, 6, 7, 8], [9, 10, 11, 1]]
+        solo = [" ".join(str(t) for t in
+                         tr.generate(np.asarray([p]), n_new)[0])
+                for p in prompts]
+        out = [None] * len(prompts)
+
+        def ask(i):
+            out[i] = servd._ask(port, " ".join(map(str, prompts[i])),
+                                timeout=120.0)
+
+        ts = [threading.Thread(target=ask, args=(i,))
+              for i in range(len(prompts))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert out == solo, (out, solo)
+        assert fe.mean_occupancy() > 1.0
+        # warm-bucket request (seen prompt length): its flight record's
+        # recompile attribution must be EMPTY
+        warm = servd._ask(port, " ".join(map(str, prompts[0])),
+                          timeout=60.0)
+        assert warm == solo[0]
+        rec = fe.flight.list()[0]
+        assert rec["outcome"] == "served"
+        assert rec["recompiles"] == [], rec["recompiles"]
+        assert rec.get("occupancy_at_dispatch") == 1
+    finally:
+        stats = fe.drain()
+    assert stats["accepted"] == stats["served"] == 4
+
+
+@pytest.mark.slow
+def test_decode_session_grid_token_exact():
+    """The full acceptance grid: batched == solo across greedy /
+    sampled / top_k sampling x ragged prompt lengths x the
+    learned-pos AND rope+GQA+window model variants, all with
+    staggered mid-decode joins."""
+    variants = (
+        {},
+        dict(embed_extra="pos_embed = 0",
+             attn_extra="  rope = 1\n  nkvhead = 2\n"
+                        "  attn_window = 8\n"),
+    )
+    for kwargs in variants:
+        tr = _trained(**kwargs)
+        rs = np.random.RandomState(9)
+        prompts = [rs.randint(0, VOCAB, rs.randint(3, 9)).tolist()
+                   for _ in range(7)]
+        for temp, top_k in ((0.0, 0), (1.0, 0), (0.7, 4)):
+            solo = _solo_continuations(tr, prompts, 6, temp, top_k, 30)
+            sess = tr.decode_session(4, 6, temperature=temp,
+                                     top_k=top_k)
+            got = _drive_session(sess, prompts, 30)
+            assert got == solo, (kwargs, temp, top_k)
+            sess.close()

@@ -65,6 +65,25 @@ def wait_until(cond, timeout=5.0, msg="condition"):
     raise AssertionError("timed out waiting for " + msg)
 
 
+def occupy_and_fill(fe, port):
+    """Park one request inside a BLOCKING backend and one in the 1-slot
+    queue, deterministically: waiting on ``accepted == 2`` alone is
+    ambiguous — on a fast machine the second send can race the worker's
+    pop of the first and be SHED instead of queued, leaving the queue
+    empty and the next request queued behind the blocked backend
+    instead of instantly shed. Returns the open sockets."""
+    socks = []
+    s = socket.create_connection(("127.0.0.1", port), timeout=5)
+    s.sendall(b"9\n")
+    socks.append(s)
+    wait_until(lambda: fe._inflight == 1, msg="worker occupied")
+    s = socket.create_connection(("127.0.0.1", port), timeout=5)
+    s.sendall(b"9\n")
+    socks.append(s)
+    wait_until(lambda: len(fe._q) == 1, msg="queue full")
+    return socks
+
+
 # ----------------------------------------------------------------------
 # servd: the TRACE prefix contract
 def test_trace_prefix_adopted_and_validated():
@@ -118,12 +137,7 @@ def test_admission_shed_leaves_flight_record_under_trace_id():
     port = fe.listen(0)
     socks = []
     try:
-        for _ in range(2):           # occupy the worker + fill the queue
-            s = socket.create_connection(("127.0.0.1", port), timeout=5)
-            s.sendall(b"9\n")
-            socks.append(s)
-        wait_until(lambda: fe.stats()["accepted"] == 2,
-                   msg="worker occupied and queue full")
+        socks += occupy_and_fill(fe, port)
         resp = faultinject.serve_request(port, "TRACE shed-1 5")
         assert resp.startswith("ERR busy queue"), resp
         rec = fe.flight.get("shed-1")
@@ -197,13 +211,7 @@ def test_retry_under_one_id_and_stitched_trace():
     try:
         # wedge replica 1 and fill its 1-slot queue so any pick of it
         # sheds ERR busy queue (zero load, index tie-break -> 1 first)
-        for _ in range(2):
-            s = socket.create_connection(("127.0.0.1", fe1.port),
-                                         timeout=5)
-            s.sendall(b"9\n")
-            socks.append(s)
-        wait_until(lambda: fe1.stats()["accepted"] == 2,
-                   msg="replica 1 full")
+        socks += occupy_and_fill(fe1, fe1.port)
         assert faultinject.serve_request(router.port, "5") == "1005"
         rrec = router.flight.list()[0]
         tid = rrec["id"]

@@ -82,6 +82,34 @@ def make_router():
         router.drain(timeout_ms=2000)
 
 
+def wedge_and_park(r, timeout=8.0):
+    """Wedge a replica AND confirm a request is parked inside its
+    blocked backend. SIGUSR1 delivery is asynchronous: on a fast
+    machine a request sent right after ``wedge_replica`` can reach the
+    backend BEFORE the handler flips the wedge flag and be served
+    instantly — so keep sending fire-and-forget requests until one
+    visibly sticks (``in_flight`` holds at 1). Returns the open
+    sockets (close them at teardown)."""
+    faultinject.wedge_replica(r)
+    socks = []
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        s = socket.create_connection(("127.0.0.1", r.port), timeout=5)
+        s.sendall(b"9\n")
+        socks.append(s)
+        t0 = time.monotonic()
+        while time.monotonic() < t0 + 0.4:
+            if replica_stats(r)["in_flight"] >= 1:
+                # confirm it HOLDS (a mid-serve flicker is not a park)
+                time.sleep(0.1)
+                if replica_stats(r)["in_flight"] >= 1:
+                    return socks
+                break
+            time.sleep(0.02)
+    raise AssertionError("could not park a request in the wedged "
+                         "replica (wedge never took effect?)")
+
+
 def wait_until(cond, timeout=8.0, interval=0.02, msg="condition"):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
@@ -121,6 +149,33 @@ def test_retryability_contract():
     assert not routerd.retryable("ERR deadline expired 5ms ago")
     assert not routerd.retryable("ERR empty request line has no tokens")
     assert not routerd.retryable("2 3 4")
+
+
+def test_free_slots_load_signal_prefers_batching_replica():
+    """The continuous-batching capacity signal: a replica reporting
+    free decode slots (``free_slots`` in its ADMIN stats — bucket
+    capacity minus active) reads as LESS loaded than an equally busy
+    solo replica, so power-of-two routing prefers the one that can
+    batch the request into a running decode pass. Old replicas omit
+    the field — parsed as 0, ordering unchanged."""
+    router = routerd.Router([("127.0.0.1", 1, 2), ("127.0.0.1", 3, 4)],
+                            probe_ms=10_000.0)
+    a, b = router._replicas
+    a.queue_depth, a.in_flight, a.free_slots = 1, 1, 0
+    b.queue_depth, b.in_flight, b.free_slots = 1, 1, 3
+    assert router._load(b) < router._load(a)
+    picked, cands = router._pick(set())
+    assert picked is b
+    assert all("free_slots" in c for c in cands)
+    router._checkin(b)
+    # snapshot carries the signal (the /fleetz surface)
+    assert b.snapshot(0.0)["free_slots"] == 3
+    # absent field == 0 (pre-batching replica): tie broken by index,
+    # exactly the pre-batching behavior
+    b.free_slots = 0
+    picked, _ = router._pick(set())
+    assert picked is a
+    router._checkin(a)
 
 
 def test_parse_replicas():
@@ -170,14 +225,12 @@ def test_queue_shed_retried_on_other_replica(make_router):
     a, b = spawn_two({"queue": 1})
     socks = []
     try:
-        # wedge A and fill its 1-slot queue with fire-and-forget
-        # requests so any pick of A sheds `ERR busy queue`
-        faultinject.wedge_replica(a)
-        for _ in range(2):
-            s = socket.create_connection(("127.0.0.1", a.port),
-                                         timeout=5)
-            s.sendall(b"9\n")
-            socks.append(s)
+        # wedge A (confirmed stuck — see wedge_and_park), then fill its
+        # 1-slot queue so any pick of A sheds `ERR busy queue`
+        socks += wedge_and_park(a)
+        s = socket.create_connection(("127.0.0.1", a.port), timeout=5)
+        s.sendall(b"9\n")
+        socks.append(s)
         wait_until(lambda: replica_stats(a)["queue_depth"] == 1
                    and replica_stats(a)["in_flight"] == 1,
                    msg="replica A full")
@@ -407,14 +460,11 @@ def test_rolling_reload_zero_downtime(make_router):
 # sees its readiness fail and routes around it; unwedge re-admits
 def test_wedged_replica_routed_around(make_router):
     a, b = spawn_two({"stall_s": 0.2})
-    sock = None
+    socks = []
     try:
         router = make_router([a, b], probe_ms=100.0, retries=2,
                              stall_s=2.0)
-        faultinject.wedge_replica(a)
-        sock = socket.create_connection(("127.0.0.1", a.port),
-                                        timeout=5)
-        sock.sendall(b"9\n")      # wedges A's worker
+        socks += wedge_and_park(a)   # a request stuck in A's worker
         # past stall_s the replica's own /healthz fails; the router's
         # probe takes it out of rotation (grouped with breaker_open)
         wait_until(lambda: router.fleet_snapshot()["replicas"][0]
@@ -426,8 +476,8 @@ def test_wedged_replica_routed_around(make_router):
         wait_until(lambda: router.fleet_snapshot()["replicas"][0]
                    ["state"] == routerd.UP, msg="unwedged re-admitted")
     finally:
-        if sock is not None:
-            sock.close()
+        for s in socks:
+            s.close()
         faultinject.stop_fleet([a, b])
 
 

@@ -1056,5 +1056,392 @@ def test_report_request_breakdown_and_slo_exit2(tmp_path, capsys):
 
 
 # ----------------------------------------------------------------------
+# continuous batching: the slot-backend dispatcher (doc/serving.md
+# "Continuous batching") driven jax-free through faultinject's fake
+# slot backend — coalescing, mid-decode join, per-iteration deadlines,
+# exactly-once under drain mid-batch, load/occupancy signals.
+
+
+def _expect_line(first_tok, n):
+    return " ".join(str(first_tok + k) for k in range(1, n + 1))
+
+
+def test_batch_coalesce_flood_exact_and_occupancy(make_frontend):
+    """A concurrent flood coalesces into real batches: every response is
+    exact (zero lost, zero duplicated — one aligned answer per
+    request), the measured mean occupancy beats 1 sequence/pass, and
+    every flight record carries occupancy_at_dispatch."""
+    sb = faultinject.slot_backend(buckets=(1, 2, 4), n_new=4,
+                                  per_token_s=0.003)
+    fe = make_frontend(None, slot_backend=sb, batch_max=4,
+                       batch_window_ms=40.0)
+    lines = ["%d 7" % (10 * i) for i in range(1, 9)]
+    resps = faultinject.serve_flood(fe.port, lines, timeout=20.0)
+    for i, r in enumerate(resps):
+        assert r == _expect_line(10 * (i + 1), 4), (i, r)
+    assert fe.mean_occupancy() is not None and fe.mean_occupancy() > 1.0
+    recs = fe.flight.list()
+    assert all(r.get("occupancy_at_dispatch", 0) >= 1 for r in recs)
+    assert any(r["occupancy_at_dispatch"] > 1 for r in recs)
+    stats = fe.drain()
+    assert reconciles(stats)
+    assert stats["accepted"] == stats["served"] == 8
+
+
+def test_batch_mid_decode_join_after_retire(make_frontend):
+    """THE headline: a finished sequence frees its slot and the next
+    queued request joins while a straggler is still decoding —
+    asserted via the fake backend's iteration journal, with exact
+    responses for all three."""
+    sb = faultinject.slot_backend(buckets=(2,), n_new=3,
+                                  per_token_s=0.01, long_for={100},
+                                  long_n_new=40)
+    fe = make_frontend(None, slot_backend=sb, batch_max=2,
+                       batch_window_ms=40.0, drain_ms=8000.0)
+    out = [None] * 3
+
+    def ask(i, line):
+        out[i] = faultinject.serve_request(fe.port, line, timeout=30.0)
+
+    t1 = threading.Thread(target=ask, args=(0, "100"))   # straggler: 40
+    t2 = threading.Thread(target=ask, args=(1, "200"))   # 3 tokens
+    t1.start()
+    t2.start()
+    time.sleep(0.15)                 # straggler mid-decode, 200 done
+    t3 = threading.Thread(target=ask, args=(2, "300"))
+    t3.start()
+    for t in (t1, t2, t3):
+        t.join()
+    assert out[0] == _expect_line(100, 40)
+    assert out[1] == _expect_line(200, 3)
+    assert out[2] == _expect_line(300, 3)
+    admits = [e for e in sb.journal if e[0] == "admit"]
+    retires = [e for e in sb.journal if e[0] == "retire"]
+    # request 300 (3rd admit) joined AFTER the first retirement freed a
+    # slot and BEFORE the straggler finished: a mid-decode join, pinned
+    # by iteration counters, not timing
+    join_iter = admits[2][2]
+    first_retire_iter = retires[0][2]
+    straggler_retire_iter = retires[-1][2]
+    assert first_retire_iter <= join_iter < straggler_retire_iter, \
+        sb.journal
+    stats = fe.drain()
+    assert reconciles(stats) and stats["served"] == 3
+
+
+def test_batch_deadline_retires_mid_decode_others_continue(make_frontend):
+    """Per-ITERATION deadline enforcement: an expired sequence retires
+    with ERR deadline between iterations while its batchmates keep
+    decoding to completion; its flight record keeps the real phases
+    (the backend burned them) and its tokens so far."""
+    sb = faultinject.slot_backend(buckets=(2,), n_new=40,
+                                  per_token_s=0.005)
+    fe = make_frontend(None, slot_backend=sb, batch_max=2,
+                       batch_window_ms=50.0, drain_ms=8000.0)
+    out = [None] * 2
+
+    def ask(i, line):
+        out[i] = faultinject.serve_request(fe.port, line, timeout=30.0)
+
+    ts = [threading.Thread(target=ask, args=(0, "DEADLINE 100 100")),
+          threading.Thread(target=ask, args=(1, "200"))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert out[0].startswith("ERR deadline"), out[0]
+    assert out[1] == _expect_line(200, 40)
+    stats = fe.drain()
+    assert reconciles(stats)
+    assert stats["deadline"] == 1 and stats["served"] == 1
+    # the retired sequence really decoded before expiring: its record
+    # carries tokens and a positive decode phase (not the hard zeros of
+    # a never-dispatched expiry)
+    rec = next(r for r in fe.flight.list() if r["outcome"] == "deadline")
+    assert rec["tokens_out"] >= 1
+    assert rec["phases"]["decode"] > 0
+
+
+def test_batch_drain_mid_batch_exactly_once(make_frontend):
+    """Drain with a batch in flight and more queued: every accepted
+    request is answered EXACTLY once — completed, ERR draining
+    (queued leftovers), or ERR draining backend (the batch the budget
+    gave up on) — and the books reconcile."""
+    sb = faultinject.slot_backend(buckets=(2,), n_new=30,
+                                  per_token_s=0.02)
+    fe = make_frontend(None, slot_backend=sb, listen=False, batch_max=2,
+                       batch_window_ms=0.0, drain_ms=300.0)
+    replies = {}
+
+    def mkreply(i):
+        def reply(text):
+            replies.setdefault(i, []).append(text)
+        return reply
+
+    for i in range(4):                  # 2 into slots, 2 queued
+        fe.submit("%d00 7" % (i + 1), mkreply(i))
+    time.sleep(0.15)                    # batch underway
+    stats = fe.drain(timeout_ms=300)
+    assert reconciles(stats), stats
+    assert stats["accepted"] == 4
+    time.sleep(0.3)                     # a late worker answer would dup
+    assert sorted(replies) == [0, 1, 2, 3]
+    for i, texts in sorted(replies.items()):
+        assert len(texts) == 1, (i, texts)
+    assert sum(1 for t in replies.values()
+               if t[0].startswith("ERR draining")) >= 2
+
+
+def test_batch_step_failure_fails_whole_batch_then_recovers(
+        make_frontend):
+    """A decode-step exception answers every active sequence ERR
+    backend (exactly once), counts ONE breaker failure, drops the
+    session — and the next request gets a fresh session and succeeds."""
+    sb = faultinject.slot_backend(buckets=(2,), n_new=4,
+                                  per_token_s=0.005,
+                                  explode_on_iterations={2})
+    fe = make_frontend(None, slot_backend=sb, batch_max=2,
+                       batch_window_ms=50.0)
+    resps = faultinject.serve_flood(fe.port, ["100", "200"],
+                                    timeout=20.0)
+    assert all(r.startswith("ERR backend") for r in resps), resps
+    assert fe.breaker.state == "closed"     # 1 failure < the threshold
+    # recovery: a NEW session serves the next request (iteration 2 of
+    # the fresh session explodes again — use a session whose first
+    # explosion is spent... the fake's explode set is per-session, so
+    # drive past it with single-token steps)
+    sb.explode_on.clear()
+    assert faultinject.serve_request(fe.port, "300",
+                                     timeout=20.0) == _expect_line(300, 4)
+    assert len(sb.sessions) >= 2
+    stats = fe.drain()
+    assert reconciles(stats)
+    assert stats["errors"] == 2 and stats["served"] == 1
+
+
+def test_batch_prefill_failure_closes_session_and_evicts(make_frontend):
+    """A prefill failure CLOSES the session (its device state integrity
+    is unknown — the DecodeSession contract) and the dispatcher evicts
+    it from the warm pool: the failed request answers ERR backend, a
+    batchmate already aboard fails with it, and the next request gets
+    a FRESH session — a broken session never serves again."""
+    sb = faultinject.slot_backend(buckets=(2,), n_new=3,
+                                  per_token_s=0.005,
+                                  explode_prefill_for={666})
+    fe = make_frontend(None, slot_backend=sb, batch_max=2,
+                       batch_window_ms=50.0)
+    resps = faultinject.serve_flood(fe.port, ["100", "666"],
+                                    timeout=20.0)
+    assert any(r.startswith("ERR backend") for r in resps), resps
+    ok = faultinject.serve_request(fe.port, "200", timeout=20.0)
+    assert ok == _expect_line(200, 3)
+    assert len(sb.sessions) >= 2        # the closed one was evicted
+    assert sb.sessions[0].closed
+    stats = fe.drain()
+    assert reconciles(stats)
+
+
+def test_batch_prefill_failure_counts_one_breaker_failure(make_frontend):
+    """ONE prefill fault in a coalesced batch costs the breaker exactly
+    ONE failure count, however many requests die of it: the dispatcher
+    stops admitting into the closed session (each further prefill
+    would raise and spuriously count again) and answers the rest
+    without re-counting — a single fault must not open the circuit."""
+    sb = faultinject.slot_backend(buckets=(8,), n_new=3,
+                                  explode_prefill_for=set(
+                                      range(100, 700, 100)))
+    # queue BEFORE start(): all six requests land in ONE gathered batch
+    # deterministically, so exactly one prefill fault covers them all
+    fe = servd.ServeFrontend(None, slot_backend=sb, batch_max=8,
+                             batch_window_ms=0.0, breaker_fails=5,
+                             drain_ms=2000.0)
+    replies = {}
+
+    def mkreply(i):
+        def reply(text):
+            replies.setdefault(i, []).append(text)
+        return reply
+
+    events = [fe.submit("%d00 7" % (i + 1), mkreply(i))
+              for i in range(6)]
+    fe.start()
+    for ev in events:
+        assert ev.wait(10.0), "request never answered"
+    assert sorted(replies) == list(range(6))
+    for i, texts in replies.items():
+        assert len(texts) == 1 and texts[0].startswith("ERR backend"), \
+            (i, texts)
+    assert fe.breaker.state == "closed", fe.breaker.describe()
+    assert fe.breaker.consecutive == 1, fe.breaker.consecutive
+    stats = fe.drain()
+    assert reconciles(stats) and stats["errors"] == 6
+
+
+def test_batch_prefill_rejection_never_feeds_breaker(make_frontend):
+    """A prefill that raises WITHOUT closing the session (pre-dispatch
+    validation — e.g. a too-long prompt against a backend with no
+    admits() hook) is a deterministic request defect: answered ERR
+    backend, breaker untouched — a flood of client defects must not
+    open the circuit and shed healthy traffic."""
+    sb = faultinject.slot_backend(buckets=(2,), n_new=3,
+                                  reject_for={666})
+    fe = make_frontend(None, slot_backend=sb, batch_max=2,
+                       batch_window_ms=0.0, breaker_fails=2)
+    for _ in range(3):      # more defects than breaker_fails
+        bad = faultinject.serve_request(fe.port, "666", timeout=10.0)
+        assert bad.startswith("ERR backend"), bad
+    assert fe.breaker.state == "closed"
+    assert fe.breaker.consecutive == 0
+    assert faultinject.serve_request(fe.port, "100",
+                                     timeout=10.0) == _expect_line(100, 3)
+    stats = fe.drain()
+    assert reconciles(stats)
+    assert stats["errors"] == 3 and stats["served"] == 1
+
+
+def test_batch_fresh_batch_occupancy_stamped_batchwide(make_frontend):
+    """Members of ONE coalesced fresh batch share their first decode
+    pass: every flight record carries the batch occupancy, not the
+    sequential admit order (1, 2, ...) — /requestz must not read
+    'not coalesced' for the batch's first member."""
+    sb = faultinject.slot_backend(buckets=(2,), n_new=3,
+                                  per_token_s=0.002)
+    # queue BEFORE start(): both requests land in one gathered batch
+    fe = servd.ServeFrontend(None, slot_backend=sb, batch_max=2,
+                             batch_window_ms=0.0, drain_ms=2000.0)
+    done = [fe.submit("%d00 7" % (i + 1), lambda t: None)
+            for i in range(2)]
+    fe.start()
+    for ev in done:
+        assert ev.wait(10.0)
+    occs = sorted(r["occupancy_at_dispatch"] for r in fe.flight.list())
+    assert occs == [2, 2], occs
+    stats = fe.drain()
+    assert reconciles(stats) and stats["served"] == 2
+
+
+def test_batch_free_slots_load_signal_in_admin_stats(make_frontend):
+    """ADMIN stats reports free decode slots (capacity − active): full
+    capacity when idle, reduced while a batch decodes — the router's
+    prefer-the-replica-that-can-batch-it-in signal. Solo frontends
+    omit the field (backward compatible by absence)."""
+    sb = faultinject.slot_backend(buckets=(4,), n_new=20,
+                                  per_token_s=0.02)
+    fe = make_frontend(None, slot_backend=sb, batch_max=4,
+                       batch_window_ms=0.0)
+
+    def stats_field(port, key):
+        line = faultinject.serve_request(port, "ADMIN stats",
+                                         timeout=5.0)
+        kv = dict(p.split("=") for p in line[3:].split())
+        return kv.get(key)
+
+    assert stats_field(fe.port, "free_slots") == "4"
+    ts = [threading.Thread(
+        target=faultinject.serve_request,
+        args=(fe.port, "%d00" % (i + 1),), kwargs={"timeout": 30.0})
+        for i in range(2)]
+    for t in ts:
+        t.start()
+    time.sleep(0.2)                     # two slots active
+    assert stats_field(fe.port, "free_slots") == "2"
+    for t in ts:
+        t.join()
+    solo = make_frontend()              # no slot backend
+    line = faultinject.serve_request(solo.port, "ADMIN stats",
+                                     timeout=5.0)
+    assert "free_slots" not in line
+
+
+def test_batch_reload_waits_for_inflight_batch(make_frontend):
+    """A reload requested mid-batch is deferred until the in-flight
+    batch finishes (the slot caches hold the old model's K/V), then
+    every warm session is closed and the next request gets a fresh
+    session from the reloaded backend."""
+    reloads = []
+    sb = faultinject.slot_backend(buckets=(2,), n_new=20,
+                                  per_token_s=0.01)
+    fe = make_frontend(None, slot_backend=sb, batch_max=2,
+                       batch_window_ms=0.0, drain_ms=8000.0,
+                       reload_fn=lambda: reloads.append(1) or True)
+    done = []
+
+    def ask():
+        done.append(faultinject.serve_request(fe.port, "100",
+                                              timeout=30.0))
+
+    t = threading.Thread(target=ask)
+    t.start()
+    time.sleep(0.05)                    # batch underway
+    assert faultinject.serve_request(
+        fe.port, "ADMIN reload", timeout=5.0).startswith("OK")
+    assert not reloads                  # deferred: batch still decoding
+    t.join()
+    assert done[0] == _expect_line(100, 20)
+    # the worker honors the flag once the batch drains
+    deadline = time.monotonic() + 5.0
+    while not reloads and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert reloads and sb.closed >= 1
+    n_sessions = len(sb.sessions)
+    assert faultinject.serve_request(fe.port, "200",
+                                     timeout=20.0) == _expect_line(200, 20)
+    assert len(sb.sessions) == n_sessions + 1
+    stats = fe.drain()
+    assert reconciles(stats)
+
+
+def test_batch_admits_check_answers_err_backend(make_frontend):
+    """The slot backend's compatibility check (prompt too long for the
+    model) answers a deterministic ERR backend without feeding the
+    breaker or poisoning the batch."""
+    sb = faultinject.slot_backend(buckets=(2,), n_new=3, max_prompt=3)
+    fe = make_frontend(None, slot_backend=sb, batch_max=2)
+    bad = faultinject.serve_request(fe.port, "1 2 3 4 5", timeout=10.0)
+    assert bad.startswith("ERR backend"), bad
+    assert fe.breaker.state == "closed" and fe.breaker.consecutive == 0
+    assert faultinject.serve_request(fe.port, "100",
+                                     timeout=10.0) == _expect_line(100, 3)
+    stats = fe.drain()
+    assert reconciles(stats)
+    assert stats["errors"] == 1 and stats["served"] == 1
+
+
+def test_batch_occupancy_metrics_honest_weighted_mean(make_frontend):
+    """The occupancy series is a per-iteration account, not a last-write
+    gauge: iterations/slot-iterations counters land in telemetry and
+    the weighted mean matches the fake backend's journal exactly."""
+    reg = telemetry._Registry()
+    reg.enable()
+    sb = faultinject.slot_backend(buckets=(2,), n_new=4,
+                                  per_token_s=0.002)
+    orig = telemetry._REG
+    telemetry._REG = reg
+    try:
+        fe = make_frontend(None, slot_backend=sb, batch_max=2,
+                           batch_window_ms=40.0)
+        resps = faultinject.serve_flood(fe.port, ["100", "200"],
+                                        timeout=20.0)
+        assert all(r for r in resps)
+        fe.drain()
+    finally:
+        telemetry._REG = orig
+    snap = reg.metrics_snapshot()
+    iters = snap["counters"]["serve.batch_iterations"]
+    slots = snap["counters"]["serve.batch_slot_iterations"]
+    assert iters > 0 and slots / float(iters) == fe.mean_occupancy()
+    assert fe.mean_occupancy() > 1.0
+    # /statusz surfaces the mean (the honest form of the gauge)
+    srv = statusd.StatusServer(0, host="127.0.0.1", registry=reg)
+    try:
+        srv.start()
+        page = urlopen("http://127.0.0.1:%d/statusz" % srv.port,
+                       timeout=5).read().decode()
+        assert "mean occupancy" in page
+    finally:
+        srv.stop()
+
+
+# ----------------------------------------------------------------------
 def test_servd_selftest():
     assert servd.selftest() == 0

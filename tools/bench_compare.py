@@ -97,8 +97,14 @@ def sub_lower_is_better(key, line):
     """Direction for a sub-field gated as ``<metric>.<key>``: latency
     sub-fields (``*_ms``, ``*latency*``) and failure-rate sub-fields
     (``*_rate``) are worse when HIGHER, whatever the parent row's unit —
-    ``ttft_p99_ms`` on a throughput row still gates as a latency."""
+    ``ttft_p99_ms`` on a throughput row still gates as a latency.
+    Conversely throughput/capacity sub-fields (``*_rps``,
+    ``*tokens_per_s*``, ``*occupancy*``) are worse when LOWER even on a
+    latency row — ``mean_batch_occupancy`` on the serve rows gates as
+    the coalescing win it measures."""
     k = str(key)
+    if k.endswith("_rps") or "tokens_per_s" in k or "occupancy" in k:
+        return False
     if k.endswith("_ms") or "latency" in k or k.endswith("_rate"):
         return True
     return lower_is_better(line)
