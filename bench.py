@@ -737,6 +737,130 @@ def bench_serve_fleet():
             "requests": nsent[0]}
 
 
+def bench_serve_tenant_isolation():
+    """Multi-tenant QoS under a noisy-tenant flood, through the
+    replicated fleet: 2 active replicas + 1 standby behind the router,
+    tenants ``noisy:1,victim:4`` fleet-wide, per-tenant SLO windows
+    federating. A closed-loop noisy flood saturates the fleet while a
+    light victim workload runs beside it — the row measures the three
+    isolation guarantees ISSUE 13's chaos arc is graded on: the
+    victim's p99 (headline, ms — holds while the flood sheds), the
+    noisy tenant's shed rate (HIGHER is the fairness actually engaging
+    — bench_compare knows this direction), and the autoscaler's
+    scale-up latency (flood start -> standby admitted, driven live by
+    the router's prober loop). Null-safe like every serve row."""
+    import threading
+    from cxxnet_tpu.models import transformer_lm_trainer
+    from cxxnet_tpu.utils import routerd, servd, statusd
+    from cxxnet_tpu.utils.telemetry import percentile
+    from tests import faultinject
+    vocab, L, plen, n_new = 8192, 256, 32, 8
+    tenants = "noisy:1,victim:4"
+    tr = transformer_lm_trainer(vocab=vocab, seq=L, batch_size=8,
+                                dim=256, nhead=4, nlayer=2, dev="tpu",
+                                extra_cfg=BF16)
+    gen_lock = threading.Lock()
+
+    def backend(toks, seq):
+        with gen_lock:
+            return tr.generate(np.asarray([toks]), n_new)[0]
+
+    rs = np.random.RandomState(0)
+    prompt = rs.randint(0, vocab, plen).tolist()
+    backend(prompt, 0)              # compile the (1, plen) decode once
+    line = " ".join(map(str, prompt))
+
+    def replica():
+        slo_t = {t: statusd.SLOTracker(availability=0.99,
+                                       min_requests=4, min_bad=3,
+                                       window_s=60.0)
+                 for t in ("noisy", "victim")}
+        fe = servd.ServeFrontend(backend, queue_size=8,
+                                 tenants=tenants,
+                                 tenant_default="victim",
+                                 slo_tenants=slo_t,
+                                 slo=statusd.SLOTracker(
+                                     availability=0.99, min_requests=8,
+                                     min_bad=3, window_s=60.0))
+        fe.start()
+        fe.listen(0)
+        ss = statusd.StatusServer(0, host="127.0.0.1").start()
+        ss.register_probe("serving", fe.health_probe)
+        ss.slo = fe.slo
+        ss.slo_tenants = slo_t
+        ss.flight = fe.flight
+        return fe, ss
+
+    actives = [replica() for _ in range(2)]
+    standby = replica()
+    router = routerd.Router(
+        [("127.0.0.1", fe.port, ss.port) for fe, ss in actives],
+        probe_ms=100.0, retries=2, federate_ms=200.0,
+        standby_replicas=[("127.0.0.1", standby[0].port,
+                           standby[1].port)],
+        scale_up_burn=1.0, scale_down_idle_s=3600.0,
+        scale_cooldown_s=0.5, tenants=tenants,
+        tenant_default="victim")
+    router.start()
+    rport = router.listen(0)
+    router.probe_now()
+    flood_s = 4.0
+    results = {}
+    t0 = time.perf_counter()
+
+    def flood(name, **kw):
+        results[name] = faultinject.tenant_flood(rport, name,
+                                                 duration_s=flood_s,
+                                                 toks=line, **kw)
+
+    ths = [threading.Thread(target=flood, args=("noisy",),
+                            kwargs={"nclients": 6}),
+           threading.Thread(target=flood, args=("victim",),
+                            kwargs={"nclients": 2})]
+    for t in ths:
+        t.start()
+    # the autoscaler runs live on the prober cadence: poll for its
+    # scale-up while the flood is on — flood start -> standby admitted
+    scale_latency = None
+    while time.perf_counter() - t0 < flood_s:
+        if router.scale_snapshot()["events"] > 0:
+            scale_latency = time.perf_counter() - t0
+            break
+        time.sleep(0.05)
+    for t in ths:
+        t.join()
+    router.drain()
+    for fe, ss in actives + [standby]:
+        fe.drain(timeout_ms=2000)
+        ss.stop()
+    noisy, victim = results.get("noisy"), results.get("victim")
+    vlats = sorted(victim["latencies"]) if victim else []
+    nlats = sorted(noisy["latencies"]) if noisy else []
+
+    def rate(d, key):
+        return round(d[key] / float(d["sent"]), 4) \
+            if d and d["sent"] else None
+
+    return {"metric": "serve_tenant_isolation",
+            "value": round(1e3 * percentile(vlats, 99), 3) if vlats
+            else None,
+            "unit": "ms", "vs_baseline": None,
+            "victim_p99_ms": round(1e3 * percentile(vlats, 99), 3)
+            if vlats else None,
+            "victim_p50_ms": round(1e3 * percentile(vlats, 50), 3)
+            if vlats else None,
+            "victim_shed_rate": rate(victim, "shed"),
+            "noisy_shed_rate": rate(noisy, "shed"),
+            "noisy_p99_ms": round(1e3 * percentile(nlats, 99), 3)
+            if nlats else None,
+            "fleet_scale_latency_s": round(scale_latency, 3)
+            if scale_latency is not None else None,
+            "lost": (victim["lost"] if victim else 0)
+            + (noisy["lost"] if noisy else 0),
+            "victim_requests": victim["sent"] if victim else 0,
+            "noisy_requests": noisy["sent"] if noisy else 0}
+
+
 def bench_mnist_mlp():
     tr = _conf_trainer(MNIST_MLP, (1, 1, 784), 100, extra=BF16)
     ips = _throughput(tr, (1, 1, 784), 10, 100, steps=100)
@@ -1070,7 +1194,8 @@ def _bench_main():
                    bench_lm_decode_b1, bench_lm_decode_long,
                    bench_lm_decode_chunked, bench_lm_decode_long_chunked,
                    bench_lm_decode_b1_chunked, bench_serve_load,
-                   bench_serve_throughput, bench_serve_fleet):
+                   bench_serve_throughput, bench_serve_fleet,
+                   bench_serve_tenant_isolation):
             print(json.dumps(_attach_telemetry(fn())), flush=True)
     if len(sys.argv) > 1 and sys.argv[1] in ("all", "pipeline"):
         lines = bench_alexnet_pipeline()

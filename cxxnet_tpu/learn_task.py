@@ -252,6 +252,29 @@ class LearnTask:
         self.fleet_federate_ms = 1000.0
         self.fleet_outlier_ratio = 3.0
         self.fleet_outlier_min_n = 20
+        # closed-loop fleet autoscaler (doc/robustness.md "Fleet
+        # autoscaling"): route_standby_replicas lists pre-provisioned
+        # host:port:status_port replicas held OUT of dispatch until the
+        # policy loop — fleet SLO burn >= route_scale_up_burn, or
+        # queued work with zero free decode slots — admits one; an
+        # admitted standby idle for route_scale_down_idle_s retires
+        # back to standby. Bounds default to [primary count, total];
+        # at most one action per route_scale_cooldown_s (hysteresis).
+        self.route_standby_replicas = ""
+        self.route_scale_min = 0         # 0 = the primary count
+        self.route_scale_max = 0         # 0 = primaries + standbys
+        self.route_scale_up_burn = 1.0
+        self.route_scale_down_idle_s = 30.0
+        self.route_scale_cooldown_s = 10.0
+        # multi-tenant weighted-fair QoS (doc/serving.md "Multi-tenant
+        # QoS"): route_tenants = "free:1,paid:4" arms per-tenant
+        # weighted-fair admission on BOTH the router and the servd
+        # replicas (share the same value fleet-wide), per-tenant
+        # counters/SLO floors, and fair-share shed charging; clients
+        # name their tenant with the TENANT <id> wire prefix, and
+        # prefix-less clients are the serve_tenant_default tenant.
+        self.route_tenants = ""
+        self.serve_tenant_default = "default"
         self.gen_new = 16
         self.gen_temperature = 0.0
         self.gen_topk = 0
@@ -510,6 +533,22 @@ class LearnTask:
             self.route_stall_s = float(val)
         if name == "route_flight_cap":
             self.route_flight_cap = int(val)
+        if name == "route_standby_replicas":
+            self.route_standby_replicas = val
+        if name == "route_scale_min":
+            self.route_scale_min = int(val)
+        if name == "route_scale_max":
+            self.route_scale_max = int(val)
+        if name == "route_scale_up_burn":
+            self.route_scale_up_burn = float(val)
+        if name == "route_scale_down_idle_s":
+            self.route_scale_down_idle_s = float(val)
+        if name == "route_scale_cooldown_s":
+            self.route_scale_cooldown_s = float(val)
+        if name == "route_tenants":
+            self.route_tenants = val
+        if name == "serve_tenant_default":
+            self.serve_tenant_default = val
         if name == "fleet_federate_ms":
             self.fleet_federate_ms = float(val)
         if name == "fleet_outlier_ratio":
@@ -1414,6 +1453,28 @@ class LearnTask:
             ttft_ms=self.slo_ttft_ms, p99_ms=self.slo_p99_ms,
             availability=self.slo_availability,
             window_s=self.slo_window_s)
+        # multi-tenant QoS: the SAME route_tenants value the fleet
+        # router enforces (the fairness verdict must agree fleet-wide),
+        # with one SLOTracker per tenant — same objectives, separate
+        # error budgets, so a noisy tenant's sheds cannot burn the
+        # victim's window
+        tenants = servd.parse_tenants(self.route_tenants)
+        slo_tenants = {}
+        if tenants:
+            if self.serve_tenant_default not in tenants:
+                tenants[self.serve_tenant_default] = 1.0
+            slo_tenants = {
+                t: statusd.SLOTracker(
+                    ttft_ms=self.slo_ttft_ms, p99_ms=self.slo_p99_ms,
+                    availability=self.slo_availability,
+                    window_s=self.slo_window_s)
+                for t in tenants}
+            if not self.silent:
+                print("serve: multi-tenant QoS on (%s; default %r)"
+                      % (",".join("%s:%g" % kv
+                                  for kv in sorted(tenants.items())),
+                         self.serve_tenant_default),
+                      file=sys.stderr, flush=True)
         # continuous batching: serve_buckets = "1,2,4,8" swaps the
         # one-request-per-pass worker for the iteration-granularity
         # batching dispatcher over Trainer.decode_session (the slot
@@ -1441,13 +1502,16 @@ class LearnTask:
             slo=slo, flight_cap=self.serve_flight_cap,
             slot_backend=slot_backend,
             batch_max=self.serve_batch_max,
-            batch_window_ms=self.serve_batch_window_ms)
+            batch_window_ms=self.serve_batch_window_ms,
+            tenants=tenants, tenant_default=self.serve_tenant_default,
+            slo_tenants=slo_tenants)
         fe.start()
         # request introspection: /trace?request=<id> + /requestz serve
         # the flight ring, /metrics + /statusz the SLO account (no-ops
         # without status_port)
         statusd.set_flight_recorder(fe.flight)
         statusd.set_slo(slo)
+        statusd.set_slo_tenants(slo_tenants)
         if self.serve_port >= 0:
             try:
                 port = fe.listen(self.serve_port, host=self.serve_host)
@@ -1549,11 +1613,15 @@ class LearnTask:
         and drain on their own signals."""
         import signal
 
-        from .utils import routerd
+        from .utils import routerd, servd
 
         replicas = routerd.parse_replicas(self.route_replicas)
         assert replicas, \
             "task = route needs route_replicas = host:port:status_port[,...]"
+        route_tenants = servd.parse_tenants(self.route_tenants)
+        if route_tenants and self.serve_tenant_default \
+                not in route_tenants:
+            route_tenants[self.serve_tenant_default] = 1.0
         router = routerd.Router(
             replicas, probe_ms=self.route_probe_ms,
             retries=self.route_retries, stall_s=self.route_stall_s,
@@ -1561,7 +1629,24 @@ class LearnTask:
             flight_cap=self.route_flight_cap,
             federate_ms=self.fleet_federate_ms,
             outlier_ratio=self.fleet_outlier_ratio,
-            outlier_min_n=self.fleet_outlier_min_n)
+            outlier_min_n=self.fleet_outlier_min_n,
+            standby_replicas=self.route_standby_replicas,
+            scale_min=self.route_scale_min,
+            scale_max=self.route_scale_max,
+            scale_up_burn=self.route_scale_up_burn,
+            scale_down_idle_s=self.route_scale_down_idle_s,
+            scale_cooldown_s=self.route_scale_cooldown_s,
+            tenants=self.route_tenants,
+            tenant_default=self.serve_tenant_default,
+            # the router's own per-tenant windows (door sheds): same
+            # objectives as the replicas', merged into the federated
+            # per-tenant burn account
+            slo_tenants={
+                t: statusd.SLOTracker(
+                    ttft_ms=self.slo_ttft_ms, p99_ms=self.slo_p99_ms,
+                    availability=self.slo_availability,
+                    window_s=self.slo_window_s)
+                for t in route_tenants})
         router.start()
         port = router.listen(self.route_port, host=self.route_host)
         # one synchronous sweep so /fleetz and the first dispatches see
@@ -1569,6 +1654,7 @@ class LearnTask:
         # is ejected before traffic arrives)
         router.probe_now()
         statusd.set_fleet(router)
+        statusd.set_slo_tenants(router.slo_tenants)
         # the routing flight ring: /requestz lists every routed
         # request's attempts, /trace?request=<id> stitches the
         # cross-process trace (set_fleet makes /trace prefer the

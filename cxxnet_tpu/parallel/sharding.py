@@ -71,7 +71,11 @@ def zero_sharding(mesh: Mesh, x: Any, axis: str = "data",
                 joint.append(a)
                 prod *= sz
         if prod > 1 and shape[0] >= prod:
-            return NamedSharding(mesh, P(tuple(joint)))
+            # canonical spec form: a single axis is the plain string —
+            # P(("data",)) and P("data") mean the same placement but
+            # stopped comparing equal in newer jax PartitionSpec
+            return NamedSharding(
+                mesh, P(joint[0] if len(joint) == 1 else tuple(joint)))
     return NamedSharding(mesh, P())
 
 

@@ -63,6 +63,11 @@ __all__ = ["RANKS", "DEFAULT_RANK", "LockOrderError", "RankedLock",
 # with `python tools/cxxlint.py --lock-graph`; tests/test_cxxlint.py
 # fails if an edge of the real graph contradicts this table.
 RANKS = {
+    "routerd.scale": 1,     # Router._scale_lock — autoscaler decisions
+    #                         and idle timers (outermost of the router
+    #                         locks: a decision may mark replicas under
+    #                         the fleet lock; IO — standby probes —
+    #                         stays outside it)
     "routerd.fleet": 2,     # Router._lock — replica states/load/windows
     #                         (outermost: held while recording telemetry,
     #                         never under any servd/statusd lock)

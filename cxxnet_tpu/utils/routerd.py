@@ -49,6 +49,9 @@ proves the request never dispatched:
     ------------------------     -----------   -----------------------
     ERR busy queue ...           never         retry elsewhere
     ERR busy breaker ...         never         eject + retry elsewhere
+    ERR busy tenant ...          never         relay (no retry: the
+                                               fair-share verdict holds
+                                               fleet-wide)
     ERR draining server ...      never         mark draining + retry
     ERR draining shutdown ...    never         mark draining + retry
     ERR draining backend ...     MAYBE         relay (no retry)
@@ -124,13 +127,38 @@ doc/observability.md "Request tracing & SLOs"):
   ``cxxnet_fleet_outlier{replica=...}`` gauge, emits a transition-only
   ``fleet_outlier`` event, and is flagged on ``/fleetz``.
 
+**Closed-loop fleet autoscaler** (doc/robustness.md "Fleet
+autoscaling"): ``standby_replicas`` lists pre-provisioned replicas
+held OUT of dispatch; one policy pass per prober sweep
+(``autoscale_now``) admits a standby when the federated fleet SLO burn
+reaches ``scale_up_burn`` or there is queued work with zero free
+decode slots anywhere (bounds ``scale_min``/``scale_max``), and
+retires a scale-up-admitted replica idle for ``scale_down_idle_s`` —
+at most one action per ``scale_cooldown_s`` (hysteresis; any load
+resets the idle timers). Decisions are transition-only ``fleet_scale``
+events + the ``cxxnet_fleet_target_replicas`` /
+``cxxnet_fleet_scale_events_total`` series and an /fleetz section.
+
+**Multi-tenant weighted-fair QoS** (doc/serving.md "Multi-tenant
+QoS"): with a ``tenants`` table the router validates/forwards the
+``TENANT`` prefix (same downgrade discipline as TRACE — a pre-TENANT
+replica's ``ERR parse`` pays progressively barer resends, each safe
+because a parse rejection proves the request never dispatched, and
+latches ``no_tenant``), keeps per-tenant reconciling books, sheds an
+over-share tenant at the door when the fleet is saturated, and merges
+per-tenant SLO windows/latency histograms into
+``cxxnet_fleet_tenant_*{tenant=}`` series. Its own per-tenant trackers
+observe ONLY zero-attempt outcomes (door sheds), so the federated
+merge never counts a request twice.
+
 Deliberately jax-free (the replicas are other processes); ``python -m
 cxxnet_tpu.utils.routerd --selftest`` drives routing, retry, ejection,
 rolling reload and drain over real loopback sockets with in-process
 servd replicas — ``make check`` gates on it. The driver surface is
 ``task = route`` (conf keys ``route_port`` / ``route_replicas`` /
 ``route_probe_ms`` / ``route_retries`` / ``route_stall_s`` /
-``route_host`` — doc/serving.md "Replicated serving fleet").
+``route_host`` / ``route_standby_replicas`` / ``route_scale_*`` /
+``route_tenants`` — doc/serving.md "Replicated serving fleet").
 """
 
 from __future__ import annotations
@@ -172,6 +200,11 @@ _COUNTERS = {
     "retries": "route.retries",
     "client_gone": "route.client_gone",
 }
+# the per-tenant reconciling subset — ONE definition with servd (the
+# shared-parser discipline: router and replica books must never
+# desynchronize): accepted == served + errors + shed + deadline per
+# tenant
+_TENANT_KEYS = servd._TENANT_KEYS
 
 
 
@@ -202,13 +235,17 @@ def parse_replicas(spec) -> List[Tuple[str, int, int]]:
 def retryable(resp: str) -> bool:
     """The retryability half of the wire contract (module docstring):
     True only when the response PROVES the request never dispatched to
-    a backend — a shed (``ERR busy``, any detail) or a drain refusal
-    that is not the drain-gave-up-on-in-flight case (``ERR draining
-    backend``). Everything else stays with the replica: exactly-once
-    beats availability."""
+    a backend — a shed (``ERR busy``) or a drain refusal that is not
+    the drain-gave-up-on-in-flight case (``ERR draining backend``) —
+    AND a different replica could rule differently. ``ERR busy
+    tenant`` is the exception on the second clause: it never
+    dispatched, but it is the weighted-fair POLICY verdict, and every
+    replica shares the tenant table — retrying a flooding tenant's
+    shed elsewhere only doubles the flood's traffic. Everything else
+    stays with the replica: exactly-once beats availability."""
     toks = resp.split()
     if toks[:2] == ["ERR", "busy"]:
-        return True
+        return toks[2:3] != ["tenant"]
     if toks[:2] == ["ERR", "draining"]:
         return toks[2:3] != ["backend"]
     return False
@@ -228,16 +265,59 @@ def _http_get(host: str, port: int, path: str,
         return e.code, e.read().decode("utf-8", "replace")
 
 
+class _SloMerge:
+    """Accumulate SLOTracker.snapshot() dicts from N replicas into ONE
+    merged-window account (requests/bad summed, the tightest budget,
+    fleet-wide alert floors) — the shape ``federation_snapshot`` hangs
+    on ``slo`` fleet-wide and on ``slo_tenants`` per tenant."""
+
+    def __init__(self):
+        self.req = self.bad = 0
+        self.budget = None
+        self.floor_req = self.floor_bad = 1
+        self.seen = False
+
+    def add(self, slo) -> None:
+        if not slo:
+            return
+        self.seen = True
+        self.req += int(slo.get("requests", 0))
+        self.bad += int(slo.get("bad", 0))
+        if slo.get("budget") is not None:
+            b = float(slo["budget"])
+            self.budget = b if self.budget is None \
+                else min(self.budget, b)
+        self.floor_req = max(self.floor_req,
+                             int(slo.get("min_requests", 1)))
+        self.floor_bad = max(self.floor_bad, int(slo.get("min_bad", 1)))
+
+    def result(self):
+        if not self.seen or self.budget is None:
+            return None
+        bad_fraction = self.bad / float(self.req) if self.req else 0.0
+        burn = bad_fraction / self.budget
+        return {"requests": self.req, "bad": self.bad,
+                "budget": round(self.budget, 6),
+                "bad_fraction": round(bad_fraction, 6),
+                "burn_rate": round(burn, 4),
+                "alert": 1 if (self.req >= self.floor_req
+                               and self.bad >= self.floor_bad
+                               and burn >= 1.0) else 0}
+
+
 class Replica:
     """One replica's routing state. All mutable fields are guarded by
     the router's fleet lock; the object itself is a dumb record."""
 
     __slots__ = ("name", "host", "port", "status_port", "state",
                  "detail", "hold", "queue_depth", "in_flight",
-                 "free_slots", "outstanding", "probe_fails", "ejections",
-                 "next_probe_at", "last_probe", "no_trace", "trace_ok")
+                 "free_slots", "has_slots", "outstanding",
+                 "probe_fails", "ejections", "next_probe_at",
+                 "last_probe", "no_trace", "trace_ok",
+                 "no_tenant", "tenant_ok", "standby", "from_standby")
 
-    def __init__(self, host: str, port: int, status_port: int):
+    def __init__(self, host: str, port: int, status_port: int,
+                 standby: bool = False):
         self.host = host
         self.port = int(port)
         self.status_port = int(status_port)
@@ -254,6 +334,10 @@ class Replica:
         #                              batching replica reports free
         #                              decode slots; old replicas omit
         #                              the field (0 = no bonus)
+        self.has_slots = False       # whether the replica REPORTS
+        #                              free_slots at all — absent means
+        #                              no batching, and 0 must then read
+        #                              as "unknown", not "saturated"
         self.outstanding = 0         # router-side live request count
         self.probe_fails = 0
         self.ejections = 0           # backoff exponent while dead
@@ -271,9 +355,21 @@ class Replica:
         # re-admission — a rollback may have downgraded the binary)
         self.no_trace = False
         self.trace_ok = False
+        # the TENANT prefix's pre-tenant latch pair — exactly the TRACE
+        # discipline: no_tenant after a proven downgrade, tenant_ok
+        # after a proven parse, both re-learned on DEAD -> UP
+        self.no_tenant = False
+        self.tenant_ok = False
+        # autoscaler state: a standby replica is listed in the conf but
+        # held OUT of dispatch until a scale-up admits it; from_standby
+        # marks scale-up admits as the ones a scale-down may retire
+        # (the fleet returns to its configured shape when idle)
+        self.standby = bool(standby)
+        self.from_standby = bool(standby)
 
     def snapshot(self, now: float) -> dict:
         return {"name": self.name, "state": self.state,
+                "standby": self.standby,
                 "detail": self.detail, "hold": self.hold,
                 "queue_depth": self.queue_depth,
                 "in_flight": self.in_flight,
@@ -306,11 +402,61 @@ class Router:
                  flight_cap: int = 256,
                  federate_ms: float = 1000.0,
                  outlier_ratio: float = 3.0,
-                 outlier_min_n: int = 20):
+                 outlier_min_n: int = 20,
+                 standby_replicas=None,
+                 scale_min: int = 0, scale_max: int = 0,
+                 scale_up_burn: float = 1.0,
+                 scale_down_idle_s: float = 30.0,
+                 scale_cooldown_s: float = 10.0,
+                 tenants=None, tenant_default: str = "default",
+                 slo_tenants=None):
         specs = parse_replicas(replicas)
         if not specs:
             raise ValueError("router needs at least one replica")
         self._replicas = [Replica(*s) for s in specs]
+        # autoscaler (module docstring "Fleet autoscaling"): standby
+        # replicas ride the same probe/state machinery but are held out
+        # of dispatch until autoscale_now() admits one; bounds default
+        # to [primary count, total count]
+        standby_specs = parse_replicas(standby_replicas or [])
+        self._replicas += [Replica(*s, standby=True)
+                           for s in standby_specs]
+        n_primary = len(specs)
+        self.scale_min = int(scale_min) if scale_min > 0 else n_primary
+        self.scale_max = int(scale_max) if scale_max > 0 \
+            else len(self._replicas)
+        self.scale_up_burn = float(scale_up_burn)
+        self.scale_down_idle_s = float(scale_down_idle_s)
+        self.scale_cooldown_s = float(scale_cooldown_s)
+        # scale decisions + idle bookkeeping live under their own rank
+        # (lockrank "routerd.scale", OUTSIDE the fleet lock: a decision
+        # reads fleet state and then marks replicas under it); all IO —
+        # probing a standby before admitting it — stays outside
+        self._scale_lock = lockrank.lock("routerd.scale")
+        self._scale_last = -float("inf")   # monotonic of last action
+        self._scale_events = 0
+        self._scale_log: List[dict] = []
+        self._idle_since: Dict[str, float] = {}
+        # multi-tenant weighted-fair QoS: the shared tenant table (one
+        # parse_tenants implementation with servd — the processes
+        # enforcing fairness must agree on it)
+        self._tenants = servd.parse_tenants(tenants)
+        self.tenant_default = str(tenant_default)
+        if self._tenants and self.tenant_default not in self._tenants:
+            self._tenants[self.tenant_default] = 1.0
+        self._tstats: Dict[str, Dict[str, int]] = {
+            t: {k: 0 for k in _TENANT_KEYS} for t in self._tenants}
+        self._tenant_active: Dict[str, int] = {
+            t: 0 for t in self._tenants}
+        # per-tenant SLO trackers for requests that NEVER touched a
+        # replica (door sheds — the fair-share gate, no-routable-fleet,
+        # router-side deadline): every replica-touching request is
+        # already in some replica's own window, so observing only the
+        # zero-attempt outcomes here keeps the federated merge
+        # double-count-free while a flood shed entirely at the router's
+        # door still burns ITS tenant's fleet-wide budget (the
+        # burn-reads-0-under-total-overload trap, the router edition)
+        self.slo_tenants = dict(slo_tenants or {})
         self.probe_s = max(0.01, float(probe_ms) / 1e3)
         self.retries = max(0, int(retries))
         self.stall_s = float(stall_s)
@@ -401,7 +547,8 @@ class Router:
             return False, "draining: not accepting new requests"
         with self._lock:
             n = sum(1 for r in self._replicas
-                    if r.state == UP and not r.hold)
+                    if r.state == UP and not r.hold
+                    and not r.standby)
             total = len(self._replicas)
         if n == 0:
             return False, ("no routable replica (0 of %d up)" % total)
@@ -419,7 +566,8 @@ class Router:
         with self._lock:
             reps = [r.snapshot(now) for r in self._replicas]
             eligible = sum(1 for r in self._replicas
-                           if r.state == UP and not r.hold)
+                           if r.state == UP and not r.hold
+                           and not r.standby)
             windows = [{"replica": n, "out_s": round(a, 3),
                         "back_s": round(b, 3)}
                        for n, a, b in self._windows[-32:]]
@@ -436,6 +584,23 @@ class Router:
                 if v is not None:
                     rsnap["outlier"] = v["outlier"]
                     rsnap["p99_ms"] = v["p99_ms"]
+        if self.scaling_enabled():
+            body["scale"] = self.scale_snapshot()
+        if self._tenants:
+            # one per-tenant table joining the router's own books, the
+            # federated fleet books, and the per-tenant fleet SLO — the
+            # /fleetz "tenants" section and the cxxnet_fleet_tenant_*
+            # label rows render from exactly this
+            tstats = self.tenant_stats()
+            ften = (fed or {}).get("tenants") or {}
+            fslo = (fed or {}).get("slo_tenants") or {}
+            body["tenants"] = {
+                t: {"weight": self._tenants[t],
+                    "router": tstats.get(t) or {},
+                    "fleet": ften.get(t) or {},
+                    "slo": fslo.get(t),
+                    "p99_ms": (ften.get(t) or {}).get("p99_ms")}
+                for t in sorted(self._tenants)}
         return body
 
     # -- replica state machine (fleet lock) ----------------------------
@@ -455,6 +620,8 @@ class Router:
                 # TRACE capability from scratch
                 r.no_trace = False
                 r.trace_ok = False
+                r.no_tenant = False
+                r.tenant_ok = False
             if state == DEAD:
                 # ejection: re-probe on the shared backoff curve; each
                 # consecutive failure doubles the wait
@@ -515,6 +682,7 @@ class Router:
                     # absent on pre-batching replicas: reset to 0, not
                     # last-known — the field IS the capability signal
                     r.free_slots = st.get("free_slots", 0)
+                    r.has_slots = "free_slots" in st
             self._mark(r, UP, "ready")
         else:
             lower = body.lower()
@@ -544,6 +712,10 @@ class Router:
                            >= self.federate_s)
                 if due:
                     self.federate_now()
+            # the control-plane half: every sweep's fresh signals feed
+            # one autoscale policy pass (no-op without standbys; its
+            # own cooldown is the hysteresis)
+            self.autoscale_now()
         health.pause("route.probe")
 
     # -- dispatch ------------------------------------------------------
@@ -564,7 +736,7 @@ class Router:
         them, so a routing decision stays explainable after the fact."""
         with self._lock:
             elig = [r for r in self._replicas
-                    if r.state == UP and not r.hold
+                    if r.state == UP and not r.hold and not r.standby
                     and r.name not in exclude]
             if not elig:
                 return None, []
@@ -631,6 +803,69 @@ class Router:
             self._trace_n += 1
             return "%s-%d" % (self._trace_prefix, self._trace_n)
 
+    def _bump_tenant(self, tenant: Optional[str], *names: str) -> None:
+        """Per-tenant half of _bump (the reconciling subset) plus the
+        ``route.tenant.<t>.<key>`` telemetry mirror — tenant names are
+        conf-bounded, so the series set is too."""
+        if not self._tenants or tenant not in self._tstats:
+            return
+        keys = [n for n in names if n in _TENANT_KEYS]
+        if not keys:
+            return
+        with self._slock:
+            st = self._tstats[tenant]
+            for k in keys:
+                st[k] += 1
+        for k in keys:
+            telemetry.count("route.tenant.%s.%s" % (tenant, k))
+
+    def tenant_stats(self) -> dict:
+        with self._slock:
+            return {t: dict(st) for t, st in self._tstats.items()}
+
+    def _tenant_gate(self, tenant: Optional[str]) -> Optional[str]:
+        """The router's weighted-fair admission check: when the fleet
+        is SATURATED — every eligible replica either has a queued
+        backlog, or (a batching replica, which reports ``free_slots``)
+        is busy with zero free decode slots; a merely-busy solo replica
+        with an empty queue is NOT saturated — a tenant already holding
+        at least its weighted fair share of the router's in-flight
+        requests is shed at the door: third token ``tenant``, the
+        policy verdict that holds fleet-wide under the shared tenant
+        table (never retried: every replica would rule the same way).
+        The share is computed over the tenants ACTIVE right now
+        (work-conserving, like _FairQueue's borrow rule: the only
+        sending tenant owns the whole fleet — an idle tenant's share is
+        never reserved against live traffic), and is floored at 1, so
+        a tenant with nothing in flight is never gated. An unsaturated
+        fleet admits everyone — fairness never taxes an idle fleet.
+        Returns the shed line, or None to admit."""
+        if not self._tenants or tenant is None:
+            return None
+        with self._lock:
+            elig = [r for r in self._replicas
+                    if r.state == UP and not r.hold and not r.standby]
+            saturated = bool(elig) and all(
+                r.queue_depth > 0
+                or (r.has_slots and r.free_slots <= 0
+                    and (r.in_flight + r.outstanding) > 0)
+                for r in elig)
+        if not saturated:
+            return None
+        with self._slock:
+            active = dict(self._tenant_active)
+        total = sum(active.values()) + 1      # the arrival included
+        live = {t for t, n in active.items() if n > 0}
+        live.add(tenant)
+        weight_sum = sum(self._tenants[t] for t in live)
+        share = max(1, int(total * self._tenants[tenant] / weight_sum))
+        mine = active.get(tenant, 0)
+        if mine < share:
+            return None
+        return ("ERR busy tenant %s over fair share (router: %d "
+                "in flight / share %d, fleet saturated)"
+                % (tenant, mine, share))
+
     def _handle(self, line: str) -> str:
         """Route one request line; returns the one response line."""
         parts = line.split()
@@ -640,6 +875,28 @@ class Router:
         # with the same ERR proto a replica would (ONE shared checker:
         # servd.parse_trace_prefix), mint otherwise
         tid, proto_detail, parts = servd.parse_trace_prefix(parts)
+        # the tenant prefix rides the same discipline (one shared
+        # checker: servd.parse_tenant_prefix; TRACE first, then TENANT,
+        # then DEADLINE). tenant_sent is the id to FORWARD — a
+        # defaulted tenant stays off the wire so prefix-less clients
+        # hit the replica byte-identically to the pre-tenant protocol
+        tenant_sent = None
+        if proto_detail is None:
+            tenant_sent, proto_detail, parts = \
+                servd.parse_tenant_prefix(parts)
+            if proto_detail is None and tenant_sent is not None \
+                    and self._tenants \
+                    and tenant_sent not in self._tenants:
+                proto_detail = ("tenant %s is not in the configured "
+                                "tenant table" % tenant_sent)
+        # the accounted tenant: None on a protocol violation (nothing
+        # to charge a malformed/unknown id to), the configured default
+        # for prefix-less clients otherwise
+        tenant = None
+        if proto_detail is None:
+            tenant = tenant_sent
+            if tenant is None and self._tenants:
+                tenant = self.tenant_default
         proto_err = None if proto_detail is None \
             else "ERR proto " + proto_detail
         if proto_err is None and parts and parts[0] == "ADMIN":
@@ -668,26 +925,61 @@ class Router:
                 return "ERR draining router is shutting down"
             self._active += 1
         self._bump("accepted")
+        self._bump_tenant(tenant, "accepted")
         if tid is None:
             tid = self._mint_trace_id()
+        tracked = bool(self._tenants) and tenant is not None
         try:
             attempts: List[dict] = []
             if proto_err is not None:
                 text, outcome = proto_err, "errors"
             else:
-                text, outcome = self._route(tid, rest, deadline, t0,
-                                            attempts)
+                # the weighted-fair admission gate BEFORE any replica
+                # is touched: a saturated fleet sheds the over-share
+                # tenant at the router's door instead of burning a
+                # replica queue slot (and a retry) on a verdict every
+                # replica would reach anyway
+                gate = self._tenant_gate(tenant)
+                if gate is not None:
+                    text, outcome = gate, "shed"
+                else:
+                    if tracked:
+                        with self._slock:
+                            self._tenant_active[tenant] += 1
+                    try:
+                        text, outcome = self._route(
+                            tid, rest, deadline, t0, attempts,
+                            tenant=tenant_sent)
+                    finally:
+                        if tracked:
+                            with self._slock:
+                                self._tenant_active[tenant] -= 1
+            reached = any(a.get("status") != "noconnect"
+                          for a in attempts)
+            if not reached and tenant is not None \
+                    and outcome != "served":
+                # nothing reached a replica window — zero attempts
+                # (door sheds, router deadline, proto) or every
+                # attempt connect-refused (fleet-wide outage): the
+                # router's own per-tenant tracker burns for it. A
+                # "lost" attempt counts as reached — the replica MAY
+                # have accepted it into its own window, and the merge
+                # must never count a request twice.
+                tr = self.slo_tenants.get(tenant)
+                if tr is not None:
+                    tr.observe(ok=False)
             total = time.monotonic() - t0
             # the flight record + route_request_done event land BEFORE
             # the response goes out (the servd rule): a client that
             # just read its answer can immediately /trace?request=<id>
             self._record_request(tid, outcome, text, attempts, total,
-                                 deadline_ms)
+                                 deadline_ms, tenant)
             # outcome lands BEFORE the active slot is released: drain()
             # snapshots final stats the moment _active hits 0, and an
             # accepted-but-not-yet-outcomed request would read as
             # non-reconciling books in the route_done event
             self._bump(outcome)
+            self._bump_tenant(tenant, outcome)
             telemetry.hist("route.request", total)
         finally:
             with self._lock:
@@ -696,8 +988,10 @@ class Router:
 
     def _record_request(self, tid: str, outcome: str, text: str,
                         attempts: List[dict], total: float,
-                        deadline_ms: Optional[float]) -> None:
+                        deadline_ms: Optional[float],
+                        tenant: Optional[str] = None) -> None:
         rec = {"id": tid, "outcome": outcome,
+               "tenant": tenant,
                "resp": " ".join(text.split()[:3])
                if text.startswith("ERR") else "served",
                # cxxlint: disable=wallclock — flight-record accept
@@ -710,16 +1004,20 @@ class Router:
                "retries": max(0, len(attempts) - 1),
                "attempts": attempts}
         self.flight.record(rec)
-        telemetry.event({"ev": "route_request_done", "req": tid,
-                         "outcome": outcome,
-                         "attempts": len(attempts),
-                         "replicas": [a["replica"] for a in attempts],
-                         "retries": rec["retries"],
-                         "total_s": rec["total_s"]})
+        ev = {"ev": "route_request_done", "req": tid,
+              "outcome": outcome,
+              "attempts": len(attempts),
+              "replicas": [a["replica"] for a in attempts],
+              "retries": rec["retries"],
+              "total_s": rec["total_s"]}
+        if tenant is not None:
+            ev["tenant"] = tenant
+        telemetry.event(ev)
 
     def _route(self, tid: str, rest: List[str],
                deadline: Optional[float], t0: float,
-               attempts_out: List[dict]) -> Tuple[str, str]:
+               attempts_out: List[dict],
+               tenant: Optional[str] = None) -> Tuple[str, str]:
         tried: set = set()
         attempts = 0
         last_shed: Optional[str] = None
@@ -747,33 +1045,47 @@ class Router:
                                                body)
             with self._lock:
                 traced = not r.no_trace
-            sendline = ("TRACE %s %s" % (tid, sendbody)) if traced \
-                else sendbody
+                tenanted = tenant is not None and not r.no_tenant
+            # wire order: TRACE <id> TENANT <t> DEADLINE <ms> <toks> —
+            # the replica parser strips them in exactly this order
+            sendline = sendbody
+            if tenanted:
+                sendline = "TENANT %s %s" % (tenant, sendline)
+            if traced:
+                sendline = "TRACE %s %s" % (tid, sendline)
             t_att = time.monotonic()
             att = {"replica": r.name,
                    "t_off_s": round(t_att - t0, 6),
                    "candidates": cands}
             try:
                 status, resp = self._forward(r, sendline, timeout)
-                if traced and status == "ok":
+                if (traced or tenanted) and status == "ok":
                     if not resp.startswith("ERR parse"):
-                        # ANY other answer to a traced line proves the
-                        # prefix was parsed: latch trace_ok so later
-                        # genuine client parse errors never pay the
-                        # downgrade resend (one write, then steady)
-                        if not r.trace_ok:
+                        # ANY other answer to a prefixed line proves
+                        # the prefixes were parsed: latch the positive
+                        # capability flags so later genuine client
+                        # parse errors never pay the downgrade resends
+                        # (one write each, then steady)
+                        if (traced and not r.trace_ok) \
+                                or (tenanted and not r.tenant_ok):
                             with self._lock:
-                                r.trace_ok = True
-                    elif not r.trace_ok:
-                        # maybe a pre-TRACE replica rejecting the
-                        # prefix itself: a parse rejection proves the
-                        # request never dispatched, so ONE bare resend
-                        # is exactly-once safe. A genuine client parse
-                        # error comes back identical and is relayed; a
-                        # different answer proves the replica is old —
-                        # latch no_trace.
-                        status, resp = self._trace_downgrade(
-                            r, sendbody, timeout, att, resp)
+                                if traced:
+                                    r.trace_ok = True
+                                if tenanted:
+                                    r.tenant_ok = True
+                    else:
+                        # maybe an OLD replica rejecting a prefix
+                        # itself: a parse rejection proves the request
+                        # never dispatched, so each progressively
+                        # barer resend is exactly-once safe. A genuine
+                        # client parse error comes back identical at
+                        # every rung and is relayed; a changed answer
+                        # proves which prefix the replica predates —
+                        # latch it (the ladder: drop TENANT first —
+                        # newer than TRACE — then TRACE too).
+                        status, resp = self._prefix_downgrade(
+                            r, tid, sendbody, traced, tenanted,
+                            timeout, att, resp)
             finally:
                 self._checkin(r)
             att["latency_s"] = round(time.monotonic() - t_att, 6)
@@ -820,28 +1132,61 @@ class Router:
                     att["retried"] = True
                     continue
                 return resp, "shed"
+            if resp.split()[:3] == ["ERR", "busy", "tenant"]:
+                # the replica's fair-share verdict: a shed (never
+                # dispatched), relayed WITHOUT retry — the tenant
+                # table is fleet-wide, so every replica rules the same
+                return resp, "shed"
             if resp.startswith("ERR deadline"):
                 return resp, "deadline"
             if resp.startswith("ERR"):
                 return resp, "errors"
             return resp, "served"
 
-    def _trace_downgrade(self, r: Replica, sendbody: str,
-                         timeout: float, att: dict,
-                         first_resp: str) -> Tuple[str, Optional[str]]:
-        """The pre-TRACE compat path (module docstring): resend the
-        bare line once; whatever comes back (including noconnect/lost)
-        is THE attempt's result — the traced try provably never
-        dispatched. A changed answer proves the replica does not speak
-        TRACE: latch it so future forwards skip the prefix."""
-        status, resp = self._forward(r, sendbody, timeout)
-        if status == "ok" and not resp.startswith("ERR parse"):
-            with self._lock:
-                r.no_trace = True
-            att["trace_downgraded"] = True
-            telemetry.count("route.trace_downgrades")
-            telemetry.event({"ev": "route_trace_downgrade",
-                             "replica": r.name})
+    def _prefix_downgrade(self, r: Replica, tid: str, sendbody: str,
+                          traced: bool, tenanted: bool, timeout: float,
+                          att: dict,
+                          first_resp: str) -> Tuple[str, Optional[str]]:
+        """The pre-TRACE / pre-TENANT compat ladder (module docstring):
+        the prefixed attempt came back ``ERR parse``, which proves it
+        never dispatched — so each progressively barer resend is
+        exactly-once safe. Rung 1 drops TENANT (the newer prefix; a
+        changed answer latches ``no_tenant`` — and proves TRACE parsed,
+        so ``trace_ok`` latches too). Rung 2 drops TRACE as well (a
+        pre-TRACE replica certainly predates TENANT: both latch). An
+        answer identical at every rung is a genuine client parse error,
+        relayed verbatim with no latch. Skips rungs whose capability is
+        already proven (``trace_ok``/``tenant_ok``) — a proven prefix
+        cannot be what the replica rejected."""
+        status, resp = "ok", first_resp
+        if tenanted and not r.tenant_ok:
+            line = sendbody if not traced \
+                else "TRACE %s %s" % (tid, sendbody)
+            status, resp = self._forward(r, line, timeout)
+            if status != "ok":
+                return status, resp
+            if not resp.startswith("ERR parse"):
+                with self._lock:
+                    r.no_tenant = True
+                    if traced:
+                        r.trace_ok = True
+                att["tenant_downgraded"] = True
+                telemetry.count("route.tenant_downgrades")
+                telemetry.event({"ev": "route_tenant_downgrade",
+                                 "replica": r.name})
+                return status, resp
+        if traced and not r.trace_ok:
+            status, resp = self._forward(r, sendbody, timeout)
+            if status == "ok" and not resp.startswith("ERR parse"):
+                with self._lock:
+                    r.no_trace = True
+                    if tenanted:
+                        # a replica too old for TRACE predates TENANT
+                        r.no_tenant = True
+                att["trace_downgraded"] = True
+                telemetry.count("route.trace_downgrades")
+                telemetry.event({"ev": "route_trace_downgrade",
+                                 "replica": r.name})
         return status, resp
 
     def _retry_allowed(self, attempts: int) -> bool:
@@ -858,7 +1203,12 @@ class Router:
         with self._lock:
             by: Dict[str, int] = {}
             for r in self._replicas:
-                key = "held" if (r.state == UP and r.hold) else r.state
+                if r.standby:
+                    key = "standby"
+                elif r.state == UP and r.hold:
+                    key = "held"
+                else:
+                    key = r.state
                 by[key] = by.get(key, 0) + 1
         return " ".join("%s=%d" % kv for kv in sorted(by.items()))
 
@@ -1029,6 +1379,21 @@ class Router:
                              "p99_ms": v["p99_ms"],
                              "fleet_p99_ms": v["fleet_p99_ms"]})
 
+    def federation_slo(self) -> Optional[dict]:
+        """The fleet-wide merged-window SLO account alone (None before
+        the first sweep or without SLO-carrying replicas) — the
+        autoscaler reads this every prober sweep, so it must not pay
+        the full federation_snapshot histogram/counter merge per tick
+        just to extract one burn rate."""
+        with self._fed_lock:
+            snaps = [d["snap"] for d in self._fed.values()]
+        if not snaps:
+            return None
+        acc = _SloMerge()
+        for snap in snaps:
+            acc.add(snap.get("slo"))
+        return acc.result()
+
     def federation_snapshot(self) -> Optional[dict]:
         """The merged fleet view (None before the first sweep): serve
         histograms merged EXACTLY (shared fixed buckets: bucket-count
@@ -1045,10 +1410,8 @@ class Router:
                         for name, v in self._fed_outlier.items()}
         hists: Dict[str, telemetry.Histogram] = {}
         counters: Dict[str, float] = {}
-        slo_req = slo_bad = 0
-        slo_budget = None
-        slo_floor_req = slo_floor_bad = 1
-        slo_seen = False
+        slo_acc = _SloMerge()
+        slo_tenant_acc: Dict[str, _SloMerge] = {}
         for name, snap in sorted(fed.items()):
             m = snap.get("metrics") or {}
             for hname, d in (m.get("hists") or {}).items():
@@ -1062,43 +1425,200 @@ class Router:
             for cname, v in (m.get("counters") or {}).items():
                 if cname.startswith("serve."):
                     counters[cname] = counters.get(cname, 0) + v
-            slo = snap.get("slo")
-            if slo:
-                # the merged-window account: each replica's rolling
-                # window contributes its request/bad counts. The alert
-                # floors are fleet-wide — N replicas each one bad
-                # request under their own min_bad can still page here
-                # (the fleet-over case no single replica triggers)
-                slo_seen = True
-                slo_req += int(slo.get("requests", 0))
-                slo_bad += int(slo.get("bad", 0))
-                if slo.get("budget") is not None:
-                    b = float(slo["budget"])
-                    slo_budget = b if slo_budget is None \
-                        else min(slo_budget, b)
-                slo_floor_req = max(slo_floor_req,
-                                    int(slo.get("min_requests", 1)))
-                slo_floor_bad = max(slo_floor_bad,
-                                    int(slo.get("min_bad", 1)))
+            # the merged-window account: each replica's rolling
+            # window contributes its request/bad counts. The alert
+            # floors are fleet-wide — N replicas each one bad
+            # request under their own min_bad can still page here
+            # (the fleet-over case no single replica triggers).
+            # Per-tenant windows merge the same way, per tenant.
+            slo_acc.add(snap.get("slo"))
+            for t, tslo in (snap.get("slo_tenants") or {}).items():
+                slo_tenant_acc.setdefault(str(t), _SloMerge()).add(tslo)
+        # the router's own per-tenant windows (door sheds only — see
+        # __init__: zero-attempt outcomes, so no request is counted in
+        # two windows) join the fleet merge
+        for t, tr in sorted(self.slo_tenants.items()):
+            slo_tenant_acc.setdefault(str(t), _SloMerge()).add(
+                tr.snapshot())
         out = {"replicas": len(fed), "age_s": round(age, 3),
                "series": {name: dict(h.stats(),
                                      buckets=h.to_dict()["buckets"])
                           for name, h in sorted(hists.items())},
                "counters": counters,
                "outliers": outliers,
-               "slo": None}
-        if slo_seen and slo_budget is not None:
-            bad_fraction = slo_bad / float(slo_req) if slo_req else 0.0
-            burn = bad_fraction / slo_budget
-            out["slo"] = {
-                "requests": slo_req, "bad": slo_bad,
-                "budget": round(slo_budget, 6),
-                "bad_fraction": round(bad_fraction, 6),
-                "burn_rate": round(burn, 4),
-                "alert": 1 if (slo_req >= slo_floor_req
-                               and slo_bad >= slo_floor_bad
-                               and burn >= 1.0) else 0}
+               "slo": slo_acc.result(),
+               "slo_tenants": {t: res
+                               for t, acc in
+                               sorted(slo_tenant_acc.items())
+                               for res in [acc.result()]
+                               if res is not None}}
+        # the per-tenant fleet account, parsed back out of the summed
+        # serve.tenant.<t>.<key> counter series and the merged
+        # serve.tenant.<t>.request histograms: fleet-wide per-tenant
+        # books (reconciling like the replica-local ones) plus each
+        # tenant's fleet p99 — what "the victim's p99 holds" is read
+        # from
+        tenants: Dict[str, dict] = {}
+        for cname, v in counters.items():
+            if not cname.startswith("serve.tenant."):
+                continue
+            t, _, key = cname[len("serve.tenant."):].rpartition(".")
+            if t:
+                tenants.setdefault(t, {})[key] = v
+        for hname, h in hists.items():
+            if hname.startswith("serve.tenant.") \
+                    and hname.endswith(".request"):
+                t = hname[len("serve.tenant."):-len(".request")]
+                if t:
+                    p99 = h.percentile(99)
+                    tenants.setdefault(t, {})["p99_ms"] = \
+                        round(1e3 * p99, 3) if p99 is not None else None
+        if tenants:
+            out["tenants"] = tenants
         return out
+
+    # -- closed-loop fleet autoscaler ----------------------------------
+    def scaling_enabled(self) -> bool:
+        return any(r.from_standby for r in self._replicas)
+
+    def scale_snapshot(self) -> dict:
+        """The autoscaler's account for /fleetz and the
+        ``cxxnet_fleet_target_replicas`` /
+        ``cxxnet_fleet_scale_events_total`` series: the current target
+        (active replicas), bounds, and the recent decisions."""
+        with self._lock:
+            active = sum(1 for r in self._replicas if not r.standby)
+            standby = sum(1 for r in self._replicas if r.standby)
+        with self._scale_lock:
+            events = self._scale_events
+            recent = list(self._scale_log[-16:])
+        return {"target_replicas": active, "standby": standby,
+                "min": self.scale_min, "max": self.scale_max,
+                "up_burn": self.scale_up_burn,
+                "down_idle_s": self.scale_down_idle_s,
+                "cooldown_s": self.scale_cooldown_s,
+                "events": events, "recent": recent}
+
+    def autoscale_now(self) -> Optional[str]:
+        """One policy pass over the federated signals (module
+        docstring): returns "up"/"down" when a scale action was taken,
+        None otherwise. The prober runs this each sweep; tests and the
+        selftest call it directly for determinism. Policy:
+
+        * **up** — fleet SLO burn >= ``scale_up_burn`` (the federated
+          merged-window account), OR queued work with zero free decode
+          slots anywhere (demand the fleet provably cannot absorb) —
+          admit one standby, bounded by ``scale_max``. A fleet below
+          ``scale_min`` admits unconditionally (the floor is a floor).
+        * **down** — the fleet is quiet (no queued work, burn < 1) and
+          a scale-up-admitted replica has been completely idle for
+          ``scale_down_idle_s`` — retire it back to standby, never
+          below ``scale_min``.
+        * **hysteresis** — at most one action per ``scale_cooldown_s``
+          (the floor-repair case excepted), and any sign of load
+          resets every idle timer: flap costs a replica a drain.
+
+        Decisions are recorded as transition-only ``fleet_scale``
+        events; counters/gauges ride ``scale_snapshot()``. All IO
+        (probing a standby before admitting it) runs lock-free."""
+        if not self.scaling_enabled():
+            return None
+        now = time.monotonic()
+        with self._lock:
+            active = [r for r in self._replicas if not r.standby]
+            active_up = [r for r in active
+                         if r.state == UP and not r.hold]
+            standbys = [r for r in self._replicas
+                        if r.standby and r.state != DEAD]
+            # pressure = work WAITING (queue depth), never mere
+            # in-flight: one slow request on an otherwise idle solo
+            # fleet must not ratchet capacity to scale_max
+            queue_total = sum(r.queue_depth for r in active_up)
+            busy_total = sum(r.queue_depth + r.in_flight
+                             for r in active_up)
+            free_total = sum(r.free_slots for r in active_up)
+            outstanding = sum(r.outstanding for r in active)
+            idle_names = {r.name for r in active
+                          if r.from_standby and r.state == UP
+                          and not r.hold
+                          and r.queue_depth + r.in_flight
+                          + r.outstanding == 0}
+        fslo = self.federation_slo()
+        burn = None if fslo is None else fslo.get("burn_rate")
+        pressure = queue_total > 0 and free_total <= 0
+        burning = burn is not None and burn >= self.scale_up_burn
+        with self._scale_lock:
+            cool = now - self._scale_last >= self.scale_cooldown_s
+            below_min = len(active_up) < self.scale_min
+            want_up = standbys and len(active) < self.scale_max \
+                and (below_min or (cool and (burning or pressure)))
+            # idle bookkeeping: any load anywhere resets every timer —
+            # a fleet that still has work in it must not shed capacity
+            if burning or pressure or busy_total or outstanding:
+                self._idle_since.clear()
+            else:
+                for name in list(self._idle_since):
+                    if name not in idle_names:
+                        del self._idle_since[name]
+                for name in idle_names:
+                    self._idle_since.setdefault(name, now)
+            ripe = [n for n, t in self._idle_since.items()
+                    if now - t >= self.scale_down_idle_s]
+            want_down = (not want_up and cool and ripe
+                         and len(active_up) > self.scale_min
+                         and not (burning or pressure))
+        if want_up:
+            # prefer a standby already probed UP; IO-free — the
+            # admitted replica keeps being probed like any other, and
+            # a dead-on-arrival standby is ejected by the normal
+            # dispatch/probe machinery
+            pick = next((r for r in standbys if r.state == UP),
+                        standbys[0])
+            reason = ("below scale_min (%d up < %d)"
+                      % (len(active_up), self.scale_min)) \
+                if below_min else \
+                ("fleet slo burn %.2fx >= %g" % (burn or 0.0,
+                                                 self.scale_up_burn)
+                 if burning else
+                 "queued work (%d) with zero free slots" % queue_total)
+            self._scale_apply(pick, up=True, reason=reason,
+                              now=now)
+            return "up"
+        if want_down:
+            with self._lock:
+                pick = next((r for r in self._replicas
+                             if r.name == ripe[0]), None)
+            if pick is None:
+                return None
+            self._scale_apply(pick, up=False,
+                              reason="idle %.1fs >= %g"
+                              % (now - self._idle_since.get(
+                                  pick.name, now),
+                                 self.scale_down_idle_s), now=now)
+            return "down"
+        return None
+
+    def _scale_apply(self, r: Replica, up: bool, reason: str,
+                     now: float) -> None:
+        with self._lock:
+            r.standby = not up
+            active = sum(1 for x in self._replicas if not x.standby)
+        with self._scale_lock:
+            self._scale_last = now
+            self._scale_events += 1
+            self._idle_since.pop(r.name, None)
+            self._scale_log.append({"action": "up" if up else "down",
+                                    "replica": r.name,
+                                    "reason": reason,
+                                    "active": active})
+            if len(self._scale_log) > 64:
+                del self._scale_log[:-64]
+        telemetry.count("route.scale_events")
+        telemetry.gauge("route.target_replicas", active)
+        telemetry.event({"ev": "fleet_scale",
+                         "action": "up" if up else "down",
+                         "replica": r.name, "reason": reason,
+                         "active": active})
 
     # -- stitched cross-process traces ---------------------------------
     def stitched_trace(self, request_id) -> Optional[dict]:

@@ -43,6 +43,8 @@ Wire protocol (one line per request, one line per response, utf-8):
     DEADLINE <ms> <tok> ...         -> same, with a per-request deadline
     TRACE <id> [DEADLINE <ms>] ...  -> same, request adopts the caller's
                                        fleet-wide trace id (see below)
+    TENANT <id> [DEADLINE <ms>] ... -> same, request runs as tenant <id>
+                                       (after TRACE, before DEADLINE)
     ADMIN reload                    -> OK reload scheduled
     ADMIN stats                     -> OK accepted=.. served=.. ...
     (anything else)                 -> ERR <class> <detail>
@@ -60,10 +62,25 @@ be 1..``TRACE_ID_MAX`` chars of ``[A-Za-z0-9._:-]``; anything else is
 answered ``ERR proto trace ...`` (class ``proto``: a protocol-level
 violation, deterministic, never dispatched).
 
+**Multi-tenant weighted-fair QoS** (doc/serving.md "Multi-tenant
+QoS"): a ``tenants`` table (``parse_tenants("free:1,paid:4")`` — give
+every process in the fleet the SAME value) makes the admission queue
+per-tenant weighted-fair (``_FairQueue``: stride-scheduled pops, fair
+shares of the queue bound with borrow-then-evict capacity fairness),
+adds per-tenant books to ``ADMIN stats`` (``tenant.<id>.<key>=N``,
+reconciling per tenant), per-tenant latency histograms
+(``serve.tenant.<t>.request`` — the federation merges them into fleet
+p99s) and per-tenant SLO windows (``slo_tenants``). The ``TENANT``
+prefix names the request's tenant; prefix-less clients run as
+``tenant_default``. A tenant at/over its fair share of a full queue is
+shed ``ERR busy tenant ...`` — third token wire format: the fleet
+router relays it WITHOUT retry (the verdict holds fleet-wide).
+
 Error classes: ``empty`` (blank request — visible instead of a silently
 missing response), ``parse`` (non-integer token, token outside vocab, bad
-DEADLINE), ``proto`` (malformed TRACE prefix), ``busy`` (queue full or
-breaker open: shed), ``deadline``,
+DEADLINE), ``proto`` (malformed TRACE or TENANT prefix, unknown
+tenant), ``busy`` (queue full, breaker open, or tenant over fair
+share: shed), ``deadline``,
 ``backend``, ``draining``. The THIRD token of an error line is a
 machine-readable detail token — the retryability contract the fleet
 router (utils/routerd.py) dispatches on, so these are wire format, not
@@ -186,7 +203,8 @@ from . import statusd
 from . import telemetry
 
 __all__ = ["CircuitBreaker", "ServeFrontend", "embed_vocab",
-           "TRACE_ID_MAX", "valid_trace_id", "selftest"]
+           "TRACE_ID_MAX", "valid_trace_id", "TENANT_ID_MAX",
+           "valid_tenant_id", "parse_tenants", "selftest"]
 
 # the TRACE prefix's id bound: long enough for any reasonable minting
 # scheme (router prefix + counter, uuid hex), short enough that a
@@ -219,6 +237,66 @@ def parse_trace_prefix(parts: List[str]):
         return None, ("trace id must be 1..%d chars of "
                       "[A-Za-z0-9._:-]" % TRACE_ID_MAX), parts
     return parts[1], None, parts[2:]
+
+
+# the TENANT prefix's id bound: tenant names are CONFIG identifiers
+# (route_tenants / serve_tenant_default), not free-form client strings —
+# short, and ':' is excluded (it is the weight separator in the conf
+# value "free:1,paid:4")
+TENANT_ID_MAX = 32
+_TENANT_ID_RE = re.compile(r"[A-Za-z0-9._-]{1,%d}$" % TENANT_ID_MAX)
+
+
+def valid_tenant_id(tid: str) -> bool:
+    """The TENANT id charset/length contract, shared with the router
+    (it validates before forwarding): 1..32 chars of ``[A-Za-z0-9._-]``
+    — safe in metric names, label values, and the conf syntax."""
+    return bool(_TENANT_ID_RE.match(tid))
+
+
+def parse_tenant_prefix(parts: List[str]):
+    """Strip a leading ``TENANT <id>`` from a token list ->
+    ``(tenant, proto_detail, rest)``. ``tenant`` is None when no prefix
+    was present; ``proto_detail`` (None when valid) is the detail text
+    of the ``ERR proto`` line — ONE implementation of the wire-format
+    check, shared by servd's parser and the router's (the
+    parse_trace_prefix discipline: the two must never desynchronize)."""
+    if parts[:1] != ["TENANT"]:
+        return None, None, parts
+    if len(parts) < 2:
+        return None, "tenant prefix needs an id", parts
+    if not valid_tenant_id(parts[1]):
+        return None, ("tenant id must be 1..%d chars of "
+                      "[A-Za-z0-9._-]" % TENANT_ID_MAX), parts
+    return parts[1], None, parts[2:]
+
+
+def parse_tenants(spec):
+    """``route_tenants`` conf value -> ``{tenant: weight}``.
+    ``"free:1,paid:4"`` (comma/whitespace separated, ``name[:weight]``,
+    weight defaults to 1). Empty/None -> ``{}`` (single-tenant mode:
+    every fairness path short-circuits to pre-tenant behavior). Shared
+    by servd, routerd, and the driver so the tenant table cannot drift
+    between the processes enforcing it."""
+    if not spec:
+        return {}
+    if isinstance(spec, dict):
+        out = {str(k): float(v) for k, v in spec.items()}
+    else:
+        out = {}
+        for item in re.split(r"[,\s]+", str(spec).strip()):
+            if not item:
+                continue
+            name, _, w = item.partition(":")
+            out[name] = float(w) if w else 1.0
+    for name, w in out.items():
+        if not valid_tenant_id(name):
+            raise ValueError("tenant name %r is not 1..%d chars of "
+                             "[A-Za-z0-9._-]" % (name, TENANT_ID_MAX))
+        if not (w > 0):
+            raise ValueError("tenant %r needs a positive weight, got %r"
+                             % (name, w))
+    return out
 
 
 def embed_vocab(net) -> int:
@@ -331,10 +409,12 @@ class _ConnState:
 
 class _Request:
     __slots__ = ("toks", "deadline", "t_arrival", "t_wall", "reply",
-                 "done", "seq", "id", "_alock", "answered")
+                 "done", "seq", "id", "tenant", "_alock", "answered")
 
-    def __init__(self, toks: List[int], deadline: Optional[float], reply):
+    def __init__(self, toks: List[int], deadline: Optional[float], reply,
+                 tenant: Optional[str] = None):
         self.toks = toks
+        self.tenant = tenant
         self.t_arrival = time.monotonic()
         # cxxlint: disable=wallclock — flight-record arrival epoch, never
         # subtracted: durations in this class all come from t_arrival
@@ -372,6 +452,95 @@ class _SlotState:
         self.occ = occ
 
 
+class _FairQueue:
+    """Per-tenant weighted-fair admission queue (stride scheduling).
+
+    Drop-in for the single admission deque — ``append`` / ``popleft`` /
+    ``__len__`` / ``__bool__`` / ``__iter__`` / ``clear`` — except pops
+    interleave tenants by WEIGHT instead of arrival order: each tenant
+    carries a virtual time advanced by ``1/weight`` per pop, and
+    ``popleft`` serves the backlogged tenant furthest behind. A
+    weight-4 tenant therefore gets 4 dispatches for every 1 a weight-1
+    tenant gets while both are backlogged, and an idle tenant's unused
+    share flows to the others (its virtual time is clamped forward to
+    the clock when it returns, so idling banks no credit).
+
+    Capacity fairness rides ``over_share``/``evict_over_share``: each
+    tenant's fair share of the bound is ``queue_size * w/W`` (floored
+    at 1); a tenant may BORROW idle capacity beyond its share, but when
+    the queue is full an arrival from an under-share tenant evicts the
+    newest queued request of the most-over-share tenant — the shed is
+    charged to the tenant over its fair share, never to the victim.
+    All methods run under the frontend's admission lock."""
+
+    def __init__(self, weights, queue_size: int):
+        total = float(sum(weights.values()))
+        self._qs = {t: deque() for t in sorted(weights)}
+        self._stride = {t: 1.0 / w for t, w in weights.items()}
+        self.shares = {t: max(1, int(queue_size * w / total))
+                       for t, w in weights.items()}
+        self._vt = {t: 0.0 for t in weights}
+        self._vclock = 0.0
+        self._n = 0
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __bool__(self) -> bool:
+        return self._n > 0
+
+    def __iter__(self):
+        for t in sorted(self._qs):
+            for req in self._qs[t]:
+                yield req
+
+    def clear(self) -> None:
+        for q in self._qs.values():
+            q.clear()
+        self._n = 0
+
+    def depth(self, tenant: str) -> int:
+        return len(self._qs[tenant])
+
+    def append(self, req) -> None:
+        q = self._qs[req.tenant]
+        if not q:
+            # a tenant returning from idle starts at the clock, not at
+            # its stale virtual time — idling must not bank credit that
+            # would let it monopolize the worker on return
+            self._vt[req.tenant] = max(self._vt[req.tenant],
+                                       self._vclock)
+        q.append(req)
+        self._n += 1
+
+    def popleft(self):
+        vt, t = min((self._vt[t], t) for t, q in self._qs.items() if q)
+        self._vclock = vt
+        self._vt[t] = vt + self._stride[t]
+        self._n -= 1
+        return self._qs[t].popleft()
+
+    def over_share(self, tenant: str) -> bool:
+        return len(self._qs[tenant]) >= self.shares[tenant]
+
+    def evict_over_share(self, exempt: str):
+        """The newest queued request of the tenant MOST over its fair
+        share (never ``exempt`` — the arriving under-share tenant), or
+        None when nobody is over-share. LIFO within the borrower: its
+        newest borrowed slot is the one it never fairly held."""
+        worst, excess = None, 0
+        for t, q in sorted(self._qs.items()):
+            if t == exempt:
+                continue
+            over = len(q) - self.shares[t]
+            if over > excess:
+                worst, excess = t, over
+        if worst is None:
+            return None
+        self._n -= 1
+        return self._qs[worst].pop()
+
+
 # stat key -> telemetry counter (serve.requests keeps PR 4's name for the
 # successfully-served count so existing dashboards/reports keep working)
 _COUNTERS = {
@@ -388,6 +557,9 @@ _COUNTERS = {
 }
 # the stats mirrored into statusd's progress gauges per bump
 _PROGRESS_KEYS = ("served", "errors", "shed", "deadline")
+# the per-tenant reconciling subset: accepted == served + errors +
+# shed + deadline holds PER TENANT exactly as it does frontend-wide
+_TENANT_KEYS = ("accepted", "served", "errors", "shed", "deadline")
 
 
 class ServeFrontend:
@@ -417,8 +589,25 @@ class ServeFrontend:
                  stall_after_s: float = 120.0,
                  slo=None, flight_cap: int = 256,
                  slot_backend=None, batch_max: int = 0,
-                 batch_window_ms: float = 0.0):
+                 batch_window_ms: float = 0.0,
+                 tenants=None, tenant_default: str = "default",
+                 slo_tenants=None):
         self.backend = backend
+        # multi-tenant weighted-fair QoS (module docstring): a tenant
+        # table turns the admission deque into a _FairQueue and arms
+        # per-tenant accounting/SLO; empty = single-tenant mode, every
+        # path byte-identical to pre-tenant behavior
+        self._tenants = parse_tenants(tenants)
+        self.tenant_default = str(tenant_default)
+        if self._tenants and self.tenant_default not in self._tenants:
+            # the default tenant must have a queue and a weight — a
+            # prefix-less client is a first-class tenant, not an error
+            self._tenants[self.tenant_default] = 1.0
+        # per-tenant SLO trackers (statusd.SLOTracker each): the
+        # per-tenant error-budget floors the fleet federation merges
+        self.slo_tenants = dict(slo_tenants or {})
+        self._tstats = {t: {k: 0 for k in _TENANT_KEYS}
+                        for t in self._tenants}
         # continuous batching (module docstring): a slot backend makes
         # the worker an iteration-granularity batching dispatcher;
         # batch_max bounds the coalesced batch (0 = the largest bucket),
@@ -456,7 +645,11 @@ class ServeFrontend:
                                       cooldown=breaker_cooldown_ms / 1e3,
                                       max_cooldown=breaker_max_cooldown_ms
                                       / 1e3)
-        self._q: deque = deque()
+        # the admission queue: a plain deque, or the per-tenant
+        # weighted-fair queue when a tenant table is configured (same
+        # interface — every consumer is tenant-agnostic)
+        self._q = (_FairQueue(self._tenants, max(1, int(queue_size)))
+                   if self._tenants else deque())
         # ranked locks (utils/lockrank.py): with CXXNET_LOCKRANK=1 the
         # chaos tests assert acquisition order matches the static graph
         self._cond = lockrank.condition("servd.queue")
@@ -540,6 +733,43 @@ class ServeFrontend:
     def stats(self) -> dict:
         with self._slock:
             return dict(self._stats)
+
+    def tenant_stats(self) -> dict:
+        """Per-tenant counter snapshot ({tenant: {key: n}}): each
+        tenant reconciles accepted == served + errors + shed +
+        deadline, exactly like the frontend-wide books."""
+        with self._slock:
+            return {t: dict(st) for t, st in self._tstats.items()}
+
+    def _bump_tenant(self, tenant: Optional[str], *names: str) -> None:
+        """Per-tenant half of _bump: the reconciling counter subset,
+        mirrored into ``serve.tenant.<t>.<key>`` telemetry counters —
+        series the fleet federation sums per tenant exactly like the
+        frontend-wide serve.* ones (tenant names are conf-bounded, so
+        the series set is bounded too)."""
+        if not self._tenants or tenant not in self._tstats:
+            return
+        keys = [n for n in names if n in _TENANT_KEYS]
+        if not keys:
+            return
+        with self._slock:
+            st = self._tstats[tenant]
+            for k in keys:
+                st[k] += 1
+        for k in keys:
+            telemetry.count("serve.tenant.%s.%s" % (tenant, k))
+
+    def _slo_observe(self, tenant: Optional[str], ok: bool,
+                     ttft_s=None, latency_s=None) -> None:
+        """Feed the frontend-wide SLO account AND the request's
+        tenant's own tracker — per-tenant error-budget floors are what
+        keep a noisy tenant's sheds from burning the victim's budget."""
+        if self.slo is not None:
+            self.slo.observe(ok=ok, ttft_s=ttft_s, latency_s=latency_s)
+        if tenant is not None:
+            tr = self.slo_tenants.get(tenant)
+            if tr is not None:
+                tr.observe(ok=ok, ttft_s=ttft_s, latency_s=latency_s)
 
     def mean_occupancy(self) -> Optional[float]:
         """Weighted-mean batch occupancy over decode iterations (None
@@ -641,6 +871,7 @@ class ServeFrontend:
             return False
         if outcome:
             self._bump(*outcome)
+            self._bump_tenant(req.tenant, *outcome)
         self._send(req.reply, text)
         req.done.set()
         return True
@@ -665,14 +896,17 @@ class ServeFrontend:
                               ntok, occupancy=occupancy)
         if won:
             self._bump(counter)
+            self._bump_tenant(req.tenant, counter)
             self._send(req.reply, text)
             req.done.set()
 
     # -- request intake ------------------------------------------------
     def _parse(self, line: str):
-        """One request line -> ("req", toks, rel_deadline_s, trace_id) |
-        ("admin", args) | ("err", cls, msg). ``trace_id`` is None unless
-        the line carried a valid ``TRACE <id>`` prefix."""
+        """One request line -> ("req", toks, rel_deadline_s, trace_id,
+        tenant) | ("admin", args) | ("err", cls, msg). ``trace_id`` is
+        None unless the line carried a valid ``TRACE <id>`` prefix;
+        ``tenant`` is the ``TENANT <id>`` prefix, or the configured
+        default for prefix-less clients (None in single-tenant mode)."""
         parts = line.split()
         if not parts:
             return ("err", "empty", "request line has no tokens")
@@ -687,6 +921,25 @@ class ServeFrontend:
             return ("err", "proto", proto_detail)
         if trace_id is not None and not parts:
             return ("err", "empty", "TRACE with no request line")
+        # the tenant prefix (TRACE first, then TENANT, then DEADLINE):
+        # same validation discipline as TRACE — malformed is a
+        # deterministic protocol violation, never dispatched. An
+        # unknown tenant on a frontend WITH a tenant table is refused
+        # too (the table bounds queue/metric cardinality); without a
+        # table the id is recorded for observability and fairness is
+        # off — the pre-tenant behavior, byte for byte
+        tenant, proto_detail, parts = parse_tenant_prefix(parts)
+        if proto_detail is not None:
+            return ("err", "proto", proto_detail)
+        if tenant is not None and not parts:
+            return ("err", "empty", "TENANT with no request line")
+        if self._tenants:
+            if tenant is None:
+                tenant = self.tenant_default
+            elif tenant not in self._tenants:
+                return ("err", "proto",
+                        "tenant %s is not in the configured tenant "
+                        "table" % tenant)
         if parts[0] == "ADMIN":
             return ("admin", parts[1:])
         deadline = (self.deadline_ms / 1e3) if self.deadline_ms > 0 \
@@ -717,7 +970,7 @@ class ServeFrontend:
         if self.vocab and not all(0 <= t < self.vocab for t in toks):
             return ("err", "parse",
                     "token id outside vocab_size %d" % self.vocab)
-        return ("req", toks, deadline, trace_id)
+        return ("req", toks, deadline, trace_id, tenant)
 
     def submit(self, line: str, reply, wait: bool = False):
         """Admit one request line. ``reply`` is called EXACTLY ONCE with
@@ -750,6 +1003,13 @@ class ServeFrontend:
                         live = dict(self.stats(),
                                     queue_depth=len(self._q),
                                     in_flight=self._inflight)
+                        # per-tenant books ride the same line as
+                        # tenant.<id>.<key>=N — the router's fleet
+                        # aggregation sums them like any other key, so
+                        # fleet-wide per-tenant reconciliation is free
+                        for t, st in self.tenant_stats().items():
+                            for k, v in st.items():
+                                live["tenant.%s.%s" % (t, k)] = v
                         if self.slot_backend is not None:
                             # free decode slots (bucket capacity −
                             # active): the router's prefer-the-replica-
@@ -767,6 +1027,8 @@ class ServeFrontend:
         req = None
         shed = False
         shed_rec = None
+        evicted = None
+        tenant = parsed[4] if parsed[0] == "req" else None
         # admission decision + accounting in ONE critical section with
         # the drain flag: after drain() flips _draining (under this
         # lock) no request can slip an accepted count past its final
@@ -787,33 +1049,68 @@ class ServeFrontend:
                 # Third token "breaker" is wire format (module docstring):
                 # retryable elsewhere AND "eject me from rotation"
                 self._bump("accepted", "shed")
+                self._bump_tenant(tenant, "accepted", "shed")
                 shed = True
                 shed_rec = self._shed_record(parsed, "breaker")
                 text = "ERR busy breaker open (circuit)"
-            elif len(self._q) >= self.queue_size:
-                # third token "queue": never dispatched, instantly
-                # retryable on another replica
+            elif len(self._q) >= self.queue_size \
+                    and not (self._tenants
+                             and not self._q.over_share(tenant)):
+                # full queue, and the arrival holds no fair-share claim
+                # (single-tenant mode, or a tenant at/over its share).
+                # Third token is wire format: "queue" (genuinely out of
+                # capacity — never dispatched, instantly retryable on
+                # another replica) vs "tenant" (a fairness verdict that
+                # holds fleet-wide under the shared tenant table — the
+                # router relays it WITHOUT burning a retry)
                 self._bump("accepted", "shed")
+                self._bump_tenant(tenant, "accepted", "shed")
                 shed = True
-                shed_rec = self._shed_record(parsed, "queue")
-                text = "ERR busy queue full (%d)" % self.queue_size
+                if self._tenants and self._q.over_share(tenant):
+                    shed_rec = self._shed_record(parsed, "tenant")
+                    text = ("ERR busy tenant %s over fair share "
+                            "(%d queued / share %d)"
+                            % (tenant, self._q.depth(tenant),
+                               self._q.shares[tenant]))
+                else:
+                    shed_rec = self._shed_record(parsed, "queue")
+                    text = "ERR busy queue full (%d)" % self.queue_size
             else:
-                _, toks, deadline, tid = parsed
-                req = _Request(toks, deadline, reply)
-                # the request id that threads through the whole datapath
-                # (trace context, flight record, /trace?request=<id>):
-                # a TRACE-propagated id wins — the router minted ONE id
-                # for this request fleet-wide, and every replica that
-                # touches it must file its flight record under it. The
-                # local counter still advances so TRACE-less requests
-                # keep their dense local ids either way.
-                self._rid += 1
-                req.id = tid if tid is not None else str(self._rid)
-                self._bump("accepted")
-                self._q.append(req)
-                telemetry.gauge("serve.queue_depth", len(self._q))
-                self._cond.notify()
-                text = None
+                if len(self._q) >= self.queue_size:
+                    # full queue but the arrival is UNDER its fair
+                    # share: the overload is borrowed capacity — evict
+                    # the newest queued request of the tenant most over
+                    # its share (the shed is charged to the borrower,
+                    # answered after the lock) and admit the arrival
+                    evicted = self._q.evict_over_share(tenant)
+                    if evicted is None:
+                        # queue full of in-share traffic: genuine
+                        # capacity exhaustion, shed the arrival
+                        self._bump("accepted", "shed")
+                        self._bump_tenant(tenant, "accepted", "shed")
+                        shed = True
+                        shed_rec = self._shed_record(parsed, "queue")
+                        text = ("ERR busy queue full (%d)"
+                                % self.queue_size)
+                if not shed:
+                    _, toks, deadline, tid, tenant = parsed
+                    req = _Request(toks, deadline, reply, tenant=tenant)
+                    # the request id that threads through the whole
+                    # datapath (trace context, flight record,
+                    # /trace?request=<id>): a TRACE-propagated id wins
+                    # — the router minted ONE id for this request
+                    # fleet-wide, and every replica that touches it
+                    # must file its flight record under it. The local
+                    # counter still advances so TRACE-less requests
+                    # keep their dense local ids either way.
+                    self._rid += 1
+                    req.id = tid if tid is not None else str(self._rid)
+                    self._bump("accepted")
+                    self._bump_tenant(tenant, "accepted")
+                    self._q.append(req)
+                    telemetry.gauge("serve.queue_depth", len(self._q))
+                    self._cond.notify()
+                    text = None
         if shed_rec is not None:
             # admission sheds land in the flight ring too: a request the
             # fleet router retried elsewhere leaves a record — under its
@@ -828,20 +1125,33 @@ class ServeFrontend:
             # null like every never-dispatched event — the report's
             # percentile table must not deflate during the overload
             # these events describe
-            telemetry.event({
+            ev = {
                 "ev": "serve_request_done", "req": shed_rec["id"],
                 "outcome": "shed", "shed_at": shed_rec["shed_at"],
                 "tokens": 0, "total_s": 0.0, "queue_wait_s": None,
                 "dispatch_s": None, "prefill_s": None,
-                "decode_s": None, "recompiles": 0})
+                "decode_s": None, "recompiles": 0}
+            if shed_rec.get("tenant") is not None:
+                ev["tenant"] = shed_rec["tenant"]
+            telemetry.event(ev)
+        if evicted is not None:
+            # the borrower's newest queued request leaves so the
+            # under-share arrival can take its place: answered (and
+            # charged) OUTSIDE the admission lock — it was already
+            # accepted, so the shed keeps its books reconciling, and
+            # the shed is the BORROWER's, never the arriving tenant's
+            self._shed_queued(evicted, tenant)
         if req is None:
-            if shed and self.slo is not None:
-                # an admission shed (queue full / breaker open at
-                # accept) is an availability failure the error budget
-                # must burn for, exactly like a dispatch-time breaker
-                # shed — otherwise a total-overload flood that sheds
-                # 99% of traffic at the door keeps cxxnet_slo_burn at 0
-                self.slo.observe(ok=False)
+            if shed:
+                # an admission shed (queue full / breaker open /
+                # fair-share verdict at accept) is an availability
+                # failure the error budget must burn for, exactly like
+                # a dispatch-time breaker shed — otherwise a
+                # total-overload flood that sheds 99% of traffic at the
+                # door keeps cxxnet_slo_burn at 0. The burn lands on
+                # the SHED tenant's own window — a noisy tenant's sheds
+                # must not page the victim's SLO.
+                self._slo_observe(tenant, ok=False)
             self._send(reply, text)
             return None
         if wait:
@@ -857,10 +1167,11 @@ class ServeFrontend:
         Phases are honest zeros (nothing was dequeued or dispatched);
         the record exists so the ONE fleet-wide id names this request
         on every replica that touched it, shed attempts included."""
-        _, toks, deadline, tid = parsed
+        _, toks, deadline, tid, tenant = parsed
         self._rid += 1
         return {"id": tid if tid is not None else str(self._rid),
                 "outcome": "shed", "shed_at": where,
+                "tenant": tenant,
                 "tokens_in": len(toks), "tokens_out": 0,
                 # cxxlint: disable=wallclock — flight-record arrival
                 # epoch (the cross-process stitch key), never subtracted
@@ -869,6 +1180,35 @@ class ServeFrontend:
                 "tokens_per_s": None,
                 "phases": {ph: 0.0 for ph in telemetry.REQUEST_PHASES},
                 "recompiles": []}
+
+    def _shed_queued(self, req: _Request, for_tenant: str) -> None:
+        """Answer a QUEUED request evicted by the fair-share policy
+        (charged to its own — borrowing — tenant): exactly-once answer,
+        shed accounting, a flight record + serve_request_done event
+        under its id (null phases: it never dispatched), and its
+        tenant's SLO burn. Called outside the admission lock."""
+        won = self._finish(
+            req, "ERR busy tenant %s over fair share (evicted for %s)"
+            % (req.tenant, for_tenant), "shed")
+        if not won:
+            return
+        self.flight.record({
+            "id": req.id, "outcome": "shed", "shed_at": "tenant",
+            "tenant": req.tenant,
+            "tokens_in": len(req.toks), "tokens_out": 0,
+            "t_wall": round(req.t_wall, 6),
+            "total_s": 0.0, "wall_s": 0.0, "ttft_s": None,
+            "tokens_per_s": None,
+            "phases": {ph: 0.0 for ph in telemetry.REQUEST_PHASES},
+            "recompiles": []})
+        telemetry.event({
+            "ev": "serve_request_done", "req": req.id,
+            "outcome": "shed", "shed_at": "tenant",
+            "tenant": req.tenant,
+            "tokens": 0, "total_s": 0.0, "queue_wait_s": None,
+            "dispatch_s": None, "prefill_s": None,
+            "decode_s": None, "recompiles": 0})
+        self._slo_observe(req.tenant, ok=False)
 
     # -- hot reload ----------------------------------------------------
     def request_reload(self) -> None:
@@ -1451,7 +1791,16 @@ class ServeFrontend:
             tps = ntok / gen
             telemetry.gauge("serve.tokens_per_second", round(tps, 3))
             telemetry.count("serve.tokens", ntok)
+        if self._tenants and req.tenant is not None:
+            # the per-tenant latency account: a serve.* series per
+            # tenant (bounded by the conf table), so the fleet
+            # federation's exact histogram merge yields per-tenant
+            # fleet p99 with no extra wire format — the "victim's p99
+            # holds" acceptance is read off exactly this series
+            telemetry.hist("serve.tenant.%s.request" % req.tenant,
+                           total)
         rec = {"id": req.id, "outcome": outcome,
+               "tenant": req.tenant,
                "tokens_in": len(req.toks), "tokens_out": ntok,
                "t_wall": round(req.t_wall, 6),
                "total_s": round(total, 6),
@@ -1482,6 +1831,8 @@ class ServeFrontend:
               "outcome": outcome, "tokens": ntok,
               "total_s": rec["total_s"],
               "recompiles": len(rec["recompiles"])}
+        if req.tenant is not None:
+            ev["tenant"] = req.tenant
         for ph, v in rec["phases"].items():
             ev[ph + "_s"] = v
         if not dispatched:
@@ -1494,9 +1845,8 @@ class ServeFrontend:
         if ttft is not None:
             ev["ttft_s"] = rec["ttft_s"]
         telemetry.event(ev)
-        if self.slo is not None:
-            self.slo.observe(ok=(outcome == "served"), ttft_s=ttft,
-                             latency_s=total)
+        self._slo_observe(req.tenant, ok=(outcome == "served"),
+                          ttft_s=ttft, latency_s=total)
 
     # -- TCP listener --------------------------------------------------
     def _accept_run(self) -> None:
@@ -1670,15 +2020,14 @@ class ServeFrontend:
             # budget exhausted: still exactly one response per accepted
             # request — an explicit ERR beats a silent dropped socket
             if self._finish(req, "ERR draining shutdown budget "
-                            "exhausted", "errors") \
-                    and self.slo is not None:
+                            "exhausted", "errors"):
                 # an accepted request the client lost burns error
                 # budget like an admission shed — a preemption that
                 # drains a full queue as ERR draining must not leave
                 # cxxnet_slo_burn reading 0 in the final snapshot (the
                 # wedged in-flight case is covered by the worker's
                 # "abandoned" observation when the backend returns)
-                self.slo.observe(ok=False)
+                self._slo_observe(req.tenant, ok=False)
         if self._worker_thread is not None:
             self._worker_thread.join(
                 timeout=max(0.5, deadline - time.monotonic() + 1.0))
@@ -1915,7 +2264,12 @@ def _stub_main(argv: List[str]) -> int:
                        drain_ms=flag("--drain-ms", 5000.0),
                        breaker_fails=int(flag("--breaker-fails", 5)),
                        stall_after_s=flag("--stall-s", 120.0),
-                       reload_fn=reload_fn)
+                       reload_fn=reload_fn,
+                       # multi-tenant QoS knobs for the fleet chaos
+                       # harness (same conf syntax as route_tenants)
+                       tenants=flag("--tenants", "", cast=str),
+                       tenant_default=flag("--tenant-default",
+                                           "default", cast=str))
     # the wedge handlers install BEFORE the port banner: the banner is
     # the chaos harness's spawn synchronization point, and a SIGUSR1
     # sent right after it must wedge the backend — not kill the process

@@ -115,7 +115,8 @@ from . import telemetry
 __all__ = [
     "StatusServer", "SLOTracker", "start", "stop", "active",
     "set_run_info", "update_progress", "register_probe", "wire_health",
-    "set_flight_recorder", "set_slo", "set_perf", "set_profiler",
+    "set_flight_recorder", "set_slo", "set_slo_tenants", "set_perf",
+    "set_profiler",
     "set_fleet", "prometheus_metrics", "programz_html", "fleetz_html",
     "requestz_html", "PROM_LINE_RE", "selftest",
 ]
@@ -299,6 +300,7 @@ def prometheus_metrics(snapshot: dict, progress: Optional[dict] = None,
                        channels: Optional[list] = None,
                        live_failures: Optional[list] = None,
                        slo: Optional[dict] = None,
+                       slo_tenants: Optional[dict] = None,
                        perf: Optional[dict] = None,
                        fleet: Optional[dict] = None) -> str:
     """Render a ``telemetry.metrics_snapshot()`` as Prometheus text
@@ -375,6 +377,25 @@ def prometheus_metrics(snapshot: dict, progress: Optional[dict] = None,
              float(slo.get("bad_fraction", 0.0)))
         emit("cxxnet_slo_window_requests", "gauge",
              int(slo.get("requests", 0)))
+    if slo_tenants:
+        # per-tenant SLO floors (one SLOTracker per configured tenant):
+        # labeled rows, so a noisy tenant's burn is visible NEXT TO the
+        # victim's holding at 0 — the multi-tenant QoS acceptance
+        fams = (("cxxnet_slo_tenant_burn",
+                 lambda s: int(s.get("alert", 0)),
+                 "1 while this tenant's own error budget burns >= 1x"),
+                ("cxxnet_slo_tenant_burn_rate",
+                 lambda s: float(s.get("burn_rate", 0.0)), None),
+                ("cxxnet_slo_tenant_window_requests",
+                 lambda s: int(s.get("requests", 0)), None))
+        for mname, get, help_ in fams:
+            if help_:
+                out.append("# HELP %s %s" % (mname, help_))
+            out.append("# TYPE %s gauge" % mname)
+            for t in sorted(slo_tenants):
+                out.append('%s{process="%s",tenant="%s"} %s'
+                           % (mname, _lesc(p), _lesc(t),
+                              _fmt(get(slo_tenants[t]))))
     if perf is not None:
         # the program performance ledger (perf.Ledger.snapshot()):
         # aggregates as plain gauges, per-program figures as labeled
@@ -433,15 +454,21 @@ def prometheus_metrics(snapshot: dict, progress: Optional[dict] = None,
                    "rolling reload)")
         by_state: Dict[str, int] = {}
         for r in reps:
-            by_state[r.get("state", "?")] = \
-                by_state.get(r.get("state", "?"), 0) + 1
+            # a standby is NOT routable whatever its probe state says:
+            # it gets its own state row, and replica_up 0 below — a
+            # dashboard counting "up" must count replicas that accept
+            # traffic, not held-out spares
+            st = "standby" if r.get("standby") \
+                else r.get("state", "?")
+            by_state[st] = by_state.get(st, 0) + 1
         if by_state:
             out.append("# TYPE cxxnet_fleet_state gauge")
             for st in sorted(by_state):
                 out.append('cxxnet_fleet_state{process="%s",state="%s"}'
                            ' %d' % (_lesc(p), _lesc(st), by_state[st]))
         fams = (("cxxnet_fleet_replica_up",
-                 lambda r: 1 if r.get("state") == "up" else 0,
+                 lambda r: 1 if (r.get("state") == "up"
+                                 and not r.get("standby")) else 0,
                  "1 while the replica is routable"),
                 ("cxxnet_fleet_replica_queue_depth",
                  lambda r: r.get("queue_depth", 0), None),
@@ -521,6 +548,56 @@ def prometheus_metrics(snapshot: dict, progress: Optional[dict] = None,
                         '{process="%s",replica="%s"} %s'
                         % (_lesc(p), _lesc(name),
                            _fmt(round(p99 / 1e3, 6))))
+        scale = fleet.get("scale")
+        if scale:
+            # the closed-loop autoscaler's account (routerd
+            # scale_snapshot): target = active replicas the policy
+            # currently holds in rotation, plus the cumulative
+            # transition count the fleet_scale JSONL events mirror
+            emit("cxxnet_fleet_target_replicas", "gauge",
+                 int(scale.get("target_replicas", 0)),
+                 help_="replicas the autoscaler holds in rotation "
+                       "(standbys excluded until a scale-up admits "
+                       "them)")
+            emit("cxxnet_fleet_scale_events_total", "counter",
+                 int(scale.get("events", 0)),
+                 help_="autoscaler scale-up/scale-down transitions")
+            emit("cxxnet_fleet_standby_replicas", "gauge",
+                 int(scale.get("standby", 0)))
+        tenants = fleet.get("tenants")
+        if tenants:
+            # per-tenant fleet books: the router's own outcome counts
+            # (labels bound by the conf tenant table), each tenant's
+            # federated fleet p99, and its fleet-wide merged-window SLO
+            # burn — the "noisy tenant sheds, victim holds" series
+            tfams = (("cxxnet_fleet_tenant_accepted_total", "counter",
+                      lambda d: (d.get("router") or {}).get("accepted")),
+                     ("cxxnet_fleet_tenant_served_total", "counter",
+                      lambda d: (d.get("router") or {}).get("served")),
+                     ("cxxnet_fleet_tenant_shed_total", "counter",
+                      lambda d: (d.get("router") or {}).get("shed")),
+                     ("cxxnet_fleet_tenant_errors_total", "counter",
+                      lambda d: (d.get("router") or {}).get("errors")),
+                     ("cxxnet_fleet_tenant_weight", "gauge",
+                      lambda d: d.get("weight")),
+                     ("cxxnet_fleet_tenant_p99_seconds", "gauge",
+                      lambda d: None if d.get("p99_ms") is None
+                      else round(d["p99_ms"] / 1e3, 6)),
+                     ("cxxnet_fleet_tenant_slo_burn", "gauge",
+                      lambda d: None if d.get("slo") is None
+                      else int(d["slo"].get("alert", 0))),
+                     ("cxxnet_fleet_tenant_slo_burn_rate", "gauge",
+                      lambda d: None if d.get("slo") is None
+                      else float(d["slo"].get("burn_rate", 0.0))))
+            for mname, mtype, get in tfams:
+                rows = [(t, get(d)) for t, d in sorted(tenants.items())]
+                rows = [(t, v) for t, v in rows if _num(v)]
+                if not rows:
+                    continue
+                out.append("# TYPE %s %s" % (mname, mtype))
+                for t, v in rows:
+                    out.append('%s{process="%s",tenant="%s"} %s'
+                               % (mname, _lesc(p), _lesc(t), _fmt(v)))
     if channels is None:
         channels = health_mod.channel_status()
     if channels:
@@ -635,6 +712,9 @@ def fleetz_html(snap: dict) -> str:
     for r in reps:
         age = r.get("last_probe_age_s")
         detail = str(r.get("detail", ""))
+        if r.get("standby"):
+            # held out of dispatch until the autoscaler admits it
+            detail = "STANDBY " + detail
         if r.get("outlier"):
             # the federation sweep's verdict: this replica's serve p99
             # diverges from the fleet median — the flagged row the
@@ -668,6 +748,43 @@ def fleetz_html(snap: dict) -> str:
                             fslo.get("bad", 0),
                             fslo.get("burn_rate", 0.0),
                             "  BURNING" if fslo.get("alert") else ""))
+    scale = snap.get("scale")
+    if scale:
+        parts.append("</pre><h2>autoscaler</h2><pre>")
+        parts.append("target %d replicas (bounds %d..%d, %d standby); "
+                     "%d scale event(s); up at burn>=%gx, retire after "
+                     "%gs idle, cooldown %gs"
+                     % (scale.get("target_replicas", 0),
+                        scale.get("min", 0), scale.get("max", 0),
+                        scale.get("standby", 0),
+                        scale.get("events", 0),
+                        scale.get("up_burn", 0.0),
+                        scale.get("down_idle_s", 0.0),
+                        scale.get("cooldown_s", 0.0)))
+        for ev in scale.get("recent") or []:
+            parts.append("%-4s %-21s -> %d active  (%s)"
+                         % (esc(ev.get("action", "?")),
+                            esc(ev.get("replica", "?")),
+                            ev.get("active", 0),
+                            esc(ev.get("reason", ""))))
+    tenants = snap.get("tenants")
+    if tenants:
+        parts.append("</pre><h2>tenants (weighted-fair QoS)</h2><pre>")
+        cols = ("tenant", "weight", "accepted", "served", "shed",
+                "errors", "fleet p99", "slo burn")
+        tfmt = "%-16s %6s %9s %9s %9s %9s %10s %9s"
+        parts.append(tfmt % cols)
+        for t, d in sorted(tenants.items()):
+            ro = d.get("router") or {}
+            slo = d.get("slo") or {}
+            parts.append(tfmt % (
+                esc(t), "%g" % d.get("weight", 1.0),
+                ro.get("accepted", 0), ro.get("served", 0),
+                ro.get("shed", 0), ro.get("errors", 0),
+                _ms(d.get("p99_ms")),
+                ("%.2fx%s" % (slo["burn_rate"],
+                              " BURNING" if slo.get("alert") else "")
+                 if slo.get("burn_rate") is not None else "n/a")))
     wins = snap.get("windows") or []
     if wins:
         parts.append("</pre><h2>rolling-reload drain windows</h2><pre>")
@@ -767,7 +884,11 @@ class _Endpoint(BaseHTTPRequestHandler):
                     # observability")
                     body = {"metrics": srv.registry.metrics_snapshot(),
                             "slo": srv.slo.snapshot()
-                            if srv.slo is not None else None}
+                            if srv.slo is not None else None,
+                            "slo_tenants": {
+                                t: tr.snapshot() for t, tr in
+                                sorted(srv.slo_tenants.items())}
+                            if srv.slo_tenants else None}
                     self._reply(200, "application/json",
                                 json.dumps(body).encode("utf-8"))
                 else:
@@ -979,6 +1100,11 @@ class StatusServer:
         # tracker behind the cxxnet_slo_* gauges and the /statusz section
         self.flight: Optional[telemetry.FlightRecorder] = None
         self.slo: Optional[SLOTracker] = None
+        # per-tenant SLO trackers ({tenant: SLOTracker}) — the
+        # cxxnet_slo_tenant_* label rows, the /statusz tenant lines,
+        # and the slo_tenants half of the /metrics?json=1 federation
+        # feed (doc/serving.md "Multi-tenant QoS")
+        self.slo_tenants: Dict[str, SLOTracker] = {}
         # performance-ledger wiring (set_perf / set_profiler): the
         # perf.Ledger behind /programz and the cxxnet_program_* series,
         # and the perf.ProfilerCapture behind /profilez
@@ -1102,6 +1228,9 @@ class StatusServer:
             channels=channels,
             live_failures=live,
             slo=self.slo.snapshot() if self.slo is not None else None,
+            slo_tenants={t: tr.snapshot()
+                         for t, tr in sorted(self.slo_tenants.items())}
+            if self.slo_tenants else None,
             perf=self.perf.snapshot() if self.perf is not None else None,
             fleet=self.fleet.fleet_snapshot()
             if self.fleet is not None else None)
@@ -1322,6 +1451,16 @@ def set_slo(tracker: Optional[SLOTracker]) -> None:
         s.slo = tracker
 
 
+def set_slo_tenants(trackers) -> None:
+    """Attach the per-tenant SLOTracker map ({tenant: tracker}) —
+    /metrics exports cxxnet_slo_tenant_* label rows and the
+    /metrics?json=1 federation feed carries each tenant's window for
+    the fleet-wide per-tenant merge. None/empty clears."""
+    s = _SERVER
+    if s is not None:
+        s.slo_tenants = dict(trackers or {})
+
+
 def set_perf(ledger) -> None:
     """Attach a perf.Ledger — /programz and the cxxnet_program_* /
     cxxnet_hbm_* series serve from it. No-op without a server."""
@@ -1493,7 +1632,13 @@ def _selftest_body(verbose: bool = False) -> int:
                      "hold": False, "queue_depth": 0, "in_flight": 0,
                      "outstanding": 0, "ejections": 3,
                      "probe_fails": 3, "last_probe_age_s": None,
-                     "detail": "statusd unreachable"}],
+                     "detail": "statusd unreachable"},
+                    {"name": "127.0.0.1:7003", "state": "up",
+                     "standby": True, "hold": False,
+                     "queue_depth": 0, "in_flight": 0,
+                     "outstanding": 0, "ejections": 0,
+                     "probe_fails": 0, "last_probe_age_s": 0.1,
+                     "detail": "ready"}],
                     "eligible": 1, "draining": False,
                     "reloading": False,
                     "windows": [{"replica": "127.0.0.1:7001",
@@ -1509,16 +1654,24 @@ def _selftest_body(verbose: bool = False) -> int:
         assert "drain windows" in fz
         fj = json.loads(urlopen(base + "/fleetz?json=1",
                                 timeout=5).read())
-        assert fj["eligible"] == 1 and len(fj["replicas"]) == 2
+        assert fj["eligible"] == 1 and len(fj["replicas"]) == 3
         mf = urlopen(base + "/metrics", timeout=5).read().decode()
         for line in mf.splitlines():
             if line and not line.startswith("#"):
                 assert PROM_LINE_RE.match(line), \
                     "invalid Prometheus line: %r" % line
-        assert 'cxxnet_fleet_replicas{process="0"} 2' in mf
+        assert 'cxxnet_fleet_replicas{process="0"} 3' in mf
         assert 'cxxnet_fleet_replicas_eligible{process="0"} 1' in mf
         assert ('cxxnet_fleet_state{process="0",state="dead"} 1'
                 in mf)
+        # a held-out standby is its OWN state and NOT "up"/routable —
+        # a probe-state "up" must not leak into the replica_up gauge
+        assert ('cxxnet_fleet_state{process="0",state="standby"} 1'
+                in mf)
+        assert ('cxxnet_fleet_state{process="0",state="up"} 1'
+                in mf)
+        assert ('cxxnet_fleet_replica_up{process="0",'
+                'replica="127.0.0.1:7003"} 0' in mf)
         assert ('cxxnet_fleet_replica_up{process="0",'
                 'replica="127.0.0.1:7002"} 0' in mf)
         assert ('cxxnet_fleet_replica_queue_depth{process="0",'

@@ -43,6 +43,14 @@ preemptible fleet:
                         router ejects) without the process dying
   ``restart_replica`` — respawn a killed replica on the SAME ports
                         (recovery for backoff re-admission tests)
+* tenant QoS + autoscaler chaos (ISSUE 13):
+  ``tenant_flood``    — closed-loop one-tenant load generator with
+                        per-outcome books (tenant_shed vs queue_shed,
+                        zero-silent-losses ``lost`` count, latencies)
+  ``spawn_standby`` / ``retire_standby``
+                      — a pre-provisioned ``servd --stub`` replica for
+                        ``route_standby_replicas`` (held out of
+                        dispatch until the autoscaler admits it)
 
 These are plain file/process manipulations so they compose with any
 test runner; tests/test_checkpoint_faults.py and
@@ -516,7 +524,8 @@ class FleetReplica:
 
 def _start_stub(port=0, status_port=0, delay_ms=0.0, queue=64,
                 drain_ms=5000.0, stall_s=120.0, breaker_fails=5,
-                explode_every=0, reload_ms=0.0):
+                explode_every=0, reload_ms=0.0, tenants="",
+                tenant_default="default"):
     import subprocess
     import sys
 
@@ -528,6 +537,9 @@ def _start_stub(port=0, status_port=0, delay_ms=0.0, queue=64,
             "--breaker-fails", str(breaker_fails),
             "--explode-every", str(explode_every),
             "--reload-ms", str(reload_ms)]
+    if tenants:
+        args += ["--tenants", str(tenants),
+                 "--tenant-default", str(tenant_default)]
     return subprocess.Popen(args, stdout=subprocess.PIPE, text=True,
                             cwd=repo), args
 
@@ -652,6 +664,110 @@ def restart_replica(r, timeout=20.0):
             seen += 1
     r.proc = proc
     return r
+
+
+def tenant_flood(port: int, tenant: str, nclients: int = 4,
+                 duration_s: float = 1.0, per: int = 0,
+                 toks: str = "5", deadline_ms: float = 0.0,
+                 stop=None, timeout: float = 10.0):
+    """Closed-loop tenant flood generator (the tenant-QoS chaos/bench
+    load): ``nclients`` concurrent connections each firing
+    ``TENANT <tenant>``-prefixed requests BACK-TO-BACK (closed loop —
+    the next request leaves when the previous answer lands) until
+    ``duration_s`` elapses, ``stop`` (a threading.Event) is set, or —
+    when ``per`` > 0 — each client has sent ``per`` requests. Returns
+    the per-outcome books::
+
+        {"sent", "served", "shed", "tenant_shed", "queue_shed",
+         "errors", "deadline", "lost", "latencies"}
+
+    ``lost`` counts requests that got NO response line — the
+    zero-silent-losses acceptance asserts it is 0. ``tenant_shed`` is
+    the ``ERR busy tenant`` subset of ``shed`` (the weighted-fair
+    verdict), ``queue_shed`` the capacity ``ERR busy queue`` subset;
+    ``latencies`` holds one wall-clock per SERVED request."""
+    import socket
+    import threading
+    import time
+
+    out = {"sent": 0, "served": 0, "shed": 0, "tenant_shed": 0,
+           "queue_shed": 0, "errors": 0, "deadline": 0, "lost": 0,
+           "latencies": []}
+    lock = threading.Lock()
+    t_end = time.monotonic() + duration_s
+    prefix = "TENANT %s " % tenant
+    if deadline_ms > 0:
+        prefix += "DEADLINE %d " % int(deadline_ms)
+    line = (prefix + toks + "\n").encode()
+
+    def one():
+        try:
+            c = socket.create_connection(("127.0.0.1", port),
+                                         timeout=timeout)
+        except OSError:
+            return
+        try:
+            f = c.makefile("r", encoding="utf-8")
+            n = 0
+            while (per <= 0 or n < per) \
+                    and (per > 0 or time.monotonic() < t_end) \
+                    and not (stop is not None and stop.is_set()):
+                n += 1
+                t0 = time.perf_counter()
+                try:
+                    c.sendall(line)
+                    resp = f.readline().rstrip("\n")
+                except OSError:
+                    resp = ""
+                dt = time.perf_counter() - t0
+                with lock:
+                    out["sent"] += 1
+                    if not resp:
+                        out["lost"] += 1
+                        return      # connection unusable past a lost line
+                    elif resp.startswith("ERR busy tenant"):
+                        out["shed"] += 1
+                        out["tenant_shed"] += 1
+                    elif resp.startswith("ERR busy queue"):
+                        out["shed"] += 1
+                        out["queue_shed"] += 1
+                    elif resp.startswith("ERR busy"):
+                        out["shed"] += 1
+                    elif resp.startswith("ERR deadline"):
+                        out["deadline"] += 1
+                    elif resp.startswith("ERR"):
+                        out["errors"] += 1
+                    else:
+                        out["served"] += 1
+                        out["latencies"].append(dt)
+        finally:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    ts = [threading.Thread(target=one) for _ in range(nclients)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    return out
+
+
+def spawn_standby(**kw):
+    """Spawn one real ``servd --stub`` replica meant to be LISTED in
+    ``route_standby_replicas`` (its ``.spec`` is the conf entry): the
+    process runs and answers its probes from the start — exactly a
+    pre-provisioned standby — but the router holds it out of dispatch
+    until the autoscaler admits it. Retire with ``retire_standby``."""
+    return spawn_replica(**kw)
+
+
+def retire_standby(r) -> None:
+    """Gracefully stop a standby replica (SIGTERM drain, SIGKILL on
+    timeout) — the operator decommissioning the capacity the
+    autoscaler already returned to standby."""
+    stop_fleet([r])
 
 
 def make_imgbin(dirname: str, bufs, page_ints: int = 1 << 12,

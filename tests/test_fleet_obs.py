@@ -613,6 +613,232 @@ def test_federation_keeps_last_known_snapshot_on_missed_sweep():
 
 
 # ----------------------------------------------------------------------
+# multi-tenant QoS on the observability plane (ISSUE 13)
+def test_pre_tenant_replica_downgrade_ladder():
+    """Backward compat, the TENANT edition of the TRACE downgrade: a
+    pre-TENANT replica rejects the prefixed line as ERR parse; the
+    router walks the ladder (drop TENANT, then TRACE too), serves the
+    request bare, and latches what the replica cannot speak — the
+    client sees nothing. A pre-TRACE replica latches BOTH."""
+    lines = []
+
+    class OldServer:
+        """A pre-TRACE, pre-TENANT servd: integer tokens only."""
+
+        def __init__(self):
+            self.sock = socket.create_server(("127.0.0.1", 0))
+            self.sock.settimeout(0.25)
+            self.port = self.sock.getsockname()[1]
+            self.alive = True
+            threading.Thread(target=self._run, daemon=True).start()
+
+        def _run(self):
+            while self.alive:
+                try:
+                    conn, _ = self.sock.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+                with conn:
+                    try:
+                        line = conn.makefile("r").readline().strip()
+                        lines.append(line)
+                        try:
+                            toks = [int(t) for t in line.split()]
+                            resp = " ".join(str(t + 1) for t in toks)
+                        except ValueError:
+                            resp = ("ERR parse non-integer token in "
+                                    "request")
+                        conn.sendall((resp + "\n").encode())
+                    except OSError:
+                        pass
+
+        def stop(self):
+            self.alive = False
+            self.sock.close()
+
+    old = OldServer()
+    router = routerd.Router([("127.0.0.1", old.port, old.port)],
+                            probe_ms=3600e3, retries=0, stall_s=5.0,
+                            drain_ms=1000.0,
+                            tenants="noisy:1,victim:4",
+                            tenant_default="victim")
+    router.start()
+    router.listen(0)
+    try:
+        # first request: TRACE+TENANT rejected, TRACE-only rejected,
+        # bare served — the full ladder, one wire line per rung
+        assert faultinject.serve_request(
+            router.port, "TENANT noisy 1 2") == "2 3"
+        assert len(lines) == 3, lines
+        assert lines[0].split()[0] == "TRACE" \
+            and lines[0].split()[2] == "TENANT"
+        assert lines[1].split()[0] == "TRACE" \
+            and "TENANT" not in lines[1]
+        assert lines[2] == "1 2"
+        r = router._replicas[0]
+        assert r.no_trace is True and r.no_tenant is True
+        # latched: the next request goes bare on the FIRST wire line,
+        # and the tenant is still ACCOUNTED router-side
+        assert faultinject.serve_request(
+            router.port, "TENANT noisy 7") == "8"
+        assert len(lines) == 4 and lines[3] == "7"
+        ts = router.tenant_stats()
+        assert ts["noisy"]["accepted"] == 2 \
+            and ts["noisy"]["served"] == 2
+    finally:
+        _drain_all(router, old)
+
+
+def test_tenant_downgrade_skipped_for_proven_replica():
+    """The positive latch, TENANT edition: one successful tenant-
+    prefixed exchange proves the replica parses TENANT — a later
+    genuine client parse error pays NO downgrade resends."""
+    fe = servd.ServeFrontend(lambda toks, seq: [t + 1 for t in toks],
+                             drain_ms=2000.0,
+                             tenants="noisy:1,victim:4",
+                             tenant_default="victim")
+    fe.start()
+    fe.listen(0)
+    ss = statusd.StatusServer(0, host="127.0.0.1").start()
+    ss.register_probe("serving", fe.health_probe)
+    router = routerd.Router([("127.0.0.1", fe.port, ss.port)],
+                            probe_ms=3600e3, retries=0, stall_s=5.0,
+                            drain_ms=1000.0,
+                            tenants="noisy:1,victim:4",
+                            tenant_default="victim")
+    router.start()
+    router.listen(0)
+    try:
+        assert faultinject.serve_request(
+            router.port, "TENANT noisy 1") == "2"
+        r = router._replicas[0]
+        assert r.trace_ok is True and r.tenant_ok is True
+        before = fe.stats()["accepted"]
+        assert faultinject.serve_request(
+            router.port, "TENANT noisy not numbers") \
+            .startswith("ERR parse")
+        # exactly ONE replica-side request for the malformed line
+        assert fe.stats()["accepted"] == before + 1
+        assert r.no_trace is False and r.no_tenant is False
+    finally:
+        _drain_all(router, ss, fe)
+
+
+def test_per_tenant_federation_series_and_slo():
+    """The per-tenant fleet account: serve.tenant.* counters sum
+    exactly, per-tenant hists merge into a fleet p99, per-tenant SLO
+    windows merge (victim holds, noisy burns), and the router's
+    statusd renders the cxxnet_fleet_tenant_*{tenant=} label rows and
+    the cxxnet_slo_tenant_* replica rows — all Prometheus-valid."""
+    noisy_slo = statusd.SLOTracker(availability=0.99, min_requests=4,
+                                   min_bad=3, window_s=300.0)
+    victim_slo = statusd.SLOTracker(availability=0.99, min_requests=4,
+                                    min_bad=3, window_s=300.0)
+    for _ in range(6):
+        noisy_slo.observe(ok=False)
+        victim_slo.observe(ok=True)
+    shards = []
+    for k in (2, 3):
+        srv, reg = _metric_statusd(
+            {"serve.tenant.noisy.request": [0.001] * k,
+             "serve.tenant.victim.request": [0.01] * k},
+            counters={"serve.tenant.noisy.accepted": 5 * k,
+                      "serve.tenant.noisy.shed": 4 * k,
+                      "serve.tenant.victim.accepted": 2 * k,
+                      "serve.tenant.victim.served": 2 * k})
+        srv.slo_tenants = {"noisy": noisy_slo, "victim": victim_slo}
+        shards.append(srv)
+    router = routerd.Router(
+        [("127.0.0.1", i + 1, s.port)
+         for i, s in enumerate(shards)],
+        probe_ms=3600e3, federate_ms=3600e3, outlier_min_n=1,
+        tenants="noisy:1,victim:4", tenant_default="victim")
+    router.start()
+    rsrv = statusd.StatusServer(0, host="127.0.0.1").start()
+    rsrv.fleet = router
+    try:
+        assert router.federate_now() == 2
+        fed = router.federation_snapshot()
+        # counters summed per tenant; fleet p99 from the merged hist
+        assert fed["tenants"]["noisy"]["accepted"] == 25
+        assert fed["tenants"]["noisy"]["shed"] == 20
+        assert fed["tenants"]["victim"]["served"] == 10
+        assert fed["tenants"]["noisy"]["p99_ms"] is not None
+        # per-tenant merged windows: noisy burns (both shards observed
+        # the same trackers here — the merge path is what's pinned),
+        # victim holds 0
+        assert fed["slo_tenants"]["noisy"]["alert"] == 1
+        assert fed["slo_tenants"]["victim"]["alert"] == 0
+        # label rows on the router's /metrics, Prometheus-valid
+        metrics = urlopen("http://127.0.0.1:%d/metrics" % rsrv.port,
+                          timeout=5).read().decode()
+        for line in metrics.splitlines():
+            if line and not line.startswith("#"):
+                assert statusd.PROM_LINE_RE.match(line), line
+        assert ('cxxnet_fleet_tenant_weight{process="0",'
+                'tenant="victim"} 4') in metrics
+        assert 'cxxnet_fleet_tenant_slo_burn{' in metrics
+        assert 'cxxnet_fleet_tenant_p99_seconds{' in metrics
+        # ... and the /fleetz tenants section renders
+        page = urlopen("http://127.0.0.1:%d/fleetz" % rsrv.port,
+                       timeout=5).read().decode()
+        assert "tenants (weighted-fair QoS)" in page
+        # the replica-side per-tenant rows + json federation feed
+        rep_metrics = urlopen("http://127.0.0.1:%d/metrics"
+                              % shards[0].port,
+                              timeout=5).read().decode()
+        assert 'cxxnet_slo_tenant_burn{process="0",tenant="noisy"} 1' \
+            in rep_metrics
+        mj = json.loads(urlopen("http://127.0.0.1:%d/metrics?json=1"
+                                % shards[0].port, timeout=5).read())
+        assert mj["slo_tenants"]["victim"]["alert"] == 0
+    finally:
+        _drain_all(router, rsrv, *shards)
+
+
+def test_bench_compare_tenant_subfield_directions(tmp_path):
+    """Direction-aware gating for the serve_tenant_isolation row:
+    victim_p99_ms and fleet_scale_latency_s gate worse-when-HIGHER,
+    noisy_shed_rate worse-when-LOWER (a drop means the flood got
+    through)."""
+    import subprocess
+    import sys
+    bench = tmp_path / "BENCH_r99.json"
+    bench.write_text(json.dumps({
+        "metric": "serve_tenant_isolation", "value": 50.0,
+        "unit": "ms", "victim_p99_ms": 50.0, "noisy_shed_rate": 0.2,
+        "fleet_scale_latency_s": 2.0}) + "\n")
+    base = tmp_path / "BASELINE.json"
+    base.write_text(json.dumps({"published": {
+        "serve_tenant_isolation": 50.0,
+        "serve_tenant_isolation.victim_p99_ms": 25.0,
+        "serve_tenant_isolation.noisy_shed_rate": 0.9,
+        "serve_tenant_isolation.fleet_scale_latency_s": 0.5}}))
+    proc = subprocess.run(
+        [sys.executable, "tools/bench_compare.py", "--bench",
+         str(bench), "--baseline", str(base)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 2, proc.stdout
+    out = proc.stdout
+    # all three regressed in their own direction
+    assert out.count("REGRESSION") == 3, out
+    assert "victim_p99_ms" in out and "noisy_shed_rate" in out \
+        and "fleet_scale_latency_s" in out
+    # and the good direction passes: higher shed rate, lower latency
+    bench.write_text(json.dumps({
+        "metric": "serve_tenant_isolation", "value": 50.0,
+        "unit": "ms", "victim_p99_ms": 20.0, "noisy_shed_rate": 0.95,
+        "fleet_scale_latency_s": 0.3}) + "\n")
+    proc = subprocess.run(
+        [sys.executable, "tools/bench_compare.py", "--bench",
+         str(bench), "--baseline", str(base)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout
+
+
+# ----------------------------------------------------------------------
 # the offline --fleet report join
 def test_fleet_report_joins_router_and_replica_shards(tmp_path, capsys):
     import subprocess

@@ -438,9 +438,16 @@ def test_rolling_reload_zero_downtime(make_router):
         assert responses and all(r in ("6", "7") for r in responses), \
             [r for r in responses if r not in ("6", "7")][:5]
         assert "7" in responses, "no request saw the reloaded model"
-        # every replica reloaded exactly once
-        for r in fleet:
-            assert replica_stats(r)["reloads"] == 1
+        # every replica reloaded exactly once. The roll completes on
+        # the reload_seen delta — bumped when the reload request is
+        # PROCESSED, before the swap itself, deliberately (a no-op
+        # roll must not burn the per-replica timeout) — so the last
+        # replica's actual swap can lag the roll by up to reload_ms:
+        # wait for it instead of racing it (reproduced failing ~1/3 on
+        # clean main on this machine before this wait)
+        wait_until(lambda: all(replica_stats(r)["reloads"] == 1
+                               for r in fleet), timeout=10.0,
+                   msg="every replica finished its swap")
         # capacity never below N-1: the drain windows are per-replica
         # and pairwise NON-overlapping (one replica held at a time)
         wins = sorted(router.fleet_snapshot()["windows"],
@@ -581,6 +588,334 @@ def test_cli_route_task_sigterm_drain():
             p.kill()
             p.wait()
         faultinject.stop_fleet(fleet)
+
+
+# ----------------------------------------------------------------------
+# ISSUE 13: multi-tenant weighted-fair QoS + closed-loop autoscaler
+# (in-process frontends — the subprocess chaos above covers process
+# faults; this layer's faults are POLICY faults, cheap to drive
+# deterministically with probing/federation/scaling off the clock)
+TEN = "noisy:1,victim:4"
+
+
+def _inproc_replica(backend, slo=False, tenants=TEN, **kw):
+    """One in-process replica: tenant-armed frontend + statusd with the
+    per-tenant SLO windows wired (the federation feed)."""
+    slo_t = {}
+    if slo:
+        slo_t = {t: statusd.SLOTracker(availability=0.99,
+                                       min_requests=4, min_bad=3,
+                                       window_s=60.0)
+                 for t in ("noisy", "victim")}
+    fe = servd.ServeFrontend(
+        backend, drain_ms=2000.0, tenants=tenants,
+        tenant_default="victim", slo_tenants=slo_t,
+        slo=statusd.SLOTracker(availability=0.99, min_requests=8,
+                               min_bad=3, window_s=60.0)
+        if slo else None, **kw)
+    fe.start()
+    fe.listen(0)
+    ss = statusd.StatusServer(0, host="127.0.0.1").start()
+    ss.register_probe("serving", fe.health_probe)
+    ss.slo = fe.slo
+    ss.slo_tenants = slo_t
+    ss.flight = fe.flight
+    return fe, ss
+
+
+def tenant_reconciles(stats_by_tenant):
+    for t, st in stats_by_tenant.items():
+        assert st["accepted"] == (st["served"] + st["errors"]
+                                  + st["shed"] + st["deadline"]), \
+            (t, st)
+
+
+def test_retryability_tenant_verdict_not_retried():
+    """The wire-contract pin: ``ERR busy tenant`` proves the request
+    never dispatched BUT is the fleet-wide policy verdict — relayed,
+    never retried (a flood must not double itself through the retry
+    path); the capacity sheds keep retrying as before."""
+    assert not routerd.retryable("ERR busy tenant noisy over fair "
+                                 "share (...)")
+    assert routerd.retryable("ERR busy queue full (64)")
+    assert routerd.retryable("ERR busy breaker open (circuit)")
+
+
+def test_router_tenant_gate_sheds_over_share_on_saturated_fleet(
+        make_router):
+    """The router's own weighted-fair admission: with every eligible
+    replica saturated, a tenant holding >= its weighted share of the
+    router's in-flight requests is shed at the door — the victim's
+    share is always >= 1, so it is NEVER gated."""
+    fe, ss = _inproc_replica(lambda toks, seq: list(toks))
+    try:
+        router = make_router([("127.0.0.1", fe.port, ss.port)],
+                             probe_ms=3600e3, federate_ms=3600e3,
+                             tenants=TEN, tenant_default="victim")
+        r = router._replicas[0]
+        # fake a saturated probe state + a noisy-heavy in-flight set
+        with router._lock:
+            r.queue_depth, r.free_slots = 3, 0
+        with router._slock:
+            router._tenant_active["noisy"] = 5
+            router._tenant_active["victim"] = 1
+        shed = router._tenant_gate("noisy")
+        assert shed is not None and shed.split()[:3] \
+            == ["ERR", "busy", "tenant"], shed
+        assert router._tenant_gate("victim") is None
+        # an unsaturated fleet admits everyone
+        with router._lock:
+            r.queue_depth = 0
+            r.free_slots = 2
+        assert router._tenant_gate("noisy") is None
+    finally:
+        fe.drain(timeout_ms=1000)
+        ss.stop()
+
+
+def test_tenant_budget_burns_on_fleet_wide_outage(make_router):
+    """A request shed because EVERY attempt was connect-refused never
+    reached any replica window — the router's own per-tenant tracker
+    must burn for it, or a fleet-wide outage under a tenant flood
+    reads cxxnet_fleet_tenant_slo_burn 0 for everyone (the
+    burn-reads-0-under-total-overload trap, outage edition)."""
+    with socket.socket() as tmp:
+        tmp.bind(("127.0.0.1", 0))
+        dead = tmp.getsockname()[1]
+    slo_t = {t: statusd.SLOTracker(availability=0.99, min_requests=4,
+                                   min_bad=3, window_s=60.0)
+             for t in ("noisy", "victim")}
+    router = make_router([("127.0.0.1", dead, dead)],
+                         probe_ms=3600e3, federate_ms=3600e3,
+                         retries=1, tenants=TEN,
+                         tenant_default="victim", slo_tenants=slo_t)
+    for _ in range(4):
+        resp = faultinject.serve_request(router.port, "TENANT noisy 5")
+        assert resp.startswith("ERR busy fleet"), resp
+    assert slo_t["noisy"].snapshot()["alert"] == 1, \
+        slo_t["noisy"].snapshot()
+    assert slo_t["victim"].snapshot()["alert"] == 0
+    st = router.tenant_stats()
+    assert st["noisy"]["accepted"] == 4 and st["noisy"]["shed"] == 4
+    # ... and the merged fleet account carries it even with zero
+    # federated replicas (the router's windows join the merge)
+    fed_slo = {}
+    router.federate_now()
+    snap = router.federation_snapshot()
+    if snap is not None:
+        fed_slo = snap.get("slo_tenants") or {}
+    # no replicas federated (all dead): federation_snapshot may be
+    # None — the tracker itself is the pinned behavior above
+    if fed_slo:
+        assert fed_slo["noisy"]["alert"] == 1
+
+
+def test_autoscaler_standby_admit_and_retire(make_router):
+    """The closed loop in isolation: queued work with zero free slots
+    admits the standby (fleet_scale event, /fleetz + series account);
+    a quiet fleet retires it after the idle window — with hysteresis
+    (cooldown) and the scale_min floor respected."""
+    release = threading.Event()
+
+    def slow(toks, seq):
+        release.wait(10.0)
+        return [t + 1 for t in toks]
+
+    # actives block until released; the standby is fresh idle capacity
+    # (a fast backend) — no tenant table: the autoscaler policy is
+    # orthogonal to the QoS layer and must work without it
+    reps = [_inproc_replica(slow, queue_size=2, tenants=None)
+            for _ in range(2)]
+    sb = _inproc_replica(lambda toks, seq: [t + 1 for t in toks],
+                         queue_size=2, tenants=None)
+    telemetry.enable()
+    try:
+        router = make_router(
+            [("127.0.0.1", fe.port, ss.port) for fe, ss in reps],
+            probe_ms=3600e3, federate_ms=3600e3,
+            standby_replicas=[("127.0.0.1", sb[0].port, sb[1].port)],
+            scale_down_idle_s=0.15, scale_cooldown_s=0.0)
+        standby = router._replicas[2]
+        assert standby.standby and standby.from_standby
+        router.probe_now()
+        # idle fleet: no action, the standby stays out of /pick
+        assert router.autoscale_now() is None
+        assert router.health_probe()[1].startswith("routing to 2 of 3")
+        # saturate: park one request in each active worker and FILL
+        # its 2-slot queue (an arrival must shed, not queue behind the
+        # parked work)
+        socks = []
+        for fe, _ in reps:
+            s = socket.create_connection(("127.0.0.1", fe.port),
+                                         timeout=5)
+            s.sendall(b"9\n")
+            socks.append(s)
+            wait_until(lambda fe=fe: fe._inflight == 1,
+                       msg="worker occupied")
+            for k in range(2):
+                s = socket.create_connection(("127.0.0.1", fe.port),
+                                             timeout=5)
+                s.sendall(b"9\n")
+                socks.append(s)
+                wait_until(lambda fe=fe, k=k: len(fe._q) == k + 1,
+                           msg="queued")
+        router.probe_now()
+        assert router.autoscale_now() == "up"
+        assert standby.standby is False
+        snap = router.scale_snapshot()
+        assert snap["target_replicas"] == 3 and snap["events"] == 1
+        assert snap["recent"][-1]["action"] == "up"
+        evs = [e for e in telemetry.recent_events()
+               if e.get("ev") == "fleet_scale"]
+        assert evs and evs[-1]["action"] == "up"
+        # traffic now routes to the admitted standby (the actives are
+        # wedged full — the pick must find the fresh replica)
+        assert faultinject.serve_request(router.port, "5") == "6"
+        # quiet down: drain the parked work, then idle past the window
+        release.set()
+        for s in socks:
+            s.close()
+        wait_until(lambda: all(fe.stats()["served"] >= 3
+                               for fe, _ in reps), msg="drained")
+        router.probe_now()
+        assert router.autoscale_now() is None      # idle timer starts
+        time.sleep(0.2)
+        router.probe_now()
+        assert router.autoscale_now() == "down"
+        assert standby.standby is True
+        snap = router.scale_snapshot()
+        assert snap["target_replicas"] == 2 and snap["events"] == 2
+        evs = [e for e in telemetry.recent_events()
+               if e.get("ev") == "fleet_scale"]
+        assert evs[-1]["action"] == "down" \
+            and evs[-1]["replica"] == standby.name
+        # scale_min floor: with the fleet back at 2 primaries, a quiet
+        # fleet never retires below the floor
+        time.sleep(0.2)
+        router.probe_now()
+        assert router.autoscale_now() is None
+    finally:
+        release.set()
+        telemetry.disable()
+        for fe, ss in reps + [sb]:
+            fe.drain(timeout_ms=1000)
+            ss.stop()
+
+
+def test_tenant_flood_chaos_headline(make_router):
+    """THE ISSUE-13 acceptance, end to end in-process: one tenant
+    floods a 2-replica fleet -> only THAT tenant sheds (the victim's
+    requests all serve, its p99 and per-tenant SLO burn hold), the
+    autoscaler admits the standby mid-flood, the fleet scales back
+    down after the flood — zero silent losses, and the books reconcile
+    per tenant on the router AND fleet-wide."""
+
+    def work(toks, seq):
+        time.sleep(0.003)
+        return [t + 1 for t in toks]
+
+    reps = [_inproc_replica(work, queue_size=4, slo=True)
+            for _ in range(2)]
+    sb = _inproc_replica(work, queue_size=4, slo=True)
+    telemetry.enable()
+    stop = threading.Event()
+    try:
+        router = make_router(
+            [("127.0.0.1", fe.port, ss.port) for fe, ss in reps],
+            probe_ms=3600e3, federate_ms=3600e3, retries=2,
+            standby_replicas=[("127.0.0.1", sb[0].port, sb[1].port)],
+            scale_up_burn=1.0, scale_down_idle_s=0.2,
+            scale_cooldown_s=0.3, tenants=TEN,
+            tenant_default="victim",
+            # the router's own windows: a flood shed at the DOOR must
+            # still burn its tenant's fleet-wide budget
+            slo_tenants={t: statusd.SLOTracker(availability=0.99,
+                                               min_requests=4,
+                                               min_bad=3,
+                                               window_s=60.0)
+                         for t in ("noisy", "victim")})
+        router.probe_now()
+
+        def pace():
+            # the prober loop, off the clock: probe + federate + one
+            # autoscale pass per turn (what the real thread does per
+            # sweep), until the test stops it
+            while not stop.is_set():
+                router.probe_now()
+                router.federate_now()
+                router.autoscale_now()
+                time.sleep(0.05)
+
+        pacer = threading.Thread(target=pace, daemon=True)
+        pacer.start()
+        results = {}
+
+        def flood(name, **kw):
+            results[name] = faultinject.tenant_flood(
+                router.port, name, duration_s=1.2, **kw)
+
+        ths = [threading.Thread(target=flood, args=("noisy",),
+                                kwargs={"nclients": 6}),
+               threading.Thread(target=flood, args=("victim",),
+                                kwargs={"nclients": 1})]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        noisy, victim = results["noisy"], results["victim"]
+        # zero silent losses: every request of BOTH tenants got its
+        # one response line
+        assert noisy["lost"] == 0 and victim["lost"] == 0
+        # isolation: the flooding tenant shed (with the fair-share
+        # verdict), the victim NEVER did — every victim request served
+        assert noisy["tenant_shed"] > 0, noisy
+        assert victim["shed"] == 0 and victim["errors"] == 0, victim
+        assert victim["served"] == victim["sent"] > 0, victim
+        # the victim's latency tail holds while the flood rages: its
+        # closed-loop p99 stays a couple of dispatch times, far under
+        # the second-scale pile-up an unfair queue would give it
+        vmax = max(victim["latencies"])
+        assert vmax < 1.0, (vmax, victim)
+        # the autoscaler admitted the standby DURING the flood (the
+        # bounded scale log pins it — the telemetry ring is churned by
+        # thousands of flood request events; the fleet_scale JSONL
+        # event itself is pinned by the autoscaler unit test)
+        snap = router.scale_snapshot()
+        assert snap["events"] >= 1
+        assert snap["recent"][0]["action"] == "up", snap["recent"]
+        # ... and retires it once the flood is gone (the pacer keeps
+        # running the loop)
+        wait_until(lambda: router._replicas[2].standby, timeout=6.0,
+                   msg="scale-down after the flood")
+        # per-tenant SLO: the noisy tenant burned its own fleet-wide
+        # budget; the victim's held at 0
+        router.federate_now()
+        fslo = router.federation_snapshot()["slo_tenants"]
+        assert fslo["noisy"]["alert"] == 1, fslo
+        assert fslo.get("victim", {"alert": 0})["alert"] == 0, fslo
+        stop.set()
+        pacer.join(2.0)
+        # books reconcile: router-wide, per tenant on the router, per
+        # tenant on every replica — and the router's accepted equals
+        # exactly what the two floods sent
+        st = router.stats()
+        assert reconciles(st), st
+        assert st["accepted"] == noisy["sent"] + victim["sent"], \
+            (st, noisy["sent"], victim["sent"])
+        tenant_reconciles(router.tenant_stats())
+        for fe, _ in reps + [sb]:
+            assert reconciles(fe.stats())
+            tenant_reconciles(fe.tenant_stats())
+        rt = router.tenant_stats()
+        assert rt["victim"]["served"] == victim["served"]
+        assert rt["noisy"]["shed"] == noisy["shed"], \
+            (rt["noisy"], noisy)
+    finally:
+        stop.set()
+        telemetry.disable()
+        for fe, ss in reps + [sb]:
+            fe.drain(timeout_ms=2000)
+            ss.stop()
 
 
 # ----------------------------------------------------------------------

@@ -21,6 +21,7 @@ lives in tests/test_decode.py::test_cli_serve_task.
 import json
 import os
 import signal
+import socket
 import subprocess
 import sys
 import threading
@@ -1443,5 +1444,195 @@ def test_batch_occupancy_metrics_honest_weighted_mean(make_frontend):
 
 
 # ----------------------------------------------------------------------
+# ----------------------------------------------------------------------
+# multi-tenant weighted-fair QoS (doc/serving.md "Multi-tenant QoS")
+TEN = "noisy:1,victim:4"
+
+
+def park_worker_and_fill(fe, port, tenant, n, first="9"):
+    """Occupy the worker with one request, then queue ``n`` more from
+    ``tenant`` — deterministically (the occupy_and_fill discipline:
+    waiting on counters alone races the worker's pop)."""
+    socks = []
+    s = socket.create_connection(("127.0.0.1", port), timeout=5)
+    s.sendall(("TENANT %s %s\n" % (tenant, first)).encode())
+    socks.append(s)
+    deadline = time.monotonic() + 5.0
+    while not fe._inflight and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert fe._inflight, "worker never occupied"
+    for i in range(n):
+        s = socket.create_connection(("127.0.0.1", port), timeout=5)
+        s.sendall(("TENANT %s %d\n" % (tenant, 10 + i)).encode())
+        socks.append(s)
+        want = i + 1
+        deadline = time.monotonic() + 5.0
+        while len(fe._q) < want and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert len(fe._q) == want, "queue fill stalled at %d" % len(fe._q)
+    return socks
+
+
+def test_tenant_prefix_parse_validation_and_compat(make_frontend):
+    """The TENANT wire contract: adopted + accounted, composes with
+    TRACE and DEADLINE (TRACE first), malformed/unknown ids are ERR
+    proto (deterministic, never dispatched), and prefix-less clients
+    ride the default tenant unchanged — the downgrade acceptance."""
+    fe = make_frontend(tenants=TEN, tenant_default="victim")
+    port = fe.port
+    assert faultinject.serve_request(port, "TENANT noisy 1 2") == "2 3"
+    assert faultinject.serve_request(
+        port, "TRACE t-1 TENANT noisy DEADLINE 5000 7") == "8"
+    assert fe.flight.get("t-1")["tenant"] == "noisy"
+    # prefix-less clients are the default tenant — wire unchanged
+    assert faultinject.serve_request(port, "5") == "6"
+    assert fe.flight.list()[0]["tenant"] == "victim"
+    for bad in ("TENANT", "TENANT bad!id 1", "TENANT %s 1" % ("x" * 33),
+                "TENANT ghost 1"):
+        resp = faultinject.serve_request(port, bad)
+        assert resp.startswith("ERR proto tenant"), (bad, resp)
+    assert faultinject.serve_request(
+        port, "TENANT noisy").startswith("ERR empty")
+    # TENANT + ADMIN composes (prefixes stripped first); the stats line
+    # carries the per-tenant books
+    resp = faultinject.serve_request(port, "TENANT noisy ADMIN stats")
+    assert resp.startswith("OK ")
+    assert "tenant.noisy.accepted=" in resp
+    assert "tenant.victim.served=" in resp
+    ts = fe.tenant_stats()
+    assert ts["noisy"]["accepted"] == 2 and ts["noisy"]["served"] == 2
+    assert ts["victim"]["accepted"] == 1
+    stats = fe.drain()
+    assert reconciles(stats)
+    for t, st in fe.tenant_stats().items():
+        assert st["accepted"] == (st["served"] + st["errors"]
+                                  + st["shed"] + st["deadline"]), (t, st)
+
+
+def test_tenant_fair_share_shed_and_eviction(make_frontend):
+    """The capacity-fairness contract: a borrower over its fair share
+    is shed with the ``tenant`` detail token (NOT retryable — the
+    policy holds fleet-wide), and an under-share arrival EVICTS the
+    borrower's newest queued request instead of being shed itself."""
+    from cxxnet_tpu.utils import routerd
+    release = threading.Event()
+
+    def slow(toks, seq):
+        release.wait(10.0)
+        return [t + 1 for t in toks]
+
+    fe = make_frontend(slow, queue_size=4, tenants=TEN,
+                       tenant_default="victim")
+    port = fe.port
+    socks = park_worker_and_fill(fe, port, "noisy", 4)
+    try:
+        assert fe._q.shares == {"noisy": 1, "victim": 3}
+        # noisy is over its share of a full queue: its arrival sheds
+        # with the machine-readable "tenant" verdict, which the router
+        # must NOT retry (every replica shares the table)
+        resp = faultinject.serve_request(port, "TENANT noisy 99")
+        assert resp.startswith("ERR busy tenant"), resp
+        assert not routerd.retryable(resp)
+        assert fe.flight.list()[0]["shed_at"] == "tenant"
+        # a victim arrival is UNDER its share: admitted by evicting the
+        # borrower's newest queued request (charged to noisy)
+        got = []
+        done = fe.submit("TENANT victim 50", got.append)
+        assert done is not None, "victim was shed instead of admitted"
+        assert len(fe._q) == 4 and fe._q.depth("victim") == 1
+        ts = fe.tenant_stats()
+        assert ts["noisy"]["shed"] == 2      # the arrival + the evictee
+        assert ts["victim"]["shed"] == 0
+        release.set()
+        done.wait(5.0)
+        assert got == ["51"]
+    finally:
+        release.set()
+        stats = fe.drain()
+        for s in socks:
+            s.close()
+    assert reconciles(stats)
+    for t, st in fe.tenant_stats().items():
+        assert st["accepted"] == (st["served"] + st["errors"]
+                                  + st["shed"] + st["deadline"]), (t, st)
+
+
+def test_tenant_weighted_fair_scheduling_order(make_frontend):
+    """The stride scheduler: with both tenants backlogged, a weight-4
+    tenant gets 4 dispatches for every 1 of a weight-1 tenant — the
+    worker pop order interleaves by weight, not arrival order."""
+    order = []
+    release = threading.Event()
+
+    def recording(toks, seq):
+        release.wait(10.0)
+        order.append(toks[0])
+        return [t + 1 for t in toks]
+
+    fe = make_frontend(recording, queue_size=16, tenants=TEN,
+                       tenant_default="victim")
+    port = fe.port
+    # park the worker, then queue noisy FIRST (arrival order would
+    # serve all noisy before any victim)
+    socks = park_worker_and_fill(fe, port, "noisy", 4)
+    try:
+        for i in range(4):
+            s = socket.create_connection(("127.0.0.1", port), timeout=5)
+            s.sendall(("TENANT victim %d\n" % (20 + i)).encode())
+            socks.append(s)
+        deadline = time.monotonic() + 5.0
+        while len(fe._q) < 8 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert len(fe._q) == 8
+        release.set()
+        deadline = time.monotonic() + 5.0
+        while len(order) < 9 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(order) == 9, order
+        # order[0] is the parked noisy request (mid-dispatch when the
+        # backlog formed); among the next 5 pops at least 4 are victim
+        # (weight 4 vs 1), all queued AFTER every noisy request
+        victims = [t for t in order[1:6] if t >= 20]
+        assert len(victims) >= 4, order
+    finally:
+        release.set()
+        fe.drain()
+        for s in socks:
+            s.close()
+
+
+def test_tenant_slo_isolation(make_frontend):
+    """A noisy tenant's sheds burn the NOISY error budget; the victim's
+    own tracker holds at 0 — per-tenant SLO floors from the existing
+    SLOTracker, per tenant."""
+    release = threading.Event()
+
+    def slow(toks, seq):
+        release.wait(10.0)
+        return list(toks)
+
+    slo_t = {t: statusd.SLOTracker(availability=0.999, min_requests=3,
+                                   min_bad=3, window_s=60.0)
+             for t in ("noisy", "victim")}
+    fe = make_frontend(slow, queue_size=2, tenants=TEN,
+                       tenant_default="victim", slo_tenants=slo_t)
+    port = fe.port
+    # worker parked on noisy, queue FULL of noisy borrowings
+    socks = park_worker_and_fill(fe, port, "noisy", 2)
+    try:
+        for _ in range(3):
+            # every further noisy arrival is over-share on a full
+            # queue: shed, charged to noisy's own error budget
+            resp = faultinject.serve_request(port, "TENANT noisy 7")
+            assert resp.startswith("ERR busy tenant"), resp
+        assert slo_t["noisy"].snapshot()["alert"] == 1
+        assert slo_t["victim"].snapshot()["alert"] == 0
+    finally:
+        release.set()
+        fe.drain()
+        for s in socks:
+            s.close()
+
+
 def test_servd_selftest():
     assert servd.selftest() == 0

@@ -101,8 +101,15 @@ def sub_lower_is_better(key, line):
     Conversely throughput/capacity sub-fields (``*_rps``,
     ``*tokens_per_s*``, ``*occupancy*``) are worse when LOWER even on a
     latency row — ``mean_batch_occupancy`` on the serve rows gates as
-    the coalescing win it measures."""
+    the coalescing win it measures. ``noisy_shed_rate`` (the
+    serve_tenant_isolation row) is the one rate that is worse when
+    LOWER: it measures the weighted-fair policy actually shedding the
+    flooding tenant — a drop means the flood is getting through to the
+    victim. (``fleet_scale_latency_s`` needs no special case: the
+    ``latency`` rule already gates it as worse-when-higher.)"""
     k = str(key)
+    if k == "noisy_shed_rate":
+        return False
     if k.endswith("_rps") or "tokens_per_s" in k or "occupancy" in k:
         return False
     if k.endswith("_ms") or "latency" in k or k.endswith("_rate"):
