@@ -561,7 +561,15 @@ def bench_serve_throughput():
 
     fe = servd.ServeFrontend(None, slot_backend=_SlotBackend(),
                              queue_size=64, batch_max=bucket,
-                             batch_window_ms=5.0)
+                             batch_window_ms=5.0,
+                             # size the iteration ring for the WHOLE
+                             # flood: at degraded occupancy the run is
+                             # up to ~(nclients*per+1)*n_new
+                             # iterations, and a silently truncated
+                             # window would bias kv_live_pct /
+                             # queue_age_p99_ms newest-ward exactly
+                             # when the bench should catch a regression
+                             batch_flight_cap=4096)
     fe.start()
     port = fe.listen(0)
     rs = np.random.RandomState(0)
@@ -572,6 +580,7 @@ def bench_serve_throughput():
     from cxxnet_tpu.utils.servd import _ask
     _ask(port, line, timeout=600.0)
     occ0 = (fe._occ_iters, fe._occ_slots)
+    iter0 = fe._iter_ord
     nclients, per = 6, 6
     lats, nerr, nsent = [], [0], [0]
     lock = threading.Lock()
@@ -603,6 +612,16 @@ def bench_serve_throughput():
     wall = time.perf_counter() - t0
     d_iters = fe._occ_iters - occ0[0]
     d_slots = fe._occ_slots - occ0[1]
+    # the decode-datapath observability sub-fields (null-safe): mean
+    # live-KV utilization and queue-age p99 over the flood window's
+    # iteration records — kv_live_pct is THE paged-KV before/after
+    # baseline (ROADMAP item 2: the reclaimable padding+dead-slot
+    # share), queue_age_p99_ms the admission-pressure tail
+    win = [r for r in fe.batch_flight.list() if r["iter"] > iter0]
+    kv_pcts = [r["kv_live_pct"] for r in win
+               if r.get("kv_live_pct") is not None]
+    qages = sorted(r["queue_age_s"] for r in win
+                   if r.get("queue_age_s") is not None)
     fe.drain()
     lats.sort()
     total = max(1, nsent[0])
@@ -618,6 +637,10 @@ def bench_serve_throughput():
             if d_iters else None,
             "decode_bound_tokens_per_s":
             perf.decode_bound_tokens_per_s(n_new),
+            "kv_live_pct": round(sum(kv_pcts) / len(kv_pcts), 2)
+            if kv_pcts else None,
+            "queue_age_p99_ms": round(1e3 * percentile(qages, 99), 3)
+            if qages else None,
             "error_rate": round(nerr[0] / float(total), 4),
             "requests": nsent[0], "bucket": bucket}
 

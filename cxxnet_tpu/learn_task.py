@@ -55,6 +55,12 @@ class _SeededSession:
     def free_slots(self):
         return self._inner.free_slots()
 
+    def kv_account(self):
+        # the live KV/HBM occupancy account rides through untouched —
+        # servd's per-bucket account and /batchz read the REAL session
+        # geometry (cache nbytes, live token extents)
+        return self._inner.kv_account()
+
     def close(self):
         self._inner.close()
 
@@ -217,6 +223,16 @@ class LearnTask:
         self.serve_buckets = ""
         self.serve_batch_max = 8
         self.serve_batch_window_ms = 2.0
+        # decode-datapath observability (doc/observability.md "Decode
+        # datapath"): the iteration-level scheduler flight ring behind
+        # statusd /batchz (one record per decode iteration: slots,
+        # admissions/retirements, queue pressure, KV utilization), and
+        # the convoy threshold — a sequence aboard >=
+        # serve_convoy_iters step iterations while queued work waits
+        # at zero free slots latches cxxnet_decode_convoy and emits
+        # ONE decode_convoy transition event per episode
+        self.serve_batch_flight_cap = 256
+        self.serve_convoy_iters = 64
         # serving SLOs + request tracing (doc/observability.md "Request
         # tracing & SLOs"): every request gets a phase-attributed trace
         # in a bounded flight recorder (statusd /trace?request=<id>,
@@ -509,6 +525,10 @@ class LearnTask:
             self.serve_batch_max = int(val)
         if name == "serve_batch_window_ms":
             self.serve_batch_window_ms = float(val)
+        if name == "serve_batch_flight_cap":
+            self.serve_batch_flight_cap = int(val)
+        if name == "serve_convoy_iters":
+            self.serve_convoy_iters = int(val)
         if name == "slo_ttft_ms":
             self.slo_ttft_ms = float(val)
         if name == "slo_p99_ms":
@@ -1503,6 +1523,8 @@ class LearnTask:
             slot_backend=slot_backend,
             batch_max=self.serve_batch_max,
             batch_window_ms=self.serve_batch_window_ms,
+            batch_flight_cap=self.serve_batch_flight_cap,
+            convoy_iters=self.serve_convoy_iters,
             tenants=tenants, tenant_default=self.serve_tenant_default,
             slo_tenants=slo_tenants)
         fe.start()
@@ -1512,6 +1534,14 @@ class LearnTask:
         statusd.set_flight_recorder(fe.flight)
         statusd.set_slo(slo)
         statusd.set_slo_tenants(slo_tenants)
+        if slot_backend is not None:
+            # decode-datapath observability (doc/observability.md
+            # "Decode datapath"): /batchz + the cxxnet_decode_* series
+            # + the /trace slot-Gantt lanes serve from the frontend's
+            # iteration ring, and the perf ledger charges the live
+            # decode KV cache against HBM headroom
+            statusd.set_batch(fe)
+            perf.set_decode_kv(fe.decode_kv_bytes)
         if self.serve_port >= 0:
             try:
                 port = fe.listen(self.serve_port, host=self.serve_host)

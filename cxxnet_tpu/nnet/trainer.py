@@ -2099,6 +2099,12 @@ class DecodeSession:
         self._pos_dev = jnp.zeros(self.nslots, jnp.int32)
         self._active = [False] * self.nslots
         self._remaining = [0] * self.nslots
+        # per-slot prompt length: with _remaining it gives the live
+        # cache extent (plen + tokens generated) the KV occupancy
+        # account reads — host scheduling metadata, never a device
+        # fetch (the deleted _pos mirror was write-only; this is read
+        # by kv_account every decode iteration)
+        self._plen = [0] * self.nslots
         self.closed = False
 
     # -- bookkeeping ---------------------------------------------------
@@ -2108,6 +2114,34 @@ class DecodeSession:
 
     def free_slots(self) -> List[int]:
         return [s for s in range(self.nslots) if not self._active[s]]
+
+    def kv_account(self) -> dict:
+        """The session's live KV/HBM occupancy account (doc/
+        performance.md "Decode KV cache"): ``kv_bytes`` is the REAL
+        allocated cache footprint (sum of the slot-major cache arrays'
+        nbytes — device-array metadata, no transfer), ``kv_live_bytes``
+        prorates it by the cache rows actually holding K/V (each active
+        slot's prompt length + tokens generated so far, vs the
+        ``nslots * l_max`` rows allocated). The gap — padding to l_max
+        plus dead slots — is exactly what a paged KV cache (ROADMAP
+        item 2) would reclaim; servd publishes it as
+        ``cxxnet_decode_kv_live_pct``. A closed session accounts 0 (its
+        arrays are released)."""
+        if self.closed or self._caches is None:
+            return {"bucket": self.nslots, "l_max": self.l_max,
+                    "active": 0, "kv_bytes": 0, "kv_live_bytes": 0,
+                    "live_tokens": 0, "alloc_tokens": 0}
+        kv_bytes = sum(int(getattr(a, "nbytes", 0))
+                       for a in self._caches.values())
+        alloc = self.nslots * self.l_max
+        live = sum(self._plen[s]
+                   + (self.n_new - 1 - self._remaining[s])
+                   for s in range(self.nslots) if self._active[s])
+        return {"bucket": self.nslots, "l_max": self.l_max,
+                "active": self.active_count, "kv_bytes": kv_bytes,
+                "kv_live_bytes": int(round(kv_bytes * live / alloc))
+                if alloc else 0,
+                "live_tokens": live, "alloc_tokens": alloc}
 
     def _check_live(self) -> None:
         check(not self.closed, "decode_session: session is closed")
@@ -2262,6 +2296,7 @@ class DecodeSession:
         self.tr._decode_params = (self.tr._decode_params[0], new_params)
         self._active[slot] = True
         self._remaining[slot] = self.n_new - 1
+        self._plen[slot] = plen
         telemetry.count("decode.tokens")
         return first, self._remaining[slot] == 0
 
@@ -2306,6 +2341,7 @@ class DecodeSession:
         if 0 <= slot < self.nslots:
             self._active[slot] = False
             self._remaining[slot] = 0
+            self._plen[slot] = 0
 
     def close(self) -> None:
         """Release the device state (the per-slot caches are the

@@ -85,6 +85,10 @@ RANKS = {
     "statusd.slo": 70,      # SLOTracker._lock — emits telemetry under it
     "health.ids": 80,       # health anomaly-id allocation
     "perf.profilez": 85,    # ProfilerCapture._lock — capture guard
+    "servd.batchflight": 88,  # BatchFlightRecorder._ring — the
+    #                           per-iteration batch scheduler ring
+    #                           (appended outside every servd lock,
+    #                           read by statusd /batchz)
     "telemetry.flight": 90,   # FlightRecorder._ring
     "perf.ledger": 95,      # Ledger._cond — emits program_card events
     #                         and reads registry hists under it

@@ -309,6 +309,11 @@ class Ledger:
         self._jobs: deque = deque()
         self._busy = 0
         self._thread: Optional[threading.Thread] = None
+        # set_decode_kv: a callable returning the serving frontend's
+        # live decode KV-cache bytes — the decode cache is persistent
+        # device state BETWEEN program executions, so the HBM headroom
+        # account must charge it next to the peak program footprint
+        self._decode_kv_fn = None
 
     def _reg(self):
         return self._registry if self._registry is not None \
@@ -608,12 +613,37 @@ class Ledger:
                         100.0 * c["predicted_s"] / p50_s, 2)
             if c["peak_bytes"] is not None:
                 peak = max(peak or 0, c["peak_bytes"])
+        decode_kv = None
+        fn = self._decode_kv_fn
+        if fn is not None:
+            try:
+                decode_kv = int(fn())
+            except Exception:
+                decode_kv = None    # the account never kills a scrape
         hbm = {"capacity_bytes": spec.hbm_capacity,
                "peak_bytes": peak,
-               "headroom_bytes": (spec.hbm_capacity - peak)
+               # the live decode KV cache is a first-class HBM
+               # consumer: persistent device state held BETWEEN
+               # program executions, so headroom charges it on top of
+               # the peak program footprint. (A decode-step execution's
+               # argument bytes include its own session's cache, so
+               # the sum is conservative by up to one session — the
+               # safe direction for an allocator sizing against it.)
+               "decode_kv_bytes": decode_kv,
+               "headroom_bytes":
+               (spec.hbm_capacity - peak - (decode_kv or 0))
                if peak is not None else None}
         return {"spec": spec.to_dict(), "enabled": self.enabled,
                 "cards": cards, "hbm": hbm}
+
+    def set_decode_kv(self, fn) -> None:
+        """Register the decode KV-cache account hook (``fn() ->
+        bytes``; None clears) — servd's batching frontend wires its
+        ``decode_kv_bytes`` here so /programz, /statusz and the
+        ``cxxnet_hbm_headroom_bytes`` gauge charge the live decode
+        cache against HBM (what ROADMAP item 2's paged allocator will
+        size against)."""
+        self._decode_kv_fn = fn
 
 
 class ProfilerCapture:
@@ -769,6 +799,12 @@ def drain(timeout: float = 10.0) -> bool:
 
 def reset() -> None:
     _LEDGER.reset()
+
+
+def set_decode_kv(fn) -> None:
+    """Module-level form of ``Ledger.set_decode_kv`` (the learn-task
+    serve wiring)."""
+    _LEDGER.set_decode_kv(fn)
 
 
 def decode_bound_tokens_per_s(ntok: int) -> Optional[float]:

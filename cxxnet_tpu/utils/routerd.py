@@ -311,7 +311,7 @@ class Replica:
 
     __slots__ = ("name", "host", "port", "status_port", "state",
                  "detail", "hold", "queue_depth", "in_flight",
-                 "free_slots", "has_slots", "outstanding",
+                 "free_slots", "has_slots", "buckets", "outstanding",
                  "probe_fails", "ejections", "next_probe_at",
                  "last_probe", "no_trace", "trace_ok",
                  "no_tenant", "tenant_ok", "standby", "from_standby")
@@ -338,6 +338,12 @@ class Replica:
         #                              free_slots at all — absent means
         #                              no batching, and 0 must then read
         #                              as "unknown", not "saturated"
+        self.buckets = {}            # per-bucket load signal from
+        #                              ADMIN stats (bucket.<b>.warm /
+        #                              .active): {b: {"warm", "active"}}
+        #                              — what /fleetz shows and
+        #                              disaggregated scheduling will
+        #                              route on; empty pre-batching
         self.outstanding = 0         # router-side live request count
         self.probe_fails = 0
         self.ejections = 0           # backoff exponent while dead
@@ -374,6 +380,8 @@ class Replica:
                 "queue_depth": self.queue_depth,
                 "in_flight": self.in_flight,
                 "free_slots": self.free_slots,
+                "buckets": {str(b): dict(d) for b, d
+                            in sorted(self.buckets.items())},
                 "outstanding": self.outstanding,
                 "ejections": self.ejections,
                 "probe_fails": self.probe_fails,
@@ -683,6 +691,27 @@ class Router:
                     # last-known — the field IS the capability signal
                     r.free_slots = st.get("free_slots", 0)
                     r.has_slots = "free_slots" in st
+                    # per-bucket warm/active counts (bucket.<b>.warm /
+                    # bucket.<b>.active): the per-bucket load signal —
+                    # wholesale replacement, same absent-means-none
+                    # discipline as free_slots
+                    buckets: Dict[int, dict] = {}
+                    for k, v in st.items():
+                        if not k.startswith("bucket."):
+                            continue
+                        # defensive parse: a foreign/old replica may
+                        # emit any 'bucket.*' shape, and a ValueError
+                        # here would kill the prober thread for good
+                        parts = k.split(".")
+                        if len(parts) != 3 \
+                                or parts[2] not in ("warm", "active"):
+                            continue
+                        try:
+                            buckets.setdefault(
+                                int(parts[1]), {})[parts[2]] = v
+                        except ValueError:
+                            continue
+                    r.buckets = buckets
             self._mark(r, UP, "ready")
         else:
             lower = body.lower()
@@ -1412,7 +1441,17 @@ class Router:
         counters: Dict[str, float] = {}
         slo_acc = _SloMerge()
         slo_tenant_acc: Dict[str, _SloMerge] = {}
+        # the decode KV/convoy account (the replicas' batch feed):
+        # byte sums are EXACT (each replica accounts its own cache),
+        # live pct recomputed from the sums — never a mean of means
+        dec_reps = dec_kv = dec_live = dec_convoy = 0
         for name, snap in sorted(fed.items()):
+            b = snap.get("batch")
+            if isinstance(b, dict):
+                dec_reps += 1
+                dec_kv += int(b.get("kv_bytes") or 0)
+                dec_live += int(b.get("kv_live_bytes") or 0)
+                dec_convoy += 1 if b.get("convoy") else 0
             m = snap.get("metrics") or {}
             for hname, d in (m.get("hists") or {}).items():
                 if not hname.startswith("serve."):
@@ -1452,6 +1491,13 @@ class Router:
                                sorted(slo_tenant_acc.items())
                                for res in [acc.result()]
                                if res is not None}}
+        if dec_reps:
+            out["decode"] = {
+                "replicas": dec_reps, "kv_bytes": dec_kv,
+                "kv_live_bytes": dec_live,
+                "kv_live_pct": round(100.0 * dec_live / dec_kv, 2)
+                if dec_kv else None,
+                "convoy_replicas": dec_convoy}
         # the per-tenant fleet account, parsed back out of the summed
         # serve.tenant.<t>.<key> counter series and the merged
         # serve.tenant.<t>.request histograms: fleet-wide per-tenant

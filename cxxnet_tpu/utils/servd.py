@@ -174,8 +174,29 @@ chaos tests inject jax-free fakes (tests/faultinject.py). Scheduling:
   weighted-mean pair ``serve.batch_iterations`` /
   ``serve.batch_slot_iterations`` (mean occupancy = slots/iterations —
   a last-write gauge scraped between batches lies), and ``ADMIN stats``
-  reports ``free_slots`` (bucket capacity − active) so the fleet router
-  can prefer the replica that can batch a request in.
+  reports ``free_slots`` (bucket capacity − active) plus per-bucket
+  ``batch_buckets`` / ``bucket.<b>.warm`` / ``bucket.<b>.active`` so
+  the fleet router can prefer the replica that can batch a request in.
+* **the scheduler is observed per ITERATION** (doc/observability.md
+  "Decode datapath") — every decode iteration lands in the
+  ``BatchFlightRecorder`` ring (``batch_flight_cap``): bucket, step
+  latency, the slots aboard with each occupant's request id and age,
+  admissions/retirements, queue depth + head-of-queue age (also the
+  ``serve.queue_age`` histogram), live-KV utilization, convoy verdict.
+  statusd serves it at ``/batchz`` and renders a request's iterations
+  as slot-Gantt lanes inside its ``/trace?request=<id>`` trace;
+  ``batch_iteration`` JSONL events fire on composition CHANGES only
+  (never per token). The per-bucket KV account (``batch_snapshot``,
+  joined from each session's ``kv_account()``) publishes
+  ``cxxnet_decode_kv_bytes{bucket=}`` / ``cxxnet_decode_kv_live_pct``
+  / ``cxxnet_decode_slot_waste_pct`` — the padding+dead-slot waste a
+  paged KV cache (ROADMAP item 2) would reclaim — and feeds the perf
+  ledger's HBM headroom account (``decode_kv_bytes``). A **convoy**
+  — a sequence aboard >= ``convoy_iters`` iterations while queued work
+  waits at zero free slots — latches ``cxxnet_decode_convoy``, counts
+  ``serve.convoys``, and emits ONE transition-only ``decode_convoy``
+  event per episode: the starvation signal the disaggregation
+  scheduler and the autoscaler's pressure pass consume.
 
 Deliberately jax-free (like health.py and statusd.py): the backend is an
 injected callable, so ``python -m cxxnet_tpu.utils.servd --selftest``
@@ -202,7 +223,8 @@ from . import perf
 from . import statusd
 from . import telemetry
 
-__all__ = ["CircuitBreaker", "ServeFrontend", "embed_vocab",
+__all__ = ["CircuitBreaker", "ServeFrontend", "BatchFlightRecorder",
+           "embed_vocab",
            "TRACE_ID_MAX", "valid_trace_id", "TENANT_ID_MAX",
            "valid_tenant_id", "parse_tenants", "selftest"]
 
@@ -437,12 +459,16 @@ class _SlotState:
     """Per-slot request state on the batching dispatcher: the admitted
     request, its trace context (first_token mark, recompiles), its
     phase timestamps (queue_wait ended at slot admission), the tokens
-    produced so far, and the batch occupancy at its admission."""
+    produced so far, the batch occupancy at its admission, and its
+    scheduling coordinates (bucket, slot, first/last step-iteration
+    ordinal — what lets /requestz answer "who did this request share
+    its decode with" without the iteration ring)."""
 
     __slots__ = ("req", "tc", "queue_wait", "t_pop", "t_back", "toks",
-                 "occ")
+                 "occ", "slot", "bucket", "first_iter", "last_iter")
 
-    def __init__(self, req, tc, queue_wait, t_pop, t_back, toks, occ):
+    def __init__(self, req, tc, queue_wait, t_pop, t_back, toks, occ,
+                 slot, bucket):
         self.req = req
         self.tc = tc
         self.queue_wait = queue_wait
@@ -450,6 +476,13 @@ class _SlotState:
         self.t_back = t_back
         self.toks = toks
         self.occ = occ
+        self.slot = slot
+        self.bucket = bucket
+        # step-iteration ordinals this sequence was aboard for (None
+        # until its first step: an n_new == 1 request finishes at
+        # prefill and never shares a decode pass)
+        self.first_iter = None
+        self.last_iter = None
 
 
 class _FairQueue:
@@ -520,6 +553,14 @@ class _FairQueue:
         self._n -= 1
         return self._qs[t].popleft()
 
+    def oldest_arrival(self):
+        """Earliest queued arrival (monotonic), or None when empty —
+        the head-of-queue age the convoy detector and the
+        serve.queue_age histogram read (deque-parity: the plain queue
+        reads its [0])."""
+        ts = [q[0].t_arrival for q in self._qs.values() if q]
+        return min(ts) if ts else None
+
     def over_share(self, tenant: str) -> bool:
         return len(self._qs[tenant]) >= self.shares[tenant]
 
@@ -539,6 +580,73 @@ class _FairQueue:
             return None
         self._n -= 1
         return self._qs[worst].pop()
+
+
+class BatchFlightRecorder:
+    """Bounded ring of per-ITERATION batch scheduler records — the
+    decode datapath's flight recorder (doc/observability.md "Decode
+    datapath"). Where ``telemetry.FlightRecorder`` keeps one record per
+    REQUEST, this ring keeps one per decode iteration: wall epoch,
+    bucket, step latency, the slots aboard (each occupant's request id
+    and age-in-iterations), admissions/retirements since the last
+    record, queue depth + head-of-queue age at the iteration, live-KV
+    utilization, and the convoy verdict. statusd serves it at
+    ``/batchz`` and merges a request's iterations into its
+    ``/trace?request=<id>`` Chrome trace as slot-Gantt lanes.
+
+    Jax-free and registry-independent (the FlightRecorder discipline:
+    it must survive a run with telemetry disabled). Records are
+    appended by the dispatcher OUTSIDE every servd lock, from a
+    snapshot taken under the admission lock once per iteration — the
+    per-iteration feed must never serialize token decoding against a
+    /batchz read. ``iterations``/``slot_iterations`` are LIFETIME
+    tallies (the ring evicts, the tallies do not): their ratio is the
+    same weighted-mean occupancy the ``serve.batch_iterations`` /
+    ``serve.batch_slot_iterations`` counter pair publishes — pinned
+    equal by a regression test."""
+
+    def __init__(self, cap: int = 256):
+        self.cap = max(1, int(cap))
+        self._lock = lockrank.lock("servd.batchflight")
+        self._ring: deque = deque(maxlen=self.cap)
+        self.iterations = 0
+        self.slot_iterations = 0
+
+    def record(self, rec: dict) -> None:
+        with self._lock:
+            self._ring.append(rec)
+            if rec.get("stepped", 1):
+                # only DECODE iterations enter the occupancy tallies —
+                # a journal-flush record (admissions/retirements on a
+                # turn that ran no step, e.g. n_new==1 finishing at
+                # prefill) is scheduler history, not a decode pass
+                self.iterations += 1
+                self.slot_iterations += int(rec.get("occupancy", 0))
+
+    def list(self, n: int = 0) -> List[dict]:
+        """Newest-first snapshot of the ring (``n > 0`` bounds it)."""
+        with self._lock:
+            recs = list(reversed(self._ring))
+        return recs[:n] if n > 0 else recs
+
+    def for_request(self, request_id) -> List[dict]:
+        """OLDEST-first: every ringed iteration the request was aboard
+        — the /trace slot-Gantt feed (which iterations this request
+        shared its decode with, and with whom)."""
+        rid = str(request_id)
+        with self._lock:
+            return [rec for rec in self._ring
+                    if any(str(row[1]) == rid
+                           for row in rec.get("slots") or [])]
+
+    def mean_occupancy(self) -> Optional[float]:
+        if not self.iterations:
+            return None
+        return self.slot_iterations / float(self.iterations)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
 
 
 # stat key -> telemetry counter (serve.requests keeps PR 4's name for the
@@ -590,6 +698,7 @@ class ServeFrontend:
                  slo=None, flight_cap: int = 256,
                  slot_backend=None, batch_max: int = 0,
                  batch_window_ms: float = 0.0,
+                 batch_flight_cap: int = 256, convoy_iters: int = 64,
                  tenants=None, tenant_default: str = "default",
                  slo_tenants=None):
         self.backend = backend
@@ -679,6 +788,29 @@ class ServeFrontend:
         self._batch_free = self._batch_capacity
         self._occ_iters = 0
         self._occ_slots = 0
+        # decode-datapath observability (doc/observability.md "Decode
+        # datapath"): the per-iteration scheduler flight ring, the
+        # per-bucket warm-session/KV account (written by the worker
+        # under _cond, read by /batchz, ADMIN stats and the perf
+        # ledger's HBM hook), and the convoy detector's latch
+        self.batch_flight = (BatchFlightRecorder(batch_flight_cap)
+                             if slot_backend is not None else None)
+        self.convoy_iters = max(1, int(convoy_iters))
+        self._bucket_state = {
+            b: {"warm": 0, "active": 0, "kv_bytes": 0,
+                "kv_live_bytes": 0, "live_tokens": 0,
+                "alloc_tokens": 0}
+            for b in self._buckets}
+        self._convoy = False         # latched while a convoy holds
+        self._convoys = 0            # episodes (0->1 transitions)
+        self._convoy_since = 0       # iteration ordinal of the latch
+        self._iter_ord = 0           # lifetime step-iteration ordinal
+        self._kv_total = 0           # decode_kv_bytes mirror (worker-
+        #                              written, read lock-free)
+        # per-turn scheduler journal (worker-thread only): admissions /
+        # retirements since the last ringed iteration record
+        self._turn_admitted: List[list] = []
+        self._turn_retired: List[list] = []
         self._seq = 0
         self._worker_thread: Optional[threading.Thread] = None
         self._accept_thread: Optional[threading.Thread] = None
@@ -702,6 +834,12 @@ class ServeFrontend:
         for name in ("serve.request", "serve.queue_wait", "serve.ttft",
                      "serve.decode_per_token"):
             telemetry.declare_hist(name)
+        if self.slot_backend is not None:
+            # the batching dispatcher's queue-age distribution (head-
+            # of-queue age sampled once per decode iteration): declared
+            # up front like the latency series — the convoy acceptance
+            # scrapes its buckets before the first flood
+            telemetry.declare_hist("serve.queue_age")
         target = (self._worker_run_batched if self.slot_backend is not None
                   else self._worker_run)
         self._worker_thread = threading.Thread(
@@ -779,6 +917,165 @@ class ServeFrontend:
         if not self._occ_iters:
             return None
         return self._occ_slots / float(self._occ_iters)
+
+    def decode_kv_bytes(self) -> int:
+        """Total allocated decode KV-cache bytes across the warm
+        sessions (0 on the solo path) — the perf ledger's HBM-account
+        hook (``perf.set_decode_kv``): the decode cache is a
+        first-class HBM consumer next to the program footprints.
+        Lock-free (a benign read of the worker's GIL-atomic mirror):
+        /metrics renders already take the admission lock once for the
+        batch snapshot, and the hook must not take it a second time
+        per scrape."""
+        return self._kv_total
+
+    def batch_snapshot(self, ring: int = 0) -> Optional[dict]:
+        """The decode-datapath observability snapshot (None on the solo
+        path): per-bucket warm-session + active-slot + KV accounts, the
+        frontend-wide live-vs-allocated cache utilization
+        (``kv_live_pct`` — the padding+dead-slot waste a paged KV cache
+        would reclaim, ROADMAP item 2), the bucket-rounding
+        ``slot_waste_pct`` (warm slots not decoding), the convoy latch
+        + episode count, and the lifetime iteration tallies. ``ring >
+        0`` appends the newest ``ring`` iteration records. Behind
+        statusd ``/batchz``, the ``cxxnet_decode_*`` /metrics families,
+        and the ``/metrics?json=1`` federation feed."""
+        if self.slot_backend is None:
+            return None
+        with self._cond:
+            buckets = {str(b): dict(bs) for b, bs
+                       in sorted(self._bucket_state.items())}
+            free = self._batch_free
+            qd = len(self._q)
+        kv = sum(bs["kv_bytes"] for bs in buckets.values())
+        kv_live = sum(bs["kv_live_bytes"] for bs in buckets.values())
+        warm_slots = sum(int(b) * bs["warm"]
+                         for b, bs in buckets.items())
+        act = sum(bs["active"] for bs in buckets.values())
+        fl = self.batch_flight
+        snap = {"buckets": buckets, "capacity": self._batch_capacity,
+                "free_slots": free, "queue_depth": qd,
+                "kv_bytes": kv, "kv_live_bytes": kv_live,
+                "kv_live_pct": round(100.0 * kv_live / kv, 2)
+                if kv else None,
+                "slot_waste_pct":
+                round(100.0 * (warm_slots - act) / warm_slots, 2)
+                if warm_slots else None,
+                "convoy": 1 if self._convoy else 0,
+                "convoys": self._convoys,
+                "convoy_iters": self.convoy_iters,
+                "iterations": fl.iterations,
+                "slot_iterations": fl.slot_iterations,
+                "mean_occupancy": self.mean_occupancy(),
+                "flight_cap": fl.cap}
+        if ring > 0:
+            snap["flight"] = fl.list(ring)
+        return snap
+
+    def _eval_convoy(self, bucket: int, free: int, slots_snap,
+                     qd: int, qage) -> Optional[int]:
+        """The convoy verdict for one iteration (worker thread only):
+        a long sequence PINS the bucket — some slot has been aboard >=
+        ``convoy_iters`` step iterations — while queued work waits with
+        zero free slots. Latched (one ``decode_convoy`` transition
+        event per episode, never per-iteration spam; the clearing
+        transition carries the episode length); ``serve.convoys``
+        counts episodes. Returns the age skew (oldest slot vs the
+        median of its batchmates, None without batchmates) for the
+        iteration record."""
+        ages = [row[2] for row in slots_snap]
+        skew = None
+        if ages:
+            mx = max(ages)
+            others = sorted(ages)
+            others.remove(mx)
+            if others:
+                skew = mx - others[len(others) // 2]
+        on = bool(qd > 0 and free == 0 and ages
+                  and max(ages) >= self.convoy_iters)
+        if on and not self._convoy:
+            self._convoy = True
+            self._convoys += 1
+            self._convoy_since = self._iter_ord
+            pinned = max(slots_snap, key=lambda r: r[2])
+            telemetry.count("serve.convoys")
+            telemetry.event({
+                "ev": "decode_convoy", "convoy": 1, "bucket": bucket,
+                "pinned": pinned[1], "slot": pinned[0],
+                "age_iters": pinned[2], "skew_iters": skew,
+                "queue_depth": qd,
+                "queue_age_s": round(qage, 6)
+                if qage is not None else None})
+        elif self._convoy and not on:
+            self._convoy = False
+            telemetry.event({
+                "ev": "decode_convoy", "convoy": 0,
+                "episode_iters": self._iter_ord - self._convoy_since})
+        return skew
+
+    def _record_iteration(self, bucket: int, slots_snap, step_s,
+                          qd: int, qage, occupancy_after: int = 0,
+                          error=None, stepped: bool = True) -> None:
+        """File one scheduler turn in the flight ring and feed the
+        derived series — called AFTER the admission lock is released,
+        from the snapshot ``_publish_batch_state`` took under it.
+        ``occupancy_after`` is the composition LEFT after the turn's
+        retirements: it holds until the next composition change, which
+        is what lets the report reconstruct exact per-iteration
+        occupancy from transition-only events (the event at iteration
+        N weighs N itself at ``occupancy`` and N+1..next-event-1 at
+        ``occupancy_after``). ``stepped=False`` flushes a turn that ran
+        NO decode pass (every admission finished at prefill, or every
+        sequence deadline-retired) so its admissions/retirements are
+        never lost or misattributed to a later iteration; such records
+        stay out of the occupancy tallies. The JSONL ``batch_iteration``
+        event is transition-only (emitted when the composition changed
+        — never per token); the ring keeps every iteration."""
+        ads, self._turn_admitted = self._turn_admitted, []
+        rets, self._turn_retired = self._turn_retired, []
+        skew = self._eval_convoy(bucket, self._batch_free, slots_snap,
+                                 qd, qage)
+        kv = kv_live = 0
+        for bs in self._bucket_state.values():   # worker-owned reads
+            kv += bs["kv_bytes"]
+            kv_live += bs["kv_live_bytes"]
+        rec = {"iter": self._iter_ord,
+               # cxxlint: disable=wallclock — iteration epoch aligning
+               # the slot-Gantt lanes with request flight records
+               # (never subtracted from a monotonic clock)
+               "t_wall": round(time.time(), 6),
+               "bucket": bucket, "occupancy": len(slots_snap),
+               "occupancy_after": int(occupancy_after),
+               "step_ms": round(step_s * 1e3, 3)
+               if step_s is not None else None,
+               "slots": slots_snap,
+               "admitted": ads, "retired": rets,
+               "queue_depth": qd,
+               "queue_age_s": round(qage, 6)
+               if qage is not None else None,
+               "kv_live_pct": round(100.0 * kv_live / kv, 2)
+               if kv else None,
+               "age_skew": skew,
+               "convoy": 1 if self._convoy else 0}
+        if not stepped:
+            rec["stepped"] = 0
+        if error is not None:
+            rec["error"] = str(error)[:200]
+        self.batch_flight.record(rec)
+        if qage is not None and stepped:
+            telemetry.hist("serve.queue_age", qage)
+        if ads or rets or error is not None:
+            ev = {"ev": "batch_iteration", "iter": self._iter_ord,
+                  "bucket": bucket, "occupancy": len(slots_snap),
+                  "occupancy_after": int(occupancy_after),
+                  "queue_depth": qd, "step_ms": rec["step_ms"],
+                  "admitted": [a[0] for a in ads],
+                  "retired": [r[0] for r in rets]}
+            if not stepped:
+                ev["stepped"] = 0
+            if error is not None:
+                ev["error"] = rec["error"]
+            telemetry.event(ev)
 
     # -- health (statusd probes) ---------------------------------------
     def _stalled_for(self) -> float:
@@ -880,7 +1177,8 @@ class ServeFrontend:
                          outcome: str, tc, queue_wait: float,
                          t_pop: float, t_back: float, t_end: float,
                          wall: float, ntok: int,
-                         occupancy: Optional[int] = None) -> None:
+                         occupancy: Optional[int] = None,
+                         batch=None) -> None:
         """Terminal step for every dequeued request: claim the
         exactly-once answer slot, publish the request's telemetry
         (flight record, SLO account, TTFT series), and only THEN send
@@ -893,7 +1191,7 @@ class ServeFrontend:
         won = self._claim(req)
         self._observe_request(req, tc, outcome if won else "abandoned",
                               queue_wait, t_pop, t_back, t_end, wall,
-                              ntok, occupancy=occupancy)
+                              ntok, occupancy=occupancy, batch=batch)
         if won:
             self._bump(counter)
             self._bump_tenant(req.tenant, counter)
@@ -1017,6 +1315,18 @@ class ServeFrontend:
                             # simply omit the field — backward
                             # compatible by absence.
                             live["free_slots"] = self._batch_free
+                            # per-bucket warm-session + active-slot
+                            # counts (bucket.<b>.warm / .active): the
+                            # per-bucket load signal the router's
+                            # /fleetz shows and disaggregated
+                            # scheduling will route on — same
+                            # backward-compatibility-by-absence
+                            live["batch_buckets"] = len(self._buckets)
+                            for b, bs in sorted(
+                                    self._bucket_state.items()):
+                                live["bucket.%d.warm" % b] = bs["warm"]
+                                live["bucket.%d.active" % b] = \
+                                    bs["active"]
                         text = "OK " + " ".join(
                             "%s=%d" % kv for kv in sorted(live.items()))
                     else:
@@ -1375,16 +1685,63 @@ class ServeFrontend:
         telemetry.count("serve.batch_iterations")
         telemetry.count("serve.batch_slot_iterations", n)
 
-    def _publish_batch_state(self, sess, active) -> None:
+    def _publish_batch_state(self, sess, active, sessions=None):
         """Refresh the load signals after any slot change: the live
-        in-flight gauge and the free-slot count ``ADMIN stats`` reports
-        (idle = full capacity; an active session = its free slots)."""
+        in-flight gauge, the free-slot count ``ADMIN stats`` reports
+        (idle = full capacity; an active session = its free slots),
+        and the per-bucket warm-session/KV account. Returns ONE
+        consistent queue snapshot ``(queue_depth, head_of_queue_age)``
+        taken under the same admission-lock acquisition — the
+        per-iteration telemetry (queue-age histogram, iteration ring,
+        convoy verdict) records FROM this snapshot after the lock is
+        released, never re-taking it per token. The KV accounts are
+        read from the sessions BEFORE the lock (host metadata
+        arithmetic — the work-outside-the-lock rule)."""
         cap = self._batch_capacity
         free = cap if not active else \
             max(0, min(cap, sess.nslots) - len(active))
+        accts = {}
+        for b, s in (sessions or {}).items():
+            fn = getattr(s, "kv_account", None)
+            if fn is None:
+                continue
+            try:
+                accts[b] = fn()
+            except Exception:
+                pass          # an account must never kill the worker
         with self._cond:
             self._batch_free = free
+            qd = len(self._q)
+            oldest = None
+            if qd:
+                t0 = (self._q.oldest_arrival()
+                      if isinstance(self._q, _FairQueue)
+                      else self._q[0].t_arrival)
+                if t0 is not None:
+                    oldest = max(0.0, time.monotonic() - t0)
+            if sessions is not None:
+                for b, bs in self._bucket_state.items():
+                    a = accts.get(b) or {}
+                    warm = 1 if (b in sessions
+                                 and not getattr(sessions[b], "closed",
+                                                 False)) else 0
+                    bs.update(warm=warm,
+                              active=int(a.get("active", 0)),
+                              kv_bytes=int(a.get("kv_bytes", 0)),
+                              kv_live_bytes=int(a.get("kv_live_bytes",
+                                                      0)),
+                              live_tokens=int(a.get("live_tokens", 0)),
+                              alloc_tokens=int(a.get("alloc_tokens",
+                                                     0)))
+                # plain-int mirror for decode_kv_bytes: the perf
+                # ledger's hook reads it per /metrics scrape, and must
+                # not pay this (the admission) lock a second time per
+                # render — benign GIL-atomic read, worker-only write
+                self._kv_total = sum(
+                    bs["kv_bytes"]
+                    for bs in self._bucket_state.values())
         telemetry.gauge("serve.in_flight", len(active))
+        return qd, oldest
 
     def _drop_inflight(self, req: _Request) -> None:
         """A popped request got its final answer: leave drain's
@@ -1432,15 +1789,30 @@ class ServeFrontend:
     def _finish_popped(self, req: _Request, text: str, counter: str,
                        outcome: str, tc, queue_wait: float, t_pop: float,
                        t_back: float, ntok: int,
-                       occupancy: Optional[int] = None) -> None:
+                       occupancy: Optional[int] = None,
+                       batch=None) -> None:
         """Terminal answer for a popped request on the batched path —
         the observed finish plus the in-flight bookkeeping drop."""
         t_end = time.perf_counter()
         wall = time.monotonic() - req.t_arrival
         self._finish_observed(req, text, counter, outcome, tc,
                               queue_wait, t_pop, t_back, t_end, wall,
-                              ntok, occupancy=occupancy)
+                              ntok, occupancy=occupancy, batch=batch)
         self._drop_inflight(req)
+
+    def _retire_info(self, st: _SlotState) -> dict:
+        """Journal a slot retirement in the per-turn scheduler log
+        (the iteration ring's ``retired`` column) and return the
+        record's scheduling coordinates: bucket, slot index, and the
+        [first, last] step-iteration ordinals the sequence was aboard
+        (None when it never stepped — n_new == 1 finishes at prefill).
+        Two records with the same bucket and overlapping iteration
+        ranges shared decode passes — the without-the-ring join
+        /requestz readers use."""
+        self._turn_retired.append([st.req.id, st.slot])
+        return {"bucket": st.bucket, "slot": st.slot,
+                "iterations": ([st.first_iter, st.last_iter]
+                               if st.first_iter is not None else None)}
 
     def _fail_unadmitted(self, reqs, msg: str) -> None:
         """Answer popped-but-never-admitted requests ``ERR backend``
@@ -1525,8 +1897,10 @@ class ServeFrontend:
         health.beat("serve.worker")
         self._inflight_since = None
         st = _SlotState(req, tc, queue_wait, t_pop, t_back,
-                        [int(first)], len(active) + 1)
+                        [int(first)], len(active) + 1, slot,
+                        sess.nslots)
         active[slot] = st
+        self._turn_admitted.append([req.id, slot])
         if done:
             self._complete_slot(sess, active, slot)
             return None
@@ -1545,7 +1919,8 @@ class ServeFrontend:
         text = " ".join(str(t) for t in st.toks)
         self._finish_popped(st.req, text, "served", "served", st.tc,
                             st.queue_wait, st.t_pop, st.t_back,
-                            len(st.toks), occupancy=st.occ)
+                            len(st.toks), occupancy=st.occ,
+                            batch=self._retire_info(st))
 
     def _retire_expired(self, sess, active) -> None:
         """Per-ITERATION deadline enforcement: an expired sequence
@@ -1562,7 +1937,8 @@ class ServeFrontend:
                     req, "ERR deadline expired %.0fms ago (mid-decode)"
                     % (1e3 * (now - req.deadline)), "deadline",
                     "deadline", st.tc, st.queue_wait, st.t_pop,
-                    st.t_back, len(st.toks), occupancy=st.occ)
+                    st.t_back, len(st.toks), occupancy=st.occ,
+                    batch=self._retire_info(st))
 
     def _fail_batch(self, sess, active, exc: Exception,
                     count_failure: bool = True) -> None:
@@ -1587,7 +1963,8 @@ class ServeFrontend:
             self._finish_popped(st.req, msg, "errors", "backend_error",
                                 st.tc, st.queue_wait, st.t_pop,
                                 st.t_back, len(st.toks),
-                                occupancy=st.occ)
+                                occupancy=st.occ,
+                                batch=self._retire_info(st))
         active.clear()
 
     def _worker_run_batched(self) -> None:
@@ -1605,6 +1982,11 @@ class ServeFrontend:
         sessions = {}                  # bucket -> warm session
         sess = None                    # current session
         active = {}                    # slot -> _SlotState
+        last_bucket = 0                # bucket of the most recent
+        #                                session: flush records filed
+        #                                after a faulted session was
+        #                                evicted (sess = None) must
+        #                                name the REAL bucket, not 0
 
         def close_all():
             for s in sessions.values():
@@ -1634,6 +2016,11 @@ class ServeFrontend:
                 sess = None
                 self._do_reload()
                 health.beat("serve.worker")
+                # the closed sessions released their caches: zero the
+                # KV account NOW, not at the next admission — /batchz
+                # and the HBM headroom hook must not show a freed
+                # cache as still allocated across an idle stretch
+                self._publish_batch_state(None, {}, sessions)
                 continue
             # --- admit: coalesce queued requests into free slots ---
             if not self._reload_flag:
@@ -1642,6 +2029,7 @@ class ServeFrontend:
                     if batch:
                         b = next((x for x in buckets
                                   if x >= len(batch)), buckets[-1])
+                        last_bucket = b
                         sess = sessions.get(b)
                         if sess is None:
                             try:
@@ -1708,16 +2096,48 @@ class ServeFrontend:
             # --- per-iteration deadline retirement ---
             if active:
                 self._retire_expired(sess, active)
-            self._publish_batch_state(sess, active)
+            if sess is not None:
+                last_bucket = sess.nslots
+            qd, qage = self._publish_batch_state(sess, active, sessions)
             if not active:
+                b0 = sess.nslots if sess is not None else last_bucket
+                if self._turn_admitted or self._turn_retired:
+                    # a turn with journal entries but NO decode pass
+                    # (every admission finished at prefill, or every
+                    # sequence deadline-retired): flush it NOW — left
+                    # queued, the entries would be misattributed to
+                    # whatever iteration comes next (or lost at drain).
+                    # The flush also runs the convoy clear.
+                    self._record_iteration(b0, [], None, qd, qage,
+                                           occupancy_after=0,
+                                           stepped=False)
+                else:
+                    # nothing to step: the convoy latch must still
+                    # clear (the straggler retired / queue drained)
+                    self._eval_convoy(b0, self._batch_free, [], qd,
+                                      qage)
                 continue
             # --- one decode iteration: every active slot, one token ---
             self._observe_occupancy(len(active))
+            self._iter_ord += 1
+            it_ord = self._iter_ord
+            for st in active.values():
+                if st.first_iter is None:
+                    st.first_iter = it_ord
+                st.last_iter = it_ord
+            # the iteration's slot map (slot, occupant id, age in step
+            # iterations) — snapshotted BEFORE the step so the record
+            # reflects exactly the composition that decoded together
+            slots_snap = [[s, st.req.id, it_ord - st.first_iter]
+                          for s, st in sorted(active.items())]
+            bucket = sess.nslots
             self._inflight_since = time.monotonic()
             health.pause("serve.worker")   # a fresh bucket may compile
+            t_step = time.perf_counter()
             try:
                 res = sess.step()
             except Exception as e:
+                step_s = time.perf_counter() - t_step
                 health.beat("serve.worker")
                 self._inflight_since = None
                 self._fail_batch(sess, active, e)
@@ -1725,8 +2145,15 @@ class ServeFrontend:
                 sessions = {b: s for b, s in sessions.items()
                             if s is not sess}
                 sess = None
-                self._publish_batch_state(sess, active)
+                qd, qage = self._publish_batch_state(sess, active,
+                                                     sessions)
+                # the crash iteration is scheduler history too: ringed
+                # with its error so /batchz shows where the batch died
+                self._record_iteration(bucket, slots_snap, step_s, qd,
+                                       qage, occupancy_after=0,
+                                       error=repr(e)[:200])
                 continue
+            step_s = time.perf_counter() - t_step
             health.beat("serve.worker")
             self._inflight_since = None
             for slot, tok, done in res:
@@ -1736,13 +2163,23 @@ class ServeFrontend:
                 st.toks.append(int(tok))
                 if done:
                     self._complete_slot(sess, active, slot)
-            self._publish_batch_state(sess, active)
+            qd, qage = self._publish_batch_state(sess, active, sessions)
+            self._record_iteration(bucket, slots_snap, step_s, qd, qage,
+                                   occupancy_after=len(active))
         close_all()
+        # the worker is exiting (drain/stop): the closed sessions
+        # released their caches — zero the KV account so a /metrics
+        # or /programz scrape during the drain window (or a later
+        # task in this process reading the perf ledger's decode hook)
+        # never reports freed memory as allocated (the reload path's
+        # own invariant)
+        self._publish_batch_state(None, {}, sessions)
 
     def _observe_request(self, req: _Request, tc, outcome: str,
                          queue_wait: float, t_pop: float, t_back: float,
                          t_end: float, wall: float, ntok: int,
-                         occupancy: Optional[int] = None) -> None:
+                         occupancy: Optional[int] = None,
+                         batch=None) -> None:
         """Phase-attribute one dequeued request and publish everything
         downstream reads: the TTFT / per-token histograms and
         tokens-per-second gauge, the flight record, the
@@ -1817,6 +2254,14 @@ class ServeFrontend:
             # admitted to its slot (itself included): /trace and
             # /requestz show the coalescing, request by request
             rec["occupancy_at_dispatch"] = int(occupancy)
+        if batch is not None:
+            # the scheduling coordinates (_retire_info): bucket, slot,
+            # and [first, last] step-iteration ordinals — /requestz
+            # answers "who did this request share its decode with"
+            # by joining overlapping ranges, no iteration ring needed
+            rec["bucket"] = batch.get("bucket")
+            rec["slot"] = batch.get("slot")
+            rec["iterations"] = batch.get("iterations")
         if tps is not None:
             # the decode-step roofline bound for THIS token count (the
             # performance ledger's card, null until one is ready):

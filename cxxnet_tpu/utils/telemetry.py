@@ -835,13 +835,21 @@ class FlightRecorder:
             return len(self._ring)
 
 
-def request_chrome_trace(rec: dict) -> dict:
+def request_chrome_trace(rec: dict, batch_iters=None) -> dict:
     """One flight record -> a Chrome-trace / Perfetto JSON object: the
     phases as back-to-back complete ('X') events on one lane (they tile
     the request's wall-clock), recompiles on a second lane inside the
     phase that paid them. Timestamps are µs relative to request accept,
     so the trace opens in ui.perfetto.dev showing exactly where this
-    request's milliseconds went."""
+    request's milliseconds went.
+
+    ``batch_iters`` (optional, oldest-first) are the batching
+    dispatcher's per-iteration scheduler records containing this
+    request (``servd.BatchFlightRecorder.for_request``): they render as
+    slot-Gantt lanes — one lane per decode slot, one bar per occupant
+    run — aligned on the shared wall epoch (each iteration record's
+    ``t_wall`` minus the request's), so the request's bar shows exactly
+    which iterations it shared its decode with, and with whom."""
     rid = str(rec.get("id", "?"))
     trace: List[dict] = [
         {"ph": "M", "name": "process_name", "pid": 0,
@@ -896,6 +904,59 @@ def request_chrome_trace(rec: dict) -> dict:
                           "args": {"cause": c.get("cause", "?"),
                                    "request": rid}})
             ct = ts + dur
+    t0_wall = rec.get("t_wall")
+    if batch_iters and t0_wall is not None:
+        # slot-Gantt lanes: per slot, contiguous runs of the same
+        # occupant merge into one bar (a straggler shows as one long
+        # bar next to the short bars of the batchmates that came and
+        # went). Each iteration spans [t_wall - step, t_wall] on the
+        # shared wall epoch; clock skew vs the request's own accept
+        # epoch is sub-ms on one host — good enough for a Gantt.
+        runs: Dict[int, dict] = {}       # slot -> open run
+        bars: List[tuple] = []           # (slot, closed run)
+        for it in batch_iters:
+            it_wall = it.get("t_wall")
+            if it_wall is None:
+                continue
+            step_s = float(it.get("step_ms") or 0.0) / 1e3
+            start = it_wall - t0_wall - step_s
+            end = it_wall - t0_wall
+            seen = set()
+            for row in it.get("slots") or []:
+                slot, occupant = int(row[0]), str(row[1])
+                seen.add(slot)
+                run = runs.get(slot)
+                if run is not None and run["rid"] == occupant:
+                    run["end"] = end
+                    run["iters"][1] = it.get("iter")
+                    continue
+                if run is not None:
+                    bars.append((slot, run))
+                runs[slot] = {"rid": occupant, "start": start,
+                              "end": end,
+                              "iters": [it.get("iter"),
+                                        it.get("iter")]}
+            for slot in [s for s in runs if s not in seen]:
+                bars.append((slot, runs.pop(slot)))
+        bars.extend(runs.items())
+        if bars:
+            lanes = sorted({slot for slot, _ in bars})
+            for slot in lanes:
+                trace.append({"ph": "M", "name": "thread_name",
+                              "pid": 0, "tid": 10 + slot,
+                              "args": {"name": "batch slot %d" % slot}})
+            for slot, run in bars:
+                trace.append({
+                    "ph": "X",
+                    "name": run["rid"] if run["rid"] != rid
+                    else "%s (this request)" % rid,
+                    "pid": 0, "tid": 10 + slot,
+                    "ts": round(run["start"] * 1e6, 1),
+                    "dur": round(max(run["end"] - run["start"],
+                                     1e-6) * 1e6, 1),
+                    "args": {"occupant": run["rid"],
+                             "iterations": "%s..%s" % tuple(run["iters"]),
+                             "request": rid}})
     return {"traceEvents": trace, "displayTimeUnit": "ms"}
 
 

@@ -283,7 +283,8 @@ def slot_backend(buckets=(1, 2, 4), n_new: int = 4,
                  long_for=None, long_n_new: int = 0,
                  step_delays=None, explode_on_iterations=(),
                  explode_prefill_for=(), reject_for=(),
-                 max_prompt: int = 0):
+                 max_prompt: int = 0, l_max: int = 64,
+                 kv_row_bytes: int = 1024):
     """Jax-free slot backend for servd's batching dispatcher — the fake
     twin of ``Trainer.decode_session`` (same duck interface: ``buckets``,
     ``session(bucket)``; a session has ``prefill``/``step``/``retire``/
@@ -328,6 +329,22 @@ def slot_backend(buckets=(1, 2, 4), n_new: int = 4,
         def free_slots(self):
             return [s for s in range(self.nslots) if s not in self._live]
 
+        def kv_account(self):
+            # the DecodeSession KV/HBM account's fake twin: a fixed
+            # bytes-per-cache-row geometry (kv_row_bytes x l_max per
+            # slot) so the /batchz + cxxnet_decode_kv_* tests are
+            # deterministic and jax-free
+            ow = self.owner
+            alloc = self.nslots * ow.l_max
+            kv_bytes = 0 if self.closed else alloc * ow.kv_row_bytes
+            live = sum(st["plen"] + st["produced"]
+                       for st in self._live.values())
+            return {"bucket": self.nslots, "l_max": ow.l_max,
+                    "active": len(self._live), "kv_bytes": kv_bytes,
+                    "kv_live_bytes": int(round(kv_bytes * live / alloc))
+                    if alloc else 0,
+                    "live_tokens": live, "alloc_tokens": alloc}
+
         def prefill(self, slot, toks, seq):
             ow = self.owner
             if self.closed:
@@ -346,7 +363,8 @@ def slot_backend(buckets=(1, 2, 4), n_new: int = 4,
             telemetry.mark("first_token")
             n = ow.long_n_new if t0 in ow.long_for else ow.n_new
             self._live[slot] = {"next": t0 + 2, "remaining": n - 1,
-                                "first": t0}
+                                "first": t0, "plen": len(toks),
+                                "produced": 0}
             ow.journal.append(("admit", slot, self.iteration, seq))
             return t0 + 1, n == 1
 
@@ -370,6 +388,7 @@ def slot_backend(buckets=(1, 2, 4), n_new: int = 4,
                 tok = st["next"]
                 st["next"] += 1
                 st["remaining"] -= 1
+                st["produced"] += 1
                 out.append((slot, tok, st["remaining"] <= 0))
             return out
 
@@ -379,6 +398,8 @@ def slot_backend(buckets=(1, 2, 4), n_new: int = 4,
 
         def close(self):
             self._live.clear()
+            self.closed = True      # releases its (fake) cache bytes:
+            #                         kv_account reads 0 from here on
             self.owner.closed += 1
 
     class _Backend:
@@ -393,6 +414,8 @@ def slot_backend(buckets=(1, 2, 4), n_new: int = 4,
             self.explode_on = set(explode_on_iterations or ())
             self.explode_prefill_for = set(explode_prefill_for or ())
             self.reject_for = set(reject_for or ())
+            self.l_max = int(l_max)
+            self.kv_row_bytes = int(kv_row_bytes)
             self.journal = []
             self.sessions = []
             self.closed = 0

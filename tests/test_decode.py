@@ -739,6 +739,68 @@ def test_decode_session_stale_after_params_change():
         sess.prefill(1, [1, 2], 7)
 
 
+def test_decode_session_kv_account_pins_cache_nbytes():
+    """The live KV/HBM occupancy account against REAL device arrays:
+    kv_bytes is exactly the slot-major cache arrays' nbytes, the live
+    share tracks prompt + generated extents through prefill/step/
+    retire, a closed session accounts 0 — and the value survives to
+    the cxxnet_decode_kv_bytes /metrics row through a batching
+    frontend's snapshot (the acceptance pin)."""
+    from cxxnet_tpu.utils import servd, statusd
+    tr = _trained(steps=2)
+    sess = tr.decode_session(2, 3)
+    nbytes = sum(int(a.nbytes) for a in sess._caches.values())
+    assert nbytes > 0
+    acct = sess.kv_account()
+    assert acct["kv_bytes"] == nbytes
+    assert acct["bucket"] == 2 and acct["l_max"] == tr.net_cfg.param.input_shape[2]
+    assert acct["active"] == 0 and acct["kv_live_bytes"] == 0
+    sess.prefill(0, [1, 2, 3], 7)
+    acct = sess.kv_account()
+    assert acct["active"] == 1 and acct["live_tokens"] == 3
+    sess.step()
+    acct = sess.kv_account()
+    assert acct["live_tokens"] == 4      # one more cache row written
+    assert acct["kv_live_bytes"] == int(
+        round(nbytes * 4.0 / acct["alloc_tokens"]))
+    sess.retire(0)
+    assert sess.kv_account()["live_tokens"] == 0
+    sess.close()
+    assert sess.kv_account()["kv_bytes"] == 0
+    # the frontend snapshot -> /metrics pin: a warm session's real
+    # nbytes is what cxxnet_decode_kv_bytes{bucket=} reports
+    made = []
+
+    class _SlotBackend:
+        buckets = [2]
+
+        def session(self, nslots):
+            s = tr.decode_session(nslots, 3)
+            made.append(s)
+            return s
+
+    fe = servd.ServeFrontend(None, slot_backend=_SlotBackend(),
+                             batch_max=2, drain_ms=8000.0)
+    fe.start()
+    port = fe.listen(0)
+    try:
+        assert servd._ask(port, "1 2 3", timeout=120.0)
+        warm_bytes = sum(int(a.nbytes)
+                         for a in made[0]._caches.values())
+        snap = fe.batch_snapshot()
+        assert snap["kv_bytes"] == warm_bytes
+        assert snap["buckets"]["2"]["kv_bytes"] == warm_bytes
+        assert fe.decode_kv_bytes() == warm_bytes
+        text = statusd.prometheus_metrics(
+            {"process": 0, "uptime_s": 1.0, "counters": {},
+             "gauges": {}, "hists": {}, "compiles": 0,
+             "compile_s": 0.0}, batch=snap)
+        assert 'cxxnet_decode_kv_bytes{process="0",bucket="2"} %d' \
+            % warm_bytes in text
+    finally:
+        fe.drain()
+
+
 def test_serve_frontend_continuous_batching_token_exact():
     """The real datapath end-to-end: servd's batching dispatcher over
     Trainer.decode_session serves a concurrent flood with responses

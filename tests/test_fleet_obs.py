@@ -838,6 +838,43 @@ def test_bench_compare_tenant_subfield_directions(tmp_path):
     assert proc.returncode == 0, proc.stdout
 
 
+def test_bench_compare_decode_subfield_directions(tmp_path):
+    """Direction-aware gating for the serve_throughput_rps decode
+    sub-fields: kv_live_pct gates worse-when-LOWER (a drop = more
+    padding/dead-slot waste — the paged-KV baseline regressing),
+    queue_age_p99_ms worse-when-HIGHER via the *_ms rule."""
+    import subprocess
+    import sys
+    bench = tmp_path / "BENCH_r99.json"
+    bench.write_text(json.dumps({
+        "metric": "serve_throughput_rps", "value": 8.0,
+        "unit": "req/s", "kv_live_pct": 10.0,
+        "queue_age_p99_ms": 900.0}) + "\n")
+    base = tmp_path / "BASELINE.json"
+    base.write_text(json.dumps({"published": {
+        "serve_throughput_rps": 8.0,
+        "serve_throughput_rps.kv_live_pct": 40.0,
+        "serve_throughput_rps.queue_age_p99_ms": 100.0}}))
+    proc = subprocess.run(
+        [sys.executable, "tools/bench_compare.py", "--bench",
+         str(bench), "--baseline", str(base)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 2, proc.stdout
+    out = proc.stdout
+    assert out.count("REGRESSION") == 2, out
+    assert "kv_live_pct" in out and "queue_age_p99_ms" in out
+    # the good directions pass: higher utilization, lower queue age
+    bench.write_text(json.dumps({
+        "metric": "serve_throughput_rps", "value": 8.0,
+        "unit": "req/s", "kv_live_pct": 60.0,
+        "queue_age_p99_ms": 50.0}) + "\n")
+    proc = subprocess.run(
+        [sys.executable, "tools/bench_compare.py", "--bench",
+         str(bench), "--baseline", str(base)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout
+
+
 # ----------------------------------------------------------------------
 # the offline --fleet report join
 def test_fleet_report_joins_router_and_replica_shards(tmp_path, capsys):
@@ -896,6 +933,113 @@ def test_fleet_report_joins_router_and_replica_shards(tmp_path, capsys):
 
 # ----------------------------------------------------------------------
 # statusd: /requestz parameters on a serving process
+class _FakeBatch:
+    """A batch_snapshot provider for a stand-in replica's statusd —
+    the federation reads the /metrics?json=1 "batch" key, nothing
+    else, so the fake needs only the snapshot dict."""
+
+    def __init__(self, snap):
+        self._snap = snap
+        self.batch_flight = None
+
+    def batch_snapshot(self, ring: int = 0):
+        return dict(self._snap)
+
+
+def test_fleet_decode_account_federates_exactly():
+    """The decode KV/convoy account federates EXACTLY: byte sums over
+    the replicas' own accounts, live pct recomputed from the sums
+    (never a mean of means), convoy replicas counted — and the
+    serve.queue_age histogram rides the existing exact serve-series
+    merge into cxxnet_fleet_serve_queue_age_seconds."""
+    s1, reg1 = _metric_statusd(
+        {"serve.queue_age": [0.01, 0.2]},
+        counters={"serve.convoys": 1})
+    s1.batch = _FakeBatch({"kv_bytes": 1000, "kv_live_bytes": 900,
+                           "kv_live_pct": 90.0, "convoy": 1,
+                           "convoys": 1, "buckets": {}})
+    s2, reg2 = _metric_statusd({"serve.queue_age": [0.05]})
+    s2.batch = _FakeBatch({"kv_bytes": 3000, "kv_live_bytes": 300,
+                           "kv_live_pct": 10.0, "convoy": 0,
+                           "convoys": 0, "buckets": {}})
+    router = routerd.Router(
+        [("127.0.0.1", 1, s1.port), ("127.0.0.1", 2, s2.port)],
+        probe_ms=3600e3, federate_ms=3600e3, outlier_min_n=1)
+    router.start()
+    rsrv = statusd.StatusServer(0, host="127.0.0.1").start()
+    rsrv.fleet = router
+    try:
+        assert router.federate_now() == 2
+        fed = router.federation_snapshot()
+        dec = fed["decode"]
+        assert dec["replicas"] == 2
+        assert dec["kv_bytes"] == 4000
+        assert dec["kv_live_bytes"] == 1200
+        # 1200/4000 = 30% — the EXACT fleet ratio; a mean of the
+        # per-replica pcts (90+10)/2 = 50% would be the lie
+        assert dec["kv_live_pct"] == 30.0
+        assert dec["convoy_replicas"] == 1
+        metrics = urlopen("http://127.0.0.1:%d/metrics" % rsrv.port,
+                          timeout=5).read().decode()
+        for line in metrics.splitlines():
+            if line and not line.startswith("#"):
+                assert statusd.PROM_LINE_RE.match(line), line
+        assert "cxxnet_fleet_decode_kv_bytes" in metrics
+        assert "cxxnet_fleet_decode_kv_live_pct" in metrics
+        assert "cxxnet_fleet_decode_convoy_replicas" in metrics
+        # the queue-age histogram merged exactly (3 observations)
+        inf = [line for line in metrics.splitlines()
+               if line.startswith("cxxnet_fleet_serve_queue_age_"
+                                  "seconds_bucket")
+               and 'le="+Inf"' in line]
+        assert inf and inf[0].rsplit(" ", 1)[1] == "3", inf
+        # the episode counter sums through the serve.* counter merge
+        assert "cxxnet_fleet_serve_convoys_total" in metrics
+    finally:
+        _drain_all(router, rsrv, s1, s2)
+
+
+def test_fleetz_shows_per_bucket_batch_load():
+    """The router parses ADMIN stats' batch_buckets / bucket.<b>.*
+    keys off a REAL batching replica and surfaces them on /fleetz —
+    the per-bucket load signal disaggregation will route on."""
+    sb = faultinject.slot_backend(buckets=(2, 4), n_new=30,
+                                  per_token_s=0.01)
+    fe = servd.ServeFrontend(None, slot_backend=sb, batch_max=4,
+                             batch_window_ms=0.0, drain_ms=8000.0)
+    fe.start()
+    port = fe.listen(0)
+    ss = statusd.StatusServer(0, host="127.0.0.1").start()
+    ss.register_probe("serving", fe.health_probe)
+    router = routerd.Router([("127.0.0.1", port, ss.port)],
+                            probe_ms=3600e3, federate_ms=3600e3)
+    router.start()
+    rsrv = statusd.StatusServer(0, host="127.0.0.1").start()
+    rsrv.fleet = router
+    ts = []
+    try:
+        ts = [threading.Thread(
+            target=faultinject.serve_request,
+            args=(port, "%d00" % (i + 1),), kwargs={"timeout": 30.0})
+            for i in range(2)]
+        for t in ts:
+            t.start()
+        wait_until(lambda: fe.batch_snapshot()["buckets"]["2"]
+                   ["active"] == 2, msg="batch underway")
+        router.probe_now()
+        snap = router.fleet_snapshot()
+        rep = snap["replicas"][0]
+        assert rep["buckets"]["2"] == {"warm": 1, "active": 2}
+        assert rep["buckets"]["4"] == {"warm": 0, "active": 0}
+        page = urlopen("http://127.0.0.1:%d/fleetz" % rsrv.port,
+                       timeout=5).read().decode()
+        assert "2:2/2" in page, page
+    finally:
+        for t in ts:
+            t.join()
+        _drain_all(router, rsrv, fe, ss)
+
+
 def test_requestz_limit_json_and_single_record():
     fr = telemetry.FlightRecorder(cap=8)
     for i in range(6):

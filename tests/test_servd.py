@@ -1238,6 +1238,13 @@ def test_batch_prefill_failure_closes_session_and_evicts(make_frontend):
     assert ok == _expect_line(200, 3)
     assert len(sb.sessions) >= 2        # the closed one was evicted
     assert sb.sessions[0].closed
+    # the faulted turn's journal flushed under the REAL bucket: the
+    # session was already evicted (sess = None) when the flush ran,
+    # and a bucket-0 row would poison /batchz and the report's
+    # per-bucket table exactly on the fault path being inspected
+    flushes = [r for r in fe.batch_flight.list()
+               if r.get("stepped") == 0]
+    assert flushes and all(r["bucket"] == 2 for r in flushes), flushes
     stats = fe.drain()
     assert reconciles(stats)
 
@@ -1441,6 +1448,434 @@ def test_batch_occupancy_metrics_honest_weighted_mean(make_frontend):
         assert "mean occupancy" in page
     finally:
         srv.stop()
+
+
+# ----------------------------------------------------------------------
+# decode-datapath observability (doc/observability.md "Decode datapath"):
+# the iteration flight ring, /batchz, the KV account, and the convoy
+# detector — all jax-free against faultinject.slot_backend
+def test_batch_iteration_flight_ring(make_frontend):
+    """Every decode iteration lands in the scheduler flight ring with
+    its composition (slot/occupant/age), admissions/retirements, queue
+    pressure, and step latency — and the ring's lifetime weighted mean
+    IS the serve.batch_iterations counter-pair mean (the regression
+    the honest-occupancy contract demands)."""
+    reg = telemetry._Registry()
+    reg.enable()
+    sb = faultinject.slot_backend(buckets=(2,), n_new=4,
+                                  per_token_s=0.002)
+    orig = telemetry._REG
+    telemetry._REG = reg
+    try:
+        fe = make_frontend(None, slot_backend=sb, batch_max=2,
+                           batch_window_ms=40.0)
+        resps = faultinject.serve_flood(fe.port, ["100", "200", "300"],
+                                        timeout=20.0)
+        assert all(not r.startswith("ERR") for r in resps), resps
+        recs = fe.batch_flight.list()
+        assert recs, "iteration ring empty after a batched flood"
+        # ring records carry the full per-iteration schema, and are
+        # JSON-serializable (the /batchz?json=1 contract)
+        json.dumps(recs)
+        for it in recs:
+            assert it["bucket"] == 2
+            assert 1 <= it["occupancy"] <= 2
+            assert it["occupancy"] == len(it["slots"])
+            assert it["step_ms"] >= 0
+            for slot, rid, age in it["slots"]:
+                assert 0 <= slot < 2 and age >= 0
+        # every request was admitted and retired through the journal
+        ads = [a[0] for it in recs for a in it["admitted"]]
+        rets = [r[0] for it in recs for r in it["retired"]]
+        served = [r["id"] for r in fe.flight.list()]
+        assert sorted(ads) == sorted(rets) == sorted(served)
+        # iteration ordinals are dense and newest-first in the listing
+        ords = [it["iter"] for it in recs]
+        assert ords == sorted(ords, reverse=True)
+        # the regression: ring lifetime tallies == the counter pair
+        fe.drain()
+    finally:
+        telemetry._REG = orig
+    snap = reg.metrics_snapshot()
+    assert fe.batch_flight.iterations \
+        == snap["counters"]["serve.batch_iterations"]
+    assert fe.batch_flight.slot_iterations \
+        == snap["counters"]["serve.batch_slot_iterations"]
+    assert fe.batch_flight.mean_occupancy() == fe.mean_occupancy()
+
+
+def test_batch_flight_records_scheduling_coordinates(make_frontend):
+    """Flight records carry bucket / slot / iterations ([first, last]
+    step ordinals) next to occupancy_at_dispatch: two coalesced
+    requests have overlapping ranges in the same bucket — the
+    who-shared-my-decode join /requestz readers use, no ring needed."""
+    sb = faultinject.slot_backend(buckets=(2,), n_new=4,
+                                  per_token_s=0.002)
+    fe = servd.ServeFrontend(None, slot_backend=sb, batch_max=2,
+                             batch_window_ms=0.0, drain_ms=2000.0)
+    done = [fe.submit("%d00 7" % (i + 1), lambda t: None)
+            for i in range(2)]
+    fe.start()
+    for ev in done:
+        assert ev.wait(10.0)
+    recs = fe.flight.list()
+    assert len(recs) == 2
+    for r in recs:
+        assert r["bucket"] == 2 and r["slot"] in (0, 1)
+        lo, hi = r["iterations"]
+        assert 1 <= lo <= hi
+    (a_lo, a_hi), (b_lo, b_hi) = (r["iterations"] for r in recs)
+    assert max(a_lo, b_lo) <= min(a_hi, b_hi), \
+        "coalesced requests must share step iterations"
+    assert recs[0]["slot"] != recs[1]["slot"]
+    fe.drain()
+    # an n_new == 1 request finishes at prefill: it never shares a
+    # decode pass, so its iterations field is honestly null — and its
+    # admission/retirement still reaches the ring as a NON-stepped
+    # flush record (out of the occupancy tallies, never misattributed
+    # to a later decode iteration)
+    sb1 = faultinject.slot_backend(buckets=(2,), n_new=1)
+    fe1 = servd.ServeFrontend(None, slot_backend=sb1, drain_ms=2000.0)
+    fe1.start()
+    fe1.listen(0)
+    assert faultinject.serve_request(fe1.port, "100",
+                                     timeout=10.0) == "101"
+    assert fe1.flight.list()[0]["iterations"] is None
+    deadline = time.monotonic() + 5.0
+    while not len(fe1.batch_flight) and time.monotonic() < deadline:
+        time.sleep(0.01)
+    flush = fe1.batch_flight.list()[0]
+    assert flush["stepped"] == 0 and flush["step_ms"] is None
+    assert [a[0] for a in flush["admitted"]] == ["1"]
+    assert [r[0] for r in flush["retired"]] == ["1"]
+    assert fe1.batch_flight.iterations == 0    # no decode pass ran
+    fe1.drain()
+
+
+def test_batchz_endpoint_kv_account_and_decode_metrics(make_frontend):
+    """/batchz renders the scheduler ring + KV account (HTML and
+    ?json=1), /metrics carries the cxxnet_decode_* families
+    Prometheus-valid, and the /metrics?json=1 federation feed carries
+    the batch account — against the fake backend's deterministic
+    geometry (l_max x kv_row_bytes per slot)."""
+    reg = telemetry._Registry()
+    reg.enable()
+    sb = faultinject.slot_backend(buckets=(2, 4), n_new=30,
+                                  per_token_s=0.01, l_max=64,
+                                  kv_row_bytes=100)
+    orig = telemetry._REG
+    telemetry._REG = reg
+    srv = None
+    try:
+        fe = make_frontend(None, slot_backend=sb, batch_max=4,
+                           batch_window_ms=0.0, drain_ms=8000.0)
+        srv = statusd.StatusServer(0, host="127.0.0.1",
+                                   registry=reg).start()
+        srv.batch = fe
+        srv.flight = fe.flight
+        base = "http://127.0.0.1:%d" % srv.port
+        ts = [threading.Thread(
+            target=faultinject.serve_request,
+            args=(fe.port, "%d00" % (i + 1),), kwargs={"timeout": 30.0})
+            for i in range(2)]
+        for t in ts:
+            t.start()
+        deadline = time.monotonic() + 5.0
+        while fe.batch_flight.iterations < 3 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        snap = json.loads(urlopen(base + "/batchz?json=1",
+                                  timeout=5).read())
+        # the fake geometry: one warm 2-slot session, 64 rows x 100
+        # bytes per slot; both slots decoding
+        assert snap["buckets"]["2"]["warm"] == 1
+        assert snap["buckets"]["2"]["kv_bytes"] == 2 * 64 * 100
+        assert snap["kv_bytes"] == 2 * 64 * 100
+        assert snap["buckets"]["2"]["active"] == 2
+        assert snap["kv_live_pct"] is not None \
+            and 0 < snap["kv_live_pct"] <= 100
+        assert snap["flight"], "ring missing from /batchz?json=1"
+        page = urlopen(base + "/batchz", timeout=5).read().decode()
+        assert "decode batch scheduler" in page and "buckets" in page
+        m = urlopen(base + "/metrics", timeout=5).read().decode()
+        for line in m.splitlines():
+            if line and not line.startswith("#"):
+                assert statusd.PROM_LINE_RE.match(line), line
+        assert 'cxxnet_decode_kv_bytes{process="0",bucket="2"} %d' \
+            % (2 * 64 * 100) in m
+        assert "cxxnet_decode_kv_live_pct" in m
+        assert "cxxnet_decode_convoy" in m
+        assert "cxxnet_serve_queue_age_seconds_bucket" in m
+        feed = json.loads(urlopen(base + "/metrics?json=1",
+                                  timeout=5).read())
+        assert feed["batch"]["kv_bytes"] == 2 * 64 * 100
+        for t in ts:
+            t.join()
+        fe.drain()
+    finally:
+        if srv is not None:
+            srv.stop()
+        telemetry._REG = orig
+    # solo processes 404 (the endpoint names its wiring)
+    srv2 = statusd.StatusServer(0, host="127.0.0.1").start()
+    try:
+        urlopen("http://127.0.0.1:%d/batchz" % srv2.port, timeout=5)
+        raise AssertionError("/batchz without a frontend should 404")
+    except HTTPError as e:
+        assert e.code == 404
+    finally:
+        srv2.stop()
+
+
+def test_trace_request_merges_slot_gantt_lanes(make_frontend):
+    """/trace?request=<id> on a batching replica renders the request's
+    scheduler iterations as slot-Gantt lanes: one lane per decode
+    slot, bars naming each occupant — the batchmate's id appears in
+    the straggler's trace."""
+    sb = faultinject.slot_backend(buckets=(2,), n_new=3,
+                                  per_token_s=0.005, long_for={100},
+                                  long_n_new=12)
+    fe = make_frontend(None, slot_backend=sb, batch_max=2,
+                       batch_window_ms=40.0, drain_ms=8000.0)
+    resps = faultinject.serve_flood(fe.port, ["100", "200"],
+                                    timeout=20.0)
+    assert all(not r.startswith("ERR") for r in resps), resps
+    strag = next(r for r in fe.flight.list()
+                 if r["tokens_out"] == 12)
+    mate = next(r for r in fe.flight.list() if r["tokens_out"] == 3)
+    iters = fe.batch_flight.for_request(strag["id"])
+    assert iters and iters == sorted(iters, key=lambda i: i["iter"])
+    trace = telemetry.request_chrome_trace(strag, batch_iters=iters)
+    lanes = [t["args"]["name"] for t in trace["traceEvents"]
+             if t.get("name") == "thread_name"]
+    assert any(str(n).startswith("batch slot") for n in lanes), lanes
+    bars = [t for t in trace["traceEvents"]
+            if t.get("tid", 0) >= 10 and t["ph"] == "X"]
+    occupants = {b["args"]["occupant"] for b in bars}
+    assert strag["id"] in occupants and mate["id"] in occupants, \
+        (occupants, strag["id"], mate["id"])
+    # and each bar names the iteration range it covers
+    assert all(".." in b["args"]["iterations"] for b in bars)
+    fe.drain()
+
+
+def test_admin_stats_batch_buckets(make_frontend):
+    """ADMIN stats reports batch_buckets plus per-bucket warm/active
+    counts next to free_slots — the per-bucket load signal routerd
+    parses onto /fleetz. Solo frontends omit the whole family."""
+    sb = faultinject.slot_backend(buckets=(2, 4), n_new=20,
+                                  per_token_s=0.02)
+    fe = make_frontend(None, slot_backend=sb, batch_max=4,
+                       batch_window_ms=0.0, drain_ms=8000.0)
+
+    def stats(port):
+        line = faultinject.serve_request(port, "ADMIN stats",
+                                         timeout=5.0)
+        return dict(p.split("=") for p in line[3:].split())
+
+    st = stats(fe.port)
+    assert st["batch_buckets"] == "2"
+    assert st["bucket.2.warm"] == "0" and st["bucket.4.warm"] == "0"
+    ts = [threading.Thread(
+        target=faultinject.serve_request,
+        args=(fe.port, "%d00" % (i + 1),), kwargs={"timeout": 30.0})
+        for i in range(2)]
+    for t in ts:
+        t.start()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        st = stats(fe.port)
+        if st.get("bucket.2.active") == "2":
+            break
+        time.sleep(0.02)
+    assert st["bucket.2.warm"] == "1" and st["bucket.2.active"] == "2"
+    assert st["bucket.4.warm"] == "0" and st["bucket.4.active"] == "0"
+    for t in ts:
+        t.join()
+    fe.drain()
+    solo = make_frontend()
+    line = faultinject.serve_request(solo.port, "ADMIN stats",
+                                     timeout=5.0)
+    assert "batch_buckets" not in line and "bucket." not in line
+
+
+def test_convoy_chaos_straggler_pins_bucket(make_frontend):
+    """THE convoy acceptance: two stragglers pin a full 2-slot bucket
+    while short requests queue at zero free slots — EXACTLY ONE
+    decode_convoy latch transition fires (plus its clearing
+    transition), the serve.convoys episode counter reads 1, queue-age
+    observations land in serve.queue_age, and ZERO requests are lost
+    (every one served exactly). Runs under CXXNET_LOCKRANK=1 (the
+    suite's autouse fixture)."""
+    reg = telemetry._Registry()
+    reg.enable()
+    sb = faultinject.slot_backend(buckets=(2,), n_new=3,
+                                  per_token_s=0.004,
+                                  long_for={100, 200}, long_n_new=40)
+    orig = telemetry._REG
+    telemetry._REG = reg
+    try:
+        # queue BEFORE start() (the queue-before-start discipline):
+        # the stragglers are popped first DETERMINISTICALLY, pin the
+        # whole bucket, and the shorts wait behind them — a TCP flood
+        # would race arrival order, and shorts served before both
+        # stragglers board would leave the queue empty (no convoy)
+        fe = servd.ServeFrontend(None, slot_backend=sb, batch_max=2,
+                                 batch_window_ms=0.0, convoy_iters=8,
+                                 drain_ms=15000.0)
+        replies = {}
+
+        def mkreply(i):
+            def reply(text):
+                replies.setdefault(i, []).append(text)
+            return reply
+
+        lines = ["100", "200", "300", "400", "500"]
+        events = [fe.submit(line, mkreply(i))
+                  for i, line in enumerate(lines)]
+        fe.start()
+        for ev in events:
+            assert ev.wait(40.0), "request never answered"
+        for i, texts in sorted(replies.items()):
+            assert len(texts) == 1, (i, texts)
+        assert replies[0][0] == _expect_line(100, 40)
+        assert replies[1][0] == _expect_line(200, 40)
+        for i, first in enumerate((300, 400, 500), start=2):
+            assert replies[i][0] == _expect_line(first, 3), \
+                (i, replies[i])
+        fe.drain()
+    finally:
+        telemetry._REG = orig
+    evs = [e for e in reg.events() if e.get("ev") == "decode_convoy"]
+    latches = [e for e in evs if e.get("convoy") == 1]
+    clears = [e for e in evs if e.get("convoy") == 0]
+    assert len(latches) == 1, evs
+    assert latches[0]["bucket"] == 2
+    assert latches[0]["age_iters"] >= 8
+    assert latches[0]["queue_depth"] >= 1
+    assert latches[0]["pinned"] in [r["id"] for r in fe.flight.list()]
+    # the latch CLEARED when the stragglers retired and the queue
+    # drained into the freed slots — a log must not end latched
+    assert len(clears) == 1 and clears[0]["episode_iters"] >= 1
+    assert fe._convoy is False and fe._convoys == 1
+    snap = reg.metrics_snapshot()
+    assert snap["counters"]["serve.convoys"] == 1
+    # the queue waited at zero free slots: the age histogram saw it
+    assert snap["hists"]["serve.queue_age"]["count"] >= 1
+    # and the ring marked the convoy iterations
+    assert any(it["convoy"] for it in fe.batch_flight.list())
+    stats = fe.drain()
+    assert reconciles(stats)
+
+
+def test_batch_snapshot_kv_live_tracks_decode_progress(make_frontend):
+    """kv_live_pct measures REAL cache extent: it grows as a sequence
+    decodes (more live rows) and collapses to 0 when every slot
+    retires (the dead-slot waste paged KV will reclaim) — while
+    kv_bytes stays at the warm session's full allocation."""
+    sb = faultinject.slot_backend(buckets=(2,), n_new=30,
+                                  per_token_s=0.01, l_max=64,
+                                  kv_row_bytes=10)
+    fe = make_frontend(None, slot_backend=sb, batch_max=2,
+                       batch_window_ms=0.0, drain_ms=8000.0)
+    t = threading.Thread(target=faultinject.serve_request,
+                         args=(fe.port, "100 2 3"),
+                         kwargs={"timeout": 30.0})
+    t.start()
+    deadline = time.monotonic() + 5.0
+    first = None
+    while time.monotonic() < deadline:
+        snap = fe.batch_snapshot()
+        if snap["buckets"]["2"]["active"] == 1:
+            first = snap
+            break
+        time.sleep(0.005)
+    assert first is not None, "sequence never observed mid-decode"
+    t.join()
+    # drained: the warm allocation persists, the live share is gone
+    deadline = time.monotonic() + 5.0
+    while fe.batch_snapshot()["buckets"]["2"]["active"] \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)
+    after = fe.batch_snapshot()
+    assert after["kv_bytes"] == first["kv_bytes"] == 2 * 64 * 10
+    assert after["kv_live_bytes"] == 0 and after["kv_live_pct"] == 0.0
+    assert after["slot_waste_pct"] == 100.0
+    assert first["kv_live_bytes"] > 0
+    assert fe.decode_kv_bytes() == 2 * 64 * 10
+    # drain closes the warm sessions and ZEROES the account: a scrape
+    # during the shutdown window (or a later task reading the perf
+    # ledger's decode hook) must never see freed memory as allocated
+    fe.drain()
+    deadline = time.monotonic() + 5.0
+    while fe.decode_kv_bytes() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert fe.decode_kv_bytes() == 0
+    assert fe.batch_snapshot()["kv_bytes"] == 0
+
+
+def test_report_batch_scheduler_section(tmp_path, capsys):
+    """telemetry_report's batch-scheduler section: per-bucket weighted
+    occupancy reconstructed from the transition-only batch_iteration
+    events (composition holds constant across the gap to the next
+    event — gap-weighting is exact), waste vs the bucket size,
+    admission-latency percentiles, and the convoy episode account —
+    with the log-ends-latched unresolved flag."""
+    evs = [
+        {"ev": "meta", "pid": 1, "t0_wall": 0.0},
+        {"ev": "batch_iteration", "iter": 1, "bucket": 4,
+         "occupancy": 2, "occupancy_after": 2, "queue_depth": 0,
+         "step_ms": 3.0, "admitted": ["1", "2"], "retired": [],
+         "ts": 1.0},
+        {"ev": "batch_iteration", "iter": 5, "bucket": 4,
+         "occupancy": 4, "occupancy_after": 4, "queue_depth": 2,
+         "step_ms": 3.0, "admitted": ["3", "4"], "retired": [],
+         "ts": 2.0},
+        # iteration 9 stepped 3 sequences and retired one: occupancy
+        # (what decoded) and occupancy_after (what is left) differ —
+        # the post-retirement gap must weigh at the AFTER composition
+        {"ev": "batch_iteration", "iter": 9, "bucket": 4,
+         "occupancy": 3, "occupancy_after": 2, "queue_depth": 0,
+         "step_ms": 3.0, "admitted": [], "retired": ["1"], "ts": 3.0},
+        # a non-stepped flush (an n_new==1 admission that finished at
+        # prefill): journaled, but NOT a decode iteration
+        {"ev": "batch_iteration", "iter": 9, "bucket": 4,
+         "occupancy": 0, "occupancy_after": 0, "stepped": 0,
+         "queue_depth": 0, "step_ms": None, "admitted": ["9"],
+         "retired": ["9"], "ts": 3.5},
+        {"ev": "decode_convoy", "convoy": 1, "bucket": 4,
+         "pinned": "2", "slot": 1, "age_iters": 70,
+         "queue_depth": 3, "ts": 4.0},
+        {"ev": "serve_request_done", "req": "1", "outcome": "served",
+         "tokens": 4, "total_s": 0.1, "queue_wait_s": 0.02,
+         "dispatch_s": 0.001, "prefill_s": 0.01, "decode_s": 0.05,
+         "recompiles": 0, "ts": 5.0},
+    ]
+    log = tmp_path / "batch.jsonl"
+    log.write_text("".join(json.dumps(e) + "\n" for e in evs))
+    rc = telemetry_report.main([str(log), "--json"])
+    agg = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    bt = agg["batch"]
+    # exact reconstruction: iter 1 at occ 2 + iters 2..4 at after 2
+    # (8), iter 5 at 4 + 6..8 at 4 (16), iter 9 at 3 (3) -> 9
+    # iterations, 27 slot-iterations, mean 3.0; the flush event adds
+    # its admitted/retired counts but NO iterations
+    b4 = bt["buckets"]["4"]
+    assert b4["iterations"] == 9
+    assert b4["slot_iterations"] == 27
+    assert b4["mean_occupancy"] == 3.0
+    assert b4["waste_pct"] == 25.0
+    assert b4["admitted"] == 5 and b4["retired"] == 2
+    assert bt["admission_p99_ms"] == 20.0
+    assert bt["convoy_episodes"] == 1
+    # the log ENDS with the convoy latched: flagged unresolved
+    assert bt["convoy_unresolved"] == ["0"]
+    rc = telemetry_report.main([str(log)])
+    out = capsys.readouterr().out
+    assert rc == 0 and "== batch scheduler" in out
+    assert "convoy episodes: 1" in out and "UNRESOLVED" in out
+    assert "pinned=2" in out
 
 
 # ----------------------------------------------------------------------

@@ -560,5 +560,65 @@ def test_summarize_trace_request_format(tmp_path, capsys):
 
 
 # ----------------------------------------------------------------------
+def test_batchz_html_and_decode_metrics_render():
+    """batchz_html and the prometheus batch section are pure functions
+    of a batch snapshot: per-bucket rows, the KV/convoy account lines,
+    the iteration-ring table — and the cxxnet_decode_* families render
+    Prometheus-valid with bucket labels."""
+    snap = {
+        "buckets": {"2": {"warm": 1, "active": 1, "kv_bytes": 4096,
+                          "kv_live_bytes": 1024, "live_tokens": 16,
+                          "alloc_tokens": 128},
+                    "4": {"warm": 0, "active": 0, "kv_bytes": 0,
+                          "kv_live_bytes": 0, "live_tokens": 0,
+                          "alloc_tokens": 0}},
+        "capacity": 4, "free_slots": 1, "queue_depth": 3,
+        "kv_bytes": 4096, "kv_live_bytes": 1024, "kv_live_pct": 25.0,
+        "slot_waste_pct": 50.0, "convoy": 1, "convoys": 2,
+        "convoy_iters": 64, "iterations": 10, "slot_iterations": 17,
+        "mean_occupancy": 1.7, "flight_cap": 256,
+        "flight": [{"iter": 10, "t_wall": 1.0, "bucket": 2,
+                    "occupancy": 1, "step_ms": 2.5,
+                    "slots": [[0, "7", 9]], "admitted": [["7", 0]],
+                    "retired": [["6", 1]], "queue_depth": 3,
+                    "queue_age_s": 0.5, "kv_live_pct": 25.0,
+                    "age_skew": None, "convoy": 1}]}
+    page = statusd.batchz_html(snap)
+    assert "decode batch scheduler" in page
+    assert "CONVOY" in page and "2 episode(s)" in page
+    assert "0:7@9" in page                 # slot:occupant@age
+    assert "+7" in page and "-6" in page   # admissions/retirements
+    text = statusd.prometheus_metrics(
+        {"process": 0, "uptime_s": 1.0, "counters": {}, "gauges": {},
+         "hists": {}, "compiles": 0, "compile_s": 0.0}, batch=snap)
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            assert statusd.PROM_LINE_RE.match(line), line
+    assert 'cxxnet_decode_kv_bytes{process="0",bucket="2"} 4096' in text
+    assert 'cxxnet_decode_kv_live_bytes{process="0",bucket="2"} 1024' \
+        in text
+    assert "cxxnet_decode_kv_live_pct" in text
+    assert "cxxnet_decode_slot_waste_pct" in text
+    assert "cxxnet_decode_convoy" in text
+    assert "cxxnet_decode_convoys_total" in text
+
+
+def test_hbm_decode_kv_row_renders():
+    """The perf section charges the live decode KV cache against HBM:
+    cxxnet_hbm_decode_kv_bytes renders when the ledger's snapshot
+    carries it, and headroom reflects the subtraction upstream."""
+    text = statusd.prometheus_metrics(
+        {"process": 0, "uptime_s": 1.0, "counters": {}, "gauges": {},
+         "hists": {}, "compiles": 0, "compile_s": 0.0},
+        perf={"hbm": {"capacity_bytes": 100, "peak_bytes": 40,
+                      "decode_kv_bytes": 25, "headroom_bytes": 35},
+              "cards": []})
+    assert "cxxnet_hbm_decode_kv_bytes" in text
+    assert "cxxnet_hbm_headroom_bytes" in text
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            assert statusd.PROM_LINE_RE.match(line), line
+
+
 def test_statusd_selftest():
     assert statusd.selftest() == 0

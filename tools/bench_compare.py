@@ -106,11 +106,18 @@ def sub_lower_is_better(key, line):
     LOWER: it measures the weighted-fair policy actually shedding the
     flooding tenant — a drop means the flood is getting through to the
     victim. (``fleet_scale_latency_s`` needs no special case: the
-    ``latency`` rule already gates it as worse-when-higher.)"""
+    ``latency`` rule already gates it as worse-when-higher.)
+    Utilization sub-fields (``*_live_pct`` — kv_live_pct on the
+    throughput row: the live share of the decode KV cache) are worse
+    when LOWER too: a drop means more padding/dead-slot waste, the
+    regression the paged-KV before/after baseline (ROADMAP item 2)
+    watches. (``queue_age_p99_ms`` needs no special case: the
+    ``*_ms`` rule already gates it as worse-when-higher.)"""
     k = str(key)
     if k == "noisy_shed_rate":
         return False
-    if k.endswith("_rps") or "tokens_per_s" in k or "occupancy" in k:
+    if k.endswith("_rps") or "tokens_per_s" in k or "occupancy" in k \
+            or k.endswith("_live_pct"):
         return False
     if k.endswith("_ms") or "latency" in k or k.endswith("_rate"):
         return True

@@ -22,7 +22,12 @@ compile cost and by roofline gap), and a request-breakdown section
 (phase-attributed
 p50/p99 over the ``serve_request_done`` events — queue_wait / dispatch /
 prefill / decode / TTFT — plus the top-5 slowest requests with their
-phase split and the requests that paid recompiles).
+phase split and the requests that paid recompiles), and a
+batch-scheduler section (per-bucket occupancy/waste reconstructed from
+the transition-only ``batch_iteration`` events, admission-latency
+percentiles, the ``serve.queue_age`` distribution, and the
+``decode_convoy`` episode account — a log that ends with the convoy
+latched is flagged unresolved).
 ``--trace`` additionally exports a chrome://tracing / Perfetto JSON built
 from the span tree. ``--json`` emits the aggregate as one JSON object
 instead of the table (for scripting).
@@ -190,6 +195,8 @@ def aggregate(events):
     outlier_events = []
     slo_events = []
     program_cards = {}
+    batch_events = []
+    convoy_events = []
 
     def proc(ev):
         p = int(ev.get("p", 0))
@@ -256,6 +263,12 @@ def aggregate(events):
             proc(ev)
         elif kind == "slo_burn":
             slo_events.append(ev)
+            proc(ev)
+        elif kind == "batch_iteration":
+            batch_events.append(ev)
+            proc(ev)
+        elif kind == "decode_convoy":
+            convoy_events.append(ev)
             proc(ev)
         elif kind == "program_card":
             # the performance ledger's per-compiled-program card
@@ -428,6 +441,89 @@ def aggregate(events):
                          for p, ev in final.items()},
                "burning": sorted(p for p, ev in final.items()
                                  if int(ev.get("state", 0)))}
+    # batch scheduler: per-bucket occupancy/waste from the
+    # batch_iteration events (transition-only — one event per
+    # composition CHANGE). Reconstruction is exact: the event at
+    # iteration N stepped at ``occupancy`` and left ``occupancy_after``
+    # aboard (its own turn's retirements excluded), and NOTHING changes
+    # until the next event — so N itself weighs ``occupancy`` and
+    # N+1..next-event-1 weigh ``occupancy_after``. Non-stepped flush
+    # events (a turn whose admissions all finished at prefill) carry
+    # admissions/retirements but no decode pass, so they stay out of
+    # the occupancy weighting. Plus admission-latency percentiles from
+    # the requests' queue_wait, the queue-age distribution, and the
+    # decode_convoy episode account (a log that ENDS with the convoy
+    # latched is reported as unresolved, the breaker-open discipline)
+    batch = None
+    if batch_events or convoy_events:
+        by_bucket = {}
+        by_pe = {}
+        for ev in batch_events:
+            by_pe.setdefault(int(ev.get("p", 0)), []).append(ev)
+
+        def bucket_of(ev):
+            return by_bucket.setdefault(int(ev.get("bucket") or 0), {
+                "iterations": 0, "slot_iterations": 0,
+                "admitted": 0, "retired": 0, "errors": 0})
+
+        for p, evs in by_pe.items():
+            evs.sort(key=lambda e: int(e.get("iter", 0)))
+            for ev in evs:
+                d = bucket_of(ev)
+                d["admitted"] += len(ev.get("admitted") or [])
+                d["retired"] += len(ev.get("retired") or [])
+                if ev.get("error"):
+                    d["errors"] += 1
+            stepped = [e for e in evs if e.get("stepped", 1)]
+            for k, ev in enumerate(stepped):
+                gap = 1
+                if k + 1 < len(stepped) \
+                        and stepped[k + 1].get("bucket") \
+                        == ev.get("bucket"):
+                    gap = max(1, int(stepped[k + 1].get("iter", 0))
+                              - int(ev.get("iter", 0)))
+                d = bucket_of(ev)
+                occ = int(ev.get("occupancy", 0))
+                after = ev.get("occupancy_after")
+                after = occ if after is None else int(after)
+                d["iterations"] += gap
+                d["slot_iterations"] += occ + after * (gap - 1)
+        for b, d in by_bucket.items():
+            occ = (d["slot_iterations"] / float(d["iterations"])
+                   if d["iterations"] else None)
+            d["mean_occupancy"] = round(occ, 3) if occ is not None \
+                else None
+            d["waste_pct"] = round(100.0 * (1.0 - occ / b), 2) \
+                if occ is not None and b else None
+        qwaits = sorted(float(r["queue_wait_s"]) for r in requests
+                        if r.get("queue_wait_s") is not None)
+        convoy_final = {}
+        for ev in convoy_events:        # events arrive time-sorted
+            convoy_final[str(int(ev.get("p", 0)))] = \
+                int(ev.get("convoy", 0))
+        batch = {
+            "events": len(batch_events),
+            "buckets": {str(b): d for b, d
+                        in sorted(by_bucket.items())},
+            "admission_p50_ms":
+                round(1e3 * percentile(qwaits, 50), 4)
+                if qwaits else None,
+            "admission_p99_ms":
+                round(1e3 * percentile(qwaits, 99), 4)
+                if qwaits else None,
+            "convoy_episodes": sum(1 for ev in convoy_events
+                                   if int(ev.get("convoy", 0))),
+            "convoys": [
+                {"p": int(ev.get("p", 0)),
+                 "pinned": ev.get("pinned"),
+                 "bucket": ev.get("bucket"),
+                 "age_iters": ev.get("age_iters"),
+                 "queue_depth": ev.get("queue_depth")}
+                for ev in convoy_events
+                if int(ev.get("convoy", 0))],
+            "convoy_unresolved": sorted(
+                p for p, st in convoy_final.items() if st),
+        }
     # program ledger: one row per carded program (utils/perf.py),
     # joined against the measured latency histograms like the live
     # /programz table — MFU% and roofline efficiency from the log alone
@@ -479,7 +575,8 @@ def aggregate(events):
     out = {"spans": {}, "compiles": {}, "counters": counters,
            "gauges": gauges, "rounds": rounds, "health": health,
            "serving": serving, "requests": req_agg, "fleet": fleet,
-           "slo": slo, "programs": programs, "hists": {}}
+           "slo": slo, "programs": programs, "batch": batch,
+           "hists": {}}
     for name, h in sorted(merged_hists.items()):
         st = h.stats()
         st["buckets"] = h.to_dict()["buckets"]
@@ -656,6 +753,43 @@ def print_report(agg, top=15):
             print("recompile-attributed requests: %s"
                   % " ".join("req=%s(%d)" % kv for kv in
                              rq["recompile_requests"].items()))
+    bt = agg.get("batch")
+    if bt:
+        print("\n== batch scheduler (iteration-level decode "
+              "datapath) ==")
+        if bt["buckets"]:
+            print("%-8s %12s %10s %9s %9s %7s" %
+                  ("bucket", "iterations", "mean_occ", "waste%",
+                   "admitted", "errors"))
+            for b, d in sorted(bt["buckets"].items(),
+                               key=lambda kv: int(kv[0])):
+                print("%-8s %12d %10s %9s %9d %7d" %
+                      (b, d["iterations"],
+                       "n/a" if d["mean_occupancy"] is None
+                       else "%.2f" % d["mean_occupancy"],
+                       "n/a" if d["waste_pct"] is None
+                       else "%.1f" % d["waste_pct"],
+                       d["admitted"], d["errors"]))
+        if bt["admission_p99_ms"] is not None:
+            print("admission latency (queue_wait): p50=%s  p99=%s"
+                  % (_fmt_ms(bt["admission_p50_ms"]),
+                     _fmt_ms(bt["admission_p99_ms"])))
+        qa = agg.get("hists", {}).get("serve.queue_age")
+        if qa and qa.get("count"):
+            print("queue age at iteration: n=%d  p50=%s  p99=%s"
+                  % (qa["count"], _fmt_ms(qa["p50_ms"]),
+                     _fmt_ms(qa["p99_ms"])))
+        print("convoy episodes: %d%s"
+              % (bt["convoy_episodes"],
+                 "  UNRESOLVED on process(es) %s (log ends with a "
+                 "straggler pinning a full bucket)"
+                 % ",".join(bt["convoy_unresolved"])
+                 if bt["convoy_unresolved"] else ""))
+        for c in bt["convoys"]:
+            print("  p=%-3d pinned=%-10s bucket=%s age=%s iters  "
+                  "queue_depth=%s"
+                  % (c["p"], c.get("pinned"), c.get("bucket"),
+                     c.get("age_iters"), c.get("queue_depth")))
     fl = agg.get("fleet")
     if fl:
         print("\n== fleet requests (router <-> replica join on "
