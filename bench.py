@@ -529,6 +529,54 @@ def bench_serve_load():
             "requests": nsent[0]}
 
 
+class _PagedSlotBackend:
+    """The serve benches' slot backend over the PAGED decode KV cache
+    (doc/performance.md "Decode KV cache") — the same pool/session/
+    admission-gate hook surface learn_task's production adapter
+    exposes, minus the task indirection: sessions share one
+    ``Trainer.decode_kv_pool``, admission is block-budgeted through
+    ``kv_fresh_blocks``/``kv_free_blocks``, and ``kv_pool_account``
+    feeds the /batchz + prefix_hit_rate sub-fields. The dispatcher's
+    seq ordinal doubles as the sampling seed (greedy in the benches,
+    so it only names the stream)."""
+
+    def __init__(self, tr, buckets, n_new, block, pool_tokens,
+                 prefix_reuse=True):
+        self.tr = tr
+        self.buckets = list(buckets)
+        self.n_new = int(n_new)
+        self.block = int(block)
+        self.pool_tokens = int(pool_tokens)
+        self.prefix_reuse = bool(prefix_reuse)
+
+    def _pool(self):
+        return self.tr.decode_kv_pool(self.block,
+                                      pool_tokens=self.pool_tokens,
+                                      prefix_reuse=self.prefix_reuse)
+
+    def _live_pool(self):
+        p = getattr(self.tr, "_kv_pool", None)
+        return None if p is None or p.closed else p
+
+    def session(self, nslots):
+        return self.tr.decode_session(nslots, self.n_new,
+                                      kv_pool=self._pool())
+
+    def kv_pool_account(self):
+        p = self._live_pool()
+        return p.account() if p is not None else None
+
+    def kv_free_blocks(self):
+        p = self._live_pool()
+        return p.alloc.free_blocks if p is not None else None
+
+    def kv_fresh_blocks(self, toks):
+        p = self._live_pool()
+        if p is None:
+            return None
+        return p.alloc.fresh_need(len(toks), self.n_new, toks)
+
+
 def bench_serve_throughput():
     """Continuous-batching serving throughput: a closed-loop N-client
     flood through the BATCHING frontend (utils/servd.py slot_backend
@@ -539,7 +587,16 @@ def bench_serve_throughput():
     the latency tail (p50/p99), the measured mean batch occupancy
     (sequences per decode pass — the coalescing proof), and the
     roofline decode-step bound (tokens/s) from the performance ledger:
-    the ceiling the measured tokens/s reports against."""
+    the ceiling the measured tokens/s reports against.
+
+    The flood runs over the PAGED KV cache (serve_kv_block semantics;
+    doc/performance.md "Decode KV cache"): ``kv_live_pct`` is the
+    before/after headline — the dense PR 13 baseline read ~14% (every
+    slot owned an l_max row); paged, waste is bounded by block
+    granularity, so the mean should sit near 100 x live_rows /
+    (blocks_held x block). ``prefix_hit_rate`` (identical prompts
+    here, so it climbs fast after the first admission) and the
+    exhaustion-defer count ride along, null-safe on a dense run."""
     import socket
     import threading
     from cxxnet_tpu.models import transformer_lm_trainer
@@ -551,15 +608,9 @@ def bench_serve_throughput():
                                 dim=256, nhead=4, nlayer=2, dev="tpu",
                                 extra_cfg=BF16)
 
-    class _SlotBackend:
-        buckets = [bucket]
-
-        def session(self, nslots):
-            # the dispatcher's seq ordinal doubles as the sampling seed
-            # (greedy here, so it only names the stream)
-            return tr.decode_session(nslots, n_new)
-
-    fe = servd.ServeFrontend(None, slot_backend=_SlotBackend(),
+    backend = _PagedSlotBackend(tr, [bucket], n_new, block=16,
+                                pool_tokens=bucket * L)
+    fe = servd.ServeFrontend(None, slot_backend=backend,
                              queue_size=64, batch_max=bucket,
                              batch_window_ms=5.0,
                              # size the iteration ring for the WHOLE
@@ -622,6 +673,11 @@ def bench_serve_throughput():
                if r.get("kv_live_pct") is not None]
     qages = sorted(r["queue_age_s"] for r in win
                    if r.get("queue_age_s") is not None)
+    # the paged-pool account (null-safe: None end to end on a dense
+    # backend) — prefix_hit_rate is token-weighted, recomputed by the
+    # snapshot from the allocator's lifetime tallies
+    snap = fe.batch_snapshot() or {}
+    pool = snap.get("pool") or {}
     fe.drain()
     lats.sort()
     total = max(1, nsent[0])
@@ -639,10 +695,118 @@ def bench_serve_throughput():
             perf.decode_bound_tokens_per_s(n_new),
             "kv_live_pct": round(sum(kv_pcts) / len(kv_pcts), 2)
             if kv_pcts else None,
+            "prefix_hit_rate": pool.get("prefix_hit_rate"),
+            "kv_blocks_total": pool.get("blocks_total"),
+            "kv_defers": pool.get("alloc_failures"),
             "queue_age_p99_ms": round(1e3 * percentile(qages, 99), 3)
             if qages else None,
             "error_rate": round(nerr[0] / float(total), 4),
             "requests": nsent[0], "bucket": bucket}
+
+
+def bench_serve_prefix_reuse():
+    """Shared-system-prompt serving flood over the paged KV cache: N
+    closed-loop clients send prompts that share one long system
+    prefix (full blocks) and differ only in a short user tail — the
+    chatbot/agent fleet shape. The shared blocks prefill ONCE
+    (refcounted in the pool's prefix trie); every later admission
+    gathers them and computes only its tail, so the prefill phase
+    shrinks by the hit rate. Headline is rps (HIGHER better);
+    ``prefix_hit_rate`` should approach 100 x shared/plen once the
+    flood is warm, and ``ttft_p99_ms`` carries the time-to-first-token
+    win the reuse buys. CPU-measurable (tiny model, greedy), null-safe
+    (a dense backend would simply report null prefix fields)."""
+    import socket
+    import threading
+    from cxxnet_tpu.models import transformer_lm_trainer
+    from cxxnet_tpu.utils import servd
+    from cxxnet_tpu.utils.telemetry import percentile
+    vocab, L, n_new = 8192, 256, 8
+    block, shared, tail = 16, 48, 8        # plen 56: 3 shared blocks
+    bucket = 4
+    tr = transformer_lm_trainer(vocab=vocab, seq=L, batch_size=8,
+                                dim=256, nhead=4, nlayer=2, dev="tpu",
+                                extra_cfg=BF16)
+    backend = _PagedSlotBackend(tr, [bucket], n_new, block=block,
+                                pool_tokens=bucket * L)
+    fe = servd.ServeFrontend(None, slot_backend=backend,
+                             queue_size=64, batch_max=bucket,
+                             batch_window_ms=5.0,
+                             batch_flight_cap=4096)
+    fe.start()
+    port = fe.listen(0)
+    rs = np.random.RandomState(7)
+    system = rs.randint(0, vocab, shared).tolist()
+
+    def prompt_line(i):
+        # one shared system prefix, a per-request user tail: request i
+        # reuses blocks request 0 loaded (the prefill-once contract)
+        tl = ((np.arange(tail) * 31 + i * 7) % vocab).tolist()
+        return " ".join(map(str, system + tl))
+
+    # warm: the first admission prefills the WHOLE prompt and compiles
+    # the (plen, 0) program; the second compiles the (plen, shared)
+    # suffix program — both outside the measured window
+    from cxxnet_tpu.utils.servd import _ask
+    _ask(port, prompt_line(10001), timeout=600.0)
+    _ask(port, prompt_line(10002), timeout=600.0)
+    nclients, per = 6, 4
+    lats, ttfts, nerr, nsent = [], [], [0], [0]
+    lock = threading.Lock()
+
+    def client(ci):
+        with socket.create_connection(("127.0.0.1", port),
+                                      timeout=600) as c:
+            f = c.makefile("r")
+            for j in range(per):
+                t0 = time.perf_counter()
+                c.sendall((prompt_line(ci * per + j) + "\n").encode())
+                resp = f.readline()
+                dt = time.perf_counter() - t0
+                with lock:
+                    nsent[0] += 1
+                    if not resp or resp.startswith("ERR"):
+                        nerr[0] += 1
+                    else:
+                        lats.append(dt)
+                if not resp:
+                    break
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(nclients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    # TTFT from the request flight ring: the prefill phase is where
+    # prefix reuse pays (only the tail is computed)
+    ttfts = [1e3 * r["ttft_s"] for r in fe.flight.list()
+             if r.get("ttft_s") is not None]
+    snap = fe.batch_snapshot() or {}
+    pool = snap.get("pool") or {}
+    fe.drain()
+    lats.sort()
+    total = max(1, nsent[0])
+    return {"metric": "serve_prefix_reuse_rps",
+            "value": round(len(lats) / wall, 3) if lats and wall > 0
+            else None,
+            "unit": "req/s", "vs_baseline": None,
+            "p50_ms": round(1e3 * percentile(lats, 50), 3) if lats
+            else None,
+            "p99_ms": round(1e3 * percentile(lats, 99), 3) if lats
+            else None,
+            "ttft_p99_ms": round(percentile(sorted(ttfts), 99), 3)
+            if ttfts else None,
+            "prefix_hit_rate": pool.get("prefix_hit_rate"),
+            "prefix_hits": pool.get("prefix_hits"),
+            "cow_copies": pool.get("cow_copies"),
+            "kv_live_pct": snap.get("kv_live_pct"),
+            "kv_defers": pool.get("alloc_failures"),
+            "error_rate": round(nerr[0] / float(total), 4),
+            "requests": nsent[0], "bucket": bucket,
+            "shared_tokens": shared, "prompt_tokens": shared + tail}
 
 
 def bench_serve_fleet():
@@ -1217,7 +1381,8 @@ def _bench_main():
                    bench_lm_decode_b1, bench_lm_decode_long,
                    bench_lm_decode_chunked, bench_lm_decode_long_chunked,
                    bench_lm_decode_b1_chunked, bench_serve_load,
-                   bench_serve_throughput, bench_serve_fleet,
+                   bench_serve_throughput, bench_serve_prefix_reuse,
+                   bench_serve_fleet,
                    bench_serve_tenant_isolation):
             print(json.dumps(_attach_telemetry(fn())), flush=True)
     if len(sys.argv) > 1 and sys.argv[1] in ("all", "pipeline"):

@@ -1280,6 +1280,22 @@ class AttentionLayer(Layer):
                     out = attention_reference(
                         q, k, v, causal=True, scale=dh ** -0.5,
                         window=self.attn_window)
+            elif isinstance(pos, int) and pos > 0:
+                # static-offset SUFFIX prefill (paged shared-prefix
+                # admission, doc/performance.md "Decode KV cache"):
+                # positions [pos, pos + L) computed against the
+                # statically sliced live cache [0, pos + L). The
+                # softmax width equals the prompt length — the same
+                # reduction width the full chunk prefill above uses —
+                # so a prefix-reused admission's logits stay bitwise
+                # identical to prefilling the whole prompt (the
+                # paged-vs-dense token-exactness pin). Only paged
+                # suffix prefills pass a static nonzero offset; every
+                # per-token decode loop traces ``pos``.
+                out = attention_reference(
+                    q, ck[:, :, :pos + L, :], cv[:, :, :pos + L, :],
+                    causal=True, scale=dh ** -0.5,
+                    window=self.attn_window, q_offset=pos)
             elif self.decode_chunk > 0 and L == 1 \
                     and ck.shape[2] % self.decode_chunk == 0:
                 # flash-decode: online-softmax while-loop over live cache

@@ -73,9 +73,19 @@ class _SlotBackendAdapter:
     next session (the dispatcher closes every session before a
     reload — slot caches hold the old model's K/V)."""
 
-    def __init__(self, task, buckets):
+    def __init__(self, task, buckets, kv_block: int = 0,
+                 kv_pool_frac: float = 0.5, prefix_reuse: bool = True):
         self.task = task
         self.buckets = list(buckets)
+        # serve_kv_block > 0 arms the PAGED decode KV cache
+        # (doc/performance.md "Decode KV cache"): every session this
+        # adapter opens shares one trainer-wide block pool, sized at
+        # dense-equivalent capacity (largest bucket x l_max rows) and
+        # clamped under serve_kv_pool_frac of the perf ledger's live
+        # HBM headroom when the ledger is on
+        self.kv_block = int(kv_block)
+        self.kv_pool_frac = float(kv_pool_frac)
+        self.prefix_reuse = bool(prefix_reuse)
 
     def admits(self, toks):
         t = self.task
@@ -85,12 +95,56 @@ class _SlotBackendAdapter:
                     "sequence length %d" % (len(toks), t.gen_new, l_max))
         return None
 
+    def _pool(self):
+        """The shared paged pool (created on first use, re-created
+        across a hot reload by ``decode_kv_pool``'s params-generation
+        key). None in dense mode."""
+        if self.kv_block <= 0:
+            return None
+        t = self.task
+        l_max = t.net_trainer.net_cfg.param.input_shape[2]
+        cap = perf.ledger().decode_pool_cap_bytes(self.kv_pool_frac) \
+            if perf.enabled() else None
+        return t.net_trainer.decode_kv_pool(
+            self.kv_block,
+            pool_tokens=max(self.buckets) * l_max,
+            prefix_reuse=self.prefix_reuse, bytes_cap=cap)
+
+    def _live_pool(self):
+        """The pool if it EXISTS and is open — the account/gate hooks
+        must never create one (they run per publish, even idle)."""
+        if self.kv_block <= 0:
+            return None
+        p = getattr(self.task.net_trainer, "_kv_pool", None)
+        return None if p is None or p.closed else p
+
+    def kv_pool_account(self):
+        """servd's block-exact pool account hook (None in dense mode
+        or before the first paged session)."""
+        p = self._live_pool()
+        return p.account() if p is not None else None
+
+    def kv_free_blocks(self):
+        """Free-list level for servd's gather budget (None disarms)."""
+        p = self._live_pool()
+        return p.alloc.free_blocks if p is not None else None
+
+    def kv_fresh_blocks(self, toks):
+        """Blocks an admission would pull off the free list right now
+        (prefix-credited) — servd pops a queued request only when this
+        fits the budget, so pool exhaustion is a deterministic FIFO
+        queue-wait, never a device OOM."""
+        p = self._live_pool()
+        if p is None:
+            return None
+        return p.alloc.fresh_need(len(toks), self.task.gen_new, toks)
+
     def session(self, bucket):
         t = self.task
         return _SeededSession(
             t.net_trainer.decode_session(
                 bucket, t.gen_new, temperature=t.gen_temperature,
-                top_k=t.gen_topk),
+                top_k=t.gen_topk, kv_pool=self._pool()),
             t.gen_seed)
 
 
@@ -223,6 +277,20 @@ class LearnTask:
         self.serve_buckets = ""
         self.serve_batch_max = 8
         self.serve_batch_window_ms = 2.0
+        # serve_kv_block > 0 arms the PAGED decode KV cache
+        # (doc/performance.md "Decode KV cache"): the batched sessions'
+        # dense slot-major caches become fixed-size KV blocks of this
+        # many tokens on a shared free-list pool — per-slot block
+        # tables, shared-prefix prefill-once reuse (serve_prefix_reuse),
+        # mid-decode block reclaim at retirement, block-budgeted
+        # admission (exhaustion = deterministic queue-wait). Must
+        # divide the net's sequence length. 0 (default) = dense.
+        self.serve_kv_block = 0
+        # fraction of the perf ledger's live HBM headroom the pool may
+        # claim (bytes_cap on Trainer.decode_kv_pool; ledger off = no
+        # cap, the pool sizes at dense-equivalent capacity)
+        self.serve_kv_pool_frac = 0.5
+        self.serve_prefix_reuse = 1
         # decode-datapath observability (doc/observability.md "Decode
         # datapath"): the iteration-level scheduler flight ring behind
         # statusd /batchz (one record per decode iteration: slots,
@@ -525,6 +593,12 @@ class LearnTask:
             self.serve_batch_max = int(val)
         if name == "serve_batch_window_ms":
             self.serve_batch_window_ms = float(val)
+        if name == "serve_kv_block":
+            self.serve_kv_block = int(val)
+        if name == "serve_kv_pool_frac":
+            self.serve_kv_pool_frac = float(val)
+        if name == "serve_prefix_reuse":
+            self.serve_prefix_reuse = int(val)
         if name == "serve_batch_flight_cap":
             self.serve_batch_flight_cap = int(val)
         if name == "serve_convoy_iters":
@@ -1458,6 +1532,15 @@ class LearnTask:
                     "keeping the current model\n" % self.name_model_dir)
                 return False
             served_sig[0] = sig
+            # the old model's paged KV pool holds old-weight K/V and
+            # the reload path has already closed every session on it:
+            # release NOW so the HBM account reads 0 until the first
+            # post-reload admission rebuilds the pool (the account must
+            # never report freed memory as allocated)
+            try:
+                self.net_trainer.release_kv_pool()
+            except Exception:
+                pass
             if not self.silent:
                 # stderr: stdout is the response stream (one line per
                 # request — a banner there desyncs positional clients)
@@ -1504,12 +1587,17 @@ class LearnTask:
         bucket_list = [int(x) for x in
                        str(self.serve_buckets).replace(",", " ").split()]
         if bucket_list:
-            slot_backend = _SlotBackendAdapter(self, bucket_list)
+            slot_backend = _SlotBackendAdapter(
+                self, bucket_list, kv_block=self.serve_kv_block,
+                kv_pool_frac=self.serve_kv_pool_frac,
+                prefix_reuse=bool(self.serve_prefix_reuse))
             if not self.silent:
                 print("serve: continuous batching on (buckets %s, "
-                      "batch_max %d, window %.1fms)"
+                      "batch_max %d, window %.1fms%s)"
                       % (sorted(set(bucket_list)), self.serve_batch_max,
-                         self.serve_batch_window_ms),
+                         self.serve_batch_window_ms,
+                         ", paged kv block %d" % self.serve_kv_block
+                         if self.serve_kv_block > 0 else ""),
                       file=sys.stderr, flush=True)
         fe = servd.ServeFrontend(
             backend, queue_size=self.serve_queue,

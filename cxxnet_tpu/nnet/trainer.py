@@ -34,6 +34,9 @@ from ..updater import create_updater
 from ..utils import serializer
 from ..utils import telemetry
 from ..utils.metric import MetricSet
+# re-exported: the paged DecodeSession raises it; the jax-free servd
+# catches it by type from utils.kvblocks directly
+from ..utils.kvblocks import KVPoolExhausted  # noqa: F401
 from .. import parallel
 from .config import NetConfig
 from .net import NeuralNet
@@ -1813,7 +1816,9 @@ class Trainer:
 
     def decode_session(self, nslots: int, n_new: int,
                        temperature: float = 0.0,
-                       top_k: int = 0) -> "DecodeSession":
+                       top_k: int = 0,
+                       kv_pool: "Optional[KVBlockPool]" = None
+                       ) -> "DecodeSession":
         """A batched decode session over ``nslots`` independent KV-cache
         slots — the iteration-granularity serving datapath
         (doc/serving.md "Continuous batching"). ``prefill`` admits one
@@ -1824,9 +1829,50 @@ class Trainer:
         same request (per-slot RNG keyed on the request's own seed).
         Programs are cached per (bucket, sampling) signature in the
         trainer's jit cache: a request joining a warm bucket never
-        recompiles (the arXiv:1802.04799 latency cliff)."""
+        recompiles (the arXiv:1802.04799 latency cliff).
+
+        ``kv_pool`` (``decode_kv_pool``) swaps the session's dense
+        slot-major cache for the PAGED layout (doc/performance.md
+        "Decode KV cache"): per-slot block tables over a shared
+        free-list block pool, shared-prefix block reuse, token-exact
+        vs the dense session."""
         return DecodeSession(self, nslots, n_new,
-                             temperature=temperature, top_k=top_k)
+                             temperature=temperature, top_k=top_k,
+                             kv_pool=kv_pool)
+
+    def decode_kv_pool(self, block: int, pool_tokens: int = 0,
+                       prefix_reuse: bool = True,
+                       bytes_cap: Optional[int] = None) -> "KVBlockPool":
+        """The process-wide paged decode KV pool (created on first use,
+        shared by every paged ``decode_session`` whatever its bucket —
+        sharing across buckets is what makes a shared system prompt
+        prefill ONCE fleet-of-buckets-wide). Keyed on the params
+        generation: a model reload (``params`` reassigned) or a
+        different block size drops the old pool (its blocks hold
+        old-weight K/V) and builds a fresh one."""
+        check(self.params is not None,
+              "decode_kv_pool: init_model/load_model first")
+        p = getattr(self, "_kv_pool", None)
+        if p is not None and (p.closed or p.bs != int(block)
+                              or p._params_key is not self.params):
+            self.release_kv_pool()
+            p = None
+        if p is None:
+            p = KVBlockPool(self, int(block), pool_tokens=pool_tokens,
+                            prefix_reuse=prefix_reuse,
+                            bytes_cap=bytes_cap)
+            self._kv_pool = p
+        return p
+
+    def release_kv_pool(self) -> None:
+        """Drop the paged pool's device arrays (worker drain / model
+        reload): the KV account must read 0 the moment the serving
+        datapath lets go — freed HBM reported as allocated is the
+        account lying. Idempotent."""
+        p = getattr(self, "_kv_pool", None)
+        if p is not None:
+            p.release()
+        self._kv_pool = None
 
     def export_decode(self, batch_size: int, prompt_len: int,
                       compat: bool = True):
@@ -2025,6 +2071,172 @@ class Trainer:
         return self.net.get_weight(self.canonical_params(), layer_name, tag)
 
 
+def _kv_gather_views(pools, tabs, T: int, bs: int):
+    """Materialize contiguous dense cache views from block pools via
+    block tables — the paged layout's read side. ``tabs`` is ``(T,)``
+    (one b=1 row) or ``(S, T)`` (the slot-major batch); a pool is
+    ``(NB, 1, nkv, bs, dh)`` per cache key and the view restores the
+    exact dense shape ``(..., 1, nkv, T*bs, dh)``, so the per-row
+    decode math downstream is BITWISE the dense session's (transpose/
+    reshape are pure layout; garbage gathered through scratch-block
+    entries only ever covers causally masked positions, whose softmax
+    weight is exactly zero)."""
+    out = {}
+    for k, p in pools.items():
+        g = p[tabs]
+        if tabs.ndim == 1:
+            # (T, 1, nkv, bs, dh) -> (1, nkv, T*bs, dh)
+            out[k] = g.transpose(1, 2, 0, 3, 4).reshape(
+                g.shape[1], g.shape[2], T * bs, g.shape[4])
+        else:
+            # (S, T, 1, nkv, bs, dh) -> (S, 1, nkv, T*bs, dh)
+            out[k] = g.transpose(0, 2, 3, 1, 4, 5).reshape(
+                g.shape[0], g.shape[2], g.shape[3], T * bs, g.shape[5])
+    return out
+
+
+def _session_row_step(net1, last, pick):
+    """ONE decode slot's step — the per-row math both the dense and the
+    paged session step programs vmap over slots. A single definition so
+    the two layouts cannot drift: the paged step runs literally this on
+    gathered views."""
+
+    def one(params, toks_r, caches_r, key_r, pos_r):
+        # EXACTLY the solo decode step at b=1, with this row's
+        # own position/cache/key
+        tok = jax.lax.dynamic_slice(toks_r, (pos_r,), (1,))
+        data = tok.reshape(1, 1, 1, 1).astype(jnp.float32)
+        values, _ = net1.forward(params, data, train=False,
+                                 decode_pos=pos_r,
+                                 kv_cache=caches_r)
+        caches2 = dict(net1._last_cache_updates)
+        nxt = pick(values[last].reshape(1, -1),
+                   jax.random.fold_in(key_r, pos_r)
+                   )[0].astype(toks_r.dtype)
+        toks2 = jax.lax.dynamic_update_slice(
+            toks_r, nxt[None], (pos_r + 1,))
+        return toks2, caches2, nxt
+
+    return one
+
+
+class KVBlockPool:
+    """Device half of the paged decode KV cache (doc/performance.md
+    "Decode KV cache"): one fixed pool of KV blocks per attention-cache
+    key — ``(NB, 1, nkv, block, dh)``, block id 0 reserved as the
+    scratch block — plus the host-side free-list allocator
+    (utils/kvblocks.BlockAllocator) that owns every placement decision.
+    Shared by every paged ``DecodeSession`` of this trainer: the pool
+    (not the session) is the HBM footprint, and ``account()`` is
+    block-exact — ``pool_bytes`` IS the arrays' nbytes, at all times.
+
+    Sizing: ``pool_tokens`` cache rows (rounded up to blocks, floored
+    at one max-length sequence), clamped under ``bytes_cap`` when the
+    perf ledger's HBM account provides one
+    (``perf.decode_pool_cap_bytes``: capacity − peak program
+    footprint). Exhaustion is the ALLOCATOR's verdict — admission
+    defers; the device never OOMs allocating a cache row.
+
+    Lifecycle: created lazily by ``Trainer.decode_kv_pool``, keyed on
+    the params generation; ``release()`` (worker drain, model reload)
+    drops the arrays and the account reads 0. A device fault inside a
+    program that DONATED the pools latches ``closed`` — integrity
+    unknown, every session on it refuses, the next session creation
+    rebuilds."""
+
+    def __init__(self, trainer: Trainer, block: int,
+                 pool_tokens: int = 0, prefix_reuse: bool = True,
+                 bytes_cap: Optional[int] = None):
+        from ..utils import kvblocks
+        check(block >= 1, "decode_kv_pool: block must be >= 1")
+        self.tr = trainer
+        self.bs = int(block)
+        self.l_max = trainer.net_cfg.param.input_shape[2]
+        check(self.l_max % self.bs == 0,
+              "decode_kv_pool: block %d must divide the net's sequence "
+              "length %d" % (self.bs, self.l_max))
+        self.T = self.l_max // self.bs
+        self._params_key = trainer.params
+        net1 = trainer._seq_net(1, 1)
+        (_, self.cache_keys, shapes1, self.cache_dtype) = \
+            trainer._decode_cache_specs(net1, 1, self.l_max)
+        self._block_shapes = {
+            k: (sh[0], sh[1], self.bs, sh[3])
+            for k, sh in zip(self.cache_keys, shapes1)}
+        itemsize = jnp.dtype(self.cache_dtype).itemsize
+        self.block_bytes = sum(
+            int(np.prod(sh)) * itemsize
+            for sh in self._block_shapes.values())
+        usable = max(-(-int(pool_tokens) // self.bs)
+                     if pool_tokens else self.T, self.T)
+        if bytes_cap:
+            # the HBM-account clamp: whole pool (scratch included)
+            # under the budget, still floored at one full sequence
+            usable = max(self.T,
+                         min(usable,
+                             int(bytes_cap) // self.block_bytes - 1))
+        nb = usable + 1                       # + the scratch block 0
+        self.pools = {k: jnp.zeros((nb,) + self._block_shapes[k],
+                                   self.cache_dtype)
+                      for k in self.cache_keys}
+        self.alloc = kvblocks.BlockAllocator(
+            nb, self.bs, prefix_reuse=prefix_reuse)
+        self.closed = False
+        import weakref
+        self._sessions = weakref.WeakSet()
+
+    @property
+    def nbytes(self) -> int:
+        """The pool's REAL device footprint (array metadata, no
+        transfer) — the value ``cxxnet_decode_kv_bytes`` /
+        ``cxxnet_hbm_decode_kv_bytes`` are pinned equal to."""
+        if self.closed or self.pools is None:
+            return 0
+        return sum(int(getattr(a, "nbytes", 0))
+                   for a in self.pools.values())
+
+    def fits(self, plen: int, n_new: int) -> bool:
+        """Whether the sequence can EVER hold its blocks — False is a
+        deterministic request defect (the admits() gate), never a
+        queue-wait."""
+        return self.alloc.fits(plen, n_new)
+
+    def reservable(self, plen: int, n_new: int, toks=None) -> bool:
+        return not self.closed \
+            and self.alloc.reservable(plen, n_new, toks)
+
+    def account(self) -> Optional[dict]:
+        """Block-exact pool account (host metadata arithmetic — safe
+        outside any lock): allocator tallies + ``pool_bytes`` (the
+        real nbytes) + live tokens summed over the open sessions.
+        ``kv_live_bytes`` counts LOGICAL live rows — shared-prefix
+        rows count once per holder, so heavy sharing can push the
+        live share past what the physical blocks hold (that is the
+        reuse win, not an accounting error). None once released."""
+        if self.closed:
+            return None
+        live = 0
+        for s in list(self._sessions):
+            if getattr(s, "closed", False):
+                continue
+            for i in range(s.nslots):
+                if s._active[i]:
+                    live += s._plen[i] + (s.n_new - 1 - s._remaining[i])
+        a = self.alloc.account()
+        a.update(pool_bytes=self.nbytes,
+                 block_bytes=self.block_bytes,
+                 live_tokens=live,
+                 kv_live_bytes=live * (self.block_bytes // self.bs))
+        return a
+
+    def release(self) -> None:
+        """Drop the device arrays; every open session on this pool is
+        implicitly dead (their _check_live latches on ``closed``).
+        Idempotent."""
+        self.closed = True
+        self.pools = None
+
+
 class DecodeSession:
     """Iteration-granularity batched decode over a fixed slot batch.
 
@@ -2065,7 +2277,8 @@ class DecodeSession:
     """
 
     def __init__(self, trainer: Trainer, nslots: int, n_new: int,
-                 temperature: float = 0.0, top_k: int = 0):
+                 temperature: float = 0.0, top_k: int = 0,
+                 kv_pool: Optional[KVBlockPool] = None):
         check(nslots >= 1, "decode_session: nslots must be >= 1")
         check(n_new >= 1, "decode_session: n_new must be >= 1")
         self.tr = trainer
@@ -2081,14 +2294,37 @@ class DecodeSession:
             trainer._decode_cache_specs(self._net1, 1, self.l_max)
         self._last = self._net1.cfg.param.num_nodes - 1
         self._pick = _sample_pick(self.temperature, self.top_k)
-        # slot-major device state. Caches keep the b=1 dim — (nslots, 1,
-        # nkvhead, l_max, dh) — so the vmapped per-row forward sees
-        # exactly the solo (1, nkvhead, l_max, dh) cache shape.
+        # paged layout (doc/performance.md "Decode KV cache"): the K/V
+        # rows live in the trainer-wide block pool; the session owns
+        # only per-slot BLOCK TABLES (device (nslots, T) int32 — the
+        # step program gathers its dense views through them) plus the
+        # host allocation mirror. Dense layout: slot-major cache
+        # arrays, exactly as before.
+        self.pool = kv_pool
+        self._caches = None
+        self._tables_dev = None
+        self._slot_blocks: List[Optional[List[int]]] = []
+        if kv_pool is not None:
+            check(kv_pool.tr is trainer
+                  and kv_pool._params_key is trainer.params
+                  and not kv_pool.closed,
+                  "decode_session: the kv pool belongs to another "
+                  "trainer/params generation (model reload?) — open a "
+                  "fresh pool via decode_kv_pool")
+            self._tables_dev = jnp.zeros((self.nslots, kv_pool.T),
+                                         jnp.int32)
+            self._slot_blocks = [None] * self.nslots
+            kv_pool._sessions.add(self)
         self._toks = jnp.zeros((self.nslots, self.l_max), jnp.int32)
-        self._caches = {k: jnp.zeros((self.nslots,) + sh,
-                                     self._cache_dtype)
-                        for k, sh in zip(self._cache_keys,
-                                         self._cache_shapes1)}
+        if kv_pool is None:
+            # slot-major device state. Caches keep the b=1 dim —
+            # (nslots, 1, nkvhead, l_max, dh) — so the vmapped per-row
+            # forward sees exactly the solo (1, nkvhead, l_max, dh)
+            # cache shape.
+            self._caches = {k: jnp.zeros((self.nslots,) + sh,
+                                         self._cache_dtype)
+                            for k, sh in zip(self._cache_keys,
+                                             self._cache_shapes1)}
         # per-slot RNG keys and positions live ON DEVICE: the admit
         # program seeds a slot's row, the step program returns pos+1 —
         # zero per-iteration H2D on the serving hot path (a retired
@@ -2126,17 +2362,36 @@ class DecodeSession:
         plus dead slots — is exactly what a paged KV cache (ROADMAP
         item 2) would reclaim; servd publishes it as
         ``cxxnet_decode_kv_live_pct``. A closed session accounts 0 (its
-        arrays are released)."""
-        if self.closed or self._caches is None:
+        arrays are released).
+
+        PAGED sessions account blocks HELD, not arrays owned: the pool
+        is the allocation (``KVBlockPool.account`` carries the
+        block-exact ``pool_bytes``) and this session's ``kv_bytes`` is
+        its block tables' claim — ``blocks_held * block_bytes``, where
+        a prefix block shared with another session counts per holder
+        (so the per-bucket rows sum to >= the physically used bytes
+        under sharing; the headline/total always comes from the
+        pool)."""
+        if self.closed or (self._caches is None and self.pool is None):
             return {"bucket": self.nslots, "l_max": self.l_max,
                     "active": 0, "kv_bytes": 0, "kv_live_bytes": 0,
                     "live_tokens": 0, "alloc_tokens": 0}
-        kv_bytes = sum(int(getattr(a, "nbytes", 0))
-                       for a in self._caches.values())
-        alloc = self.nslots * self.l_max
         live = sum(self._plen[s]
                    + (self.n_new - 1 - self._remaining[s])
                    for s in range(self.nslots) if self._active[s])
+        if self.pool is not None:
+            held = sum(len(b) for b in self._slot_blocks if b)
+            bb = 0 if self.pool.closed else self.pool.block_bytes
+            alloc = held * self.pool.bs
+            return {"bucket": self.nslots, "l_max": self.l_max,
+                    "active": self.active_count,
+                    "kv_bytes": held * bb,
+                    "kv_live_bytes": live * (bb // self.pool.bs),
+                    "live_tokens": live, "alloc_tokens": alloc,
+                    "paged": 1, "blocks_held": held}
+        kv_bytes = sum(int(getattr(a, "nbytes", 0))
+                       for a in self._caches.values())
+        alloc = self.nslots * self.l_max
         return {"bucket": self.nslots, "l_max": self.l_max,
                 "active": self.active_count, "kv_bytes": kv_bytes,
                 "kv_live_bytes": int(round(kv_bytes * live / alloc))
@@ -2145,6 +2400,15 @@ class DecodeSession:
 
     def _check_live(self) -> None:
         check(not self.closed, "decode_session: session is closed")
+        if self.pool is not None and self.pool.closed:
+            # the pool died under us (device fault in a program that
+            # donated it, or an explicit release): this session's block
+            # tables point into freed/unknown state — same latch-then-
+            # raise discipline as staleness below
+            self.closed = True
+            check(False,
+                  "decode_session: the kv block pool is closed — open "
+                  "a fresh session (the dispatcher rebuilds the pool)")
         if self.tr.params is not self._params_key:
             # staleness IS the never-serve-again condition the closed
             # flag encodes: latch it BEFORE raising, so the dispatcher
@@ -2212,21 +2476,10 @@ class DecodeSession:
         net1, last, pick = self._net1, self._last, self._pick
 
         def build():
-            def one(params, toks_r, caches_r, key_r, pos_r):
-                # EXACTLY the solo decode step at b=1, with this row's
-                # own position/cache/key — vmapped below over slots
-                tok = jax.lax.dynamic_slice(toks_r, (pos_r,), (1,))
-                data = tok.reshape(1, 1, 1, 1).astype(jnp.float32)
-                values, _ = net1.forward(params, data, train=False,
-                                         decode_pos=pos_r,
-                                         kv_cache=caches_r)
-                caches2 = dict(net1._last_cache_updates)
-                nxt = pick(values[last].reshape(1, -1),
-                           jax.random.fold_in(key_r, pos_r)
-                           )[0].astype(toks_r.dtype)
-                toks2 = jax.lax.dynamic_update_slice(
-                    toks_r, nxt[None], (pos_r + 1,))
-                return toks2, caches2, nxt
+            # the per-row step math is ONE definition shared with the
+            # paged step program (_session_row_step) — the two cache
+            # layouts cannot drift
+            one = _session_row_step(net1, last, pick)
 
             def run(params, toks, caches, keys, pos):
                 # inactive slots are stepped too (fixed shapes — that is
@@ -2246,6 +2499,123 @@ class DecodeSession:
             ("sess_step", self.nslots, self.temperature, self.top_k),
             "jit.decode_step", build)
 
+    # -- paged programs (block-table layout; doc/performance.md) -------
+    def _prefill_fn_paged(self, plen: int, p0: int):
+        """Paged admission program for (prompt length, reuse offset):
+        gather the slot's b=1 dense view through ``gather_row``
+        (shared-prefix content included — the copy-on-write source
+        rides here), run the SUFFIX forward [p0, plen) (p0 = 0 is the
+        whole-prompt chunk prefill, bitwise the dense session's), pick
+        the first token with the solo RNG fold, and scatter the
+        written blocks back to ``wb_ids``. A fresh (plen, p0) pair
+        compiles once — exactly the per-prompt-length discipline the
+        dense prefill already has."""
+        pool, last, pick = self.pool, self._last, self._pick
+        bs, T = pool.bs, pool.T
+        k0 = p0 // bs
+        nwb = -(-plen // bs) - k0              # blocks written [k0, ..)
+        tr = self.tr
+
+        def build():
+            net = tr._seq_net(1, plen - p0)
+
+            def run(params, pools, gather_row, wb_ids, toks, key):
+                views = _kv_gather_views(pools, gather_row, T, bs)
+                L = plen - p0
+                sub = jax.lax.dynamic_slice(toks, (0, p0), (1, L))
+                values, _ = net.forward(
+                    params,
+                    sub.reshape(1, 1, 1, L).astype(jnp.float32),
+                    train=False, decode_pos=p0, kv_cache=views)
+                cu = net._last_cache_updates
+                first = pick(values[last].reshape(1, -1, L)[:, :, -1],
+                             jax.random.fold_in(key, plen - 1)
+                             ).astype(toks.dtype)
+                toks = jax.lax.dynamic_update_slice(
+                    toks, first[:, None], (0, plen))
+                pools2 = {}
+                for k in pools:
+                    row = cu[k]                # (1, nkv, l_max, dh)
+                    blocks = row.reshape(
+                        row.shape[0], row.shape[1], T, bs,
+                        row.shape[3]).transpose(2, 0, 1, 3, 4)
+                    pools2[k] = pools[k].at[wb_ids].set(
+                        blocks[k0:k0 + nwb].astype(pools[k].dtype))
+                # params donated-and-returned (see _swap_params)
+                return toks, pools2, first, params
+            return jax.jit(run, donate_argnums=(0, 1, 4))
+
+        return tr._watched_jit(
+            ("sess_prefill_paged", plen, p0, T, bs, self.temperature,
+             self.top_k), "jit.decode_prefill", build)
+
+    def _admit_fn_paged(self):
+        """Scatter one slot's row into the paged session state (toks /
+        RNG key / position / block table) — also the RETIRE program
+        with an all-zero row: a dead slot's table must point at the
+        scratch block so its runaway device writes can never land in a
+        block the free list re-issued to someone else."""
+        def build():
+            def run(btoks, bkeys, bpos, btabs, toks1, key1, pos1, tab1,
+                    slot):
+                btoks = jax.lax.dynamic_update_slice(
+                    btoks, toks1, (slot, 0))
+                bkeys = jax.lax.dynamic_update_slice(
+                    bkeys, key1[None].astype(bkeys.dtype), (slot, 0))
+                bpos = jax.lax.dynamic_update_slice(
+                    bpos, pos1[None].astype(bpos.dtype), (slot,))
+                btabs = jax.lax.dynamic_update_slice(
+                    btabs, tab1[None].astype(btabs.dtype), (slot, 0))
+                return btoks, bkeys, bpos, btabs
+            return jax.jit(run, donate_argnums=(0, 1, 2, 3))
+
+        return self.tr._watched_jit(
+            ("sess_admit_paged", self.nslots, self.pool.T),
+            "jit.decode_admit", build)
+
+    def _step_fn_paged(self):
+        """Paged decode step: gather every slot's dense view through
+        its block table, run EXACTLY the dense per-row step
+        (_session_row_step) vmapped over slots, then scatter each
+        slot's written block back to the pool. One program per
+        (bucket, table width, block, sampling) signature; the pool
+        arrays ride the donate-and-return chain like the dense
+        caches."""
+        net1, last, pick = self._net1, self._last, self._pick
+        pool = self.pool
+        bs, T = pool.bs, pool.T
+
+        def build():
+            one = _session_row_step(net1, last, pick)
+
+            def run(params, pools, toks, keys, pos, tabs):
+                views = _kv_gather_views(pools, tabs, T, bs)
+                toks2, views2, nxt = jax.vmap(
+                    one, in_axes=(None, 0, 0, 0, 0))(
+                        params, toks, views, keys, pos)
+                # write back each slot's CURRENT block (the only block
+                # a step writes). A dead slot's clipped index resolves
+                # through its zeroed table row to the scratch block —
+                # duplicate scratch writes are garbage nobody reads.
+                bi = jnp.clip(pos // bs, 0, T - 1)
+                wb = jnp.take_along_axis(tabs, bi[:, None], axis=1)[:, 0]
+                pools2 = {}
+                for k in pools:
+                    v2 = views2[k]          # (S, 1, nkv, l_max, dh)
+                    nkv, dh = v2.shape[2], v2.shape[4]
+                    blk = jax.vmap(
+                        lambda row, b: jax.lax.dynamic_slice(
+                            row, (0, 0, b * bs, 0),
+                            (1, nkv, bs, dh)))(v2, bi)
+                    pools2[k] = pools[k].at[wb].set(
+                        blk.astype(pools[k].dtype))
+                return toks2, pools2, nxt, pos + 1, params
+            return jax.jit(run, donate_argnums=(0, 1, 2, 4))
+
+        return self.tr._watched_jit(
+            ("sess_step_paged", self.nslots, T, bs, self.temperature,
+             self.top_k), "jit.decode_step", build)
+
     # -- scheduling surface -------------------------------------------
     def prefill(self, slot: int, toks, seed: int) -> Tuple[int, bool]:
         """Admit one request into free ``slot``: run its b=1 prefill,
@@ -2263,11 +2633,14 @@ class DecodeSession:
         check(plen + self.n_new <= self.l_max,
               "decode_session: prompt len %d + n_new %d exceeds the "
               "net's sequence length %d" % (plen, self.n_new, self.l_max))
-        pre_fn, admit_fn = self._prefill_fn(plen), self._admit_fn()
         params = self.tr._decode_params_current()
         t1 = np.zeros((1, self.l_max), np.int32)
         t1[0, :plen] = toks
         key = np.asarray(jax.random.PRNGKey(int(seed)))
+        if self.pool is not None:
+            return self._prefill_paged(slot, toks, plen, params, t1,
+                                       key)
+        pre_fn, admit_fn = self._prefill_fn(plen), self._admit_fn()
         try:
             t0 = time.perf_counter()
             toks1, caches1, first, new_params = pre_fn(
@@ -2300,6 +2673,68 @@ class DecodeSession:
         telemetry.count("decode.tokens")
         return first, self._remaining[slot] == 0
 
+    def _prefill_paged(self, slot: int, toks, plen: int, params, t1,
+                       key) -> Tuple[int, bool]:
+        """Paged admission: reserve blocks (shared prefix refcounted —
+        the reused positions are NOT recomputed: prefill-once), run the
+        suffix prefill + block writeback, scatter the slot row + block
+        table. Raises ``KVPoolExhausted`` BEFORE any device work when
+        the free list cannot cover the request — the session stays
+        open (servd's ``reservable`` gate defers the request instead
+        of ever reaching this)."""
+        pool = self.pool
+        ticket = pool.alloc.admit(toks, self.n_new)
+        if ticket is None:
+            raise KVPoolExhausted(
+                "decode_session: kv block pool exhausted (%d free of "
+                "%d) — request needs %d fresh blocks; defer admission"
+                % (pool.alloc.free_blocks, pool.alloc.usable,
+                   pool.alloc.blocks_for(plen, self.n_new)))
+        ids, p0 = ticket.ids, ticket.p0
+        pre_fn = self._prefill_fn_paged(plen, p0)
+        admit_fn = self._admit_fn_paged()
+        grow = np.zeros(pool.T, np.int32)
+        grow[:len(ticket.gather_ids)] = ticket.gather_ids
+        k0 = p0 // pool.bs
+        nwb = -(-plen // pool.bs) - k0
+        wb = np.asarray(ids[k0:k0 + nwb], np.int32)
+        trow = np.zeros(pool.T, np.int32)
+        trow[:len(ids)] = ids
+        try:
+            t0 = time.perf_counter()
+            toks1, pool.pools, first, new_params = pre_fn(
+                params, pool.pools, jnp.asarray(grow), jnp.asarray(wb),
+                jnp.asarray(t1), jnp.asarray(key))
+            (self._toks, self._keys_dev, self._pos_dev,
+             self._tables_dev) = admit_fn(
+                self._toks, self._keys_dev, self._pos_dev,
+                self._tables_dev, toks1, jnp.asarray(key),
+                jnp.asarray(plen, jnp.int32), jnp.asarray(trow),
+                jnp.asarray(slot, jnp.int32))
+            first = int(np.asarray(first)[0])   # blocks: the first token
+        except Exception:
+            # the prefill DONATED the pool arrays: their integrity is
+            # unknown — the pool (and with it every session's block
+            # tables and the allocator books) is dead; the dispatcher
+            # opens a fresh session and the trainer rebuilds the pool
+            self.tr._decode_params = None
+            self.closed = True
+            pool.release()
+            raise
+        t_first = time.perf_counter()
+        # publish the FULL prompt blocks for reuse only after the
+        # prefill landed (a faulted admission's blocks hold garbage)
+        pool.alloc.register(ticket, toks)
+        self._slot_blocks[slot] = list(ids)
+        telemetry.mark("first_token")
+        telemetry.span_event("decode.prefill", t0, t_first - t0)
+        self.tr._decode_params = (self.tr._decode_params[0], new_params)
+        self._active[slot] = True
+        self._remaining[slot] = self.n_new - 1
+        self._plen[slot] = plen
+        telemetry.count("decode.tokens")
+        return first, self._remaining[slot] == 0
+
     def step(self) -> List[Tuple[int, int, bool]]:
         """Advance every active slot one token (one jitted pass over the
         whole bucket); blocks on the token vector — iteration
@@ -2308,18 +2743,25 @@ class DecodeSession:
         self._check_live()
         if self.active_count == 0:
             return []
-        step_fn = self._step_fn()
         params = self.tr._decode_params_current()
         try:
             t0 = time.perf_counter()
-            (self._toks, self._caches, nxt, self._pos_dev,
-             new_params) = step_fn(
-                params, self._toks, self._caches, self._keys_dev,
-                self._pos_dev)
+            if self.pool is not None:
+                (self._toks, self.pool.pools, nxt, self._pos_dev,
+                 new_params) = self._step_fn_paged()(
+                    params, self.pool.pools, self._toks,
+                    self._keys_dev, self._pos_dev, self._tables_dev)
+            else:
+                (self._toks, self._caches, nxt, self._pos_dev,
+                 new_params) = self._step_fn()(
+                    params, self._toks, self._caches, self._keys_dev,
+                    self._pos_dev)
             nxt = np.asarray(nxt)               # blocks: this iteration
         except Exception:
             self.tr._decode_params = None
             self.closed = True      # batch state integrity unknown
+            if self.pool is not None:
+                self.pool.release()   # the step donated the pool arrays
             raise
         telemetry.span_event("decode.step", t0,
                              time.perf_counter() - t0,
@@ -2336,21 +2778,56 @@ class DecodeSession:
 
     def retire(self, slot: int) -> None:
         """Free a finished (or abandoned) slot — the next queued request
-        joins mid-decode here. Device state is left in place: a dead
-        slot's rows are never read, and admission overwrites them."""
-        if 0 <= slot < self.nslots:
-            self._active[slot] = False
-            self._remaining[slot] = 0
-            self._plen[slot] = 0
+        joins mid-decode here. Dense layout: device state is left in
+        place (a dead slot's rows are never read, admission overwrites
+        them). Paged layout: the slot's blocks return to the free list
+        NOW (mid-decode — the reclaim the paged design exists for) and
+        its table row is reset to the scratch block, so the dead
+        slot's still-stepping device writes can never corrupt a block
+        the free list re-issues."""
+        if not 0 <= slot < self.nslots:
+            return
+        self._active[slot] = False
+        self._remaining[slot] = 0
+        self._plen[slot] = 0
+        if self.pool is None or not self._slot_blocks:
+            return
+        ids, self._slot_blocks[slot] = self._slot_blocks[slot], None
+        if not self.closed and not self.pool.closed:
+            try:
+                zkey = np.zeros_like(np.asarray(jax.random.PRNGKey(0)))
+                (self._toks, self._keys_dev, self._pos_dev,
+                 self._tables_dev) = self._admit_fn_paged()(
+                    self._toks, self._keys_dev, self._pos_dev,
+                    self._tables_dev,
+                    jnp.zeros((1, self.l_max), jnp.int32),
+                    jnp.asarray(zkey), jnp.asarray(0, jnp.int32),
+                    jnp.zeros(self.pool.T, jnp.int32),
+                    jnp.asarray(slot, jnp.int32))
+            except Exception:
+                # retire must never raise (it runs on cleanup paths):
+                # a failed table reset leaves device state unknown —
+                # latch this session AND the pool dead instead
+                self.closed = True
+                self.pool.release()
+        if ids and not self.pool.closed:
+            self.pool.alloc.free(ids)
 
     def close(self) -> None:
-        """Release the device state (the per-slot caches are the
-        session's HBM footprint). Idempotent."""
+        """Release the device state (the per-slot caches — or, paged,
+        the block-table claims on the shared pool — are the session's
+        HBM footprint). Idempotent."""
+        if self.pool is not None and not self.pool.closed:
+            for s in range(self.nslots):
+                if self._slot_blocks and self._slot_blocks[s]:
+                    self.pool.alloc.free(self._slot_blocks[s])
+                    self._slot_blocks[s] = None
         self.closed = True
         self._toks = None
         self._caches = None
         self._keys_dev = None
         self._pos_dev = None
+        self._tables_dev = None
 
 
 def create_net(net_type: int = 0) -> Trainer:

@@ -1,0 +1,307 @@
+"""Free-list KV-block allocator for the paged decode cache.
+
+The HOST half of the paged KV cache (doc/performance.md "Decode KV
+cache"): the device side — per-layer block pools and the gather/
+writeback programs — lives in ``nnet/trainer.py`` (``KVBlockPool`` /
+the paged ``DecodeSession``); this module owns every allocation
+decision and is deliberately jax-free so the allocator invariants are
+testable in milliseconds (``tests/test_kvblocks.py``).
+
+Model
+-----
+The pool is ``blocks`` fixed-size blocks of ``block_size`` cache rows
+(tokens) each. Block id 0 is RESERVED as the scratch block: the padding
+entry of every block table, and the landing pad for a retired slot's
+runaway device writes — it is never allocated and never meaningfully
+read (attention masks every position past a slot's live extent, and a
+gathered scratch block only ever covers masked positions).
+
+* ``admit(toks, n_new)`` reserves every block a sequence can ever
+  write — ``ceil((plen + n_new - 1) / block_size)`` — up front, so a
+  mid-decode allocation failure cannot exist: admission either holds
+  all its blocks or defers (servd's deterministic queue-wait). The
+  prompt's full blocks are first matched against the prefix trie;
+  matched blocks are SHARED (refcount incremented, prefilled by
+  whoever loaded them — the prefill-once contract) and only the
+  remainder comes off the free list.
+* Shared-prefix matching is content-keyed at block granularity: the
+  trie maps ``(previous block id, the block's token tuple)`` to a
+  resident block, so two prompts share exactly their common full-block
+  prefix. A partial tail block is never shared.
+* Copy-on-write: a sequence never writes into a block with refcount
+  > 1. The only write into the shared region is the block-aligned
+  full-coverage case (the whole prompt matched): the last prompt
+  position must be recomputed for its first-token logits, so the last
+  matched block is demoted to a GATHER source and a fresh block
+  becomes the write target — the device writeback copies the old
+  content through the gathered view (``cow_copies`` counts these).
+  Every other write lands past the shared prefix in exclusively-owned
+  blocks by construction.
+* ``free(ids)`` decrements refcounts; a block reaching zero leaves the
+  trie and returns to the free list in the same step — accounting is
+  exact at every instant (no deferred reclamation, no leak: after the
+  last holder frees, ``blocks_free`` equals the usable pool and the
+  trie is empty).
+
+Thread model: single mutating owner (servd's worker thread drives
+every admit/free through the session). The published account travels
+through servd's admission-lock snapshot (``_publish_batch_state``) —
+the allocator itself takes no lock, so the cxxlint lock graph is
+untouched.
+"""
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["BlockAllocator", "AdmitTicket", "KVPoolExhausted"]
+
+
+class KVPoolExhausted(RuntimeError):
+    """Transient block-pool exhaustion at admission: the request fits
+    the pool but not RIGHT NOW. Raised by a paged
+    ``DecodeSession.prefill`` before any device work (the session
+    stays open); servd's block-budgeted ``_gather`` makes it all but
+    unreachable on the serving path, and its ``_admit_one`` catches it
+    as a REQUEUE (the request returns to the queue head: a
+    deterministic wait, never an error, never a device OOM). Lives
+    here (not trainer.py) so the jax-free serving frontend can catch
+    it by type."""
+
+
+class AdmitTicket:
+    """One admission's block reservation.
+
+    ``ids``         every block the sequence holds (refcounted), in
+                    position order: ``ids[j]`` backs cache rows
+                    ``[j*bs, (j+1)*bs)``.
+    ``gather_ids``  the ids to GATHER content from, same order —
+                    identical to ``ids`` except at a copy-on-write
+                    index, where it names the shared source block
+                    whose content the device writeback copies.
+    ``p0``          first position the suffix prefill must compute
+                    (0 = no reuse; the positions [0, p0) are already
+                    resident in the shared blocks).
+    """
+
+    __slots__ = ("ids", "gather_ids", "p0")
+
+    def __init__(self, ids: List[int], gather_ids: List[int], p0: int):
+        self.ids = ids
+        self.gather_ids = gather_ids
+        self.p0 = p0
+
+
+class BlockAllocator:
+    """Free-list allocator with refcounted shared-prefix blocks."""
+
+    def __init__(self, blocks: int, block_size: int,
+                 prefix_reuse: bool = True):
+        if blocks < 2:
+            raise ValueError("kvblocks: need >= 2 blocks "
+                             "(one is the reserved scratch block)")
+        if block_size < 1:
+            raise ValueError("kvblocks: block_size must be >= 1")
+        self.blocks = int(blocks)
+        self.bs = int(block_size)
+        self.prefix_reuse = bool(prefix_reuse)
+        # ascending allocation order (pop() from the tail): determinism
+        # the tests and the flight ring rely on
+        self._free: List[int] = list(range(self.blocks - 1, 0, -1))
+        self._ref = [0] * self.blocks
+        # (prev block id | 0 at the root, block token tuple) -> block id
+        self._trie: Dict[Tuple[int, tuple], int] = {}
+        self._key_of: Dict[int, Tuple[int, tuple]] = {}
+        # lifetime tallies (the cxxnet_decode_kv_block_* series) —
+        # counted at admission SUCCESS only: a deferred ask retries
+        # and must tally once, not once per attempt (alloc_failures
+        # counts the defers), and the hit-rate denominator
+        # (prompt_tokens) must hold only tokens that actually admitted
+        self.prefix_queries = 0      # admissions completed
+        self.prefix_hits = 0         # admissions that reused >= 1 token
+        self.prefix_hit_tokens = 0   # prompt tokens NOT re-prefilled
+        self.prompt_tokens = 0       # prompt tokens admitted
+        self.cow_copies = 0          # copy-on-write block demotions
+        self.alloc_failures = 0      # admissions deferred on exhaustion
+
+    # -- geometry ------------------------------------------------------
+    @property
+    def usable(self) -> int:
+        """Allocatable blocks (the scratch block excluded)."""
+        return self.blocks - 1
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.usable - len(self._free)
+
+    def blocks_for(self, plen: int, n_new: int) -> int:
+        """Blocks a (prompt, budget) sequence can ever write: cache
+        rows [0, plen + n_new - 1) — the final generated token is
+        returned but its K/V row is never written (no later step reads
+        it)."""
+        rows = max(1, int(plen) + max(1, int(n_new)) - 1)
+        return -(-rows // self.bs)
+
+    def fits(self, plen: int, n_new: int) -> bool:
+        """Whether the sequence can EVER be admitted (vs the whole
+        pool) — False is a deterministic request defect, not a wait."""
+        return self.blocks_for(plen, n_new) <= self.usable
+
+    # -- prefix trie ---------------------------------------------------
+    def match_prefix(self, toks: Sequence[int]) -> List[int]:
+        """Resident blocks covering the prompt's full-block prefix —
+        the chain of content-matched FULL blocks from the root. No
+        refcounts move (``admit`` does that)."""
+        if not self.prefix_reuse:
+            return []
+        out: List[int] = []
+        prev = 0
+        bs = self.bs
+        for j in range(len(toks) // bs):
+            key = (prev, tuple(int(t) for t in toks[j * bs:(j + 1) * bs]))
+            b = self._trie.get(key)
+            if b is None:
+                break
+            out.append(b)
+            prev = b
+        return out
+
+    def fresh_need(self, plen: int, n_new: int,
+                   toks: Optional[Sequence[int]] = None) -> int:
+        """Blocks ``admit`` would pull OFF THE FREE LIST right now —
+        total need minus the resident shared prefix (with ``toks``),
+        CoW demotion included. servd's gather loop budgets queue pops
+        against this (single mutating owner, so check-then-admit is
+        race-free)."""
+        shared = len(self.match_prefix(toks)) if toks is not None else 0
+        need = self.blocks_for(plen, n_new)
+        if shared * self.bs >= plen:
+            shared -= 1       # the CoW demotion needs a fresh target
+        return need - max(0, shared)
+
+    def reservable(self, plen: int, n_new: int,
+                   toks: Optional[Sequence[int]] = None) -> bool:
+        """Whether ``admit`` would succeed RIGHT NOW — the admission
+        gate. With ``toks`` the shared prefix is credited."""
+        return self.fresh_need(plen, n_new, toks) <= len(self._free)
+
+    # -- reserve / release ---------------------------------------------
+    def admit(self, toks: Sequence[int],
+              n_new: int) -> Optional[AdmitTicket]:
+        """Reserve every block for (prompt, generation budget): shared
+        full-prefix blocks are refcounted, the rest come off the free
+        list. Returns None when the free list cannot cover the fresh
+        need (nothing moves — the caller defers: servd's deterministic
+        queue-wait, never a device OOM)."""
+        plen = len(toks)
+        if plen < 1:
+            raise ValueError("kvblocks: empty prompt")
+        need = self.blocks_for(plen, n_new)
+        if need > self.usable:
+            raise ValueError(
+                "kvblocks: sequence needs %d blocks, pool holds %d — "
+                "gate this at admits() (it can never fit)"
+                % (need, self.usable))
+        shared = self.match_prefix(toks)
+        cow_src = None
+        if shared and len(shared) * self.bs >= plen:
+            # block-aligned full coverage: the last prompt position
+            # must be recomputed (its first-token logits are not
+            # stored), and that write may not land in a shared block —
+            # demote the last match to a gather source (CoW)
+            cow_src = shared.pop()
+        fresh_need = need - len(shared)
+        if fresh_need > len(self._free):
+            self.alloc_failures += 1
+            return None
+        self.prefix_queries += 1
+        p0 = (plen - 1) if cow_src is not None else len(shared) * self.bs
+        if p0 > 0:
+            self.prefix_hits += 1
+            self.prefix_hit_tokens += p0
+        if cow_src is not None:
+            self.cow_copies += 1
+        self.prompt_tokens += plen
+        for b in shared:
+            self._ref[b] += 1
+        fresh = [self._free.pop() for _ in range(fresh_need)]
+        for b in fresh:
+            self._ref[b] = 1
+        ids = shared + fresh
+        gather_ids = list(ids)
+        if cow_src is not None:
+            # gather the shared content, write back to the fresh copy
+            gather_ids[len(shared)] = cow_src
+        return AdmitTicket(ids, gather_ids, p0)
+
+    def register(self, ticket: AdmitTicket,
+                 toks: Sequence[int]) -> None:
+        """Publish the admission's FULL prompt blocks into the trie
+        (call after its prefill succeeded — a faulted prefill's blocks
+        hold garbage and must stay unfindable). An existing entry wins:
+        a copy-on-write twin is not re-registered under the same
+        content (its source already serves lookups)."""
+        if not self.prefix_reuse:
+            return
+        prev = 0
+        bs = self.bs
+        for j in range(len(toks) // bs):
+            b = ticket.ids[j]
+            key = (prev, tuple(int(t) for t in toks[j * bs:(j + 1) * bs]))
+            cur = self._trie.setdefault(key, b)
+            if cur == b:
+                self._key_of[b] = key
+            prev = cur
+
+    def free(self, ids: Sequence[int]) -> None:
+        """Release one holder's blocks (retire / deadline-evict /
+        close): refcounts drop, a block reaching zero leaves the trie
+        and returns to the free list immediately — the account is
+        exact at every instant."""
+        for b in ids:
+            if not 1 <= b < self.blocks:
+                raise ValueError("kvblocks: bad block id %r" % (b,))
+            self._ref[b] -= 1
+            if self._ref[b] < 0:
+                raise ValueError("kvblocks: double free of block %d" % b)
+            if self._ref[b] == 0:
+                key = self._key_of.pop(b, None)
+                if key is not None and self._trie.get(key) == b:
+                    del self._trie[key]
+                self._free.append(b)
+
+    # -- account / invariants ------------------------------------------
+    def account(self) -> dict:
+        return {"blocks_total": self.usable,
+                "blocks_free": len(self._free),
+                "blocks_used": self.used_blocks,
+                "block_tokens": self.bs,
+                "prefix_queries": self.prefix_queries,
+                "prefix_hits": self.prefix_hits,
+                "prefix_hit_tokens": self.prefix_hit_tokens,
+                "prompt_tokens": self.prompt_tokens,
+                "cow_copies": self.cow_copies,
+                "alloc_failures": self.alloc_failures}
+
+    def check(self) -> None:
+        """Assert every structural invariant (the test suite's oracle
+        after chaos-ordered admit/free interleavings)."""
+        assert self._ref[0] == 0, "scratch block acquired a refcount"
+        free = set(self._free)
+        assert len(free) == len(self._free), "free list duplicates"
+        assert 0 not in free, "scratch block on the free list"
+        for b in range(1, self.blocks):
+            if b in free:
+                assert self._ref[b] == 0, \
+                    "block %d free with refcount %d" % (b, self._ref[b])
+            else:
+                assert self._ref[b] > 0, \
+                    "block %d leaked (neither free nor held)" % b
+        for key, b in self._trie.items():
+            assert self._ref[b] > 0, "trie points at dead block %d" % b
+            assert self._key_of.get(b) == key, \
+                "trie/_key_of disagree on block %d" % b
+        for b, key in self._key_of.items():
+            assert self._trie.get(key) == b

@@ -625,16 +625,49 @@ class Ledger:
                # the live decode KV cache is a first-class HBM
                # consumer: persistent device state held BETWEEN
                # program executions, so headroom charges it on top of
-               # the peak program footprint. (A decode-step execution's
-               # argument bytes include its own session's cache, so
-               # the sum is conservative by up to one session — the
-               # safe direction for an allocator sizing against it.)
+               # the peak program footprint. Under the PAGED layout
+               # decode_kv_bytes is the block pool's REAL array nbytes
+               # (block-exact, pinned by test_perf). The HEADROOM row
+               # stays conservative in BOTH layouts: the decode-step
+               # card's argument bytes already include the cache the
+               # decode_kv row charges again — one session's worth
+               # dense, up to the whole pool paged (the step program
+               # donates the pool arrays). It can only understate
+               # free HBM, never overstate it, and the ledger cannot
+               # tell which card bytes are the pool's to exclude them.
                "decode_kv_bytes": decode_kv,
                "headroom_bytes":
                (spec.hbm_capacity - peak - (decode_kv or 0))
                if peak is not None else None}
         return {"spec": spec.to_dict(), "enabled": self.enabled,
                 "cards": cards, "hbm": hbm}
+
+    def decode_pool_cap_bytes(self,
+                              frac: float = 0.5) -> Optional[int]:
+        """Byte budget for the PAGED decode KV pool (ROADMAP item 2:
+        "sized from the live HBM account"): ``frac`` of what the spec's
+        HBM capacity leaves after the peak program footprint measured
+        so far. The decode-KV hook is deliberately NOT charged here —
+        the pool REPLACES the dense caches that hook reports, so
+        charging them would double-count the very bytes being sized.
+        None when the ledger is off (the pool falls back to
+        dense-equivalent sizing). Conservative by construction: cards
+        land as programs compile, so a pool sized at serving start sees
+        the train/prefill peak, and ``Trainer.decode_kv_pool`` still
+        floors the result at one max-length sequence."""
+        if not self.enabled:
+            return None
+        spec = self.spec or current_device_spec()
+        peak = 0
+        with self._cond:
+            for c in self._cards.values():
+                pb = c.get("peak_bytes")
+                if pb is not None:
+                    peak = max(peak, int(pb))
+        room = spec.hbm_capacity - peak
+        if room <= 0:
+            return None
+        return int(max(0.0, min(1.0, float(frac))) * room)
 
     def set_decode_kv(self, fn) -> None:
         """Register the decode KV-cache account hook (``fn() ->

@@ -311,7 +311,9 @@ class Replica:
 
     __slots__ = ("name", "host", "port", "status_port", "state",
                  "detail", "hold", "queue_depth", "in_flight",
-                 "free_slots", "has_slots", "buckets", "outstanding",
+                 "free_slots", "has_slots", "kv_blocks_total",
+                 "kv_blocks_free", "has_kv_blocks",
+                 "buckets", "outstanding",
                  "probe_fails", "ejections", "next_probe_at",
                  "last_probe", "no_trace", "trace_ok",
                  "no_tenant", "tenant_ok", "standby", "from_standby")
@@ -338,6 +340,15 @@ class Replica:
         #                              free_slots at all — absent means
         #                              no batching, and 0 must then read
         #                              as "unknown", not "saturated"
+        self.kv_blocks_total = 0     # paged-KV pool level from ADMIN
+        self.kv_blocks_free = 0      # stats (kv_blocks_total/free):
+        #                              process-global (the pool is
+        #                              shared across buckets), so the
+        #                              fleet sum is exact. Absent on
+        #                              dense/pre-paging replicas —
+        self.has_kv_blocks = False   # the same absence-is-the-
+        #                              capability-signal discipline as
+        #                              free_slots
         self.buckets = {}            # per-bucket load signal from
         #                              ADMIN stats (bucket.<b>.warm /
         #                              .active): {b: {"warm", "active"}}
@@ -380,6 +391,10 @@ class Replica:
                 "queue_depth": self.queue_depth,
                 "in_flight": self.in_flight,
                 "free_slots": self.free_slots,
+                "kv_blocks_total": self.kv_blocks_total
+                if self.has_kv_blocks else None,
+                "kv_blocks_free": self.kv_blocks_free
+                if self.has_kv_blocks else None,
                 "buckets": {str(b): dict(d) for b, d
                             in sorted(self.buckets.items())},
                 "outstanding": self.outstanding,
@@ -691,6 +706,18 @@ class Router:
                     # last-known — the field IS the capability signal
                     r.free_slots = st.get("free_slots", 0)
                     r.has_slots = "free_slots" in st
+                    # paged-KV pool level: same absent-means-dense
+                    # discipline, and the same defensive parse — a
+                    # foreign replica may emit any value shape, and an
+                    # exception here would kill the prober for good
+                    try:
+                        r.kv_blocks_total = int(
+                            st.get("kv_blocks_total", 0))
+                        r.kv_blocks_free = int(
+                            st.get("kv_blocks_free", 0))
+                    except (TypeError, ValueError):
+                        r.kv_blocks_total = r.kv_blocks_free = 0
+                    r.has_kv_blocks = "kv_blocks_total" in st
                     # per-bucket warm/active counts (bucket.<b>.warm /
                     # bucket.<b>.active): the per-bucket load signal —
                     # wholesale replacement, same absent-means-none
@@ -704,7 +731,8 @@ class Router:
                         # here would kill the prober thread for good
                         parts = k.split(".")
                         if len(parts) != 3 \
-                                or parts[2] not in ("warm", "active"):
+                                or parts[2] not in ("warm", "active",
+                                                    "blocks_held"):
                             continue
                         try:
                             buckets.setdefault(
@@ -1445,6 +1473,13 @@ class Router:
         # byte sums are EXACT (each replica accounts its own cache),
         # live pct recomputed from the sums — never a mean of means
         dec_reps = dec_kv = dec_live = dec_convoy = 0
+        # the paged-KV pool federation: block counts and prefix-token
+        # tallies sum exactly (each replica's pool is its own), the
+        # fleet hit rate is recomputed from the token sums — never a
+        # mean of per-replica rates. Foreign/dense replicas simply
+        # lack the "pool" key (the PR 13 guard: absent never kills)
+        pool_reps = blk_total = blk_free = 0
+        pfx_hit_toks = pfx_prompt_toks = kv_defers = 0
         for name, snap in sorted(fed.items()):
             b = snap.get("batch")
             if isinstance(b, dict):
@@ -1452,6 +1487,19 @@ class Router:
                 dec_kv += int(b.get("kv_bytes") or 0)
                 dec_live += int(b.get("kv_live_bytes") or 0)
                 dec_convoy += 1 if b.get("convoy") else 0
+                pl = b.get("pool")
+                if isinstance(pl, dict):
+                    try:
+                        pool_reps += 1
+                        blk_total += int(pl.get("blocks_total") or 0)
+                        blk_free += int(pl.get("blocks_free") or 0)
+                        pfx_hit_toks += int(
+                            pl.get("prefix_hit_tokens") or 0)
+                        pfx_prompt_toks += int(
+                            pl.get("prompt_tokens") or 0)
+                        kv_defers += int(pl.get("alloc_failures") or 0)
+                    except (TypeError, ValueError):
+                        pass
             m = snap.get("metrics") or {}
             for hname, d in (m.get("hists") or {}).items():
                 if not hname.startswith("serve."):
@@ -1498,6 +1546,17 @@ class Router:
                 "kv_live_pct": round(100.0 * dec_live / dec_kv, 2)
                 if dec_kv else None,
                 "convoy_replicas": dec_convoy}
+            if pool_reps:
+                out["decode"]["pool"] = {
+                    "replicas": pool_reps,
+                    "blocks_total": blk_total,
+                    "blocks_free": blk_free,
+                    "prefix_hit_tokens": pfx_hit_toks,
+                    "prompt_tokens": pfx_prompt_toks,
+                    "prefix_hit_rate":
+                    round(100.0 * pfx_hit_toks / pfx_prompt_toks, 2)
+                    if pfx_prompt_toks else None,
+                    "kv_defers": kv_defers}
         # the per-tenant fleet account, parsed back out of the summed
         # serve.tenant.<t>.<key> counter series and the merged
         # serve.tenant.<t>.request histograms: fleet-wide per-tenant

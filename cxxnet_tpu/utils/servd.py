@@ -177,6 +177,20 @@ chaos tests inject jax-free fakes (tests/faultinject.py). Scheduling:
   reports ``free_slots`` (bucket capacity − active) plus per-bucket
   ``batch_buckets`` / ``bucket.<b>.warm`` / ``bucket.<b>.active`` so
   the fleet router can prefer the replica that can batch a request in.
+* **block-aware admission (paged KV)** — a backend exposing the
+  paged-pool hooks (``kv_free_blocks`` / ``kv_fresh_blocks`` /
+  ``kv_pool_account``; doc/performance.md "Decode KV cache") gets a
+  block-budgeted gather: a queued request is popped only when the
+  pool covers its prompt + generation blocks RIGHT NOW, head-of-queue
+  order, no skip-ahead — pool exhaustion is a deterministic FIFO
+  queue-wait, never an error, never a device OOM. A retirement
+  returns its blocks mid-decode and the next turn's gather admits
+  into them; the rare budget race (``kvblocks.KVPoolExhausted`` from
+  a prefill that ran no device work) REQUEUES at the queue head.
+  ``ADMIN stats`` gains ``kv_blocks_total``/``kv_blocks_free`` +
+  ``bucket.<b>.blocks_held``, and ``batch_snapshot()`` the ``pool``
+  sub-dict (free-list level, prefix-reuse/CoW tallies, block-exact
+  ``pool_bytes`` — what ``decode_kv_bytes`` reports under paging).
 * **the scheduler is observed per ITERATION** (doc/observability.md
   "Decode datapath") — every decode iteration lands in the
   ``BatchFlightRecorder`` ring (``batch_flight_cap``): bucket, step
@@ -218,6 +232,7 @@ from typing import Callable, List, Optional, Tuple
 
 from . import checkpoint as ckpt
 from . import health
+from . import kvblocks
 from . import lockrank
 from . import perf
 from . import statusd
@@ -429,6 +444,12 @@ class _ConnState:
         self.unsent = 0                # filled slots not yet transmitted
 
 
+# _admit_one's "block pool could not cover this admission" verdict —
+# distinct from None (rejected / failed / finished at prefill) so the
+# worker loop can requeue the request and its unadmitted batchmates
+_KV_DEFER = object()
+
+
 class _Request:
     __slots__ = ("toks", "deadline", "t_arrival", "t_wall", "reply",
                  "done", "seq", "id", "tenant", "_alock", "answered")
@@ -552,6 +573,24 @@ class _FairQueue:
         self._vt[t] = vt + self._stride[t]
         self._n -= 1
         return self._qs[t].popleft()
+
+    def peek(self):
+        """The request ``popleft`` would return RIGHT NOW, no mutation
+        — the paged-KV gather gate budgets the NEXT admission's block
+        demand (deque-parity: the plain queue peeks its [0])."""
+        vt, t = min((self._vt[t], t) for t, q in self._qs.items() if q)
+        return self._qs[t][0]
+
+    def appendleft(self, req) -> None:
+        """Return a popped request to ITS TENANT's queue head (the
+        paged-KV defer/requeue path): the pop's virtual-time charge is
+        refunded — a defer costs the tenant no fair-share credit, and
+        the refund makes its tenant the furthest-behind again so the
+        deferred request is the next pop (deque-parity head semantics).
+        No idle clamp: the tenant never left the queue."""
+        self._vt[req.tenant] -= self._stride[req.tenant]
+        self._qs[req.tenant].appendleft(req)
+        self._n += 1
 
     def oldest_arrival(self):
         """Earliest queued arrival (monotonic), or None when empty —
@@ -801,6 +840,11 @@ class ServeFrontend:
                 "kv_live_bytes": 0, "live_tokens": 0,
                 "alloc_tokens": 0}
             for b in self._buckets}
+        # paged-KV pool account mirror (worker-written under _cond from
+        # the slot backend's kv_pool_account() hook; None on dense/solo
+        # backends) — /batchz, ADMIN stats and the /metrics block
+        # series read it instead of re-asking the backend
+        self._pool_state: Optional[dict] = None
         self._convoy = False         # latched while a convoy holds
         self._convoys = 0            # episodes (0->1 transitions)
         self._convoy_since = 0       # iteration ordinal of the latch
@@ -919,9 +963,11 @@ class ServeFrontend:
         return self._occ_slots / float(self._occ_iters)
 
     def decode_kv_bytes(self) -> int:
-        """Total allocated decode KV-cache bytes across the warm
-        sessions (0 on the solo path) — the perf ledger's HBM-account
-        hook (``perf.set_decode_kv``): the decode cache is a
+        """Total allocated decode KV-cache bytes (0 on the solo path)
+        — dense: summed across the warm sessions' cache arrays; paged:
+        the block pool's REAL nbytes (block-exact, free blocks
+        included — they are allocated HBM). The perf ledger's
+        HBM-account hook (``perf.set_decode_kv``): the decode cache is a
         first-class HBM consumer next to the program footprints.
         Lock-free (a benign read of the worker's GIL-atomic mirror):
         /metrics renders already take the admission lock once for the
@@ -947,6 +993,8 @@ class ServeFrontend:
                        in sorted(self._bucket_state.items())}
             free = self._batch_free
             qd = len(self._q)
+            pool = (dict(self._pool_state)
+                    if self._pool_state is not None else None)
         kv = sum(bs["kv_bytes"] for bs in buckets.values())
         kv_live = sum(bs["kv_live_bytes"] for bs in buckets.values())
         warm_slots = sum(int(b) * bs["warm"]
@@ -968,6 +1016,18 @@ class ServeFrontend:
                 "slot_iterations": fl.slot_iterations,
                 "mean_occupancy": self.mean_occupancy(),
                 "flight_cap": fl.cap}
+        if pool is not None:
+            # the paged-KV pool account (block-exact; shared across
+            # buckets, so it rides ONCE at the top level, not per
+            # bucket). prefix_hit_rate is TOKEN-weighted: the share of
+            # admitted prompt tokens served from resident shared
+            # blocks instead of being re-prefilled — the bench's
+            # prefix-reuse headline.
+            pt = pool.get("prompt_tokens", 0)
+            pool["prefix_hit_rate"] = (
+                round(100.0 * pool.get("prefix_hit_tokens", 0) / pt, 2)
+                if pt else None)
+            snap["pool"] = pool
         if ring > 0:
             snap["flight"] = fl.list(ring)
         return snap
@@ -1057,6 +1117,14 @@ class ServeFrontend:
                if kv else None,
                "age_skew": skew,
                "convoy": 1 if self._convoy else 0}
+        ps = self._pool_state             # worker-owned write/read
+        if ps is not None:
+            # the paged pool's free-list level at this iteration: the
+            # /batchz ring's view of block pressure building toward an
+            # admission wait (kv_defer) — next to the queue columns it
+            # answers "queued because slots or because blocks?"
+            rec["blocks_free"] = int(ps.get("blocks_free", 0))
+            rec["blocks_total"] = int(ps.get("blocks_total", 0))
         if not stepped:
             rec["stepped"] = 0
         if error is not None:
@@ -1327,6 +1395,21 @@ class ServeFrontend:
                                 live["bucket.%d.warm" % b] = bs["warm"]
                                 live["bucket.%d.active" % b] = \
                                     bs["active"]
+                                if self._pool_state is not None:
+                                    live["bucket.%d.blocks_held" % b] \
+                                        = bs.get("blocks_held", 0)
+                            if self._pool_state is not None:
+                                # paged-KV pool load (process-global —
+                                # the pool is shared across buckets, so
+                                # these are TOP-level keys the fleet
+                                # aggregation can sum exactly; same
+                                # absence-is-the-capability-signal
+                                # discipline as free_slots)
+                                ps = self._pool_state
+                                live["kv_blocks_total"] = \
+                                    ps.get("blocks_total", 0)
+                                live["kv_blocks_free"] = \
+                                    ps.get("blocks_free", 0)
                         text = "OK " + " ".join(
                             "%s=%d" % kv for kv in sorted(live.items()))
                     else:
@@ -1709,6 +1792,16 @@ class ServeFrontend:
                 accts[b] = fn()
             except Exception:
                 pass          # an account must never kill the worker
+        # the paged-KV pool account (block-exact: pool_bytes IS the
+        # device arrays' nbytes) — host metadata arithmetic, read
+        # BEFORE the lock like the per-session accounts
+        pool = None
+        pool_fn = getattr(self.slot_backend, "kv_pool_account", None)
+        if pool_fn is not None:
+            try:
+                pool = pool_fn()
+            except Exception:
+                pool = None
         with self._cond:
             self._batch_free = free
             qd = len(self._q)
@@ -1732,14 +1825,22 @@ class ServeFrontend:
                                                       0)),
                               live_tokens=int(a.get("live_tokens", 0)),
                               alloc_tokens=int(a.get("alloc_tokens",
-                                                     0)))
+                                                     0)),
+                              blocks_held=int(a.get("blocks_held", 0)))
+                self._pool_state = pool
                 # plain-int mirror for decode_kv_bytes: the perf
                 # ledger's hook reads it per /metrics scrape, and must
                 # not pay this (the admission) lock a second time per
-                # render — benign GIL-atomic read, worker-only write
-                self._kv_total = sum(
-                    bs["kv_bytes"]
-                    for bs in self._bucket_state.values())
+                # render — benign GIL-atomic read, worker-only write.
+                # Paged backends charge the POOL's real nbytes (the
+                # per-bucket kv_bytes are block-table claims: a shared
+                # block counts once per holder, and free blocks are
+                # still allocated HBM — the PR 13 conservative-by-one-
+                # session caveat is gone: this IS the arrays' nbytes)
+                self._kv_total = (
+                    int(pool.get("pool_bytes", 0)) if pool is not None
+                    else sum(bs["kv_bytes"]
+                             for bs in self._bucket_state.values()))
         telemetry.gauge("serve.in_flight", len(active))
         return qd, oldest
 
@@ -1764,10 +1865,40 @@ class ServeFrontend:
         out: List[_Request] = []
         if limit <= 0:
             return out
+        # paged-KV block budget (doc/performance.md "Decode KV cache"):
+        # a request is popped only when the pool can cover its fresh
+        # blocks RIGHT NOW — head-of-queue order, no skip-ahead, so
+        # exhaustion is a deterministic FIFO wait (retirements return
+        # blocks mid-decode and the next turn's gather admits). The
+        # budget is decremented per pop because this turn's admissions
+        # have not hit the allocator yet (worst-case: same-turn prefix
+        # twins are NOT credited — they defer one turn and then share).
+        # Hooks absent (dense/solo backend) => no gate.
+        kv_free = None
+        need_fn = getattr(self.slot_backend, "kv_fresh_blocks", None)
+        free_fn = getattr(self.slot_backend, "kv_free_blocks", None)
+        if need_fn is not None and free_fn is not None:
+            try:
+                kv_free = free_fn()
+            except Exception:
+                kv_free = None    # the gate must never kill the worker
         deadline = None
         with self._cond:
             while True:
                 while self._q and len(out) < limit:
+                    if kv_free is not None:
+                        # the NEXT pop's block demand — peek() on the
+                        # tenant fair queue (its head is virtual-time
+                        # order, not arrival order), [0] on the deque
+                        peek = getattr(self._q, "peek", None)
+                        head = peek() if peek is not None else self._q[0]
+                        try:
+                            need = need_fn(head.toks)
+                        except Exception:
+                            need = None
+                        if need is not None and need > kv_free:
+                            break
+                        kv_free -= need or 0
                     req = self._q.popleft()
                     out.append(req)
                     self._inflight_reqs.append(req)
@@ -1813,6 +1944,24 @@ class ServeFrontend:
         return {"bucket": st.bucket, "slot": st.slot,
                 "iterations": ([st.first_iter, st.last_iter]
                                if st.first_iter is not None else None)}
+
+    def _requeue_head(self, reqs) -> None:
+        """Return popped-but-unadmitted requests to the queue HEAD in
+        their given (arrival) order — the paged-KV defer path: the
+        deferred request and everything popped behind it retry before
+        anything that arrived later, so FIFO holds under block
+        pressure and two defers can never invert each other (the
+        admission loop stops at the first). queue_wait keeps running
+        (admission, not pop, ends it)."""
+        with self._cond:
+            for req in reversed(reqs):
+                try:
+                    self._inflight_reqs.remove(req)
+                except ValueError:
+                    continue       # already answered (a drain raced)
+                self._q.appendleft(req)
+            self._inflight = len(self._inflight_reqs)
+            telemetry.gauge("serve.queue_depth", len(self._q))
 
     def _fail_unadmitted(self, reqs, msg: str) -> None:
         """Answer popped-but-never-admitted requests ``ERR backend``
@@ -1873,6 +2022,20 @@ class ServeFrontend:
             with tc:
                 t_back = time.perf_counter()
                 first, done = sess.prefill(slot, req.toks, req.seq)
+        except kvblocks.KVPoolExhausted:
+            # transient block-pool exhaustion (the gather budget lost a
+            # race it cannot model, e.g. a same-turn batchmate taking
+            # the blocks): the session is OPEN and no device work ran.
+            # Hand the verdict back to the worker loop (_KV_DEFER) —
+            # it requeues this request AND its unadmitted batchmates
+            # at the queue head in arrival order (its queue_wait keeps
+            # running) to retry after retirements return blocks. A
+            # deterministic wait: never an error, never a breaker
+            # count, never a device OOM.
+            health.beat("serve.worker")
+            self._inflight_since = None
+            telemetry.count("serve.kv_defer")
+            return _KV_DEFER
         except Exception as e:
             health.beat("serve.worker")
             self._inflight_since = None
@@ -2057,6 +2220,14 @@ class ServeFrontend:
                 new_slots = []
                 for i, req in enumerate(batch):
                     slot = self._admit_one(sb, sess, active, req)
+                    if slot is _KV_DEFER:
+                        # the pool could not cover this admission (the
+                        # gather budget's rare blind spot): it and its
+                        # unadmitted batchmates go back to the queue
+                        # head in arrival order — nothing popped after
+                        # the deferred request may admit ahead of it
+                        self._requeue_head([req] + list(batch[i + 1:]))
+                        break
                     if slot is not None:
                         new_slots.append(slot)
                     if getattr(sess, "closed", False):

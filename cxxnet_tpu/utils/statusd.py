@@ -486,6 +486,57 @@ def prometheus_metrics(snapshot: dict, progress: Optional[dict] = None,
                    "the transitions)")
         emit("cxxnet_decode_convoys_total", "counter",
              int(batch.get("convoys", 0)))
+        pool = batch.get("pool")
+        if pool is not None:
+            # the paged-KV block pool account (doc/performance.md
+            # "Decode KV cache"): free-list level, block-exact pool
+            # bytes, and the prefix-reuse / copy-on-write lifetime
+            # tallies — absent entirely (not zero) on dense backends,
+            # the absence-is-the-capability-signal discipline
+            emit("cxxnet_decode_kv_block_total", "gauge",
+                 int(pool.get("blocks_total", 0)),
+                 help_="allocatable KV blocks in the paged decode "
+                       "pool (scratch block excluded)")
+            emit("cxxnet_decode_kv_block_free", "gauge",
+                 int(pool.get("blocks_free", 0)))
+            emit("cxxnet_decode_kv_block_used", "gauge",
+                 int(pool.get("blocks_used", 0)))
+            emit("cxxnet_decode_kv_block_tokens", "gauge",
+                 int(pool.get("block_tokens", 0)),
+                 help_="cache rows per KV block (serve_kv_block)")
+            emit("cxxnet_decode_kv_pool_bytes", "gauge",
+                 int(pool.get("pool_bytes", 0)),
+                 help_="the paged pool's real device array nbytes "
+                       "(block-exact: equals cxxnet_decode_kv_bytes "
+                       "under paging)")
+            emit("cxxnet_decode_prefix_queries_total", "counter",
+                 int(pool.get("prefix_queries", 0)),
+                 help_="paged admissions completed (a deferred ask "
+                       "retries and counts once, at success — "
+                       "cxxnet_decode_kv_defers_total counts the "
+                       "defers)")
+            emit("cxxnet_decode_prefix_hits_total", "counter",
+                 int(pool.get("prefix_hits", 0)),
+                 help_="admissions that reused >= 1 resident shared-"
+                       "prefix token (prefilled once, fleet-of-"
+                       "buckets-wide)")
+            emit("cxxnet_decode_prefix_hit_tokens_total", "counter",
+                 int(pool.get("prefix_hit_tokens", 0)))
+            emit("cxxnet_decode_prefix_cow_total", "counter",
+                 int(pool.get("cow_copies", 0)),
+                 help_="copy-on-write block demotions (whole-prompt "
+                       "matches recomputing their last position)")
+            emit("cxxnet_decode_kv_defers_total", "counter",
+                 int(pool.get("alloc_failures", 0)),
+                 help_="admissions deferred on block-pool exhaustion "
+                       "(deterministic queue-wait, never a device "
+                       "OOM)")
+            if _num(pool.get("prefix_hit_rate")):
+                emit("cxxnet_decode_prefix_hit_rate", "gauge",
+                     pool["prefix_hit_rate"],
+                     help_="share of admitted prompt tokens served "
+                           "from resident shared blocks (token-"
+                           "weighted, %)")
     if fleet is not None:
         # the routing fleet (routerd.Router.fleet_snapshot()): per-state
         # counts as one labeled family, per-replica load/liveness rows
@@ -612,6 +663,26 @@ def prometheus_metrics(snapshot: dict, progress: Optional[dict] = None,
                      help_="replicas currently latched in a decode "
                            "convoy (a straggler pinning a full bucket "
                            "while work queues)")
+                pl = dec.get("pool")
+                if pl:
+                    # paged-KV pool federation: block counts summed
+                    # exactly over the paged replicas, fleet prefix
+                    # hit rate recomputed from the token sums
+                    emit("cxxnet_fleet_decode_kv_block_total", "gauge",
+                         int(pl.get("blocks_total", 0)),
+                         help_="paged decode KV blocks summed over "
+                               "the federated replicas")
+                    emit("cxxnet_fleet_decode_kv_block_free", "gauge",
+                         int(pl.get("blocks_free", 0)))
+                    if _num(pl.get("prefix_hit_rate")):
+                        emit("cxxnet_fleet_decode_prefix_hit_rate",
+                             "gauge", pl["prefix_hit_rate"],
+                             help_="fleet share of admitted prompt "
+                                   "tokens served from resident "
+                                   "shared blocks (token-weighted, "
+                                   "%)")
+                    emit("cxxnet_fleet_decode_kv_defers_total",
+                         "counter", int(pl.get("kv_defers", 0)))
         scale = fleet.get("scale")
         if scale:
             # the closed-loop autoscaler's account (routerd
@@ -773,8 +844,9 @@ def fleetz_html(snap: dict) -> str:
                     else ""))
     parts.append("</pre><h2>replicas</h2><pre>")
     cols = ("replica", "state", "hold", "queue", "in_flight",
-            "outstanding", "buckets", "ejections", "probed", "detail")
-    fmt = "%-21s %-12s %-4s %5s %9s %11s %-12s %9s %8s  %s"
+            "outstanding", "buckets", "blocks", "ejections", "probed",
+            "detail")
+    fmt = "%-21s %-12s %-4s %5s %9s %11s %-12s %-9s %9s %8s  %s"
     parts.append(fmt % cols)
     for r in reps:
         age = r.get("last_probe_age_s")
@@ -797,11 +869,17 @@ def fleetz_html(snap: dict) -> str:
             detail = ("OUTLIER (p99 %.1fms vs fleet) " % r["p99_ms"]
                       if r.get("p99_ms") is not None
                       else "OUTLIER ") + detail
+        # paged-KV pool level (ADMIN stats kv_blocks_free/total):
+        # "-" on dense/pre-paging replicas (None in the snapshot —
+        # absence is the capability signal, never rendered as 0/0)
+        blks = ("%s/%s" % (r.get("kv_blocks_free"),
+                           r.get("kv_blocks_total"))
+                if r.get("kv_blocks_total") is not None else "-")
         parts.append(fmt % (
             esc(r.get("name", "?")), esc(r.get("state", "?")),
             "yes" if r.get("hold") else "-", r.get("queue_depth", 0),
             r.get("in_flight", 0), r.get("outstanding", 0),
-            esc(bks), r.get("ejections", 0),
+            esc(bks), esc(blks), r.get("ejections", 0),
             "never" if age is None else "%.1fs" % age,
             esc(detail)))
     parts.append("</pre><h2>router</h2><pre>")
@@ -835,6 +913,17 @@ def fleetz_html(snap: dict) -> str:
                             "  CONVOY on %d replica(s)"
                             % dec["convoy_replicas"]
                             if dec.get("convoy_replicas") else ""))
+            pl = dec.get("pool")
+            if pl:
+                hr = pl.get("prefix_hit_rate")
+                parts.append("paged kv (%d replica(s)): %s/%s blocks "
+                             "free, prefix hit rate %s%%, %s "
+                             "exhaustion defer(s)"
+                             % (pl.get("replicas", 0),
+                                pl.get("blocks_free", 0),
+                                pl.get("blocks_total", 0),
+                                "n/a" if hr is None else "%.1f" % hr,
+                                pl.get("kv_defers", 0)))
     scale = snap.get("scale")
     if scale:
         parts.append("</pre><h2>autoscaler</h2><pre>")
@@ -964,6 +1053,22 @@ def batchz_html(snap: dict) -> str:
                     "n/a" if kv_pct is None else "%.1f" % kv_pct,
                     "" if waste is None
                     else ", %.1f%% slot waste" % waste))
+    pool = snap.get("pool")
+    if pool is not None:
+        hr = pool.get("prefix_hit_rate")
+        parts.append("paged pool: %s/%s blocks free (%s tokens/block, "
+                     "%s MiB pool)   prefix reuse: %s/%s admissions "
+                     "hit, %s%% of prompt tokens resident, %s CoW, "
+                     "%s exhaustion defers"
+                     % (pool.get("blocks_free", 0),
+                        pool.get("blocks_total", 0),
+                        pool.get("block_tokens", 0),
+                        _mib(pool.get("pool_bytes")),
+                        pool.get("prefix_hits", 0),
+                        pool.get("prefix_queries", 0),
+                        "n/a" if hr is None else "%.1f" % hr,
+                        pool.get("cow_copies", 0),
+                        pool.get("alloc_failures", 0)))
     parts.append("convoy: %s (%d episode(s); threshold %d iterations "
                  "pinned with queued work at zero free slots)"
                  % ("ACTIVE" if snap.get("convoy") else "none",
@@ -972,15 +1077,22 @@ def batchz_html(snap: dict) -> str:
     parts.append("</pre><h2>buckets</h2><pre>")
     cols = ("bucket", "warm", "active", "kv MiB", "live MiB", "live%")
     fmt = "%-7s %5s %7s %9s %9s %7s"
+    if pool is not None:
+        cols = cols + ("blocks",)
+        fmt += " %7s"
     parts.append(fmt % cols)
     for b, bs in sorted((snap.get("buckets") or {}).items(),
                         key=lambda kv: int(kv[0])):
         kvb = bs.get("kv_bytes", 0)
-        parts.append(fmt % (
-            esc(str(b)), bs.get("warm", 0), bs.get("active", 0),
-            _mib(kvb), _mib(bs.get("kv_live_bytes", 0)),
-            "%.1f" % (100.0 * bs.get("kv_live_bytes", 0) / kvb)
-            if kvb else "n/a"))
+        row = (esc(str(b)), bs.get("warm", 0), bs.get("active", 0),
+               _mib(kvb), _mib(bs.get("kv_live_bytes", 0)),
+               "%.1f" % (100.0 * bs.get("kv_live_bytes", 0) / kvb)
+               if kvb else "n/a")
+        if pool is not None:
+            # block-table claims: a shared prefix block counts once
+            # per holder, so the column can sum past blocks_used
+            row = row + (bs.get("blocks_held", 0),)
+        parts.append(fmt % row)
     ring = snap.get("flight") or []
     if ring:
         parts.append("</pre><h2>iteration flight ring (newest %d of "
@@ -989,6 +1101,11 @@ def batchz_html(snap: dict) -> str:
         cols = ("iter", "bucket", "occ", "step", "queue", "q_age",
                 "kv_live%", "slots [slot:id@age]")
         ifmt = "%-8s %6s %4s %9s %6s %8s %8s  %s"
+        if pool is not None:
+            # block pressure per iteration: next to the queue columns
+            # it answers "queued because slots or because blocks?"
+            cols = cols[:7] + ("blk_free",) + cols[7:]
+            ifmt = "%-8s %6s %4s %9s %6s %8s %8s %8s  %s"
         parts.append(ifmt % cols)
         for it in ring:
             slots = " ".join("%s:%s@%s" % (r[0], r[1], r[2])
@@ -1005,14 +1122,16 @@ def batchz_html(snap: dict) -> str:
             if extra:
                 slots += "  (" + " ".join(extra) + ")"
             kvp = it.get("kv_live_pct")
-            parts.append(ifmt % (
-                it.get("iter", "?"), it.get("bucket", "?"),
-                it.get("occupancy", 0), _ms(it.get("step_ms")),
-                it.get("queue_depth", 0),
-                _ms(None if it.get("queue_age_s") is None
-                    else it["queue_age_s"] * 1e3),
-                "n/a" if kvp is None else "%.1f" % kvp,
-                esc(slots)))
+            row = (it.get("iter", "?"), it.get("bucket", "?"),
+                   it.get("occupancy", 0), _ms(it.get("step_ms")),
+                   it.get("queue_depth", 0),
+                   _ms(None if it.get("queue_age_s") is None
+                       else it["queue_age_s"] * 1e3),
+                   "n/a" if kvp is None else "%.1f" % kvp)
+            if pool is not None:
+                row = row + ("%s/%s" % (it.get("blocks_free", "?"),
+                                        it.get("blocks_total", "?")),)
+            parts.append(ifmt % (row + (esc(slots),)))
     parts.append("</pre><p>one request's slot-Gantt view: "
                  "<code>/trace?request=&lt;id&gt;</code>; "
                  "<a href='/batchz?json=1'>json</a> "

@@ -284,7 +284,9 @@ def slot_backend(buckets=(1, 2, 4), n_new: int = 4,
                  step_delays=None, explode_on_iterations=(),
                  explode_prefill_for=(), reject_for=(),
                  max_prompt: int = 0, l_max: int = 64,
-                 kv_row_bytes: int = 1024):
+                 kv_row_bytes: int = 1024,
+                 kv_pool_blocks: int = 0, kv_block_tokens: int = 4,
+                 kv_gate: bool = True):
     """Jax-free slot backend for servd's batching dispatcher — the fake
     twin of ``Trainer.decode_session`` (same duck interface: ``buckets``,
     ``session(bucket)``; a session has ``prefill``/``step``/``retire``/
@@ -308,6 +310,17 @@ def slot_backend(buckets=(1, 2, 4), n_new: int = 4,
     validation failure the breaker must ignore.
     ``max_prompt > 0`` arms the ``admits`` compatibility check.
 
+    ``kv_pool_blocks > 0`` arms the PAGED-KV twin: a REAL
+    ``utils.kvblocks.BlockAllocator`` (that module is jax-free — the
+    fake fakes the device, not the allocator) of that many usable
+    blocks x ``kv_block_tokens`` rows backs admission, prefill raises
+    ``KVPoolExhausted`` when the free list cannot cover a request, a
+    retired slot frees its blocks mid-decode, and the backend exposes
+    the production gate/account hooks (``kv_free_blocks`` /
+    ``kv_fresh_blocks`` / ``kv_pool_account``). ``kv_gate=False``
+    disarms the gather-budget hooks (they return None) so the
+    dispatcher's KVPoolExhausted REQUEUE path is what gets exercised.
+
     Every session appends to the shared ``backend.journal``:
     ``("admit", slot, iteration, seq)`` / ``("retire", slot,
     iteration)`` — the mid-decode-join assertions read it.
@@ -325,6 +338,7 @@ def slot_backend(buckets=(1, 2, 4), n_new: int = 4,
             #                      prefill/step closes the session (its
             #                      device state integrity is unknown)
             self._live = {}     # slot -> {"next", "remaining", "first"}
+            self._tickets = {}  # slot -> AdmitTicket (paged twin)
 
         def free_slots(self):
             return [s for s in range(self.nslots) if s not in self._live]
@@ -358,10 +372,22 @@ def slot_backend(buckets=(1, 2, 4), n_new: int = 4,
                 self.closed = True
                 raise RuntimeError("injected prefill explosion (%d)"
                                    % t0)
+            n = ow.long_n_new if t0 in ow.long_for else ow.n_new
+            if ow.alloc is not None:
+                # the paged-KV admission: every block reserved up
+                # front or none (exhaustion defers BEFORE any "device"
+                # work — the session stays open)
+                from cxxnet_tpu.utils.kvblocks import KVPoolExhausted
+                ticket = ow.alloc.admit(toks, n)
+                if ticket is None:
+                    raise KVPoolExhausted(
+                        "fake pool exhausted (%d free)"
+                        % ow.alloc.free_blocks)
+                ow.alloc.register(ticket, toks)
+                self._tickets[slot] = ticket
             if ow.prefill_s:
                 time.sleep(ow.prefill_s)
             telemetry.mark("first_token")
-            n = ow.long_n_new if t0 in ow.long_for else ow.n_new
             self._live[slot] = {"next": t0 + 2, "remaining": n - 1,
                                 "first": t0, "plen": len(toks),
                                 "produced": 0}
@@ -394,10 +420,17 @@ def slot_backend(buckets=(1, 2, 4), n_new: int = 4,
 
         def retire(self, slot):
             self._live.pop(slot, None)
+            t = self._tickets.pop(slot, None)
+            if t is not None:
+                # mid-decode block reclaim: the free list grows NOW
+                self.owner.alloc.free(t.ids)
             self.owner.journal.append(("retire", slot, self.iteration))
 
         def close(self):
             self._live.clear()
+            for t in self._tickets.values():
+                self.owner.alloc.free(t.ids)
+            self._tickets.clear()
             self.closed = True      # releases its (fake) cache bytes:
             #                         kv_account reads 0 from here on
             self.owner.closed += 1
@@ -419,6 +452,36 @@ def slot_backend(buckets=(1, 2, 4), n_new: int = 4,
             self.journal = []
             self.sessions = []
             self.closed = 0
+            self.alloc = None
+            if kv_pool_blocks > 0:
+                from cxxnet_tpu.utils import kvblocks
+                self.alloc = kvblocks.BlockAllocator(
+                    kv_pool_blocks + 1, kv_block_tokens)
+
+        # the production paged-KV hook surface (learn_task adapter
+        # twin): servd's gather loop budgets queue pops against these;
+        # None disarms (dense, or kv_gate=False to force the
+        # KVPoolExhausted requeue path instead)
+        def kv_free_blocks(self):
+            if self.alloc is None or not kv_gate:
+                return None
+            return self.alloc.free_blocks
+
+        def kv_fresh_blocks(self, toks):
+            if self.alloc is None or not kv_gate:
+                return None
+            t0 = int(toks[0])
+            n = self.long_n_new if t0 in self.long_for else self.n_new
+            return self.alloc.fresh_need(len(toks), n, toks)
+
+        def kv_pool_account(self):
+            if self.alloc is None:
+                return None
+            a = self.alloc.account()
+            a["pool_bytes"] = ((self.alloc.blocks)
+                               * self.alloc.bs * self.kv_row_bytes)
+            a["block_bytes"] = self.alloc.bs * self.kv_row_bytes
+            return a
 
         def session(self, bucket):
             s = _Session(self, bucket)

@@ -882,3 +882,185 @@ def test_decode_session_grid_token_exact():
             got = _drive_session(sess, prompts, 30)
             assert got == solo, (kwargs, temp, top_k)
             sess.close()
+
+
+# ----------------------------------------------------------------------
+# paged KV cache (doc/performance.md "Decode KV cache"): block-table
+# sessions over the trainer-wide free-list pool must be token-exact vs
+# the dense session AND solo dispatch — shared-prefix reuse and
+# copy-on-write included — with zero recompiles on a warm bucket, and
+# exhaustion must be a deterministic deferral, never a device fault.
+
+
+def test_decode_session_paged_token_exact_and_prefix_reuse():
+    """Paged == solo, token for token, greedy AND sampled, staggered
+    mid-decode admissions, over prompts that SHARE full-block prefixes
+    (prefill-once reuse) including an identical twin (the
+    copy-on-write demotion case); then a warm re-serve records ZERO
+    compiles — paging must not reintroduce the arXiv:1802.04799
+    per-request compile cliff."""
+    from cxxnet_tpu.utils import telemetry
+    tr = _trained()
+    base = [1, 2, 3, 4]                       # one full block (bs=4)
+    prompts = [base + [5, 6], base + [5, 6],  # identical twin: CoW
+               base + [7], [2, 3, 4, 5, 6, 7], base]
+    n_new = 5
+    pool = tr.decode_kv_pool(4, pool_tokens=3 * SEQ)
+    for temp, top_k in ((0.0, 0), (0.8, 3)):
+        solo = _solo_continuations(tr, prompts, n_new, temp, top_k, 50)
+        sess = tr.decode_session(3, n_new, temperature=temp,
+                                 top_k=top_k, kv_pool=pool)
+        got = _drive_session(sess, prompts, 50)
+        assert got == solo, "paged != solo at temp=%s top_k=%s" \
+            % (temp, top_k)
+        # every retirement returned its blocks (no leak, trie drained)
+        assert pool.alloc.free_blocks == pool.alloc.usable
+        pool.alloc.check()
+        # warm-bucket join through the PAGED programs: nothing compiles
+        tc = telemetry.trace_context("warm-paged-join")
+        with tc:
+            got2 = _drive_session(sess, prompts[:1], 50)
+        assert got2[0] == solo[0]
+        assert tc.compiles == [], tc.compiles
+        sess.close()
+    # the prompt family DID share (prefill-once) and the twin DID
+    # copy-on-write — the reuse the token-exactness claim covers
+    assert pool.alloc.prefix_hits > 0
+    assert pool.alloc.cow_copies > 0
+    tr.release_kv_pool()
+
+
+def test_decode_session_paged_exhaustion_defers_and_retire_reclaims():
+    """Pool exhaustion at admission raises KVPoolExhausted BEFORE any
+    device work with the session left OPEN (servd turns this into a
+    deterministic queue-wait), and a retired slot returns its blocks
+    to the free list MID-DECODE — the reclaim the paged design exists
+    for."""
+    from cxxnet_tpu.nnet.trainer import KVPoolExhausted
+    tr = _trained(steps=2)
+    # the smallest legal pool: one max-length sequence (6 blocks of 4)
+    pool = tr.decode_kv_pool(4, pool_tokens=SEQ, prefix_reuse=False)
+    assert pool.alloc.usable == SEQ // 4
+    sess = tr.decode_session(4, 3, kv_pool=pool)
+    # plen 6 + n_new 3 -> 8 rows -> 2 blocks per sequence
+    for s in range(3):
+        sess.prefill(s, [s + 1, s + 2, s + 3, s + 4, s + 5, s + 6], 7)
+    assert pool.alloc.free_blocks == 0
+    assert not pool.reservable(6, 3)
+    with pytest.raises(KVPoolExhausted):
+        sess.prefill(3, [9, 10, 11, 12, 13, 14], 7)
+    assert not sess.closed            # no device work ran: still open
+    sess.step()                       # ...and decoding continues
+    acct = sess.kv_account()
+    assert acct["paged"] == 1 and acct["blocks_held"] == 6
+    assert acct["kv_bytes"] == 6 * pool.block_bytes
+    sess.retire(0)                    # mid-decode reclaim
+    assert pool.alloc.free_blocks == 2
+    first, _ = sess.prefill(3, [9, 10, 11, 12, 13, 14], 7)
+    # the deferred-then-admitted request decodes exactly like a solo
+    # dispatch (deferral must not perturb the stream)
+    want = tr.generate(np.asarray([[9, 10, 11, 12, 13, 14]]), 3,
+                       seed=7)[0]
+    assert first == want[0]
+    sess.close()
+    assert pool.alloc.free_blocks == pool.alloc.usable
+    pool.alloc.check()
+    tr.release_kv_pool()
+    assert pool.closed and pool.nbytes == 0
+
+
+def test_decode_session_paged_kv_account_pins_pool_nbytes():
+    """The block-exact decode KV account (the PR 13
+    conservative-by-one-session caveat fix): through a batching
+    frontend over the PAGED backend, ``cxxnet_decode_kv_bytes`` (the
+    perf ledger hook) equals the pool arrays' REAL nbytes at all
+    times — free blocks included, because they are allocated HBM —
+    and the cxxnet_decode_kv_block_* series ride the /metrics text."""
+    from cxxnet_tpu.utils import servd, statusd
+    tr = _trained(steps=2)
+
+    class _PagedBackend:
+        buckets = [2]
+
+        def _pool(self):
+            return tr.decode_kv_pool(4)
+
+        def session(self, nslots):
+            return tr.decode_session(nslots, 3, kv_pool=self._pool())
+
+        def kv_pool_account(self):
+            p = getattr(tr, "_kv_pool", None)
+            return p.account() if p is not None and not p.closed \
+                else None
+
+        def kv_free_blocks(self):
+            p = getattr(tr, "_kv_pool", None)
+            return p.alloc.free_blocks \
+                if p is not None and not p.closed else None
+
+        def kv_fresh_blocks(self, toks):
+            p = getattr(tr, "_kv_pool", None)
+            if p is None or p.closed:
+                return None
+            return p.alloc.fresh_need(len(toks), 3, toks)
+
+    fe = servd.ServeFrontend(None, slot_backend=_PagedBackend(),
+                             batch_max=2, drain_ms=8000.0)
+    fe.start()
+    port = fe.listen(0)
+    try:
+        assert servd._ask(port, "1 2 3", timeout=120.0)
+        pool = tr._kv_pool
+        real = sum(int(a.nbytes) for a in pool.pools.values())
+        assert real > 0 and pool.nbytes == real
+        snap = fe.batch_snapshot()
+        assert snap["pool"]["pool_bytes"] == real
+        # THE pin: the HBM-account hook reads the pool's real nbytes —
+        # not a per-session sum, not conservative, EQUAL
+        assert fe.decode_kv_bytes() == real
+        text = statusd.prometheus_metrics(
+            {"process": 0, "uptime_s": 1.0, "counters": {},
+             "gauges": {}, "hists": {}, "compiles": 0,
+             "compile_s": 0.0}, batch=snap)
+        assert ("cxxnet_decode_kv_pool_bytes{process=\"0\"} %d"
+                % real) in text
+        assert "cxxnet_decode_kv_block_total" in text
+        assert "cxxnet_decode_prefix_queries_total" in text
+    finally:
+        fe.drain()
+    tr.release_kv_pool()
+    # released: the account must read 0 the moment the datapath lets go
+    assert tr._kv_pool is None and pool.nbytes == 0
+
+
+@pytest.mark.slow
+def test_decode_session_paged_grid_token_exact():
+    """The paged acceptance grid (the ISSUE pin): paged == solo across
+    greedy / sampled / top_k x ragged shared-family prompt lengths x
+    the learned-pos AND rope+GQA+window AND flash-decode-chunked model
+    variants, all with staggered mid-decode admissions through the
+    shared block pool."""
+    variants = (
+        {},
+        dict(embed_extra="pos_embed = 0",
+             attn_extra="  rope = 1\n  nkvhead = 2\n"
+                        "  attn_window = 8\n"),
+        dict(attn_extra="  decode_chunk = 8\n"),
+    )
+    for kwargs in variants:
+        tr = _trained(**kwargs)
+        rs = np.random.RandomState(9)
+        fam = rs.randint(0, VOCAB, 12).tolist()
+        prompts = [fam[:rs.randint(3, 12)] for _ in range(5)] \
+            + [fam[:8], fam[:8]]              # twins: the CoW case
+        pool = tr.decode_kv_pool(4, pool_tokens=4 * SEQ)
+        for temp, top_k in ((0.0, 0), (1.0, 0), (0.7, 4)):
+            solo = _solo_continuations(tr, prompts, 6, temp, top_k, 30)
+            sess = tr.decode_session(4, 6, temperature=temp,
+                                     top_k=top_k, kv_pool=pool)
+            got = _drive_session(sess, prompts, 30)
+            assert got == solo, (kwargs, temp, top_k)
+            sess.close()
+            pool.alloc.check()
+        assert pool.alloc.prefix_hits > 0
+        tr.release_kv_pool()

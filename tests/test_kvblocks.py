@@ -1,0 +1,221 @@
+"""Free-list KV-block allocator invariants (utils/kvblocks.py) — the
+host half of the paged decode cache (doc/performance.md "Decode KV
+cache"), deliberately jax-free so every allocation-policy invariant is
+testable in milliseconds: alloc/free/refcount bookkeeping, the
+shared-prefix trie, copy-on-write demotion, exhaustion-as-deferral,
+and no-leak accounting after chaos-ordered retire/evict interleavings
+(``BlockAllocator.check()`` is the oracle after every mutation).
+"""
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.utils.kvblocks import BlockAllocator, KVPoolExhausted
+
+
+def test_geometry_and_bounds():
+    a = BlockAllocator(9, 4)                 # 8 usable + scratch 0
+    assert a.usable == 8 and a.free_blocks == 8 and a.used_blocks == 0
+    assert a.bs == 4
+    # rows [0, plen + n_new - 1): the final token's K/V row is never
+    # written (no later step reads it)
+    assert a.blocks_for(1, 1) == 1
+    assert a.blocks_for(4, 1) == 1           # 4 rows, one block
+    assert a.blocks_for(4, 2) == 2           # 5 rows
+    assert a.blocks_for(8, 8) == 4           # 15 rows
+    assert a.fits(8, 8) and a.fits(16, 17)
+    assert not a.fits(17, 17)                # 33 rows > 8 blocks
+    with pytest.raises(ValueError):
+        BlockAllocator(1, 4)                 # no room for scratch
+    with pytest.raises(ValueError):
+        BlockAllocator(4, 0)
+    with pytest.raises(ValueError):
+        a.admit([], 1)                       # empty prompt
+    with pytest.raises(ValueError):
+        a.admit(list(range(33)), 1)          # can never fit: gate bug
+
+
+def test_admit_free_roundtrip_deterministic():
+    a = BlockAllocator(9, 4)
+    t1 = a.admit([1, 2, 3, 4, 5], 4)         # 8 rows -> 2 blocks
+    assert t1.ids == [1, 2] and t1.gather_ids == [1, 2] and t1.p0 == 0
+    assert a.free_blocks == 6 and a.used_blocks == 2
+    t2 = a.admit([9, 9], 3)                   # 4 rows -> 1 block
+    assert t2.ids == [3]
+    a.check()
+    a.free(t1.ids)
+    a.check()
+    assert a.free_blocks == 7
+    # freed ids are reissued deterministically (tail-of-free-list:
+    # most recently freed first, then the untouched ascending range)
+    t3 = a.admit([7] * 12, 1)                 # 12 rows -> 3 blocks
+    assert t3.ids == [2, 1, 4]
+    a.free(t3.ids)
+    a.free(t2.ids)
+    a.check()
+    assert a.free_blocks == a.usable and a.used_blocks == 0
+    with pytest.raises(ValueError):
+        a.free([3])                           # double free
+    with pytest.raises(ValueError):
+        a.free([0])                           # the scratch block
+    with pytest.raises(ValueError):
+        a.free([99])
+
+
+def test_prefix_sharing_refcounts_and_trie_eviction():
+    a = BlockAllocator(17, 4)
+    p = list(range(10))                       # 2 full blocks + tail 2
+    t1 = a.admit(p, 4)
+    assert t1.p0 == 0 and len(t1.ids) == 4    # 13 rows -> 4 blocks
+    # nothing resident until REGISTER (a faulted prefill's blocks must
+    # stay unfindable)
+    assert a.match_prefix(p) == []
+    t2 = a.admit(p, 4)
+    assert t2.p0 == 0 and not set(t1.ids) & set(t2.ids)
+    a.register(t1, p)
+    assert a.match_prefix(p) == t1.ids[:2]
+    # a twin registered under the same content does NOT displace the
+    # resident entry (the existing entry wins)
+    a.register(t2, p)
+    assert a.match_prefix(p) == t1.ids[:2]
+    # third admission SHARES the two full-block prefix ids, computes
+    # only from p0 = 8, and pulls fresh blocks for the rest
+    t3 = a.admit(p, 4)
+    assert t3.p0 == 8
+    assert t3.ids[:2] == t1.ids[:2] and t3.gather_ids == t3.ids
+    assert a.prefix_hits == 1 and a.prefix_hit_tokens == 8
+    a.check()
+    # a longer prompt sharing the first block only
+    q = p[:4] + [11, 12, 13]
+    a.register(t3, p)
+    t4 = a.admit(q, 2)
+    assert t4.p0 == 4 and t4.ids[0] == t1.ids[0]
+    a.check()
+    # refcounted teardown: the shared block stays resident until its
+    # LAST holder frees; reaching zero evicts it from the trie and
+    # returns it to the free list in the same step
+    for t in (t4, t3, t2):
+        a.free(t.ids)
+        a.check()
+    assert a.match_prefix(p) == t1.ids[:2]
+    a.free(t1.ids)
+    a.check()
+    assert a.free_blocks == a.usable
+    assert a.match_prefix(p) == []            # trie fully drained
+
+
+def test_copy_on_write_whole_prompt_match():
+    a = BlockAllocator(9, 4)
+    p = [5, 6, 7, 8]                          # exactly one full block
+    t1 = a.admit(p, 4)
+    a.register(t1, p)
+    # block-aligned FULL coverage: the last prompt position must be
+    # recomputed for its first-token logits, and that write may not
+    # land in the shared block — the last match demotes to a gather
+    # source and a FRESH block becomes the write target
+    t2 = a.admit(p, 4)
+    assert a.cow_copies == 1
+    assert t2.p0 == len(p) - 1                # only the last position
+    assert t2.ids[0] != t1.ids[0]             # fresh write target
+    assert t2.gather_ids[0] == t1.ids[0]      # shared gather source
+    # the demoted source is NOT refcounted by the twin: admit ->
+    # device gather -> register is one synchronous call on the single
+    # mutating owner (nothing can free the source in between), and
+    # after the writeback the twin owns a full private copy
+    assert a._ref[t1.ids[0]] == 1
+    # the CoW twin is NOT re-registered under the same content
+    a.register(t2, p)
+    assert a.match_prefix(p) == [t1.ids[0]]
+    a.free(t2.ids)
+    a.check()
+    assert a._ref[t1.ids[0]] == 1
+    a.free(t1.ids)
+    a.check()
+    assert a.free_blocks == a.usable
+
+
+def test_exhaustion_is_deferral_nothing_moves():
+    a = BlockAllocator(5, 4)                  # 4 usable blocks
+    t1 = a.admit([1] * 8, 5)                  # 12 rows -> 3 blocks
+    before = a.account()
+    assert a.admit([2] * 8, 5) is None        # needs 3, only 1 free
+    after = a.account()
+    before["alloc_failures"] += 1             # the ONLY thing that moved
+    assert after == before
+    a.check()
+    a.free(t1.ids)
+    assert a.admit([2] * 8, 5) is not None    # deferral, not a defect
+    a.check()
+
+
+def test_fresh_need_and_reservable_credit_prefix():
+    a = BlockAllocator(9, 4, prefix_reuse=True)
+    p = list(range(8))
+    assert a.fresh_need(8, 5) == 3            # 12 rows, no residency
+    t1 = a.admit(p, 5)
+    a.register(t1, p)
+    # both full prompt blocks resident — but residency covers the
+    # WHOLE prompt, so the CoW demotion claims one fresh write target
+    # on top of the generation tail; fresh_need must agree with what
+    # admit() actually pulls
+    assert a.fresh_need(8, 5, p) == 2
+    assert a.reservable(8, 5, p)
+    t2 = a.admit(p, 5)
+    assert len(set(t2.ids) - set(t1.ids)) == 2
+    # whole-prompt CoW coverage still needs its fresh write target
+    assert a.fresh_need(8, 1, p) == 1
+    a.check()
+
+
+def test_prefix_reuse_off():
+    a = BlockAllocator(9, 4, prefix_reuse=False)
+    p = list(range(8))
+    t1 = a.admit(p, 2)
+    a.register(t1, p)
+    assert a.match_prefix(p) == []
+    t2 = a.admit(p, 2)
+    assert t2.p0 == 0 and not set(t1.ids) & set(t2.ids)
+    assert a.prefix_hits == 0
+    a.check()
+
+
+def test_chaos_ordered_no_leak():
+    """Random admit/register/free interleavings over shared prompt
+    families — the retire/deadline-evict orderings the dispatcher
+    produces under chaos — hold every structural invariant at every
+    step, and a full drain always returns the pool to pristine."""
+    rs = np.random.RandomState(42)
+    a = BlockAllocator(33, 4)                 # 32 usable
+    families = [list(rs.randint(0, 50, 12)) for _ in range(3)]
+    live = []
+    for step in range(400):
+        if live and (rs.rand() < 0.45 or a.free_blocks < 4):
+            # chaos retire order: never FIFO
+            t, toks = live.pop(rs.randint(len(live)))
+            a.free(t.ids)
+        else:
+            fam = families[rs.randint(len(families))]
+            plen = int(rs.randint(1, len(fam) + 1))
+            toks = fam[:plen]
+            n_new = int(rs.randint(1, 6))
+            t = a.admit(toks, n_new)
+            if t is None:
+                continue                      # deferral, nothing moved
+            if rs.rand() < 0.8:               # a faulted prefill never
+                a.register(t, toks)           # registers
+            live.append((t, toks))
+        a.check()
+    assert a.prefix_hits > 0                  # the families DID share
+    while live:
+        t, _ = live.pop()
+        a.free(t.ids)
+        a.check()
+    acct = a.account()
+    assert acct["blocks_free"] == a.usable and acct["blocks_used"] == 0
+    assert a._trie == {} and a._key_of == {}
+
+
+def test_exhausted_exception_importable_jax_free():
+    # servd catches the paged session's admission exhaustion BY TYPE
+    # from this jax-free module (trainer re-exports it)
+    assert issubclass(KVPoolExhausted, RuntimeError)

@@ -561,3 +561,32 @@ def test_profilez_real_capture_writes_a_loadable_trace(tmp_path):
     for root, _, files in os.walk(path):
         found += files
     assert any(f.endswith(".xplane.pb") for f in found), found
+
+
+def test_decode_pool_cap_bytes_sizes_from_live_account():
+    """The paged decode KV pool's byte budget (ROADMAP item 2: "sized
+    from the live HBM account"): frac x (capacity − peak program
+    footprint), peak taken over the cards measured SO FAR; None when
+    the ledger is off (the pool falls back to dense-equivalent
+    sizing). The decode-KV hook is NOT charged — the pool replaces
+    the dense caches that hook reports (charging them would
+    double-count the bytes being sized)."""
+    lg, reg = make_ledger()          # capacity 8 GiB
+    try:
+        # no cards yet: the whole capacity is headroom
+        assert lg.decode_pool_cap_bytes(0.5) == int(0.5 * 8 * 2.0**30)
+        lg.complete_card("jit.train_step", "s",
+                         mem={"argument_size_in_bytes": 2 * 2**30,
+                              "temp_size_in_bytes": 2**30,
+                              "output_size_in_bytes": 0})
+        assert lg.decode_pool_cap_bytes(0.5) == int(0.5 * 5 * 2.0**30)
+        # a registered decode-KV hook must NOT shrink the budget
+        lg.set_decode_kv(lambda: 10 * 2**30)
+        assert lg.decode_pool_cap_bytes(0.5) == int(0.5 * 5 * 2.0**30)
+        # frac clamps to [0, 1]
+        assert lg.decode_pool_cap_bytes(2.0) == int(5 * 2.0**30)
+        assert lg.decode_pool_cap_bytes(-1.0) == 0
+    finally:
+        lg.disable()
+        reg.disable()
+    assert lg.decode_pool_cap_bytes(0.5) is None    # ledger off

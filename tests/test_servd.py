@@ -1229,10 +1229,26 @@ def test_batch_prefill_failure_closes_session_and_evicts(make_frontend):
     sb = faultinject.slot_backend(buckets=(2,), n_new=3,
                                   per_token_s=0.005,
                                   explode_prefill_for={666})
-    fe = make_frontend(None, slot_backend=sb, batch_max=2,
-                       batch_window_ms=50.0)
-    resps = faultinject.serve_flood(fe.port, ["100", "666"],
-                                    timeout=20.0)
+    # queue BEFORE start(): "100" boards first and "666"'s prefill
+    # fault kills it in the SAME gathered turn. The TCP-flood version
+    # raced arrival order — a fast machine gathered "666" first and
+    # alone, so no admission was ever journaled and there was no
+    # stepped==0 flush to assert on.
+    fe = servd.ServeFrontend(None, slot_backend=sb, batch_max=2,
+                             batch_window_ms=50.0, drain_ms=2000.0)
+    replies = {}
+
+    def mkreply(i):
+        def reply(text):
+            replies.setdefault(i, []).append(text)
+        return reply
+
+    events = [fe.submit("100", mkreply(0)), fe.submit("666", mkreply(1))]
+    fe.start()
+    fe.listen(0)
+    for ev in events:
+        assert ev.wait(20.0), "request never answered"
+    resps = [replies[0][-1], replies[1][-1]]
     assert any(r.startswith("ERR backend") for r in resps), resps
     ok = faultinject.serve_request(fe.port, "200", timeout=20.0)
     assert ok == _expect_line(200, 3)
@@ -2071,3 +2087,124 @@ def test_tenant_slo_isolation(make_frontend):
 
 def test_servd_selftest():
     assert servd.selftest() == 0
+
+
+# -- paged KV block pool: exhaustion is a deterministic queue-wait ----
+# (doc/performance.md "Decode KV cache"; CXXNET_LOCKRANK=1 via the
+# suite's autouse fixture — the admission gate reads the allocator
+# outside servd's locks, and these chaos floods prove no inversion)
+
+
+def test_paged_kv_exhaustion_deterministic_queue_wait(make_frontend):
+    """THE pool-exhaustion acceptance: a flood whose sequences need 2
+    blocks each over a 4-block pool can run at most TWO concurrent
+    sequences however many slots the bucket has — the gather gate
+    defers the rest in FIFO order (deterministic queue-wait: zero
+    lost, zero errors, zero device faults, not one KVPoolExhausted
+    raised), retirements return blocks mid-decode and the queue
+    drains into them, and the /batchz + ADMIN stats + flight-ring
+    block columns publish the pressure."""
+    sb = faultinject.slot_backend(buckets=(4,), n_new=4,
+                                  per_token_s=0.002,
+                                  kv_pool_blocks=4, kv_block_tokens=4)
+    fe = make_frontend(None, slot_backend=sb, batch_max=4,
+                       batch_window_ms=0.0, drain_ms=15000.0)
+    lines = ["%d %d %d %d" % (10 * i, 10 * i + 1, 10 * i + 2,
+                              10 * i + 3) for i in range(1, 9)]
+    resps = faultinject.serve_flood(fe.port, lines, timeout=30.0)
+    for i, r in enumerate(resps):
+        assert r == _expect_line(10 * (i + 1), 4), (i, r)
+    # the gate made exhaustion unreachable: the allocator never even
+    # SAW an over-ask (admissions deferred in the queue instead)
+    assert sb.alloc.alloc_failures == 0
+    assert sb.alloc.free_blocks == sb.alloc.usable
+    sb.alloc.check()
+    # never more concurrent sequences than the pool covers: every
+    # iteration record's occupancy respects the BLOCK bound (2), not
+    # the slot bound (4), and the ring carries the block columns
+    recs = fe.batch_flight.list()
+    assert recs
+    for r in recs:
+        assert r["occupancy"] <= 2, r
+        assert r["blocks_total"] == 4 and 0 <= r["blocks_free"] <= 4
+    snap = fe.batch_snapshot()
+    assert snap["pool"]["blocks_total"] == 4
+    assert snap["pool"]["blocks_free"] == 4
+    st = dict(kv.split("=") for kv in faultinject.serve_request(
+        fe.port, "ADMIN stats", timeout=5.0).split()[1:])
+    assert st["kv_blocks_total"] == "4"
+    assert st["kv_blocks_free"] == "4"
+    stats = fe.drain()
+    assert reconciles(stats)
+    assert stats["accepted"] == stats["served"] == 8
+
+
+def test_paged_kv_exhaustion_requeue_path(make_frontend):
+    """With the gather-budget hooks disarmed (a backend that cannot
+    predict demand), admission reaches the allocator and raises
+    KVPoolExhausted — the dispatcher must REQUEUE to the head (a
+    deterministic retry after the next retirement), never answer ERR,
+    never count a breaker failure, and still serve every request
+    exactly."""
+    sb = faultinject.slot_backend(buckets=(4,), n_new=4,
+                                  per_token_s=0.002,
+                                  kv_pool_blocks=4, kv_block_tokens=4,
+                                  kv_gate=False)
+    fe = make_frontend(None, slot_backend=sb, batch_max=4,
+                       batch_window_ms=0.0, drain_ms=15000.0)
+    lines = ["%d %d %d %d" % (10 * i, 10 * i + 1, 10 * i + 2,
+                              10 * i + 3) for i in range(1, 7)]
+    resps = faultinject.serve_flood(fe.port, lines, timeout=30.0)
+    for i, r in enumerate(resps):
+        assert r == _expect_line(10 * (i + 1), 4), (i, r)
+    # the allocator DID refuse some admissions (the path under test)…
+    assert sb.alloc.alloc_failures > 0
+    # …and every refusal became a requeue: no error class, no breaker
+    # count, no session closed mid-serve, nothing lost
+    assert sb.closed == 0
+    stats = fe.drain()
+    assert reconciles(stats)
+    assert stats["accepted"] == stats["served"] == 6
+    assert stats["errors"] == 0 and stats["shed"] == 0
+    assert sb.alloc.free_blocks == sb.alloc.usable
+    sb.alloc.check()
+
+
+def test_paged_kv_tenant_fair_queue_gate_and_requeue(make_frontend):
+    """Paged KV composes with the PR 12 tenant fair queue: the gather
+    gate budgets the queue's ``peek()`` (the virtual-time head — the
+    fair queue is not subscriptable), and the defer path requeues
+    through its ``appendleft`` (tenant-head insert, stride refunded).
+    Both paths flood two tenants over a pool that can hold only two
+    concurrent sequences: every request serves exactly, zero errors,
+    zero lost, the worker survives, the pool drains back to full."""
+    for gate in (True, False):
+        sb = faultinject.slot_backend(buckets=(4,), n_new=4,
+                                      per_token_s=0.002,
+                                      kv_pool_blocks=4,
+                                      kv_block_tokens=4, kv_gate=gate)
+        fe = make_frontend(None, slot_backend=sb, batch_max=4,
+                           batch_window_ms=0.0, drain_ms=15000.0,
+                           tenants=TEN, tenant_default="victim")
+        lines = ["TENANT %s %d %d %d %d"
+                 % (("noisy", "victim")[i % 2], 10 * i, 10 * i + 1,
+                    10 * i + 2, 10 * i + 3) for i in range(1, 7)]
+        resps = faultinject.serve_flood(fe.port, lines, timeout=30.0)
+        for i, r in enumerate(resps):
+            assert r == _expect_line(10 * (i + 1), 4), (gate, i, r)
+        if gate:
+            # the budgeted gather never over-admits: the allocator
+            # never saw an over-ask even through the fair queue's
+            # virtual-time pop order
+            assert sb.alloc.alloc_failures == 0
+        else:
+            # the allocator DID refuse — every refusal requeued via
+            # _FairQueue.appendleft (the pre-fix AttributeError path)
+            assert sb.alloc.alloc_failures > 0
+        assert sb.closed == 0
+        stats = fe.drain()
+        assert reconciles(stats)
+        assert stats["accepted"] == stats["served"] == 6
+        assert stats["errors"] == 0 and stats["shed"] == 0
+        assert sb.alloc.free_blocks == sb.alloc.usable
+        sb.alloc.check()

@@ -117,7 +117,10 @@ def sub_lower_is_better(key, line):
     if k == "noisy_shed_rate":
         return False
     if k.endswith("_rps") or "tokens_per_s" in k or "occupancy" in k \
-            or k.endswith("_live_pct"):
+            or k.endswith("_live_pct") or k.endswith("hit_rate"):
+        # prefix_hit_rate (the paged-KV shared-prefix reuse share) is
+        # the other rate that is worse when LOWER: a drop means prompt
+        # tokens are being re-prefilled instead of shared
         return False
     if k.endswith("_ms") or "latency" in k or k.endswith("_rate"):
         return True
