@@ -934,8 +934,13 @@ def bench_serve_tenant_isolation():
     victim's p99 (headline, ms — holds while the flood sheds), the
     noisy tenant's shed rate (HIGHER is the fairness actually engaging
     — bench_compare knows this direction), and the autoscaler's
-    scale-up latency (flood start -> standby admitted, driven live by
-    the router's prober loop). Null-safe like every serve row."""
+    scale-up ADMISSION latency (flood start -> standby admitted into
+    rotation, driven live by the router's prober loop). Admission is a
+    stub-side measure: the standby here serves the same warm backend,
+    so "admitted" == "useful". Against a cold real replica it is NOT —
+    the admitted standby still owes its compile grid; the honest
+    admitted->useful gap is what ``serve_scale_up_to_first_token_s``
+    (the cold-start row) measures. Null-safe like every serve row."""
     import threading
     from cxxnet_tpu.models import transformer_lm_trainer
     from cxxnet_tpu.utils import routerd, servd, statusd
@@ -1040,12 +1045,143 @@ def bench_serve_tenant_isolation():
             "noisy_shed_rate": rate(noisy, "shed"),
             "noisy_p99_ms": round(1e3 * percentile(nlats, 99), 3)
             if nlats else None,
-            "fleet_scale_latency_s": round(scale_latency, 3)
+            "fleet_scale_admission_latency_s": round(scale_latency, 3)
             if scale_latency is not None else None,
             "lost": (victim["lost"] if victim else 0)
             + (noisy["lost"] if noisy else 0),
             "victim_requests": victim["sent"] if victim else 0,
             "noisy_requests": noisy["sent"] if noisy else 0}
+
+
+def bench_serve_cold_start():
+    """HONEST cold-start / scale-up / reload latency against a REAL
+    jax replica (doc/performance.md "Compile cliff") — three rows,
+    measured in one run so they share the trainer:
+
+    * ``serve_cold_start_to_ready_s``: trainer construction -> the
+      full expected program grid warm (``ready_pct`` 100 after the
+      warm-up sweep over ``plens``) — what a replica actually owes
+      before it is USEFUL, not merely admitted.
+    * ``serve_scale_up_to_first_token_s``: the first request against
+      the cold replica -> its first token, server-side TTFT from the
+      flight recorder, with the in-band compile stall attributed
+      (``compile_stall_s``) — the admitted->useful gap the
+      tenant-isolation row's ``fleet_scale_admission_latency_s``
+      deliberately does NOT include.
+    * ``serve_reload_capacity_dip``: a steady closed-loop flood with a
+      rolling reload fired mid-flood (``reload_fn`` drops the jit
+      cache, the real model-swap cost) — fractional completions/sec
+      lost in the post-reload window vs the pre-reload window, stalls
+      attributed on the post-reload requests (``reload_stall_s``).
+
+    A PRIVATE perf ledger owns the warm account so programs warmed by
+    earlier bench rows cannot pre-warm the grid (cold start must start
+    at 0%% ready); the shared ledger's recompile hook is re-armed on
+    the way out. Null-safe like every serve row."""
+    from cxxnet_tpu.models import transformer_lm_trainer
+    from cxxnet_tpu.utils import perf, servd
+    from cxxnet_tpu.utils.servd import _ask
+    vocab, L, n_new = 8192, 64, 4
+    plens, bucket = [8, 16], 1
+    shared_was_enabled = perf.enabled()
+    lg = perf.Ledger().enable()
+    fe = None
+    t0 = time.perf_counter()
+    try:
+        tr = transformer_lm_trainer(vocab=vocab, seq=L, batch_size=4,
+                                    dim=128, nhead=4, nlayer=2,
+                                    dev="tpu", extra_cfg=BF16)
+        lg.set_expected_grid(tr.expected_decode_grid([bucket], plens))
+
+        class _Dense:
+            # dense slot backend over the real decode datapath — the
+            # minimal duck interface (buckets + session)
+            buckets = [bucket]
+
+            def session(self, nslots):
+                return tr.decode_session(nslots, n_new)
+
+        def reload_fn():
+            # the real model-swap cost: the decode programs die with
+            # the old params; the warm account resets with them so the
+            # readiness series stays honest through the roll
+            tr._clear_jit_cache()
+            lg.reset()
+            return True
+
+        fe = servd.ServeFrontend(None, slot_backend=_Dense(),
+                                 queue_size=32, batch_max=bucket,
+                                 batch_window_ms=2.0,
+                                 reload_fn=reload_fn)
+        fe.start()
+        fe.set_warm_account(lg.readiness, ready_pct=0.0)
+        port = fe.listen(0)
+        rs = np.random.RandomState(0)
+        lines = [" ".join(map(str, rs.randint(0, vocab, p)))
+                 for p in plens]
+        # warm-up sweep: one request per declared prompt length — the
+        # first pays prefill+admit+step compiles IN-BAND (scale-up to
+        # first token), the rest fill out the prefill grid
+        t_ready = None
+        for ln in lines:
+            _ask(port, ln, timeout=600.0)
+            rd = lg.readiness()
+            if t_ready is None and rd.get("ready_pct") == 100.0:
+                t_ready = time.perf_counter() - t0
+        served = [r for r in fe.flight.list()
+                  if r["outcome"] == "served"]
+        first = served[0] if served else {}
+        rd = lg.readiness()
+        # steady closed-loop flood (batch-1 capacity), rolling reload
+        # fired mid-flood: the dip is completions/sec after vs before
+        nflood, reload_at = 12, 6
+        done_ts, t_r = [], None
+        k0 = len(served)
+        t_flood = time.perf_counter()
+        for i in range(nflood):
+            if i == reload_at:
+                fe.request_reload()
+                t_r = time.perf_counter()
+            _ask(port, lines[0], timeout=600.0)
+            done_ts.append(time.perf_counter())
+        dip = None
+        if t_r is not None and done_ts:
+            w = min(t_r - t_flood, done_ts[-1] - t_r)
+            pre = sum(1 for t in done_ts if t_r - w < t <= t_r)
+            post = sum(1 for t in done_ts if t_r < t <= t_r + w)
+            if pre:
+                dip = round(max(0.0, 1.0 - post / float(pre)), 4)
+        flood_recs = [r for r in fe.flight.list()
+                      if r["outcome"] == "served"][k0 + reload_at:]
+        stalls = [r.get("compile_stall_s") or 0.0 for r in flood_recs]
+        rd_after = lg.readiness()
+        return [
+            {"metric": "serve_cold_start_to_ready_s",
+             "value": round(t_ready, 3) if t_ready is not None
+             else None,
+             "unit": "s", "vs_baseline": None,
+             "ready_programs_pct": rd.get("ready_pct"),
+             "programs_expected": rd.get("expected"),
+             "programs_warm": rd.get("warm")},
+            {"metric": "serve_scale_up_to_first_token_s",
+             "value": round(first["ttft_s"], 3)
+             if first.get("ttft_s") is not None else None,
+             "unit": "s", "vs_baseline": None,
+             "compile_stall_s": first.get("compile_stall_s")},
+            {"metric": "serve_reload_capacity_dip",
+             "value": dip, "unit": "ratio", "vs_baseline": None,
+             "reload_stall_s": round(max(stalls), 6) if stalls
+             else None,
+             "ready_programs_pct": rd_after.get("ready_pct"),
+             "flood_requests": len(done_ts)},
+        ]
+    finally:
+        if fe is not None:
+            fe.drain(timeout_ms=2000)
+        lg.disable()
+        if shared_was_enabled:
+            # give the recompile hook back to the shared ledger
+            perf.enable()
 
 
 def bench_mnist_mlp():
@@ -1385,6 +1521,10 @@ def _bench_main():
                    bench_serve_fleet,
                    bench_serve_tenant_isolation):
             print(json.dumps(_attach_telemetry(fn())), flush=True)
+        # the cold-start family shares one run (one trainer, three
+        # rows) — list-returning, like the pipeline rows below
+        for line in bench_serve_cold_start():
+            print(json.dumps(_attach_telemetry(line)), flush=True)
     if len(sys.argv) > 1 and sys.argv[1] in ("all", "pipeline"):
         lines = bench_alexnet_pipeline()
         if lines:

@@ -301,6 +301,21 @@ class LearnTask:
         # ONE decode_convoy transition event per episode
         self.serve_batch_flight_cap = 256
         self.serve_convoy_iters = 64
+        # compile-cliff observability (doc/observability.md "Compile
+        # flight recorder"): serve_plen_buckets declares the prompt
+        # lengths clients are padded/bucketed to — with serve_buckets
+        # it spans the EXPECTED program grid
+        # (Trainer.expected_decode_grid), arming the warm-grid
+        # readiness account: cxxnet_ready_programs_pct, /compilez,
+        # per-replica warm fraction on /fleetz. Empty = no declared
+        # grid (readiness reads "-" everywhere; compiles still ring).
+        self.serve_plen_buckets = ""
+        # serve_warm_ready_pct > 0 gates readiness on the warm grid:
+        # /healthz answers 503 "warming: ..." (router state WARMING —
+        # probed, never routed) until that percentage of the expected
+        # programs has compiled. 0 (default) keeps a cold replica
+        # routable — it serves, it just pays compile cliffs in-band.
+        self.serve_warm_ready_pct = 0.0
         # serving SLOs + request tracing (doc/observability.md "Request
         # tracing & SLOs"): every request gets a phase-attributed trace
         # in a bounded flight recorder (statusd /trace?request=<id>,
@@ -603,6 +618,10 @@ class LearnTask:
             self.serve_batch_flight_cap = int(val)
         if name == "serve_convoy_iters":
             self.serve_convoy_iters = int(val)
+        if name == "serve_plen_buckets":
+            self.serve_plen_buckets = val
+        if name == "serve_warm_ready_pct":
+            self.serve_warm_ready_pct = float(val)
         if name == "slo_ttft_ms":
             self.slo_ttft_ms = float(val)
         if name == "slo_p99_ms":
@@ -1630,6 +1649,27 @@ class LearnTask:
             # decode KV cache against HBM headroom
             statusd.set_batch(fe)
             perf.set_decode_kv(fe.decode_kv_bytes)
+            plen_list = [int(x) for x in
+                         str(self.serve_plen_buckets)
+                         .replace(",", " ").split()]
+            if plen_list and getattr(self, "_perf_enabled", False):
+                # warm-grid readiness (doc/observability.md "Compile
+                # flight recorder"): declare the expected program grid
+                # on the ledger (serve_buckets x serve_plen_buckets x
+                # admit/step variants), wire the frontend's warm
+                # account to it — cxxnet_ready_programs_pct, the ADMIN
+                # warm_programs/expected_programs ints the router
+                # federates, and (serve_warm_ready_pct > 0) the
+                # "warming" health gate
+                perf.ledger().set_expected_grid(
+                    self.net_trainer.expected_decode_grid(
+                        bucket_list, plen_list,
+                        temperature=self.gen_temperature,
+                        top_k=self.gen_topk,
+                        kv_block=self.serve_kv_block))
+                fe.set_warm_account(
+                    perf.ledger().readiness,
+                    ready_pct=self.serve_warm_ready_pct)
         if self.serve_port >= 0:
             try:
                 port = fe.listen(self.serve_port, host=self.serve_host)

@@ -1874,6 +1874,57 @@ class Trainer:
             p.release()
         self._kv_pool = None
 
+    def expected_decode_grid(self, buckets, plens, temperature:
+                             float = 0.0, top_k: int = 0,
+                             kv_block: int = 0):
+        """Enumerate the EXPECTED serving program grid as ``(key,
+        bucket_label)`` pairs — the jit-cache keys a serving datapath
+        over these ``buckets`` (slot counts) and ``plens`` (declared
+        prompt lengths, ``serve_plen_buckets``) will compile, exactly
+        as ``DecodeSession`` keys them. Feeding the pairs to
+        ``perf.Ledger.set_expected_grid`` turns the compile flight
+        recorder into the warm-grid readiness account (doc/
+        observability.md): warm-vs-expected per bucket,
+        ``cxxnet_ready_programs_pct``, the ``warming`` health state.
+
+        Pure enumeration — no params, no device, no compile. Prefill
+        keys land under the ``"prefill"`` bucket label (they are
+        per-prompt-length, shared by every slot bucket); admit/step
+        keys under their slot count. The paged suffix-prefill reuse
+        variants (``p0 > 0`` — one per observed shared-prefix length)
+        are deliberately NOT enumerated: their population is
+        input-dependent, so they compile lazily and simply do not
+        gate readiness."""
+        temperature, top_k = float(temperature), int(top_k)
+        grid = []
+        for plen in sorted({int(p) for p in plens}):
+            check(plen >= 1, "expected_decode_grid: plen must be >= 1")
+            if kv_block > 0:
+                l_max = self.net_cfg.param.input_shape[2]
+                bs = int(kv_block)
+                check(l_max % bs == 0,
+                      "expected_decode_grid: kv_block %d must divide "
+                      "the net's sequence length %d" % (bs, l_max))
+                grid.append((("sess_prefill_paged", plen, 0,
+                              l_max // bs, bs, temperature, top_k),
+                             "prefill"))
+            else:
+                grid.append((("sess_prefill", plen, temperature,
+                              top_k), "prefill"))
+        for b in sorted({max(1, int(b)) for b in buckets}):
+            if kv_block > 0:
+                l_max = self.net_cfg.param.input_shape[2]
+                bs = int(kv_block)
+                T = l_max // bs
+                grid.append((("sess_admit_paged", b, T), str(b)))
+                grid.append((("sess_step_paged", b, T, bs,
+                              temperature, top_k), str(b)))
+            else:
+                grid.append((("sess_admit", b), str(b)))
+                grid.append((("sess_step", b, temperature, top_k),
+                             str(b)))
+        return grid
+
     def export_decode(self, batch_size: int, prompt_len: int,
                       compat: bool = True):
         """AOT-export the KV-cached decode loop as TWO self-contained

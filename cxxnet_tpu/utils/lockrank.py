@@ -90,6 +90,10 @@ RANKS = {
     #                           (appended outside every servd lock,
     #                           read by statusd /batchz)
     "telemetry.flight": 90,   # FlightRecorder._ring
+    "perf.compiles": 92,    # Ledger._clock — the compile flight ring +
+    #                         warm-grid account (ring append / warm mark
+    #                         under it; the program_compile event — IO —
+    #                         is emitted OUTSIDE it)
     "perf.ledger": 95,      # Ledger._cond — emits program_card events
     #                         and reads registry hists under it
     "telemetry.registry": 100,  # _Registry._lock — innermost by design:

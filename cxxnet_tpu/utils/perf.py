@@ -289,6 +289,12 @@ def footprint_bytes(mem) -> Optional[int]:
     return sum(v or 0 for v in parts)
 
 
+# bound on the compile flight ring (per-compile records with trigger
+# attribution) — sized like telemetry.FlightRecorder's request ring:
+# the full grid of a serving run fits with room for reload rebuilds
+COMPILE_RING_CAP = 256
+
+
 class Ledger:
     """The program performance ledger: cards keyed by (program name,
     shapes hash), completed asynchronously by the carder thread, joined
@@ -296,7 +302,8 @@ class Ledger:
     process (the module singleton); tests build isolated instances
     against private telemetry registries."""
 
-    def __init__(self, registry=None, spec: Optional[DeviceSpec] = None):
+    def __init__(self, registry=None, spec: Optional[DeviceSpec] = None,
+                 compile_ring_cap: int = COMPILE_RING_CAP):
         # ranked between telemetry.flight and telemetry.registry: card
         # completion emits the program_card event under this lock (the
         # SLOTracker precedent — completion order must match log order)
@@ -309,6 +316,18 @@ class Ledger:
         self._jobs: deque = deque()
         self._busy = 0
         self._thread: Optional[threading.Thread] = None
+        # the compile flight recorder (doc/performance.md "Compile
+        # cliff"): a bounded ring of per-compile records with trigger
+        # attribution (which request / dispatcher window paid the
+        # cliff), plus the warm-grid readiness account — the expected
+        # program grid vs the keys compiled so far. One lock guards
+        # both (rank perf.compiles); the program_compile JSONL event is
+        # emitted OUTSIDE it (the IO-outside-the-lock rule).
+        self._clock = lockrank.lock("perf.compiles")
+        self._ring: deque = deque(maxlen=max(1, int(compile_ring_cap)))
+        self._compile_seq = 0
+        self._expected: Dict[str, str] = {}   # key str -> bucket label
+        self._warm: set = set()               # key strs compiled so far
         # set_decode_kv: a callable returning the serving frontend's
         # live decode KV-cache bytes — the decode cache is persistent
         # device state BETWEEN program executions, so the HBM headroom
@@ -355,6 +374,11 @@ class Ledger:
             self._cards.clear()
             del self._order[:]
             self._jobs.clear()
+        with self._clock:
+            self._ring.clear()
+            self._warm.clear()
+            # the expected grid survives: it is conf-derived wiring
+            # (like the compile hook), not per-run measurement state
 
     # -- capture -------------------------------------------------------
     def on_compile(self, name: str, cause: str, seconds: float,
@@ -400,11 +424,102 @@ class Ledger:
                     else:
                         card["status"] = "error"
                         card["error"] = "could not abstract call args"
+            self._record_flight(name, cause, seconds, disp, h, key)
             reg = self._reg()
             reg.count("perf.compile_hooks")
         except Exception:
             reg = self._reg()
             reg.count("perf.capture_errors")
+
+    def _record_flight(self, name, cause, seconds, disp, h, key) -> None:
+        """One compile into the flight ring + the warm-grid account,
+        with trigger attribution: the active trace context (a serving
+        request paying the cliff at prefill) and/or the active compile
+        window (the dispatcher's session-creation / batch-step bracket,
+        a bench phase). Emits the transition-style ``program_compile``
+        JSONL event OUTSIDE the ring lock."""
+        reg = self._reg()
+        tc = reg.current_trace()
+        win = reg.current_compile_window()
+        ks = str(key) if key is not None else None
+        rec = {"name": name, "key": ks, "cause": cause,
+               "shapes": disp, "sig": h,
+               "seconds": round(float(seconds), 6),
+               # the compile STARTED seconds ago (same convention as
+               # the telemetry compile event's ts)
+               "ts": round(reg._ts(time.perf_counter()) - seconds, 6),
+               "trigger_request": tc.request_id if tc is not None
+               else None,
+               "trigger_context": win.label if win is not None else None}
+        with self._clock:
+            self._compile_seq += 1
+            rec["seq"] = self._compile_seq
+            self._ring.append(dict(rec))
+            if ks is not None:
+                self._warm.add(ks)
+            expected = len(self._expected)
+            warm = sum(1 for k in self._expected if k in self._warm)
+        ev = {"ev": "program_compile"}
+        ev.update(rec)
+        if expected:
+            # the readiness transition rides the event: the offline
+            # report replays warm-up as a 0 -> 100 trajectory
+            ev["warm_programs"] = warm
+            ev["expected_programs"] = expected
+            ev["ready_pct"] = round(100.0 * warm / expected, 2)
+        reg.record(ev)
+
+    def recent_compiles(self, n: Optional[int] = None) -> List[dict]:
+        """Newest-first snapshot of the compile flight ring."""
+        with self._clock:
+            out = [dict(r) for r in self._ring]
+        out.reverse()
+        return out[:n] if n else out
+
+    def set_expected_grid(self, entries) -> None:
+        """Register the EXPECTED program grid (the warm-grid readiness
+        denominator): an iterable of ``(key, bucket_label)`` pairs — or
+        bare keys — where ``key`` is the trainer's jit-cache key for a
+        program conf implies will compile (``Trainer.
+        expected_decode_grid`` enumerates the serving grid). Replaces
+        any previous grid; keys are matched by ``str()`` against the
+        keys the recompile detector reports."""
+        exp: Dict[str, str] = {}
+        for e in entries or ():
+            if isinstance(e, (tuple, list)) and len(e) == 2 \
+                    and isinstance(e[1], str):
+                exp[str(e[0])] = e[1]
+            else:
+                exp[str(e)] = ""
+        with self._clock:
+            self._expected = exp
+
+    def readiness(self) -> dict:
+        """The warm-grid account: expected vs warm program counts,
+        headline ``ready_pct`` (None when no grid is registered —
+        absence is the capability signal, like every federation field)
+        and the per-bucket-label breakdown."""
+        with self._clock:
+            exp = dict(self._expected)
+            warm_set = set(self._warm)
+        buckets: Dict[str, dict] = {}
+        warm = 0
+        for k, label in sorted(exp.items()):
+            st = buckets.setdefault(label or "all",
+                                    {"expected": 0, "warm": 0})
+            st["expected"] += 1
+            if k in warm_set:
+                st["warm"] += 1
+                warm += 1
+        for st in buckets.values():
+            st["ready_pct"] = round(100.0 * st["warm"] / st["expected"],
+                                    2)
+        return {"expected": len(exp), "warm": warm,
+                "ready_pct": round(100.0 * warm / len(exp), 2)
+                if exp else None,
+                "cold_keys": sorted(k for k in exp
+                                    if k not in warm_set)[:16],
+                "buckets": buckets}
 
     @staticmethod
     def _new_card(name, h, disp, cause, key) -> dict:
@@ -640,7 +755,11 @@ class Ledger:
                (spec.hbm_capacity - peak - (decode_kv or 0))
                if peak is not None else None}
         return {"spec": spec.to_dict(), "enabled": self.enabled,
-                "cards": cards, "hbm": hbm}
+                "cards": cards, "hbm": hbm,
+                # the warm-grid readiness account (ready_pct None until
+                # an expected grid is registered) — statusd exports it
+                # as cxxnet_ready_programs_pct (+ per-bucket rows)
+                "readiness": self.readiness()}
 
     def decode_pool_cap_bytes(self,
                               frac: float = 0.5) -> Optional[int]:
@@ -927,6 +1046,55 @@ def _selftest_body(verbose: bool = False) -> int:
     cardd = lg.card("jit.decode_step")
     assert cardd["predicted_s"] == 5.0e8 / 500e9
 
+    # compile flight ring: per-compile records with trigger
+    # attribution (a trace context = the request whose prefill
+    # compiled in-band; a compile window = the dispatcher's bracket
+    # around batch-wide work) + the warm-grid readiness account
+    lg.set_expected_grid([(("sess_step", 2, 0.0, 0), "2"),
+                          (("sess_admit", 2), "2"),
+                          (("sess_prefill", 8, 0.0, 0), "prefill")])
+    rd = lg.readiness()
+    assert rd["expected"] == 3 and rd["warm"] == 0 \
+        and rd["ready_pct"] == 0.0, rd
+    # mirror JitWatch's cache-growth sequence: record_compile (feeds
+    # the innermost trace context / every open compile window) then
+    # the supervised ledger hook (feeds the ring)
+    with reg.trace_context("req-7") as tc7:
+        reg.record_compile("jit.decode_prefill", "new_signature", 0.3,
+                           key=("sess_prefill", 8, 0.0, 0))
+        lg.on_compile("jit.decode_prefill", "new_signature", 0.3,
+                      fn=None, args=(_A((1, 8)),),
+                      key=("sess_prefill", 8, 0.0, 0))
+    assert tc7.compiles and tc7.compiles[0]["dur"] == 0.3
+    with reg.compile_window("session:b2") as cwin:
+        reg.record_compile("jit.decode_step", "new_signature", 0.7,
+                           key=("sess_step", 2, 0.0, 0))
+        lg.on_compile("jit.decode_step", "new_signature", 0.7,
+                      fn=None, args=(_A((2, 8)),),
+                      key=("sess_step", 2, 0.0, 0))
+    assert cwin.stall_s == 0.7, cwin.compiles
+    assert reg.current_compile_window() is None
+    recs = lg.recent_compiles(2)          # newest first
+    assert recs[0]["key"] == str(("sess_step", 2, 0.0, 0))
+    assert recs[0]["trigger_context"] == "session:b2" \
+        and recs[0]["trigger_request"] is None, recs[0]
+    assert recs[1]["trigger_request"] == "req-7" \
+        and recs[1]["trigger_context"] is None, recs[1]
+    assert recs[0]["seq"] > recs[1]["seq"] > 0
+    assert recs[0]["seconds"] == 0.7 and recs[0]["shapes"]
+    rd = lg.readiness()
+    assert rd["warm"] == 2 and rd["ready_pct"] == 66.67, rd
+    assert rd["buckets"]["2"] == {"expected": 2, "warm": 1,
+                                  "ready_pct": 50.0}, rd
+    assert rd["buckets"]["prefill"]["ready_pct"] == 100.0
+    assert rd["cold_keys"] == [str(("sess_admit", 2))], rd
+    cevs = [e for e in reg.events()
+            if e.get("ev") == "program_compile"]
+    assert cevs and cevs[-1]["trigger_context"] == "session:b2" \
+        and cevs[-1]["warm_programs"] == 2 \
+        and cevs[-1]["expected_programs"] == 3, cevs[-1]
+    assert lg.snapshot()["readiness"]["ready_pct"] == 66.67
+
     # /programz + /metrics + /profilez over a real socket
     from . import statusd
     srv = statusd.StatusServer(0, host="127.0.0.1", registry=reg).start()
@@ -956,6 +1124,23 @@ def _selftest_body(verbose: bool = False) -> int:
         assert 'cxxnet_program_mfu_pct{process="0",program=' in m
         assert "cxxnet_hbm_peak_bytes" in m
         assert "cxxnet_hbm_headroom_bytes" in m
+        assert "cxxnet_ready_programs_pct" in m
+        assert 'cxxnet_ready_programs_bucket_pct{process="0"' \
+               ',bucket="2"} 50.0' in m
+        # /compilez: the flight ring + readiness render, json contract
+        page = urlopen(base + "/compilez", timeout=5).read().decode()
+        assert "compile flight recorder" in page \
+            and "session:b2" in page and "66.7% ready" in page, page
+        doc = json.loads(urlopen(base + "/compilez?json=1&n=2",
+                                 timeout=5).read())
+        assert doc["shown"] == 2 and doc["total"] >= 4
+        assert doc["readiness"]["ready_pct"] == 66.67
+        assert doc["compiles"][0]["trigger_context"] == "session:b2"
+        try:
+            urlopen(base + "/compilez?n=nope", timeout=5)
+            raise AssertionError("bad n should 400")
+        except HTTPError as e:
+            assert e.code == 400
         # profilez: capture starts, a concurrent second one is refused
         r = urlopen(base + "/profilez?secs=0.5", timeout=5)
         assert r.status == 200 and b"capture_001" in r.read()
@@ -980,13 +1165,20 @@ def _selftest_body(verbose: bool = False) -> int:
             raise AssertionError("no profiler registered should 404")
         except HTTPError as e:
             assert e.code == 404
+        srv.perf = None
+        try:
+            urlopen(base + "/compilez", timeout=5)
+            raise AssertionError("no ledger registered should 404")
+        except HTTPError as e:
+            assert e.code == 404
     finally:
         srv.stop()
         lg.disable()
         reg.disable()
     if verbose:
-        print("perf selftest: card math, MFU/headroom joins, /programz, "
-              "/metrics program series, /profilez guard ok")
+        print("perf selftest: card math, MFU/headroom joins, compile "
+              "ring + readiness, /programz, /compilez, /metrics "
+              "program series, /profilez guard ok")
     return 0
 
 

@@ -181,10 +181,19 @@ from . import telemetry
 
 __all__ = ["Replica", "Router", "parse_replicas", "retryable",
            "route_chrome_trace", "stitched_chrome_trace",
-           "UP", "DRAINING", "BREAKER_OPEN", "DEAD", "selftest"]
+           "UP", "DRAINING", "WARMING", "BREAKER_OPEN", "DEAD",
+           "selftest"]
 
 UP = "up"
 DRAINING = "draining"
+# warming: the replica's /healthz answers 503 "warming: ..." — its
+# warm-grid readiness gate (servd.set_warm_account) is unmet. Probed
+# and ADMIN-answering (the warm fraction keeps refreshing onto
+# /fleetz) but NOT routed; flips to UP by itself once the grid
+# compiles. The autoscaler MAY admit a warming standby — that is
+# exactly the "admitted vs useful" gap the scale-up event's warm_pct
+# field measures.
+WARMING = "warming"
 BREAKER_OPEN = "breaker_open"
 DEAD = "dead"
 
@@ -313,6 +322,7 @@ class Replica:
                  "detail", "hold", "queue_depth", "in_flight",
                  "free_slots", "has_slots", "kv_blocks_total",
                  "kv_blocks_free", "has_kv_blocks",
+                 "warm_programs", "expected_programs", "has_warm",
                  "buckets", "outstanding",
                  "probe_fails", "ejections", "next_probe_at",
                  "last_probe", "no_trace", "trace_ok",
@@ -349,6 +359,13 @@ class Replica:
         self.has_kv_blocks = False   # the same absence-is-the-
         #                              capability-signal discipline as
         #                              free_slots
+        self.warm_programs = 0       # warm-grid readiness from ADMIN
+        self.expected_programs = 0   # stats (compiled vs expected
+        #                              serving programs) — the /fleetz
+        #                              warm column and the scale-up
+        #                              event's warm_pct read these.
+        self.has_warm = False        # absent on replicas with no
+        #                              declared grid: "-", never 0%
         self.buckets = {}            # per-bucket load signal from
         #                              ADMIN stats (bucket.<b>.warm /
         #                              .active): {b: {"warm", "active"}}
@@ -384,6 +401,14 @@ class Replica:
         self.standby = bool(standby)
         self.from_standby = bool(standby)
 
+    def warm_pct(self) -> Optional[float]:
+        """Warm fraction of the replica's expected program grid, or
+        None when it reports no grid (fleet-lock caller)."""
+        if not self.has_warm or self.expected_programs <= 0:
+            return None
+        return round(100.0 * self.warm_programs
+                     / self.expected_programs, 1)
+
     def snapshot(self, now: float) -> dict:
         return {"name": self.name, "state": self.state,
                 "standby": self.standby,
@@ -395,6 +420,11 @@ class Replica:
                 if self.has_kv_blocks else None,
                 "kv_blocks_free": self.kv_blocks_free
                 if self.has_kv_blocks else None,
+                "warm_programs": self.warm_programs
+                if self.has_warm else None,
+                "expected_programs": self.expected_programs
+                if self.has_warm else None,
+                "warm_pct": self.warm_pct(),
                 "buckets": {str(b): dict(d) for b, d
                             in sorted(self.buckets.items())},
                 "outstanding": self.outstanding,
@@ -689,68 +719,88 @@ class Router:
         with self._lock:
             r.last_probe = time.monotonic()
         if code == 200:
-            # load refresh from the replica's own ADMIN stats (the
-            # live queue_depth/in_flight gauges, read under its
-            # admission lock): per-replica-exact even when replicas
-            # share one telemetry registry in-process, and far cheaper
-            # than a /metrics scrape (which runs the replica's whole
-            # probe pass + registry snapshot per poll). The same
-            # gauges ride /metrics for dashboards.
-            st = self._replica_stats(r)
-            if st is not None:
-                with self._lock:
-                    r.queue_depth = st.get("queue_depth",
-                                           r.queue_depth)
-                    r.in_flight = st.get("in_flight", r.in_flight)
-                    # absent on pre-batching replicas: reset to 0, not
-                    # last-known — the field IS the capability signal
-                    r.free_slots = st.get("free_slots", 0)
-                    r.has_slots = "free_slots" in st
-                    # paged-KV pool level: same absent-means-dense
-                    # discipline, and the same defensive parse — a
-                    # foreign replica may emit any value shape, and an
-                    # exception here would kill the prober for good
-                    try:
-                        r.kv_blocks_total = int(
-                            st.get("kv_blocks_total", 0))
-                        r.kv_blocks_free = int(
-                            st.get("kv_blocks_free", 0))
-                    except (TypeError, ValueError):
-                        r.kv_blocks_total = r.kv_blocks_free = 0
-                    r.has_kv_blocks = "kv_blocks_total" in st
-                    # per-bucket warm/active counts (bucket.<b>.warm /
-                    # bucket.<b>.active): the per-bucket load signal —
-                    # wholesale replacement, same absent-means-none
-                    # discipline as free_slots
-                    buckets: Dict[int, dict] = {}
-                    for k, v in st.items():
-                        if not k.startswith("bucket."):
-                            continue
-                        # defensive parse: a foreign/old replica may
-                        # emit any 'bucket.*' shape, and a ValueError
-                        # here would kill the prober thread for good
-                        parts = k.split(".")
-                        if len(parts) != 3 \
-                                or parts[2] not in ("warm", "active",
-                                                    "blocks_held"):
-                            continue
-                        try:
-                            buckets.setdefault(
-                                int(parts[1]), {})[parts[2]] = v
-                        except ValueError:
-                            continue
-                    r.buckets = buckets
+            self._refresh_load(r)
             self._mark(r, UP, "ready")
         else:
             lower = body.lower()
             if "draining" in lower:
                 self._mark(r, DRAINING, body.strip()[:120])
+            elif "warming" in lower:
+                # warm-grid gate unmet (servd.set_warm_account): out
+                # of rotation like breaker_open, but the replica's
+                # ADMIN surface is live — keep refreshing its load and
+                # warm counts so /fleetz shows the warm fraction
+                # CLIMB, not a stale snapshot from admission time
+                self._refresh_load(r)
+                self._mark(r, WARMING, body.strip()[:120])
             else:
                 # breaker open, stalled backend, anomaly: unready for a
                 # cause other than drain — grouped as breaker_open (out
                 # of rotation until a ready probe; statusd reachable,
                 # so no backoff ejection)
                 self._mark(r, BREAKER_OPEN, body.strip()[:120])
+
+    def _refresh_load(self, r: Replica) -> None:
+        """Refresh one replica's load/capability signals from its own
+        ADMIN stats (the live queue_depth/in_flight gauges, read under
+        its admission lock): per-replica-exact even when replicas
+        share one telemetry registry in-process, and far cheaper than
+        a /metrics scrape (which runs the replica's whole probe pass +
+        registry snapshot per poll). The same gauges ride /metrics for
+        dashboards. IO lock-free; the update lands under the fleet
+        lock."""
+        st = self._replica_stats(r)
+        if st is None:
+            return
+        with self._lock:
+            r.queue_depth = st.get("queue_depth", r.queue_depth)
+            r.in_flight = st.get("in_flight", r.in_flight)
+            # absent on pre-batching replicas: reset to 0, not
+            # last-known — the field IS the capability signal
+            r.free_slots = st.get("free_slots", 0)
+            r.has_slots = "free_slots" in st
+            # paged-KV pool level: same absent-means-dense
+            # discipline, and the same defensive parse — a
+            # foreign replica may emit any value shape, and an
+            # exception here would kill the prober for good
+            try:
+                r.kv_blocks_total = int(st.get("kv_blocks_total", 0))
+                r.kv_blocks_free = int(st.get("kv_blocks_free", 0))
+            except (TypeError, ValueError):
+                r.kv_blocks_total = r.kv_blocks_free = 0
+            r.has_kv_blocks = "kv_blocks_total" in st
+            # warm-grid readiness (warm_programs/expected_programs):
+            # the compile-cliff account — absent on replicas with no
+            # declared grid, and the same defensive parse
+            try:
+                r.warm_programs = int(st.get("warm_programs", 0))
+                r.expected_programs = int(
+                    st.get("expected_programs", 0))
+            except (TypeError, ValueError):
+                r.warm_programs = r.expected_programs = 0
+            r.has_warm = "expected_programs" in st
+            # per-bucket warm/active counts (bucket.<b>.warm /
+            # bucket.<b>.active): the per-bucket load signal —
+            # wholesale replacement, same absent-means-none
+            # discipline as free_slots
+            buckets: Dict[int, dict] = {}
+            for k, v in st.items():
+                if not k.startswith("bucket."):
+                    continue
+                # defensive parse: a foreign/old replica may
+                # emit any 'bucket.*' shape, and a ValueError
+                # here would kill the prober thread for good
+                parts = k.split(".")
+                if len(parts) != 3 \
+                        or parts[2] not in ("warm", "active",
+                                            "blocks_held"):
+                    continue
+                try:
+                    buckets.setdefault(
+                        int(parts[1]), {})[parts[2]] = v
+                except ValueError:
+                    continue
+            r.buckets = buckets
 
     def _prober_run(self) -> None:
         # wait FIRST: replicas start optimistic (routable), so the
@@ -1673,12 +1723,16 @@ class Router:
                          and len(active_up) > self.scale_min
                          and not (burning or pressure))
         if want_up:
-            # prefer a standby already probed UP; IO-free — the
-            # admitted replica keeps being probed like any other, and
-            # a dead-on-arrival standby is ejected by the normal
-            # dispatch/probe machinery
+            # prefer a standby already probed UP, then a WARMING one
+            # (admissible — it turns routable by itself once its grid
+            # compiles; the event's warm_pct records how cold it was
+            # at admission); IO-free — the admitted replica keeps
+            # being probed like any other, and a dead-on-arrival
+            # standby is ejected by the normal dispatch/probe
+            # machinery
             pick = next((r for r in standbys if r.state == UP),
-                        standbys[0])
+                        next((r for r in standbys
+                              if r.state == WARMING), standbys[0]))
             reason = ("below scale_min (%d up < %d)"
                       % (len(active_up), self.scale_min)) \
                 if below_min else \
@@ -1708,6 +1762,11 @@ class Router:
         with self._lock:
             r.standby = not up
             active = sum(1 for x in self._replicas if not x.standby)
+            # the replica's warm fraction AT the scale decision: on a
+            # scale-up this is the honest "admitted vs useful" gap —
+            # 0.0 means every program still compiles ahead (the
+            # serve_scale_up_to_first_token_s cost); None = no grid
+            warm_pct = r.warm_pct()
         with self._scale_lock:
             self._scale_last = now
             self._scale_events += 1
@@ -1715,7 +1774,8 @@ class Router:
             self._scale_log.append({"action": "up" if up else "down",
                                     "replica": r.name,
                                     "reason": reason,
-                                    "active": active})
+                                    "active": active,
+                                    "warm_pct": warm_pct})
             if len(self._scale_log) > 64:
                 del self._scale_log[:-64]
         telemetry.count("route.scale_events")
@@ -1723,7 +1783,7 @@ class Router:
         telemetry.event({"ev": "fleet_scale",
                          "action": "up" if up else "down",
                          "replica": r.name, "reason": reason,
-                         "active": active})
+                         "active": active, "warm_pct": warm_pct})
 
     # -- stitched cross-process traces ---------------------------------
     def stitched_trace(self, request_id) -> Optional[dict]:

@@ -486,7 +486,8 @@ class _SlotState:
     its decode with" without the iteration ring)."""
 
     __slots__ = ("req", "tc", "queue_wait", "t_pop", "t_back", "toks",
-                 "occ", "slot", "bucket", "first_iter", "last_iter")
+                 "occ", "slot", "bucket", "first_iter", "last_iter",
+                 "stall_s")
 
     def __init__(self, req, tc, queue_wait, t_pop, t_back, toks, occ,
                  slot, bucket):
@@ -504,6 +505,12 @@ class _SlotState:
         # prefill and never shares a decode pass)
         self.first_iter = None
         self.last_iter = None
+        # compile seconds this request sat through OUTSIDE its own
+        # trace context — batch-wide cliffs (warm-session creation,
+        # the shared decode step) the dispatcher's compile window
+        # attributed to every sequence aboard; its own prefill's
+        # recompiles already land on tc.compiles
+        self.stall_s = 0.0
 
 
 class _FairQueue:
@@ -866,6 +873,12 @@ class ServeFrontend:
         # at interpreter exit would be a silently dropped answer
         self._conn_lock = lockrank.lock("servd.conns")
         self._conns: set = set()
+        # warm-grid readiness account (doc/observability.md "Compile
+        # flight recorder"): a readiness callable (perf.Ledger.readiness
+        # shaped) plus the gate percentage below which health_probe
+        # reports "warming" — unset/0 leaves every path byte-identical
+        self._warm_readiness: Optional[Callable] = None
+        self._warm_ready_pct = 0.0
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> "ServeFrontend":
@@ -1154,17 +1167,55 @@ class ServeFrontend:
             return 0.0
         return time.monotonic() - t0
 
+    def set_warm_account(self, readiness_fn: Callable,
+                         ready_pct: float = 0.0) -> None:
+        """Register the warm-grid readiness account (a zero-arg
+        callable returning ``perf.Ledger.readiness()``-shaped dicts)
+        and, optionally, the gate: with ``ready_pct > 0`` the health
+        probe reports ``warming`` (503, router state WARMING — probed
+        but not routed) until at least that percentage of the expected
+        program grid has compiled. 0 keeps the replica routable while
+        cold — it serves, it just pays cliffs — but the ADMIN
+        ``warm_programs``/``expected_programs`` ints still federate."""
+        self._warm_readiness = readiness_fn
+        self._warm_ready_pct = float(ready_pct)
+
+    def warm_programs(self) -> Optional[Tuple[int, int, float]]:
+        """``(warm, expected, ready_pct)`` from the registered warm
+        account, or None when there is no account / no expected grid —
+        absence is the capability signal (ADMIN omits the keys, the
+        fleet table shows "-")."""
+        fn = self._warm_readiness
+        if fn is None:
+            return None
+        try:
+            rd = fn() or {}
+        except Exception:
+            return None
+        if rd.get("ready_pct") is None:
+            return None
+        return (int(rd.get("warm", 0)), int(rd.get("expected", 0)),
+                float(rd["ready_pct"]))
+
     def health_probe(self) -> Tuple[bool, str]:
         """Readiness: NOT ready while draining, while the circuit
         breaker is anything but closed (open, or a half-open probe still
-        unresolved), or while the current dispatch has been stuck inside
-        the backend past ``stall_after_s`` — the "don't route traffic
-        here" signal."""
+        unresolved), while the warm-grid gate (``set_warm_account``) is
+        armed and unmet, or while the current dispatch has been stuck
+        inside the backend past ``stall_after_s`` — the "don't route
+        traffic here" signal."""
         if self._draining:
             return False, "draining: not accepting new requests"
         st = self.breaker.state
         if st != "closed":
             return False, "circuit breaker %s" % self.breaker.describe()
+        if self._warm_ready_pct > 0:
+            wp = self.warm_programs()
+            if wp is not None and wp[2] < self._warm_ready_pct:
+                return False, ("warming: %d/%d programs compiled "
+                               "(%.1f%% ready, gate %.0f%%)"
+                               % (wp[0], wp[1], wp[2],
+                                  self._warm_ready_pct))
         stalled = self._stalled_for()
         if self.stall_after_s > 0 and stalled > self.stall_after_s:
             return False, ("backend stalled: request in flight for "
@@ -1410,6 +1461,16 @@ class ServeFrontend:
                                     ps.get("blocks_total", 0)
                                 live["kv_blocks_free"] = \
                                     ps.get("blocks_free", 0)
+                        wp = self.warm_programs()
+                        if wp is not None:
+                            # warm-grid readiness (the compile-cliff
+                            # account): compiled vs expected serving
+                            # programs — the router federates these
+                            # onto /fleetz as the warm fraction, and
+                            # absence (no registered grid) is the
+                            # capability signal
+                            live["warm_programs"] = wp[0]
+                            live["expected_programs"] = wp[1]
                         text = "OK " + " ".join(
                             "%s=%d" % kv for kv in sorted(live.items()))
                     else:
@@ -1943,7 +2004,8 @@ class ServeFrontend:
         self._turn_retired.append([st.req.id, st.slot])
         return {"bucket": st.bucket, "slot": st.slot,
                 "iterations": ([st.first_iter, st.last_iter]
-                               if st.first_iter is not None else None)}
+                               if st.first_iter is not None else None),
+                "stall_s": round(st.stall_s, 6)}
 
     def _requeue_head(self, reqs) -> None:
         """Return popped-but-unadmitted requests to the queue HEAD in
@@ -1974,7 +2036,8 @@ class ServeFrontend:
                                 None, now - req.t_arrival, t_pop,
                                 t_pop, 0)
 
-    def _admit_one(self, sb, sess, active, req: _Request):
+    def _admit_one(self, sb, sess, active, req: _Request,
+                   stall0: float = 0.0):
         """Admit one popped request into a free slot of ``sess`` (its
         ``queue_wait`` ends HERE — slot admission, not queue pop): the
         solo dispatch-time gates first (expired deadline, breaker,
@@ -2062,6 +2125,11 @@ class ServeFrontend:
         st = _SlotState(req, tc, queue_wait, t_pop, t_back,
                         [int(first)], len(active) + 1, slot,
                         sess.nslots)
+        # seed the batch-level stall this request already paid BEFORE
+        # its slot existed (the turn's warm-session creation) — set
+        # before the done-at-prefill early completion below so an
+        # n_new == 1 request carries it too
+        st.stall_s = float(stall0)
         active[slot] = st
         self._turn_admitted.append([req.id, slot])
         if done:
@@ -2187,6 +2255,7 @@ class ServeFrontend:
                 continue
             # --- admit: coalesce queued requests into free slots ---
             if not self._reload_flag:
+                sess_stall = 0.0
                 if not active:
                     batch = self._gather(cap, fresh=True)
                     if batch:
@@ -2196,7 +2265,17 @@ class ServeFrontend:
                         sess = sessions.get(b)
                         if sess is None:
                             try:
-                                sess = sessions[b] = sb.session(b)
+                                # warm-session creation compiles the
+                                # bucket's admit/step programs OUTSIDE
+                                # any request's trace context: the
+                                # compile window attributes the cliff
+                                # to every request admitted this turn
+                                # (compile_stall_s on their flight
+                                # records)
+                                with telemetry.compile_window(
+                                        "session:b%d" % b) as cw:
+                                    sess = sessions[b] = sb.session(b)
+                                sess_stall = cw.stall_s
                             except Exception as e:
                                 # the batch never reached a slot: every
                                 # drained request is answered, the
@@ -2219,7 +2298,8 @@ class ServeFrontend:
                 leftovers = []
                 new_slots = []
                 for i, req in enumerate(batch):
-                    slot = self._admit_one(sb, sess, active, req)
+                    slot = self._admit_one(sb, sess, active, req,
+                                           stall0=sess_stall)
                     if slot is _KV_DEFER:
                         # the pool could not cover this admission (the
                         # gather budget's rare blind spot): it and its
@@ -2306,11 +2386,20 @@ class ServeFrontend:
             health.pause("serve.worker")   # a fresh bucket may compile
             t_step = time.perf_counter()
             try:
-                res = sess.step()
+                # the decode step runs with NO trace context (it is
+                # batch-wide work): the compile window catches a
+                # first-step cliff and the dispatcher fans it out to
+                # every sequence that sat through it
+                with telemetry.compile_window(
+                        "step:b%d" % bucket) as cw:
+                    res = sess.step()
             except Exception as e:
                 step_s = time.perf_counter() - t_step
                 health.beat("serve.worker")
                 self._inflight_since = None
+                if cw.stall_s:
+                    for st in active.values():
+                        st.stall_s += cw.stall_s
                 self._fail_batch(sess, active, e)
                 # the session's state is suspect: drop it from the pool
                 sessions = {b: s for b, s in sessions.items()
@@ -2327,6 +2416,9 @@ class ServeFrontend:
             step_s = time.perf_counter() - t_step
             health.beat("serve.worker")
             self._inflight_since = None
+            if cw.stall_s:
+                for st in active.values():
+                    st.stall_s += cw.stall_s
             for slot, tok, done in res:
                 st = active.get(slot)
                 if st is None:
@@ -2420,6 +2512,17 @@ class ServeFrontend:
                           "prefill": round(prefill, 6),
                           "decode": round(decode, 6)},
                "recompiles": list(tc.compiles) if tc is not None else []}
+        # compile seconds this request paid: its OWN prefill's
+        # recompiles (tc.compiles) plus the batch-wide cliffs the
+        # dispatcher's compile window attributed to its slot
+        # (warm-session creation, a first decode step) — exactly 0.0
+        # for a request riding warm programs, so TTFT decomposes into
+        # "queued" vs "paying the cliff" honestly
+        stall = sum(c["dur"] for c in tc.compiles) \
+            if tc is not None else 0.0
+        if batch is not None:
+            stall += batch.get("stall_s") or 0.0
+        rec["compile_stall_s"] = round(stall, 6)
         if occupancy is not None:
             # sequences sharing the decode pass when this request was
             # admitted to its slot (itself included): /trace and
@@ -2446,7 +2549,8 @@ class ServeFrontend:
         ev = {"ev": "serve_request_done", "req": req.id,
               "outcome": outcome, "tokens": ntok,
               "total_s": rec["total_s"],
-              "recompiles": len(rec["recompiles"])}
+              "recompiles": len(rec["recompiles"]),
+              "compile_stall_s": rec["compile_stall_s"]}
         if req.tenant is not None:
             ev["tenant"] = req.tenant
         for ph, v in rec["phases"].items():

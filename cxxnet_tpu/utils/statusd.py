@@ -448,6 +448,28 @@ def prometheus_metrics(snapshot: dict, progress: Optional[dict] = None,
                     '%s{process="%s",program="%s",shapes="%s"} %s'
                     % (mname, _lesc(p), _lesc(c.get("name", "?")),
                        _lesc(c.get("sig", "?")), _fmt(c[field])))
+        rd = perf.get("readiness") or {}
+        if rd.get("ready_pct") is not None:
+            # warm-grid readiness: absent entirely when no expected
+            # program grid was registered (serve-only wiring) — the
+            # absence-is-capability-signal convention
+            emit("cxxnet_ready_programs_pct", "gauge", rd["ready_pct"],
+                 help_="compiled fraction of the expected serving "
+                       "program grid; below 100 the replica is still "
+                       "paying compile cliffs on first hits")
+            emit("cxxnet_expected_programs", "gauge",
+                 int(rd.get("expected", 0)))
+            emit("cxxnet_warm_programs", "gauge", int(rd.get("warm", 0)))
+            bks = rd.get("buckets") or {}
+            if bks:
+                out.append("# TYPE cxxnet_ready_programs_bucket_pct "
+                           "gauge")
+                for b in sorted(bks):
+                    out.append(
+                        'cxxnet_ready_programs_bucket_pct{process="%s"'
+                        ',bucket="%s"} %s'
+                        % (_lesc(p), _lesc(str(b)),
+                           _fmt(bks[b].get("ready_pct", 0.0))))
     if batch is not None:
         # the decode-datapath observability account
         # (servd.ServeFrontend.batch_snapshot()): the live KV/HBM
@@ -584,6 +606,22 @@ def prometheus_metrics(snapshot: dict, progress: Optional[dict] = None,
                            % (mname, _lesc(p),
                               _lesc(r.get("name", "?")),
                               _fmt(get(r))))
+        # warm-grid readiness per replica: only rows for replicas
+        # that declare a grid (absence is the capability signal —
+        # a missing row, never a lying 0)
+        wreps = [r for r in reps if r.get("warm_pct") is not None]
+        if wreps:
+            out.append("# HELP cxxnet_fleet_replica_warm_pct compiled "
+                       "fraction of the replica's expected serving "
+                       "program grid (ADMIN warm_programs/"
+                       "expected_programs)")
+            out.append("# TYPE cxxnet_fleet_replica_warm_pct gauge")
+            for r in wreps:
+                out.append(
+                    'cxxnet_fleet_replica_warm_pct{process="%s"'
+                    ',replica="%s"} %s'
+                    % (_lesc(p), _lesc(r.get("name", "?")),
+                       _fmt(r["warm_pct"])))
         fed = fleet.get("federation")
         if fed:
             # the federated fleet account (routerd.federation_snapshot)
@@ -827,6 +865,62 @@ def programz_html(snap: dict) -> str:
     return "\n".join(parts)
 
 
+def compilez_html(body: dict) -> str:
+    """Render the compile flight recorder as the /compilez page: the
+    warm-grid readiness account, then one row per recorded compile
+    (newest first) with its trigger attribution — which request /
+    dispatcher window paid the cliff. Pure function of the
+    ``{"compiles", "total", "shown", "readiness"}`` body the handler
+    builds — the perf selftest and tests validate it socket-free."""
+    esc = html.escape
+    rd = body.get("readiness") or {}
+    parts = ["<html><head><title>cxxnet compilez</title></head>"
+             "<body><h1>compile flight recorder</h1><pre>"]
+    pct = rd.get("ready_pct")
+    if pct is None:
+        parts.append("warm grid: no expected program grid registered "
+                     "(serve-only; learn_task wires it from "
+                     "serve_buckets/serve_plen_buckets)")
+    else:
+        parts.append("warm grid: %d/%d programs compiled (%.1f%% ready)"
+                     % (rd.get("warm", 0), rd.get("expected", 0), pct))
+        for b, st in sorted((rd.get("buckets") or {}).items()):
+            parts.append("  bucket %-10s %d/%d (%.1f%%)"
+                         % (esc(str(b)), st.get("warm", 0),
+                            st.get("expected", 0),
+                            st.get("ready_pct", 0.0)))
+        cold = rd.get("cold_keys") or []
+        if cold:
+            parts.append("  cold: " + " ".join(esc(k) for k in cold))
+    parts.append("</pre><h2>compiles (%d shown of %d recorded)</h2><pre>"
+                 % (body.get("shown", 0), body.get("total", 0)))
+    cols = ("seq", "ts", "program", "cause", "seconds", "trigger",
+            "key")
+    fmt = "%5s %9s %-18s %-19s %8s %-24s %s"
+    parts.append(fmt % cols)
+    for r in body.get("compiles") or []:
+        trig = r.get("trigger_request") or r.get("trigger_context") \
+            or "-"
+        parts.append(fmt % (
+            r.get("seq", "?"),
+            "%.2f" % r["ts"] if r.get("ts") is not None else "n/a",
+            esc(str(r.get("name", "?"))), esc(str(r.get("cause", "?"))),
+            "%.3f" % r.get("seconds", 0.0), esc(str(trig)),
+            esc(str(r.get("key") or r.get("shapes") or "?"))))
+    if not body.get("compiles"):
+        parts.append("(no compiles recorded since the ledger was "
+                     "enabled)")
+    parts.append("</pre><p>trigger = the request id (prefill paid the "
+                 "cliff inside that request) or the dispatcher window "
+                 "(session:/step: — every request aboard the batch "
+                 "stalled; their flight records carry it as "
+                 "compile_stall_s); "
+                 "<a href='/compilez?json=1'>json</a> "
+                 "<a href='/programz'>programz</a> "
+                 "<a href='/statusz'>statusz</a></p></body></html>")
+    return "\n".join(parts)
+
+
 def fleetz_html(snap: dict) -> str:
     """Render a ``routerd.Router.fleet_snapshot()`` as the /fleetz
     page: one row per replica (state machine + load + ejection
@@ -844,9 +938,9 @@ def fleetz_html(snap: dict) -> str:
                     else ""))
     parts.append("</pre><h2>replicas</h2><pre>")
     cols = ("replica", "state", "hold", "queue", "in_flight",
-            "outstanding", "buckets", "blocks", "ejections", "probed",
-            "detail")
-    fmt = "%-21s %-12s %-4s %5s %9s %11s %-12s %-9s %9s %8s  %s"
+            "outstanding", "buckets", "blocks", "warm", "ejections",
+            "probed", "detail")
+    fmt = "%-21s %-12s %-4s %5s %9s %11s %-12s %-9s %-9s %9s %8s  %s"
     parts.append(fmt % cols)
     for r in reps:
         age = r.get("last_probe_age_s")
@@ -875,11 +969,19 @@ def fleetz_html(snap: dict) -> str:
         blks = ("%s/%s" % (r.get("kv_blocks_free"),
                            r.get("kv_blocks_total"))
                 if r.get("kv_blocks_total") is not None else "-")
+        # warm-grid readiness (ADMIN stats warm_programs/
+        # expected_programs): compiled fraction of the replica's
+        # expected program grid — "-" when it declares no grid (None
+        # in the snapshot; absence is the capability signal)
+        warm = ("%.0f%% (%s/%s)" % (r["warm_pct"],
+                                    r.get("warm_programs"),
+                                    r.get("expected_programs"))
+                if r.get("warm_pct") is not None else "-")
         parts.append(fmt % (
             esc(r.get("name", "?")), esc(r.get("state", "?")),
             "yes" if r.get("hold") else "-", r.get("queue_depth", 0),
             r.get("in_flight", 0), r.get("outstanding", 0),
-            esc(bks), esc(blks), r.get("ejections", 0),
+            esc(bks), esc(blks), esc(warm), r.get("ejections", 0),
             "never" if age is None else "%.1fs" % age,
             esc(detail)))
     parts.append("</pre><h2>router</h2><pre>")
@@ -938,10 +1040,16 @@ def fleetz_html(snap: dict) -> str:
                         scale.get("down_idle_s", 0.0),
                         scale.get("cooldown_s", 0.0)))
         for ev in scale.get("recent") or []:
-            parts.append("%-4s %-21s -> %d active  (%s)"
+            # warm_pct: the replica's compiled fraction at the scale
+            # decision — a 0% scale-up is "admitted but paying every
+            # compile cliff ahead" (serve_scale_up_to_first_token_s)
+            wp = ev.get("warm_pct")
+            parts.append("%-4s %-21s -> %d active%s  (%s)"
                          % (esc(ev.get("action", "?")),
                             esc(ev.get("replica", "?")),
                             ev.get("active", 0),
+                            "" if wp is None
+                            else ", %.0f%% warm" % wp,
                             esc(ev.get("reason", ""))))
     tenants = snap.get("tenants")
     if tenants:
@@ -1349,6 +1457,34 @@ class _Endpoint(BaseHTTPRequestHandler):
                     else:
                         self._reply(200, "text/html; charset=utf-8",
                                     programz_html(snap).encode("utf-8"))
+            elif path == "/compilez":
+                lg = srv.perf
+                if lg is None:
+                    self._reply(404, "text/plain; charset=utf-8",
+                                b"no performance ledger registered "
+                                b"(perf_ledger=0?)\n")
+                else:
+                    q = parse_qs(query)
+                    try:
+                        # ?n=<k>: compile-ring rows shown (default 64)
+                        n = int((q.get("n") or ["64"])[0])
+                    except ValueError:
+                        self._reply(400, "text/plain; charset=utf-8",
+                                    b"n must be an integer\n")
+                        return
+                    recs = lg.recent_compiles()
+                    total = len(recs)
+                    if n > 0:
+                        recs = recs[:n]
+                    body = {"compiles": recs, "total": total,
+                            "shown": len(recs),
+                            "readiness": lg.readiness()}
+                    if q.get("json"):
+                        self._reply(200, "application/json",
+                                    json.dumps(body).encode("utf-8"))
+                    else:
+                        self._reply(200, "text/html; charset=utf-8",
+                                    compilez_html(body).encode("utf-8"))
             elif path == "/fleetz":
                 fl = srv.fleet
                 if fl is None:
@@ -1403,7 +1539,8 @@ class _Endpoint(BaseHTTPRequestHandler):
                 self._reply(404, "text/plain; charset=utf-8",
                             b"not found; endpoints: /metrics /healthz "
                             b"/livez /statusz /trace /requestz "
-                            b"/programz /profilez /fleetz /batchz\n")
+                            b"/programz /compilez /profilez /fleetz "
+                            b"/batchz\n")
         except Exception as e:    # a broken probe must not kill the server
             try:
                 self._reply(500, "text/plain; charset=utf-8",

@@ -93,6 +93,7 @@ __all__ = [
     "Histogram", "HIST_BUCKETS", "trace_context", "current_trace", "mark",
     "declare_hist", "TraceContext", "FlightRecorder",
     "request_chrome_trace", "REQUEST_PHASES",
+    "CompileWindow", "compile_window", "current_compile_window",
 ]
 
 # per-span-name duration history kept for live percentiles (the JSONL log
@@ -285,6 +286,40 @@ class TraceContext:
         self.marks[name] = time.perf_counter()
 
 
+class CompileWindow:
+    """Collects the compile records observed on this thread while
+    active — the batching dispatcher's stall-attribution bracket around
+    work that runs OUTSIDE any request's trace context (warm-session
+    creation, the batch-wide decode step): a compile inside the window
+    stalled every request aboard the batch, so the dispatcher fans
+    ``window.compiles`` out to their flight records as
+    ``compile_stall_s``. Like TraceContext it works with telemetry
+    DISABLED (thread-local append, no sink needed) and nests — every
+    active window on the thread sees the compile. The label also rides
+    the perf ledger's compile flight ring as the trigger context."""
+
+    __slots__ = ("reg", "label", "compiles")
+
+    def __init__(self, reg: "_Registry", label):
+        self.reg = reg
+        self.label = str(label)
+        self.compiles: List[dict] = []
+
+    def __enter__(self) -> "CompileWindow":
+        self.reg._win_stack().append(self)
+        return self
+
+    def __exit__(self, *exc):
+        stack = self.reg._win_stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        return False
+
+    @property
+    def stall_s(self) -> float:
+        return round(sum(c["dur"] for c in self.compiles), 6)
+
+
 class _Registry:
     """The process-wide telemetry state. Use the module-level functions;
     the class exists so tests can build isolated instances."""
@@ -386,11 +421,24 @@ class _Registry:
             s = self._tls.ctx = []
         return s
 
+    def _win_stack(self) -> list:
+        s = getattr(self._tls, "win", None)
+        if s is None:
+            s = self._tls.win = []
+        return s
+
     def trace_context(self, request_id) -> TraceContext:
         return TraceContext(self, request_id)
 
     def current_trace(self) -> Optional[TraceContext]:
         s = getattr(self._tls, "ctx", None)
+        return s[-1] if s else None
+
+    def compile_window(self, label) -> CompileWindow:
+        return CompileWindow(self, label)
+
+    def current_compile_window(self) -> Optional[CompileWindow]:
+        s = getattr(self._tls, "win", None)
         return s[-1] if s else None
 
     def mark(self, name: str) -> None:
@@ -532,6 +580,17 @@ class _Registry:
                 entry["off"] = round(
                     time.perf_counter() - seconds - tc.t0, 6)
             tc.compiles.append(entry)
+        wins = getattr(self._tls, "win", None)
+        if wins:
+            # every active compile window on the thread sees the
+            # compile — the batching dispatcher's batch-wide stall
+            # attribution (a step compile stalls ALL slots aboard)
+            wentry = {"name": name, "cause": cause,
+                      "dur": round(seconds, 6)}
+            if key is not None:
+                wentry["key"] = str(key)
+            for w in wins:
+                w.compiles.append(dict(wentry))
         if not self.enabled:
             return
         ev = {"ev": "compile", "name": name, "cause": cause,
@@ -984,10 +1043,12 @@ class JitWatch:
     def __call__(self, *args, **kwargs):
         reg = self._reg
         if not reg.enabled and reg.current_trace() is None \
+                and reg.current_compile_window() is None \
                 and reg.compile_hook is None:
-            # an active trace context wants its recompiles attributed
-            # (the flight recorder works with telemetry disabled too),
-            # and the perf ledger wants its cards either way
+            # an active trace context or compile window wants its
+            # recompiles attributed (the flight recorder works with
+            # telemetry disabled too), and the perf ledger wants its
+            # cards either way
             return self._fn(*args, **kwargs)
         try:
             before = self._fn._cache_size()
@@ -1076,6 +1137,17 @@ def trace_context(request_id) -> TraceContext:
 
 def current_trace() -> Optional[TraceContext]:
     return _REG.current_trace()
+
+
+def compile_window(label) -> CompileWindow:
+    """A stall-attribution bracket for work outside any request's trace
+    context (``with compile_window("step:b4") as w:`` — then read
+    ``w.compiles`` / ``w.stall_s``). Works with telemetry disabled."""
+    return _REG.compile_window(label)
+
+
+def current_compile_window() -> Optional[CompileWindow]:
+    return _REG.current_compile_window()
 
 
 def mark(name: str) -> None:

@@ -286,7 +286,7 @@ def slot_backend(buckets=(1, 2, 4), n_new: int = 4,
                  max_prompt: int = 0, l_max: int = 64,
                  kv_row_bytes: int = 1024,
                  kv_pool_blocks: int = 0, kv_block_tokens: int = 4,
-                 kv_gate: bool = True):
+                 kv_gate: bool = True, compile_ms: float = 0.0):
     """Jax-free slot backend for servd's batching dispatcher — the fake
     twin of ``Trainer.decode_session`` (same duck interface: ``buckets``,
     ``session(bucket)``; a session has ``prefill``/``step``/``retire``/
@@ -309,6 +309,19 @@ def slot_backend(buckets=(1, 2, 4), n_new: int = 4,
     ``reject_for`` raises WITHOUT closing — the pre-dispatch
     validation failure the breaker must ignore.
     ``max_prompt > 0`` arms the ``admits`` compatibility check.
+
+    ``compile_ms > 0`` arms the COMPILE-CLIFF twin: the first time a
+    program shape is seen (per-plen prefill, per-bucket admit/step —
+    the backend-wide ``compiled`` set plays the jit cache, shared
+    across sessions like the real one) the call sleeps ``compile_ms``
+    and replays JitWatch's cache-growth sequence —
+    ``telemetry.record_compile`` (trace-context / compile-window
+    attribution) then the supervised perf-ledger ``compile_hook``
+    (compile ring + warm-grid account) — with the trainer's real key
+    shapes (``("sess_prefill", plen, 0.0, 0)`` etc., temperature 0 /
+    top_k 0) so ``Trainer.expected_decode_grid``-shaped warm grids
+    match. The stall-attribution and readiness suites stay jax-free
+    and deterministic.
 
     ``kv_pool_blocks > 0`` arms the PAGED-KV twin: a REAL
     ``utils.kvblocks.BlockAllocator`` (that module is jax-free — the
@@ -385,6 +398,11 @@ def slot_backend(buckets=(1, 2, 4), n_new: int = 4,
                         % ow.alloc.free_blocks)
                 ow.alloc.register(ticket, toks)
                 self._tickets[slot] = ticket
+            # the prefill-shaped cliffs fire under the caller's trace
+            # context (servd holds the request tc here), like real jax
+            ow._compile("jit.decode_prefill",
+                        ("sess_prefill", len(toks), 0.0, 0))
+            ow._compile("jit.decode_admit", ("sess_admit", self.nslots))
             if ow.prefill_s:
                 time.sleep(ow.prefill_s)
             telemetry.mark("first_token")
@@ -402,6 +420,10 @@ def slot_backend(buckets=(1, 2, 4), n_new: int = 4,
             if self.iteration in ow.explode_on:
                 raise RuntimeError("injected step explosion (iteration "
                                    "%d)" % self.iteration)
+            # the step-shaped cliff fires inside servd's step compile
+            # window (batch-wide attribution), like real jax
+            ow._compile("jit.decode_step", ("sess_step", self.nslots,
+                                            0.0, 0))
             delay = ow.per_token_s + sum(
                 ow.step_delays.get(st["first"], 0.0)
                 for st in self._live.values())
@@ -452,11 +474,33 @@ def slot_backend(buckets=(1, 2, 4), n_new: int = 4,
             self.journal = []
             self.sessions = []
             self.closed = 0
+            self.compile_s = float(compile_ms) / 1e3
+            self.compiled = set()  # the fake jit cache: first hit per
+            #                        key pays the (simulated) cliff
             self.alloc = None
             if kv_pool_blocks > 0:
                 from cxxnet_tpu.utils import kvblocks
                 self.alloc = kvblocks.BlockAllocator(
                     kv_pool_blocks + 1, kv_block_tokens)
+
+        def _compile(self, name, key):
+            # first-hit compile cliff: sleep the stall, then replay
+            # JitWatch's exact sequence — record_compile feeds any open
+            # trace context / compile window, the supervised hook feeds
+            # the perf ledger's ring + warm-grid account
+            if not self.compile_s or key in self.compiled:
+                return
+            self.compiled.add(key)
+            time.sleep(self.compile_s)
+            telemetry.record_compile(name, "new_signature",
+                                     self.compile_s, key=key)
+            hook = telemetry._REG.compile_hook
+            if hook is not None:
+                try:
+                    hook(name, "new_signature", self.compile_s,
+                         fn=None, args=(), kwargs={}, key=key)
+                except Exception:
+                    pass
 
         # the production paged-KV hook surface (learn_task adapter
         # twin): servd's gather loop budgets queue pops against these;

@@ -800,7 +800,7 @@ def test_per_tenant_federation_series_and_slo():
 
 def test_bench_compare_tenant_subfield_directions(tmp_path):
     """Direction-aware gating for the serve_tenant_isolation row:
-    victim_p99_ms and fleet_scale_latency_s gate worse-when-HIGHER,
+    victim_p99_ms and fleet_scale_admission_latency_s gate worse-when-HIGHER,
     noisy_shed_rate worse-when-LOWER (a drop means the flood got
     through)."""
     import subprocess
@@ -809,13 +809,13 @@ def test_bench_compare_tenant_subfield_directions(tmp_path):
     bench.write_text(json.dumps({
         "metric": "serve_tenant_isolation", "value": 50.0,
         "unit": "ms", "victim_p99_ms": 50.0, "noisy_shed_rate": 0.2,
-        "fleet_scale_latency_s": 2.0}) + "\n")
+        "fleet_scale_admission_latency_s": 2.0}) + "\n")
     base = tmp_path / "BASELINE.json"
     base.write_text(json.dumps({"published": {
         "serve_tenant_isolation": 50.0,
         "serve_tenant_isolation.victim_p99_ms": 25.0,
         "serve_tenant_isolation.noisy_shed_rate": 0.9,
-        "serve_tenant_isolation.fleet_scale_latency_s": 0.5}}))
+        "serve_tenant_isolation.fleet_scale_admission_latency_s": 0.5}}))
     proc = subprocess.run(
         [sys.executable, "tools/bench_compare.py", "--bench",
          str(bench), "--baseline", str(base)],
@@ -825,12 +825,12 @@ def test_bench_compare_tenant_subfield_directions(tmp_path):
     # all three regressed in their own direction
     assert out.count("REGRESSION") == 3, out
     assert "victim_p99_ms" in out and "noisy_shed_rate" in out \
-        and "fleet_scale_latency_s" in out
+        and "fleet_scale_admission_latency_s" in out
     # and the good direction passes: higher shed rate, lower latency
     bench.write_text(json.dumps({
         "metric": "serve_tenant_isolation", "value": 50.0,
         "unit": "ms", "victim_p99_ms": 20.0, "noisy_shed_rate": 0.95,
-        "fleet_scale_latency_s": 0.3}) + "\n")
+        "fleet_scale_admission_latency_s": 0.3}) + "\n")
     proc = subprocess.run(
         [sys.executable, "tools/bench_compare.py", "--bench",
          str(bench), "--baseline", str(base)],

@@ -89,8 +89,15 @@ def extract_lines(doc, raw_text=""):
 
 
 def lower_is_better(line):
-    return (line.get("unit") == "ms"
-            or "latency" in str(line.get("metric", "")))
+    m = str(line.get("metric", ""))
+    # the cold-start family (serve_cold_start_to_ready_s /
+    # serve_scale_up_to_first_token_s / serve_reload_capacity_dip):
+    # seconds-to-useful and capacity lost to recompiles — worse when
+    # HIGHER even though the unit is s / ratio, not ms
+    if (m.endswith("_to_ready_s") or m.endswith("_to_first_token_s")
+            or m.endswith("_capacity_dip")):
+        return True
+    return line.get("unit") == "ms" or "latency" in m
 
 
 def sub_lower_is_better(key, line):
@@ -105,8 +112,8 @@ def sub_lower_is_better(key, line):
     serve_tenant_isolation row) is the one rate that is worse when
     LOWER: it measures the weighted-fair policy actually shedding the
     flooding tenant — a drop means the flood is getting through to the
-    victim. (``fleet_scale_latency_s`` needs no special case: the
-    ``latency`` rule already gates it as worse-when-higher.)
+    victim. (``fleet_scale_admission_latency_s`` needs no special
+    case: the ``latency`` rule already gates it as worse-when-higher.)
     Utilization sub-fields (``*_live_pct`` — kv_live_pct on the
     throughput row: the live share of the decode KV cache) are worse
     when LOWER too: a drop means more padding/dead-slot waste, the
@@ -114,6 +121,16 @@ def sub_lower_is_better(key, line):
     watches. (``queue_age_p99_ms`` needs no special case: the
     ``*_ms`` rule already gates it as worse-when-higher.)"""
     k = str(key)
+    if (k.endswith("_to_ready_s") or k.endswith("_to_first_token_s")
+            or k.endswith("_capacity_dip")):
+        # the cold-start family as sub-fields: same direction as the
+        # headline rule — time-to-useful and recompile capacity loss
+        # are worse when HIGHER whatever the parent row measures
+        return True
+    if "ready_programs_pct" in k:
+        # warm-grid readiness (the compile-cliff account): a drop means
+        # more of the program grid is cold at admission — worse LOWER
+        return False
     if k == "noisy_shed_rate":
         return False
     if k.endswith("_rps") or "tokens_per_s" in k or "occupancy" in k \

@@ -180,6 +180,7 @@ def merge_fleet_shards(shard_events):
 def aggregate(events):
     spans = {}
     compiles = []
+    program_compiles = []
     counters_by_p = {}
     hists_by_p = {}
     gauges = {}
@@ -215,6 +216,11 @@ def aggregate(events):
             sp[1] += float(ev.get("dur", 0.0))
         elif kind == "compile":
             compiles.append(ev)
+            proc(ev)
+        elif kind == "program_compile":
+            # the perf ledger's compile flight record (one per program
+            # the warm grid learns about): carries the readiness climb
+            program_compiles.append(ev)
             proc(ev)
         elif kind == "gauge":
             gauges[ev["name"]] = ev.get("value")
@@ -596,6 +602,22 @@ def aggregate(events):
         "total_s": round(sum(float(c.get("dur", 0.0)) for c in compiles), 6),
         "by_cause": count_by(compiles, "cause"),
     }
+    if program_compiles:
+        # the compile-cliff section (doc/performance.md "Compile
+        # cliff"): the warm-grid readiness climb across the run plus
+        # the requests that paid a cliff in-band — events arrive in
+        # emission order, so first/last bracket the climb
+        pc = program_compiles
+        out["compile_cliff"] = {
+            "count": len(pc),
+            "total_s": round(sum(float(c.get("seconds") or 0.0)
+                                 for c in pc), 6),
+            "ready_pct_first": pc[0].get("ready_pct"),
+            "ready_pct_last": pc[-1].get("ready_pct"),
+            "by_name": count_by(pc, "name"),
+            "stalled_requests": sorted(
+                {str(c["req"]) for c in pc if c.get("req")}),
+        }
     if len(procs) > 1:
         out["processes"] = {}
         for p in sorted(procs):
@@ -648,6 +670,20 @@ def print_report(agg, top=15):
     print("count: %d   total: %.2fs" % (comp["count"], comp["total_s"]))
     for cause, n in sorted(comp["by_cause"].items()):
         print("  %-24s %d" % (cause, n))
+    cliff = agg.get("compile_cliff")
+    if cliff:
+        print("\n== compile cliff (warm-grid readiness climb) ==")
+        print("programs: %d   total: %.2fs   ready: %s%% -> %s%%"
+              % (cliff["count"], cliff["total_s"],
+                 "?" if cliff["ready_pct_first"] is None
+                 else cliff["ready_pct_first"],
+                 "?" if cliff["ready_pct_last"] is None
+                 else cliff["ready_pct_last"]))
+        for name, n in sorted(cliff["by_name"].items()):
+            print("  %-24s %d" % (name, n))
+        if cliff["stalled_requests"]:
+            print("  stalled requests: %s"
+                  % ", ".join(cliff["stalled_requests"][:16]))
     step = spans.get("train.step")
     if step:
         print("\n== step-time percentiles (train.step dispatch) ==")
