@@ -1053,6 +1053,153 @@ def bench_serve_tenant_isolation():
             "noisy_requests": noisy["sent"] if noisy else 0}
 
 
+def bench_serve_chaos_availability():
+    """Availability through a SIGKILL: a 3-replica batched fleet
+    (``servd --stub`` subprocesses — a kill must take a PROCESS, and
+    the row grades the router's failover datapath, which is
+    model-free: replay/hedge correctness against the real decode
+    backend is tests/test_failover.py's job) floods through the
+    router with deterministic replay on, one replica is SIGKILLed
+    mid-flood with requests decoding aboard its batch, and the row
+    reports the fraction of flood requests answered OK (headline,
+    pct — bench_compare gates it worse-when-LOWER) plus the failover
+    engagement sub-fields: error_rate, replays (a drop to zero means
+    the failover path stopped firing), and the p99 of requests issued
+    inside the kill window next to the overall p99. Null-safe like
+    every serve row."""
+    import threading
+    from cxxnet_tpu.utils import routerd
+    from cxxnet_tpu.utils.telemetry import percentile
+    from tests import faultinject
+    fleet = faultinject.spawn_fleet(3, batch_max=4, n_new=8,
+                                    per_token_ms=10)
+    router = routerd.Router([r.spec for r in fleet], probe_ms=100.0,
+                            retries=2, stall_s=2.0,
+                            probe_backoff_cap_s=0.5)
+    router.start()
+    rport = router.listen(0)
+    router.probe_now()
+    flood_s, kill_at, kill_win = 3.0, 0.8, 1.0
+    lock = threading.Lock()
+    samples = []                     # (t_issue_rel, latency_s, ok)
+    t0 = time.perf_counter()
+    stop_at = t0 + flood_s
+    faultinject.kill9(fleet[0], delay_s=kill_at)
+
+    def client(i):
+        while time.perf_counter() < stop_at:
+            t1 = time.perf_counter()
+            try:
+                resp = faultinject.serve_request(rport, "%d" % (10 + i),
+                                                 timeout=10)
+            except OSError:
+                resp = None
+            ok = bool(resp) and not resp.startswith("ERR")
+            with lock:
+                samples.append((t1 - t0, time.perf_counter() - t1, ok))
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    rstats = router.drain()
+    faultinject.stop_fleet(fleet)
+    sent = len(samples)
+    lats = sorted(dt for _, dt, ok in samples if ok)
+    kill_lats = sorted(dt for ti, dt, ok in samples
+                       if ok and kill_at <= ti < kill_at + kill_win)
+    nok = len(lats)
+    return {"metric": "serve_chaos_availability",
+            "value": round(100.0 * nok / sent, 3) if sent else None,
+            "unit": "pct", "vs_baseline": None,
+            "error_rate": round((sent - nok) / float(sent), 4)
+            if sent else None,
+            "replays": rstats.get("replays", 0),
+            "lost_contact": rstats.get("lost_contact", 0),
+            "p99_ms": round(1e3 * percentile(lats, 99), 3) if lats
+            else None,
+            "kill_window_p99_ms": round(1e3 * percentile(kill_lats,
+                                                         99), 3)
+            if kill_lats else None,
+            "replicas": len(fleet), "requests": sent}
+
+
+def bench_serve_hedged_tail():
+    """What tail hedging buys: a 2-replica fleet with one deliberate
+    straggler (``servd --stub`` subprocesses, one at ``--delay-ms
+    200`` — stub-based for the same reason as the chaos row: the
+    hedge race is router-layer, model-free), flooded twice with the
+    SAME client schedule — hedging off, then ``route_hedge_ms = 40``.
+    Headline: the hedged p99 (ms, worse-when-HIGHER as usual);
+    ``p99_unhedged_ms`` rides along as the honest before, and
+    ``hedges`` / ``hedge_wins`` gate worse-when-LOWER (zero means the
+    hedge lane stopped engaging and the headline quietly became the
+    unhedged tail). Null-safe like every serve row."""
+    import threading
+    from cxxnet_tpu.utils import routerd
+    from cxxnet_tpu.utils.telemetry import percentile
+    from tests import faultinject
+
+    def flood(rport, n=40, nclients=4):
+        lats, lock = [], threading.Lock()
+
+        def client(k):
+            for j in range(n // nclients):
+                t1 = time.perf_counter()
+                try:
+                    resp = faultinject.serve_request(
+                        rport, "%d" % (10 + k + j), timeout=10)
+                except OSError:
+                    resp = None
+                if resp and not resp.startswith("ERR"):
+                    with lock:
+                        lats.append(time.perf_counter() - t1)
+
+        ths = [threading.Thread(target=client, args=(k,))
+               for k in range(nclients)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        return sorted(lats)
+
+    out = {"unhedged": None, "hedged": None, "stats": {}}
+    for mode, hedge_ms in (("unhedged", 0.0), ("hedged", 40.0)):
+        a = faultinject._start_stub(delay_ms=200.0)
+        b = faultinject._start_stub()
+        procs = []
+        for proc, args in (a, b):
+            port, sp = faultinject._await_ports(proc)
+            r = faultinject.FleetReplica(proc, port, sp, args)
+            procs.append(r)
+        router = routerd.Router([r.spec for r in procs],
+                                probe_ms=100.0, retries=1,
+                                hedge_ms=hedge_ms)
+        router.start()
+        rport = router.listen(0)
+        router.probe_now()
+        out[mode] = flood(rport)
+        st = router.drain()
+        if mode == "hedged":
+            out["stats"] = st
+        faultinject.stop_fleet(procs)
+    hl, ul, st = out["hedged"], out["unhedged"], out["stats"]
+    return {"metric": "serve_hedged_tail",
+            "value": round(1e3 * percentile(hl, 99), 3) if hl
+            else None,
+            "unit": "ms", "vs_baseline": None,
+            "p99_unhedged_ms": round(1e3 * percentile(ul, 99), 3)
+            if ul else None,
+            "p50_ms": round(1e3 * percentile(hl, 50), 3) if hl
+            else None,
+            "hedges": st.get("hedges", 0),
+            "hedge_wins": st.get("hedge_wins", 0),
+            "discarded_late": st.get("discarded_late", 0),
+            "requests": len(hl) + len(ul)}
+
+
 def bench_serve_cold_start():
     """HONEST cold-start / scale-up / reload latency against a REAL
     jax replica (doc/performance.md "Compile cliff") — three rows,
@@ -1519,7 +1666,9 @@ def _bench_main():
                    bench_lm_decode_b1_chunked, bench_serve_load,
                    bench_serve_throughput, bench_serve_prefix_reuse,
                    bench_serve_fleet,
-                   bench_serve_tenant_isolation):
+                   bench_serve_tenant_isolation,
+                   bench_serve_chaos_availability,
+                   bench_serve_hedged_tail):
             print(json.dumps(_attach_telemetry(fn())), flush=True)
         # the cold-start family shares one run (one trainer, three
         # rows) — list-returning, like the pipeline rows below

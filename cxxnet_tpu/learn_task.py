@@ -374,6 +374,18 @@ class LearnTask:
         # prefix-less clients are the serve_tenant_default tenant.
         self.route_tenants = ""
         self.serve_tenant_default = "default"
+        # zero-loss failover (doc/robustness.md "Failover & hedging"):
+        # route_replay re-executes a lost-contact generation attempt on
+        # a surviving replica (deterministic stack: token-identical;
+        # guarded by the replica reload count so a replay never splices
+        # model generations); route_hedge_ms launches one duplicate of
+        # a still-unanswered request after that many ms (-1 = track the
+        # federated serve p99, 0 = off), first answer wins, capped at
+        # route_hedge_max_pct of in-flight and denied to tenants over
+        # fair share.
+        self.route_replay = 1
+        self.route_hedge_ms = 0.0
+        self.route_hedge_max_pct = 10.0
         self.gen_new = 16
         self.gen_temperature = 0.0
         self.gen_topk = 0
@@ -662,6 +674,12 @@ class LearnTask:
             self.route_tenants = val
         if name == "serve_tenant_default":
             self.serve_tenant_default = val
+        if name == "route_replay":
+            self.route_replay = int(val)
+        if name == "route_hedge_ms":
+            self.route_hedge_ms = float(val)
+        if name == "route_hedge_max_pct":
+            self.route_hedge_max_pct = float(val)
         if name == "fleet_federate_ms":
             self.fleet_federate_ms = float(val)
         if name == "fleet_outlier_ratio":
@@ -1796,6 +1814,9 @@ class LearnTask:
             scale_cooldown_s=self.route_scale_cooldown_s,
             tenants=self.route_tenants,
             tenant_default=self.serve_tenant_default,
+            replay=bool(self.route_replay),
+            hedge_ms=self.route_hedge_ms,
+            hedge_max_pct=self.route_hedge_max_pct,
             # the router's own per-tenant windows (door sheds): same
             # objectives as the replicas', merged into the federated
             # per-tenant burn account

@@ -55,11 +55,43 @@ proves the request never dispatched:
     ERR draining server ...      never         mark draining + retry
     ERR draining shutdown ...    never         mark draining + retry
     ERR draining backend ...     MAYBE         relay (no retry)
+    ERR backend rescued ...      yes, rescued  REPLAY elsewhere (the
+                                               replica evicted a wedged
+                                               batch: no answer left it)
     ERR backend ...              yes           relay (no retry)
     ERR parse / empty / deadline deterministic relay (no retry)
     connect refused              never         mark dead + retry
-    sent, then no response       MAYBE         mark dead-suspect, relay
-                                               ERR backend (no retry)
+    sent, then no response       MAYBE         REPLAY elsewhere (close
+                                               the old socket, reap+
+                                               discard a late answer)
+
+**Deterministic replay failover.** Generation in this stack is a pure
+function of (prompt, seed, model version) — re-executing a lost
+request on a survivor is idempotent at the token level, so ``lost``
+is a RECOVERABLE outcome: the attempt is re-executed on a different
+replica (``route.replays``), spending from the same parsed deadline
+budget, with the original socket handed to a reaper so a late answer
+is read, counted (``route.discarded_late``) and dropped — the client
+can never see two answers. Replay is gated three ways: it is denied
+to a tenant over its weighted fair share (``route.replay_denied`` —
+a flood must not double itself through failover), it never applies
+to ``ADMIN``/reload traffic (those bypass ``_route`` entirely), and
+a model-generation guard refuses to splice answers across a
+mid-replay weight push: the replay carries the lost replica's last
+advertised reload count (``ADMIN stats`` ``reloads``) and is denied
+when the survivor's differs (``ERR backend generation ...``).
+``route_replay = 0`` restores the old relay-an-error behavior.
+
+**Tail hedging.** After ``route_hedge_ms`` (or, at ``-1``, the live
+federated serve p99) a still-unanswered first attempt launches ONE
+duplicate on another replica (``route.hedges``); the first served
+answer wins (``route.hedge_wins``), the loser's answer is read and
+discarded (``route.discarded_late``) — determinism means both
+answers are identical when both arrive (``route.hedge_mismatch``
+counts any divergence). Hedges are capped at ``route_hedge_max_pct``
+of the requests in flight and denied to over-share tenants; a hedge
+never worsens an outcome (an ERR from the hedge lane is only used
+when the primary also failed).
 
 Retries respect the client's remaining ``DEADLINE`` budget: the router
 parses the bound once at accept, and every forward carries the budget
@@ -208,6 +240,16 @@ _COUNTERS = {
     "admin": "route.admin",
     "retries": "route.retries",
     "client_gone": "route.client_gone",
+    # failover account (module docstring "Deterministic replay
+    # failover" / "Tail hedging"): OUTSIDE the reconciling subset like
+    # retries — these count extra ATTEMPTS and discarded duplicate
+    # answers; the client request is charged exactly once above
+    "lost_contact": "route.lost_contact",
+    "replays": "route.replays",
+    "replay_denied": "route.replay_denied",
+    "hedges": "route.hedges",
+    "hedge_wins": "route.hedge_wins",
+    "discarded_late": "route.discarded_late",
 }
 # the per-tenant reconciling subset — ONE definition with servd (the
 # shared-parser discipline: router and replica books must never
@@ -323,7 +365,7 @@ class Replica:
                  "free_slots", "has_slots", "kv_blocks_total",
                  "kv_blocks_free", "has_kv_blocks",
                  "warm_programs", "expected_programs", "has_warm",
-                 "buckets", "outstanding",
+                 "buckets", "outstanding", "reloads", "lost",
                  "probe_fails", "ejections", "next_probe_at",
                  "last_probe", "no_trace", "trace_ok",
                  "no_tenant", "tenant_ok", "standby", "from_standby")
@@ -373,6 +415,15 @@ class Replica:
         #                              disaggregated scheduling will
         #                              route on; empty pre-batching
         self.outstanding = 0         # router-side live request count
+        self.reloads = -1            # model generation: the replica's
+        #                              ADMIN stats reload count, -1
+        #                              until probed — the replay
+        #                              failover's generation guard
+        #                              compares the lost replica's
+        #                              against the survivor's
+        self.lost = 0                # lost-contact attempts charged to
+        #                              this replica (the /fleetz
+        #                              failover column)
         self.probe_fails = 0
         self.ejections = 0           # backoff exponent while dead
         self.next_probe_at = 0.0     # monotonic; dead replicas re-probe
@@ -428,6 +479,8 @@ class Replica:
                 "buckets": {str(b): dict(d) for b, d
                             in sorted(self.buckets.items())},
                 "outstanding": self.outstanding,
+                "reloads": self.reloads if self.reloads >= 0 else None,
+                "lost": self.lost,
                 "ejections": self.ejections,
                 "probe_fails": self.probe_fails,
                 "last_probe_age_s": None if self.last_probe is None
@@ -462,7 +515,10 @@ class Router:
                  scale_down_idle_s: float = 30.0,
                  scale_cooldown_s: float = 10.0,
                  tenants=None, tenant_default: str = "default",
-                 slo_tenants=None):
+                 slo_tenants=None,
+                 replay: bool = True,
+                 hedge_ms: float = 0.0,
+                 hedge_max_pct: float = 10.0):
         specs = parse_replicas(replicas)
         if not specs:
             raise ValueError("router needs at least one replica")
@@ -547,6 +603,17 @@ class Router:
         self._trace_prefix = "r%05x" % random.randrange(16 ** 5)
         self._trace_n = 0
         self._stats = {k: 0 for k in _COUNTERS}
+        # failover knobs (module docstring "Deterministic replay
+        # failover" / "Tail hedging"): replay gates the lost->replay
+        # path, hedge_ms the duplicate-attempt delay (0 off, -1 = live
+        # federated serve p99), hedge_max_pct the in-flight hedge cap
+        self.replay = bool(replay)
+        self.hedge_ms = float(hedge_ms)
+        self.hedge_max_pct = float(hedge_max_pct)
+        self._hedge_auto_s: Optional[float] = None  # GIL-atomic store,
+        #                              written by the federation sweep
+        self._hedges_live = 0        # under _slock: duplicate attempts
+        #                              currently in flight (the cap)
         self._draining = False
         self._stop = False
         self._active = 0             # requests currently being handled
@@ -755,6 +822,13 @@ class Router:
         with self._lock:
             r.queue_depth = st.get("queue_depth", r.queue_depth)
             r.in_flight = st.get("in_flight", r.in_flight)
+            # model generation (reload count): the replay failover's
+            # generation guard — defensive parse, same reason as below
+            if "reloads" in st:
+                try:
+                    r.reloads = int(st["reloads"])
+                except (TypeError, ValueError):
+                    pass
             # absent on pre-batching replicas: reset to 0, not
             # last-known — the field IS the capability signal
             r.free_slots = st.get("free_slots", 0)
@@ -873,35 +947,85 @@ class Router:
         with self._lock:
             r.outstanding = max(0, r.outstanding - 1)
 
-    def _forward(self, r: Replica, line: str,
-                 timeout: float) -> Tuple[str, Optional[str]]:
-        """One attempt against one replica -> (status, response):
+    def _forward_keep(self, r: Replica, line: str, timeout: float
+                      ) -> Tuple[str, Optional[str], Optional[socket.socket]]:
+        """One attempt against one replica -> (status, response, sock):
         ``ok`` (a response line), ``noconnect`` (the request never
         left: SAFE to retry), ``lost`` (sent, then EOF/timeout: the
-        request MAY have dispatched — never retried). A fresh
-        connection per attempt: a pooled socket into a replica that
-        died between requests would turn an innocent request into a
-        false 'lost'."""
+        request MAY have dispatched). On a read TIMEOUT the still-open
+        socket is returned so the replay path can reap — and count —
+        a late answer (``route.discarded_late``); on EOF/send failure
+        there is nothing to reap and sock is None. A fresh connection
+        per attempt: a pooled socket into a replica that died between
+        requests would turn an innocent request into a false 'lost'."""
         try:
             c = socket.create_connection((r.host, r.port),
                                          timeout=self.connect_timeout)
         except OSError:
-            return "noconnect", None
+            return "noconnect", None, None
+        keep = False
         try:
             c.settimeout(max(0.05, timeout))
             try:
                 c.sendall((line + "\n").encode("utf-8", "replace"))
                 resp = c.makefile("r", encoding="utf-8").readline()
+            except socket.timeout:
+                # subclass of OSError — MUST be caught first: a timeout
+                # means the replica may still answer on this socket
+                keep = True
+                return "lost", None, c
             except OSError:
-                return "lost", None
+                return "lost", None, None
             if not resp:
-                return "lost", None
-            return "ok", resp.rstrip("\n")
+                return "lost", None, None
+            return "ok", resp.rstrip("\n"), None
         finally:
+            if not keep:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+
+    def _forward(self, r: Replica, line: str,
+                 timeout: float) -> Tuple[str, Optional[str]]:
+        """_forward_keep without the reapable socket (probes, ADMIN):
+        any kept socket is closed — a late probe answer has no replay
+        to feed."""
+        status, resp, sock = self._forward_keep(r, line, timeout)
+        if sock is not None:
             try:
-                c.close()
+                sock.close()
             except OSError:
                 pass
+        return status, resp
+
+    def _reap_socket(self, sock: socket.socket, rname: str, tid: str,
+                     grace_s: float) -> None:
+        """Drain a lost attempt's kept socket in the background: a late
+        answer arriving after the request was replayed elsewhere is
+        discarded and COUNTED (route.discarded_late) — the observable
+        half of the exactly-once-to-the-client guarantee. Always closes
+        the socket; daemon thread, never joined (drain does not wait on
+        a dead replica's silence)."""
+        def run():
+            late = False
+            try:
+                sock.settimeout(max(0.05, grace_s))
+                late = bool(sock.makefile(
+                    "r", encoding="utf-8").readline())
+            except OSError:
+                pass
+            finally:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            if late:
+                self._bump("discarded_late")
+                telemetry.event({"ev": "route_discarded_late",
+                                 "replica": rname, "request": tid})
+        threading.Thread(target=run, name="cxn-routerd-reap",
+                         daemon=True).start()
 
     def _mint_trace_id(self) -> str:
         """One fleet-wide request id (router prefix + counter): valid
@@ -1056,7 +1180,7 @@ class Router:
                     try:
                         text, outcome = self._route(
                             tid, rest, deadline, t0, attempts,
-                            tenant=tenant_sent)
+                            tenant=tenant_sent, tenant_acct=tenant)
                     finally:
                         if tracked:
                             with self._slock:
@@ -1121,13 +1245,249 @@ class Router:
             ev["tenant"] = tenant
         telemetry.event(ev)
 
+    def _attempt_once(self, r: Replica, tid: str, sendbody: str,
+                      tenant: Optional[str], timeout: float, att: dict
+                      ) -> Tuple[str, Optional[str],
+                                 Optional[socket.socket]]:
+        """One full attempt against one PICKED replica (prefix build,
+        forward, capability-downgrade ladder) -> (status, resp, sock).
+        sock is the kept socket of a timed-out read (reapable), else
+        None. Checks the replica back in; fills att latency/status."""
+        with self._lock:
+            traced = not r.no_trace
+            tenanted = tenant is not None and not r.no_tenant
+        # wire order: TRACE <id> TENANT <t> DEADLINE <ms> <toks> —
+        # the replica parser strips them in exactly this order
+        sendline = sendbody
+        if tenanted:
+            sendline = "TENANT %s %s" % (tenant, sendline)
+        if traced:
+            sendline = "TRACE %s %s" % (tid, sendline)
+        t_att = time.monotonic()
+        sock: Optional[socket.socket] = None
+        try:
+            status, resp, sock = self._forward_keep(r, sendline,
+                                                    timeout)
+            if (traced or tenanted) and status == "ok":
+                if not resp.startswith("ERR parse"):
+                    # ANY other answer to a prefixed line proves
+                    # the prefixes were parsed: latch the positive
+                    # capability flags so later genuine client
+                    # parse errors never pay the downgrade resends
+                    # (one write each, then steady)
+                    if (traced and not r.trace_ok) \
+                            or (tenanted and not r.tenant_ok):
+                        with self._lock:
+                            if traced:
+                                r.trace_ok = True
+                            if tenanted:
+                                r.tenant_ok = True
+                else:
+                    # maybe an OLD replica rejecting a prefix
+                    # itself: a parse rejection proves the request
+                    # never dispatched, so each progressively
+                    # barer resend is exactly-once safe. A genuine
+                    # client parse error comes back identical at
+                    # every rung and is relayed; a changed answer
+                    # proves which prefix the replica predates —
+                    # latch it (the ladder: drop TENANT first —
+                    # newer than TRACE — then TRACE too).
+                    status, resp = self._prefix_downgrade(
+                        r, tid, sendbody, traced, tenanted,
+                        timeout, att, resp)
+        finally:
+            self._checkin(r)
+        att["latency_s"] = round(time.monotonic() - t_att, 6)
+        att["status"] = status
+        return status, resp, sock
+
+    def _tenant_over_share(self, tenant: Optional[str]) -> bool:
+        """True when the tenant already holds MORE than its weighted
+        fair share of the router's in-flight requests — the replay and
+        hedge denial gate. Unlike _tenant_gate there is no saturation
+        requirement: a replay or hedge is EXTRA fleet work on top of
+        an already-charged request, so an over-share tenant is denied
+        even on an idle fleet (a flood must not double itself through
+        failover). A sole-active tenant is never denied — its share is
+        the whole router."""
+        if not self._tenants or tenant is None \
+                or tenant not in self._tenants:
+            return False
+        with self._slock:
+            active = dict(self._tenant_active)
+        total = sum(active.values())
+        live = {t for t, n in active.items() if n > 0}
+        live.add(tenant)
+        weight_sum = sum(self._tenants[t] for t in live)
+        share = max(1, int(total * self._tenants[tenant] / weight_sum))
+        return active.get(tenant, 0) > share
+
+    def _hedge_delay(self) -> Optional[float]:
+        """The hedge launch delay in seconds, or None when hedging is
+        off: route_hedge_ms > 0 is a fixed bound; -1 tracks the live
+        fleet-merged serve p99 (None until the federation sweep has
+        enough observations to trust); 0 disables."""
+        if self.hedge_ms > 0:
+            return self.hedge_ms / 1e3
+        if self.hedge_ms < 0:
+            return self._hedge_auto_s
+        return None
+
+    def _hedge_allowed(self, tenant: Optional[str]) -> bool:
+        """Claim one hedge slot -> bool. Hedges are capped at
+        hedge_max_pct of the requests in flight right now (floored at
+        one) and denied to a tenant over its fair share. Sequential
+        lock takes (fleet, then stats) — never nested."""
+        if self._tenant_over_share(tenant):
+            return False
+        with self._lock:
+            active = self._active
+        with self._slock:
+            cap = max(1, int(self.hedge_max_pct / 100.0 * active))
+            if self._hedges_live >= cap:
+                return False
+            self._hedges_live += 1
+            return True
+
+    def _discard_loser(self, status: str, resp: Optional[str],
+                       sock: Optional[socket.socket], rname: str,
+                       tid: str, winner_resp: Optional[str]) -> None:
+        """Account a hedge race's losing lane: a full answer is
+        discarded and COUNTED (route.discarded_late) — and
+        cross-checked against the winner's, because determinism says
+        duplicate answers are identical: a mismatch is a correctness
+        alarm (route.hedge_mismatch), not noise. A kept socket (the
+        lane timed out) is reaped in the background."""
+        if status == "ok" and resp:
+            self._bump("discarded_late")
+            if (winner_resp and not resp.startswith("ERR")
+                    and not winner_resp.startswith("ERR")
+                    and resp != winner_resp):
+                telemetry.count("route.hedge_mismatch")
+                telemetry.event({"ev": "route_hedge_mismatch",
+                                 "request": tid, "replica": rname,
+                                 "loser": resp[:80],
+                                 "winner": winner_resp[:80]})
+        if sock is not None:
+            self._reap_socket(sock, rname, tid, self.stall_s)
+
+    def _attempt_hedged(self, r: Replica, att: dict, tid: str,
+                        sendbody: str, tenant: Optional[str],
+                        tenant_acct: Optional[str], timeout: float,
+                        delay: float, t0: float, tried: set,
+                        attempts_out: List[dict]
+                        ) -> Tuple[str, Optional[str],
+                                   Optional[socket.socket],
+                                   Replica, dict]:
+        """Race a primary attempt against ONE delayed duplicate on a
+        different replica -> (status, resp, sock, replica, att) for
+        the winning lane. First genuine answer wins; the loser is
+        discarded+counted by whichever side observes the race outcome
+        — the CPython-atomic dict.setdefault claim makes it exactly
+        one. A hedge answer is adopted only when it is a real answer
+        (ok, non-ERR): hedging never worsens an outcome — a lost
+        primary still flows into the replay machinery upstream."""
+        res: dict = {}
+        evt = threading.Event()
+
+        def primary():
+            try:
+                res["p"] = self._attempt_once(r, tid, sendbody,
+                                              tenant, timeout, att)
+            except Exception:
+                res["p"] = ("lost", None, None)
+            evt.set()
+            # loser self-accounting: the main thread already adopted
+            # the hedge answer AND saw this lane unfinished — the
+            # setdefault claim picks exactly one accountant
+            if res.get("winner") == "hedge" \
+                    and res.setdefault("acct", "p") == "p":
+                st, rp, sk = res["p"]
+                self._discard_loser(st, rp, sk, r.name, tid,
+                                    res.get("winner_resp"))
+
+        threading.Thread(target=primary, name="cxn-routerd-hedge-pri",
+                         daemon=True).start()
+        if evt.wait(delay):
+            st, rp, sk = res["p"]
+            return st, rp, sk, r, att
+        # the downgrade ladder can legally take ~3x the per-forward
+        # timeout: the pathological wait bound for the primary lane
+        bound = 3.0 * timeout + 1.0
+        if not self._hedge_allowed(tenant_acct):
+            evt.wait(bound)
+            st, rp, sk = res.get("p", ("lost", None, None))
+            return st, rp, sk, r, att
+        try:
+            r2, cands2 = self._pick(tried | {r.name})
+            if r2 is None:
+                evt.wait(bound)
+                st, rp, sk = res.get("p", ("lost", None, None))
+                return st, rp, sk, r, att
+            self._bump("hedges")
+            att2 = {"replica": r2.name, "cls": "hedge",
+                    "t_off_s": round(time.monotonic() - t0, 6),
+                    "candidates": cands2}
+            st2, rp2, sk2 = self._attempt_once(r2, tid, sendbody,
+                                               tenant, timeout, att2)
+            tried.add(r2.name)
+        finally:
+            with self._slock:
+                self._hedges_live -= 1
+        p = res.get("p") if evt.is_set() else None
+        if p is not None and p[0] == "ok" and p[1] is not None \
+                and not p[1].startswith("ERR"):
+            # the primary produced a genuine answer: it wins (it was
+            # first, or close enough that order doesn't matter —
+            # determinism makes the answers identical either way)
+            att2["outcome"] = "hedge_loser"
+            attempts_out.append(att2)
+            self._discard_loser(st2, rp2, sk2, r2.name, tid, p[1])
+            return p[0], p[1], p[2], r, att
+        if st2 == "ok" and rp2 is not None \
+                and not rp2.startswith("ERR"):
+            # the hedge produced the genuine answer: adopt it; the
+            # primary lane (late, lost, or errored) is the loser
+            res["winner_resp"] = rp2
+            res["winner"] = "hedge"
+            self._bump("hedge_wins")
+            if evt.is_set() and res.setdefault("acct", "m") == "m":
+                st, rp, sk = res["p"]
+                self._discard_loser(st, rp, sk, r.name, tid, rp2)
+            att["outcome"] = "hedge_loser"
+            attempts_out.append(att)
+            return st2, rp2, sk2, r2, att2
+        # the hedge failed too (ERR / lost / noconnect): wait out the
+        # primary — it may still answer, and a lost primary flows into
+        # the replay machinery upstream
+        att2["outcome"] = (" ".join(rp2.split()[:3])
+                           if (st2 == "ok" and rp2) else st2)
+        attempts_out.append(att2)
+        if sk2 is not None:
+            self._reap_socket(sk2, r2.name, tid, self.stall_s)
+        if evt.wait(bound):
+            st, rp, sk = res["p"]
+            return st, rp, sk, r, att
+        # pathological: the primary never returned inside the bound —
+        # report it lost; its late completion self-discards via the
+        # winner flag
+        res["winner"] = "hedge"
+        res["winner_resp"] = None
+        res.setdefault("acct", "p")
+        return "lost", None, None, r, att
+
     def _route(self, tid: str, rest: List[str],
                deadline: Optional[float], t0: float,
                attempts_out: List[dict],
-               tenant: Optional[str] = None) -> Tuple[str, str]:
+               tenant: Optional[str] = None,
+               tenant_acct: Optional[str] = None) -> Tuple[str, str]:
         tried: set = set()
         attempts = 0
         last_shed: Optional[str] = None
+        loss: Optional[str] = None    # the last lost/rescued attempt's
+        #                               text (replayable losses)
+        replay_gen: Optional[int] = None  # expected model generation
+        #                               once replaying (the guard)
         body = " ".join(rest)
         while True:
             now = time.monotonic()
@@ -1137,10 +1497,38 @@ class Router:
                         "deadline")
             r, cands = self._pick(tried)
             if r is None:
+                if loss is not None:
+                    # a replayable loss with no survivor left: the
+                    # honest verdict is the loss, not a fleet shed
+                    return (loss + " (no survivor to replay on)",
+                            "errors")
                 if last_shed is not None:
                     return last_shed, "shed"
                 return ("ERR busy fleet no routable replica (%s)"
                         % self._states_brief(), "shed")
+            if replay_gen is not None and replay_gen >= 0:
+                # generation guard (module docstring): a replay must
+                # not splice tokens across a weight push — the
+                # survivor's live ADMIN reload count must match the
+                # lost replica's (a fresh probe per replay: replays
+                # are rare, staleness here splices generations)
+                st = self._replica_stats(r)
+                sg = -1
+                if st is not None:
+                    try:
+                        sg = int(st.get("reloads", -1))
+                    except (TypeError, ValueError):
+                        sg = -1
+                if sg >= 0 and sg != replay_gen:
+                    self._checkin(r)
+                    attempts_out.append(
+                        {"replica": r.name, "cls": "replay",
+                         "status": "denied",
+                         "outcome": "replay_denied_generation"})
+                    self._bump("replay_denied")
+                    return ("ERR backend generation moved mid-replay "
+                            "(expected %d, replica %s at %d)"
+                            % (replay_gen, r.name, sg), "errors")
             timeout = self.stall_s
             sendbody = body
             if deadline is not None:
@@ -1150,53 +1538,22 @@ class Router:
                 # replica's own queue-expiry check spends the same clock
                 sendbody = "DEADLINE %d %s" % (max(1, int(rem * 1e3)),
                                                body)
-            with self._lock:
-                traced = not r.no_trace
-                tenanted = tenant is not None and not r.no_tenant
-            # wire order: TRACE <id> TENANT <t> DEADLINE <ms> <toks> —
-            # the replica parser strips them in exactly this order
-            sendline = sendbody
-            if tenanted:
-                sendline = "TENANT %s %s" % (tenant, sendline)
-            if traced:
-                sendline = "TRACE %s %s" % (tid, sendline)
-            t_att = time.monotonic()
             att = {"replica": r.name,
-                   "t_off_s": round(t_att - t0, 6),
+                   "t_off_s": round(time.monotonic() - t0, 6),
                    "candidates": cands}
-            try:
-                status, resp = self._forward(r, sendline, timeout)
-                if (traced or tenanted) and status == "ok":
-                    if not resp.startswith("ERR parse"):
-                        # ANY other answer to a prefixed line proves
-                        # the prefixes were parsed: latch the positive
-                        # capability flags so later genuine client
-                        # parse errors never pay the downgrade resends
-                        # (one write each, then steady)
-                        if (traced and not r.trace_ok) \
-                                or (tenanted and not r.tenant_ok):
-                            with self._lock:
-                                if traced:
-                                    r.trace_ok = True
-                                if tenanted:
-                                    r.tenant_ok = True
-                    else:
-                        # maybe an OLD replica rejecting a prefix
-                        # itself: a parse rejection proves the request
-                        # never dispatched, so each progressively
-                        # barer resend is exactly-once safe. A genuine
-                        # client parse error comes back identical at
-                        # every rung and is relayed; a changed answer
-                        # proves which prefix the replica predates —
-                        # latch it (the ladder: drop TENANT first —
-                        # newer than TRACE — then TRACE too).
-                        status, resp = self._prefix_downgrade(
-                            r, tid, sendbody, traced, tenanted,
-                            timeout, att, resp)
-            finally:
-                self._checkin(r)
-            att["latency_s"] = round(time.monotonic() - t_att, 6)
-            att["status"] = status
+            if replay_gen is not None:
+                att["cls"] = "replay"
+            hdelay = self._hedge_delay()
+            sock: Optional[socket.socket] = None
+            if not tried and hdelay is not None and hdelay < timeout:
+                # first attempt only: a retry/replay already burned
+                # tail budget, doubling it again helps no one
+                status, resp, sock, r, att = self._attempt_hedged(
+                    r, att, tid, sendbody, tenant, tenant_acct,
+                    timeout, hdelay, t0, tried, attempts_out)
+            else:
+                status, resp, sock = self._attempt_once(
+                    r, tid, sendbody, tenant, timeout, att)
             tried.add(r.name)
             if status == "noconnect":
                 # never sent: safe. Eject now — waiting a probe
@@ -1210,17 +1567,55 @@ class Router:
                     att["retried"] = True
                     continue
                 return ("ERR busy fleet replicas unreachable", "shed")
-            if status == "lost":
-                # sent, then silence/EOF: the request MAY have
-                # dispatched — exactly-once forbids a replay. The
-                # prober decides whether the replica is dead (SIGKILL)
-                # or merely slow (stall bound), so no hard eject here.
-                att["outcome"] = "lost"
-                attempts_out.append(att)
-                telemetry.count("route.lost_contact")
-                return ("ERR backend replica %s lost contact "
-                        "mid-request (not retried: may have dispatched)"
-                        % r.name, "errors")
+            # a lost attempt (sent, then silence/EOF) or a replica-side
+            # batch rescue (``ERR backend rescued``: the replica evicted
+            # a wedged batch — provably no answer left it): both are
+            # REPLAYABLE losses under the determinism argument in the
+            # module docstring. The prober decides whether the replica
+            # is dead (SIGKILL) or merely slow, so no hard eject here.
+            rescued = (status == "ok" and resp is not None
+                       and resp.split()[:3]
+                       == ["ERR", "backend", "rescued"])
+            if status == "lost" or rescued:
+                with self._lock:
+                    r.lost += 1
+                    gen = r.reloads
+                if status == "lost":
+                    att["outcome"] = "lost"
+                    attempts_out.append(att)
+                    self._bump("lost_contact")
+                    if sock is not None:
+                        # the timed-out socket: reap (and count) a
+                        # late answer in the background so the replay
+                        # below stays exactly-once to the client
+                        grace = self.stall_s
+                        if deadline is not None:
+                            grace = min(grace, max(
+                                0.05, deadline - time.monotonic()))
+                        self._reap_socket(sock, r.name, tid, grace)
+                        sock = None
+                    loss = ("ERR backend replica %s lost contact "
+                            "mid-request" % r.name)
+                else:
+                    att["outcome"] = "ERR backend rescued"
+                    attempts_out.append(att)
+                    loss = resp
+                if not self.replay:
+                    if rescued:
+                        return loss, "errors"
+                    return (loss + " (not retried: may have "
+                            "dispatched)", "errors")
+                if self._tenant_over_share(tenant_acct):
+                    # a flood must not double itself through failover:
+                    # the over-share tenant eats its loss
+                    self._bump("replay_denied")
+                    return (loss + " (not replayed: tenant %s over "
+                            "fair share)" % tenant_acct, "errors")
+                self._bump("replays")
+                att["replayed"] = True
+                if replay_gen is None:
+                    replay_gen = gen
+                continue
             att["outcome"] = " ".join(resp.split()[:3]) \
                 if resp.startswith("ERR") else "served"
             attempts_out.append(att)
@@ -1440,6 +1835,7 @@ class Router:
         cxxnet_fleet_outlier gauges; transitions emit ONE
         ``fleet_outlier`` event each (never per-sweep spam)."""
         p99s: Dict[str, float] = {}
+        h_all = telemetry.Histogram()
         for name, snap in snaps.items():
             d = (snap.get("metrics") or {}).get("hists", {}) \
                 .get("serve.request")
@@ -1448,10 +1844,16 @@ class Router:
             h = telemetry.Histogram()
             try:
                 h.merge_dict(d)
+                h_all.merge_dict(d)
             except (ValueError, TypeError):
                 continue
             if h.n >= self.outlier_min_n:
                 p99s[name] = h.percentile(99)
+        if h_all.n >= self.outlier_min_n:
+            # the fleet-merged serve p99: the live hedge delay when
+            # route_hedge_ms = -1 (GIL-atomic float store; floored so
+            # a degenerate all-fast histogram can't hedge everything)
+            self._hedge_auto_s = max(0.001, h_all.percentile(99))
         flips = []
         with self._fed_lock:
             prev = self._fed_outlier
@@ -2080,11 +2482,15 @@ def stitched_chrome_trace(router_rec: dict, hops) -> dict:
         ts = r_off + float(att.get("t_off_s") or 0.0)
         trace.append({
             "ph": "X",
-            "name": "forward:%s" % att.get("replica", "?"),
+            # hedge/replay lanes carry their attempt class in the
+            # name so the duplicate attempt is visually distinct
+            "name": ("hedge:%s" if att.get("cls") == "hedge"
+                     else "forward:%s") % att.get("replica", "?"),
             "pid": 0, "tid": 1, "ts": round(ts * 1e6, 1),
             "dur": round(float(att.get("latency_s") or 0.0) * 1e6, 1),
             "args": {"request": rid, "attempt": i + 1,
                      "outcome": att.get("outcome", "?"),
+                     "class": att.get("cls"),
                      "candidates": att.get("candidates")}})
     for i, (name, rrec) in enumerate(hops):
         sub = telemetry.request_chrome_trace(rrec)

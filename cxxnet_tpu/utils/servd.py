@@ -879,6 +879,13 @@ class ServeFrontend:
         # reports "warming" — unset/0 leaves every path byte-identical
         self._warm_readiness: Optional[Callable] = None
         self._warm_ready_pct = 0.0
+        # batch rescue (doc/robustness.md "Failover & hedging"): the
+        # session the worker is stepping right now (GIL-atomic store,
+        # read by the rescue thread), the rescued flag the worker
+        # checks around each step, and the watchdog thread itself
+        self._cur_sess = None
+        self._batch_rescued = False
+        self._rescue_thread: Optional[threading.Thread] = None
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> "ServeFrontend":
@@ -902,6 +909,15 @@ class ServeFrontend:
         self._worker_thread = threading.Thread(
             target=target, name="cxn-servd-worker", daemon=True)
         self._worker_thread.start()
+        if self.slot_backend is not None and self.stall_after_s > 0:
+            # the batch-rescue watchdog: a dispatch wedged past the
+            # stall bound fails the batch and answers ERR backend so
+            # the requests become replayable losses upstream instead
+            # of hostages (doc/robustness.md "Failover & hedging")
+            self._rescue_thread = threading.Thread(
+                target=self._rescue_run, name="cxn-servd-rescue",
+                daemon=True)
+            self._rescue_thread.start()
         return self
 
     def listen(self, port: int = 0, host: str = "") -> int:
@@ -2190,13 +2206,75 @@ class ServeFrontend:
                          "reqs": [st.req.id for st in active.values()]})
         msg = "ERR backend " + " ".join(repr(exc).split())[:200]
         for slot, st in list(active.items()):
-            sess.retire(slot)
+            try:
+                sess.retire(slot)
+            except Exception:
+                pass               # a rescued (closed) session may
+                #                    refuse the retire: the slot dies
+                #                    with the session either way
             self._finish_popped(st.req, msg, "errors", "backend_error",
                                 st.tc, st.queue_wait, st.t_pop,
                                 st.t_back, len(st.toks),
                                 occupancy=st.occ,
                                 batch=self._retire_info(st))
         active.clear()
+
+    def _rescue_run(self) -> None:
+        """Batch-rescue watchdog loop (doc/robustness.md "Failover &
+        hedging"): a dispatch wedged inside the backend past the stall
+        bound gets its batch EVICTED — every aboard request is
+        answered ``ERR backend rescued`` (a replayable loss upstream:
+        provably no answer left this replica) instead of sitting
+        hostage until the router's stall timeout. Poll cadence scales
+        with the bound."""
+        tick = max(0.01, min(0.25, self.stall_after_s / 4.0))
+        while not self._stop:
+            if self._stalled_for() > self.stall_after_s \
+                    and not self._batch_rescued:
+                self._rescue_batch(self._stalled_for())
+            time.sleep(tick)
+
+    def _rescue_batch(self, stalled: float) -> None:
+        """Evict the wedged batch: answer every in-flight request
+        (exactly once — the answer-slot claim), count ONE breaker
+        failure + ``serve.batch_rescues``, close the wedged session.
+        The worker, still blocked inside ``sess.step()``, observes
+        ``_batch_rescued`` when the backend finally returns (or
+        raises on the closed session) and runs the slot/journal
+        cleanup with ``count_failure=False`` — one fault, one count.
+        The in-flight set is NOT dropped here: ``_stalled_for`` keeps
+        reporting the wedge to the health probe until the worker
+        actually recovers."""
+        sess = self._cur_sess
+        since0 = self._inflight_since
+        with self._cond:
+            reqs = list(self._inflight_reqs)
+        if not reqs or since0 is None:
+            return
+        self._batch_rescued = True
+        # verify-then-commit: the step may have ended in the window
+        # between the trigger check and the flag write — bail (and
+        # un-flag) rather than rescue a batch that is not wedged
+        if self._cur_sess is not sess or self._inflight_since != since0:
+            self._batch_rescued = False
+            return
+        self.breaker.failure()
+        telemetry.count("serve.batch_rescues")
+        telemetry.event({"ev": "serve_batch_rescue",
+                         "stalled_s": round(stalled, 3),
+                         "reqs": [r.id for r in reqs]})
+        msg = ("ERR backend rescued batch wedged %.1fs inside the "
+               "backend (stall bound %.1fs; replayable: no answer "
+               "left this replica)" % (stalled, self.stall_after_s))
+        for req in reqs:
+            self._finish(req, msg, "errors")
+        if sess is not None:
+            try:
+                close = getattr(sess, "close", None)
+                if close is not None:
+                    close()
+            except Exception:
+                pass
 
     def _worker_run_batched(self) -> None:
         """The iteration-granularity scheduling loop (module docstring
@@ -2382,6 +2460,7 @@ class ServeFrontend:
             slots_snap = [[s, st.req.id, it_ord - st.first_iter]
                           for s, st in sorted(active.items())]
             bucket = sess.nslots
+            self._cur_sess = sess          # the rescue watchdog's view
             self._inflight_since = time.monotonic()
             health.pause("serve.worker")   # a fresh bucket may compile
             t_step = time.perf_counter()
@@ -2397,10 +2476,18 @@ class ServeFrontend:
                 step_s = time.perf_counter() - t_step
                 health.beat("serve.worker")
                 self._inflight_since = None
+                self._cur_sess = None
                 if cw.stall_s:
                     for st in active.values():
                         st.stall_s += cw.stall_s
-                self._fail_batch(sess, active, e)
+                # a rescued batch already answered its requests and
+                # counted the fault (the watchdog): this cleanup pass
+                # must not double the breaker count — the finishes
+                # below are abandoned no-ops either way (claims taken)
+                rescued = self._batch_rescued
+                self._batch_rescued = False
+                self._fail_batch(sess, active, e,
+                                 count_failure=not rescued)
                 # the session's state is suspect: drop it from the pool
                 sessions = {b: s for b, s in sessions.items()
                             if s is not sess}
@@ -2416,9 +2503,28 @@ class ServeFrontend:
             step_s = time.perf_counter() - t_step
             health.beat("serve.worker")
             self._inflight_since = None
+            self._cur_sess = None
             if cw.stall_s:
                 for st in active.values():
                     st.stall_s += cw.stall_s
+            if self._batch_rescued:
+                # the wedge cleared just as the watchdog evicted the
+                # batch: the requests are already answered upstream —
+                # run the same cleanup as a failed step (abandoned
+                # no-ops) and drop the closed session
+                self._batch_rescued = False
+                self._fail_batch(sess, active, RuntimeError(
+                    "batch rescued by the stall watchdog"),
+                    count_failure=False)
+                sessions = {b: s for b, s in sessions.items()
+                            if s is not sess}
+                sess = None
+                qd, qage = self._publish_batch_state(sess, active,
+                                                     sessions)
+                self._record_iteration(bucket, slots_snap, step_s, qd,
+                                       qage, occupancy_after=0,
+                                       error="batch rescued")
+                continue
             for slot, tok, done in res:
                 st = active.get(slot)
                 if st is None:
@@ -2980,11 +3086,78 @@ def _stub_main(argv: List[str]) -> int:
         model["version"] += 1
         return True
 
+    # batched decode mode (--batch-max N): the continuous-batching
+    # dispatcher over an inline slot backend — same deterministic
+    # answer law as the solo stub continued per token (first token =
+    # last prompt token + version, then +1 per decode step), so a
+    # kill-mid-decode chaos test can assert token-exact replays while
+    # requests are genuinely ABOARD a decode batch when the SIGKILL
+    # lands (--per-token-ms paces the steps to hold them there)
+    batch_max = int(flag("--batch-max", 0))
+    n_new = int(flag("--n-new", 8))
+    per_token_s = flag("--per-token-ms", 0.0) / 1e3
+
+    class _StubSession:
+        def __init__(self, n):
+            self.nslots = n
+            self.closed = False
+            self.lives: dict = {}
+
+        def free_slots(self):
+            return [s for s in range(self.nslots)
+                    if s not in self.lives]
+
+        def prefill(self, slot, toks, seq):
+            while wedge["on"]:
+                time.sleep(0.05)
+            if self.closed:
+                raise RuntimeError("session closed")
+            first = (toks[-1] if toks else 0) + model["version"]
+            if n_new <= 1:
+                return first, True
+            self.lives[slot] = {"next": first + 1,
+                                "remaining": n_new - 1}
+            return first, False
+
+        def step(self):
+            while wedge["on"]:
+                time.sleep(0.05)
+            if self.closed:
+                raise RuntimeError("session closed")
+            if per_token_s:
+                time.sleep(per_token_s)
+            out = []
+            for slot, live in list(self.lives.items()):
+                tok = live["next"]
+                live["next"] += 1
+                live["remaining"] -= 1
+                done = live["remaining"] <= 0
+                if done:
+                    self.lives.pop(slot, None)
+                out.append((slot, tok, done))
+            return out
+
+        def retire(self, slot):
+            self.lives.pop(slot, None)
+
+        def close(self):
+            self.closed = True
+            self.lives.clear()
+
+    class _StubSlotBackend:
+        buckets = (batch_max,) if batch_max > 0 else ()
+
+        def session(self, b):
+            return _StubSession(b)
+
     fe = ServeFrontend(backend, queue_size=int(flag("--queue", 64)),
                        drain_ms=flag("--drain-ms", 5000.0),
                        breaker_fails=int(flag("--breaker-fails", 5)),
                        stall_after_s=flag("--stall-s", 120.0),
                        reload_fn=reload_fn,
+                       slot_backend=_StubSlotBackend()
+                       if batch_max > 0 else None,
+                       batch_max=batch_max,
                        # multi-tenant QoS knobs for the fleet chaos
                        # harness (same conf syntax as route_tenants)
                        tenants=flag("--tenants", "", cast=str),

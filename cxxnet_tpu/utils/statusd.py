@@ -594,7 +594,11 @@ def prometheus_metrics(snapshot: dict, progress: Optional[dict] = None,
                  lambda r: r.get("in_flight", 0), None),
                 ("cxxnet_fleet_replica_outstanding",
                  lambda r: r.get("outstanding", 0),
-                 "requests this router currently has on the replica"))
+                 "requests this router currently has on the replica"),
+                ("cxxnet_fleet_replica_lost_contact",
+                 lambda r: r.get("lost", 0),
+                 "lost-contact attempts charged to this replica "
+                 "(each one fed the replay failover)"))
         for mname, get, help_ in fams:
             if not reps:
                 continue
@@ -606,6 +610,25 @@ def prometheus_metrics(snapshot: dict, progress: Optional[dict] = None,
                            % (mname, _lesc(p),
                               _lesc(r.get("name", "?")),
                               _fmt(get(r))))
+        # the router-local failover account (doc/observability.md
+        # "Fleet observability"): route.* counters are router-owned,
+        # not federated from replicas — emitted here so the headline
+        # chaos acceptance can scrape replays/hedges off the router
+        rstats = fleet.get("stats") or {}
+        ffams = (("lost_contact", "attempts that went silent after "
+                  "dispatch (EOF/timeout) — replay failover feed"),
+                 ("replays", "lost attempts re-executed on a "
+                  "different replica (deterministic replay)"),
+                 ("replay_denied", "replays refused (generation "
+                  "moved, or tenant over fair share)"),
+                 ("hedges", "duplicate tail-hedge attempts launched"),
+                 ("hedge_wins", "requests whose hedge answered first"),
+                 ("discarded_late", "duplicate answers reaped and "
+                  "discarded (exactly-once to the client)"))
+        for k, help_ in ffams:
+            if k in rstats:
+                emit("cxxnet_fleet_failover_%s_total" % k, "counter",
+                     int(rstats.get(k) or 0), help_=help_)
         # warm-grid readiness per replica: only rows for replicas
         # that declare a grid (absence is the capability signal —
         # a missing row, never a lying 0)
@@ -938,9 +961,10 @@ def fleetz_html(snap: dict) -> str:
                     else ""))
     parts.append("</pre><h2>replicas</h2><pre>")
     cols = ("replica", "state", "hold", "queue", "in_flight",
-            "outstanding", "buckets", "blocks", "warm", "ejections",
-            "probed", "detail")
-    fmt = "%-21s %-12s %-4s %5s %9s %11s %-12s %-9s %-9s %9s %8s  %s"
+            "outstanding", "lost", "buckets", "blocks", "warm",
+            "ejections", "probed", "detail")
+    fmt = ("%-21s %-12s %-4s %5s %9s %11s %5s %-12s %-9s %-9s %9s "
+           "%8s  %s")
     parts.append(fmt % cols)
     for r in reps:
         age = r.get("last_probe_age_s")
@@ -981,12 +1005,28 @@ def fleetz_html(snap: dict) -> str:
             esc(r.get("name", "?")), esc(r.get("state", "?")),
             "yes" if r.get("hold") else "-", r.get("queue_depth", 0),
             r.get("in_flight", 0), r.get("outstanding", 0),
+            r.get("lost", 0),
             esc(bks), esc(blks), esc(warm), r.get("ejections", 0),
             "never" if age is None else "%.1fs" % age,
             esc(detail)))
     parts.append("</pre><h2>router</h2><pre>")
+    stats = snap.get("stats") or {}
     parts.append(" ".join("%s=%s" % kv for kv in
-                          sorted((snap.get("stats") or {}).items())))
+                          sorted(stats.items())))
+    if stats.get("lost_contact") or stats.get("hedges"):
+        # the failover account, interpreted: how many losses the
+        # replay machinery recovered vs surfaced, and the hedge win
+        # rate — the at-a-glance line behind the
+        # cxxnet_fleet_failover_* series
+        parts.append("failover: %s lost-contact, %s replayed, %s "
+                     "denied; %s hedged, %s hedge wins; %s late "
+                     "duplicate answer(s) discarded"
+                     % (stats.get("lost_contact", 0),
+                        stats.get("replays", 0),
+                        stats.get("replay_denied", 0),
+                        stats.get("hedges", 0),
+                        stats.get("hedge_wins", 0),
+                        stats.get("discarded_late", 0)))
     fed = snap.get("federation")
     if fed:
         parts.append("</pre><h2>federated fleet metrics</h2><pre>")

@@ -655,7 +655,8 @@ class FleetReplica:
 def _start_stub(port=0, status_port=0, delay_ms=0.0, queue=64,
                 drain_ms=5000.0, stall_s=120.0, breaker_fails=5,
                 explode_every=0, reload_ms=0.0, tenants="",
-                tenant_default="default"):
+                tenant_default="default", batch_max=0, n_new=8,
+                per_token_ms=0.0):
     import subprocess
     import sys
 
@@ -667,6 +668,12 @@ def _start_stub(port=0, status_port=0, delay_ms=0.0, queue=64,
             "--breaker-fails", str(breaker_fails),
             "--explode-every", str(explode_every),
             "--reload-ms", str(reload_ms)]
+    if batch_max:
+        # batched-decode stub (the kill-mid-decode chaos harness):
+        # continuous batching over an inline slot backend, n_new
+        # tokens per request paced at per_token_ms per decode step
+        args += ["--batch-max", str(batch_max), "--n-new", str(n_new),
+                 "--per-token-ms", str(per_token_ms)]
     if tenants:
         args += ["--tenants", str(tenants),
                  "--tenant-default", str(tenant_default)]
@@ -772,6 +779,49 @@ def wedge_replica(r):
 def unwedge_replica(r):
     """SIGUSR2 — the wedged backend resumes."""
     os.kill(r.proc.pid, signal.SIGUSR2)
+
+
+def _maybe_delayed(fn, delay_s):
+    """Run ``fn`` now (delay 0) or on a daemon timer thread — the
+    chaos knobs' shared scheduling: a fault can be armed BEFORE the
+    flood starts and land mid-flight."""
+    if not delay_s:
+        fn()
+        return None
+    import threading
+
+    t = threading.Timer(delay_s, fn)
+    t.daemon = True
+    t.start()
+    return t
+
+
+def kill9(r, delay_s=0.0):
+    """Chaos knob: SIGKILL the replica (kill_replica), optionally
+    ``delay_s`` seconds from now on a timer thread — the kill-mid-
+    flood shape: arm it, start the flood, the replica dies with
+    requests decoding aboard. Returns the timer (or None)."""
+    return _maybe_delayed(lambda: kill_replica(r), delay_s)
+
+
+def wedge_mid_decode(r, delay_s=0.0):
+    """Chaos knob: wedge the replica's backend (wedge_replica —
+    blocks inside prefill/step, heartbeats silent) optionally
+    ``delay_s`` seconds from now, so requests already aboard a decode
+    batch are the ones that hang. Reverse with unwedge_replica."""
+    return _maybe_delayed(lambda: wedge_replica(r), delay_s)
+
+
+def partition(r, delay_s=0.0, heal_after_s=None):
+    """Chaos knob: SIGSTOP the replica (partition_replica) optionally
+    ``delay_s`` seconds from now; with ``heal_after_s`` the partition
+    heals itself (SIGCONT) that many seconds after it lands — the
+    transient network blip shape."""
+    def go():
+        partition_replica(r)
+        if heal_after_s is not None:
+            _maybe_delayed(lambda: heal_replica(r), heal_after_s)
+    return _maybe_delayed(go, delay_s)
 
 
 def restart_replica(r, timeout=20.0):

@@ -593,3 +593,61 @@ def test_ranks_are_a_topological_order_of_the_real_graph(real_lint):
     # and the graph the doc tells people to inspect is printable
     order = cxxlint.topo_ranks(edges)
     assert set(order) == {n for e in edges for n in e}
+
+
+# ----------------------------------------------------------------------
+# err-vocab: every ERR string servd/routerd can emit must be a row of
+# serving.md's error-vocabulary table (the wire contract the fleet
+# router dispatches retry/replay/relay on)
+
+ERR_DOC = (
+    "# serving\n\n### Error vocabulary\n\n"
+    "| error line | meaning |\n|---|---|\n"
+    "| `ERR busy queue full (N)` | shed |\n"
+    "| `ERR busy tenant <t> over fair share ...` | fair-share shed |\n"
+    "| `ERR backend ...` | backend raised |\n\n"
+    "## next section\n\n`ERR bogus thing` outside the table does "
+    "not count.\n")
+
+
+def test_err_vocab_fires_on_undocumented_error_string(tmp_path):
+    res = lint_snippet(tmp_path, {"servd.py": (
+        'MSG = "ERR wedged backend stuck"\n')},
+        docs={"serving.md": ERR_DOC})
+    assert_fires_once(res, "err-vocab")
+
+
+def test_err_vocab_matching_rules(tmp_path):
+    # %-format tokens, placeholder/`(N)` doc tokens, `...` tails and
+    # code-side prefixes ("ERR backend " + detail) all match; the rule
+    # only watches the wire-speaking modules, and a span outside the
+    # vocabulary section does not whitelist anything
+    res = lint_snippet(tmp_path, {
+        "servd.py": (
+            'A = "ERR busy queue full (%d)" % 4\n'
+            'B = "ERR busy tenant %s over fair share (evicted)"\n'
+            'C = "ERR backend " + "boom"\n'
+            'D = "ERR %s %s"\n'),
+        "other.py": 'E = "ERR wedged not a wire module"\n'},
+        docs={"serving.md": ERR_DOC})
+    assert "err-vocab" not in rules_of(res)
+    res = lint_snippet(tmp_path, {"routerd.py": (
+        'F = "ERR bogus thing"\n')},
+        docs={"serving.md": ERR_DOC})
+    assert_fires_once(res, "err-vocab")
+
+
+def test_err_vocab_off_without_vocabulary_section(tmp_path):
+    # a doc tree without the table (or without serving.md at all)
+    # disables the rule instead of flagging every error string
+    res = lint_snippet(tmp_path, {"servd.py": (
+        'MSG = "ERR wedged backend stuck"\n')},
+        docs={"serving.md": "# serving\n\nno table here\n"})
+    assert rules_of(res) == []
+
+
+def test_err_vocab_real_tree_is_clean(real_lint):
+    # the shipped servd/routerd error strings are all documented —
+    # the baseline carries ZERO err-vocab debt
+    assert [f for f in real_lint.findings
+            if f.rule == "err-vocab"] == []

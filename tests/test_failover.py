@@ -1,0 +1,402 @@
+"""Zero-loss failover suite (utils/routerd.py + utils/servd.py):
+deterministic replay failover, tail hedging, replica-side batch
+rescue, and the kill-mid-decode chaos headline.
+
+Everything here is jax-free: real ``servd --stub`` subprocesses (the
+faultinject fleet helpers — batched decode via ``batch_max``) or
+in-process frontends, all under runtime lock-order enforcement. The
+failover invariants:
+
+* a lost-contact attempt on a generation request is REPLAYED on a
+  different replica and the client's answer is token-exact — the
+  stack's determinism (PR 11/15) makes re-execution idempotent at the
+  token level;
+* the client request is charged exactly once: replays/hedges ride
+  OUTSIDE the accepted == served + errors + shed + deadline books,
+  and a late duplicate answer is reaped + counted, never delivered;
+* a flood must not double itself: an over-share tenant's loss is not
+  replayed, its tail not hedged;
+* a replay never splices model generations (the ADMIN reload-count
+  guard);
+* a batch wedged past the replica's stall bound is rescued — answered
+  ``ERR backend rescued`` so the loss is replayable upstream.
+"""
+
+import threading
+import time
+from urllib.request import urlopen
+
+import pytest
+
+from cxxnet_tpu.utils import routerd, servd, statusd, telemetry
+
+from . import faultinject
+from .test_routerd import (make_router, reconciles,  # noqa: F401
+                           replica_stats, spawn_two, wait_until)
+
+
+@pytest.fixture(autouse=True)
+def _lockrank_on(monkeypatch):
+    monkeypatch.setenv("CXXNET_LOCKRANK", "1")
+
+
+def _expected(prompt_tok: int, n_new: int, version: int = 1) -> str:
+    """The batched stub's deterministic answer law: first token =
+    last prompt token + version, then +1 per decode step."""
+    first = prompt_tok + version
+    return " ".join(str(first + j) for j in range(n_new))
+
+
+# ----------------------------------------------------------------------
+# THE HEADLINE CHAOS GUARANTEE (ISSUE 17 acceptance): SIGKILL a replica
+# mid-flood with requests DECODING ABOARD a batch -> every client
+# answer token-exact via replay on the survivors, zero client-visible
+# errors, books reconciling on the router and every survivor, the
+# failover series non-zero on the router's own /metrics scrape
+def test_kill_mid_decode_zero_loss_token_exact(make_router):
+    n_new, per_token_ms = 8, 20
+    fleet = faultinject.spawn_fleet(3, batch_max=4, n_new=n_new,
+                                    per_token_ms=per_token_ms)
+    rsrv = None
+    try:
+        router = make_router(fleet, probe_ms=100.0, retries=2,
+                             stall_s=2.0, probe_backoff_cap_s=0.5)
+        rsrv = statusd.StatusServer(0, host="127.0.0.1").start()
+        rsrv.fleet = router
+        n = 16
+        responses = [None] * n
+
+        def client(i):
+            try:
+                responses[i] = faultinject.serve_request(
+                    router.port, "%d" % (10 + i), timeout=25)
+            except OSError:
+                responses[i] = None
+
+        ts = [threading.Thread(target=client, args=(i,))
+              for i in range(n)]
+        for t in ts:
+            t.start()
+        # the kill lands while requests are genuinely aboard a decode
+        # batch on the victim (8 tokens x 20ms: ~160ms aboard)
+        wait_until(lambda: replica_stats(fleet[0])["in_flight"] >= 1,
+                   msg="requests decoding aboard the victim")
+        faultinject.kill9(fleet[0])
+        for t in ts:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in ts)
+        # zero client-visible losses, every answer token-exact: the
+        # victim's aboard requests replayed on the survivors
+        for i, resp in enumerate(responses):
+            assert resp == _expected(10 + i, n_new), (i, resp)
+        st = router.stats()
+        assert st["accepted"] == n and st["served"] == n, st
+        assert st["errors"] == 0 and st["shed"] == 0, st
+        assert reconciles(st)
+        assert st["replays"] > 0, st
+        assert st["lost_contact"] >= st["replays"], st
+        # books reconcile on every survivor too
+        for r in fleet[1:]:
+            assert reconciles(replica_stats(r))
+        # the failover series are non-zero on the router scrape
+        metrics = urlopen("http://127.0.0.1:%d/metrics" % rsrv.port,
+                          timeout=5).read().decode()
+        for line in metrics.splitlines():
+            if line and not line.startswith("#"):
+                assert statusd.PROM_LINE_RE.match(line), line
+        replayed = [line for line in metrics.splitlines()
+                    if line.startswith(
+                        "cxxnet_fleet_failover_replays_total")]
+        assert replayed and float(replayed[0].rsplit(" ", 1)[1]) > 0, \
+            replayed
+        # and the victim's lost-contact count rides the per-replica
+        # gauge (the /fleetz failover column's data)
+        lost = [line for line in metrics.splitlines()
+                if line.startswith("cxxnet_fleet_replica_lost_contact")
+                and 'replica="127.0.0.1:%d"' % fleet[0].port in line]
+        assert lost and float(lost[0].rsplit(" ", 1)[1]) > 0, metrics
+        page = urlopen("http://127.0.0.1:%d/fleetz" % rsrv.port,
+                       timeout=5).read().decode()
+        assert "failover:" in page and "replayed" in page
+    finally:
+        if rsrv is not None:
+            rsrv.stop()
+        faultinject.stop_fleet(fleet)
+
+
+# ----------------------------------------------------------------------
+# wedge-mid-decode -> batch rescue -> replay: the wedged replica's
+# aboard requests come back ERR backend rescued, the router replays
+# them on the survivor, the client sees token-exact answers
+def test_wedge_mid_decode_rescued_and_replayed(make_router):
+    n_new = 8
+    fleet = faultinject.spawn_fleet(2, batch_max=4, n_new=n_new,
+                                    per_token_ms=30, stall_s=0.4)
+    try:
+        router = make_router(fleet, probe_ms=3600e3, retries=2,
+                             stall_s=10.0)
+        out = {}
+
+        def client():
+            out["resp"] = faultinject.serve_request(router.port, "5",
+                                                    timeout=20)
+
+        t = threading.Thread(target=client)
+        t.start()
+        # zero load, index tie-break: the request decodes on fleet[0]
+        wait_until(lambda: replica_stats(fleet[0])["in_flight"] >= 1,
+                   msg="request decoding aboard fleet[0]")
+        faultinject.wedge_mid_decode(fleet[0])
+        t.join(timeout=20)
+        assert not t.is_alive()
+        # rescued upstream, replayed on the survivor, token-exact
+        assert out["resp"] == _expected(5, n_new), out
+        st = router.stats()
+        assert st["served"] == 1 and st["errors"] == 0, st
+        assert st["replays"] == 1, st
+        assert reconciles(st)
+        # the wedged replica's own books carry the rescue as an error
+        faultinject.unwedge_replica(fleet[0])
+        wait_until(lambda: replica_stats(fleet[0])["errors"] >= 1,
+                   msg="rescue lands in the victim's books")
+        assert reconciles(replica_stats(fleet[0]))
+    finally:
+        faultinject.stop_fleet(fleet)
+
+
+# ----------------------------------------------------------------------
+# the reaper: a replica that answers AFTER the router timed it out and
+# replayed gets its late duplicate discarded AND counted
+def test_late_answer_reaped_and_counted(make_router):
+    a, b = spawn_two({"delay_ms": 350})
+    try:
+        router = make_router([a, b], probe_ms=3600e3, retries=2,
+                             stall_s=0.2)
+        # primary on A times out at 0.2s (socket kept), replays on B;
+        # A's answer at 0.35s dies in the reaper
+        assert faultinject.serve_request(router.port, "7",
+                                         timeout=10) == "8"
+        st = router.stats()
+        assert st["served"] == 1 and st["replays"] == 1, st
+        wait_until(lambda: router.stats()["discarded_late"] == 1,
+                   msg="late duplicate answer reaped+counted")
+        assert reconciles(router.stats())
+    finally:
+        faultinject.stop_fleet([a, b])
+
+
+# ----------------------------------------------------------------------
+# generation guard: a replay carries the lost replica's reload count;
+# a survivor on a DIFFERENT model generation refuses the splice
+def test_replay_denied_across_generation(make_router):
+    a, b = spawn_two({"delay_ms": 600})
+    try:
+        # move B one generation ahead (ADMIN reload bumps its version)
+        assert faultinject.serve_request(
+            b.port, "ADMIN reload").startswith("OK")
+        wait_until(lambda: replica_stats(b)["reloads"] == 1,
+                   msg="B's reload applied (worker idle poll)")
+        router = make_router([a, b], probe_ms=200.0, retries=2,
+                             stall_s=0.3)
+        # the prober must have refreshed A's reload count before the
+        # loss (the guard compares the LOST replica's generation)
+        wait_until(lambda: (router.fleet_snapshot()["replicas"][0]
+                            .get("reloads") is not None),
+                   msg="prober learned A's generation")
+        resp = faultinject.serve_request(router.port, "7", timeout=10)
+        # A (gen 0) times out -> lost; replay onto B (gen 1) denied
+        assert resp.startswith("ERR backend generation moved"), resp
+        st = router.stats()
+        assert st["errors"] == 1 and st["replay_denied"] == 1, st
+        assert st["replays"] == 1, st     # the replay was attempted,
+        #                                   then denied at the guard
+        assert reconciles(st)
+        # B never executed the spliced request
+        assert replica_stats(b)["accepted"] == 0
+    finally:
+        faultinject.stop_fleet([a, b])
+
+
+# ----------------------------------------------------------------------
+# a flood must not double itself: an over-share tenant's loss is not
+# replayed (and the share math itself, unit-level)
+def test_tenant_over_share_gates_replay(make_router):
+    a, b = spawn_two({"delay_ms": 600})
+    try:
+        router = make_router([a, b], probe_ms=3600e3, retries=2,
+                             stall_s=0.3, tenants="t1:1,t2:1")
+        # unit: the share gate (no saturation requirement — replay is
+        # EXTRA work); a sole-active tenant is never denied
+        with router._slock:
+            router._tenant_active.update(t1=6, t2=1)
+        assert router._tenant_over_share("t1") is True
+        assert router._tenant_over_share("t2") is False
+        assert router._tenant_over_share(None) is False
+        with router._slock:
+            router._tenant_active.update(t1=0, t2=0)
+        assert router._tenant_over_share("t1") is False
+        # end-to-end: preload t1 over its share, then lose its request
+        with router._slock:
+            router._tenant_active.update(t1=6, t2=1)
+        resp = faultinject.serve_request(router.port, "TENANT t1 7",
+                                         timeout=10)
+        assert "not replayed: tenant t1 over fair share" in resp, resp
+        st = router.stats()
+        assert st["errors"] == 1 and st["replays"] == 0, st
+        assert st["replay_denied"] == 1, st
+        assert replica_stats(b)["accepted"] == 0
+    finally:
+        faultinject.stop_fleet([a, b])
+
+
+# ----------------------------------------------------------------------
+# tail hedging: first answer wins, the loser's duplicate answer is
+# discarded+counted, and determinism means the answers were identical
+def test_hedge_first_answer_wins(make_router):
+    a, b = spawn_two({"delay_ms": 400})
+    telemetry.enable()
+    try:
+        router = make_router([a, b], probe_ms=3600e3, retries=0,
+                             stall_s=5.0, hedge_ms=50.0)
+        t0 = time.monotonic()
+        resp = faultinject.serve_request(router.port, "7", timeout=10)
+        took = time.monotonic() - t0
+        # the hedge (fast B) answered; the primary (A, 400ms) lost
+        assert resp == "8", resp
+        assert took < 0.35, "hedge did not short-circuit the tail"
+        st = router.stats()
+        assert st["served"] == 1 and st["hedges"] == 1, st
+        assert st["hedge_wins"] == 1, st
+        assert reconciles(st)
+        # the primary's late answer is discarded and counted — and it
+        # was IDENTICAL to the winner's (deterministic generation:
+        # zero hedge mismatches)
+        wait_until(lambda: router.stats()["discarded_late"] == 1,
+                   msg="hedge loser discarded+counted")
+        assert telemetry.summary()["counters"].get(
+            "route.hedge_mismatch", 0) == 0
+    finally:
+        telemetry.disable()
+        faultinject.stop_fleet([a, b])
+
+
+# ----------------------------------------------------------------------
+# the hedge budget: capped at hedge_max_pct of in-flight, denied to
+# over-share tenants — and the auto delay tracks the federated p99
+def test_hedge_cap_and_tenant_denial(make_router):
+    a, b = spawn_two({"delay_ms": 150})
+    try:
+        router = make_router([a, b], probe_ms=3600e3, retries=0,
+                             stall_s=5.0, hedge_ms=30.0,
+                             tenants="t1:1,t2:1")
+        # saturate the hedge budget: cap = max(1, 10% of in-flight)
+        with router._slock:
+            router._hedges_live = 5
+        assert faultinject.serve_request(router.port, "7",
+                                         timeout=10) == "8"
+        assert router.stats()["hedges"] == 0, router.stats()
+        with router._slock:
+            router._hedges_live = 0
+        # an over-share tenant's tail is its own: no hedge
+        with router._slock:
+            router._tenant_active.update(t1=6, t2=1)
+        assert faultinject.serve_request(
+            router.port, "TENANT t1 7", timeout=10) == "8"
+        assert router.stats()["hedges"] == 0, router.stats()
+    finally:
+        faultinject.stop_fleet([a, b])
+
+
+def test_hedge_auto_delay_tracks_federated_p99():
+    """route_hedge_ms = -1: the hedge delay follows the fleet-merged
+    serve.request p99 from the federation sweep (None — hedging held
+    off — until enough observations federate)."""
+    router = routerd.Router([("127.0.0.1", 1, 1)], probe_ms=3600e3,
+                            federate_ms=3600e3, outlier_min_n=10,
+                            hedge_ms=-1.0)
+    assert router._hedge_delay() is None     # no federation data yet
+    h = telemetry.Histogram()
+    for _ in range(50):
+        h.observe(0.01)
+    h.observe(2.0)                           # the tail
+    router._detect_outliers(
+        {"a": {"metrics": {"hists": {"serve.request": h.to_dict()}}}})
+    auto = router._hedge_delay()
+    # log-bucketed histogram: the p99 lands on a bucket boundary near
+    # the 2s tail observation, not exactly on it
+    assert auto is not None and 0.01 < auto <= 4.0, auto
+    # a fixed bound wins over auto; 0 disables
+    router.hedge_ms = 25.0
+    assert router._hedge_delay() == 0.025
+    router.hedge_ms = 0.0
+    assert router._hedge_delay() is None
+
+
+# ----------------------------------------------------------------------
+# replica-side batch rescue, in-process: a step wedged past the stall
+# bound fails the batch with ERR backend rescued, the worker survives,
+# the frontend keeps serving
+def test_batch_rescue_in_process():
+    gate = threading.Event()
+    gate.set()
+
+    class _Session:
+        def __init__(self, n):
+            self.nslots = n
+            self.closed = False
+            self.lives = {}
+
+        def free_slots(self):
+            return [s for s in range(self.nslots)
+                    if s not in self.lives]
+
+        def prefill(self, slot, toks, seq):
+            self.lives[slot] = {"next": toks[-1] + 2, "rem": 1}
+            return toks[-1] + 1, False
+
+        def step(self):
+            assert gate.wait(10.0), "test gate never released"
+            if self.closed:
+                raise RuntimeError("session closed")
+            out = []
+            for slot, live in list(self.lives.items()):
+                out.append((slot, live["next"], True))
+                self.lives.pop(slot)
+            return out
+
+        def retire(self, slot):
+            self.lives.pop(slot, None)
+
+        def close(self):
+            self.closed = True
+
+    class _SB:
+        buckets = (2,)
+
+        def session(self, b):
+            return _Session(b)
+
+    telemetry.enable()
+    fe = servd.ServeFrontend(lambda toks, seq: toks, slot_backend=_SB(),
+                             batch_max=2, stall_after_s=0.3,
+                             breaker_fails=50).start()
+    port = fe.listen(0)
+    try:
+        assert faultinject.serve_request(port, "5") == "6 7"
+        gate.clear()                   # wedge the next step
+        resp = faultinject.serve_request(port, "9", timeout=10)
+        assert resp.startswith("ERR backend rescued"), resp
+        assert "replayable" in resp
+        st = fe.stats()
+        assert st["errors"] == 1, st
+        assert st["accepted"] == st["served"] + st["errors"], st
+        gate.set()                     # the wedge clears: the worker
+        #                                cleans up and keeps serving
+        wait_until(lambda: faultinject.serve_request(
+            port, "5", timeout=5) == "6 7", timeout=8.0,
+            msg="frontend serves again after the rescue")
+        assert telemetry.summary()["counters"].get(
+            "serve.batch_rescues", 0) == 1
+    finally:
+        fe.drain(timeout_ms=2000)
+        telemetry.disable()

@@ -838,6 +838,56 @@ def test_bench_compare_tenant_subfield_directions(tmp_path):
     assert proc.returncode == 0, proc.stdout
 
 
+def test_bench_compare_failover_subfield_directions(tmp_path):
+    """Direction-aware gating for the failover rows:
+    serve_chaos_availability (pct) and its replays sub-field gate
+    worse-when-LOWER (a drop toward zero means the failover datapath
+    stopped firing), error_rate / kill_window_p99_ms worse-when-HIGHER
+    via the existing rate/latency rules; on serve_hedged_tail the
+    headline hedged p99 is a latency while hedges/hedge_wins gate
+    worse-when-LOWER."""
+    import subprocess
+    import sys
+    bench = tmp_path / "BENCH_r99.json"
+    bench.write_text("\n".join([
+        json.dumps({"metric": "serve_chaos_availability", "value": 60.0,
+                    "unit": "pct", "replays": 0, "error_rate": 0.4,
+                    "kill_window_p99_ms": 900.0}),
+        json.dumps({"metric": "serve_hedged_tail", "value": 400.0,
+                    "unit": "ms", "hedges": 0, "hedge_wins": 0})]) + "\n")
+    base = tmp_path / "BASELINE.json"
+    base.write_text(json.dumps({"published": {
+        "serve_chaos_availability": 99.0,
+        "serve_chaos_availability.replays": 3.0,
+        "serve_chaos_availability.error_rate": 0.01,
+        "serve_chaos_availability.kill_window_p99_ms": 150.0,
+        "serve_hedged_tail": 50.0,
+        "serve_hedged_tail.hedges": 2.0,
+        "serve_hedged_tail.hedge_wins": 2.0}}))
+    proc = subprocess.run(
+        [sys.executable, "tools/bench_compare.py", "--bench",
+         str(bench), "--baseline", str(base)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 2, proc.stdout
+    out = proc.stdout
+    # every field regressed in its own direction: availability and
+    # the engagement counters fell, error rate and latencies rose
+    assert out.count("REGRESSION") == 7, out
+    assert "replays" in out and "hedges" in out and "hedge_wins" in out
+    # and the good directions pass
+    bench.write_text("\n".join([
+        json.dumps({"metric": "serve_chaos_availability",
+                    "value": 100.0, "unit": "pct", "replays": 5,
+                    "error_rate": 0.0, "kill_window_p99_ms": 100.0}),
+        json.dumps({"metric": "serve_hedged_tail", "value": 40.0,
+                    "unit": "ms", "hedges": 4, "hedge_wins": 3})]) + "\n")
+    proc = subprocess.run(
+        [sys.executable, "tools/bench_compare.py", "--bench",
+         str(bench), "--baseline", str(base)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout
+
+
 def test_bench_compare_decode_subfield_directions(tmp_path):
     """Direction-aware gating for the serve_throughput_rps decode
     sub-fields: kv_live_pct gates worse-when-LOWER (a drop = more

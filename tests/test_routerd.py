@@ -10,8 +10,11 @@ stub's backend answers ``tok + model_version`` so tests can SEE which
 model served). The fleet invariants under fault injection:
 
 * every request the ROUTER accepts gets exactly one response line;
-* a request that MAY have dispatched to a replica is never replayed on
-  another one (exactly-once beats availability);
+* a lost-contact attempt (the replica MAY have dispatched it) is
+  REPLAYED on a different replica — generation is deterministic, so
+  the replay is token-identical — and the original socket is reaped so
+  a late answer is discarded+counted, never delivered twice
+  (exactly-once to the CLIENT survives the failover);
 * router counters reconcile: accepted == served + errors + shed +
   deadline — and so does the fleet-wide ``ADMIN stats`` aggregate over
   the surviving replicas;
@@ -274,8 +277,11 @@ def test_breaker_shed_ejects_replica(make_router):
 
 
 # ----------------------------------------------------------------------
-# exactly-once: a replica that dies AFTER accepting is never replayed
-def test_no_replay_when_replica_dies_after_accepting(make_router):
+# deterministic replay failover: a replica that dies AFTER accepting
+# gets its request REPLAYED on the survivor — the client sees the
+# token-exact answer, charged once; route_replay = 0 restores the old
+# never-replay verdict
+def test_replay_when_replica_dies_after_accepting(make_router):
     a, b = spawn_two({"delay_ms": 500})
     try:
         router = make_router([a, b], probe_ms=3600e3, retries=2,
@@ -288,19 +294,56 @@ def test_no_replay_when_replica_dies_after_accepting(make_router):
 
         t = threading.Thread(target=client)
         t.start()
-        # zero load, index tie-break: the request is on A (800ms
+        # zero load, index tie-break: the request is on A (500ms
         # backend); kill A while it is in flight
         wait_until(lambda: replica_stats(a)["in_flight"] == 1,
                    msg="request in flight on A")
         faultinject.kill_replica(a)
         t.join(timeout=15)
         assert not t.is_alive()
-        # the client got an honest ERR, and the request was NOT
-        # replayed: replica B never saw a request
+        # the lost attempt was replayed on B: token-exact answer
+        # (generation is deterministic — same prompt, same model
+        # version, same tokens), client charged exactly once
+        assert out["resp"] == "8", out
+        st = router.stats()
+        assert st["served"] == 1 and st["errors"] == 0, st
+        assert st["replays"] == 1 and st["lost_contact"] == 1, st
+        assert st["retries"] == 0, st    # replays ride OUTSIDE the
+        #                                  retry budget and its counter
+        assert reconciles(st)
+        assert replica_stats(b)["accepted"] == 1
+        # the lost attempt is on A's /fleetz failover account
+        snap = router.fleet_snapshot()["replicas"]
+        assert snap[0]["lost"] == 1 and snap[1]["lost"] == 0, snap
+    finally:
+        faultinject.stop_fleet([a, b])
+
+
+# ----------------------------------------------------------------------
+# route_replay = 0: the old exactly-once-beats-availability verdict —
+# a lost-contact attempt is answered as an honest ERR, never replayed
+def test_replay_off_restores_never_replay(make_router):
+    a, b = spawn_two({"delay_ms": 500})
+    try:
+        router = make_router([a, b], probe_ms=3600e3, retries=2,
+                             stall_s=5.0, replay=False)
+        out = {}
+
+        def client():
+            out["resp"] = faultinject.serve_request(router.port, "7",
+                                                    timeout=15)
+
+        t = threading.Thread(target=client)
+        t.start()
+        wait_until(lambda: replica_stats(a)["in_flight"] == 1,
+                   msg="request in flight on A")
+        faultinject.kill_replica(a)
+        t.join(timeout=15)
+        assert not t.is_alive()
         assert out["resp"].startswith("ERR backend"), out
         assert "not retried" in out["resp"]
         st = router.stats()
-        assert st["errors"] == 1 and st["retries"] == 0, st
+        assert st["errors"] == 1 and st["replays"] == 0, st
         assert replica_stats(b)["accepted"] == 0
     finally:
         faultinject.stop_fleet([a, b])
@@ -363,15 +406,14 @@ def test_kill_and_partition_mid_flood_zero_loss(make_router):
         for t in ts:
             t.join(timeout=30)
         assert not any(t.is_alive() for t in ts)
-        # zero silent losses: every accepted request was answered
-        # (served, or an honest ERR — never a missing line)
-        assert all(r is not None for r in responses), responses
-        ok = [r for r in responses if r == "6"]
-        errs = [r for r in responses if r.startswith("ERR")]
-        assert len(ok) + len(errs) == n, responses
-        assert ok, "no request survived the chaos"
+        # ZERO client-visible losses: every accepted request was
+        # answered token-exact — the killed replica's in-flight
+        # requests replay off its EOF, the partitioned replica's off
+        # the stall timeout (their late answers die in the reaper)
+        assert all(r == "6" for r in responses), responses
         st = router.stats()
         assert st["accepted"] == n and reconciles(st), st
+        assert st["replays"] > 0, st
         # both failed replicas are ejected
         wait_until(lambda: router.fleet_snapshot()["replicas"][0]
                    ["state"] == routerd.DEAD, msg="killed ejected")

@@ -139,6 +139,13 @@ def sub_lower_is_better(key, line):
         # the other rate that is worse when LOWER: a drop means prompt
         # tokens are being re-prefilled instead of shared
         return False
+    if "availability" in k or k in ("replays", "hedges", "hedge_wins"):
+        # failover health (the serve_chaos_availability /
+        # serve_hedged_tail rows): availability percentages and the
+        # replay/hedge engagement counters are worse when LOWER — a
+        # drop toward zero means the failover datapath stopped firing
+        # while the error-rate sub-fields rose to tell the same story
+        return False
     if k.endswith("_ms") or "latency" in k or k.endswith("_rate"):
         return True
     return lower_is_better(line)

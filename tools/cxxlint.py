@@ -92,6 +92,8 @@ RULES = {
     "timed-dispatch": "span times an async jit dispatch with no sync",
     "conf-undocumented": "code reads a conf key no doc/*.md mentions",
     "conf-dead": "doc registers a conf key nothing reads",
+    "err-vocab": "servd/routerd ERR string missing from serving.md's "
+                 "error-vocabulary table",
     "metric-name": "telemetry series name outside [A-Za-z0-9_./]",
     "metric-type": "one series name used as two metric types",
     "metric-suffix": "metric unit-suffix convention violation",
@@ -117,6 +119,9 @@ HINTS = {
                       "suppress with a reason if dispatch-time is meant",
     "conf-undocumented": "document the key in the owning doc/*.md page",
     "conf-dead": "delete the doc row or wire the key back up",
+    "err-vocab": "add a row to doc/serving.md '### Error vocabulary' — "
+                 "the table IS the wire contract the router dispatches "
+                 "on",
     "metric-name": "stick to letters, digits, '_', '.', '/'",
     "metric-type": "pick one type per name; split the series otherwise",
     "metric-suffix": "statusd appends _total/_seconds — drop the unit "
@@ -1304,6 +1309,86 @@ def conf_findings(project: Project, doc_dir: str) -> List[Finding]:
 
 
 # ----------------------------------------------------------------------
+# error vocabulary: the serving wire contract
+# ----------------------------------------------------------------------
+
+# the serving line protocol's error grammar is a CONTRACT: the fleet
+# router dispatches retry/replay/relay on the `ERR <class> <detail>`
+# third token, so an error string servd/routerd can emit that the
+# doc/serving.md "### Error vocabulary" table does not list is a wire
+# format nobody agreed to. The checker scans every string constant
+# starting "ERR " in the two wire-speaking modules and matches it
+# against the table's backticked `ERR ...` spans: `<placeholder>` and
+# `(N)` doc tokens match any code token, `...` matches any tail,
+# %-format code tokens match any doc token, and a code string that is
+# a PREFIX of a row matches (builders append the detail at runtime).
+
+ERR_VOCAB_MODULES = ("servd.py", "routerd.py")
+ERR_SPAN_RE = re.compile(r"`(ERR [^`]+)`")
+
+
+def _err_vocab_patterns(doc_dir: str) -> Optional[List[List[str]]]:
+    path = os.path.join(doc_dir, "serving.md")
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        return None
+    m = re.search(r"^### Error vocabulary\s*$", text, re.M)
+    if m is None:
+        return None
+    tail = text[m.end():]
+    end = re.search(r"^#{2,3} ", tail, re.M)
+    section = tail[:end.start()] if end else tail
+    return [span.split()[1:] for span in ERR_SPAN_RE.findall(section)]
+
+
+def _err_matches(pat: List[str], toks: List[str]) -> bool:
+    i = 0
+    for p in pat:
+        if p == "...":
+            return True
+        if i >= len(toks):
+            # the code string is a prefix of the row: the runtime
+            # appends the detail ("ERR backend " + repr(e))
+            return True
+        if p.startswith("<") or p == "(N)" or "%" in toks[i]:
+            i += 1
+            continue
+        if p != toks[i]:
+            return False
+        i += 1
+    # an exact (wildcard-less) row must not leave a code tail unmatched
+    return i >= len(toks)
+
+
+def err_vocab_findings(project: Project, doc_dir: str) -> List[Finding]:
+    out: List[Finding] = []
+    pats = _err_vocab_patterns(doc_dir)
+    if not pats:
+        return out
+    for mod in project.modules.values():
+        if os.path.basename(mod.path) not in ERR_VOCAB_MODULES:
+            continue
+        seen = set()
+        for node in mod.nodes:
+            s = const_str(node)
+            if s is None or not s.startswith("ERR ") \
+                    or (s, node.lineno) in seen:
+                continue
+            seen.add((s, node.lineno))
+            toks = s.split()[1:]
+            if not toks:
+                continue
+            if not any(_err_matches(p, toks) for p in pats):
+                out.append(Finding(
+                    "err-vocab", mod.path, node.lineno,
+                    "error string %r matches no row of doc/serving.md "
+                    "'### Error vocabulary'" % s, key=s))
+    return out
+
+
+# ----------------------------------------------------------------------
 # metric registry
 # ----------------------------------------------------------------------
 
@@ -1411,6 +1496,8 @@ def run_lint(root: str = ROOT, pkg: str = PKG,
     findings.extend(traced_branch_findings(project))
     findings.extend(timed_dispatch_findings(project))
     findings.extend(conf_findings(
+        project, doc_dir or os.path.join(root, "doc")))
+    findings.extend(err_vocab_findings(
         project, doc_dir or os.path.join(root, "doc")))
     findings.extend(metric_findings(project))
 
