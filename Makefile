@@ -41,6 +41,14 @@ test-fast:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
 		-p no:cacheprovider
 
+# where the tier-1 wall-clock goes: the 15 slowest tests of the same
+# selection test-fast runs — watch this when adding tests so the fast
+# pass stays fast (anything that can't get under ~5s belongs behind
+# @pytest.mark.slow instead)
+t1-slowest:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+		-p no:cacheprovider --durations=15 --durations-min=0.5
+
 # project-native static analysis (doc/static_analysis.md): lock-order /
 # blocking-under-lock rules, JAX hazards (donated reuse, traced
 # branches, wall-clock durations, dispatch-vs-compute spans), the
@@ -72,4 +80,4 @@ check:
 		sys.exit(lockrank.selftest(verbose=True))"
 	python tools/cxxlint.py --selftest
 
-.PHONY: all clean test-fast check lint
+.PHONY: all clean test-fast t1-slowest check lint

@@ -541,18 +541,20 @@ class _PagedSlotBackend:
     so it only names the stream)."""
 
     def __init__(self, tr, buckets, n_new, block, pool_tokens,
-                 prefix_reuse=True):
+                 prefix_reuse=True, retained_frac=1.0):
         self.tr = tr
         self.buckets = list(buckets)
         self.n_new = int(n_new)
         self.block = int(block)
         self.pool_tokens = int(pool_tokens)
         self.prefix_reuse = bool(prefix_reuse)
+        self.retained_frac = float(retained_frac)
 
     def _pool(self):
         return self.tr.decode_kv_pool(self.block,
                                       pool_tokens=self.pool_tokens,
-                                      prefix_reuse=self.prefix_reuse)
+                                      prefix_reuse=self.prefix_reuse,
+                                      retained_frac=self.retained_frac)
 
     def _live_pool(self):
         p = getattr(self.tr, "_kv_pool", None)
@@ -567,8 +569,16 @@ class _PagedSlotBackend:
         return p.account() if p is not None else None
 
     def kv_free_blocks(self):
+        # free + evictable-retained: under retention the gather budget
+        # must see parked blocks as headroom (evict-before-defer)
         p = self._live_pool()
-        return p.alloc.free_blocks if p is not None else None
+        return p.alloc.available_blocks if p is not None else None
+
+    def kv_shed_retained(self, target_free):
+        p = self._live_pool()
+        if p is None:
+            return 0
+        return p.alloc.evict_retained(target_free=target_free)
 
     def kv_fresh_blocks(self, toks):
         p = self._live_pool()
@@ -807,6 +817,92 @@ def bench_serve_prefix_reuse():
             "error_rate": round(nerr[0] / float(total), 4),
             "requests": nsent[0], "bucket": bucket,
             "shared_tokens": shared, "prompt_tokens": shared + tail}
+
+
+def bench_serve_multiturn_ttft():
+    """Multi-turn conversation TTFT over the RETAINED conversation
+    cache (doc/robustness.md "Memory governance"): turn N+1 extends
+    turn N's prompt, so with retention the retired chain REVIVES at
+    admission (refcount 0 -> 1) and prefill computes only the new
+    tail; with a cold trie (serve_retained_frac 0 — the PR 15
+    free-instantly contract) every turn re-prefills the whole
+    conversation. Headline is the warm-trie turn-N+1 TTFT in ms
+    (LOWER is better — the *_ms direction rule); ``cold_ttft_ms``
+    carries the same turn over the cold trie, measured with identical
+    programs (both program shapes warmed outside the window), so
+    warm < cold is pure recompute avoided, not compile skew.
+    ``prefix_hit_rate``/``kv_retained_pct`` pin that the warm pass
+    really served from retained mass. CPU-measurable (tiny model,
+    greedy, sequential turns)."""
+    from cxxnet_tpu.models import transformer_lm_trainer
+    from cxxnet_tpu.utils import servd
+    from cxxnet_tpu.utils.servd import _ask
+    from cxxnet_tpu.utils.telemetry import percentile
+    vocab, L, n_new = 8192, 256, 8
+    block, bucket = 16, 2
+    # a LONG turn 1 (most of the context window) and a short turn-2
+    # tail: the shape where retention pays — turn 2 revives 192 tokens
+    # and computes 16, vs a 208-token cold re-prefill
+    base, grow, nconv = 192, 16, 4
+    tr = transformer_lm_trainer(vocab=vocab, seq=L, batch_size=8,
+                                dim=256, nhead=4, nlayer=2, dev="tpu",
+                                extra_cfg=BF16)
+    rs = np.random.RandomState(11)
+    # conversations: distinct content, identical shape — turn 2's
+    # prompt is turn 1's plus one grown block
+    convs = [rs.randint(0, vocab, base + grow).tolist()
+             for _ in range(nconv + 1)]
+
+    def run_pass(retained_frac):
+        backend = _PagedSlotBackend(tr, [bucket], n_new, block=block,
+                                    pool_tokens=bucket * L,
+                                    retained_frac=retained_frac)
+        fe = servd.ServeFrontend(None, slot_backend=backend,
+                                 queue_size=64, batch_max=bucket,
+                                 batch_window_ms=5.0,
+                                 batch_flight_cap=4096)
+        fe.start()
+        port = fe.listen(0)
+        # warm BOTH program shapes outside the window: the cold pass
+        # prefills (base+grow, 0), the warm pass (base, 0) then the
+        # revived suffix (base+grow, base) — conversation 0 is the
+        # sacrificial compile turn in each pass
+        toks = convs[0]
+        _ask(port, " ".join(map(str, toks[:base])), timeout=600.0)
+        _ask(port, " ".join(map(str, toks)), timeout=600.0)
+        for conv in convs[1:]:
+            _ask(port, " ".join(map(str, conv[:base])), timeout=600.0)
+            _ask(port, " ".join(map(str, conv)), timeout=600.0)
+        # turn-N+1 TTFT from the flight ring, keyed by prompt length
+        # (only final turns are base+grow tokens long); the ring is
+        # newest-first, so the sacrificial compile turn is LAST
+        ttfts = [1e3 * r["ttft_s"] for r in fe.flight.list()
+                 if r.get("ttft_s") is not None
+                 and r.get("tokens_in") == base + grow][:-1]
+        snap = fe.batch_snapshot() or {}
+        pool = snap.get("pool") or {}
+        fe.drain()
+        tr.release_kv_pool()
+        return ttfts, pool
+
+    cold_ttfts, _ = run_pass(0.0)
+    warm_ttfts, pool = run_pass(1.0)
+    warm = (round(percentile(sorted(warm_ttfts), 50), 3)
+            if warm_ttfts else None)
+    cold = (round(percentile(sorted(cold_ttfts), 50), 3)
+            if cold_ttfts else None)
+    return {"metric": "serve_multiturn_ttft", "value": warm,
+            "unit": "ms", "vs_baseline": None,
+            "cold_ttft_ms": cold,
+            "ttft_speedup": round(cold / warm, 3)
+            if warm and cold else None,
+            "prefix_hit_rate": pool.get("prefix_hit_rate"),
+            "retained_hit_rate": pool.get("retained_hit_rate"),
+            "kv_retained_pct": pool.get("kv_retained_pct"),
+            "retained_hits": pool.get("retained_hits"),
+            "retained_evictions": pool.get("retained_evictions"),
+            "conversations": nconv, "turn_tokens": base + grow,
+            "revived_tokens": base}
 
 
 def bench_serve_fleet():
@@ -1665,6 +1761,7 @@ def _bench_main():
                    bench_lm_decode_chunked, bench_lm_decode_long_chunked,
                    bench_lm_decode_b1_chunked, bench_serve_load,
                    bench_serve_throughput, bench_serve_prefix_reuse,
+                   bench_serve_multiturn_ttft,
                    bench_serve_fleet,
                    bench_serve_tenant_isolation,
                    bench_serve_chaos_availability,

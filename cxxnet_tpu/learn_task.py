@@ -74,7 +74,8 @@ class _SlotBackendAdapter:
     reload — slot caches hold the old model's K/V)."""
 
     def __init__(self, task, buckets, kv_block: int = 0,
-                 kv_pool_frac: float = 0.5, prefix_reuse: bool = True):
+                 kv_pool_frac: float = 0.5, prefix_reuse: bool = True,
+                 retained_frac: float = 1.0):
         self.task = task
         self.buckets = list(buckets)
         # serve_kv_block > 0 arms the PAGED decode KV cache
@@ -86,6 +87,10 @@ class _SlotBackendAdapter:
         self.kv_block = int(kv_block)
         self.kv_pool_frac = float(kv_pool_frac)
         self.prefix_reuse = bool(prefix_reuse)
+        # serve_retained_frac: retired conversations stay trie-resident
+        # (evictable, refcount 0) up to this fraction of the pool — the
+        # multi-turn warm-cache (doc/robustness.md "Memory governance")
+        self.retained_frac = float(retained_frac)
 
     def admits(self, toks):
         t = self.task
@@ -108,7 +113,8 @@ class _SlotBackendAdapter:
         return t.net_trainer.decode_kv_pool(
             self.kv_block,
             pool_tokens=max(self.buckets) * l_max,
-            prefix_reuse=self.prefix_reuse, bytes_cap=cap)
+            prefix_reuse=self.prefix_reuse, bytes_cap=cap,
+            retained_frac=self.retained_frac)
 
     def _live_pool(self):
         """The pool if it EXISTS and is open — the account/gate hooks
@@ -125,9 +131,22 @@ class _SlotBackendAdapter:
         return p.account() if p is not None else None
 
     def kv_free_blocks(self):
-        """Free-list level for servd's gather budget (None disarms)."""
+        """Admissible headroom for servd's gather budget (None
+        disarms). Free PLUS evictable-retained blocks — reporting the
+        bare free list under retention would defer requests forever
+        while reclaimable memory sits parked (the evict-before-defer
+        livelock)."""
         p = self._live_pool()
-        return p.alloc.free_blocks if p is not None else None
+        return p.alloc.available_blocks if p is not None else None
+
+    def kv_shed_retained(self, target_free):
+        """servd's pressure-latch shed hook: evict retained (LRU,
+        deepest-suffix-first) until the free list reaches
+        ``target_free``. Returns blocks recycled (0 in dense mode)."""
+        p = self._live_pool()
+        if p is None:
+            return 0
+        return p.alloc.evict_retained(target_free=target_free)
 
     def kv_fresh_blocks(self, toks):
         """Blocks an admission would pull off the free list right now
@@ -291,6 +310,18 @@ class LearnTask:
         # cap, the pool sizes at dense-equivalent capacity)
         self.serve_kv_pool_frac = 0.5
         self.serve_prefix_reuse = 1
+        # retained conversation cache (doc/robustness.md "Memory
+        # governance"): a retired sequence's registered blocks stay
+        # trie-resident at refcount 0 — evictable headroom, not a
+        # commitment — so the next turn of a multi-turn conversation
+        # revives its prefix instead of re-prefilling it. Cap as a
+        # fraction of the usable pool; 0 restores free-instantly.
+        self.serve_retained_frac = 1.0
+        # KV pressure latch: free-list percentage below which servd
+        # sheds retained mass proactively (cxxnet_decode_kv_pressure),
+        # and the hysteresis clear threshold it sheds back up to
+        self.serve_kv_pressure_pct = 10.0
+        self.serve_kv_pressure_clear_pct = 25.0
         # decode-datapath observability (doc/observability.md "Decode
         # datapath"): the iteration-level scheduler flight ring behind
         # statusd /batchz (one record per decode iteration: slots,
@@ -626,6 +657,12 @@ class LearnTask:
             self.serve_kv_pool_frac = float(val)
         if name == "serve_prefix_reuse":
             self.serve_prefix_reuse = int(val)
+        if name == "serve_retained_frac":
+            self.serve_retained_frac = float(val)
+        if name == "serve_kv_pressure_pct":
+            self.serve_kv_pressure_pct = float(val)
+        if name == "serve_kv_pressure_clear_pct":
+            self.serve_kv_pressure_clear_pct = float(val)
         if name == "serve_batch_flight_cap":
             self.serve_batch_flight_cap = int(val)
         if name == "serve_convoy_iters":
@@ -1627,7 +1664,8 @@ class LearnTask:
             slot_backend = _SlotBackendAdapter(
                 self, bucket_list, kv_block=self.serve_kv_block,
                 kv_pool_frac=self.serve_kv_pool_frac,
-                prefix_reuse=bool(self.serve_prefix_reuse))
+                prefix_reuse=bool(self.serve_prefix_reuse),
+                retained_frac=self.serve_retained_frac)
             if not self.silent:
                 print("serve: continuous batching on (buckets %s, "
                       "batch_max %d, window %.1fms%s)"
@@ -1650,6 +1688,8 @@ class LearnTask:
             batch_window_ms=self.serve_batch_window_ms,
             batch_flight_cap=self.serve_batch_flight_cap,
             convoy_iters=self.serve_convoy_iters,
+            kv_pressure_pct=self.serve_kv_pressure_pct,
+            kv_pressure_clear_pct=self.serve_kv_pressure_clear_pct,
             tenants=tenants, tenant_default=self.serve_tenant_default,
             slo_tenants=slo_tenants)
         fe.start()

@@ -1842,7 +1842,8 @@ class Trainer:
 
     def decode_kv_pool(self, block: int, pool_tokens: int = 0,
                        prefix_reuse: bool = True,
-                       bytes_cap: Optional[int] = None) -> "KVBlockPool":
+                       bytes_cap: Optional[int] = None,
+                       retained_frac: float = 1.0) -> "KVBlockPool":
         """The process-wide paged decode KV pool (created on first use,
         shared by every paged ``decode_session`` whatever its bucket —
         sharing across buckets is what makes a shared system prompt
@@ -1860,7 +1861,8 @@ class Trainer:
         if p is None:
             p = KVBlockPool(self, int(block), pool_tokens=pool_tokens,
                             prefix_reuse=prefix_reuse,
-                            bytes_cap=bytes_cap)
+                            bytes_cap=bytes_cap,
+                            retained_frac=retained_frac)
             self._kv_pool = p
         return p
 
@@ -2186,7 +2188,10 @@ class KVBlockPool:
     perf ledger's HBM account provides one
     (``perf.decode_pool_cap_bytes``: capacity − peak program
     footprint). Exhaustion is the ALLOCATOR's verdict — admission
-    defers; the device never OOMs allocating a cache row.
+    evicts retained conversation blocks before deferring
+    (``retained_frac`` caps the retained pool; doc/robustness.md
+    "Memory governance"); the device never OOMs allocating a cache
+    row.
 
     Lifecycle: created lazily by ``Trainer.decode_kv_pool``, keyed on
     the params generation; ``release()`` (worker drain, model reload)
@@ -2197,7 +2202,8 @@ class KVBlockPool:
 
     def __init__(self, trainer: Trainer, block: int,
                  pool_tokens: int = 0, prefix_reuse: bool = True,
-                 bytes_cap: Optional[int] = None):
+                 bytes_cap: Optional[int] = None,
+                 retained_frac: float = 1.0):
         from ..utils import kvblocks
         check(block >= 1, "decode_kv_pool: block must be >= 1")
         self.tr = trainer
@@ -2231,7 +2237,8 @@ class KVBlockPool:
                                    self.cache_dtype)
                       for k in self.cache_keys}
         self.alloc = kvblocks.BlockAllocator(
-            nb, self.bs, prefix_reuse=prefix_reuse)
+            nb, self.bs, prefix_reuse=prefix_reuse,
+            retained_frac=retained_frac)
         self.closed = False
         import weakref
         self._sessions = weakref.WeakSet()
@@ -2737,9 +2744,11 @@ class DecodeSession:
         ticket = pool.alloc.admit(toks, self.n_new)
         if ticket is None:
             raise KVPoolExhausted(
-                "decode_session: kv block pool exhausted (%d free of "
-                "%d) — request needs %d fresh blocks; defer admission"
-                % (pool.alloc.free_blocks, pool.alloc.usable,
+                "decode_session: kv block pool exhausted (%d free + %d "
+                "retained of %d) — request needs %d fresh blocks; "
+                "defer admission"
+                % (pool.alloc.free_blocks, pool.alloc.retained_blocks,
+                   pool.alloc.usable,
                    pool.alloc.blocks_for(plen, self.n_new)))
         ids, p0 = ticket.ids, ticket.p0
         pre_fn = self._prefill_fn_paged(plen, p0)

@@ -37,20 +37,49 @@ gathered scratch block only ever covers masked positions).
   content through the gathered view (``cow_copies`` counts these).
   Every other write lands past the shared prefix in exclusively-owned
   blocks by construction.
-* ``free(ids)`` decrements refcounts; a block reaching zero leaves the
-  trie and returns to the free list in the same step — accounting is
-  exact at every instant (no deferred reclamation, no leak: after the
-  last holder frees, ``blocks_free`` equals the usable pool and the
-  trie is empty).
+* ``free(ids)`` decrements refcounts; a REGISTERED block reaching zero
+  moves to the **retained pool** instead of the free list, keeping its
+  trie key — the cross-request conversation cache (doc/robustness.md
+  "Memory governance"): turn N+1 of a conversation revives the blocks
+  turn N computed (refcount 0 -> 1, a *retained* hit) instead of
+  re-prefilling them. Unregistered blocks (a faulted prefill's, or any
+  block with ``prefix_reuse`` off) still free instantly. Accounting
+  stays exact at every instant: ``live + retained + free == pool``,
+  always (``check()`` asserts it).
+* Eviction is **cost-to-recompute LRU, deepest-suffix first**: the
+  free list is served first; when it runs dry the allocator evicts the
+  least-recently-retired retained LEAF — a block with no trie-resident
+  descendant. Leaf-only eviction is a correctness rule, not a policy:
+  a trie child's key names its parent's block id, so evicting a parent
+  whose descendant is still resident would let a recycled id serve
+  stale KV under new content. (A retained block can never have a LIVE
+  descendant — ``admit`` refcounts the whole shared chain from the
+  root, so a live block's ancestors are all live — which also means a
+  nonempty retained pool always has an evictable leaf: eviction can
+  always make progress, and exhaustion can never deadlock a
+  reserve-up-front admission.)
+* Evict-before-defer: ``admit`` reserves against free PLUS evictable
+  retained blocks — it returns None (servd's deterministic queue-wait)
+  only when live + reserved blocks alone exceed the pool. Eviction and
+  reservation happen atomically under the allocator's admission lock.
 
 Thread model: single mutating owner (servd's worker thread drives
-every admit/free through the session). The published account travels
-through servd's admission-lock snapshot (``_publish_batch_state``) —
-the allocator itself takes no lock, so the cxxlint lock graph is
-untouched.
+every admit/free through the session); the published account travels
+through servd's admission-lock snapshot (``_publish_batch_state``).
+The mutating entry points (``admit``/``free``/``register``/
+``evict_retained``) additionally serialize under one ranked lock,
+``kvblocks.evict`` (lockrank.RANKS rank 15) — it nests INSIDE servd's
+admission lock (``servd.queue``, rank 10) and never the reverse, so a
+pressure shed issued from the dispatcher while coalescing a batch
+cannot invert against an in-flight reservation; ``CXXNET_LOCKRANK=1``
+(the chaos harness) asserts the order at runtime. Read-only queries
+(``match_prefix``/``fresh_need``/``reservable``/``account``) stay
+lockless under the single-owner model.
 """
 
 from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import lockrank
 
 __all__ = ["BlockAllocator", "AdmitTicket", "KVPoolExhausted"]
 
@@ -94,7 +123,8 @@ class BlockAllocator:
     """Free-list allocator with refcounted shared-prefix blocks."""
 
     def __init__(self, blocks: int, block_size: int,
-                 prefix_reuse: bool = True):
+                 prefix_reuse: bool = True,
+                 retained_frac: float = 1.0):
         if blocks < 2:
             raise ValueError("kvblocks: need >= 2 blocks "
                              "(one is the reserved scratch block)")
@@ -103,6 +133,12 @@ class BlockAllocator:
         self.blocks = int(blocks)
         self.bs = int(block_size)
         self.prefix_reuse = bool(prefix_reuse)
+        # retained-pool cap as a fraction of the usable pool
+        # (serve_retained_frac). 0 restores the PR 15 free-instantly
+        # contract; the default retains everything reclaimable —
+        # retained blocks are evictable headroom, not a commitment
+        self.retained_frac = max(0.0, min(1.0, float(retained_frac)))
+        self.retained_cap = int(self.retained_frac * (self.blocks - 1))
         # ascending allocation order (pop() from the tail): determinism
         # the tests and the flight ring rely on
         self._free: List[int] = list(range(self.blocks - 1, 0, -1))
@@ -110,6 +146,18 @@ class BlockAllocator:
         # (prev block id | 0 at the root, block token tuple) -> block id
         self._trie: Dict[Tuple[int, tuple], int] = {}
         self._key_of: Dict[int, Tuple[int, tuple]] = {}
+        # refcount-0 blocks still resident in the trie: block id ->
+        # retire stamp (monotonic clock; min stamp = LRU). A chain
+        # retires parent-before-child, so the LRU leaf is the oldest
+        # conversation's deepest suffix — the eviction order
+        self._retained: Dict[int, int] = {}
+        self._rclock = 0
+        # trie-resident children per parent block id (leaf test for
+        # eviction); root (0) is not tracked
+        self._children: Dict[int, int] = {}
+        # serializes reservation+eviction+release (see module doc:
+        # rank 15, nests inside servd.queue)
+        self._lock = lockrank.lock("kvblocks.evict")
         # lifetime tallies (the cxxnet_decode_kv_block_* series) —
         # counted at admission SUCCESS only: a deferred ask retries
         # and must tally once, not once per attempt (alloc_failures
@@ -121,6 +169,9 @@ class BlockAllocator:
         self.prompt_tokens = 0       # prompt tokens admitted
         self.cow_copies = 0          # copy-on-write block demotions
         self.alloc_failures = 0      # admissions deferred on exhaustion
+        self.retained_hits = 0       # admissions served from retained
+        self.retained_hit_tokens = 0  # hit tokens beyond the live chain
+        self.retained_evictions = 0  # retained blocks recycled
 
     # -- geometry ------------------------------------------------------
     @property
@@ -134,7 +185,25 @@ class BlockAllocator:
 
     @property
     def used_blocks(self) -> int:
+        """Blocks not on the free list (live + retained)."""
         return self.usable - len(self._free)
+
+    @property
+    def retained_blocks(self) -> int:
+        return len(self._retained)
+
+    @property
+    def live_blocks(self) -> int:
+        """Blocks held by a live (refcount > 0) sequence."""
+        return self.used_blocks - len(self._retained)
+
+    @property
+    def available_blocks(self) -> int:
+        """Admissible headroom: free plus cascade-evictable retained
+        blocks. The per-request form (``reservable``) subtracts the
+        request's OWN pinned chain — the shared blocks it is about to
+        revive and its CoW gather source fund nothing."""
+        return len(self._free) + len(self._retained)
 
     def blocks_for(self, plen: int, n_new: int) -> int:
         """Blocks a (prompt, budget) sequence can ever write: cache
@@ -181,20 +250,44 @@ class BlockAllocator:
             shared -= 1       # the CoW demotion needs a fresh target
         return need - max(0, shared)
 
+    def _pinned(self, shared: List[int], cow_src: Optional[int]) -> int:
+        """Retained blocks this admission itself pins: the chain it is
+        about to revive plus a retained CoW gather source — they cannot
+        be evicted to fund the same admission's fresh need."""
+        n = sum(1 for b in shared if b in self._retained)
+        if cow_src is not None and cow_src in self._retained:
+            n += 1
+        return n
+
     def reservable(self, plen: int, n_new: int,
                    toks: Optional[Sequence[int]] = None) -> bool:
         """Whether ``admit`` would succeed RIGHT NOW — the admission
-        gate. With ``toks`` the shared prefix is credited."""
-        return self.fresh_need(plen, n_new, toks) <= len(self._free)
+        gate. With ``toks`` the shared prefix is credited. Retained
+        blocks count as headroom (evict-before-defer): False means
+        live + reserved blocks alone exceed the pool."""
+        shared = self.match_prefix(toks) if toks is not None else []
+        cow_src = None
+        if shared and len(shared) * self.bs >= plen:
+            cow_src = shared.pop()
+        need = self.blocks_for(plen, n_new) - len(shared)
+        return need <= (len(self._free) + len(self._retained)
+                        - self._pinned(shared, cow_src))
 
     # -- reserve / release ---------------------------------------------
     def admit(self, toks: Sequence[int],
               n_new: int) -> Optional[AdmitTicket]:
         """Reserve every block for (prompt, generation budget): shared
-        full-prefix blocks are refcounted, the rest come off the free
-        list. Returns None when the free list cannot cover the fresh
-        need (nothing moves — the caller defers: servd's deterministic
-        queue-wait, never a device OOM)."""
+        full-prefix blocks are refcounted (a retained match is REVIVED:
+        refcount 0 -> 1, a retained hit), the rest come off the free
+        list — evicting retained LRU leaves when it runs dry, atomically
+        under the admission lock. Returns None only when live + reserved
+        blocks alone exceed the pool (nothing moves — the caller defers:
+        servd's deterministic queue-wait, never a device OOM)."""
+        with self._lock:
+            return self._admit(toks, n_new)
+
+    def _admit(self, toks: Sequence[int],
+               n_new: int) -> Optional[AdmitTicket]:
         plen = len(toks)
         if plen < 1:
             raise ValueError("kvblocks: empty prompt")
@@ -213,7 +306,8 @@ class BlockAllocator:
             # demote the last match to a gather source (CoW)
             cow_src = shared.pop()
         fresh_need = need - len(shared)
-        if fresh_need > len(self._free):
+        if fresh_need > (len(self._free) + len(self._retained)
+                         - self._pinned(shared, cow_src)):
             self.alloc_failures += 1
             return None
         self.prefix_queries += 1
@@ -221,12 +315,37 @@ class BlockAllocator:
         if p0 > 0:
             self.prefix_hits += 1
             self.prefix_hit_tokens += p0
+        # retained sub-source of the hit: tokens of [0, p0) beyond the
+        # LIVE-held chain came from retained content (revived blocks
+        # and/or a retained CoW source). Live blocks form a chain
+        # PREFIX — a live block's ancestors are all live — so the
+        # boundary is the first retained block in the chain.
+        chain = shared + ([cow_src] if cow_src is not None else [])
+        n_live = 0
+        for b in chain:
+            if b in self._retained:
+                break
+            n_live += 1
+        rtoks = max(0, p0 - n_live * self.bs)
+        if rtoks > 0:
+            self.retained_hits += 1
+            self.retained_hit_tokens += rtoks
         if cow_src is not None:
             self.cow_copies += 1
         self.prompt_tokens += plen
         for b in shared:
+            if b in self._retained:
+                del self._retained[b]     # revival: refcount 0 -> 1
             self._ref[b] += 1
-        fresh = [self._free.pop() for _ in range(fresh_need)]
+        fresh: List[int] = []
+        for _ in range(fresh_need):
+            if not self._free:
+                # evict-before-defer: recycle the LRU retained leaf.
+                # The revived chain already left the retained pool;
+                # only the CoW gather source still needs pinning (its
+                # content is gathered by THIS admission's prefill).
+                self._evict_one(exclude=cow_src)
+            fresh.append(self._free.pop())
         for b in fresh:
             self._ref[b] = 1
         ids = shared + fresh
@@ -245,45 +364,132 @@ class BlockAllocator:
         content (its source already serves lookups)."""
         if not self.prefix_reuse:
             return
-        prev = 0
-        bs = self.bs
-        for j in range(len(toks) // bs):
-            b = ticket.ids[j]
-            key = (prev, tuple(int(t) for t in toks[j * bs:(j + 1) * bs]))
-            cur = self._trie.setdefault(key, b)
-            if cur == b:
-                self._key_of[b] = key
-            prev = cur
+        with self._lock:
+            prev = 0
+            bs = self.bs
+            for j in range(len(toks) // bs):
+                b = ticket.ids[j]
+                key = (prev,
+                       tuple(int(t) for t in toks[j * bs:(j + 1) * bs]))
+                cur = self._trie.get(key)
+                if cur is None:
+                    self._trie[key] = b
+                    self._key_of[b] = key
+                    if prev:
+                        self._children[prev] = \
+                            self._children.get(prev, 0) + 1
+                    cur = b
+                prev = cur
 
     def free(self, ids: Sequence[int]) -> None:
         """Release one holder's blocks (retire / deadline-evict /
-        close): refcounts drop, a block reaching zero leaves the trie
-        and returns to the free list immediately — the account is
-        exact at every instant."""
-        for b in ids:
-            if not 1 <= b < self.blocks:
-                raise ValueError("kvblocks: bad block id %r" % (b,))
-            self._ref[b] -= 1
-            if self._ref[b] < 0:
-                raise ValueError("kvblocks: double free of block %d" % b)
-            if self._ref[b] == 0:
-                key = self._key_of.pop(b, None)
-                if key is not None and self._trie.get(key) == b:
-                    del self._trie[key]
-                self._free.append(b)
+        close): refcounts drop; a REGISTERED block reaching zero moves
+        to the retained pool (trie key kept — the conversation cache),
+        an unregistered one returns to the free list. The account is
+        exact at every instant: live + retained + free == pool."""
+        with self._lock:
+            for b in ids:
+                if not 1 <= b < self.blocks:
+                    raise ValueError("kvblocks: bad block id %r" % (b,))
+                self._ref[b] -= 1
+                if self._ref[b] < 0:
+                    raise ValueError(
+                        "kvblocks: double free of block %d" % b)
+                if self._ref[b] != 0:
+                    continue
+                if self.retained_cap > 0 and b in self._key_of:
+                    # retain: keep the trie entry, stamp the LRU clock
+                    # (ids arrive in position order, so a chain stamps
+                    # parent-before-child and the LRU leaf is the
+                    # oldest conversation's deepest suffix)
+                    self._rclock += 1
+                    self._retained[b] = self._rclock
+                else:
+                    self._drop_key(b)
+                    self._free.append(b)
+            # cap AFTER the whole release landed: a parent is never
+            # dropped from the trie before its child is accounted, so
+            # the leaf rule sees the finished chain
+            while len(self._retained) > self.retained_cap:
+                self._evict_one()
+
+    # -- retained pool --------------------------------------------------
+    def _drop_key(self, b: int) -> None:
+        """Remove ``b``'s trie entry (if any) and its parent's child
+        count — the bookkeeping shared by instant-free and eviction."""
+        key = self._key_of.pop(b, None)
+        if key is None:
+            return
+        if self._trie.get(key) == b:
+            del self._trie[key]
+        prev = key[0]
+        if prev:
+            c = self._children.get(prev, 0) - 1
+            if c > 0:
+                self._children[prev] = c
+            else:
+                self._children.pop(prev, None)
+
+    def _evict_one(self, exclude: Optional[int] = None) -> int:
+        """Recycle the LRU retained LEAF (no trie-resident descendant)
+        onto the free list. Always succeeds on a nonempty retained pool
+        (minus ``exclude``): retained blocks never have live
+        descendants, so every retained chain bottoms out in a retained
+        leaf — eviction cannot wedge against reservation."""
+        best = None
+        best_stamp = 0
+        for b, stamp in self._retained.items():
+            if b == exclude or self._children.get(b, 0):
+                continue
+            if best is None or stamp < best_stamp:
+                best, best_stamp = b, stamp
+        if best is None:
+            raise AssertionError(
+                "kvblocks: no evictable retained leaf (%d retained) — "
+                "the leaf invariant is broken" % len(self._retained))
+        del self._retained[best]
+        self._drop_key(best)
+        self._free.append(best)
+        self.retained_evictions += 1
+        return best
+
+    def evict_retained(self, n: Optional[int] = None,
+                       target_free: Optional[int] = None) -> int:
+        """Proactively shed retained mass (servd's low-headroom
+        pressure latch): evict LRU leaves until ``n`` blocks are
+        recycled and/or the free list reaches ``target_free`` (with
+        neither bound, drain the whole retained pool). Returns the
+        number of blocks evicted."""
+        with self._lock:
+            done = 0
+            while self._retained:
+                if n is not None and done >= n:
+                    break
+                if target_free is not None \
+                        and len(self._free) >= target_free:
+                    break
+                self._evict_one()
+                done += 1
+            return done
 
     # -- account / invariants ------------------------------------------
     def account(self) -> dict:
         return {"blocks_total": self.usable,
                 "blocks_free": len(self._free),
                 "blocks_used": self.used_blocks,
+                "blocks_live": self.live_blocks,
+                "blocks_retained": len(self._retained),
+                "retained_cap": self.retained_cap,
                 "block_tokens": self.bs,
                 "prefix_queries": self.prefix_queries,
                 "prefix_hits": self.prefix_hits,
                 "prefix_hit_tokens": self.prefix_hit_tokens,
                 "prompt_tokens": self.prompt_tokens,
                 "cow_copies": self.cow_copies,
-                "alloc_failures": self.alloc_failures}
+                "alloc_failures": self.alloc_failures,
+                "retained_hits": self.retained_hits,
+                "retained_hit_tokens": self.retained_hit_tokens,
+                "retained_evictions": self.retained_evictions}
 
     def check(self) -> None:
         """Assert every structural invariant (the test suite's oracle
@@ -292,16 +498,52 @@ class BlockAllocator:
         free = set(self._free)
         assert len(free) == len(self._free), "free list duplicates"
         assert 0 not in free, "scratch block on the free list"
+        retained = set(self._retained)
+        assert not (free & retained), \
+            "blocks both free and retained: %r" % sorted(free & retained)
+        live = 0
         for b in range(1, self.blocks):
             if b in free:
                 assert self._ref[b] == 0, \
                     "block %d free with refcount %d" % (b, self._ref[b])
+            elif b in retained:
+                assert self._ref[b] == 0, \
+                    "retained block %d holds refcount %d" \
+                    % (b, self._ref[b])
+                assert b in self._key_of, \
+                    "retained block %d has no trie key" % b
             else:
                 assert self._ref[b] > 0, \
-                    "block %d leaked (neither free nor held)" % b
+                    "block %d leaked (neither free, retained nor held)" \
+                    % b
+                live += 1
+        # the books reconcile, always: live + retained + free == pool
+        assert live + len(retained) + len(free) == self.usable, \
+            "books broken: live %d + retained %d + free %d != pool %d" \
+            % (live, len(retained), len(free), self.usable)
+        assert len(retained) <= self.retained_cap, \
+            "retained pool over cap: %d > %d" \
+            % (len(retained), self.retained_cap)
+        children: Dict[int, int] = {}
         for key, b in self._trie.items():
-            assert self._ref[b] > 0, "trie points at dead block %d" % b
+            assert self._ref[b] > 0 or b in retained, \
+                "trie points at dead block %d" % b
             assert self._key_of.get(b) == key, \
                 "trie/_key_of disagree on block %d" % b
+            prev = key[0]
+            if prev:
+                # chain integrity: a resident child's parent must be
+                # resident too (the leaf-only eviction rule's contract)
+                assert prev in self._key_of, \
+                    "block %d's trie parent %d left the trie" % (b, prev)
+                children[prev] = children.get(prev, 0) + 1
+                # and a live child can never hang off a retained
+                # parent (admit refcounts the whole chain)
+                if self._ref[b] > 0:
+                    assert prev not in retained, \
+                        "live block %d under retained parent %d" \
+                        % (b, prev)
+        assert children == self._children, \
+            "child counts drifted: %r != %r" % (children, self._children)
         for b, key in self._key_of.items():
             assert self._trie.get(key) == b

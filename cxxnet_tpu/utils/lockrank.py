@@ -77,6 +77,16 @@ RANKS = {
     #                         (never nested with fleet/stats; IO stays
     #                         outside it)
     "servd.queue": 10,      # ServeFrontend._cond — admission/worker/drain
+    "kvblocks.evict": 15,   # BlockAllocator._lock — KV block
+    #                         reservation + retained-pool eviction
+    #                         (atomic evict-before-defer). Nests INSIDE
+    #                         the admission lock (servd.queue), never
+    #                         the reverse: the dispatcher sheds/admits
+    #                         while coalescing, the allocator never
+    #                         calls back into servd — so exhaustion
+    #                         cannot deadlock a reserve-up-front
+    #                         admission (tests/test_servd.py chaos
+    #                         flood under CXXNET_LOCKRANK=1)
     "servd.conns": 20,      # ServeFrontend._conn_lock — live writer set
     "servd.conn": 30,       # _ConnState.cond — per-connection reply slots
     "servd.request": 40,    # _Request._alock — exactly-once answer claim

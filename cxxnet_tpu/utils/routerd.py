@@ -364,6 +364,8 @@ class Replica:
                  "detail", "hold", "queue_depth", "in_flight",
                  "free_slots", "has_slots", "kv_blocks_total",
                  "kv_blocks_free", "has_kv_blocks",
+                 "kv_retained_blocks", "kv_retained_hits",
+                 "has_kv_retained",
                  "warm_programs", "expected_programs", "has_warm",
                  "buckets", "outstanding", "reloads", "lost",
                  "probe_fails", "ejections", "next_probe_at",
@@ -401,6 +403,13 @@ class Replica:
         self.has_kv_blocks = False   # the same absence-is-the-
         #                              capability-signal discipline as
         #                              free_slots
+        self.kv_retained_blocks = 0  # retained conversation cache
+        self.kv_retained_hits = 0    # (ADMIN kv_retained_blocks/
+        #                              kv_retained_hits): refcount-0
+        #                              blocks parked for revival and
+        #                              the lifetime revival count —
+        #                              absent on pre-retention
+        self.has_kv_retained = False  # replicas ("-", never 0)
         self.warm_programs = 0       # warm-grid readiness from ADMIN
         self.expected_programs = 0   # stats (compiled vs expected
         #                              serving programs) — the /fleetz
@@ -471,6 +480,10 @@ class Replica:
                 if self.has_kv_blocks else None,
                 "kv_blocks_free": self.kv_blocks_free
                 if self.has_kv_blocks else None,
+                "kv_retained_blocks": self.kv_retained_blocks
+                if self.has_kv_retained else None,
+                "kv_retained_hits": self.kv_retained_hits
+                if self.has_kv_retained else None,
                 "warm_programs": self.warm_programs
                 if self.has_warm else None,
                 "expected_programs": self.expected_programs
@@ -843,6 +856,17 @@ class Router:
             except (TypeError, ValueError):
                 r.kv_blocks_total = r.kv_blocks_free = 0
             r.has_kv_blocks = "kv_blocks_total" in st
+            # retained conversation cache (PR 18): same absent-means-
+            # no-retention discipline, same defensive parse — garbage
+            # from a foreign replica must not kill the prober
+            try:
+                r.kv_retained_blocks = int(
+                    st.get("kv_retained_blocks", 0))
+                r.kv_retained_hits = int(
+                    st.get("kv_retained_hits", 0))
+            except (TypeError, ValueError):
+                r.kv_retained_blocks = r.kv_retained_hits = 0
+            r.has_kv_retained = "kv_retained_blocks" in st
             # warm-grid readiness (warm_programs/expected_programs):
             # the compile-cliff account — absent on replicas with no
             # declared grid, and the same defensive parse
@@ -1932,6 +1956,7 @@ class Router:
         # lack the "pool" key (the PR 13 guard: absent never kills)
         pool_reps = blk_total = blk_free = 0
         pfx_hit_toks = pfx_prompt_toks = kv_defers = 0
+        blk_retained = ret_hits = ret_hit_toks = pressure_reps = 0
         for name, snap in sorted(fed.items()):
             b = snap.get("batch")
             if isinstance(b, dict):
@@ -1950,6 +1975,12 @@ class Router:
                         pfx_prompt_toks += int(
                             pl.get("prompt_tokens") or 0)
                         kv_defers += int(pl.get("alloc_failures") or 0)
+                        blk_retained += int(
+                            pl.get("blocks_retained") or 0)
+                        ret_hits += int(pl.get("retained_hits") or 0)
+                        ret_hit_toks += int(
+                            pl.get("retained_hit_tokens") or 0)
+                        pressure_reps += 1 if pl.get("pressure") else 0
                     except (TypeError, ValueError):
                         pass
             m = snap.get("metrics") or {}
@@ -2008,7 +2039,18 @@ class Router:
                     "prefix_hit_rate":
                     round(100.0 * pfx_hit_toks / pfx_prompt_toks, 2)
                     if pfx_prompt_toks else None,
-                    "kv_defers": kv_defers}
+                    "kv_defers": kv_defers,
+                    # retained conversation cache: block/hit sums are
+                    # exact, the fleet hit rate recomputed from token
+                    # sums (never a mean of per-replica rates), and
+                    # pressure_replicas counts latched replicas
+                    "blocks_retained": blk_retained,
+                    "retained_hits": ret_hits,
+                    "retained_hit_tokens": ret_hit_toks,
+                    "retained_hit_rate":
+                    round(100.0 * ret_hit_toks / pfx_prompt_toks, 2)
+                    if pfx_prompt_toks else None,
+                    "pressure_replicas": pressure_reps}
         # the per-tenant fleet account, parsed back out of the summed
         # serve.tenant.<t>.<key> counter series and the merged
         # serve.tenant.<t>.request histograms: fleet-wide per-tenant

@@ -746,7 +746,8 @@ class ServeFrontend:
                  batch_window_ms: float = 0.0,
                  batch_flight_cap: int = 256, convoy_iters: int = 64,
                  tenants=None, tenant_default: str = "default",
-                 slo_tenants=None):
+                 slo_tenants=None, kv_pressure_pct: float = 10.0,
+                 kv_pressure_clear_pct: float = 25.0):
         self.backend = backend
         # multi-tenant weighted-fair QoS (module docstring): a tenant
         # table turns the admission deque into a _FairQueue and arms
@@ -855,6 +856,20 @@ class ServeFrontend:
         self._convoy = False         # latched while a convoy holds
         self._convoys = 0            # episodes (0->1 transitions)
         self._convoy_since = 0       # iteration ordinal of the latch
+        # KV memory-pressure latch (doc/robustness.md "Memory
+        # governance"): latches when the pool's FREE headroom (the
+        # block-exact mirror of the HBM ledger's decode headroom —
+        # the pool is sized under perf.decode_pool_cap_bytes) drops
+        # under kv_pressure_pct percent; while latched the worker
+        # sheds retained conversation blocks (kv_shed_retained hook)
+        # toward kv_pressure_clear_pct and clears there (hysteresis).
+        # 0 disables the latch.
+        self.kv_pressure_pct = float(kv_pressure_pct)
+        self.kv_pressure_clear_pct = max(float(kv_pressure_clear_pct),
+                                         float(kv_pressure_pct))
+        self._kv_pressure = False    # latched under low headroom
+        self._kv_pressures = 0       # episodes (0->1 transitions)
+        self._kv_shed_blocks = 0     # retained blocks shed by the latch
         self._iter_ord = 0           # lifetime step-iteration ordinal
         self._kv_total = 0           # decode_kv_bytes mirror (worker-
         #                              written, read lock-free)
@@ -1056,6 +1071,17 @@ class ServeFrontend:
             pool["prefix_hit_rate"] = (
                 round(100.0 * pool.get("prefix_hit_tokens", 0) / pt, 2)
                 if pt else None)
+            # the RETAINED sub-source of the hit rate (tokens revived
+            # from the conversation cache — refcount-0 blocks a new
+            # turn re-admitted) and the retained share of the pool:
+            # the multi-turn bench's warm-trie evidence
+            pool["retained_hit_rate"] = (
+                round(100.0 * pool.get("retained_hit_tokens", 0)
+                      / pt, 2) if pt else None)
+            bt = pool.get("blocks_total", 0)
+            pool["kv_retained_pct"] = (
+                round(100.0 * pool.get("blocks_retained", 0) / bt, 2)
+                if bt else None)
             snap["pool"] = pool
         if ring > 0:
             snap["flight"] = fl.list(ring)
@@ -1477,6 +1503,12 @@ class ServeFrontend:
                                     ps.get("blocks_total", 0)
                                 live["kv_blocks_free"] = \
                                     ps.get("blocks_free", 0)
+                                live["kv_retained_blocks"] = \
+                                    ps.get("blocks_retained", 0)
+                                live["kv_retained_hits"] = \
+                                    ps.get("retained_hits", 0)
+                                live["kv_pressure"] = \
+                                    1 if self._kv_pressure else 0
                         wp = self.warm_programs()
                         if wp is not None:
                             # warm-grid readiness (the compile-cliff
@@ -1879,6 +1911,8 @@ class ServeFrontend:
                 pool = pool_fn()
             except Exception:
                 pool = None
+        if pool is not None:
+            pool = self._kv_pressure_tick(pool, pool_fn)
         with self._cond:
             self._batch_free = free
             qd = len(self._q)
@@ -1920,6 +1954,60 @@ class ServeFrontend:
                              for bs in self._bucket_state.values()))
         telemetry.gauge("serve.in_flight", len(active))
         return qd, oldest
+
+    def _kv_pressure_tick(self, pool: dict, pool_fn) -> dict:
+        """The low-headroom KV pressure latch (worker thread only,
+        OUTSIDE the admission lock — shedding is host metadata
+        arithmetic on the single mutating owner). Latches when free
+        blocks drop under ``kv_pressure_pct`` percent of the pool,
+        sheds retained conversation blocks toward
+        ``kv_pressure_clear_pct`` (the ``kv_shed_retained`` hook —
+        proactive evict-ahead-of-flood, distinct from the allocator's
+        own evict-before-defer at admission), and clears only at the
+        higher threshold (hysteresis). One transition-only
+        ``kv_pressure`` flight event per episode; the latch itself
+        travels in the published pool snapshot (``pressure``) to
+        /batchz, ADMIN stats and ``cxxnet_decode_kv_pressure``."""
+        total = int(pool.get("blocks_total") or 0)
+        if total <= 0 or self.kv_pressure_pct <= 0:
+            return pool
+        free_pct = 100.0 * int(pool.get("blocks_free") or 0) / total
+        if not self._kv_pressure and free_pct < self.kv_pressure_pct:
+            self._kv_pressure = True
+            self._kv_pressures += 1
+            telemetry.count("serve.kv_pressure")
+            telemetry.event({
+                "ev": "kv_pressure", "pressure": 1,
+                "free_pct": round(free_pct, 2),
+                "retained": int(pool.get("blocks_retained") or 0)})
+        if self._kv_pressure:
+            shed_fn = getattr(self.slot_backend, "kv_shed_retained",
+                              None)
+            if shed_fn is not None \
+                    and int(pool.get("blocks_retained") or 0) > 0:
+                target = -(-int(self.kv_pressure_clear_pct * total)
+                           // 100)
+                try:
+                    shed = int(shed_fn(target) or 0)
+                except Exception:
+                    shed = 0      # a shed must never kill the worker
+                if shed > 0:
+                    self._kv_shed_blocks += shed
+                    telemetry.count("serve.kv_shed_blocks", shed)
+                    try:
+                        pool = pool_fn() or pool
+                    except Exception:
+                        pass
+                    free_pct = (100.0 * int(pool.get("blocks_free")
+                                            or 0) / total)
+            if free_pct >= self.kv_pressure_clear_pct:
+                self._kv_pressure = False
+                telemetry.event({
+                    "ev": "kv_pressure", "pressure": 0,
+                    "free_pct": round(free_pct, 2)})
+        pool = dict(pool)
+        pool["pressure"] = 1 if self._kv_pressure else 0
+        return pool
 
     def _drop_inflight(self, req: _Request) -> None:
         """A popped request got its final answer: leave drain's

@@ -559,6 +559,35 @@ def prometheus_metrics(snapshot: dict, progress: Optional[dict] = None,
                      help_="share of admitted prompt tokens served "
                            "from resident shared blocks (token-"
                            "weighted, %)")
+            # retained conversation cache (doc/robustness.md "Memory
+            # governance"): parked refcount-0 blocks, the revival
+            # tallies, eviction churn, and the pressure latch
+            emit("cxxnet_decode_kv_block_retained", "gauge",
+                 int(pool.get("blocks_retained", 0)),
+                 help_="refcount-0 blocks parked in the retained "
+                       "conversation cache (evictable headroom)")
+            emit("cxxnet_decode_retained_hits_total", "counter",
+                 int(pool.get("retained_hits", 0)),
+                 help_="admissions that REVIVED a retired "
+                       "conversation's blocks (the retained sub-"
+                       "source of the prefix hit rate)")
+            emit("cxxnet_decode_retained_hit_tokens_total", "counter",
+                 int(pool.get("retained_hit_tokens", 0)))
+            emit("cxxnet_decode_retained_evictions_total", "counter",
+                 int(pool.get("retained_evictions", 0)),
+                 help_="retained blocks recycled onto the free list "
+                       "(LRU, deepest-suffix-first)")
+            if _num(pool.get("retained_hit_rate")):
+                emit("cxxnet_decode_retained_hit_rate", "gauge",
+                     pool["retained_hit_rate"],
+                     help_="share of admitted prompt tokens served "
+                           "from RETAINED (refcount-0) blocks")
+            if "pressure" in pool:
+                emit("cxxnet_decode_kv_pressure", "gauge",
+                     1 if pool.get("pressure") else 0,
+                     help_="1 while the low-headroom latch sheds "
+                           "retained mass (kv_pressure events mark "
+                           "the transitions)")
     if fleet is not None:
         # the routing fleet (routerd.Router.fleet_snapshot()): per-state
         # counts as one labeled family, per-replica load/liveness rows
@@ -744,6 +773,20 @@ def prometheus_metrics(snapshot: dict, progress: Optional[dict] = None,
                                    "%)")
                     emit("cxxnet_fleet_decode_kv_defers_total",
                          "counter", int(pl.get("kv_defers", 0)))
+                    emit("cxxnet_fleet_decode_kv_block_retained",
+                         "gauge", int(pl.get("blocks_retained", 0)),
+                         help_="retained conversation-cache blocks "
+                               "summed over the federated replicas")
+                    emit("cxxnet_fleet_decode_retained_hits_total",
+                         "counter", int(pl.get("retained_hits", 0)))
+                    if _num(pl.get("retained_hit_rate")):
+                        emit("cxxnet_fleet_decode_retained_hit_rate",
+                             "gauge", pl["retained_hit_rate"])
+                    emit("cxxnet_fleet_decode_kv_pressure_replicas",
+                         "gauge", int(pl.get("pressure_replicas", 0)),
+                         help_="replicas currently latched in KV "
+                               "memory pressure (shedding retained "
+                               "mass)")
         scale = fleet.get("scale")
         if scale:
             # the closed-loop autoscaler's account (routerd
@@ -961,10 +1004,10 @@ def fleetz_html(snap: dict) -> str:
                     else ""))
     parts.append("</pre><h2>replicas</h2><pre>")
     cols = ("replica", "state", "hold", "queue", "in_flight",
-            "outstanding", "lost", "buckets", "blocks", "warm",
-            "ejections", "probed", "detail")
-    fmt = ("%-21s %-12s %-4s %5s %9s %11s %5s %-12s %-9s %-9s %9s "
-           "%8s  %s")
+            "outstanding", "lost", "buckets", "blocks", "retained",
+            "warm", "ejections", "probed", "detail")
+    fmt = ("%-21s %-12s %-4s %5s %9s %11s %5s %-12s %-9s %-9s %-9s "
+           "%9s %8s  %s")
     parts.append(fmt % cols)
     for r in reps:
         age = r.get("last_probe_age_s")
@@ -993,6 +1036,13 @@ def fleetz_html(snap: dict) -> str:
         blks = ("%s/%s" % (r.get("kv_blocks_free"),
                            r.get("kv_blocks_total"))
                 if r.get("kv_blocks_total") is not None else "-")
+        # retained conversation cache (ADMIN stats
+        # kv_retained_blocks/kv_retained_hits): parked blocks and
+        # lifetime revivals — "-" on pre-retention replicas (None in
+        # the snapshot; absence is the capability signal)
+        ret = ("%s:%s" % (r.get("kv_retained_blocks"),
+                          r.get("kv_retained_hits"))
+               if r.get("kv_retained_blocks") is not None else "-")
         # warm-grid readiness (ADMIN stats warm_programs/
         # expected_programs): compiled fraction of the replica's
         # expected program grid — "-" when it declares no grid (None
@@ -1006,7 +1056,8 @@ def fleetz_html(snap: dict) -> str:
             "yes" if r.get("hold") else "-", r.get("queue_depth", 0),
             r.get("in_flight", 0), r.get("outstanding", 0),
             r.get("lost", 0),
-            esc(bks), esc(blks), esc(warm), r.get("ejections", 0),
+            esc(bks), esc(blks), esc(ret), esc(warm),
+            r.get("ejections", 0),
             "never" if age is None else "%.1fs" % age,
             esc(detail)))
     parts.append("</pre><h2>router</h2><pre>")
@@ -1058,14 +1109,22 @@ def fleetz_html(snap: dict) -> str:
             pl = dec.get("pool")
             if pl:
                 hr = pl.get("prefix_hit_rate")
+                rr = pl.get("retained_hit_rate")
                 parts.append("paged kv (%d replica(s)): %s/%s blocks "
-                             "free, prefix hit rate %s%%, %s "
-                             "exhaustion defer(s)"
+                             "free, %s retained (%s revival(s), hit "
+                             "rate %s%%), prefix hit rate %s%%, %s "
+                             "exhaustion defer(s)%s"
                              % (pl.get("replicas", 0),
                                 pl.get("blocks_free", 0),
                                 pl.get("blocks_total", 0),
+                                pl.get("blocks_retained", 0),
+                                pl.get("retained_hits", 0),
+                                "n/a" if rr is None else "%.1f" % rr,
                                 "n/a" if hr is None else "%.1f" % hr,
-                                pl.get("kv_defers", 0)))
+                                pl.get("kv_defers", 0),
+                                "  PRESSURE on %d replica(s)"
+                                % pl["pressure_replicas"]
+                                if pl.get("pressure_replicas") else ""))
     scale = snap.get("scale")
     if scale:
         parts.append("</pre><h2>autoscaler</h2><pre>")
@@ -1217,6 +1276,17 @@ def batchz_html(snap: dict) -> str:
                         "n/a" if hr is None else "%.1f" % hr,
                         pool.get("cow_copies", 0),
                         pool.get("alloc_failures", 0)))
+        rr = pool.get("retained_hit_rate")
+        parts.append("retained cache: %s block(s) parked (cap %s), "
+                     "%s revival(s) (%s%% of prompt tokens), %s "
+                     "eviction(s)%s"
+                     % (pool.get("blocks_retained", 0),
+                        pool.get("retained_cap", 0),
+                        pool.get("retained_hits", 0),
+                        "n/a" if rr is None else "%.1f" % rr,
+                        pool.get("retained_evictions", 0),
+                        "   MEMORY PRESSURE (shedding)"
+                        if pool.get("pressure") else ""))
     parts.append("convoy: %s (%d episode(s); threshold %d iterations "
                  "pinned with queued work at zero free slots)"
                  % ("ACTIVE" if snap.get("convoy") else "none",

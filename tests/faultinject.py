@@ -286,7 +286,9 @@ def slot_backend(buckets=(1, 2, 4), n_new: int = 4,
                  max_prompt: int = 0, l_max: int = 64,
                  kv_row_bytes: int = 1024,
                  kv_pool_blocks: int = 0, kv_block_tokens: int = 4,
-                 kv_gate: bool = True, compile_ms: float = 0.0):
+                 kv_gate: bool = True, kv_retained_frac: float = 0.0,
+                 kv_evict_storm: int = 0, kv_revive_race: bool = False,
+                 compile_ms: float = 0.0):
     """Jax-free slot backend for servd's batching dispatcher — the fake
     twin of ``Trainer.decode_session`` (same duck interface: ``buckets``,
     ``session(bucket)``; a session has ``prefill``/``step``/``retire``/
@@ -330,9 +332,25 @@ def slot_backend(buckets=(1, 2, 4), n_new: int = 4,
     ``KVPoolExhausted`` when the free list cannot cover a request, a
     retired slot frees its blocks mid-decode, and the backend exposes
     the production gate/account hooks (``kv_free_blocks`` /
-    ``kv_fresh_blocks`` / ``kv_pool_account``). ``kv_gate=False``
-    disarms the gather-budget hooks (they return None) so the
-    dispatcher's KVPoolExhausted REQUEUE path is what gets exercised.
+    ``kv_fresh_blocks`` / ``kv_pool_account`` / ``kv_shed_retained``).
+    ``kv_gate=False`` disarms the gather-budget hooks (they return
+    None) so the dispatcher's KVPoolExhausted REQUEUE path is what
+    gets exercised.
+
+    ``kv_retained_frac`` arms the RETAINED-cache twin (the PR 18
+    never-OOM governance; production pools default 1.0 but the twin
+    defaults 0.0 so the deferral-semantics suites keep exercising the
+    free-instantly contract): retired conversations park in the
+    allocator's retained pool and fund later admissions by eviction.
+    Two chaos knobs stress the governance itself — ``kv_evict_storm=N``
+    force-drains the ENTIRE retained pool before every Nth prefill (an
+    eviction storm landing between a gather-time match and the
+    admission that hoped to revive it), and ``kv_revive_race=True``
+    evicts the LRU retained leaf before EVERY admission (the
+    revive-vs-evict race: the block a request is about to revive is
+    exactly the eviction candidate). Under both, admissions must
+    recompute instead of crash, books must reconcile
+    (``alloc.check()``), and replies stay token-exact.
 
     Every session appends to the shared ``backend.journal``:
     ``("admit", slot, iteration, seq)`` / ``("retire", slot,
@@ -391,6 +409,15 @@ def slot_backend(buckets=(1, 2, 4), n_new: int = 4,
                 # front or none (exhaustion defers BEFORE any "device"
                 # work — the session stays open)
                 from cxxnet_tpu.utils.kvblocks import KVPoolExhausted
+                ow.prefills += 1
+                if ow.evict_storm and ow.prefills % ow.evict_storm == 0:
+                    # eviction storm: the whole retained pool vanishes
+                    # between the gather-time match and this admission
+                    ow.alloc.evict_retained()
+                if ow.revive_race:
+                    # revive-vs-evict race: drop the LRU leaf — often
+                    # the very block this admission hoped to revive
+                    ow.alloc.evict_retained(1)
                 ticket = ow.alloc.admit(toks, n)
                 if ticket is None:
                     raise KVPoolExhausted(
@@ -478,10 +505,14 @@ def slot_backend(buckets=(1, 2, 4), n_new: int = 4,
             self.compiled = set()  # the fake jit cache: first hit per
             #                        key pays the (simulated) cliff
             self.alloc = None
+            self.prefills = 0
+            self.evict_storm = int(kv_evict_storm)
+            self.revive_race = bool(kv_revive_race)
             if kv_pool_blocks > 0:
                 from cxxnet_tpu.utils import kvblocks
                 self.alloc = kvblocks.BlockAllocator(
-                    kv_pool_blocks + 1, kv_block_tokens)
+                    kv_pool_blocks + 1, kv_block_tokens,
+                    retained_frac=kv_retained_frac)
 
         def _compile(self, name, key):
             # first-hit compile cliff: sleep the stall, then replay
@@ -509,7 +540,11 @@ def slot_backend(buckets=(1, 2, 4), n_new: int = 4,
         def kv_free_blocks(self):
             if self.alloc is None or not kv_gate:
                 return None
-            return self.alloc.free_blocks
+            # free + evictable-retained: the gather budget MUST see
+            # retained blocks as headroom or requests defer forever
+            # while reclaimable memory sits parked (the evict-before-
+            # defer livelock)
+            return self.alloc.available_blocks
 
         def kv_fresh_blocks(self, toks):
             if self.alloc is None or not kv_gate:
@@ -517,6 +552,11 @@ def slot_backend(buckets=(1, 2, 4), n_new: int = 4,
             t0 = int(toks[0])
             n = self.long_n_new if t0 in self.long_for else self.n_new
             return self.alloc.fresh_need(len(toks), n, toks)
+
+        def kv_shed_retained(self, target_free):
+            if self.alloc is None:
+                return 0
+            return self.alloc.evict_retained(target_free=target_free)
 
         def kv_pool_account(self):
             if self.alloc is None:

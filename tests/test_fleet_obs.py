@@ -925,6 +925,52 @@ def test_bench_compare_decode_subfield_directions(tmp_path):
     assert proc.returncode == 0, proc.stdout
 
 
+def test_bench_compare_multiturn_subfield_directions(tmp_path):
+    """Direction-aware gating for the serve_multiturn_ttft row (the
+    retained conversation cache, doc/robustness.md "Memory
+    governance"): kv_retained_pct, retained_hit_rate and ttft_speedup
+    gate worse-when-LOWER (a drop means the retained cache stopped
+    holding mass / paying), cold_ttft_ms and the ms-unit headline
+    worse-when-HIGHER via the ttft/latency rules."""
+    import subprocess
+    import sys
+    bench = tmp_path / "BENCH_r99.json"
+    bench.write_text(json.dumps({
+        "metric": "serve_multiturn_ttft", "value": 40.0,
+        "unit": "ms", "cold_ttft_ms": 80.0, "ttft_speedup": 1.0,
+        "kv_retained_pct": 10.0, "retained_hit_rate": 5.0}) + "\n")
+    base = tmp_path / "BASELINE.json"
+    base.write_text(json.dumps({"published": {
+        "serve_multiturn_ttft": 25.0,
+        "serve_multiturn_ttft.cold_ttft_ms": 45.0,
+        "serve_multiturn_ttft.ttft_speedup": 1.8,
+        "serve_multiturn_ttft.kv_retained_pct": 60.0,
+        "serve_multiturn_ttft.retained_hit_rate": 45.0}}))
+    proc = subprocess.run(
+        [sys.executable, "tools/bench_compare.py", "--bench",
+         str(bench), "--baseline", str(base)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 2, proc.stdout
+    out = proc.stdout
+    # every field regressed in its own direction
+    assert out.count("REGRESSION") == 5, out
+    for field in ("cold_ttft_ms", "ttft_speedup", "kv_retained_pct",
+                  "retained_hit_rate"):
+        assert field in out, (field, out)
+    # the good directions pass: faster warm TTFT, bigger speedup,
+    # more retained mass — and a slower COLD pass is a regression of
+    # the baseline path, still gated worse-when-higher, so keep it flat
+    bench.write_text(json.dumps({
+        "metric": "serve_multiturn_ttft", "value": 20.0,
+        "unit": "ms", "cold_ttft_ms": 45.0, "ttft_speedup": 2.2,
+        "kv_retained_pct": 70.0, "retained_hit_rate": 50.0}) + "\n")
+    proc = subprocess.run(
+        [sys.executable, "tools/bench_compare.py", "--bench",
+         str(bench), "--baseline", str(base)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout
+
+
 # ----------------------------------------------------------------------
 # the offline --fleet report join
 def test_fleet_report_joins_router_and_replica_shards(tmp_path, capsys):
@@ -1088,6 +1134,179 @@ def test_fleetz_shows_per_bucket_batch_load():
         for t in ts:
             t.join()
         _drain_all(router, rsrv, fe, ss)
+
+
+def test_fleet_federates_retained_pool_and_pressure():
+    """The retained conversation cache federates EXACTLY
+    (doc/robustness.md "Memory governance"): block/hit/token sums over
+    the replicas' own pools, the fleet retained hit rate recomputed
+    from the TOKEN sums (never a mean of per-replica rates), and
+    pressure_replicas counting latched replicas — all riding the
+    cxxnet_fleet_decode_* series and the /fleetz paged-kv line."""
+    s1, _reg1 = _metric_statusd({})
+    s1.batch = _FakeBatch({
+        "kv_bytes": 0, "kv_live_bytes": 0, "convoy": 0, "convoys": 0,
+        "buckets": {}, "pool": {
+            "blocks_total": 8, "blocks_free": 1, "blocks_retained": 5,
+            "prefix_hit_tokens": 30, "prompt_tokens": 100,
+            "alloc_failures": 0, "retained_hits": 2,
+            "retained_hit_tokens": 30, "pressure": 1}})
+    s2, _reg2 = _metric_statusd({})
+    s2.batch = _FakeBatch({
+        "kv_bytes": 0, "kv_live_bytes": 0, "convoy": 0, "convoys": 0,
+        "buckets": {}, "pool": {
+            "blocks_total": 8, "blocks_free": 6, "blocks_retained": 1,
+            "prefix_hit_tokens": 10, "prompt_tokens": 300,
+            "alloc_failures": 0, "retained_hits": 1,
+            "retained_hit_tokens": 10, "pressure": 0}})
+    router = routerd.Router(
+        [("127.0.0.1", 1, s1.port), ("127.0.0.1", 2, s2.port)],
+        probe_ms=3600e3, federate_ms=3600e3, outlier_min_n=1)
+    router.start()
+    rsrv = statusd.StatusServer(0, host="127.0.0.1").start()
+    rsrv.fleet = router
+    try:
+        assert router.federate_now() == 2
+        pl = router.federation_snapshot()["decode"]["pool"]
+        assert pl["blocks_retained"] == 6
+        assert pl["retained_hits"] == 3
+        assert pl["retained_hit_tokens"] == 40
+        # 40/400 = 10% — the EXACT fleet ratio; a mean of the
+        # per-replica rates (30% + 3.33%)/2 ≈ 16.7% would be the lie
+        assert pl["retained_hit_rate"] == 10.0
+        assert pl["pressure_replicas"] == 1
+        metrics = urlopen("http://127.0.0.1:%d/metrics" % rsrv.port,
+                          timeout=5).read().decode()
+        for line in metrics.splitlines():
+            if line and not line.startswith("#"):
+                assert statusd.PROM_LINE_RE.match(line), line
+        want = {"cxxnet_fleet_decode_kv_block_retained": "6",
+                "cxxnet_fleet_decode_retained_hits_total": "3",
+                "cxxnet_fleet_decode_retained_hit_rate": "10.0",
+                "cxxnet_fleet_decode_kv_pressure_replicas": "1"}
+        for name, val in want.items():
+            row = [ln for ln in metrics.splitlines()
+                   if ln.startswith(name + " ")
+                   or ln.startswith(name + "{")]
+            assert len(row) == 1 and row[0].endswith(" " + val), \
+                (name, row)
+        page = urlopen("http://127.0.0.1:%d/fleetz" % rsrv.port,
+                       timeout=5).read().decode()
+        assert "PRESSURE on 1 replica(s)" in page, page
+        assert "6 retained" in page, page
+    finally:
+        _drain_all(router, rsrv, s1, s2)
+
+
+def test_fleetz_retained_column_and_garbage_guard(monkeypatch):
+    """The router parses ADMIN stats' kv_retained_blocks /
+    kv_retained_hits off a REAL retaining replica onto the /fleetz
+    retained column; a replica WITHOUT the retained cache renders "-"
+    (absence is the capability signal, never a lying 0); and garbage
+    values from a foreign replica zero the fields instead of killing
+    the prober thread (the PR 13 guard discipline)."""
+    sb = faultinject.slot_backend(buckets=(4,), n_new=4,
+                                  kv_pool_blocks=8, kv_block_tokens=4,
+                                  kv_retained_frac=1.0)
+    fe = servd.ServeFrontend(None, slot_backend=sb, batch_max=4,
+                             batch_window_ms=0.0, drain_ms=8000.0)
+    fe.start()
+    port = fe.listen(0)
+    ss = statusd.StatusServer(0, host="127.0.0.1").start()
+    ss.register_probe("serving", fe.health_probe)
+    # the retention-less replica: plain echo, no slot backend
+    fe2 = servd.ServeFrontend(lambda toks, seq: [t + 1 for t in toks],
+                              drain_ms=2000.0)
+    fe2.start()
+    port2 = fe2.listen(0)
+    ss2 = statusd.StatusServer(0, host="127.0.0.1").start()
+    ss2.register_probe("serving", fe2.health_probe)
+    router = routerd.Router([("127.0.0.1", port, ss.port),
+                             ("127.0.0.1", port2, ss2.port)],
+                            probe_ms=3600e3, federate_ms=3600e3)
+    router.start()
+    rsrv = statusd.StatusServer(0, host="127.0.0.1").start()
+    rsrv.fleet = router
+    try:
+        # turn 1 retires into the retained pool; turn 2 extends the
+        # same conversation and REVIVES it (>= 1 retained hit)
+        faultinject.serve_request(
+            port, " ".join(str(t) for t in range(1, 9)), timeout=30.0)
+        faultinject.serve_request(
+            port, " ".join(str(t) for t in range(1, 13)), timeout=30.0)
+        router.probe_now()
+        reps = {r["name"]: r
+                for r in router.fleet_snapshot()["replicas"]}
+        warm = reps["127.0.0.1:%d" % port]
+        bare = reps["127.0.0.1:%d" % port2]
+        assert warm["kv_retained_hits"] >= 1, warm
+        assert isinstance(warm["kv_retained_blocks"], int)
+        assert bare["kv_retained_blocks"] is None
+        assert bare["kv_retained_hits"] is None
+        page = urlopen("http://127.0.0.1:%d/fleetz" % rsrv.port,
+                       timeout=5).read().decode()
+        assert "%s:%s" % (warm["kv_retained_blocks"],
+                          warm["kv_retained_hits"]) in page, page
+        # a foreign replica answering garbage for the retained keys:
+        # the guarded parse zeroes the fields, the prober survives
+        monkeypatch.setattr(
+            router, "_replica_stats",
+            lambda r: {"queue_depth": 0, "in_flight": 0,
+                       "kv_retained_blocks": "grue",
+                       "kv_retained_hits": []})
+        router.probe_now()        # must not raise / kill the prober
+        reps = {r["name"]: r
+                for r in router.fleet_snapshot()["replicas"]}
+        warm = reps["127.0.0.1:%d" % port]
+        assert warm["kv_retained_blocks"] == 0
+        assert warm["kv_retained_hits"] == 0
+    finally:
+        _drain_all(router, rsrv, fe, ss, fe2, ss2)
+
+
+def test_batchz_and_metrics_render_retained_cache():
+    """statusd renders the retained-cache account: the /batchz
+    "retained cache:" line (parked/cap/revivals/hit-pct/evictions +
+    the MEMORY PRESSURE flag) and the per-process
+    cxxnet_decode_retained_* / cxxnet_decode_kv_pressure series —
+    pure render off the published pool snapshot."""
+    srv = statusd.StatusServer(0, host="127.0.0.1").start()
+    srv.batch = _FakeBatch({
+        "kv_bytes": 1 << 20, "kv_live_bytes": 1 << 19, "convoy": 0,
+        "convoys": 0, "buckets": {}, "pool": {
+            "blocks_total": 16, "blocks_free": 4, "block_tokens": 8,
+            "pool_bytes": 1 << 20, "prefix_hits": 3,
+            "prefix_queries": 5, "prefix_hit_rate": 40.0,
+            "prefix_hit_tokens": 40, "prompt_tokens": 100,
+            "cow_copies": 0, "alloc_failures": 0,
+            "blocks_retained": 5, "retained_cap": 15,
+            "retained_hits": 2, "retained_hit_tokens": 30,
+            "retained_hit_rate": 30.0, "retained_evictions": 4,
+            "kv_retained_pct": 31.25, "pressure": 1}})
+    base = "http://127.0.0.1:%d" % srv.port
+    try:
+        page = urlopen(base + "/batchz", timeout=5).read().decode()
+        assert "retained cache: 5 block(s) parked (cap 15), " \
+            "2 revival(s) (30.0% of prompt tokens), 4 eviction(s)" \
+            in page, page
+        assert "MEMORY PRESSURE (shedding)" in page, page
+        metrics = urlopen(base + "/metrics", timeout=5).read().decode()
+        for line in metrics.splitlines():
+            if line and not line.startswith("#"):
+                assert statusd.PROM_LINE_RE.match(line), line
+        want = {"cxxnet_decode_kv_block_retained": "5",
+                "cxxnet_decode_retained_hits_total": "2",
+                "cxxnet_decode_retained_hit_tokens_total": "30",
+                "cxxnet_decode_retained_evictions_total": "4",
+                "cxxnet_decode_retained_hit_rate": "30.0",
+                "cxxnet_decode_kv_pressure": "1"}
+        for name, val in want.items():
+            row = [ln for ln in metrics.splitlines()
+                   if ln.startswith(name)]
+            assert len(row) == 1 and row[0].endswith(" " + val), \
+                (name, row)
+    finally:
+        _drain_all(srv)
 
 
 def test_requestz_limit_json_and_single_record():

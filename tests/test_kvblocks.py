@@ -2,9 +2,13 @@
 host half of the paged decode cache (doc/performance.md "Decode KV
 cache"), deliberately jax-free so every allocation-policy invariant is
 testable in milliseconds: alloc/free/refcount bookkeeping, the
-shared-prefix trie, copy-on-write demotion, exhaustion-as-deferral,
-and no-leak accounting after chaos-ordered retire/evict interleavings
-(``BlockAllocator.check()`` is the oracle after every mutation).
+shared-prefix trie, copy-on-write demotion, the retained conversation
+cache (retirement retains registered blocks; revival, LRU
+deepest-suffix-first eviction, evict-before-defer — doc/robustness.md
+"Memory governance"), exhaustion-as-deferral, and no-leak accounting
+after chaos-ordered retire/evict interleavings
+(``BlockAllocator.check()`` is the oracle after every mutation,
+including the ``live + retained + free == pool`` books).
 """
 
 import numpy as np
@@ -92,13 +96,22 @@ def test_prefix_sharing_refcounts_and_trie_eviction():
     assert t4.p0 == 4 and t4.ids[0] == t1.ids[0]
     a.check()
     # refcounted teardown: the shared block stays resident until its
-    # LAST holder frees; reaching zero evicts it from the trie and
-    # returns it to the free list in the same step
+    # LAST holder frees; reaching zero RETAINS a registered block (the
+    # conversation cache) while unregistered ones free instantly
     for t in (t4, t3, t2):
         a.free(t.ids)
         a.check()
     assert a.match_prefix(p) == t1.ids[:2]
     a.free(t1.ids)
+    a.check()
+    # the registered full-prefix blocks retain (still matchable at
+    # refcount 0); t1's unregistered tail blocks freed instantly
+    assert a.match_prefix(p) == t1.ids[:2]
+    assert a.retained_blocks == 2 and a.live_blocks == 0
+    assert a.free_blocks == a.usable - 2
+    assert a.available_blocks == a.usable     # retained = headroom
+    # an explicit shed drains the retained pool and only then the trie
+    assert a.evict_retained() == 2
     a.check()
     assert a.free_blocks == a.usable
     assert a.match_prefix(p) == []            # trie fully drained
@@ -131,7 +144,16 @@ def test_copy_on_write_whole_prompt_match():
     assert a._ref[t1.ids[0]] == 1
     a.free(t1.ids)
     a.check()
-    assert a.free_blocks == a.usable
+    # the registered source retains; a retained block still serves CoW
+    # coverage (gathered at refcount 0 — pinned against eviction for
+    # the duration of the admission)
+    assert a.retained_blocks == 1
+    t3 = a.admit(p, 4)
+    assert t3.p0 == len(p) - 1
+    assert t3.gather_ids[0] == t1.ids[0] and t3.ids[0] != t1.ids[0]
+    assert a.retained_hits == 1 and a.retained_hit_tokens == len(p) - 1
+    a.free(t3.ids)
+    a.check()
 
 
 def test_exhaustion_is_deferral_nothing_moves():
@@ -211,8 +233,150 @@ def test_chaos_ordered_no_leak():
         a.free(t.ids)
         a.check()
     acct = a.account()
+    # drained of LIVE holders the books still reconcile — the retained
+    # pool holds the registered prefixes, free + retained == pool
+    assert acct["blocks_live"] == 0
+    assert acct["blocks_free"] + acct["blocks_retained"] == a.usable
+    a.evict_retained()
+    a.check()
+    acct = a.account()
     assert acct["blocks_free"] == a.usable and acct["blocks_used"] == 0
     assert a._trie == {} and a._key_of == {}
+
+
+def test_retained_revival_refcount_zero_to_one():
+    """Turn N+1 of a conversation revives the blocks turn N computed:
+    a retired (registered) prefix is matched exactly like a live one,
+    revival flips refcount 0 -> 1 and counts as a RETAINED hit — the
+    sub-source of cxxnet_decode_prefix_hit_rate this PR adds."""
+    a = BlockAllocator(9, 4)
+    p = list(range(10))                       # 2 full blocks + tail
+    t1 = a.admit(p, 4)
+    a.register(t1, p)
+    a.free(t1.ids)
+    a.check()
+    assert a.retained_blocks == 2             # the 2 registered blocks
+    t2 = a.admit(p, 4)
+    assert t2.p0 == 8 and t2.ids[:2] == t1.ids[:2]
+    assert all(a._ref[b] == 1 for b in t2.ids[:2])
+    assert a.retained_blocks == 0             # revived, not evicted
+    assert a.retained_hits == 1 and a.retained_hit_tokens == 8
+    assert a.prefix_hits == 1 and a.prefix_hit_tokens == 8
+    a.check()
+    # a hit off a LIVE prefix is NOT a retained hit: same prompt while
+    # t2 still holds the chain
+    t3 = a.admit(p, 4)
+    assert t3.p0 == 8
+    assert a.prefix_hits == 2 and a.retained_hits == 1
+    a.free(t3.ids)
+    a.free(t2.ids)
+    a.check()
+
+
+def test_eviction_lru_deepest_suffix_first():
+    """Cost-to-recompute order: the LRU retained LEAF goes first — the
+    oldest conversation loses its deepest suffix before its head, and
+    a younger conversation keeps everything."""
+    a = BlockAllocator(9, 4)
+    pa = list(range(100, 108))                # conversation A: 2 blocks
+    pb = list(range(200, 208))                # conversation B: 2 blocks
+    ta = a.admit(pa, 1)
+    a.register(ta, pa)
+    a.free(ta.ids)
+    tb = a.admit(pb, 1)
+    a.register(tb, pb)
+    a.free(tb.ids)
+    a.check()
+    assert a.retained_blocks == 4 and a.free_blocks == 4
+    # force ONE eviction: 5 fresh blocks wanted, 4 free
+    tc = a.admit(list(range(300, 320)), 1)    # 20 rows -> 5 blocks
+    assert tc is not None and a.retained_evictions == 1
+    a.check()
+    # A (older) lost exactly its DEEPEST block; its head still matches,
+    # B (younger) is untouched
+    assert a.match_prefix(pa) == ta.ids[:1]
+    assert a.match_prefix(pb) == tb.ids[:2]
+    # next eviction may not take A's head while B's leaf is younger?
+    # No — LRU: A's head (oldest stamp) is now a leaf and goes next
+    a.evict_retained(n=1)
+    assert a.match_prefix(pa) == []
+    assert a.match_prefix(pb) == tb.ids[:2]
+    a.free(tc.ids)
+    a.check()
+
+
+def test_evict_before_defer_and_true_exhaustion():
+    """A reservation evicts retained blocks before deferring; a request
+    defers ONLY when live + reserved blocks alone exceed the pool."""
+    a = BlockAllocator(9, 4)
+    p = list(range(8))
+    t1 = a.admit(p, 1)
+    a.register(t1, p)
+    a.free(t1.ids)
+    assert a.retained_blocks == 2 and a.free_blocks == 6
+    # 8 fresh blocks wanted, 6 free: PR 15 would defer — now the two
+    # retained blocks fund the reservation (evict-before-defer)
+    assert a.reservable(29, 4)
+    t2 = a.admit(list(range(400, 429)), 4)    # 32 rows -> 8 blocks
+    assert t2 is not None
+    assert a.alloc_failures == 0 and a.retained_evictions == 2
+    a.check()
+    # TRUE exhaustion: every block is live-held — this is the only
+    # case that defers, and nothing moves
+    before = a.account()
+    assert not a.reservable(4, 1)
+    assert a.admit([1, 2, 3, 4], 1) is None
+    after = a.account()
+    before["alloc_failures"] += 1
+    assert after == before
+    a.free(t2.ids)
+    a.check()
+
+
+def test_retained_cap_and_frac_zero():
+    # cap = frac * usable, LRU-enforced at retire time
+    a = BlockAllocator(9, 4, retained_frac=0.25)   # cap = 2 of 8
+    assert a.retained_cap == 2
+    pa, pb = list(range(8)), list(range(50, 58))
+    ta = a.admit(pa, 1)
+    a.register(ta, pa)
+    a.free(ta.ids)
+    tb = a.admit(pb, 1)
+    a.register(tb, pb)
+    a.free(tb.ids)
+    a.check()
+    # B's 2 blocks displaced A's (LRU): cap held, A evicted
+    assert a.retained_blocks == 2 and a.retained_evictions == 2
+    assert a.match_prefix(pa) == [] and a.match_prefix(pb) == tb.ids
+    # frac 0 restores the PR 15 free-instantly contract
+    z = BlockAllocator(9, 4, retained_frac=0.0)
+    tz = z.admit(pa, 1)
+    z.register(tz, pa)
+    z.free(tz.ids)
+    z.check()
+    assert z.free_blocks == z.usable and z._trie == {}
+
+
+def test_eviction_rank_nests_inside_admission_lock():
+    """The lockrank contract the chaos harness runs under: the
+    allocator's reservation+eviction lock (kvblocks.evict, rank 15)
+    nests INSIDE servd's admission lock (servd.queue, rank 10) — and
+    the reverse order raises instead of deadlocking."""
+    from cxxnet_tpu.utils import lockrank
+    a = BlockAllocator(5, 4)
+    q = lockrank.lock("servd.queue")
+    with lockrank.enforced():
+        with q:                               # admission lock held
+            t = a.admit([1, 2, 3, 4, 5], 2)   # takes kvblocks.evict
+            a.register(t, [1, 2, 3, 4, 5])
+            a.free(t.ids)
+            assert a.evict_retained() == 1
+        with pytest.raises(lockrank.LockOrderError):
+            with a._lock:
+                with q:
+                    pass
+    a.check()
+    assert not lockrank.held()
 
 
 def test_exhausted_exception_importable_jax_free():

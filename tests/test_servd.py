@@ -2208,3 +2208,216 @@ def test_paged_kv_tenant_fair_queue_gate_and_requeue(make_frontend):
         assert stats["errors"] == 0 and stats["shed"] == 0
         assert sb.alloc.free_blocks == sb.alloc.usable
         sb.alloc.check()
+
+
+# -- retained conversation cache: never-OOM memory governance ---------
+# (doc/robustness.md "Memory governance"; PR 18. CXXNET_LOCKRANK=1 via
+# the autouse fixture — eviction runs under the rank-15 kvblocks.evict
+# lock inside the admission path, and these floods prove no inversion)
+
+
+def _books_reconcile(alloc):
+    """The retained invariant, asserted at a quiescent instant: every
+    block is live, retained, or free — and nothing else."""
+    assert (alloc.live_blocks + alloc.retained_blocks
+            + alloc.free_blocks) == alloc.usable
+    alloc.check()
+
+
+def test_retained_kv_exhaustion_chaos_flood(make_frontend):
+    """THE never-OOM acceptance: mixed multi-turn + one-shot traffic
+    floods a pool far too small to hold every conversation's cache.
+    Turn N+1 of each conversation extends turn N's prompt (the
+    retained-revival path: refcount 0 -> 1), one-shot noise churns the
+    retained pool through LRU eviction, and true exhaustion (live
+    blocks alone exceeding the pool) still defers deterministically.
+    Invariants: zero OOM (no KVPoolExhausted escapes — the gate +
+    evict-before-defer absorb everything), zero deadlock (the flood
+    completes under CXXNET_LOCKRANK=1), zero silent losses (every
+    request answered exactly once, token-exact — an evicted-then-
+    revived conversation recomputes, never serves stale KV), and the
+    books reconcile: live + retained + free == pool, always."""
+    sb = faultinject.slot_backend(buckets=(4,), n_new=4,
+                                  per_token_s=0.002,
+                                  kv_pool_blocks=12, kv_block_tokens=4,
+                                  kv_retained_frac=1.0)
+    fe = make_frontend(None, slot_backend=sb, batch_max=4,
+                       batch_window_ms=0.0, drain_ms=15000.0)
+    results = {}
+
+    def convo_client(c):
+        # a live multi-turn client: turn k+1 is sent the moment turn
+        # k answers, its prompt one block longer — the just-retired
+        # chain is the NEWEST retained mass, so LRU eviction recycles
+        # the noise first and the head of a chain last (leaf-first
+        # eviction order): revival is what the design promises here
+        out = []
+        for turn in range(3):
+            p = list(range(100 * c + 1, 100 * c + 5 + 4 * turn))
+            line = " ".join(map(str, p))
+            out.append((line, faultinject.serve_request(
+                fe.port, line, timeout=60.0)))
+        results["convo%d" % c] = out
+
+    def noise_client(z):
+        # one-shot churn: distinct prompts that only ever park and
+        # get evicted — the traffic that would OOM an unguarded pool
+        out = []
+        for i in range(3):
+            t0 = 1000 * z + 10 * i + 1
+            line = " ".join(str(t0 + k) for k in range(4))
+            out.append((line, faultinject.serve_request(
+                fe.port, line, timeout=60.0)))
+        results["noise%d" % z] = out
+
+    clients = [threading.Thread(target=convo_client, args=(c,))
+               for c in (1, 2, 3)]
+    clients += [threading.Thread(target=noise_client, args=(z,))
+                for z in (1, 2)]
+    for t in clients:
+        t.start()
+    for t in clients:
+        t.join(120.0)
+        assert not t.is_alive(), "chaos client wedged (deadlock?)"
+    for name, out in sorted(results.items()):
+        for line, r in out:
+            t0 = int(line.split()[0])
+            assert r == _expect_line(t0, 4), (name, line, r)
+    # the chaos DID exercise the governance, not a comfortable pool:
+    # conversations revived retained blocks AND the one-shot churn
+    # forced retained evictions
+    assert sb.alloc.retained_hits > 0
+    assert sb.alloc.retained_hit_tokens > 0
+    assert sb.alloc.retained_evictions > 0
+    # zero OOM, zero device faults, zero silent losses
+    assert sb.closed == 0
+    stats = fe.drain()
+    assert reconciles(stats)
+    assert stats["accepted"] == stats["served"] == 3 * 3 + 2 * 3
+    assert stats["errors"] == 0 and stats["shed"] == 0
+    # quiescent books: nothing live, everything parked or free
+    assert sb.alloc.live_blocks == 0
+    assert sb.alloc.available_blocks == sb.alloc.usable
+    sb.alloc.check()
+
+
+def test_retained_eviction_storm_and_revive_race(make_frontend):
+    """The chaos knobs: an eviction storm drains the WHOLE retained
+    pool between a gather-time match and its admission, and the
+    revive-race knob evicts the LRU leaf before every admission — the
+    block a request hoped to revive is exactly the one recycled.
+    Admissions must recompute instead of crash, replies stay
+    token-exact, and the books reconcile after every round."""
+    for knobs in ({"kv_evict_storm": 3}, {"kv_revive_race": True},
+                  {"kv_evict_storm": 2, "kv_revive_race": True}):
+        sb = faultinject.slot_backend(buckets=(4,), n_new=4,
+                                      per_token_s=0.002,
+                                      kv_pool_blocks=8,
+                                      kv_block_tokens=4,
+                                      kv_retained_frac=1.0, **knobs)
+        fe = make_frontend(None, slot_backend=sb, batch_max=4,
+                           batch_window_ms=0.0, drain_ms=15000.0)
+        base = list(range(1, 5))
+        for turn in range(3):
+            # two conversations re-serving the SAME growing prompt +
+            # one-shot churn: every admission races the eviction knobs
+            lines = [" ".join(map(str, base + list(range(5, 5 + 4 * turn)))),
+                     " ".join(map(str, base + list(range(50, 54)))),
+                     " ".join(str(9000 + 100 * turn + k)
+                              for k in range(4))]
+            resps = faultinject.serve_flood(fe.port, lines,
+                                            timeout=60.0)
+            for line, r in zip(lines, resps):
+                t0 = int(line.split()[0])
+                assert r == _expect_line(t0, 4), (knobs, turn, line, r)
+            _books_reconcile(sb.alloc)
+        assert sb.closed == 0
+        stats = fe.drain()
+        assert reconciles(stats)
+        assert stats["errors"] == 0 and stats["shed"] == 0
+        assert stats["accepted"] == stats["served"] == 9
+        assert sb.alloc.live_blocks == 0
+        sb.alloc.check()
+
+
+def test_evict_before_defer_admission(make_frontend):
+    """A reservation that the free list cannot cover but free +
+    retained CAN must evict and admit — never defer. Sequential
+    one-shots fill the retained pool to the brim; a second wave of
+    distinct prompts then admits by recycling it: zero alloc_failures
+    (the allocator never refused), retained_evictions > 0 (the
+    funding), every reply exact."""
+    sb = faultinject.slot_backend(buckets=(1,), n_new=4,
+                                  kv_pool_blocks=4, kv_block_tokens=4,
+                                  kv_retained_frac=1.0)
+    fe = make_frontend(None, slot_backend=sb, batch_max=1,
+                       batch_window_ms=0.0, drain_ms=15000.0)
+    # wave 1: fill retention (each request: 1 registered block parks
+    # at retire, 1 scratch block frees) until the cap (4) is reached
+    for i in range(1, 5):
+        t0 = 10 * i
+        line = " ".join(str(t0 + k) for k in range(4))
+        assert faultinject.serve_request(fe.port, line,
+                                         timeout=30.0) \
+            == _expect_line(t0, 4)
+    assert sb.alloc.retained_blocks > 0
+    retained_before = sb.alloc.retained_blocks
+    # wave 2: distinct prompts over a free list too small for them —
+    # funded by eviction, not deferred into the queue forever
+    for i in range(5, 9):
+        t0 = 10 * i
+        line = " ".join(str(t0 + k) for k in range(4))
+        assert faultinject.serve_request(fe.port, line,
+                                         timeout=30.0) \
+            == _expect_line(t0, 4)
+    assert sb.alloc.alloc_failures == 0
+    assert sb.alloc.retained_evictions > 0
+    assert sb.alloc.retained_blocks <= sb.alloc.retained_cap
+    stats = fe.drain()
+    assert reconciles(stats)
+    assert stats["accepted"] == stats["served"] == 8
+    _books_reconcile(sb.alloc)
+
+
+def test_kv_pressure_latch_sheds_retained(make_frontend):
+    """The low-headroom pressure latch: when the free list drops under
+    kv_pressure_pct percent of the pool, the worker latches
+    cxxnet_decode_kv_pressure, sheds retained blocks toward the clear
+    threshold through the backend's kv_shed_retained hook, emits ONE
+    kv_pressure transition event per edge (hysteresis — no flapping),
+    and publishes the latch through /batchz, ADMIN stats and the
+    federation feed."""
+    sb = faultinject.slot_backend(buckets=(1,), n_new=4,
+                                  kv_pool_blocks=8, kv_block_tokens=4,
+                                  kv_retained_frac=1.0)
+    fe = make_frontend(None, slot_backend=sb, batch_max=1,
+                       batch_window_ms=0.0, drain_ms=15000.0,
+                       kv_pressure_pct=50.0,
+                       kv_pressure_clear_pct=75.0)
+    # distinct one-shots park one retained block each: free drops 8 ->
+    # 7 -> 6 -> 5 -> 3 (under 50%) -> latch fires, sheds back to >= 6
+    for i in range(1, 8):
+        t0 = 10 * i
+        line = " ".join(str(t0 + k) for k in range(4))
+        assert faultinject.serve_request(fe.port, line,
+                                         timeout=30.0) \
+            == _expect_line(t0, 4)
+    assert fe._kv_pressures >= 1
+    assert fe._kv_shed_blocks > 0
+    assert sb.alloc.retained_evictions > 0
+    # hysteresis: after the shed the latch CLEARED (free >= clear_pct)
+    snap = fe.batch_snapshot()
+    assert snap["pool"]["pressure"] == 0
+    assert snap["pool"]["blocks_free"] >= 6
+    # the retained sub-fields ride the snapshot for /batchz + bench
+    assert "retained_hit_rate" in snap["pool"]
+    assert "kv_retained_pct" in snap["pool"]
+    # ADMIN stats carries the governance keys (what routerd federates)
+    st = dict(kv.split("=") for kv in faultinject.serve_request(
+        fe.port, "ADMIN stats", timeout=5.0).split()[1:])
+    assert "kv_retained_blocks" in st and "kv_retained_hits" in st
+    assert st["kv_pressure"] == "0"
+    stats = fe.drain()
+    assert reconciles(stats)
+    assert stats["accepted"] == stats["served"] == 7
+    _books_reconcile(sb.alloc)

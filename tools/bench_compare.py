@@ -134,11 +134,21 @@ def sub_lower_is_better(key, line):
     if k == "noisy_shed_rate":
         return False
     if k.endswith("_rps") or "tokens_per_s" in k or "occupancy" in k \
-            or k.endswith("_live_pct") or k.endswith("hit_rate"):
+            or k.endswith("_live_pct") or k.endswith("hit_rate") \
+            or k.endswith("retained_pct") or k.endswith("_speedup"):
         # prefix_hit_rate (the paged-KV shared-prefix reuse share) is
         # the other rate that is worse when LOWER: a drop means prompt
-        # tokens are being re-prefilled instead of shared
+        # tokens are being re-prefilled instead of shared.
+        # kv_retained_pct (the retained-cache share on the multiturn
+        # row) and ttft_speedup (warm/cold ratio) gate the same way: a
+        # drop means the retained conversation cache stopped holding
+        # mass / stopped paying
         return False
+    if "ttft" in k:
+        # ttft sub-fields are time-to-first-token latencies — worse
+        # when HIGHER even when the name lacks the _ms suffix
+        # (checked after _speedup: ttft_speedup is a ratio, not a time)
+        return True
     if "availability" in k or k in ("replays", "hedges", "hedge_wins"):
         # failover health (the serve_chaos_availability /
         # serve_hedged_tail rows): availability percentages and the
