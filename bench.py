@@ -610,7 +610,7 @@ def bench_serve_throughput():
     import socket
     import threading
     from cxxnet_tpu.models import transformer_lm_trainer
-    from cxxnet_tpu.utils import perf, servd
+    from cxxnet_tpu.utils import perf, servd, telemetry
     from cxxnet_tpu.utils.telemetry import percentile
     vocab, L, plen, n_new = 8192, 256, 32, 16
     bucket = 4
@@ -642,6 +642,13 @@ def bench_serve_throughput():
     _ask(port, line, timeout=600.0)
     occ0 = (fe._occ_iters, fe._occ_slots)
     iter0 = fe._iter_ord
+    # bracket the flood for the autopsy/books sub-fields: records
+    # before this mark are warm-up (whose verdicts MAY carry
+    # compile_stall), and the auditor's violation count is deltaed so
+    # other rows in this process cannot leak into this one
+    nrec0 = len(fe.flight.list())
+    telemetry.audit_sweep()
+    books0 = telemetry.auditor().snapshot()["violations"]
     nclients, per = 6, 6
     lats, nerr, nsent = [], [0], [0]
     lock = threading.Lock()
@@ -688,6 +695,24 @@ def bench_serve_throughput():
     # snapshot from the allocator's lifetime tallies
     snap = fe.batch_snapshot() or {}
     pool = snap.get("pool") or {}
+    # the autopsy plane over the flood window: every flood request's
+    # stamped verdict (warm bucket -> compile_stall share exactly 0),
+    # plus the conservation-law auditor's verdict — swept BEFORE
+    # drain, while this frontend's laws are still registered
+    telemetry.audit_sweep()
+    books1 = telemetry.auditor().snapshot()["violations"]
+    allrec = fe.flight.list()                # newest first
+    floodrec = allrec[:max(0, len(allrec) - nrec0)]
+    verdicts = {}
+    stall_s = wall_s = 0.0
+    for rec in floodrec:
+        aut = rec.get("autopsy")
+        if not aut:
+            continue
+        verdicts[aut["primary"]] = verdicts.get(aut["primary"], 0) + 1
+        stall_s += float((aut.get("causes") or {})
+                         .get("compile_stall", 0.0))
+        wall_s += float(aut.get("wall_s") or 0.0)
     fe.drain()
     lats.sort()
     total = max(1, nsent[0])
@@ -711,6 +736,16 @@ def bench_serve_throughput():
             "queue_age_p99_ms": round(1e3 * percentile(qages, 99), 3)
             if qages else None,
             "error_rate": round(nerr[0] / float(total), 4),
+            # the self-explaining-telemetry sub-fields: the flood's
+            # primary-verdict histogram, the compile-stall share of
+            # its wall time (0.0 on this warm bucket — any rise means
+            # the flood paid a cliff), and the auditor's violation
+            # delta across the row (0 on a healthy run; gated by
+            # bench_compare as worse-when-higher)
+            "autopsy_verdicts": verdicts or None,
+            "autopsy_compile_stall_pct":
+            round(100.0 * stall_s / wall_s, 3) if wall_s > 0 else None,
+            "books_violations": books1 - books0,
             "requests": nsent[0], "bucket": bucket}
 
 
@@ -1164,7 +1199,7 @@ def bench_serve_chaos_availability():
     inside the kill window next to the overall p99. Null-safe like
     every serve row."""
     import threading
-    from cxxnet_tpu.utils import routerd
+    from cxxnet_tpu.utils import routerd, telemetry
     from cxxnet_tpu.utils.telemetry import percentile
     from tests import faultinject
     fleet = faultinject.spawn_fleet(3, batch_max=4, n_new=8,
@@ -1175,6 +1210,10 @@ def bench_serve_chaos_availability():
     router.start()
     rport = router.listen(0)
     router.probe_now()
+    # conservation-law bracket: the router's books must reconcile
+    # through the SIGKILL (deltaed so other rows cannot leak in)
+    telemetry.audit_sweep()
+    books0 = telemetry.auditor().snapshot()["violations"]
     flood_s, kill_at, kill_win = 3.0, 0.8, 1.0
     lock = threading.Lock()
     samples = []                     # (t_issue_rel, latency_s, ok)
@@ -1200,6 +1239,10 @@ def bench_serve_chaos_availability():
         t.start()
     for t in threads:
         t.join()
+    # sweep while the router's laws are still registered: a kill that
+    # corrupted the route books must show up HERE, not vanish at drain
+    telemetry.audit_sweep()
+    books1 = telemetry.auditor().snapshot()["violations"]
     rstats = router.drain()
     faultinject.stop_fleet(fleet)
     sent = len(samples)
@@ -1219,6 +1262,9 @@ def bench_serve_chaos_availability():
             "kill_window_p99_ms": round(1e3 * percentile(kill_lats,
                                                          99), 3)
             if kill_lats else None,
+            # the metrics auditor's verdict on the kill: route books
+            # must reconcile through a SIGKILL (worse-when-higher)
+            "books_violations": books1 - books0,
             "replicas": len(fleet), "requests": sent}
 
 

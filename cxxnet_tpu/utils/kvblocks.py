@@ -491,6 +491,23 @@ class BlockAllocator:
                 "retained_hit_tokens": self.retained_hit_tokens,
                 "retained_evictions": self.retained_evictions}
 
+    def books_law(self) -> Optional[str]:
+        """Conservation law for the auditor (telemetry.BooksAuditor):
+        ``live + retained + free == pool``, evaluated atomically under
+        the allocator lock. Returns None when the books reconcile, a
+        detail string when they do not — never raises (the auditor
+        treats exceptions as inconclusive, but a broken pool equation
+        is a definite violation and must latch)."""
+        with self._lock:
+            free = len(self._free)
+            retained = len(self._retained)
+            live = sum(1 for b in range(1, self.blocks)
+                       if self._ref[b] > 0)
+            if live + retained + free == self.usable:
+                return None
+            return ("kv blocks leak: live %d + retained %d + free %d "
+                    "!= pool %d" % (live, retained, free, self.usable))
+
     def check(self) -> None:
         """Assert every structural invariant (the test suite's oracle
         after chaos-ordered admit/free interleavings)."""

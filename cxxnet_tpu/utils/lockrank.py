@@ -106,6 +106,10 @@ RANKS = {
     #                         is emitted OUTSIDE it)
     "perf.ledger": 95,      # Ledger._cond — emits program_card events
     #                         and reads registry hists under it
+    "telemetry.audit": 97,  # BooksAuditor._lock — latch bookkeeping
+    #                         only: laws are evaluated OUTSIDE it, the
+    #                         books_broken event is emitted outside it;
+    #                         below everything but the registry
     "telemetry.registry": 100,  # _Registry._lock — innermost by design:
     #                             every subsystem records telemetry, so
     #                             nothing may be acquired under it

@@ -205,6 +205,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from . import autopsy
 from . import checkpoint as ckpt
 from . import health
 from . import lockrank
@@ -643,6 +644,11 @@ class Router:
         telemetry.declare_hist("route.request")
         telemetry.gauge("route.replicas", len(self._replicas))
         telemetry.gauge("route.replicas_up", len(self._replicas))
+        telemetry.audit_register("route.books", self._law_books)
+        telemetry.audit_register("route.tenant_books",
+                                 self._law_tenant_books)
+        telemetry.audit_register("fleet.federation",
+                                 self._law_federation)
         self._probe_thread = threading.Thread(
             target=self._prober_run, name="cxn-routerd-probe",
             daemon=True)
@@ -672,6 +678,90 @@ class Router:
                 self._stats[name] += 1
         for name in names:
             telemetry.count(_COUNTERS[name])
+
+    # -- conservation laws (telemetry.BooksAuditor) --------------------
+    def _law_books(self) -> Optional[str]:
+        """``accepted == served + errors + shed + deadline`` up to the
+        requests in flight. _handle's ordering makes both directions
+        sound: the active slot is claimed BEFORE accepted is bumped and
+        released AFTER the outcome lands, so at every instant
+        ``active >= accepted - outcomes`` — outcomes exceeding accepted
+        is an immediate violation, the forward direction must persist
+        across stable-snapshot brackets (a bracket the books moved
+        through is inconclusive, never a latch)."""
+        detail = None
+        for _ in range(6):
+            with self._slock:
+                s1 = dict(self._stats)
+            with self._lock:
+                active = self._active
+            with self._slock:
+                s2 = dict(self._stats)
+            if s1 != s2:
+                return None          # the books moved mid-bracket
+            a = s1["accepted"]
+            o = (s1["served"] + s1["errors"] + s1["shed"]
+                 + s1["deadline"])
+            if o > a:
+                return ("route books: outcomes %d exceed accepted %d "
+                        "(served %d + errors %d + shed %d + deadline "
+                        "%d)" % (o, a, s1["served"], s1["errors"],
+                                 s1["shed"], s1["deadline"]))
+            if a <= o + active:
+                return None
+            detail = ("route books: accepted %d != outcomes %d + "
+                      "in-flight %d" % (a, o, active))
+            time.sleep(0.005)        # let an in-limbo answer land
+        return detail
+
+    def _law_tenant_books(self) -> Optional[str]:
+        """Per outcome key, the tenant charges sum to at most the
+        door's own books — exact, because ONE stats-lock snapshot
+        covers both, and _handle bumps the global counter BEFORE the
+        tenant's for accepted and outcome alike."""
+        if not self._tenants:
+            return None
+        with self._slock:
+            g = dict(self._stats)
+            ts = {t: dict(st) for t, st in self._tstats.items()}
+        for k in _TENANT_KEYS:
+            tot = sum(st[k] for st in ts.values())
+            if tot > g[k]:
+                return ("route tenant books: tenant %s charges sum to "
+                        "%d, the door counted %d" % (k, tot, g[k]))
+        return None
+
+    def _law_federation(self) -> Optional[str]:
+        """Every federated fleet counter equals the sum of the stored
+        replica feeds — the merge must never invent or lose a count.
+        Recomputed from the SAME stored snapshots the snapshot method
+        reads; a federation sweep landing mid-check makes the bracket
+        inconclusive (epoch recheck), never a latch."""
+        with self._fed_lock:
+            if not self._fed:
+                return None
+            at1 = self._fed_at
+            feeds = [d["snap"] for d in self._fed.values()]
+        snap = self.federation_snapshot()
+        with self._fed_lock:
+            if self._fed_at != at1:
+                return None          # a sweep landed mid-check
+        if snap is None:
+            return None
+        expect: Dict[str, float] = {}
+        for s in feeds:
+            for cname, v in ((s.get("metrics") or {})
+                             .get("counters") or {}).items():
+                if cname.startswith("serve.") \
+                        and isinstance(v, (int, float)):
+                    expect[cname] = expect.get(cname, 0) + v
+        got = snap.get("counters") or {}
+        for cname, v in expect.items():
+            if got.get(cname, 0) != v:
+                return ("federation books: fleet %s = %r != sum of "
+                        "replica feeds %r"
+                        % (cname, got.get(cname, 0), v))
+        return None
 
     # -- health (statusd probes) ---------------------------------------
     def health_probe(self) -> Tuple[bool, str]:
@@ -1258,13 +1348,18 @@ class Router:
                "deadline_ms": deadline_ms,
                "retries": max(0, len(attempts) - 1),
                "attempts": attempts}
+        # the router-side autopsy rides the record AND the done event:
+        # /why?request=<id> refines it with the replica's books (the
+        # stitch); a log consumer gets the verdict with zero joins
+        rec["autopsy"] = autopsy.classify_route(rec)
         self.flight.record(rec)
         ev = {"ev": "route_request_done", "req": tid,
               "outcome": outcome,
               "attempts": len(attempts),
               "replicas": [a["replica"] for a in attempts],
               "retries": rec["retries"],
-              "total_s": rec["total_s"]}
+              "total_s": rec["total_s"],
+              "autopsy": rec["autopsy"]}
         if tenant is not None:
             ev["tenant"] = tenant
         telemetry.event(ev)
@@ -2230,18 +2325,14 @@ class Router:
                          "active": active, "warm_pct": warm_pct})
 
     # -- stitched cross-process traces ---------------------------------
-    def stitched_trace(self, request_id) -> Optional[dict]:
-        """ONE Chrome trace for one routed request: the router's
-        attempt lane plus the phase lane of every replica that touched
-        it, fetched live over each replica's statusd
-        (``/requestz?request=<id>``) and aligned on the shared
-        wall-clock epoch. None when the router never saw the id. A
-        replica that is gone (or has evicted the record) simply
-        contributes no lane — the router lane still names it."""
-        rid = str(request_id)
-        rec = self.flight.get(rid)
-        if rec is None:
-            return None
+    def _fetch_hops(self, rec: dict) -> List[Tuple[str, dict]]:
+        """The flight records of every replica one routed request
+        touched, fetched live over each replica's statusd
+        (``/requestz?request=<id>``) — the shared hop source of the
+        /trace stitch and the /why autopsy. A replica that is gone (or
+        has evicted the record) simply contributes no hop — the router
+        lane still names it."""
+        rid = str(rec.get("id"))
         with self._lock:
             by_name = {r.name: (r.host, r.status_port)
                        for r in self._replicas}
@@ -2264,7 +2355,66 @@ class Router:
                 continue
             if isinstance(rrec, dict) and rrec.get("id") == rid:
                 hops.append((name, rrec))
-        return stitched_chrome_trace(rec, hops)
+        return hops
+
+    def stitched_trace(self, request_id) -> Optional[dict]:
+        """ONE Chrome trace for one routed request: the router's
+        attempt lane plus the phase lane of every replica that touched
+        it, aligned on the shared wall-clock epoch. None when the
+        router never saw the id."""
+        rec = self.flight.get(str(request_id))
+        if rec is None:
+            return None
+        return stitched_chrome_trace(rec, self._fetch_hops(rec))
+
+    def stitched_why(self, request_id) -> Optional[dict]:
+        """ONE cross-process autopsy for one routed request (the
+        router's /why): the router-lane verdict refined by the winning
+        replica's own cause decomposition, ``slow_replica`` absorbing
+        the latency the replica's books cannot account for. None when
+        the router never saw the id."""
+        rec = self.flight.get(str(request_id))
+        if rec is None:
+            return None
+        return autopsy.stitch_route(rec, self._fetch_hops(rec))
+
+    def fleet_eventz(self, n: Optional[int] = None) -> List[dict]:
+        """The fleet incident timeline (the router's /eventz): this
+        process's own incident rows merged with every non-dead
+        replica's ``/eventz?json=1`` rows, aligned on the shared
+        wall-clock epoch. Each replica row is tagged with the replica
+        name; the router's own rows say "router". ``n`` bounds the
+        output to the NEWEST rows AFTER the merge — a bound applied
+        per-feed would drop old-but-fleet-relevant rows unevenly."""
+        rows = autopsy.incidents(
+            telemetry.recent_events(),
+            t0_wall=telemetry.wall_epoch(),
+            records=self.flight.list(), process="router")
+        with self._lock:
+            reps = [(r.name, r.state, r.host, r.status_port)
+                    for r in self._replicas]
+        for name, state, host, sport in reps:
+            if state == DEAD:
+                continue             # don't burn a timeout per render
+            try:
+                code, body = _http_get(host, sport, "/eventz?json=1",
+                                       self.probe_timeout)
+                if code != 200:
+                    continue
+                snap = json.loads(body)
+            except (OSError, ValueError):
+                continue
+            if not isinstance(snap, dict):
+                continue
+            for row in snap.get("rows") or []:
+                if isinstance(row, dict):
+                    row = dict(row)
+                    row["process"] = name
+                    rows.append(row)
+        rows.sort(key=lambda r: r.get("t_wall") or 0.0)
+        if n is not None and n > 0:
+            rows = rows[-int(n):]
+        return rows
 
     # -- rolling reload ------------------------------------------------
     def request_rolling_reload(self) -> bool:
@@ -2475,6 +2625,11 @@ class Router:
             leftovers = self._active
         health.pause("route.accept")
         health.pause("route.probe")
+        # the laws leave the auditor with the process (a latched
+        # violation survives: BooksAuditor latches are sticky)
+        for law in ("route.books", "route.tenant_books",
+                    "fleet.federation"):
+            telemetry.audit_unregister(law)
         stats = self.stats()
         telemetry.event(dict({"ev": "route_drain", "phase": "end",
                               "seconds": round(time.monotonic() - t0,
@@ -2803,6 +2958,29 @@ def _selftest_body(verbose: bool = False) -> int:
         assert code == 200
         lst = json.loads(body)
         assert lst["shown"] <= 2 and lst["total"] >= 2
+
+        # the autopsy plane: every routing record carries its verdict;
+        # stitched_why (the /why source) refines the winner's latency
+        # lane with the replica's own books and still tiles total_s
+        assert rrec["autopsy"]["primary"] in autopsy.CAUSES, rrec
+        why = router.stitched_why("obs-1")
+        assert why is not None and why["hops"], why
+        maut = why["autopsy"]
+        assert abs(sum(maut["causes"].values()) - maut["wall_s"]) \
+            <= max(1e-6, 0.05 * maut["wall_s"]), maut
+        assert router.stitched_why("missing") is None
+        # the fleet incident timeline merges this router's incident
+        # rows with every replica's /eventz feed, wall-clock ordered
+        rows = router.fleet_eventz(n=64)
+        assert rows, "fleet_eventz returned no rows"
+        walls = [r["t_wall"] for r in rows]
+        assert walls == sorted(walls)
+        assert any(r.get("process") != "router" for r in rows), rows
+        # the conservation-law auditor sweeps clean over a healthy
+        # router + fleet (route books / tenant books / federation)
+        viol = telemetry.audit_sweep()
+        assert not any(viol.values()), viol
+        assert not telemetry.auditor().snapshot()["broken"]
 
         # live federation: EXACT histogram merge — for every merged
         # series the fleet bucket counts equal the sum of the

@@ -230,6 +230,7 @@ import time
 from collections import deque
 from typing import Callable, List, Optional, Tuple
 
+from . import autopsy
 from . import checkpoint as ckpt
 from . import health
 from . import kvblocks
@@ -452,7 +453,8 @@ _KV_DEFER = object()
 
 class _Request:
     __slots__ = ("toks", "deadline", "t_arrival", "t_wall", "reply",
-                 "done", "seq", "id", "tenant", "_alock", "answered")
+                 "done", "seq", "id", "tenant", "_alock", "answered",
+                 "kv_defers")
 
     def __init__(self, toks: List[int], deadline: Optional[float], reply,
                  tenant: Optional[str] = None):
@@ -474,6 +476,9 @@ class _Request:
         # still answer it later — only the first answer goes out
         self._alock = lockrank.lock("servd.request")
         self.answered = False
+        # block-pool admission defers this request ate (the paged-KV
+        # requeue path) — the autopsy's kv_defer attribution signal
+        self.kv_defers = 0
 
 
 class _SlotState:
@@ -870,6 +875,16 @@ class ServeFrontend:
         self._kv_pressure = False    # latched under low headroom
         self._kv_pressures = 0       # episodes (0->1 transitions)
         self._kv_shed_blocks = 0     # retained blocks shed by the latch
+        # autopsy episode windows (utils/autopsy.py): monotonic
+        # [t0, t1] spans of the convoy / KV-pressure latches — the
+        # classifier intersects a request's [arrival, answer] span
+        # with these to attribute convoy_victim / eviction_storm
+        # seconds. Single-thread discipline: latch, clear and the
+        # _observe_request reads all run on the worker thread
+        self._convoy_t0: Optional[float] = None
+        self._convoy_episodes: deque = deque(maxlen=64)
+        self._kvp_t0: Optional[float] = None
+        self._kvp_episodes: deque = deque(maxlen=64)
         self._iter_ord = 0           # lifetime step-iteration ordinal
         self._kv_total = 0           # decode_kv_bytes mirror (worker-
         #                              written, read lock-free)
@@ -919,6 +934,19 @@ class ServeFrontend:
             # up front like the latency series — the convoy acceptance
             # scrapes its buckets before the first flood
             telemetry.declare_hist("serve.queue_age")
+        # conservation laws (doc/observability.md "Metric conservation
+        # laws"): the books auditor re-proves the serving invariants
+        # continuously — accepted vs outcomes + queue + in-flight,
+        # tenant charges vs the door books, and (paged backends) the
+        # block-pool equation. Registered here, unregistered at drain;
+        # a latched violation survives the unregister by design.
+        telemetry.audit_register("serve.books", self._law_books)
+        telemetry.audit_register("serve.tenant_books",
+                                 self._law_tenant_books)
+        pool_law = getattr(getattr(self.slot_backend, "alloc", None),
+                           "books_law", None)
+        if pool_law is not None:
+            telemetry.audit_register("kv.blocks", pool_law)
         target = (self._worker_run_batched if self.slot_backend is not None
                   else self._worker_run)
         self._worker_thread = threading.Thread(
@@ -1112,6 +1140,7 @@ class ServeFrontend:
             self._convoy = True
             self._convoys += 1
             self._convoy_since = self._iter_ord
+            self._convoy_t0 = time.monotonic()
             pinned = max(slots_snap, key=lambda r: r[2])
             telemetry.count("serve.convoys")
             telemetry.event({
@@ -1123,6 +1152,10 @@ class ServeFrontend:
                 if qage is not None else None})
         elif self._convoy and not on:
             self._convoy = False
+            if self._convoy_t0 is not None:
+                self._convoy_episodes.append(
+                    (self._convoy_t0, time.monotonic()))
+                self._convoy_t0 = None
             telemetry.event({
                 "ev": "decode_convoy", "convoy": 0,
                 "episode_iters": self._iter_ord - self._convoy_since})
@@ -1279,6 +1312,64 @@ class ServeFrontend:
                            "(2x the %.0fs stall bound)"
                            % (stalled, self.stall_after_s))
         return True, "alive"
+
+    # -- conservation laws (telemetry.BooksAuditor) --------------------
+    def _law_books(self) -> Optional[str]:
+        """``accepted == served + errors + shed + deadline + queued +
+        in-flight``, at every instant. A sync rejection bumps accepted
+        and its outcome in ONE _slock section, so outcomes can never
+        exceed accepted in any snapshot — that direction latches
+        immediately. The forward direction has microsecond limbo
+        windows (a fair-share eviction and drain leftovers leave the
+        queue under the admission lock but are answered OUTSIDE it),
+        so a forward violation must PERSIST across several
+        stable-snapshot brackets before it returns a detail. A torn
+        bracket (the stats moved while the queue was read) is
+        inconclusive, never a latch."""
+        detail = None
+        for _ in range(6):
+            with self._slock:
+                s1 = dict(self._stats)
+            with self._cond:
+                depth = len(self._q)
+                infl = self._inflight
+            with self._slock:
+                s2 = dict(self._stats)
+            if s1 != s2:
+                return None          # the books moved mid-bracket
+            a = s1["accepted"]
+            o = (s1["served"] + s1["errors"] + s1["shed"]
+                 + s1["deadline"])
+            if o > a:
+                return ("serve books: outcomes %d exceed accepted %d "
+                        "(served %d + errors %d + shed %d + deadline "
+                        "%d)" % (o, a, s1["served"], s1["errors"],
+                                 s1["shed"], s1["deadline"]))
+            if a <= o + depth + infl:
+                return None
+            detail = ("serve books: accepted %d != outcomes %d + "
+                      "queued %d + in-flight %d"
+                      % (a, o, depth, infl))
+            time.sleep(0.005)        # let an in-limbo answer land
+        return detail
+
+    def _law_tenant_books(self) -> Optional[str]:
+        """Per-tenant charges never exceed the door books, key by key.
+        The frontend-wide counter is bumped before the tenant's and
+        both live under _slock, so ONE combined snapshot makes
+        ``sum_t tenant[k] <= global[k]`` exact — no persistence
+        dance needed."""
+        if not self._tenants:
+            return None
+        with self._slock:
+            g = dict(self._stats)
+            ts = {t: dict(st) for t, st in self._tstats.items()}
+        for k in _TENANT_KEYS:
+            tot = sum(st[k] for st in ts.values())
+            if tot > g[k]:
+                return ("tenant books: tenant %s charges sum to %d, "
+                        "the door counted %d" % (k, tot, g[k]))
+        return None
 
     # -- accounting ----------------------------------------------------
     def _bump(self, *names: str) -> None:
@@ -1975,6 +2066,7 @@ class ServeFrontend:
         if not self._kv_pressure and free_pct < self.kv_pressure_pct:
             self._kv_pressure = True
             self._kv_pressures += 1
+            self._kvp_t0 = time.monotonic()
             telemetry.count("serve.kv_pressure")
             telemetry.event({
                 "ev": "kv_pressure", "pressure": 1,
@@ -2002,6 +2094,10 @@ class ServeFrontend:
                                             or 0) / total)
             if free_pct >= self.kv_pressure_clear_pct:
                 self._kv_pressure = False
+                if self._kvp_t0 is not None:
+                    self._kvp_episodes.append(
+                        (self._kvp_t0, time.monotonic()))
+                    self._kvp_t0 = None
                 telemetry.event({
                     "ev": "kv_pressure", "pressure": 0,
                     "free_pct": round(free_pct, 2)})
@@ -2201,6 +2297,7 @@ class ServeFrontend:
             # count, never a device OOM.
             health.beat("serve.worker")
             self._inflight_since = None
+            req.kv_defers += 1
             telemetry.count("serve.kv_defer")
             return _KV_DEFER
         except Exception as e:
@@ -2632,6 +2729,19 @@ class ServeFrontend:
         # own invariant)
         self._publish_batch_state(None, {}, sessions)
 
+    def _episode_overlap(self, episodes, open_t0, a: float,
+                         b: float) -> float:
+        """Seconds of the monotonic span [a, b] covered by recorded
+        episode windows plus a still-open episode (latched at
+        ``open_t0``, not yet cleared). Worker thread only — the
+        episode deques have a single writer and a single reader."""
+        s = 0.0
+        for e0, e1 in episodes:
+            s += max(0.0, min(b, e1) - max(a, e0))
+        if open_t0 is not None:
+            s += max(0.0, b - max(a, open_t0))
+        return s
+
     def _observe_request(self, req: _Request, tc, outcome: str,
                          queue_wait: float, t_pop: float, t_back: float,
                          t_end: float, wall: float, ntok: int,
@@ -2739,6 +2849,20 @@ class ServeFrontend:
                 perf.decode_bound_tokens_per_s(ntok)
         if tc is not None and tc.counts:
             rec["counts"] = dict(tc.counts)
+        # autopsy inputs + verdict (utils/autopsy.py): seconds of this
+        # request's [arrival, answer] span spent inside convoy / KV-
+        # pressure episodes, its block-pool defer count, and the
+        # classified cause decomposition — /why renders it, the
+        # serve_request_done event carries it, and /eventz joins
+        # incident rows to the requests whose autopsies cite them
+        t1 = req.t_arrival + wall
+        rec["convoy_overlap_s"] = round(self._episode_overlap(
+            self._convoy_episodes, self._convoy_t0,
+            req.t_arrival, t1), 6)
+        rec["kv_pressure_overlap_s"] = round(self._episode_overlap(
+            self._kvp_episodes, self._kvp_t0, req.t_arrival, t1), 6)
+        rec["kv_defers"] = req.kv_defers
+        rec["autopsy"] = autopsy.classify_record(rec)
         self.flight.record(rec)
         ev = {"ev": "serve_request_done", "req": req.id,
               "outcome": outcome, "tokens": ntok,
@@ -2758,6 +2882,7 @@ class ServeFrontend:
             ev["prefill_s"] = ev["decode_s"] = None
         if ttft is not None:
             ev["ttft_s"] = rec["ttft_s"]
+        ev["autopsy"] = rec["autopsy"]
         telemetry.event(ev)
         self._slo_observe(req.tenant, ok=(outcome == "served"),
                           ttft_s=ttft, latency_s=total)
@@ -2973,6 +3098,11 @@ class ServeFrontend:
             time.sleep(0.02)
         health.pause("serve.worker")
         health.pause("serve.accept")
+        # the laws leave the registry with the frontend; a latch
+        # observed before drain survives the unregister (the auditor's
+        # contract), so a violation still fails the next scrape
+        for law in ("serve.books", "serve.tenant_books", "kv.blocks"):
+            telemetry.audit_unregister(law)
         stats = self.stats()
         telemetry.event(dict({"ev": "serve_drain", "phase": "end",
                               "seconds": round(time.monotonic() - t0, 3)},

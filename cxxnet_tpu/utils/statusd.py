@@ -70,6 +70,22 @@ Endpoints:
   — plus the router's counters and the rolling-reload drain windows.
   ``?json=1`` returns the raw snapshot; /metrics exports the same
   account as the ``cxxnet_fleet_*`` series.
+* ``/why?request=<id>`` — one request's slowdown AUTOPSY
+  (utils/autopsy.py): its wall time decomposed into named causes
+  (queue_wait / compile_stall / convoy_victim / kv_defer /
+  eviction_storm / hedge_replay / slow_replica / decode_baseline) with
+  seconds attributed to each and exactly ONE primary verdict. On a
+  router process the verdict is stitched CROSS-PROCESS: the winning
+  replica's own books refine the attempt latency lane, ``slow_replica``
+  absorbing what they cannot account for. ``?json=1`` for the raw
+  payload.
+* ``/eventz`` — the fleet incident timeline: every transition-only
+  event stream (decode convoy, KV pressure, SLO burn, fleet outliers,
+  breaker, scale/reload/drain, broken books) merged into ONE
+  wall-clock-aligned list of begin/end/point rows, each begin row
+  carrying the requests whose autopsies cite its episode. On a router
+  the timeline federates every replica's own feed under one clock.
+  ``?json=1`` raw rows, ``?n=<k>`` newest rows.
 
 Serving SLOs: an ``SLOTracker`` (objectives ``slo_ttft_ms`` /
 ``slo_p99_ms`` / ``slo_availability`` over a rolling window) turns each
@@ -108,6 +124,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs
 
+from . import autopsy
 from . import health as health_mod
 from . import lockrank
 from . import telemetry
@@ -117,9 +134,31 @@ __all__ = [
     "set_run_info", "update_progress", "register_probe", "wire_health",
     "set_flight_recorder", "set_slo", "set_slo_tenants", "set_perf",
     "set_profiler", "set_batch",
-    "set_fleet", "prometheus_metrics", "programz_html", "fleetz_html",
-    "requestz_html", "batchz_html", "PROM_LINE_RE", "selftest",
+    "set_fleet", "set_auditor",
+    "prometheus_metrics", "programz_html", "fleetz_html",
+    "requestz_html", "batchz_html", "why_html", "eventz_html",
+    "ENDPOINTS", "PROM_LINE_RE", "selftest",
 ]
+
+# Every endpoint the handler dispatches, with its query contract:
+# (path, takes ?json=1, takes ?n=<k>). The 404 page and the
+# parametrized endpoint-contract test both derive from THIS table, so
+# an endpoint cannot ship without declaring (and honoring) its flags.
+ENDPOINTS: Tuple[Tuple[str, bool, bool], ...] = (
+    ("/metrics", True, False),
+    ("/healthz", False, False),
+    ("/livez", False, False),
+    ("/statusz", False, False),
+    ("/trace", False, False),
+    ("/requestz", True, True),
+    ("/batchz", True, True),
+    ("/programz", True, True),
+    ("/compilez", True, True),
+    ("/profilez", False, False),
+    ("/fleetz", True, True),
+    ("/why", True, False),
+    ("/eventz", True, True),
+)
 
 _NAME_SAN = re.compile(r"[^a-zA-Z0-9_]")
 
@@ -303,7 +342,8 @@ def prometheus_metrics(snapshot: dict, progress: Optional[dict] = None,
                        slo_tenants: Optional[dict] = None,
                        perf: Optional[dict] = None,
                        batch: Optional[dict] = None,
-                       fleet: Optional[dict] = None) -> str:
+                       fleet: Optional[dict] = None,
+                       books: Optional[dict] = None) -> str:
     """Render a ``telemetry.metrics_snapshot()`` as Prometheus text
     exposition format 0.0.4. Pure function of its inputs — the selftest
     and tests validate its output without a socket. ``channels`` is the
@@ -852,6 +892,28 @@ def prometheus_metrics(snapshot: dict, progress: Optional[dict] = None,
         v = (progress or {}).get(key)
         if _num(v):
             emit("cxxnet_progress_" + key, "gauge", v)
+    if books is not None:
+        # the conservation-law auditor's account (telemetry.BooksAuditor
+        # snapshot): one latched gauge row per law — a 1 is sticky until
+        # an operator resets the auditor, so a scrape-miss between sweep
+        # and page can never hide a violation. Broken laws that were
+        # since unregistered (a drained router) still render their latch.
+        laws = sorted(set(books.get("laws") or ())
+                      | set(books.get("broken") or ()))
+        if laws:
+            out.append("# HELP cxxnet_books_broken 1 latched once the "
+                       "named conservation law was ever violated")
+            out.append("# TYPE cxxnet_books_broken gauge")
+            broken = set(books.get("broken") or ())
+            for law in laws:
+                out.append('cxxnet_books_broken{process="%s",law="%s"} %d'
+                           % (_lesc(p), _lesc(law),
+                              1 if law in broken else 0))
+        emit("cxxnet_books_laws", "gauge",
+             len(books.get("laws") or ()),
+             help_="conservation laws currently registered for sweeping")
+        emit("cxxnet_books_sweeps_total", "counter",
+             int(books.get("sweeps", 0)))
     for name, v in sorted(snapshot.get("counters", {}).items()):
         if _num(v):
             emit(_mname(name) + "_total", "counter", v)
@@ -1357,6 +1419,98 @@ def batchz_html(snap: dict) -> str:
     return "\n".join(parts)
 
 
+def why_html(payload: dict) -> str:
+    """Render one request's slowdown autopsy (a ``classify_record`` /
+    ``classify_route`` verdict, or a router's ``stitch_route`` merge)
+    as the /why page: the primary verdict up top, then the cause
+    waterfall with seconds and share of wall time, then — on a router —
+    each hop's own local verdict. Pure function of the payload —
+    validated socket-free in tests."""
+    esc = html.escape
+    aut = payload.get("autopsy") or {}
+    causes = aut.get("causes") or {}
+    wall = float(aut.get("wall_s") or 0.0)
+    parts = ["<html><head><title>cxxnet why</title></head>"
+             "<body><h1>request autopsy: %s</h1><pre>"
+             % esc(str(payload.get("id", "?")))]
+    parts.append("outcome: %-12s  wall %s   PRIMARY VERDICT: %s"
+                 % (esc(str(payload.get("outcome", "?"))),
+                    _ms(wall * 1e3),
+                    esc(str(aut.get("primary", "?")))))
+    parts.append("</pre><h2>cause waterfall</h2><pre>")
+    fmt = "%-16s %10s %7s  %s"
+    parts.append(fmt % ("cause", "seconds", "share", ""))
+    for cause in autopsy.CAUSES:
+        s = float(causes.get(cause, 0.0))
+        share = (100.0 * s / wall) if wall > 0 else 0.0
+        bar = "#" * int(round(share / 4.0))
+        mark = " <-- primary" if cause == aut.get("primary") else ""
+        parts.append(fmt % (esc(cause), "%.6f" % s,
+                            "%.1f%%" % share, bar + mark))
+    hops = payload.get("hops") or {}
+    if hops:
+        parts.append("</pre><h2>hops (each replica's local verdict)"
+                     "</h2><pre>")
+        hfmt = "%-16s %-16s %10s  %s"
+        parts.append(hfmt % ("replica", "primary", "wall", "causes"))
+        for name in sorted(hops):
+            h = hops[name] or {}
+            hc = h.get("causes") or {}
+            detail = " ".join(
+                "%s=%s" % (c, _ms(hc[c] * 1e3))
+                for c in autopsy.CAUSES if hc.get(c, 0.0) > 0.0)
+            parts.append(hfmt % (
+                esc(str(name)), esc(str(h.get("primary", "?"))),
+                _ms(float(h.get("wall_s") or 0.0) * 1e3), esc(detail)))
+    parts.append("</pre><p>the raw record: "
+                 "<code>/requestz?request=&lt;id&gt;</code>; the Gantt "
+                 "view: <code>/trace?request=&lt;id&gt;</code>; "
+                 "<a href='/why?request=%s&amp;json=1'>json</a> "
+                 "<a href='/eventz'>eventz</a> "
+                 "<a href='/statusz'>statusz</a></p></body></html>"
+                 % esc(str(payload.get("id", "?"))))
+    return "\n".join(parts)
+
+
+def eventz_html(rows: List[dict], limit: int = 0) -> str:
+    """Render the incident timeline (``autopsy.incidents`` rows — on a
+    router the fleet-merged feed) as the /eventz page: one wall-clock
+    ordered row per transition or point incident, begin rows naming the
+    requests whose autopsies cite the episode. Pure function of the
+    rows — validated socket-free in tests."""
+    esc = html.escape
+    parts = ["<html><head><title>cxxnet eventz</title></head>"
+             "<body><h1>fleet incident timeline</h1><pre>"]
+    parts.append("%d incident row(s)%s"
+                 % (len(rows),
+                    "  (newest %d — ?n=<k> to change)" % limit
+                    if limit > 0 else ""))
+    parts.append("</pre><pre>")
+    fmt = "%-12s %-10s %-14s %-6s %-24s %s"
+    parts.append(fmt % ("t+", "process", "kind", "state", "requests",
+                        "detail"))
+    for r in rows:
+        ev = r.get("event") or {}
+        detail = " ".join(
+            "%s=%s" % (k, ev[k]) for k in sorted(ev)
+            if k not in ("ev", "ts") and not isinstance(ev[k], (dict,
+                                                               list)))
+        reqs = ",".join(str(x) for x in (r.get("requests") or ())) \
+            or "-"
+        parts.append(fmt % (
+            "%.3fs" % float(r.get("ts") or 0.0),
+            esc(str(r.get("process", "-"))), esc(str(r.get("kind", "?"))),
+            esc(str(r.get("state", "?"))), esc(reqs), esc(detail)))
+    if not rows:
+        parts.append("(no incidents recorded — a quiet fleet)")
+    parts.append("</pre><p>each begin row names the requests whose "
+                 "<code>/why?request=&lt;id&gt;</code> autopsies cite "
+                 "the episode; "
+                 "<a href='/eventz?json=1'>json</a> "
+                 "<a href='/statusz'>statusz</a></p></body></html>")
+    return "\n".join(parts)
+
+
 class _HTTPServer(ThreadingHTTPServer):
     daemon_threads = True
     allow_reuse_address = True
@@ -1555,13 +1709,27 @@ class _Endpoint(BaseHTTPRequestHandler):
                                 batchz_html(snap).encode("utf-8"))
             elif path == "/programz":
                 lg = srv.perf
+                q = parse_qs(query)
+                try:
+                    # ?n=<k>: program cards shown (default all — the
+                    # grid is small; floods of shapes are not). The
+                    # query contract outranks the subsystem check: a
+                    # malformed ?n is 400 even with no ledger wired.
+                    n = int((q.get("n") or ["0"])[0])
+                except ValueError:
+                    self._reply(400, "text/plain; charset=utf-8",
+                                b"n must be an integer\n")
+                    return
                 if lg is None:
                     self._reply(404, "text/plain; charset=utf-8",
                                 b"no performance ledger registered "
                                 b"(perf_ledger=0?)\n")
                 else:
                     snap = lg.snapshot()
-                    if parse_qs(query).get("json"):
+                    if n > 0:
+                        snap = dict(snap)
+                        snap["cards"] = (snap.get("cards") or [])[:n]
+                    if q.get("json"):
                         self._reply(200, "application/json",
                                     json.dumps(snap).encode("utf-8"))
                     else:
@@ -1569,19 +1737,20 @@ class _Endpoint(BaseHTTPRequestHandler):
                                     programz_html(snap).encode("utf-8"))
             elif path == "/compilez":
                 lg = srv.perf
+                q = parse_qs(query)
+                try:
+                    # ?n=<k>: compile-ring rows shown (default 64).
+                    # Contract first: malformed ?n is 400, ledger or not.
+                    n = int((q.get("n") or ["64"])[0])
+                except ValueError:
+                    self._reply(400, "text/plain; charset=utf-8",
+                                b"n must be an integer\n")
+                    return
                 if lg is None:
                     self._reply(404, "text/plain; charset=utf-8",
                                 b"no performance ledger registered "
                                 b"(perf_ledger=0?)\n")
                 else:
-                    q = parse_qs(query)
-                    try:
-                        # ?n=<k>: compile-ring rows shown (default 64)
-                        n = int((q.get("n") or ["64"])[0])
-                    except ValueError:
-                        self._reply(400, "text/plain; charset=utf-8",
-                                    b"n must be an integer\n")
-                        return
                     recs = lg.recent_compiles()
                     total = len(recs)
                     if n > 0:
@@ -1597,6 +1766,15 @@ class _Endpoint(BaseHTTPRequestHandler):
                                     compilez_html(body).encode("utf-8"))
             elif path == "/fleetz":
                 fl = srv.fleet
+                q = parse_qs(query)
+                try:
+                    # ?n=<k>: replica rows shown (default all).
+                    # Contract first: malformed ?n is 400, fleet or not.
+                    n = int((q.get("n") or ["0"])[0])
+                except ValueError:
+                    self._reply(400, "text/plain; charset=utf-8",
+                                b"n must be an integer\n")
+                    return
                 if fl is None:
                     self._reply(404, "text/plain; charset=utf-8",
                                 b"no fleet registered (this process is "
@@ -1604,12 +1782,85 @@ class _Endpoint(BaseHTTPRequestHandler):
                                 b"one)\n")
                 else:
                     snap = fl.fleet_snapshot()
-                    if parse_qs(query).get("json"):
+                    if n > 0:
+                        snap = dict(snap)
+                        snap["replicas"] = \
+                            (snap.get("replicas") or [])[:n]
+                    if q.get("json"):
                         self._reply(200, "application/json",
                                     json.dumps(snap).encode("utf-8"))
                     else:
                         self._reply(200, "text/html; charset=utf-8",
                                     fleetz_html(snap).encode("utf-8"))
+            elif path == "/why":
+                q = parse_qs(query, keep_blank_values=True)
+                rid = (q.get("request") or [None])[0]
+                if rid is None:
+                    self._reply(400, "text/plain; charset=utf-8",
+                                b"which request? /why?request=<id> "
+                                b"(ids on /requestz)\n")
+                    return
+                if srv.fleet is not None and hasattr(
+                        srv.fleet, "stitched_why"):
+                    # router process: the cross-process verdict — the
+                    # router's own lane decomposition with the winning
+                    # replica's books stitched into the latency lane
+                    # (routerd.stitched_why)
+                    payload = srv.fleet.stitched_why(rid)
+                else:
+                    fr = srv.flight
+                    rec = fr.get(rid) if fr is not None else None
+                    payload = None if rec is None else {
+                        "id": rec.get("id"),
+                        "outcome": rec.get("outcome"),
+                        # replicas stamp the verdict at record time
+                        # (servd._observe_request); classify on the
+                        # fly for records that predate the autopsy
+                        "autopsy": rec.get("autopsy")
+                        or autopsy.classify_record(rec),
+                        "hops": {}}
+                if payload is None:
+                    self._reply(404, "text/plain; charset=utf-8",
+                                ("no flight record for request %r; "
+                                 "see /requestz\n" % rid)
+                                .encode("utf-8"))
+                elif q.get("json"):
+                    self._reply(200, "application/json",
+                                json.dumps(payload).encode("utf-8"))
+                else:
+                    self._reply(200, "text/html; charset=utf-8",
+                                why_html(payload).encode("utf-8"))
+            elif path == "/eventz":
+                q = parse_qs(query)
+                try:
+                    # ?n=<k>: newest incident rows shown (default all —
+                    # the transition streams are sparse by design)
+                    n = int((q.get("n") or ["0"])[0])
+                except ValueError:
+                    self._reply(400, "text/plain; charset=utf-8",
+                                b"n must be an integer\n")
+                    return
+                if srv.fleet is not None and hasattr(
+                        srv.fleet, "fleet_eventz"):
+                    # router process: the fleet-merged timeline — this
+                    # router's incidents plus every replica's own
+                    # /eventz feed under one wall clock
+                    rows = srv.fleet.fleet_eventz(
+                        n if n > 0 else None)
+                else:
+                    fr = srv.flight
+                    rows = autopsy.incidents(
+                        srv.registry.recent_events(),
+                        t0_wall=getattr(srv.registry, "t0_wall", 0.0),
+                        records=fr.list() if fr is not None else None,
+                        n=n if n > 0 else None)
+                if q.get("json"):
+                    body = {"rows": rows, "shown": len(rows)}
+                    self._reply(200, "application/json",
+                                json.dumps(body).encode("utf-8"))
+                else:
+                    self._reply(200, "text/html; charset=utf-8",
+                                eventz_html(rows, n).encode("utf-8"))
             elif path == "/profilez":
                 prof = srv.profiler
                 if prof is None:
@@ -1646,11 +1897,13 @@ class _Endpoint(BaseHTTPRequestHandler):
                         self._reply(code, "text/plain; charset=utf-8",
                                     (detail + "\n").encode("utf-8"))
             else:
+                # the endpoint table IS the list — a new endpoint that
+                # skips ENDPOINTS is invisible here and fails the
+                # parametrized contract test
                 self._reply(404, "text/plain; charset=utf-8",
-                            b"not found; endpoints: /metrics /healthz "
-                            b"/livez /statusz /trace /requestz "
-                            b"/programz /compilez /profilez /fleetz "
-                            b"/batchz\n")
+                            ("not found; endpoints: %s\n"
+                             % " ".join(p for p, _, _ in ENDPOINTS))
+                            .encode("utf-8"))
         except Exception as e:    # a broken probe must not kill the server
             try:
                 self._reply(500, "text/plain; charset=utf-8",
@@ -1693,6 +1946,10 @@ class StatusServer:
         # fleet wiring (set_fleet): the routerd.Router behind /fleetz
         # and the cxxnet_fleet_* series (task = route registers it)
         self.fleet = None
+        # the conservation-law auditor behind cxxnet_books_* — the
+        # PROCESS-wide one by default (servd/routerd register their
+        # laws there), swappable for isolation via set_auditor(None)
+        self.auditor = telemetry.auditor()
         # (name, probe_fn, liveness): see register_probe
         self.probes: List[Tuple[str, Callable[[], Tuple[bool, str]],
                                 bool]] = []
@@ -1801,6 +2058,13 @@ class StatusServer:
         # within a single response
         channels = health_mod.channel_status()
         ready, live = self.all_failures(channels)
+        books = None
+        if self.auditor is not None:
+            # EVERY scrape sweeps: a violation can never hide between
+            # daemon periods, and the latched account below is at most
+            # one scrape old
+            self.auditor.sweep()
+            books = self.auditor.snapshot()
         return prometheus_metrics(
             self.registry.metrics_snapshot(),
             progress=dict(self.progress),
@@ -1815,7 +2079,8 @@ class StatusServer:
             batch=self.batch.batch_snapshot()
             if self.batch is not None else None,
             fleet=self.fleet.fleet_snapshot()
-            if self.fleet is not None else None)
+            if self.fleet is not None else None,
+            books=books)
 
     def statusz_html(self) -> str:
         reg = self.registry
@@ -1979,11 +2244,9 @@ class StatusServer:
             for k, v in cfg:
                 parts.append("%s = %s" % (esc(str(k)), esc(str(v))))
             parts.append("</pre></details>")
-        parts.append("<p>endpoints: <a href='/metrics'>/metrics</a> "
-                     "<a href='/healthz'>/healthz</a> "
-                     "<a href='/trace'>/trace</a> "
-                     "<a href='/requestz'>/requestz</a> "
-                     "<a href='/programz'>/programz</a></p></body></html>")
+        parts.append("<p>endpoints: %s</p></body></html>"
+                     % " ".join("<a href='%s'>%s</a>" % (p, p)
+                                for p, _, _ in ENDPOINTS))
         return "\n".join(parts)
 
 
@@ -1998,6 +2261,10 @@ def start(port: int = 0, host: str = "", registry=None) -> StatusServer:
     global _SERVER
     stop()
     _SERVER = StatusServer(port, host=host, registry=registry).start()
+    # the continuous half of the conservation-law auditor: scrapes
+    # sweep on demand (metrics_text), the daemon sweeps between them —
+    # an unwatched process still latches cxxnet_books_broken
+    telemetry.auditor().start(0.5)
     return _SERVER
 
 
@@ -2006,6 +2273,7 @@ def stop() -> None:
     if _SERVER is not None:
         s, _SERVER = _SERVER, None
         s.stop()
+        telemetry.auditor().stop()
 
 
 def active() -> Optional[StatusServer]:
@@ -2095,6 +2363,15 @@ def set_fleet(router) -> None:
     s = _SERVER
     if s is not None:
         s.fleet = router
+
+
+def set_auditor(aud) -> None:
+    """Swap the conservation-law auditor behind the cxxnet_books_*
+    series (the process-wide telemetry.auditor() by default). None
+    stops exporting books state. No-op without a server."""
+    s = _SERVER
+    if s is not None:
+        s.auditor = aud
 
 
 # ----------------------------------------------------------------------
@@ -2193,6 +2470,48 @@ def _selftest_body(verbose: bool = False) -> int:
             raise AssertionError("unknown request id should 404")
         except HTTPError as e:
             assert e.code == 404
+        # request autopsy: /why decomposes the record's wall time into
+        # named causes, exactly ONE primary verdict, and the attributed
+        # seconds tile >= 95% of wall_s
+        why = json.loads(urlopen(base + "/why?request=7&json=1",
+                                 timeout=5).read())
+        aut = why["autopsy"]
+        assert aut["primary"] == "decode_baseline", aut
+        assert sum(aut["causes"].values()) >= 0.95 * aut["wall_s"], aut
+        wpage = urlopen(base + "/why?request=7",
+                        timeout=5).read().decode()
+        assert "PRIMARY VERDICT" in wpage and "decode_baseline" in wpage
+        try:
+            urlopen(base + "/why?request=nope", timeout=5)
+            raise AssertionError("unknown request id should 404")
+        except HTTPError as e:
+            assert e.code == 404
+        try:
+            urlopen(base + "/why", timeout=5)
+            raise AssertionError("missing request id should 400")
+        except HTTPError as e:
+            assert e.code == 400
+        # incident timeline: a transition pair and a point event merge
+        # into wall-clock-ordered rows on /eventz
+        reg.record({"ev": "kv_pressure", "pressure": 1, "ts": 0.01})
+        reg.record({"ev": "kv_pressure", "pressure": 0, "ts": 0.05})
+        reg.record({"ev": "serve_drain", "ts": 0.06})
+        evz = json.loads(urlopen(base + "/eventz?json=1",
+                                 timeout=5).read())
+        kinds = [r["kind"] for r in evz["rows"]]
+        assert "kv_pressure" in kinds and "serve_drain" in kinds, kinds
+        walls = [r["t_wall"] for r in evz["rows"]]
+        assert walls == sorted(walls)
+        lim2 = json.loads(urlopen(base + "/eventz?json=1&n=1",
+                                  timeout=5).read())
+        assert lim2["shown"] == 1
+        epage = urlopen(base + "/eventz", timeout=5).read().decode()
+        assert "incident timeline" in epage
+        try:
+            urlopen(base + "/eventz?n=x", timeout=5)
+            raise AssertionError("non-integer n should 400")
+        except HTTPError as e:
+            assert e.code == 400
         # SLO burn flips under a flood of objective-violating requests
         for _ in range(5):
             srv.slo.observe(ok=True, ttft_s=0.5)     # >> 50ms objective
@@ -2299,19 +2618,46 @@ def _selftest_body(verbose: bool = False) -> int:
         trace = json.loads(urlopen(base + "/trace", timeout=5).read())
         assert any(t.get("ph") == "X" for t in trace["traceEvents"])
 
+        # conservation-law auditor: a law that cannot reconcile latches
+        # cxxnet_books_broken on the next scrape (metrics_text sweeps),
+        # sticky until an operator resets the auditor
+        telemetry.audit_register("selftest.books",
+                                 lambda: "debit 3 != credit 2")
+        try:
+            mb = urlopen(base + "/metrics", timeout=5).read().decode()
+            for line in mb.splitlines():
+                if line and not line.startswith("#"):
+                    assert PROM_LINE_RE.match(line), \
+                        "invalid Prometheus line: %r" % line
+            assert ('cxxnet_books_broken{process="0",'
+                    'law="selftest.books"} 1' in mb)
+            assert "cxxnet_books_laws" in mb
+            # the latch is sticky: a clean follow-up sweep cannot clear
+            mb2 = urlopen(base + "/metrics", timeout=5).read().decode()
+            assert ('cxxnet_books_broken{process="0",'
+                    'law="selftest.books"} 1' in mb2)
+        finally:
+            telemetry.audit_unregister("selftest.books")
+            telemetry.auditor().reset()
+
         try:
             urlopen(base + "/nope", timeout=5)
             raise AssertionError("unknown path should 404")
         except HTTPError as e:
             assert e.code == 404
+            # the 404 body derives from the ENDPOINTS table
+            body = e.read().decode()
+            for p, _, _ in ENDPOINTS:
+                assert p in body, (p, body)
     finally:
         srv.stop()
         reg.disable()
     if verbose:
         print("statusd selftest: /metrics /healthz /livez /statusz "
-              "/trace /requestz ok (Prometheus format valid, readiness "
-              "vs liveness flips, per-request trace, SLO burn flip, "
-              "empty-series n/a, 404)")
+              "/trace /requestz /why /eventz ok (Prometheus format "
+              "valid, readiness vs liveness flips, per-request trace, "
+              "autopsy verdict + incident timeline, books latch, SLO "
+              "burn flip, empty-series n/a, 404)")
     return 0
 
 

@@ -88,12 +88,15 @@ __all__ = [
     "hist", "event", "record_compile", "jit_watch",
     "sample_device_memory",
     "flush", "finish", "summary", "brief_summary", "events",
-    "recent_events", "last_event", "span_event", "percentile", "count_by",
+    "recent_events", "last_event", "wall_epoch", "span_event",
+    "percentile", "count_by",
     "chrome_trace", "events_to_chrome", "write_chrome_trace",
     "Histogram", "HIST_BUCKETS", "trace_context", "current_trace", "mark",
     "declare_hist", "TraceContext", "FlightRecorder",
     "request_chrome_trace", "REQUEST_PHASES",
     "CompileWindow", "compile_window", "current_compile_window",
+    "BooksAuditor", "auditor", "audit_register", "audit_unregister",
+    "audit_sweep",
 ]
 
 # per-span-name duration history kept for live percentiles (the JSONL log
@@ -1085,6 +1088,139 @@ class JitWatch:
         return getattr(self._fn, name)
 
 
+class BooksAuditor:
+    """Conservation-law registry: named invariants over the serving
+    books — "accepted = served + shed + errors + deadline + abandoned",
+    "blocks total = free + live + retained", "tenant charges sum to the
+    door books", "fleet sums = Σ replica feeds" — checked on a daemon
+    sweep and at every /metrics scrape, so every number the request
+    autopsy and the bench rows cite is provably reconciled.
+
+    A law is a callable ``fn() -> Optional[str]``: ``None`` means the
+    books reconcile (or the law could not take a consistent snapshot —
+    inconclusive PASSES; a law must never false-latch off a racy read:
+    use a stable-snapshot double-read and return None when the bracket
+    moved), a string is the violation detail. The first violation
+    LATCHES the law sticky-broken (``cxxnet_books_broken{law=...}``
+    stays 1 until ``reset()``), emits exactly one ``books_broken``
+    transition event (``broken: 1`` carrying the detail; ``reset()``
+    emits the matching ``broken: 0`` clear), and bumps the
+    ``books.violations`` counter — a single bad snapshot can never flap
+    the gauge, and telemetry_report's exit-2 gate sees the latch even
+    if every later sweep reconciles.
+
+    Laws run OUTSIDE the auditor lock (a law reads other subsystems'
+    locked state; rank "telemetry.audit" keeps the latch bookkeeping
+    below only the registry itself), and the transition events are
+    emitted outside it too. A law that RAISES is counted
+    (``law_errors``) but treated as inconclusive: laws are registered
+    at start() and unregistered at drain(), and a transient exception
+    during concurrent teardown must not break the books."""
+
+    def __init__(self, registry: Optional["_Registry"] = None):
+        self._lock = lockrank.lock("telemetry.audit")
+        self._registry = registry
+        self._laws: Dict[str, object] = {}
+        self._broken: Dict[str, str] = {}
+        self.violations = 0          # cumulative latches (survives reset)
+        self.sweeps = 0
+        self.law_errors = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stop_ev = threading.Event()
+
+    def _reg(self) -> "_Registry":
+        return self._registry if self._registry is not None else _REG
+
+    def register(self, name: str, fn) -> None:
+        """Install (or replace) the named law."""
+        with self._lock:
+            self._laws[str(name)] = fn
+
+    def unregister(self, name: str) -> None:
+        """Remove the named law. A latch it already tripped STAYS
+        latched — a violation observed just before drain must still
+        fail the next scrape."""
+        with self._lock:
+            self._laws.pop(str(name), None)
+
+    def sweep(self) -> Dict[str, Optional[str]]:
+        """Evaluate every registered law once. Returns {law: detail}
+        (None = reconciled/inconclusive) for this sweep; latch state is
+        cumulative and read via snapshot()."""
+        with self._lock:
+            laws = list(self._laws.items())
+        results: Dict[str, Optional[str]] = {}
+        errors = 0
+        for name, fn in laws:
+            try:
+                detail = fn()
+            except Exception:
+                errors += 1
+                detail = None
+            results[name] = None if detail is None else str(detail)
+        newly: List[tuple] = []
+        with self._lock:
+            self.sweeps += 1
+            self.law_errors += errors
+            for name, detail in results.items():
+                if detail is not None and name not in self._broken:
+                    self._broken[name] = detail
+                    self.violations += 1
+                    newly.append((name, detail))
+        reg = self._reg()
+        for name, detail in newly:
+            reg.count("books.violations")
+            reg.record({"ev": "books_broken", "law": name, "broken": 1,
+                        "detail": detail})
+        return results
+
+    def snapshot(self) -> dict:
+        """Point-in-time view for /metrics and bench rows."""
+        with self._lock:
+            return {"laws": sorted(self._laws),
+                    "broken": dict(self._broken),
+                    "violations": self.violations,
+                    "sweeps": self.sweeps,
+                    "law_errors": self.law_errors}
+
+    def reset(self) -> None:
+        """Clear every latch, emitting the ``broken: 0`` transition for
+        each — the operator's acknowledge. ``violations`` stays
+        cumulative (the bench-row feed)."""
+        with self._lock:
+            cleared = sorted(self._broken)
+            self._broken.clear()
+        reg = self._reg()
+        for name in cleared:
+            reg.record({"ev": "books_broken", "law": name, "broken": 0})
+
+    def start(self, period_s: float = 1.0) -> None:
+        """Start the daemon sweep loop (idempotent)."""
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._stop_ev.clear()
+            t = threading.Thread(target=self._run,
+                                 args=(max(0.05, float(period_s)),),
+                                 name="books-auditor", daemon=True)
+            self._thread = t
+        t.start()
+
+    def _run(self, period_s: float) -> None:
+        while not self._stop_ev.wait(period_s):
+            try:
+                self.sweep()
+            except Exception:
+                pass
+
+    def stop(self) -> None:
+        with self._lock:
+            t, self._thread = self._thread, None
+        if t is not None:
+            self._stop_ev.set()
+            t.join(timeout=2.0)
+
+
 # ----------------------------------------------------------------------
 # module-level singleton surface
 _REG = _Registry()
@@ -1191,6 +1327,13 @@ def recent_events() -> List[dict]:
     return _REG.recent_events()
 
 
+def wall_epoch() -> float:
+    """The registry's wall-clock epoch: event ``ts`` seconds are
+    relative to this, so cross-process alignment (the /eventz incident
+    merge, --merge shard re-basing) is ``t0_wall + ts``."""
+    return _REG.t0_wall
+
+
 def last_event(kind: str) -> Optional[dict]:
     return _REG.last_event(kind)
 
@@ -1201,6 +1344,29 @@ def chrome_trace() -> dict:
 
 def write_chrome_trace(path: str) -> str:
     return _REG.write_chrome_trace(path)
+
+
+# the process-wide conservation-law auditor: subsystems register laws
+# at start() (servd's door books, kvblocks' block conservation, routerd's
+# federation sums) and unregister them at drain(); statusd sweeps at
+# every scrape and exports the latches as cxxnet_books_broken{law=...}
+_AUDITOR = BooksAuditor()
+
+
+def auditor() -> BooksAuditor:
+    return _AUDITOR
+
+
+def audit_register(name: str, fn) -> None:
+    _AUDITOR.register(name, fn)
+
+
+def audit_unregister(name: str) -> None:
+    _AUDITOR.unregister(name)
+
+
+def audit_sweep() -> Dict[str, Optional[str]]:
+    return _AUDITOR.sweep()
 
 
 def sample_device_memory() -> Optional[dict]:

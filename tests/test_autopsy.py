@@ -1,0 +1,350 @@
+"""Request-autopsy + incident-timeline + conservation-law tests
+(utils/autopsy.py, telemetry.BooksAuditor, tools/telemetry_report.py).
+
+Everything here is jax-free: the classifier and the timeline are pure
+functions of dicts, the auditor is stdlib threading, and the report
+tool parses JSONL. One fixture per cause class drives the classifier
+through every verdict it can return; the auditor tests corrupt a
+counter on purpose and assert the latch -> event -> exit-2 chain the
+acceptance criteria name.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from cxxnet_tpu.utils import autopsy, telemetry
+from cxxnet_tpu.utils.autopsy import (CAUSES, classify_record,
+                                      classify_route, incidents,
+                                      stitch_route)
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tools"))
+import telemetry_report  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _lockrank_on(monkeypatch):
+    monkeypatch.setenv("CXXNET_LOCKRANK", "1")
+
+
+def _phases(queue=0.0, dispatch=0.0, prefill=0.0, decode=0.0):
+    return {"queue_wait": queue, "dispatch": dispatch,
+            "prefill": prefill, "decode": decode}
+
+
+def _tiles(aut, frac=0.95):
+    """The acceptance shape: causes tile >= frac of wall_s."""
+    return sum(aut["causes"].values()) >= frac * aut["wall_s"] > 0
+
+
+# ----------------------------------------------------------------------
+# one fixture per cause class
+
+def test_cause_decode_baseline():
+    aut = classify_record({"id": "a", "wall_s": 1.0, "total_s": 1.0,
+                           "phases": _phases(queue=0.05, prefill=0.2,
+                                             decode=0.75)})
+    assert aut["primary"] == "decode_baseline"
+    assert _tiles(aut)
+
+
+def test_cause_queue_wait():
+    aut = classify_record({"id": "q", "wall_s": 1.0, "total_s": 1.0,
+                           "phases": _phases(queue=0.8, prefill=0.1,
+                                             decode=0.1)})
+    assert aut["primary"] == "queue_wait"
+    assert aut["causes"]["queue_wait"] == pytest.approx(0.8)
+    assert _tiles(aut)
+
+
+def test_cause_compile_stall():
+    aut = classify_record({"id": "c", "wall_s": 2.0, "total_s": 2.0,
+                           "phases": _phases(queue=0.1, prefill=1.6,
+                                             decode=0.3),
+                           "compile_stall_s": 1.5})
+    assert aut["primary"] == "compile_stall"
+    assert aut["causes"]["compile_stall"] == pytest.approx(1.5)
+    assert _tiles(aut)
+
+
+def test_cause_convoy_victim():
+    aut = classify_record({"id": "v", "wall_s": 1.0, "total_s": 1.0,
+                           "phases": _phases(queue=0.7, decode=0.3),
+                           "convoy_overlap_s": 0.6})
+    assert aut["primary"] == "convoy_victim"
+    # the overlap never claims more than the queue pool holds
+    assert aut["causes"]["convoy_victim"] == pytest.approx(0.6)
+    assert aut["causes"]["queue_wait"] == pytest.approx(0.1)
+    assert _tiles(aut)
+
+
+def test_cause_kv_defer():
+    aut = classify_record({"id": "k", "wall_s": 1.0, "total_s": 1.0,
+                           "phases": _phases(queue=0.75, decode=0.25),
+                           "kv_defers": 3})
+    assert aut["primary"] == "kv_defer"
+    assert aut["causes"]["kv_defer"] == pytest.approx(0.75)
+    assert aut["causes"]["queue_wait"] == 0.0
+    assert _tiles(aut)
+
+
+def test_cause_eviction_storm():
+    aut = classify_record({"id": "e", "wall_s": 1.0, "total_s": 1.0,
+                           "phases": _phases(prefill=0.2, decode=0.8),
+                           "kv_pressure_overlap_s": 0.7})
+    assert aut["primary"] == "eviction_storm"
+    assert aut["causes"]["eviction_storm"] == pytest.approx(0.7)
+    assert _tiles(aut)
+
+
+def test_cause_hedge_replay():
+    aut = classify_route({"id": "h", "outcome": "served", "total_s": 1.0,
+                          "attempts": [
+                              {"replica": "x", "t_off_s": 0.0,
+                               "latency_s": 0.35, "status": "lost"},
+                              {"replica": "y", "t_off_s": 0.6,
+                               "latency_s": 0.4, "status": "ok",
+                               "cls": "replay"}]})
+    assert aut["primary"] == "hedge_replay"
+    assert aut["causes"]["hedge_replay"] == pytest.approx(0.6)
+    assert _tiles(aut)
+
+
+def test_cause_slow_replica():
+    # router saw 0.9s on the winning lane; the replica's own books only
+    # explain 0.2s -> the 0.7s gap is the replica being slower than it
+    # admits (network, GC, noisy neighbor)
+    route = {"id": "s", "outcome": "served", "total_s": 1.0,
+             "attempts": [{"replica": "x", "t_off_s": 0.1,
+                           "latency_s": 0.9, "status": "ok"}]}
+    hop = {"id": "s", "outcome": "served", "wall_s": 0.2, "total_s": 0.2,
+           "phases": _phases(prefill=0.05, decode=0.15)}
+    sw = stitch_route(route, [("x", hop)])
+    aut = sw["autopsy"]
+    assert aut["primary"] == "slow_replica"
+    assert aut["causes"]["slow_replica"] == pytest.approx(0.7)
+    assert _tiles(aut)
+    assert sw["hops"]["x"]["primary"] == "decode_baseline"
+
+
+# ----------------------------------------------------------------------
+# classifier contracts: unique primary, tiling, determinism
+
+def test_mixed_record_single_primary_and_tiling():
+    rec = {"id": "m", "wall_s": 3.0, "total_s": 3.0,
+           "phases": _phases(queue=1.0, dispatch=0.1, prefill=1.0,
+                             decode=0.9),
+           "convoy_overlap_s": 0.4, "kv_defers": 1,
+           "compile_stall_s": 0.8, "kv_pressure_overlap_s": 0.5}
+    aut = classify_record(rec)
+    # every input cause got its named share, exactly one primary
+    assert aut["causes"]["convoy_victim"] == pytest.approx(0.4)
+    assert aut["causes"]["kv_defer"] == pytest.approx(0.7)
+    assert aut["causes"]["compile_stall"] == pytest.approx(0.8)
+    assert aut["causes"]["eviction_storm"] == pytest.approx(0.5)
+    assert aut["primary"] in CAUSES
+    assert aut["primary"] == "compile_stall"        # the max cause
+    assert sum(aut["causes"].values()) == pytest.approx(aut["wall_s"])
+    assert _tiles(aut)
+    # deterministic: the same record always gets the same verdict
+    assert classify_record(dict(rec)) == aut
+
+
+def test_named_cause_beats_baseline_on_tie():
+    # compile_stall == decode_baseline exactly: the named cause wins
+    aut = classify_record({"id": "t", "wall_s": 1.0, "total_s": 1.0,
+                           "phases": _phases(decode=1.0),
+                           "compile_stall_s": 0.5})
+    assert aut["causes"]["compile_stall"] == \
+        aut["causes"]["decode_baseline"] == pytest.approx(0.5)
+    assert aut["primary"] == "compile_stall"
+
+
+def test_wall_residual_lands_in_baseline():
+    # phases under-measure the wall clock (a lost 0.3s): the residual
+    # must land in decode_baseline, never inflate a named cause
+    aut = classify_record({"id": "r", "wall_s": 1.0, "total_s": 0.7,
+                           "phases": _phases(queue=0.2, decode=0.5)})
+    assert aut["wall_s"] == pytest.approx(1.0)
+    assert aut["causes"]["decode_baseline"] == pytest.approx(0.8)
+    assert _tiles(aut)
+
+
+def test_bare_and_shed_records_still_classify():
+    assert classify_record({"id": "bare"})["primary"] == "queue_wait"
+    # a door shed on the router: no attempts, all queue_wait
+    aut = classify_route({"id": "shed", "outcome": "shed",
+                          "total_s": 0.01, "attempts": []})
+    assert aut["primary"] == "queue_wait"
+    assert aut["causes"]["queue_wait"] == pytest.approx(0.01)
+
+
+def test_stitch_scales_skewed_hop_books():
+    # the replica claims MORE than the router-observed lane (clock
+    # skew): books scale down so the stitch still tiles total_s
+    route = {"id": "z", "outcome": "served", "total_s": 0.5,
+             "attempts": [{"replica": "x", "t_off_s": 0.0,
+                           "latency_s": 0.5, "status": "ok"}]}
+    hop = {"id": "z", "outcome": "served", "wall_s": 1.0, "total_s": 1.0,
+           "phases": _phases(prefill=0.5, decode=0.5)}
+    aut = stitch_route(route, [("x", hop)])["autopsy"]
+    assert sum(aut["causes"].values()) == pytest.approx(0.5)
+    assert aut["causes"]["slow_replica"] == pytest.approx(0.0)
+
+
+# ----------------------------------------------------------------------
+# incident timeline
+
+def _convoy_events():
+    return [{"ev": "decode_convoy", "convoy": 1, "ts": 1.0, "slot": 2},
+            {"ev": "serve_drain", "ts": 1.5},
+            {"ev": "kv_pressure", "pressure": 1, "ts": 2.0},
+            {"ev": "decode_convoy", "convoy": 0, "ts": 3.0, "slot": 2},
+            {"ev": "span", "name": "noise", "ts": 2.5},   # not incident
+            {"ev": "books_broken", "law": "serve.books", "broken": 1,
+             "detail": "x", "ts": 4.0}]
+
+
+def test_incidents_rows_sorted_and_classified():
+    rows = incidents(_convoy_events(), t0_wall=100.0, process="router")
+    kinds = [(r["kind"], r["state"]) for r in rows]
+    assert kinds == [("decode_convoy", "begin"), ("serve_drain", "point"),
+                     ("kv_pressure", "begin"), ("decode_convoy", "end"),
+                     ("books_broken", "begin")]
+    walls = [r["t_wall"] for r in rows]
+    assert walls == sorted(walls) and walls[0] == pytest.approx(101.0)
+    assert all(r["process"] == "router" for r in rows)
+
+
+def test_incidents_links_overlapping_requests():
+    recs = [
+        # overlaps the convoy window [101, 103] and blames it
+        {"id": "v1", "t_wall": 101.5, "wall_s": 1.0,
+         "autopsy": {"primary": "convoy_victim",
+                     "causes": {"convoy_victim": 0.9}, "wall_s": 1.0}},
+        # blames the convoy but ran AFTER it ended: no link
+        {"id": "v2", "t_wall": 200.0, "wall_s": 1.0,
+         "autopsy": {"primary": "convoy_victim",
+                     "causes": {"convoy_victim": 0.9}, "wall_s": 1.0}},
+        # overlaps but blames nothing the convoy causes: no link
+        {"id": "v3", "t_wall": 101.5, "wall_s": 1.0,
+         "autopsy": {"primary": "decode_baseline",
+                     "causes": {"decode_baseline": 1.0}, "wall_s": 1.0}},
+        # the kv_pressure episode never ends (still latched): a late
+        # request still links through the open window
+        {"id": "p1", "t_wall": 500.0, "wall_s": 0.5,
+         "autopsy": {"primary": "kv_defer",
+                     "causes": {"kv_defer": 0.4}, "wall_s": 0.5}}]
+    rows = incidents(_convoy_events(), t0_wall=100.0, records=recs)
+    by = {(r["kind"], r["state"]): r for r in rows}
+    assert by[("decode_convoy", "begin")]["requests"] == ["v1"]
+    assert by[("kv_pressure", "begin")]["requests"] == ["p1"]
+    assert "requests" not in by[("decode_convoy", "end")]
+
+
+def test_incidents_n_keeps_newest():
+    rows = incidents(_convoy_events(), t0_wall=0.0, n=2)
+    assert [r["kind"] for r in rows] == ["decode_convoy", "books_broken"]
+    assert incidents(_convoy_events(), n=0) == []
+
+
+# ----------------------------------------------------------------------
+# conservation laws: corrupt a counter, watch the whole chain fire
+
+def test_books_latch_event_and_report_exit2(tmp_path, capsys):
+    reg = telemetry._Registry()
+    reg.enable(str(tmp_path / "books.jsonl"))
+    aud = telemetry.BooksAuditor(registry=reg)
+    try:
+        books = {"accepted": 5, "served": 5}
+        aud.register("serve.books",
+                     lambda: None
+                     if books["accepted"] == books["served"]
+                     else "accepted %(accepted)d != served %(served)d"
+                     % books)
+        assert aud.sweep() == {"serve.books": None}
+        assert aud.snapshot()["broken"] == {}
+
+        books["served"] = 3          # the corruption: 2 requests vanish
+        res = aud.sweep()
+        assert "accepted 5 != served 3" in res["serve.books"]
+        snap = aud.snapshot()
+        assert snap["broken"] == {"serve.books": "accepted 5 != served 3"}
+        assert snap["violations"] == 1
+
+        # sticky: a later clean sweep must NOT clear the latch, and the
+        # event stream carries exactly one broken:1 transition
+        books["served"] = 5
+        aud.sweep()
+        assert aud.snapshot()["broken"] != {}
+        evs = [e for e in reg.recent_events()
+               if e.get("ev") == "books_broken"]
+        assert [(e["law"], e["broken"]) for e in evs] == \
+            [("serve.books", 1)]
+
+        # the offline gate: a log that ENDS latched exits 2
+        reg.flush()
+        path = reg.log_path
+        assert telemetry_report.main([path]) == 2
+        out = capsys.readouterr()
+        assert "conservation law" in out.err and "serve.books" in out.err
+        assert "LATCHED at end of log" in out.out
+
+        # operator reset emits the broken:0 clear; the gate opens
+        aud.reset()
+        assert aud.snapshot()["broken"] == {}
+        assert aud.snapshot()["violations"] == 1   # cumulative
+        reg.flush()
+        assert telemetry_report.main([path]) == 0
+        assert "all laws clear at end of log" in capsys.readouterr().out
+    finally:
+        aud.stop()
+        reg.disable()
+
+
+def test_report_incidents_and_autopsy_sections(tmp_path, capsys):
+    reg = telemetry._Registry()
+    reg.enable(str(tmp_path / "run.jsonl"))
+    try:
+        reg.record({"ev": "decode_convoy", "convoy": 1, "ts": 0.5,
+                    "slot": 0})
+        reg.record({"ev": "decode_convoy", "convoy": 0, "ts": 1.5,
+                    "slot": 0})
+        reg.record({"ev": "serve_request_done", "req": "7",
+                    "outcome": "served", "total_s": 1.0, "ts": 2.0,
+                    "autopsy": {"primary": "convoy_victim",
+                                "causes": {"convoy_victim": 0.8,
+                                           "decode_baseline": 0.2},
+                                "wall_s": 1.0}})
+        reg.flush()
+        path = reg.log_path
+    finally:
+        reg.disable()
+    assert telemetry_report.main([path, "--incidents"]) == 0
+    out = capsys.readouterr().out
+    assert "autopsy breakdown" in out
+    assert "convoy_victim" in out and "top primary verdicts" in out
+    assert "incident timeline" in out and "decode_convoy" in out
+    # --json carries the machine form of both sections
+    assert telemetry_report.main([path, "--incidents", "--json"]) == 0
+    agg = json.loads(capsys.readouterr().out)
+    assert agg["autopsy"]["primary"] == {"convoy_victim": 1}
+    assert [r["kind"] for r in agg["incidents"]] == \
+        ["decode_convoy", "decode_convoy"]
+
+
+def test_inconclusive_and_raising_laws_never_latch():
+    aud = telemetry.BooksAuditor(registry=telemetry._Registry())
+    aud.register("flaky", lambda: (_ for _ in ()).throw(RuntimeError()))
+    aud.register("quiet", lambda: None)
+    aud.sweep()
+    snap = aud.snapshot()
+    assert snap["broken"] == {} and snap["law_errors"] == 1
+    assert snap["laws"] == ["flaky", "quiet"]
+
+
+def test_autopsy_module_selftest():
+    assert autopsy.selftest() == 0

@@ -398,6 +398,62 @@ def test_metric_clean_no_false_positive(tmp_path):
     assert rules_of(res) == []
 
 
+def test_metric_doc_fires_on_undocumented_series(tmp_path):
+    # an exported series missing from the doc metric tables — named by
+    # its SCRAPE name (sanitized + type suffix), what an operator greps
+    res = lint_snippet(tmp_path, {"m.py": (
+        "from .utils import telemetry\n"
+        "def f():\n"
+        '    telemetry.count("serve.requests")\n')},
+        docs={"observability.md": "no metric tables here\n"})
+    assert_fires_once(res, "metric-doc")
+    assert "cxxnet_serve_requests_total" in res.findings[0].msg
+
+
+def test_metric_doc_latch_without_clear_fires(tmp_path):
+    # a transition-latch event with a set site but no constant clear
+    # site: the timeline would open episodes that never end
+    res = lint_snippet(tmp_path, {
+        "autopsy.py":
+            'TRANSITION_EVENTS = {"kv_pressure": "pressure"}\n',
+        "m.py": (
+            "from .utils import telemetry\n"
+            "def f():\n"
+            '    telemetry.event({"ev": "kv_pressure",'
+            ' "pressure": 1})\n')},
+        docs={"observability.md": "x\n"})
+    assert_fires_once(res, "metric-doc")
+    assert "kv_pressure" in res.findings[0].msg
+
+
+def test_metric_doc_clean_no_false_positive(tmp_path):
+    res = lint_snippet(tmp_path, {
+        "autopsy.py":
+            'TRANSITION_EVENTS = {"kv_pressure": "pressure"}\n',
+        "m.py": (
+            "from .utils import telemetry\n"
+            "def f():\n"
+            '    telemetry.count("serve.requests")\n'
+            '    telemetry.event({"ev": "kv_pressure",'
+            ' "pressure": 1})\n'
+            '    telemetry.event({"ev": "kv_pressure",'
+            ' "pressure": 0})\n')},
+        docs={"observability.md":
+              "| `cxxnet_serve_requests_total` | door count |\n"})
+    assert rules_of(res) == []
+
+
+def test_metric_doc_off_without_doc_files(tmp_path):
+    # neither observability.md nor serving.md in the doc dir: the rule
+    # is OFF (synthetic fixture packages must not drown in findings),
+    # exactly like the conf registry with no global.md
+    res = lint_snippet(tmp_path, {"m.py": (
+        "from .utils import telemetry\n"
+        "def f():\n"
+        '    telemetry.count("serve.requests")\n')})
+    assert rules_of(res) == []
+
+
 # ----------------------------------------------------------------------
 # baseline ratchet
 def fp(rule, n):

@@ -22,13 +22,14 @@ failover invariants:
   ``ERR backend rescued`` so the loss is replayable upstream.
 """
 
+import json
 import threading
 import time
 from urllib.request import urlopen
 
 import pytest
 
-from cxxnet_tpu.utils import routerd, servd, statusd, telemetry
+from cxxnet_tpu.utils import autopsy, routerd, servd, statusd, telemetry
 
 from . import faultinject
 from .test_routerd import (make_router, reconciles,  # noqa: F401
@@ -82,9 +83,20 @@ def test_kill_mid_decode_zero_loss_token_exact(make_router):
         wait_until(lambda: replica_stats(fleet[0])["in_flight"] >= 1,
                    msg="requests decoding aboard the victim")
         faultinject.kill9(fleet[0])
-        for t in ts:
-            t.join(timeout=30)
-        assert not any(t.is_alive() for t in ts)
+        # the conservation-law auditor sweeps CONTINUOUSLY through the
+        # kill + replay storm (ISSUE 19 acceptance: books_broken never
+        # latches under kill9) — replays ride outside the books, so a
+        # latch here means the failover path corrupted a counter
+        deadline = time.monotonic() + 30.0
+        while any(t.is_alive() for t in ts):
+            telemetry.audit_sweep()
+            for t in ts:
+                t.join(timeout=0.05)
+            assert time.monotonic() < deadline, "client wedged"
+        telemetry.audit_sweep()
+        broken = telemetry.auditor().snapshot()["broken"]
+        assert not set(broken) & {"route.books", "route.tenant_books",
+                                  "fleet.federation"}, broken
         # zero client-visible losses, every answer token-exact: the
         # victim's aboard requests replayed on the survivors
         for i, resp in enumerate(responses):
@@ -118,6 +130,24 @@ def test_kill_mid_decode_zero_loss_token_exact(make_router):
         page = urlopen("http://127.0.0.1:%d/fleetz" % rsrv.port,
                        timeout=5).read().decode()
         assert "failover:" in page and "replayed" in page
+        # the cross-process autopsy: a replayed request's /why on the
+        # ROUTER charges the dead lane to hedge_replay, names exactly
+        # one primary, and the causes tile the routed wall clock
+        rec = next(r for r in router.flight.list()
+                   if len(r.get("attempts") or []) > 1)
+        why = json.loads(urlopen(
+            "http://127.0.0.1:%d/why?request=%s&json=1"
+            % (rsrv.port, rec["id"]), timeout=5).read())
+        aut = why["autopsy"]
+        assert aut["primary"] in autopsy.CAUSES
+        assert aut["causes"]["hedge_replay"] > 0, aut
+        assert sum(aut["causes"].values()) >= 0.95 * aut["wall_s"] > 0
+        # the fleet timeline federates: the router's own /eventz rows
+        # carry a process tag (replica feeds merge in when live)
+        ez = json.loads(urlopen(
+            "http://127.0.0.1:%d/eventz?json=1" % rsrv.port,
+            timeout=5).read())
+        assert all("process" in r for r in ez["rows"])
     finally:
         if rsrv is not None:
             rsrv.stop()

@@ -2224,6 +2224,18 @@ def _books_reconcile(alloc):
     alloc.check()
 
 
+def _laws_hold():
+    """Sweep the process-global conservation-law auditor and assert no
+    serving law latched: the chaos ran with the books provably
+    balanced. A latch is sticky, so a single mid-storm violation
+    anywhere in the flood fails here even if the books reconcile again
+    by the time the assert runs."""
+    telemetry.audit_sweep()
+    broken = telemetry.auditor().snapshot()["broken"]
+    assert not set(broken) & {"serve.books", "serve.tenant_books",
+                              "kv.blocks"}, broken
+
+
 def test_retained_kv_exhaustion_chaos_flood(make_frontend):
     """THE never-OOM acceptance: mixed multi-turn + one-shot traffic
     floods a pool far too small to hold every conversation's cache.
@@ -2276,9 +2288,18 @@ def test_retained_kv_exhaustion_chaos_flood(make_frontend):
                 for z in (1, 2)]
     for t in clients:
         t.start()
-    for t in clients:
-        t.join(120.0)
-        assert not t.is_alive(), "chaos client wedged (deadlock?)"
+    # the conservation-law auditor sweeps CONTINUOUSLY through the
+    # chaos (ISSUE 19 acceptance: books_broken never latches under the
+    # eviction storm) — a mid-flight inconsistency a law cannot prove
+    # persistent stays inconclusive by design, so any latch IS real
+    deadline = time.monotonic() + 120.0
+    while any(t.is_alive() for t in clients):
+        telemetry.audit_sweep()
+        for t in clients:
+            t.join(0.05)
+        assert time.monotonic() < deadline, \
+            "chaos client wedged (deadlock?)"
+    _laws_hold()
     for name, out in sorted(results.items()):
         for line, r in out:
             t0 = int(line.split()[0])
@@ -2331,6 +2352,7 @@ def test_retained_eviction_storm_and_revive_race(make_frontend):
                 t0 = int(line.split()[0])
                 assert r == _expect_line(t0, 4), (knobs, turn, line, r)
             _books_reconcile(sb.alloc)
+            _laws_hold()        # no conservation law latched mid-storm
         assert sb.closed == 0
         stats = fe.drain()
         assert reconciles(stats)
@@ -2421,3 +2443,43 @@ def test_kv_pressure_latch_sheds_retained(make_frontend):
     assert reconciles(stats)
     assert stats["accepted"] == stats["served"] == 7
     _books_reconcile(sb.alloc)
+
+
+# -- request autopsy on a live flood (utils/autopsy.py; ISSUE 19) -----
+def test_autopsy_warm_flood_zero_compile_stall(make_frontend):
+    """The autopsy acceptance on a live flood: requests riding the
+    warm-up pay the compile cliff (compile_stall > 0 on their
+    verdicts), and a warm-bucket flood afterwards attributes EXACTLY
+    zero seconds to compile_stall — the classifier must not smear the
+    cliff onto requests that rode warm programs. Every verdict tiles
+    >= 95% of the request's wall clock."""
+    sb = faultinject.slot_backend(buckets=(4,), n_new=4,
+                                  per_token_s=0.001, compile_ms=40.0)
+    fe = make_frontend(None, slot_backend=sb, batch_max=4,
+                       batch_window_ms=0.0, drain_ms=15000.0)
+    # warm-up: the first request compiles session + prefill + step
+    assert faultinject.serve_request(fe.port, "1 2 3 4",
+                                     timeout=30.0) == _expect_line(1, 4)
+    warm_rec = fe.flight.list()[0]
+    assert warm_rec["compile_stall_s"] > 0
+    aut = warm_rec["autopsy"]
+    assert aut["causes"]["compile_stall"] > 0
+    assert sum(aut["causes"].values()) >= 0.95 * aut["wall_s"] > 0
+    # warm flood: the same prompt shape on the warm bucket — the jit-
+    # cache twin has seen every key, so zero stall, zero smearing
+    lines = [" ".join(str(10 * i + k) for k in range(4))
+             for i in range(2, 8)]
+    resps = faultinject.serve_flood(fe.port, lines, timeout=30.0)
+    for line, r in zip(lines, resps):
+        assert r == _expect_line(int(line.split()[0]), 4), (line, r)
+    recs = [r for r in fe.flight.list() if r["id"] != warm_rec["id"]]
+    assert len(recs) == len(lines)
+    for rec in recs:
+        aut = rec["autopsy"]
+        assert rec["compile_stall_s"] == 0.0
+        assert aut["causes"]["compile_stall"] == 0.0       # exactly 0
+        assert aut["primary"] != "compile_stall"
+        assert sum(aut["causes"].values()) >= 0.95 * aut["wall_s"] > 0
+    stats = fe.drain()
+    assert reconciles(stats)
+    assert stats["accepted"] == stats["served"] == 7

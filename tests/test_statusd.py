@@ -19,7 +19,7 @@ from urllib.request import urlopen
 import numpy as np
 import pytest
 
-from cxxnet_tpu.utils import health, statusd, telemetry
+from cxxnet_tpu.utils import autopsy, health, statusd, telemetry
 from cxxnet_tpu.utils.telemetry import HIST_BUCKETS, Histogram
 
 from . import faultinject
@@ -618,6 +618,118 @@ def test_hbm_decode_kv_row_renders():
     for line in text.splitlines():
         if line and not line.startswith("#"):
             assert statusd.PROM_LINE_RE.match(line), line
+
+
+# ----------------------------------------------------------------------
+# endpoint query contract: derived from the ENDPOINTS table, so a new
+# endpoint cannot ship without declaring (and honoring) its flags
+@pytest.mark.parametrize("path,has_json,has_n", statusd.ENDPOINTS)
+def test_endpoint_query_contract(server, path, has_json, has_n):
+    qs = "?request=0" if path == "/why" else ""
+    code, _ = _get(server, path + qs)
+    assert code < 500, (path, code)
+    if has_json:
+        sep = "&" if qs else "?"
+        code, body = _get(server, path + qs + sep + "json=1")
+        assert code < 500, (path, code)
+        if code == 200:
+            json.loads(body)        # 200 + ?json=1 must be strict JSON
+    if has_n:
+        code, body = _get(server, path + "?n=x")
+        assert code == 400 and "integer" in body, (path, code)
+        assert _get(server, path + "?n=1")[0] < 500, path
+
+
+def test_404_lists_every_endpoint(server):
+    code, body = _get(server, "/nope")
+    assert code == 404
+    for p, _, _ in statusd.ENDPOINTS:
+        assert p in body, (p, body)
+
+
+# ----------------------------------------------------------------------
+# /why: the per-request slowdown autopsy over a real socket
+def test_why_endpoint_replica_autopsy(server):
+    fr = telemetry.FlightRecorder()
+    fr.record({"id": "42", "outcome": "served", "t_wall": 5.0,
+               "total_s": 2.0,
+               "phases": {"queue_wait": 0.1, "dispatch": 0.0,
+                          "prefill": 1.5, "decode": 0.4},
+               "compile_stall_s": 1.4})
+    server.flight = fr
+    code, body = _get(server, "/why?request=42&json=1")
+    assert code == 200
+    why = json.loads(body)
+    assert why["id"] == "42" and why["hops"] == {}
+    aut = why["autopsy"]
+    assert aut["primary"] == "compile_stall"
+    # acceptance shape: causes tile >= 95% of wall, all 8 named
+    assert sum(aut["causes"].values()) >= 0.95 * aut["wall_s"] > 0
+    assert set(aut["causes"]) == set(autopsy.CAUSES)
+    code, page = _get(server, "/why?request=42")
+    assert code == 200
+    assert "PRIMARY VERDICT" in page and "compile_stall" in page
+    code, body = _get(server, "/why?request=nope")
+    assert code == 404 and "/requestz" in body
+    code, body = _get(server, "/why")
+    assert code == 400 and "request" in body
+
+
+# ----------------------------------------------------------------------
+# /eventz: the incident timeline over a real socket
+def test_eventz_timeline(registry, server):
+    registry.record({"ev": "kv_pressure", "pressure": 1, "ts": 1.0})
+    registry.record({"ev": "serve_drain", "ts": 1.5})
+    registry.record({"ev": "kv_pressure", "pressure": 0, "ts": 2.0})
+    code, body = _get(server, "/eventz?json=1")
+    assert code == 200
+    ev = json.loads(body)
+    kinds = [(r["kind"], r["state"]) for r in ev["rows"]]
+    assert kinds == [("kv_pressure", "begin"), ("serve_drain", "point"),
+                     ("kv_pressure", "end")]
+    assert ev["shown"] == 3
+    walls = [r["t_wall"] for r in ev["rows"]]
+    assert walls == sorted(walls)
+    # ?n keeps the NEWEST rows (freshest incidents first out the door)
+    ev = json.loads(_get(server, "/eventz?json=1&n=1")[1])
+    assert ev["shown"] == 1 and ev["rows"][0]["state"] == "end"
+    code, page = _get(server, "/eventz")
+    assert code == 200 and "kv_pressure" in page
+
+
+# ----------------------------------------------------------------------
+# conservation laws on the scrape path: cxxnet_books_broken latches
+def test_books_broken_gauge_latches_in_scrape(registry, server):
+    # a PRIVATE auditor on the server: latches must never leak into the
+    # process-global one other suites scrape
+    aud = telemetry.BooksAuditor(registry=registry)
+    server.auditor = aud
+    books = {"debit": 2, "credit": 2}
+    aud.register("test.books",
+                 lambda: None if books["debit"] == books["credit"]
+                 else "debit %d != credit %d"
+                 % (books["debit"], books["credit"]))
+    text = _get(server, "/metrics")[1]
+    _parse_prom(text)
+    assert 'cxxnet_books_broken{process="0",law="test.books"} 0' in text
+    assert "cxxnet_books_laws" in text
+    assert "cxxnet_books_sweeps_total" in text
+    books["credit"] = 5          # the corruption: books stop balancing
+    text = _get(server, "/metrics")[1]
+    assert 'cxxnet_books_broken{process="0",law="test.books"} 1' in text
+    # sticky: the law reconciling again must NOT clear the latch
+    books["credit"] = 2
+    text = _get(server, "/metrics")[1]
+    _parse_prom(text)
+    assert 'cxxnet_books_broken{process="0",law="test.books"} 1' in text
+    # unregistering (a drained subsystem) must not hide the latch either
+    aud.unregister("test.books")
+    text = _get(server, "/metrics")[1]
+    assert 'cxxnet_books_broken{process="0",law="test.books"} 1' in text
+    # the violation became exactly one transition event in the stream
+    evs = [e for e in registry.recent_events()
+           if e.get("ev") == "books_broken"]
+    assert [(e["law"], e["broken"]) for e in evs] == [("test.books", 1)]
 
 
 def test_statusd_selftest():

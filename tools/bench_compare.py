@@ -30,7 +30,10 @@ baseline publishes ``"<metric>.<field>"`` — e.g.
 row's TTFT tail, and
 ``"serve_fleet_p99_latency_ms.ttft_p99_ms"`` /
 ``".retry_rate"`` gate the routed-fleet row's tail and retry pressure
-(the fleet TTFT comes from the router↔replica trace-id join)
+(the fleet TTFT comes from the router↔replica trace-id join), and
+``"serve_throughput_rps.autopsy_compile_stall_pct"`` /
+``".books_violations"`` gate the flood's compile-stall share and the
+conservation-law auditor's violation count (both worse when HIGHER)
 (direction-aware: ``*_ms`` / ``*_rate`` sub-fields are
 worse when higher; null values skip cleanly like headline rows).
 """
@@ -131,6 +134,16 @@ def sub_lower_is_better(key, line):
         # warm-grid readiness (the compile-cliff account): a drop means
         # more of the program grid is cold at admission — worse LOWER
         return False
+    if k == "autopsy_compile_stall_pct":
+        # the autopsy's compile-stall share of flood wall time (the
+        # serve_throughput_rps row): a rise means more of the flood sat
+        # behind cold programs — worse when HIGHER, unlike the other
+        # _pct sub-fields that measure utilization
+        return True
+    if k == "books_violations":
+        # the conservation-law auditor's violation count for the run:
+        # any rise above the published 0 is bookkeeping corruption
+        return True
     if k == "noisy_shed_rate":
         return False
     if k.endswith("_rps") or "tokens_per_s" in k or "occupancy" in k \

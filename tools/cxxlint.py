@@ -48,6 +48,13 @@ metric registry
                      a literal Prometheus counter must end in _total)
     metric-collision two distinct series names that collide after
                      Prometheus sanitization (both become cxxnet_a_b)
+    metric-doc       an exported ``cxxnet_*`` series that appears in no
+                     backticked span of doc/observability.md or
+                     doc/serving.md (the doc tables ARE the dashboard
+                     contract), or a transition-latch event (autopsy
+                     TRANSITION_EVENTS) missing a constant set (=1) or
+                     clear (=0) record site — a latch nobody clears is
+                     a permanent false alarm
 
 Suppression (reason REQUIRED — an empty reason is itself a finding)::
 
@@ -98,6 +105,8 @@ RULES = {
     "metric-type": "one series name used as two metric types",
     "metric-suffix": "metric unit-suffix convention violation",
     "metric-collision": "two series names collide after sanitization",
+    "metric-doc": "exported cxxnet_* series missing from the doc metric "
+                  "tables, or latch event without set+clear sites",
     "bad-suppression": "cxxlint disable comment without a reason",
 }
 
@@ -128,6 +137,9 @@ HINTS = {
                      "suffix from the raw name",
     "metric-collision": "rename one series; both sanitize to the same "
                         "Prometheus name",
+    "metric-doc": "add a backticked row to doc/observability.md (or "
+                  "serving.md); a latch event needs literal =1 set and "
+                  "=0 clear record sites",
     "bad-suppression": "a suppression must say WHY: "
                        "`# cxxlint: disable=<rule> — <reason>`",
 }
@@ -1468,6 +1480,142 @@ def metric_findings(project: Project) -> List[Finding]:
 
 
 # ----------------------------------------------------------------------
+# metric documentation: the /metrics surface vs the doc tables
+# ----------------------------------------------------------------------
+
+# Every series statusd can export must appear (backticked) in one of the
+# two operator-facing pages — the doc tables are what dashboards and
+# alert rules are built from, so an undocumented series is a dashboard
+# nobody can write. Exported names are derived exactly the way statusd
+# derives them: telemetry series sanitize [^A-Za-z0-9_] -> '_', gain the
+# cxxnet_ prefix, and counters/histograms gain _total/_seconds; literal
+# emit() names are already full Prometheus names.
+METRIC_DOC_FILES = ("observability.md", "serving.md")
+# backticked spans AND fenced scrape examples both document a series, so
+# the scan is any word-boundary occurrence in the two pages
+METRIC_DOC_TOKEN_RE = re.compile(r"\b(cxxnet_[A-Za-z0-9_]+)")
+METRIC_EXPORT_SUFFIX = {"counter": "_total", "gauge": "",
+                        "histogram": "_seconds"}
+
+
+def _doc_metric_tokens(doc_dir: str) -> Optional[Set[str]]:
+    toks: Set[str] = set()
+    seen_any = False
+    for fn in METRIC_DOC_FILES:
+        try:
+            with open(os.path.join(doc_dir, fn), encoding="utf-8") as f:
+                text = f.read()
+        except OSError:
+            continue
+        seen_any = True
+        toks.update(METRIC_DOC_TOKEN_RE.findall(text))
+    return toks if seen_any else None
+
+
+def _transition_table(project: Project) -> Dict[str, str]:
+    """autopsy.py's TRANSITION_EVENTS literal, read from the AST (the
+    linter never imports the package)."""
+    for mod in project.modules.values():
+        if os.path.basename(mod.path) != "autopsy.py":
+            continue
+        for node in mod.nodes:
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Dict):
+                continue
+            names = [dotted(t) for t in node.targets]
+            if "TRANSITION_EVENTS" not in names:
+                continue
+            table: Dict[str, str] = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                ks, vs = const_str(k), const_str(v)
+                if ks is not None and vs is not None:
+                    table[ks] = vs
+            return table
+    return {}
+
+
+def metric_doc_findings(project: Project,
+                        doc_dir: str) -> List[Finding]:
+    out: List[Finding] = []
+    doc = _doc_metric_tokens(doc_dir)
+    if doc is None:
+        return out
+
+    # exported name -> first (path, line) that creates it
+    exported: Dict[str, Tuple[str, int]] = {}
+    # transition kind -> field -> {const values seen} / first site
+    latch_vals: Dict[str, Set[object]] = defaultdict(set)
+    latch_site: Dict[str, Tuple[str, int]] = {}
+    table = _transition_table(project)
+    for mod in project.modules.values():
+        for node in mod.nodes:
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            f = node.func
+            fname = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if fname in METRIC_FUNCS:
+                recv = dotted(f.value) if isinstance(f, ast.Attribute) \
+                    else ""
+                recv = recv or ""
+                if recv not in ("telemetry", "reg", "self.reg") \
+                        and not recv.endswith(".telemetry"):
+                    continue
+                name = const_str(node.args[0])
+                if name is None:
+                    continue
+                prom = "cxxnet_" + re.sub(r"[^A-Za-z0-9_]", "_", name) \
+                    + METRIC_EXPORT_SUFFIX[METRIC_FUNCS[fname]]
+                exported.setdefault(prom, (mod.path, node.lineno))
+            elif isinstance(f, ast.Name) and f.id == "emit" \
+                    and len(node.args) >= 2:
+                name = const_str(node.args[0])
+                if name is not None and name.startswith("cxxnet_"):
+                    exported.setdefault(name, (mod.path, node.lineno))
+            if fname in ("record", "event") \
+                    and isinstance(node.args[0], ast.Dict):
+                d = node.args[0]
+                kv = {const_str(k): v
+                      for k, v in zip(d.keys, d.values)
+                      if const_str(k) is not None}
+                kind = const_str(kv.get("ev")) if "ev" in kv else None
+                if kind in table and table[kind] in kv:
+                    v = kv[table[kind]]
+                    if isinstance(v, ast.Constant) \
+                            and isinstance(v.value, int):
+                        latch_vals[kind].add(bool(v.value))
+                        latch_site.setdefault(kind,
+                                              (mod.path, node.lineno))
+
+    for prom in sorted(exported):
+        if prom.startswith("cxxnet_selftest_"):
+            continue    # selftest fixtures are not operator surface
+        if prom not in doc:
+            path, line = exported[prom]
+            out.append(Finding(
+                "metric-doc", path, line,
+                "exported series %r appears in no backticked span of "
+                "doc/{observability,serving}.md" % prom, key=prom))
+    for kind in sorted(table):
+        field = table[kind]
+        vals = latch_vals.get(kind, set())
+        missing = []
+        if True not in vals:
+            missing.append("set (%s=1)" % field)
+        if False not in vals:
+            missing.append("clear (%s=0)" % field)
+        if missing:
+            path, line = latch_site.get(
+                kind, (os.path.join(ROOT, PKG, "utils", "autopsy.py"), 0))
+            out.append(Finding(
+                "metric-doc", path, line,
+                "transition event %r has no constant %s record site"
+                % (kind, " or ".join(missing)),
+                key="latch:" + kind))
+    return out
+
+
+# ----------------------------------------------------------------------
 # assembly: suppressions, baseline ratchet, CLI
 # ----------------------------------------------------------------------
 
@@ -1500,6 +1648,8 @@ def run_lint(root: str = ROOT, pkg: str = PKG,
     findings.extend(err_vocab_findings(
         project, doc_dir or os.path.join(root, "doc")))
     findings.extend(metric_findings(project))
+    findings.extend(metric_doc_findings(
+        project, doc_dir or os.path.join(root, "doc")))
 
     by_path = {m.path: m for m in project.modules.values()}
     kept: List[Finding] = []

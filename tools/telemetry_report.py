@@ -3,7 +3,7 @@
 
 Usage:
     python tools/telemetry_report.py run.jsonl [--top N] [--trace out.json]
-                                               [--json]
+                                               [--json] [--incidents]
     python tools/telemetry_report.py --merge shard0.jsonl shard1.jsonl ...
                                                [--top N] [--json]
     python tools/telemetry_report.py --fleet router.jsonl replica0.jsonl ...
@@ -28,6 +28,17 @@ the transition-only ``batch_iteration`` events, admission-latency
 percentiles, the ``serve.queue_age`` distribution, and the
 ``decode_convoy`` episode account — a log that ends with the convoy
 latched is flagged unresolved).
+An autopsy-breakdown section summarizes the slowdown verdicts the
+serving processes stamp on ``serve_request_done`` /
+``route_request_done`` events (utils/autopsy.py): per-cause attributed
+seconds (p50/p99 across requests), the primary-verdict histogram, and
+the top-5 primary verdicts; a conservation-laws section reports the
+``books_broken`` transitions of the metrics auditor
+(telemetry.BooksAuditor). ``--incidents`` additionally renders the
+fleet incident timeline — every transition-only event stream (convoy,
+KV pressure, SLO burn, outliers, breaker, scale/reload/drain, broken
+books) merged into one wall-clock-ordered list, the offline twin of the
+live ``/eventz`` endpoint.
 ``--trace`` additionally exports a chrome://tracing / Perfetto JSON built
 from the span tree. ``--json`` emits the aggregate as one JSON object
 instead of the table (for scripting).
@@ -59,10 +70,12 @@ that is not valid JSON, or no telemetry events at all) OR a log with
 inline ``resolution`` field) ever answered, OR a log whose LAST
 ``serve_breaker`` event (per process) left the circuit breaker open,
 OR a log whose LAST ``slo_burn`` event (per process) left the SLO
-error budget burning (state 1) — CI gates on this so neither a broken
-emitter, an unrecovered training anomaly, a serving run that ended with
-its backend shedding, nor one that ended blowing its SLOs can silently
-pass.
+error budget burning (state 1), OR a log whose LAST ``books_broken``
+event (per process and law) left a conservation law latched broken —
+CI gates on this so neither a broken emitter, an unrecovered training
+anomaly, a serving run that ended with its backend shedding, one that
+ended blowing its SLOs, nor one whose metrics books stopped reconciling
+can silently pass.
 """
 
 import json
@@ -72,6 +85,7 @@ import sys
 sys.path.insert(0, os.path.join(
     os.path.dirname(os.path.abspath(__file__)), ".."))
 
+from cxxnet_tpu.utils import autopsy  # noqa: E402
 from cxxnet_tpu.utils.perf import MEASURED_SERIES  # noqa: E402
 from cxxnet_tpu.utils.telemetry import (  # noqa: E402
     HIST_BUCKETS, Histogram, count_by, events_to_chrome, fmt_ms,
@@ -198,6 +212,7 @@ def aggregate(events):
     program_cards = {}
     batch_events = []
     convoy_events = []
+    books_events = []
 
     def proc(ev):
         p = int(ev.get("p", 0))
@@ -275,6 +290,9 @@ def aggregate(events):
             proc(ev)
         elif kind == "decode_convoy":
             convoy_events.append(ev)
+            proc(ev)
+        elif kind == "books_broken":
+            books_events.append(ev)
             proc(ev)
         elif kind == "program_card":
             # the performance ledger's per-compiled-program card
@@ -447,6 +465,51 @@ def aggregate(events):
                          for p, ev in final.items()},
                "burning": sorted(p for p, ev in final.items()
                                  if int(ev.get("state", 0)))}
+    # autopsy breakdown: the slowdown verdicts the serving processes
+    # stamp on their done events (utils/autopsy.py) — per-cause
+    # attributed seconds and the primary-verdict histogram
+    auts = [ev["autopsy"] for ev in requests + route_requests
+            if isinstance(ev.get("autopsy"), dict)]
+    autopsy_agg = None
+    if auts:
+        cause_vals = {}
+        for a in auts:
+            for c, s in (a.get("causes") or {}).items():
+                cause_vals.setdefault(c, []).append(float(s))
+        cause_stats = {}
+        for c, vals in sorted(cause_vals.items()):
+            vals.sort()
+            cause_stats[c] = {
+                "requests": sum(1 for v in vals if v > 0),
+                "total_s": round(sum(vals), 6),
+                "p50_ms": round(1e3 * percentile(vals, 50), 4),
+                "p99_ms": round(1e3 * percentile(vals, 99), 4)}
+        prim = count_by(auts, "primary")
+        autopsy_agg = {
+            "count": len(auts),
+            "causes": cause_stats,
+            "primary": prim,
+            "top_primary": sorted(prim.items(),
+                                  key=lambda kv: (-kv[1], kv[0]))[:5]}
+    # conservation laws: books_broken transitions (telemetry
+    # BooksAuditor) — the LAST state per (process, law) is the gate; a
+    # log that ends with any law latched broken exits 2, because every
+    # other number in this report is then suspect
+    books = None
+    if books_events:
+        final_bk = {}
+        for ev in books_events:         # events arrive time-sorted
+            final_bk[(int(ev.get("p", 0)), str(ev.get("law")))] = ev
+        books = {
+            "transitions": len(books_events),
+            "final": {"p%d:%s" % k: int(ev.get("broken", 0))
+                      for k, ev in sorted(final_bk.items())},
+            "details": {"p%d:%s" % k: ev.get("detail")
+                        for k, ev in sorted(final_bk.items())
+                        if ev.get("detail")},
+            "latched": sorted("p%d:%s" % k
+                              for k, ev in final_bk.items()
+                              if int(ev.get("broken", 0)))}
     # batch scheduler: per-bucket occupancy/waste from the
     # batch_iteration events (transition-only — one event per
     # composition CHANGE). Reconstruction is exact: the event at
@@ -582,6 +645,7 @@ def aggregate(events):
            "gauges": gauges, "rounds": rounds, "health": health,
            "serving": serving, "requests": req_agg, "fleet": fleet,
            "slo": slo, "programs": programs, "batch": batch,
+           "autopsy": autopsy_agg, "books": books,
            "hists": {}}
     for name, h in sorted(merged_hists.items()):
         st = h.stats()
@@ -789,6 +853,21 @@ def print_report(agg, top=15):
             print("recompile-attributed requests: %s"
                   % " ".join("req=%s(%d)" % kv for kv in
                              rq["recompile_requests"].items()))
+    au = agg.get("autopsy")
+    if au:
+        print("\n== autopsy breakdown (slowdown verdicts) ==")
+        print("requests with verdicts: %d" % au["count"])
+        print("%-16s %9s %10s %10s %10s" %
+              ("cause", "requests", "total_s", "p50_ms", "p99_ms"))
+        for c in autopsy.CAUSES:
+            st = au["causes"].get(c)
+            if st:
+                print("%-16s %9d %10.3f %10.2f %10.2f" %
+                      (c, st["requests"], st["total_s"],
+                       st["p50_ms"], st["p99_ms"]))
+        print("top primary verdicts: %s"
+              % "  ".join("%s(%d)" % (c, n)
+                          for c, n in au["top_primary"]))
     bt = agg.get("batch")
     if bt:
         print("\n== batch scheduler (iteration-level decode "
@@ -876,6 +955,16 @@ def print_report(agg, top=15):
             print("  process %s final: %s (burn rate %sx)"
                   % (p, "BURNING" if st["state"] else "within budget",
                      st.get("burn_rate")))
+    bk = agg.get("books")
+    if bk:
+        print("\n== conservation laws (metrics books) ==")
+        print("books_broken transitions: %d%s"
+              % (bk["transitions"],
+                 "   LATCHED at end of log: %s"
+                 % ", ".join(bk["latched"]) if bk["latched"]
+                 else "   all laws clear at end of log"))
+        for k, d in sorted(bk.get("details", {}).items()):
+            print("  %-28s %s" % (k, d))
     pg = agg.get("programs")
     if pg:
         print("\n== program ledger (per-compiled-program perf cards) ==")
@@ -942,6 +1031,7 @@ def main(argv):
     as_json = False
     merge = False
     fleet = False
+    want_incidents = False
     paths = []
     i = 0
     while i < len(argv):
@@ -960,6 +1050,9 @@ def main(argv):
             i += 1
         elif a == "--fleet":
             fleet = True
+            i += 1
+        elif a == "--incidents":
+            want_incidents = True
             i += 1
         elif a.startswith("--"):
             print("unknown option %s" % a, file=sys.stderr)
@@ -987,6 +1080,13 @@ def main(argv):
         events = load_events(paths[0])
         label = paths[0]
     agg = aggregate(events)
+    if want_incidents:
+        # the offline twin of the live /eventz endpoint: t_wall aligns
+        # on the earliest shard's wall epoch (single log: its own)
+        t0s = [float(ev.get("t0_wall", 0.0)) for ev in events
+               if ev.get("ev") == "meta"]
+        agg["incidents"] = autopsy.incidents(
+            events, t0_wall=min(t0s) if t0s else 0.0)
     if as_json:
         print(json.dumps(agg, indent=1))
     else:
@@ -996,6 +1096,20 @@ def main(argv):
         elif merge:
             print("merged %d shard(s): %s\n" % (len(paths), label))
         print_report(agg, top=top)
+        if want_incidents:
+            print("\n== incident timeline ==")
+            rows = agg["incidents"]
+            if not rows:
+                print("(no transition or point incidents in this log)")
+            for r in rows:
+                ev = r["event"]
+                detail = " ".join(
+                    "%s=%s" % (k, ev[k]) for k in sorted(ev)
+                    if k not in ("ev", "ts", "p")
+                    and not isinstance(ev[k], (dict, list)))
+                print("%10.3fs p=%-3s %-20s %-6s %s"
+                      % (r["ts"], ev.get("p", 0), r["kind"],
+                         r["state"], detail))
     if trace_out:
         with open(trace_out, "w") as f:
             json.dump(events_to_chrome(events), f)
@@ -1019,6 +1133,12 @@ def main(argv):
         print("%s: SLO error-budget burn rate still exceeded at end of "
               "log (process %s) — the run ended blowing its objectives"
               % (label, ", ".join(burning)), file=sys.stderr)
+        return 2
+    latched = (agg.get("books") or {}).get("latched", [])
+    if latched:
+        print("%s: conservation law(s) still latched BROKEN at end of "
+              "log (%s) — every other number in this report is suspect"
+              % (label, ", ".join(latched)), file=sys.stderr)
         return 2
     return 0
 
